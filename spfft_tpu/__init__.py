@@ -1,0 +1,35 @@
+"""spfft_tpu — a TPU-native sparse 3D FFT framework.
+
+A from-scratch rebuild of the capabilities of SpFFT (reference mounted at
+/root/reference) on JAX/XLA: sparse frequency-domain 3D FFTs (spherical-cutoff
+plane-wave sets), C2C and R2C with hermitian-symmetry exploitation, positive
+and centered indexing, single/double precision, batched multi-transform
+execution, and distributed slab<->pencil decomposition over a TPU device mesh
+via ``shard_map`` + ``lax.all_to_all``.
+"""
+
+from .errors import (AllocationError, DeviceAllocationError, DeviceError,
+                     DeviceFFTError, DeviceSupportError, DistributedError,
+                     DistributedSupportError, DuplicateIndicesError, ErrorCode,
+                     FFTError, GenericError, HostExecutionError, InternalError,
+                     InvalidIndicesError, InvalidParameterError, OverflowError_,
+                     ParameterMismatchError)
+from .indexing import IndexPlan, build_index_plan, check_stick_duplicates
+from .plan import TransformPlan, make_local_plan
+from .types import (ExchangeType, IndexFormat, ProcessingUnit, Scaling,
+                    TransformType)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "ErrorCode", "GenericError", "AllocationError", "OverflowError_",
+    "InvalidParameterError",
+    "DuplicateIndicesError", "InvalidIndicesError", "DistributedSupportError",
+    "DistributedError", "ParameterMismatchError", "HostExecutionError",
+    "FFTError", "InternalError", "DeviceError", "DeviceSupportError",
+    "DeviceAllocationError", "DeviceFFTError",
+    "ExchangeType", "ProcessingUnit", "IndexFormat", "TransformType",
+    "Scaling",
+    "IndexPlan", "build_index_plan", "check_stick_duplicates",
+    "TransformPlan", "make_local_plan",
+]

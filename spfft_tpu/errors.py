@@ -1,0 +1,155 @@
+"""Typed error taxonomy for spfft_tpu.
+
+Mirrors the reference exception hierarchy and C error-code enum
+(reference: include/spfft/exceptions.hpp:40-295, include/spfft/errors.h:33-126).
+Where the reference distinguishes CUDA/ROCm ("GPU") failures, this framework
+reports TPU/XLA device failures through the single :class:`DeviceError` branch —
+XLA surfaces device problems as runtime errors on the jitted callable, so the
+fine-grained GPU sub-errors (launch/copy/invalid-pointer/...) have no TPU
+counterpart and are collapsed.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class ErrorCode(enum.IntEnum):
+    """Stable error codes, mirroring ``SpfftError`` (reference: errors.h:33-126).
+
+    GPU-specific codes that have no TPU counterpart are kept for API parity so
+    code written against the reference's enum can be migrated mechanically.
+    """
+
+    SUCCESS = 0
+    UNKNOWN = 1
+    INVALID_HANDLE = 2
+    OVERFLOW = 3
+    ALLOCATION = 4
+    INVALID_PARAMETER = 5
+    DUPLICATE_INDICES = 6
+    INVALID_INDICES = 7
+    DISTRIBUTED_SUPPORT = 8   # reference: SPFFT_MPI_SUPPORT_ERROR
+    DISTRIBUTED = 9           # reference: SPFFT_MPI_ERROR
+    PARAMETER_MISMATCH = 10   # reference: SPFFT_MPI_PARAMETER_MISMATCH_ERROR
+    HOST_EXECUTION = 11
+    FFT = 12                  # reference: SPFFT_FFTW_ERROR
+    DEVICE = 13               # reference: SPFFT_GPU_ERROR
+    DEVICE_PRECEDING = 14
+    DEVICE_SUPPORT = 15
+    DEVICE_ALLOCATION = 16
+    DEVICE_LAUNCH = 17
+    DEVICE_NO_DEVICE = 18
+    DEVICE_INVALID_VALUE = 19
+    DEVICE_INVALID_DEVICE_PTR = 20
+    DEVICE_COPY = 21
+    DEVICE_FFT = 22
+
+
+class GenericError(Exception):
+    """Base class for all spfft_tpu errors (reference: exceptions.hpp:40-47)."""
+
+    code = ErrorCode.UNKNOWN
+
+    def error_code(self) -> ErrorCode:
+        return self.code
+
+
+class OverflowError_(GenericError):
+    """Integer overflow in size computation (reference: exceptions.hpp:50-59)."""
+
+    code = ErrorCode.OVERFLOW
+
+
+class AllocationError(GenericError):
+    """Failed buffer allocation (reference: exceptions.hpp:62-71)."""
+
+    code = ErrorCode.ALLOCATION
+
+
+class InvalidParameterError(GenericError):
+    """Invalid parameter passed to a plan or transform
+    (reference: exceptions.hpp:74-83)."""
+
+    code = ErrorCode.INVALID_PARAMETER
+
+
+class DuplicateIndicesError(GenericError):
+    """Duplicate z-stick indices — typically a z-column owned by two shards
+    (reference: exceptions.hpp:86-95, indices.hpp:105-117)."""
+
+    code = ErrorCode.DUPLICATE_INDICES
+
+
+class InvalidIndicesError(GenericError):
+    """Frequency-domain index triplet out of bounds
+    (reference: exceptions.hpp:98-107, indices.hpp:137-149)."""
+
+    code = ErrorCode.INVALID_INDICES
+
+
+class DistributedSupportError(GenericError):
+    """Distributed operation requested without a device mesh
+    (reference: exceptions.hpp:110-121, MPISupportError)."""
+
+    code = ErrorCode.DISTRIBUTED_SUPPORT
+
+
+class DistributedError(GenericError):
+    """Failure in a collective/distributed operation
+    (reference: exceptions.hpp:124-131, MPIError)."""
+
+    code = ErrorCode.DISTRIBUTED
+
+
+class ParameterMismatchError(GenericError):
+    """Plan parameters disagree across shards/hosts
+    (reference: exceptions.hpp:134-145, MPIParameterMismatchError;
+    cross-rank checks grid_internal.cpp:148-167, parameters.cpp:92-109)."""
+
+    code = ErrorCode.PARAMETER_MISMATCH
+
+
+class HostExecutionError(GenericError):
+    """Failed execution on host (reference: exceptions.hpp:148-157)."""
+
+    code = ErrorCode.HOST_EXECUTION
+
+
+class FFTError(GenericError):
+    """Failure inside the FFT backend (reference: exceptions.hpp:160-167,
+    FFTWError; here: XLA Fft HLO)."""
+
+    code = ErrorCode.FFT
+
+
+class InternalError(GenericError):
+    """Internal consistency failure (reference: exceptions.hpp:170-177)."""
+
+    code = ErrorCode.UNKNOWN
+
+
+class DeviceError(GenericError):
+    """TPU/XLA device-side failure (reference: exceptions.hpp:183-190,
+    GPUError branch)."""
+
+    code = ErrorCode.DEVICE
+
+
+class DeviceSupportError(DeviceError):
+    """Device execution requested but no accelerator is available
+    (reference: exceptions.hpp:193-204)."""
+
+    code = ErrorCode.DEVICE_SUPPORT
+
+
+class DeviceAllocationError(DeviceError):
+    """Failed allocation on device (reference: exceptions.hpp:221-230)."""
+
+    code = ErrorCode.DEVICE_ALLOCATION
+
+
+class DeviceFFTError(DeviceError):
+    """Failure in the device FFT path (reference: exceptions.hpp:295-304)."""
+
+    code = ErrorCode.DEVICE_FFT

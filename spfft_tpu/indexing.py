@@ -1,0 +1,200 @@
+"""Index planning: sparse frequency triplets -> z-stick tables.
+
+Vectorised NumPy reimplementation of the semantics of the reference index
+conversion (reference: src/compression/indices.hpp:120-186 ``convert_index_triplets``,
+:49-55 ``to_storage_index``, :105-117 ``check_stick_duplicates``) and the local
+half of the distribution plan (reference: src/parameters/parameters.cpp:143-180).
+
+All planning is host-side NumPy: it runs once per plan, produces static index
+tables, and those tables become device-resident constants of the jitted
+transform — mirroring how the reference computes all indices at plan time and
+never at execute time (SURVEY.md §3.1).
+
+Conventions (identical to the reference):
+
+* A "z-stick" is the set of all sparse values sharing an (x, y) index pair;
+  sticks are keyed by ``x * dim_y + y`` and ordered ascending by that key
+  (indices.hpp:152-165 uses an ordered map with the same key).
+* Each value maps to the flat index ``stick_id * dim_z + z`` into the packed
+  stick array (indices.hpp:168-176).
+* Negative ("centered") indices map to storage via ``dim + index``
+  (indices.hpp:49-55). Centered indexing is detected by any negative index
+  (indices.hpp:129-135).
+* Bounds (indices.hpp:137-149): for a dimension of size n, centered indices
+  must lie in [floor(n/2) - n + 1, floor(n/2)], non-negative ones in [0, n-1];
+  hermitian (R2C) transforms additionally require x in [0, floor(n/2)]
+  (docs/source/details.rst "Real-To-Complex Transforms").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .errors import (DuplicateIndicesError, InvalidIndicesError,
+                     InvalidParameterError)
+from .types import TransformType
+
+
+def to_storage_index(dim: int, index: np.ndarray) -> np.ndarray:
+    """Map [-N, N) frequency indices to [0, N) storage indices
+    (reference: indices.hpp:49-55)."""
+    return np.where(index < 0, index + dim, index)
+
+
+def _check_triplet_bounds(hermitian: bool, centered: bool,
+                          dim_x: int, dim_y: int, dim_z: int,
+                          x: np.ndarray, y: np.ndarray, z: np.ndarray) -> None:
+    """Bounds validation, exactly as reference indices.hpp:137-149."""
+    max_x = (dim_x // 2 + 1 if (hermitian or centered) else dim_x) - 1
+    max_y = (dim_y // 2 + 1 if centered else dim_y) - 1
+    max_z = (dim_z // 2 + 1 if centered else dim_z) - 1
+    min_x = 0 if hermitian else max_x - dim_x + 1
+    min_y = max_y - dim_y + 1
+    min_z = max_z - dim_z + 1
+    if ((x < min_x).any() or (x > max_x).any()
+            or (y < min_y).any() or (y > max_y).any()
+            or (z < min_z).any() or (z > max_z).any()):
+        raise InvalidIndicesError(
+            f"index triplet out of bounds for dims ({dim_x},{dim_y},{dim_z}), "
+            f"hermitian={hermitian}, centered={centered}")
+
+
+def convert_index_triplets(hermitian: bool, dim_x: int, dim_y: int, dim_z: int,
+                           triplets: np.ndarray):
+    """Convert (n, 3) index triplets into per-value flat indices and the
+    ordered unique stick-key list.
+
+    Returns ``(value_indices, stick_keys, centered)`` where
+    ``value_indices[i] = stick_id(i) * dim_z + z_storage(i)`` and
+    ``stick_keys`` is the ascending list of unique ``x*dim_y + y`` keys.
+
+    Semantics of reference indices.hpp:120-186, vectorised.
+    """
+    triplets = np.asarray(triplets)
+    if triplets.ndim != 2 or triplets.shape[1] != 3:
+        raise InvalidParameterError(
+            f"expected (n, 3) index triplets, got shape {triplets.shape}")
+    if not np.issubdtype(triplets.dtype, np.integer):
+        raise InvalidParameterError(
+            f"index triplets must be integers, got dtype {triplets.dtype}")
+    n = triplets.shape[0]
+    if n > dim_x * dim_y * dim_z:
+        raise InvalidParameterError(
+            "more frequency values than grid elements (indices.hpp:126-128)")
+
+    x, y, z = (triplets[:, 0].astype(np.int64), triplets[:, 1].astype(np.int64),
+               triplets[:, 2].astype(np.int64))
+    centered = bool((triplets < 0).any())
+    _check_triplet_bounds(hermitian, centered, dim_x, dim_y, dim_z, x, y, z)
+
+    xs = to_storage_index(dim_x, x)
+    ys = to_storage_index(dim_y, y)
+    zs = to_storage_index(dim_z, z)
+
+    keys = xs * dim_y + ys
+    stick_keys, stick_ids = np.unique(keys, return_inverse=True)
+    value_indices = stick_ids.astype(np.int64) * dim_z + zs
+    return (value_indices.astype(np.int32), stick_keys.astype(np.int32),
+            centered)
+
+
+def check_stick_duplicates(stick_keys_per_shard: Sequence[np.ndarray]) -> None:
+    """Raise if any z-stick appears on more than one shard
+    (reference: indices.hpp:105-117)."""
+    all_keys = np.concatenate([np.asarray(k) for k in stick_keys_per_shard]) \
+        if stick_keys_per_shard else np.empty(0, np.int32)
+    if all_keys.size != np.unique(all_keys).size:
+        raise DuplicateIndicesError(
+            "z-stick (x,y) index owned by more than one shard")
+
+
+@dataclasses.dataclass(frozen=True)
+class IndexPlan:
+    """Static index tables for one shard's sparse frequency set.
+
+    The local analogue of the reference ``Parameters`` object
+    (reference: src/parameters/parameters.hpp:48-156): everything a transform
+    needs to gather/scatter sparse values and place sticks in the frequency
+    grid, computed once at plan time.
+    """
+
+    transform_type: TransformType
+    dim_x: int
+    dim_y: int
+    dim_z: int
+    centered: bool
+    #: per-value flat index ``stick_id * dim_z + z`` (indices.hpp:168-176)
+    value_indices: np.ndarray
+    #: ascending unique ``x*dim_y + y`` stick keys (indices.hpp:179-185)
+    stick_keys: np.ndarray
+
+    @property
+    def num_values(self) -> int:
+        return int(self.value_indices.shape[0])
+
+    @property
+    def num_sticks(self) -> int:
+        return int(self.stick_keys.shape[0])
+
+    @property
+    def hermitian(self) -> bool:
+        return self.transform_type == TransformType.R2C
+
+    @property
+    def dim_x_freq(self) -> int:
+        """Frequency-domain x extent: ``dim_x//2 + 1`` for R2C
+        (reference: parameters.cpp:49), else ``dim_x``."""
+        return self.dim_x // 2 + 1 if self.hermitian else self.dim_x
+
+    @property
+    def stick_x(self) -> np.ndarray:
+        """Storage x index of each stick."""
+        return self.stick_keys // self.dim_y
+
+    @property
+    def stick_y(self) -> np.ndarray:
+        """Storage y index of each stick."""
+        return self.stick_keys % self.dim_y
+
+    @property
+    def scatter_cols(self) -> np.ndarray:
+        """Column index of each stick in the x-innermost frequency plane
+        ``(dim_y, dim_x_freq)`` flattened: ``y * dim_x_freq + x``.
+
+        The reference keeps a y-innermost plane on host and x-innermost on GPU
+        (execution_host.cpp:147-151 vs execution_gpu.cpp:85-86); this framework
+        uses x-innermost everywhere so the space-domain output is directly in
+        the user layout ``(z*Ny + y)*Nx + x`` (docs/source/details.rst
+        "Indexing") with no final transpose.
+        """
+        return (self.stick_y * self.dim_x_freq + self.stick_x).astype(np.int32)
+
+    @property
+    def zero_stick_id(self) -> Optional[int]:
+        """Position of the (x=0, y=0) stick, or None if absent — the stick that
+        receives hermitian completion for R2C (reference: parameters.cpp:133-139)."""
+        hits = np.nonzero(self.stick_keys == 0)[0]
+        return int(hits[0]) if hits.size else None
+
+
+def build_index_plan(transform_type: TransformType,
+                     dim_x: int, dim_y: int, dim_z: int,
+                     triplets: np.ndarray) -> IndexPlan:
+    """Build the index plan for one shard's triplet list.
+
+    Dimension/parameter validation mirrors reference grid_internal.cpp:122-145
+    and transform_internal.cpp:52-83.
+    """
+    if dim_x < 1 or dim_y < 1 or dim_z < 1:
+        raise InvalidParameterError(
+            f"dimensions must be >= 1, got ({dim_x},{dim_y},{dim_z})")
+    transform_type = TransformType(transform_type)
+    hermitian = transform_type == TransformType.R2C
+    value_indices, stick_keys, centered = convert_index_triplets(
+        hermitian, dim_x, dim_y, dim_z, triplets)
+    return IndexPlan(transform_type=transform_type, dim_x=dim_x, dim_y=dim_y,
+                     dim_z=dim_z, centered=centered,
+                     value_indices=value_indices, stick_keys=stick_keys)

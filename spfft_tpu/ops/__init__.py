@@ -1,0 +1,3 @@
+"""Device-side computation stages (jit-traceable) for sparse 3D FFTs."""
+
+from . import stages  # noqa: F401

@@ -1,0 +1,191 @@
+"""Jit-traceable computation stages of the sparse 3D FFT pipeline.
+
+Each function here is one phase of the reference execution pipeline
+(reference: src/execution/execution_host.cpp:249-352), re-expressed as a pure
+JAX function over complex arrays:
+
+* decompress / compress  — sparse value scatter/gather
+  (reference: src/compression/compression_host.hpp:50-93)
+* z_backward / z_forward — batched 1D FFT along z over sticks
+  (reference: src/fft/transform_1d_host.hpp, transform_1d_gpu.hpp)
+* sticks_to_grid / grid_to_sticks — the local stick<->plane transpose
+  (reference: src/transpose/transpose_host.hpp:94-154)
+* xy_* — batched 1D/2D FFTs over planes
+* complete_stick_hermitian / complete_plane_hermitian — R2C fixups
+  (reference: src/symmetry/symmetry_host.hpp:38-95)
+
+Transform convention (docs/source/details.rst "Transform Definition"): the
+backward transform is the *unnormalised* inverse DFT (sum with e^{+2πikn/N}),
+i.e. ``ifft * N``; the forward transform is the plain DFT with optional
+1/(Nx·Ny·Nz) scaling applied at compression time.
+
+Everything here is meant to run *inside* ``jax.jit``: complex dtypes are not
+reliably materialisable on the TPU host boundary, so plan objects convert
+to/from interleaved real arrays at the edges (see plan.py) and XLA fuses these
+stages into a handful of kernels.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+# ---------------------------------------------------------------------------
+# Compression: sparse values <-> packed z-stick array
+# ---------------------------------------------------------------------------
+
+def decompress(values, value_indices, num_sticks: int, dim_z: int):
+    """Scatter sparse values into a zeroed packed stick array.
+
+    reference: compression_host.hpp:76-93 (zero sticks then scatter by the
+    flat ``stick_id * dim_z + z`` index list).
+
+    Args:
+      values: (num_values,) complex.
+      value_indices: (num_values,) int32 flat indices.
+    Returns:
+      (num_sticks, dim_z) complex stick array.
+    """
+    flat = jnp.zeros((num_sticks * dim_z,), values.dtype)
+    flat = flat.at[value_indices].set(values, mode="drop")
+    return flat.reshape(num_sticks, dim_z)
+
+
+def compress(sticks, value_indices, scale=None):
+    """Gather sparse values out of the packed stick array, optionally scaled
+    (reference: compression_host.hpp:50-72)."""
+    flat = sticks.reshape(-1)
+    values = flat[value_indices]
+    if scale is not None:
+        values = values * jnp.asarray(scale, values.real.dtype)
+    return values
+
+
+# ---------------------------------------------------------------------------
+# z-stage: batched 1D FFT over sticks
+# ---------------------------------------------------------------------------
+
+def z_backward(sticks):
+    """Unnormalised inverse DFT along z for every stick:
+    ``ifft * dim_z`` (reference backward z, execution_host.cpp:311-315)."""
+    dim_z = sticks.shape[-1]
+    return jnp.fft.ifft(sticks, axis=-1) * sticks.real.dtype.type(dim_z)
+
+
+def z_forward(sticks):
+    """Forward DFT along z for every stick (reference forward z,
+    execution_host.cpp:283-290)."""
+    return jnp.fft.fft(sticks, axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# Local transpose: packed sticks <-> frequency-domain planes
+# ---------------------------------------------------------------------------
+
+def sticks_to_grid(sticks, scatter_cols, num_planes: int, dim_y: int,
+                   dim_x_freq: int):
+    """Scatter z-transformed sticks into a zeroed plane grid.
+
+    reference: transpose_host.hpp:132-154 (backward unpack: zero the grid,
+    then place each stick at its xy index). The grid layout is x-innermost
+    ``(planes, dim_y, dim_x_freq)`` — see IndexPlan.scatter_cols.
+
+    Args:
+      sticks: (num_sticks, num_planes) complex — stick-major, z-restricted.
+      scatter_cols: (num_sticks,) int32 — ``y * dim_x_freq + x`` per stick.
+    Returns:
+      (num_planes, dim_y, dim_x_freq) complex.
+    """
+    flat = jnp.zeros((num_planes, dim_y * dim_x_freq), sticks.dtype)
+    flat = flat.at[:, scatter_cols].set(sticks.T, mode="drop")
+    return flat.reshape(num_planes, dim_y, dim_x_freq)
+
+
+def grid_to_sticks(grid, scatter_cols):
+    """Gather sticks out of the plane grid (reference forward pack,
+    transpose_host.hpp:94-116).
+
+    Returns (num_sticks, num_planes) complex.
+    """
+    num_planes = grid.shape[0]
+    flat = grid.reshape(num_planes, -1)
+    return flat[:, scatter_cols].T
+
+
+# ---------------------------------------------------------------------------
+# Hermitian symmetry completion (R2C backward only;
+# reference applies stick symmetry before the z-FFT and plane symmetry after
+# the exchange — execution_host.cpp:306-308, 340-342)
+# ---------------------------------------------------------------------------
+
+def complete_stick_hermitian(stick):
+    """Complete the (x=0, y=0) z-stick: missing entries become the conjugate
+    of their mirror, provided entries win.
+
+    Functional form of reference symmetry_host.hpp:69-91 (nonzero-guarded
+    ``stick[N-i] = conj(stick[i])``); identical on valid inputs where each
+    (+z, -z) pair has at least one consistent value supplied
+    (docs/source/details.rst "Real-To-Complex Transforms").
+    """
+    mirror = jnp.roll(stick[::-1], 1)  # mirror[i] = stick[(N - i) % N]
+    return jnp.where(stick != 0, stick, jnp.conj(mirror))
+
+
+def complete_plane_hermitian(grid):
+    """Complete the x=0 column of every z-plane along y: missing ±y entries
+    become the conjugate of their mirror (reference symmetry_host.hpp:41-64;
+    tolerates either +y or -y being supplied).
+
+    Args:
+      grid: (planes, dim_y, dim_x_freq) complex.
+    """
+    col = grid[:, :, 0]
+    mirror = jnp.roll(col[:, ::-1], 1, axis=1)
+    col = jnp.where(col != 0, col, jnp.conj(mirror))
+    return grid.at[:, :, 0].set(col)
+
+
+# ---------------------------------------------------------------------------
+# xy-stage: batched FFTs over planes
+# ---------------------------------------------------------------------------
+
+def xy_backward_c2c(grid):
+    """Unnormalised inverse DFT over (y, x) per plane:
+    ``ifft2 * (dim_y * dim_x)``.
+
+    The reference transforms y over only the non-empty x-rows then x over full
+    planes (execution_host.cpp:139-145, 328-352); on TPU a dense batched 2D
+    FFT is one XLA Fft HLO and the row-sparsity bookkeeping would serialise
+    it, so density is the faster choice here.
+    """
+    dim_y, dim_x = grid.shape[-2], grid.shape[-1]
+    scale = grid.real.dtype.type(dim_y * dim_x)
+    return jnp.fft.ifft2(grid, axes=(-2, -1)) * scale
+
+
+def xy_forward_c2c(grid):
+    """Forward DFT over (y, x) per plane."""
+    return jnp.fft.fft2(grid, axes=(-2, -1))
+
+
+def xy_backward_r2c(grid, dim_x: int):
+    """R2C backward xy-stage: inverse y DFT then real inverse x DFT.
+
+    ``grid`` is (planes, dim_y, dim_x//2+1) complex; returns real
+    (planes, dim_y, dim_x). Mirrors reference backward_xy with the c2r
+    x-transform (execution_host.cpp:344-351, transform_real_1d_host.hpp).
+    """
+    dim_y = grid.shape[-2]
+    rdtype = grid.real.dtype
+    grid = jnp.fft.ifft(grid, axis=-2) * rdtype.type(dim_y)
+    return jnp.fft.irfft(grid, n=dim_x, axis=-1) * rdtype.type(dim_x)
+
+
+def xy_forward_r2c(space):
+    """R2C forward xy-stage: real forward x DFT then y DFT.
+
+    ``space`` is real (planes, dim_y, dim_x); returns
+    (planes, dim_y, dim_x//2+1) complex.
+    """
+    grid = jnp.fft.rfft(space, axis=-1)
+    return jnp.fft.fft(grid, axis=-2)

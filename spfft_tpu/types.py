@@ -1,0 +1,74 @@
+"""Public enums for spfft_tpu.
+
+Mirrors the reference's ``SpfftExchangeType`` / ``SpfftProcessingUnitType`` /
+``SpfftIndexFormatType`` / ``SpfftTransformType`` / ``SpfftScalingType``
+(reference: include/spfft/types.h:33-106), re-expressed for a TPU runtime:
+
+* The reference's six MPI exchange algorithms (Alltoall / Alltoallv / Alltoallw,
+  each optionally with a single-precision wire format) collapse on TPU to one
+  XLA ``all_to_all`` collective over the ICI mesh on a padded block layout (the
+  natural fit for XLA's fixed-shape collectives — reference BUFFERED variant,
+  types.h:40-46).  The enum is kept so the wire-precision option remains
+  selectable: the ``*_FLOAT`` variants cast the exchanged block to the next
+  lower precision around the collective, halving ICI bytes exactly as the
+  reference halves MPI bytes (docs/source/details.rst "MPI Exchange").
+* ``ProcessingUnit`` keeps the HOST=1 / DEVICE=2 bitmask values
+  (types.h:67-76, SPFFT_PU_HOST/SPFFT_PU_GPU) so call sites translate 1:1.
+  On TPU, DEVICE means "arrays stay committed to TPU HBM"; HOST means numpy
+  in/out with implicit transfer.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class ExchangeType(enum.Enum):
+    """Distributed exchange algorithm selector (reference: types.h:33-62).
+
+    On TPU every variant lowers to ``lax.all_to_all`` on a padded
+    ``(shards, max_sticks, max_planes)`` block; the distinctions that remain
+    meaningful are wire precision (``*_FLOAT``) and, for COMPACT/UNBUFFERED,
+    a compact (unpadded, ragged-concat) wire layout.
+    """
+
+    DEFAULT = "default"
+    BUFFERED = "buffered"
+    BUFFERED_FLOAT = "buffered_float"
+    COMPACT_BUFFERED = "compact_buffered"
+    COMPACT_BUFFERED_FLOAT = "compact_buffered_float"
+    UNBUFFERED = "unbuffered"
+
+    @property
+    def float_wire(self) -> bool:
+        """True if the on-wire precision is reduced (reference: types.h:43-57)."""
+        return self in (ExchangeType.BUFFERED_FLOAT,
+                        ExchangeType.COMPACT_BUFFERED_FLOAT)
+
+
+class ProcessingUnit(enum.IntFlag):
+    """Where transform I/O lives (reference: types.h:67-76)."""
+
+    HOST = 1    # SPFFT_PU_HOST
+    DEVICE = 2  # SPFFT_PU_GPU — on this framework: TPU HBM
+
+
+class IndexFormat(enum.Enum):
+    """Sparse frequency-index format (reference: types.h:78-83)."""
+
+    TRIPLETS = "triplets"  # SPFFT_INDEX_TRIPLETS: interleaved x,y,z
+
+
+class TransformType(enum.Enum):
+    """Transform kind (reference: types.h:85-95)."""
+
+    C2C = "c2c"
+    R2C = "r2c"
+
+
+class Scaling(enum.Enum):
+    """Forward-transform scaling (reference: types.h:97-106; normalization
+    spec docs/source/details.rst "Normalization")."""
+
+    NONE = "none"   # SPFFT_NO_SCALING
+    FULL = "full"   # SPFFT_FULL_SCALING: multiply forward output by 1/(Nx*Ny*Nz)

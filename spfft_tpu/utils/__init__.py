@@ -1,0 +1,3 @@
+from .dtypes import (as_complex_np, as_interleaved, complex_dtype,
+                     interleaved_to_complex, complex_to_interleaved,
+                     real_dtype)  # noqa: F401

@@ -1,0 +1,72 @@
+"""Precision handling and the interleaved-real <-> complex boundary.
+
+The reference stores complex data as interleaved double/single pairs and
+guarantees std::complex layout compatibility (docs/source/details.rst
+"Complex Number Format"). This framework keeps the same boundary format for a
+TPU-specific reason as well: complex arrays are not reliably materialisable at
+the TPU host boundary, so every jitted transform takes and returns *real*
+arrays with a trailing interleaved axis of extent 2 and converts to complex
+only inside the traced computation.
+
+Precision names follow the reference's double/single split
+(SPFFT_SINGLE_PRECISION, reference CMakeLists.txt:36): "double" = f64/c128
+(host/CPU oracle paths; requires jax x64), "single" = f32/c64 (the native TPU
+precision).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..errors import InvalidParameterError
+
+_REAL = {"double": np.float64, "single": np.float32}
+_COMPLEX = {"double": np.complex128, "single": np.complex64}
+
+
+def real_dtype(precision: str):
+    try:
+        return _REAL[precision]
+    except KeyError:
+        raise InvalidParameterError(
+            f"precision must be 'double' or 'single', got {precision!r}")
+
+
+def complex_dtype(precision: str):
+    real_dtype(precision)
+    return _COMPLEX[precision]
+
+
+def interleaved_to_complex(arr):
+    """(..., 2) real (traced) -> (...) complex. Jit-safe."""
+    return jnp.asarray(arr[..., 0] + 1j * arr[..., 1])
+
+
+def complex_to_interleaved(arr):
+    """(...) complex (traced) -> (..., 2) real. Jit-safe."""
+    return jnp.stack([jnp.real(arr), jnp.imag(arr)], axis=-1)
+
+
+def as_interleaved(arr, precision: str) -> np.ndarray:
+    """Coerce host-side input (numpy complex, or real already-interleaved)
+    into the canonical (..., 2) real layout at the plan's precision."""
+    arr = np.asarray(arr)
+    rdt = real_dtype(precision)
+    if np.issubdtype(arr.dtype, np.complexfloating):
+        out = np.empty(arr.shape + (2,), rdt)
+        out[..., 0] = arr.real
+        out[..., 1] = arr.imag
+        return out
+    if arr.ndim >= 1 and arr.shape[-1] == 2:
+        return np.ascontiguousarray(arr, rdt)
+    raise InvalidParameterError(
+        "expected complex array or interleaved real array with trailing "
+        f"axis 2, got dtype {arr.dtype} shape {arr.shape}")
+
+
+def as_complex_np(interleaved) -> np.ndarray:
+    """Host-side (..., 2) real -> numpy complex."""
+    arr = np.asarray(interleaved)
+    cdt = np.complex128 if arr.dtype == np.float64 else np.complex64
+    return (arr[..., 0] + 1j * arr[..., 1]).astype(cdt)

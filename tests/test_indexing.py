@@ -1,0 +1,97 @@
+"""Unit tests for the index planner (semantics of reference
+src/compression/indices.hpp and src/parameters/parameters.cpp)."""
+
+import numpy as np
+import pytest
+
+from spfft_tpu import (DuplicateIndicesError, InvalidIndicesError,
+                       InvalidParameterError, TransformType, build_index_plan,
+                       check_stick_duplicates)
+from spfft_tpu.indexing import convert_index_triplets, to_storage_index
+
+
+def test_storage_index_conversion():
+    # reference: indices.hpp:49-55
+    idx = np.array([0, 1, -1, -4, 3])
+    np.testing.assert_array_equal(to_storage_index(8, idx), [0, 1, 7, 4, 3])
+
+
+def test_stick_ordering_matches_reference():
+    # Sticks keyed x*dimY + y, ascending (reference: indices.hpp:152-165).
+    triplets = np.array([
+        [2, 1, 0],   # key 2*4+1 = 9
+        [0, 3, 1],   # key 3
+        [1, 0, 2],   # key 4
+        [0, 3, 0],   # key 3 (same stick)
+    ])
+    vi, keys, centered = convert_index_triplets(False, 3, 4, 5, triplets)
+    assert not centered
+    np.testing.assert_array_equal(keys, [3, 4, 9])
+    # value flat index = stick_id * dimZ + z (reference: indices.hpp:168-176)
+    np.testing.assert_array_equal(vi, [2 * 5 + 0, 0 * 5 + 1, 1 * 5 + 2,
+                                       0 * 5 + 0])
+
+
+def test_centered_detection_and_conversion():
+    # Any negative index flips the whole set to centered interpretation
+    # (reference: indices.hpp:129-135).
+    triplets = np.array([[0, 0, 0], [-1, 2, -3]])
+    vi, keys, centered = convert_index_triplets(False, 8, 8, 8, triplets)
+    assert centered
+    # storage: (-1 -> 7), z: -3 -> 5
+    np.testing.assert_array_equal(keys, [0, 7 * 8 + 2])
+    np.testing.assert_array_equal(vi, [0, 1 * 8 + 5])
+
+
+@pytest.mark.parametrize("bad", [
+    [[8, 0, 0]],             # x out of non-centered range
+    [[0, -5, 0]],            # y below centered min for dim 8: min = -3
+    [[5, 0, -1]],            # centered mode: max x = 4 for dim 8
+    [[0, -1, 5]],            # centered mode: max z = 4 for dim 8
+])
+def test_bounds_checking(bad):
+    # reference: indices.hpp:137-149
+    with pytest.raises(InvalidIndicesError):
+        convert_index_triplets(False, 8, 8, 8, np.asarray(bad, np.int64))
+
+
+def test_hermitian_bounds():
+    # R2C: x must be in [0, dimX/2] (details.rst "Real-To-Complex")
+    convert_index_triplets(True, 8, 8, 8, np.array([[4, 7, 7]]))
+    with pytest.raises(InvalidIndicesError):
+        convert_index_triplets(True, 8, 8, 8, np.array([[5, 0, 0]]))
+    with pytest.raises(InvalidIndicesError):
+        convert_index_triplets(True, 8, 8, 8, np.array([[-1, 0, 0]]))
+
+
+def test_too_many_values_rejected():
+    # reference: indices.hpp:126-128
+    triplets = np.zeros((9, 3), np.int64)
+    with pytest.raises(InvalidParameterError):
+        convert_index_triplets(False, 2, 2, 2, triplets)
+
+
+def test_duplicate_stick_detection_across_shards():
+    # reference: indices.hpp:105-117
+    check_stick_duplicates([np.array([1, 2]), np.array([3])])
+    with pytest.raises(DuplicateIndicesError):
+        check_stick_duplicates([np.array([1, 2]), np.array([2])])
+
+
+def test_index_plan_properties():
+    plan = build_index_plan(TransformType.R2C, 8, 6, 4,
+                            np.array([[0, 0, 0], [2, 5, 3], [0, 0, 2]]))
+    assert plan.dim_x_freq == 5
+    assert plan.num_sticks == 2
+    assert plan.num_values == 3
+    assert plan.zero_stick_id == 0
+    np.testing.assert_array_equal(plan.stick_x, [0, 2])
+    np.testing.assert_array_equal(plan.stick_y, [0, 5])
+    # x-innermost scatter columns: y * dim_x_freq + x
+    np.testing.assert_array_equal(plan.scatter_cols, [0, 5 * 5 + 2])
+
+
+def test_zero_stick_absent():
+    plan = build_index_plan(TransformType.C2C, 4, 4, 4,
+                            np.array([[1, 1, 0]]))
+    assert plan.zero_stick_id is None

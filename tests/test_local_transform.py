@@ -1,0 +1,147 @@
+"""Local (single-device) C2C and R2C transforms vs the dense FFT oracle.
+
+Mirrors reference tests/local_tests/test_local_transform.cpp and the oracle
+strategy of tests/test_util/test_transform.hpp: random sparse sets, dense
+numpy FFT comparison, the reference dimension matrix (primes, evens,
+degenerate 1s), centered and non-centered indexing, and the repeated-backward
+check for missing buffer zeroing (test_transform.hpp:129-131)."""
+
+import numpy as np
+import pytest
+
+from spfft_tpu import Scaling, TransformType, make_local_plan
+from spfft_tpu.utils import as_complex_np
+
+from test_util import (center_triplets, dense_backward, dense_cube_from_values,
+                       dense_forward, hermitian_triplets, random_sparse_triplets,
+                       random_values, sample_cube, tolerance_for)
+
+DIMS = [
+    (1, 1, 1),
+    (2, 2, 2),
+    (11, 11, 11),
+    (12, 12, 12),
+    (13, 13, 13),
+    (2, 11, 13),
+    (13, 12, 1),
+    (1, 12, 13),
+    (100, 100, 100),
+]
+
+
+@pytest.mark.parametrize("dims", DIMS)
+@pytest.mark.parametrize("centered", [False, True])
+@pytest.mark.parametrize("precision", ["double", "single"])
+def test_c2c_backward_forward(dims, centered, precision):
+    rng = np.random.default_rng(42)
+    triplets = random_sparse_triplets(rng, dims)
+    if centered:
+        triplets = center_triplets(triplets, dims)
+    values = random_values(rng, len(triplets))
+
+    cube = dense_cube_from_values(triplets, values, dims)
+    space_oracle = dense_backward(cube)
+
+    plan = make_local_plan(TransformType.C2C, *dims, triplets,
+                           precision=precision)
+    tol = tolerance_for(precision, space_oracle)
+
+    # backward twice: catches missing buffer zeroing (test_transform.hpp:129-146)
+    for _ in range(2):
+        space = as_complex_np(np.asarray(plan.backward(values)))
+        assert space.shape == (dims[2], dims[1], dims[0])
+        np.testing.assert_allclose(space, space_oracle, atol=tol, rtol=0)
+
+    # forward from the oracle space field, compare at sparse positions
+    # (test_transform.hpp:151-219)
+    freq_oracle = dense_forward(space_oracle)
+    expected = sample_cube(freq_oracle, triplets, dims)
+    tol_f = tolerance_for(precision, expected)
+    got = as_complex_np(np.asarray(plan.forward(space_oracle)))
+    np.testing.assert_allclose(got, expected, atol=tol_f, rtol=0)
+
+    # FULL scaling divides by the grid size (details.rst "Normalization")
+    got_scaled = as_complex_np(
+        np.asarray(plan.forward(space_oracle, Scaling.FULL)))
+    np.testing.assert_allclose(got_scaled,
+                               expected / (dims[0] * dims[1] * dims[2]),
+                               atol=tol_f, rtol=0)
+
+
+@pytest.mark.parametrize("dims", [(2, 2, 2), (11, 12, 13), (12, 11, 13),
+                                  (13, 11, 12), (32, 32, 32), (1, 5, 6)])
+@pytest.mark.parametrize("precision", ["double", "single"])
+def test_r2c_roundtrip(dims, precision):
+    """R2C with reduced hermitian provision: redundant x=0 columns omitted,
+    some provided at -y, (0,0) stick half-omitted
+    (reference: test_transform.hpp:221-276)."""
+    rng = np.random.default_rng(42)
+    nx, ny, nz = dims
+    space = rng.uniform(-1, 1, (nz, ny, nx))
+    freq = dense_forward(space)
+
+    triplets = hermitian_triplets(rng, dims)
+    values = sample_cube(freq, triplets, dims)
+
+    plan = make_local_plan(TransformType.R2C, *dims, triplets,
+                           precision=precision)
+    tol = tolerance_for(precision, space * space.size)
+
+    for _ in range(2):
+        got = np.asarray(plan.backward(values))
+        assert got.shape == space.shape
+        np.testing.assert_allclose(got, space * space.size, atol=tol, rtol=0)
+
+    got_freq = as_complex_np(np.asarray(plan.forward(space)))
+    tol_f = tolerance_for(precision, values)
+    np.testing.assert_allclose(got_freq, values, atol=tol_f, rtol=0)
+
+
+def test_r2c_centered_indexing():
+    """Centered (negative) indices with hermitian symmetry."""
+    rng = np.random.default_rng(7)
+    dims = (8, 9, 10)
+    space = rng.uniform(-1, 1, (dims[2], dims[1], dims[0]))
+    freq = dense_forward(space)
+    triplets = center_triplets(hermitian_triplets(rng, dims), dims)
+    values = sample_cube(freq, triplets, dims)
+    plan = make_local_plan(TransformType.R2C, *dims, triplets,
+                           precision="double")
+    got = np.asarray(plan.backward(values))
+    np.testing.assert_allclose(got, space * space.size, atol=1e-8, rtol=0)
+
+
+def test_empty_value_set():
+    """Zero sparse values is legal (empty shards exist in the distributed
+    case, reference execution_host.cpp:167-179) and yields a zero field."""
+    plan = make_local_plan(TransformType.C2C, 4, 4, 4,
+                           np.empty((0, 3), np.int32), precision="double")
+    space = as_complex_np(np.asarray(plan.backward(np.empty(0, np.complex128))))
+    assert space.shape == (4, 4, 4)
+    np.testing.assert_array_equal(space, 0)
+
+
+def test_forward_backward_identity_with_scaling():
+    """forward(FULL) then backward is the identity (details.rst
+    "Normalization")."""
+    rng = np.random.default_rng(3)
+    dims = (6, 5, 4)
+    triplets = random_sparse_triplets(rng, dims)
+    values = random_values(rng, len(triplets))
+    plan = make_local_plan(TransformType.C2C, *dims, triplets,
+                           precision="double")
+    space = plan.backward(values)
+    values2 = as_complex_np(np.asarray(plan.forward(space, Scaling.FULL)))
+    back = as_complex_np(np.asarray(plan.backward(values2)))
+    ref = as_complex_np(np.asarray(space))
+    np.testing.assert_allclose(back, ref, atol=1e-9 * max(1, np.abs(ref).max()))
+
+
+def test_input_validation():
+    from spfft_tpu import InvalidParameterError
+    plan = make_local_plan(TransformType.C2C, 4, 4, 4,
+                           np.array([[0, 0, 0]]), precision="double")
+    with pytest.raises(InvalidParameterError):
+        plan.backward(np.zeros(5, np.complex128))
+    with pytest.raises(InvalidParameterError):
+        plan.forward(np.zeros((3, 3, 3), np.complex128))

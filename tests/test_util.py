@@ -1,0 +1,131 @@
+"""Shared fixtures: randomized sparse index generation and dense FFT oracles.
+
+Reimplements the semantics of the reference test fixtures
+(reference: tests/test_util/generate_indices.hpp:38-136 — seeded random stick
+sets with ~0.7 stick fraction and ~0.7 z-fill, optional centered conversion)
+and the dense-oracle comparison strategy
+(reference: tests/test_util/test_transform.hpp:40-47 — every sparse transform
+is checked against a dense 3D FFT of the same cube; here numpy.fft instead of
+FFTW).
+
+Layouts: dense cubes and space-domain slabs are indexed [z, y, x] (the
+reference's memory order (z*Ny + y)*Nx + x, docs/source/details.rst
+"Indexing"); triplets are (x, y, z).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def random_sparse_triplets(rng: np.random.Generator, dims,
+                           stick_fraction: float = 0.7,
+                           fill_fraction: float = 0.7) -> np.ndarray:
+    """Random C2C sparse set: a subset of (x, y) sticks, each with a random
+    subset of z values (reference: generate_indices.hpp:38-85)."""
+    nx, ny, nz = dims
+    num_keys = nx * ny
+    num_sticks = max(1, int(round(stick_fraction * num_keys)))
+    keys = rng.choice(num_keys, size=num_sticks, replace=False)
+    triplets = []
+    for key in np.sort(keys):
+        x, y = int(key) // ny, int(key) % ny
+        num_z = max(1, int(round(fill_fraction * nz)))
+        for z in np.sort(rng.choice(nz, size=num_z, replace=False)):
+            triplets.append((x, y, int(z)))
+    return np.asarray(triplets, np.int32)
+
+
+def center_triplets(triplets: np.ndarray, dims) -> np.ndarray:
+    """Convert storage triplets to centered (negative-frequency) indexing
+    (reference: generate_indices.hpp:87-99): i -> i - n for i > n/2."""
+    out = triplets.astype(np.int64).copy()
+    for axis, n in enumerate(dims):
+        col = out[:, axis]
+        out[:, axis] = np.where(col > n // 2, col - n, col)
+    return out.astype(np.int32)
+
+
+def storage_triplets(triplets: np.ndarray, dims) -> np.ndarray:
+    """Map possibly-centered triplets to storage indices."""
+    out = triplets.astype(np.int64).copy()
+    for axis, n in enumerate(dims):
+        col = out[:, axis]
+        out[:, axis] = np.where(col < 0, col + n, col)
+    return out.astype(np.int64)
+
+
+def dense_cube_from_values(triplets: np.ndarray, values: np.ndarray,
+                           dims) -> np.ndarray:
+    """Place sparse values into a dense [z, y, x] frequency cube."""
+    nx, ny, nz = dims
+    cube = np.zeros((nz, ny, nx), np.complex128)
+    st = storage_triplets(triplets, dims)
+    cube[st[:, 2], st[:, 1], st[:, 0]] = values
+    return cube
+
+
+def dense_backward(cube: np.ndarray) -> np.ndarray:
+    """Unnormalised inverse DFT of the dense cube — the backward-transform
+    oracle (details.rst "Transform Definition": e^{+2πi k n / N}, no
+    normalisation)."""
+    return np.fft.ifftn(cube) * cube.size
+
+
+def dense_forward(space: np.ndarray) -> np.ndarray:
+    """Forward DFT oracle (no scaling)."""
+    return np.fft.fftn(space)
+
+
+def sample_cube(cube: np.ndarray, triplets: np.ndarray, dims) -> np.ndarray:
+    """Gather dense-cube values at sparse triplet positions."""
+    st = storage_triplets(triplets, dims)
+    return cube[st[:, 2], st[:, 1], st[:, 0]]
+
+
+def random_values(rng: np.random.Generator, n: int) -> np.ndarray:
+    return (rng.uniform(-1, 1, n) + 1j * rng.uniform(-1, 1, n))
+
+
+def tolerance_for(precision: str, oracle: np.ndarray) -> float:
+    """Comparison tolerance scaled to the oracle magnitude. The reference
+    checks 1e-6 absolute in double (test_check_values.hpp:46-50); single
+    precision gets a proportionally looser bound."""
+    scale = max(1.0, float(np.max(np.abs(oracle))) if oracle.size else 1.0)
+    return (1e-9 if precision == "double" else 3e-5) * scale
+
+
+def hermitian_triplets(rng: np.random.Generator, dims,
+                       mirror_some_columns: bool = True):
+    """Full R2C stick set following the hermitian provision rules
+    (details.rst "Real-To-Complex Transforms", reference
+    test_transform.hpp:221-276):
+
+    * all sticks with x in [1, nx//2] (full z columns),
+    * at x = 0: one z-column per ±y pair — the +y storage column, or (if
+      ``mirror_some_columns``) randomly the mirror ny-y column instead,
+    * at x = 0, y = 0: only z in [0, nz//2] (redundant half omitted).
+    """
+    nx, ny, nz = dims
+    triplets = []
+    # x = 0, y = 0 stick: non-redundant half only
+    for z in range(nz // 2 + 1):
+        triplets.append((0, 0, z))
+    # x = 0, y != 0: one column per pair
+    seen = set()
+    for y in range(1, ny):
+        pair = frozenset((y, (ny - y) % ny))
+        if pair in seen:
+            continue
+        seen.add(pair)
+        y_pick = y
+        if mirror_some_columns and (ny - y) % ny != y and rng.random() < 0.5:
+            y_pick = (ny - y) % ny
+        for z in range(nz):
+            triplets.append((0, y_pick, z))
+    # x in [1, nx//2]: full sticks
+    for x in range(1, nx // 2 + 1):
+        for y in range(ny):
+            for z in range(nz):
+                triplets.append((x, y, z))
+    return np.asarray(triplets, np.int32)
