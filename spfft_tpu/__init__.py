@@ -15,6 +15,9 @@ from .errors import (AllocationError, DeviceAllocationError, DeviceError,
                      InvalidIndicesError, InvalidParameterError, OverflowError_,
                      ParameterMismatchError)
 from .indexing import IndexPlan, build_index_plan, check_stick_duplicates
+from .parallel import (DistributedIndexPlan, DistributedTransformPlan,
+                       build_distributed_plan, make_distributed_plan,
+                       make_mesh)
 from .plan import TransformPlan, make_local_plan
 from .types import (ExchangeType, IndexFormat, ProcessingUnit, Scaling,
                     TransformType)
@@ -32,4 +35,6 @@ __all__ = [
     "Scaling",
     "IndexPlan", "build_index_plan", "check_stick_duplicates",
     "TransformPlan", "make_local_plan",
+    "DistributedIndexPlan", "DistributedTransformPlan",
+    "build_distributed_plan", "make_distributed_plan", "make_mesh",
 ]

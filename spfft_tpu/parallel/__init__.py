@@ -1,0 +1,5 @@
+"""Distributed slab<->pencil sparse FFT over a JAX device mesh."""
+
+from .mesh import make_mesh  # noqa: F401
+from .dist import (DistributedIndexPlan, DistributedTransformPlan,
+                   build_distributed_plan, make_distributed_plan)  # noqa: F401

@@ -1,0 +1,415 @@
+"""Distributed sparse 3D FFT plans over a 1-D device mesh.
+
+The reference's distributed layout (README.md:8, SURVEY.md §5.7): space domain
+split into z-plane *slabs* per shard, frequency domain into z-stick *pencils*;
+a collective exchange re-localises z between the two (reference:
+src/parameters/parameters.cpp:43-140 builds the per-rank distribution plan,
+src/execution/execution_host.cpp:249-352 runs the phases around the MPI
+alltoall).
+
+TPU-native realisation: one ``shard_map`` over a 1-D mesh whose body is the
+whole per-shard pipeline —
+
+  backward:  decompress -> [stick symmetry] -> z-IFFT -> pack ->
+             all_to_all -> unpack -> [plane symmetry] -> xy-IFFT
+  forward:   xy-FFT -> pack -> all_to_all -> unpack -> z-FFT -> compress
+
+with all per-shard index tables padded to common maxima and passed as sharded
+arrays (an SPMD body is traced once, so shard-varying data must be data, not
+Python branches). Plan-time validation reproduces the reference's collective
+consistency checks centrally: sum-of-planes == dim_z and sum-of-sticks bounds
+(parameters.cpp:103-109), global duplicate-stick detection
+(indices.hpp:105-117).
+
+Caller-visible array layouts (per shard r, stacked over the shard axis and
+sharded with ``PartitionSpec('shards')``):
+
+* frequency values: ``(num_shards, max_values, 2)`` interleaved, shard r's
+  values first, zero-padded;
+* space domain: ``(num_shards, max_planes, dim_y, dim_x[, 2])`` — shard r's
+  slab is rows ``[0, num_planes(r))`` of its block (zero-padded after), the
+  global z order being ``plane_offsets(r) + p``.
+
+Helpers convert between these padded device layouts and per-shard numpy lists.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..errors import InvalidParameterError, ParameterMismatchError
+from ..indexing import IndexPlan, build_index_plan, check_stick_duplicates
+from ..ops import stages
+from ..types import ExchangeType, Scaling, TransformType
+from ..utils.dtypes import (as_interleaved, complex_dtype,
+                            complex_to_interleaved, interleaved_to_complex,
+                            real_dtype)
+from .exchange import (all_to_all_blocks, pack_freq_to_blocks,
+                       pack_space_to_blocks, unpack_blocks_to_grid,
+                       unpack_blocks_to_sticks)
+from .mesh import SHARD_AXIS, make_mesh
+
+
+@dataclasses.dataclass(frozen=True)
+class DistributedIndexPlan:
+    """The global distribution plan: per-shard stick sets + slab split.
+
+    Equivalent of the reference ``Parameters`` in distributed mode
+    (reference: parameters.cpp:43-140): per-rank stick counts and xy indices,
+    per-rank plane counts and offsets, with the same validation.
+    """
+
+    transform_type: TransformType
+    dim_x: int
+    dim_y: int
+    dim_z: int
+    shard_plans: tuple
+    num_planes: tuple
+    plane_offsets: tuple
+
+    @property
+    def num_shards(self) -> int:
+        return len(self.shard_plans)
+
+    @property
+    def max_sticks(self) -> int:
+        return max(p.num_sticks for p in self.shard_plans)
+
+    @property
+    def max_planes(self) -> int:
+        return max(self.num_planes)
+
+    @property
+    def max_values(self) -> int:
+        return max(p.num_values for p in self.shard_plans)
+
+    @property
+    def dim_x_freq(self) -> int:
+        return self.shard_plans[0].dim_x_freq
+
+    @property
+    def hermitian(self) -> bool:
+        return self.transform_type == TransformType.R2C
+
+    @property
+    def num_global_elements(self) -> int:
+        """Total sparse values across shards (reference:
+        transform.hpp:145 num_global_elements)."""
+        return sum(p.num_values for p in self.shard_plans)
+
+
+def build_distributed_plan(transform_type: TransformType,
+                           dim_x: int, dim_y: int, dim_z: int,
+                           triplets_per_shard: Sequence[np.ndarray],
+                           planes_per_shard: Sequence[int],
+                           ) -> DistributedIndexPlan:
+    """Build and validate the global distribution plan.
+
+    ``triplets_per_shard[r]`` is shard r's sparse triplet list (a z-stick must
+    live wholly on one shard — enforced by the duplicate check);
+    ``planes_per_shard[r]`` its slab height. The distribution is caller-chosen
+    and may be arbitrary/non-uniform including empty shards, exactly like the
+    reference (tests/mpi_tests/test_transform.cpp:110-165).
+    """
+    transform_type = TransformType(transform_type)
+    if len(triplets_per_shard) != len(planes_per_shard):
+        raise InvalidParameterError(
+            "triplets_per_shard and planes_per_shard length mismatch")
+    if len(triplets_per_shard) == 0:
+        raise InvalidParameterError("need at least one shard")
+    planes = tuple(int(p) for p in planes_per_shard)
+    if any(p < 0 for p in planes):
+        raise InvalidParameterError("negative plane count")
+    if sum(planes) != dim_z:
+        # reference: parameters.cpp:107-109 (MPIParameterMismatchError)
+        raise ParameterMismatchError(
+            f"sum of planes per shard ({sum(planes)}) != dim_z ({dim_z})")
+    shard_plans = tuple(
+        build_index_plan(transform_type, dim_x, dim_y, dim_z,
+                         np.asarray(t).reshape(-1, 3))
+        for t in triplets_per_shard)
+    check_stick_duplicates([p.stick_keys for p in shard_plans])
+    total_sticks = sum(p.num_sticks for p in shard_plans)
+    if total_sticks > dim_x * dim_y:
+        # reference: parameters.cpp:103-106
+        raise ParameterMismatchError(
+            f"total sticks ({total_sticks}) exceed xy plane size")
+    offsets = tuple(int(o) for o in np.concatenate(
+        [[0], np.cumsum(planes)[:-1]]))
+    return DistributedIndexPlan(
+        transform_type=transform_type, dim_x=dim_x, dim_y=dim_y, dim_z=dim_z,
+        shard_plans=shard_plans, num_planes=planes, plane_offsets=offsets)
+
+
+class DistributedTransformPlan:
+    """A compiled distributed sparse 3D FFT over a device mesh.
+
+    Equivalent of a distributed reference ``Transform``
+    (reference: transform.hpp:56-227 with an MPI communicator).
+    """
+
+    def __init__(self, dist_plan: DistributedIndexPlan,
+                 mesh: Optional[Mesh] = None, precision: str = "single",
+                 exchange: ExchangeType = ExchangeType.DEFAULT):
+        self.dist_plan = dist_plan
+        self.precision = precision
+        self.exchange = ExchangeType(exchange)
+        self.mesh = mesh if mesh is not None else make_mesh(
+            dist_plan.num_shards)
+        (self.axis_name,) = self.mesh.axis_names
+        if self.mesh.devices.size != dist_plan.num_shards:
+            raise InvalidParameterError(
+                f"mesh has {self.mesh.devices.size} devices but plan has "
+                f"{dist_plan.num_shards} shards")
+        self._rdt = real_dtype(precision)
+        self._cdt = complex_dtype(precision)
+        # Reduced wire precision (reference *_FLOAT exchanges, types.h:43-57):
+        # one real dtype down from the transform precision.
+        self._wire_dtype = None
+        if self.exchange.float_wire:
+            self._wire_dtype = (np.float32 if precision == "double"
+                                else jnp.bfloat16)
+        self._build_tables()
+        self._sharded = NamedSharding(self.mesh, P(self.axis_name))
+        self._replicated = NamedSharding(self.mesh, P())
+        # Commit the static tables to device once, at plan time (never on the
+        # hot path — SURVEY.md §3.1's plan/execute split).
+        self._device_tables = (
+            jax.device_put(self._vi, self._sharded),
+            jax.device_put(self._onehot, self._sharded),
+            jax.device_put(self._cols_flat, self._replicated),
+            jax.device_put(self._zmap, self._replicated))
+        shmap = functools.partial(
+            jax.shard_map, mesh=self.mesh,
+            in_specs=(P(self.axis_name), P(self.axis_name), P(self.axis_name),
+                      P(), P()),
+            out_specs=P(self.axis_name))
+        self._backward_jit = jax.jit(shmap(self._backward_body))
+        self._forward_jit = {
+            s: jax.jit(shmap(functools.partial(self._forward_body,
+                                               scaled=(s == Scaling.FULL))))
+            for s in (Scaling.NONE, Scaling.FULL)
+        }
+
+    # -- static tables -------------------------------------------------------
+    def _build_tables(self) -> None:
+        dp = self.dist_plan
+        S, ms, mp_, mv = (dp.num_shards, dp.max_sticks, dp.max_planes,
+                          dp.max_values)
+        dim_z = dp.dim_z
+        # Per-shard value indices, padded with an out-of-range sentinel so
+        # scatter mode='drop' / gather mode='fill' ignore padding lanes.
+        pad_vi = ms * dim_z
+        vi = np.full((S, mv), pad_vi, np.int32)
+        for r, p in enumerate(dp.shard_plans):
+            vi[r, :p.num_values] = p.value_indices
+        # Every shard's scatter columns (replicated): the global stick table,
+        # the analogue of the reference's plan-time stick-list exchange
+        # (indices.hpp:58-102 create_distributed_transform_indices).
+        pad_col = dp.dim_y * dp.dim_x_freq
+        cols = np.full((S, ms), pad_col, np.int32)
+        for r, p in enumerate(dp.shard_plans):
+            cols[r, :p.num_sticks] = p.scatter_cols
+        # z index owned by each shard's p-th plane (replicated), sentinel
+        # dim_z for slab padding.
+        zmap = np.full((S, mp_), dim_z, np.int32)
+        for r in range(S):
+            n = dp.num_planes[r]
+            zmap[r, :n] = dp.plane_offsets[r] + np.arange(n)
+        # One-hot mask of the (0,0) stick per shard (sharded) — drives the
+        # R2C stick-symmetry fixup without per-shard Python branches
+        # (reference: parameters.cpp:133-139 locates the stick; the owner is
+        # shard-dependent but the SPMD body is traced once).
+        onehot = np.zeros((S, ms), np.float32)
+        for r, p in enumerate(dp.shard_plans):
+            if p.zero_stick_id is not None:
+                onehot[r, p.zero_stick_id] = 1.0
+        self._vi = vi
+        self._cols_flat = cols.reshape(-1)
+        self._zmap = zmap
+        self._onehot = onehot
+
+    # -- SPMD bodies ---------------------------------------------------------
+    def _backward_body(self, values_il, vi, onehot, cols_flat, zmap):
+        dp = self.dist_plan
+        values = interleaved_to_complex(values_il[0]).astype(self._cdt)
+        sticks = stages.decompress(values, vi[0], dp.max_sticks, dp.dim_z)
+        if dp.hermitian:
+            # Complete every stick, then blend by the one-hot (0,0)-stick
+            # mask — SPMD-safe stand-in for the reference's "owner rank
+            # applies StickSymmetry" branch (execution_host.cpp:306-308).
+            completed = jax.vmap(stages.complete_stick_hermitian)(sticks)
+            oh = onehot[0][:, None].astype(self._rdt)
+            sticks = sticks * (1 - oh) + completed * oh
+        sticks = stages.z_backward(sticks)
+        blocks = pack_freq_to_blocks(sticks, zmap)
+        blocks = all_to_all_blocks(blocks, self.axis_name, self._wire_dtype)
+        grid = unpack_blocks_to_grid(blocks, cols_flat, dp.dim_y,
+                                     dp.dim_x_freq)
+        if dp.hermitian:
+            grid = stages.complete_plane_hermitian(grid)
+            return stages.xy_backward_r2c(grid, dp.dim_x)[None]
+        return complex_to_interleaved(stages.xy_backward_c2c(grid))[None]
+
+    def _forward_body(self, space, vi, onehot, cols_flat, zmap, *,
+                      scaled: bool):
+        dp = self.dist_plan
+        if dp.hermitian:
+            grid = stages.xy_forward_r2c(space[0].astype(self._rdt))
+        else:
+            grid = stages.xy_forward_c2c(
+                interleaved_to_complex(space[0]).astype(self._cdt))
+        blocks = pack_space_to_blocks(grid, cols_flat, dp.num_shards,
+                                      dp.max_sticks)
+        blocks = all_to_all_blocks(blocks, self.axis_name, self._wire_dtype)
+        sticks = unpack_blocks_to_sticks(blocks, zmap, dp.dim_z)
+        sticks = stages.z_forward(sticks)
+        scale = 1.0 / self.global_size if scaled else None
+        flat = sticks.reshape(-1)
+        values = jnp.take(flat, vi[0], mode="fill", fill_value=0)
+        if scale is not None:
+            values = values * jnp.asarray(scale, self._rdt)
+        return complex_to_interleaved(values)[None]
+
+    # -- getters (reference transform.hpp:91-171) ---------------------------
+    @property
+    def transform_type(self) -> TransformType:
+        return self.dist_plan.transform_type
+
+    @property
+    def dim_x(self) -> int:
+        return self.dist_plan.dim_x
+
+    @property
+    def dim_y(self) -> int:
+        return self.dist_plan.dim_y
+
+    @property
+    def dim_z(self) -> int:
+        return self.dist_plan.dim_z
+
+    @property
+    def global_size(self) -> int:
+        return self.dim_x * self.dim_y * self.dim_z
+
+    @property
+    def num_global_elements(self) -> int:
+        return self.dist_plan.num_global_elements
+
+    def local_z_length(self, shard: int) -> int:
+        return self.dist_plan.num_planes[shard]
+
+    def local_z_offset(self, shard: int) -> int:
+        return self.dist_plan.plane_offsets[shard]
+
+    def local_slice_size(self, shard: int) -> int:
+        return self.dim_x * self.dim_y * self.local_z_length(shard)
+
+    def num_local_elements(self, shard: int) -> int:
+        return self.dist_plan.shard_plans[shard].num_values
+
+    # -- data movement helpers ----------------------------------------------
+    def shard_values(self, values_per_shard: Sequence) -> jax.Array:
+        """Per-shard numpy value arrays -> padded sharded device array."""
+        dp = self.dist_plan
+        if len(values_per_shard) != dp.num_shards:
+            raise InvalidParameterError("one value array per shard required")
+        out = np.zeros((dp.num_shards, dp.max_values, 2), self._rdt)
+        for r, v in enumerate(values_per_shard):
+            il = as_interleaved(v, self.precision)
+            if il.shape != (dp.shard_plans[r].num_values, 2):
+                raise InvalidParameterError(
+                    f"shard {r}: expected {dp.shard_plans[r].num_values} "
+                    f"values, got {il.shape[:-1]}")
+            out[r, :il.shape[0]] = il
+        return jax.device_put(out, self._sharded)
+
+    def unshard_values(self, values: jax.Array):
+        """Padded sharded values -> per-shard numpy complex arrays."""
+        dp = self.dist_plan
+        arr = np.asarray(values)
+        return [arr[r, :dp.shard_plans[r].num_values, 0]
+                + 1j * arr[r, :dp.shard_plans[r].num_values, 1]
+                for r in range(dp.num_shards)]
+
+    def shard_space(self, slabs: Sequence) -> jax.Array:
+        """Per-shard space-domain slabs -> padded sharded device array."""
+        dp = self.dist_plan
+        if len(slabs) != dp.num_shards:
+            raise InvalidParameterError("one slab per shard required")
+        if dp.hermitian:
+            out = np.zeros((dp.num_shards, dp.max_planes, dp.dim_y,
+                            dp.dim_x), self._rdt)
+        else:
+            out = np.zeros((dp.num_shards, dp.max_planes, dp.dim_y, dp.dim_x,
+                            2), self._rdt)
+        for r, slab in enumerate(slabs):
+            n = dp.num_planes[r]
+            expect = (n, dp.dim_y, dp.dim_x)
+            if dp.hermitian:
+                arr = np.asarray(slab, self._rdt)
+                if arr.shape != expect:
+                    raise InvalidParameterError(
+                        f"shard {r}: expected real slab {expect}, "
+                        f"got {arr.shape}")
+            else:
+                arr = as_interleaved(slab, self.precision)
+                if arr.shape != expect + (2,):
+                    raise InvalidParameterError(
+                        f"shard {r}: expected complex slab {expect}, "
+                        f"got {arr.shape[:-1]}")
+            out[r, :n] = arr
+        return jax.device_put(out, self._sharded)
+
+    def unshard_space(self, space: jax.Array):
+        """Padded sharded space array -> per-shard numpy slabs (complex for
+        C2C, real for R2C), trimmed to each shard's true slab height."""
+        dp = self.dist_plan
+        arr = np.asarray(space)
+        out = []
+        for r in range(dp.num_shards):
+            slab = arr[r, :dp.num_planes[r]]
+            if not dp.hermitian:
+                slab = slab[..., 0] + 1j * slab[..., 1]
+            out.append(slab)
+        return out
+
+    # -- execution -----------------------------------------------------------
+    def backward(self, values) -> jax.Array:
+        """Frequency -> space across the mesh. ``values``: a per-shard list
+        (numpy) or the padded sharded device array. Returns the padded
+        sharded space array."""
+        if not isinstance(values, jax.Array):
+            values = self.shard_values(values)
+        return self._backward_jit(values, *self._device_tables)
+
+    def forward(self, space, scaling: Scaling = Scaling.NONE) -> jax.Array:
+        """Space -> frequency across the mesh. Returns the padded sharded
+        values array."""
+        scaling = Scaling(scaling)
+        if not isinstance(space, jax.Array):
+            space = self.shard_space(space)
+        return self._forward_jit[scaling](space, *self._device_tables)
+
+
+def make_distributed_plan(transform_type: TransformType,
+                          dim_x: int, dim_y: int, dim_z: int,
+                          triplets_per_shard: Sequence[np.ndarray],
+                          planes_per_shard: Sequence[int],
+                          mesh: Optional[Mesh] = None,
+                          precision: str = "single",
+                          exchange: ExchangeType = ExchangeType.DEFAULT,
+                          ) -> DistributedTransformPlan:
+    """Plan a distributed transform in one call (the distributed analogue of
+    ``Grid::create_transform``, reference grid.hpp:138-141)."""
+    dist = build_distributed_plan(TransformType(transform_type), dim_x, dim_y,
+                                  dim_z, triplets_per_shard, planes_per_shard)
+    return DistributedTransformPlan(dist, mesh=mesh, precision=precision,
+                                    exchange=exchange)

@@ -1,0 +1,127 @@
+"""The slab<->pencil exchange: pack, all-to-all, unpack.
+
+TPU-native rebuild of the reference transpose/exchange engine
+(reference: src/transpose/ — eight MPI/local variants, SURVEY.md §2.5). On a
+TPU mesh all variants collapse to one ``lax.all_to_all`` on a padded
+``(num_shards, max_sticks, max_planes)`` complex block — the analogue of the
+reference's BUFFERED MPI_Alltoall layout (transpose_mpi_buffered_host.cpp),
+which is the natural fit for XLA's fixed-shape collectives. Data stays in HBM
+end-to-end, i.e. the reference's GPUDirect mode (SPFFT_GPU_DIRECT,
+transpose_mpi_buffered_gpu.cpp:171-199) is implicit and always on.
+
+Pack/unpack are gathers/scatters with plan-time index tables and sentinel
+padding:
+
+* pack (freq side): restrict each local stick to the z-planes owned by each
+  target shard (reference pack_backward,
+  transpose_mpi_compact_buffered_host.cpp:109-125);
+* unpack (space side): scatter every source shard's sticks into the local
+  plane grid by xy index (reference unpack_backward, :128-175).
+
+The reference's reduced-precision wire option (``*_FLOAT`` exchange types,
+docs/source/details.rst "MPI Exchange") maps to casting the interleaved block
+to the next lower real dtype around the collective: f64 -> f32 on the wire for
+double transforms, f32 -> bf16 for single.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.dtypes import complex_to_interleaved, interleaved_to_complex
+
+
+def pack_freq_to_blocks(sticks, z_map):
+    """Split z-FFT'ed local sticks into per-target-shard plane blocks.
+
+    Args:
+      sticks: (max_sticks, dim_z) complex — full-z local sticks.
+      z_map: (num_shards, max_planes) int32 — global z index of each target
+        shard's p-th plane, sentinel ``dim_z`` for padding rows.
+    Returns:
+      (num_shards, max_sticks, max_planes) complex.
+    """
+    blocks = jnp.take(sticks, z_map, axis=1, mode="fill", fill_value=0)
+    return jnp.transpose(blocks, (1, 0, 2))
+
+
+def unpack_blocks_to_grid(blocks, all_scatter_cols, dim_y: int,
+                          dim_x_freq: int):
+    """Scatter received stick segments into the local frequency plane grid.
+
+    Args:
+      blocks: (num_shards, max_sticks, max_planes) complex — blocks[s] holds
+        shard s's sticks restricted to this shard's planes.
+      all_scatter_cols: (num_shards * max_sticks,) int32 — every shard's
+        stick xy column (``y * dim_x_freq + x``), sentinel out-of-range for
+        padding sticks (dropped by the scatter).
+    Returns:
+      (max_planes, dim_y, dim_x_freq) complex.
+    """
+    num_shards, max_sticks, max_planes = blocks.shape
+    flat = jnp.transpose(blocks, (2, 0, 1)).reshape(max_planes,
+                                                    num_shards * max_sticks)
+    grid = jnp.zeros((max_planes, dim_y * dim_x_freq), blocks.dtype)
+    grid = grid.at[:, all_scatter_cols].set(flat, mode="drop")
+    return grid.reshape(max_planes, dim_y, dim_x_freq)
+
+
+def pack_space_to_blocks(grid, all_scatter_cols, num_shards: int,
+                         max_sticks: int):
+    """Forward-direction pack: gather every shard's stick columns out of the
+    local plane grid (reference pack_forward,
+    transpose_mpi_compact_buffered_host.cpp:203-242).
+
+    Args:
+      grid: (max_planes, dim_y, dim_x_freq) complex.
+    Returns:
+      (num_shards, max_sticks, max_planes) complex.
+    """
+    max_planes = grid.shape[0]
+    flat = grid.reshape(max_planes, -1)
+    cols = jnp.take(flat, all_scatter_cols, axis=1, mode="fill",
+                    fill_value=0)  # (max_planes, S * max_sticks)
+    blocks = cols.reshape(max_planes, num_shards, max_sticks)
+    return jnp.transpose(blocks, (1, 2, 0))
+
+
+def unpack_blocks_to_sticks(blocks, z_map, dim_z: int):
+    """Forward-direction unpack: reassemble full-z local sticks from received
+    per-source-shard plane blocks (reference unpack_forward,
+    transpose_mpi_compact_buffered_host.cpp:245-266).
+
+    Args:
+      blocks: (num_shards, max_sticks, max_planes) complex — blocks[s] holds
+        this shard's sticks restricted to shard s's planes.
+    Returns:
+      (max_sticks, dim_z) complex.
+    """
+    num_shards, max_sticks, max_planes = blocks.shape
+    flat = jnp.transpose(blocks, (1, 0, 2)).reshape(max_sticks,
+                                                    num_shards * max_planes)
+    sticks = jnp.zeros((max_sticks, dim_z), blocks.dtype)
+    return sticks.at[:, z_map.reshape(-1)].set(flat, mode="drop")
+
+
+def all_to_all_blocks(blocks, axis_name: str,
+                      wire_real_dtype: Optional[jnp.dtype] = None):
+    """Exchange blocks between shards; block (r -> s) lands at (s, slot r).
+
+    One XLA all-to-all over the mesh axis — the whole distributed backbone
+    (reference: MPI_(I)Alltoall(v/w), SURVEY.md §5.8). ``wire_real_dtype``
+    enables the reduced-precision wire mode: the complex block is viewed as
+    interleaved reals, cast down for the collective, and cast back after
+    (reference float-exchange conversion in pack/unpack,
+    transpose_mpi_compact_buffered_host.cpp:60-63).
+    """
+    if wire_real_dtype is None:
+        return jax.lax.all_to_all(blocks, axis_name, split_axis=0,
+                                  concat_axis=0, tiled=True)
+    rdt = blocks.real.dtype
+    il = complex_to_interleaved(blocks).astype(wire_real_dtype)
+    il = jax.lax.all_to_all(il, axis_name, split_axis=0, concat_axis=0,
+                            tiled=True)
+    return interleaved_to_complex(il.astype(rdt))

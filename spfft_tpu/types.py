@@ -27,9 +27,12 @@ class ExchangeType(enum.Enum):
     """Distributed exchange algorithm selector (reference: types.h:33-62).
 
     On TPU every variant lowers to ``lax.all_to_all`` on a padded
-    ``(shards, max_sticks, max_planes)`` block; the distinctions that remain
-    meaningful are wire precision (``*_FLOAT``) and, for COMPACT/UNBUFFERED,
-    a compact (unpadded, ragged-concat) wire layout.
+    ``(shards, max_sticks, max_planes)`` block; the only distinction that is
+    currently meaningful is wire precision (``*_FLOAT``). BUFFERED,
+    COMPACT_BUFFERED and UNBUFFERED are accepted for API parity and behave
+    identically (the reference's Alltoallv/Alltoallw layouts exist to avoid
+    padding bytes on the MPI wire; a compact ragged wire layout is a possible
+    future optimisation for highly non-uniform distributions).
     """
 
     DEFAULT = "default"
