@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""North-star benchmark: 256^3 spherical-cutoff C2C forward+backward pair.
+
+Driver metric (BASELINE.json): wall-clock of a backward+forward pair on a
+256^3 grid with a spherical-cutoff sparse frequency set, plus L2 error vs a
+dense FFT oracle. Mirrors the reference benchmark workload
+(reference: tests/programs/benchmark.cpp:176-205 builds a dense-within-cutoff
+stick set; :84-96 times repeated backward+forward pairs).
+
+Baseline: the reference publishes no numbers (BASELINE.md) and this container
+has no FFTW/CUDA to build its benchmark, so the baseline is *generated* here:
+the same sparse algorithm (stick z-FFTs -> scatter -> plane FFTs) run on CPU
+via scipy's pocketfft — the moral equivalent of the reference host path on
+this machine's single core. ``vs_baseline`` is baseline_seconds /
+tpu_seconds (>1 means faster than baseline).
+
+Prints exactly one JSON line at the end:
+  {"metric": ..., "value": ..., "unit": "s", "vs_baseline": ...}
+
+Env knobs: SPFFT_BENCH_DIM (default 256), SPFFT_BENCH_REPS (default 10),
+SPFFT_BENCH_SKIP_BASELINE=1 to skip the CPU baseline (vs_baseline = 0).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def cpu_baseline_pair_seconds(plan, values: np.ndarray, reps: int = 2) -> float:
+    """The same sparse pipeline on CPU (pocketfft), timed after one warm-up
+    rep (first-touch allocation and pocketfft plan setup excluded, matching
+    the warmed TPU measurement)."""
+    from scipy import fft as sfft
+    ip = plan.index_plan
+    nz, ny, nxf = ip.dim_z, ip.dim_y, ip.dim_x_freq
+    cols = ip.scatter_cols
+    vi = ip.value_indices
+    t0 = time.perf_counter()
+    for rep in range(reps + 1):
+        if rep == 1:
+            t0 = time.perf_counter()  # discard the warm-up rep
+        # backward: decompress -> z-IFFT -> scatter -> xy-IFFT
+        sticks = np.zeros((ip.num_sticks * nz,), np.complex64)
+        sticks[vi] = values
+        sticks = sticks.reshape(ip.num_sticks, nz)
+        sticks = sfft.ifft(sticks, axis=1, workers=-1) * nz
+        grid = np.zeros((nz, ny * nxf), np.complex64)
+        grid[:, cols] = sticks.T
+        grid = grid.reshape(nz, ny, nxf)
+        space = sfft.ifft2(grid, axes=(1, 2), workers=-1) * (ny * nxf)
+        # forward: xy-FFT -> gather -> z-FFT -> compress
+        grid = sfft.fft2(space, axes=(1, 2), workers=-1)
+        sticks = grid.reshape(nz, ny * nxf)[:, cols].T
+        sticks = np.ascontiguousarray(sticks)
+        sticks = sfft.fft(sticks, axis=1, workers=-1)
+        _ = sticks.reshape(-1)[vi]
+    return (time.perf_counter() - t0) / reps
+
+
+def main() -> None:
+    import jax
+    from spfft_tpu import TransformType, make_local_plan
+    from spfft_tpu.utils import as_interleaved
+    from spfft_tpu.utils.workloads import spherical_cutoff_triplets
+
+    n = int(os.environ.get("SPFFT_BENCH_DIM", "256"))
+    reps = int(os.environ.get("SPFFT_BENCH_REPS", "10"))
+
+    triplets = spherical_cutoff_triplets(n)
+    rng = np.random.default_rng(42)
+    values = (rng.uniform(-1, 1, len(triplets))
+              + 1j * rng.uniform(-1, 1, len(triplets))).astype(np.complex64)
+
+    t_plan = time.perf_counter()
+    plan = make_local_plan(TransformType.C2C, n, n, n, triplets,
+                           precision="single")
+    t_plan = time.perf_counter() - t_plan
+
+    values_il = jax.device_put(
+        np.asarray(as_interleaved(values, "single")))
+
+    # warm-up / compile
+    space = plan.backward(values_il)
+    out = plan.forward(space)
+    out.block_until_ready()
+
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        space = plan.backward(values_il)
+        out = plan.forward(space)
+    out.block_until_ready()
+    pair_s = (time.perf_counter() - t0) / reps
+
+    # accuracy: L2 error of the backward result vs a dense oracle
+    st = triplets.copy()
+    st = np.where(st < 0, st + n, st)
+    cube = np.zeros((n, n, n), np.complex64)
+    cube[st[:, 2], st[:, 1], st[:, 0]] = values
+    from scipy import fft as sfft
+    oracle = sfft.ifftn(cube, workers=-1) * cube.size
+    got = np.asarray(space)
+    got = got[..., 0] + 1j * got[..., 1]
+    l2 = float(np.linalg.norm(got - oracle) / np.linalg.norm(oracle))
+
+    if os.environ.get("SPFFT_BENCH_SKIP_BASELINE") == "1":
+        baseline_s = 0.0
+    else:
+        baseline_s = cpu_baseline_pair_seconds(plan, values)
+
+    result = {
+        "metric": f"{n}^3 spherical-cutoff C2C fwd+bwd pair wall-clock "
+                  f"(l2_err_vs_dense={l2:.2e}, plan_s={t_plan:.2f}, "
+                  f"n_values={len(triplets)}, "
+                  f"baseline=single-core pocketfft {baseline_s:.3f}s)",
+        "value": round(pair_s, 6),
+        "unit": "s",
+        "vs_baseline": round(baseline_s / pair_s, 3) if baseline_s else 0.0,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -18,6 +18,8 @@ from .indexing import IndexPlan, build_index_plan, check_stick_duplicates
 from .parallel import (DistributedIndexPlan, DistributedTransformPlan,
                        build_distributed_plan, make_distributed_plan,
                        make_mesh)
+from .grid import Grid, Transform
+from .multi import multi_transform_backward, multi_transform_forward
 from .plan import TransformPlan, make_local_plan
 from .types import (ExchangeType, IndexFormat, ProcessingUnit, Scaling,
                     TransformType)
@@ -37,4 +39,6 @@ __all__ = [
     "TransformPlan", "make_local_plan",
     "DistributedIndexPlan", "DistributedTransformPlan",
     "build_distributed_plan", "make_distributed_plan", "make_mesh",
+    "Grid", "Transform",
+    "multi_transform_backward", "multi_transform_forward",
 ]

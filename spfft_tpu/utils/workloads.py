@@ -1,0 +1,22 @@
+"""Canonical benchmark workload generators.
+
+The reference benchmark builds a dense-within-cutoff stick set
+(reference: tests/programs/benchmark.cpp:176-205); the driver's north-star
+workload is the full spherical cutoff of a plane-wave DFT code. Shared here so
+bench.py and the driver entry point cannot diverge on the flagship workload.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def spherical_cutoff_triplets(n: int, radius: int | None = None) -> np.ndarray:
+    """All (x, y, z) with x^2+y^2+z^2 <= radius^2 in centered indexing
+    (default radius n//2) — the plane-wave sphere of a DFT code."""
+    c = np.arange(n)
+    c = np.where(c > n // 2, c - n, c).astype(np.int32)
+    r = n // 2 if radius is None else radius
+    X, Y, Z = np.meshgrid(c, c, c, indexing="ij")
+    mask = X * X + Y * Y + Z * Z <= r * r
+    return np.stack([X[mask], Y[mask], Z[mask]], axis=1)
