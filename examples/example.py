@@ -1,0 +1,43 @@
+"""Dense 2x2x2 C2C round-trip through the Grid/Transform API — the
+reference's example program (reference: examples/example.cpp, also embedded
+in README.md:73-159), in Python."""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+import spfft_tpu as sp  # noqa: E402
+
+dim_x = dim_y = dim_z = 2
+print(f"Dimensions: x = {dim_x}, y = {dim_y}, z = {dim_z}\n")
+
+# use all frequency elements, like the reference example
+indices = np.array([(x, y, z)
+                    for x in range(dim_x)
+                    for y in range(dim_y)
+                    for z in range(dim_z)], np.int32)
+num_elements = len(indices)
+values = np.arange(num_elements) * (1.0 - 1.0j)
+
+print("Input:")
+for v in values:
+    print(f"{v.real}, {v.imag}")
+
+grid = sp.Grid(dim_x, dim_y, dim_z, dim_x * dim_y, sp.ProcessingUnit.DEVICE)
+transform = grid.create_transform(
+    sp.ProcessingUnit.DEVICE, sp.TransformType.C2C, dim_x, dim_y, dim_z,
+    local_z_length=dim_z, num_local_elements=num_elements,
+    index_format=sp.IndexFormat.TRIPLETS, indices=indices)
+
+space = transform.backward(values)
+print("\nAfter backward transform:")
+for v in np.asarray(space).reshape(-1, 2):
+    print(f"{v[0]}, {v[1]}")
+
+freq = transform.forward(scaling=sp.Scaling.NONE)
+print("\nAfter forward transform (without scaling):")
+for v in np.asarray(freq):
+    print(f"{v[0]}, {v[1]}")

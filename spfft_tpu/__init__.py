@@ -18,6 +18,7 @@ from .indexing import IndexPlan, build_index_plan, check_stick_duplicates
 from .parallel import (DistributedIndexPlan, DistributedTransformPlan,
                        build_distributed_plan, make_distributed_plan,
                        make_mesh)
+from . import timing
 from .grid import Grid, Transform
 from .multi import multi_transform_backward, multi_transform_forward
 from .plan import TransformPlan, make_local_plan

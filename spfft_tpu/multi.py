@@ -23,6 +23,7 @@ from typing import Optional, Sequence
 
 from .errors import InvalidParameterError
 from .grid import Transform
+from .timing import suppressed, timed_transform
 from .types import Scaling
 
 
@@ -38,7 +39,13 @@ def multi_transform_backward(transforms: Sequence[Transform],
     multi_transform.hpp:56-66). Returns the list of space-domain results;
     all dispatched before any host synchronisation."""
     _check(transforms, values_batch, "value arrays")
-    return [t.backward(v) for t, v in zip(transforms, values_batch)]
+    # Per-transform timing would block between dispatches and serialise the
+    # batch; time the whole batch as one scope instead.
+    with timed_transform("multi_backward") as box:
+        with suppressed():
+            box.value = [t.backward(v)
+                         for t, v in zip(transforms, values_batch)]
+    return box.value
 
 
 def multi_transform_forward(transforms: Sequence[Transform],
@@ -53,5 +60,9 @@ def multi_transform_forward(transforms: Sequence[Transform],
         scalings = [Scaling.NONE] * len(transforms)
     _check(transforms, space_batch, "space arrays")
     _check(transforms, scalings, "scalings")
-    return [t.forward(s, sc)
-            for t, s, sc in zip(transforms, space_batch, scalings)]
+    with timed_transform("multi_forward") as box:
+        with suppressed():
+            box.value = [t.forward(s, sc)
+                         for t, s, sc in zip(transforms, space_batch,
+                                             scalings)]
+    return box.value

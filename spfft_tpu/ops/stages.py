@@ -27,6 +27,7 @@ stages into a handful of kernels.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 
@@ -189,3 +190,35 @@ def xy_forward_r2c(space):
     """
     grid = jnp.fft.rfft(space, axis=-1)
     return jnp.fft.fft(grid, axis=-2)
+
+
+# ---------------------------------------------------------------------------
+# Profiler phase attribution: wrap every stage in a jax.named_scope so XLA
+# traces show the pipeline phases by name — the device-side counterpart of
+# the reference's HOST_TIMING labels ("z transform", "pack", "unpack", ...,
+# execution_host.cpp:251-295).
+# ---------------------------------------------------------------------------
+
+import functools as _functools
+
+
+def _named(fn, label: str):
+    @_functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        with jax.named_scope(f"spfft.{label}"):
+            return fn(*args, **kwargs)
+    return wrapper
+
+
+decompress = _named(decompress, "decompress")
+compress = _named(compress, "compress")
+z_backward = _named(z_backward, "z_backward")
+z_forward = _named(z_forward, "z_forward")
+sticks_to_grid = _named(sticks_to_grid, "unpack")
+grid_to_sticks = _named(grid_to_sticks, "pack")
+complete_stick_hermitian = _named(complete_stick_hermitian, "stick_symmetry")
+complete_plane_hermitian = _named(complete_plane_hermitian, "plane_symmetry")
+xy_backward_c2c = _named(xy_backward_c2c, "xy_backward")
+xy_forward_c2c = _named(xy_forward_c2c, "xy_forward")
+xy_backward_r2c = _named(xy_backward_r2c, "xy_backward")
+xy_forward_r2c = _named(xy_forward_r2c, "xy_forward")

@@ -47,6 +47,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..errors import InvalidParameterError, ParameterMismatchError
 from ..indexing import IndexPlan, build_index_plan, check_stick_duplicates
 from ..ops import stages
+from ..timing import timed_transform
 from ..types import ExchangeType, Scaling, TransformType
 from ..utils.dtypes import (as_interleaved, complex_dtype,
                             complex_to_interleaved, interleaved_to_complex,
@@ -388,7 +389,9 @@ class DistributedTransformPlan:
         sharded space array."""
         if not isinstance(values, jax.Array):
             values = self.shard_values(values)
-        return self._backward_jit(values, *self._device_tables)
+        with timed_transform("backward") as box:
+            box.value = self._backward_jit(values, *self._device_tables)
+        return box.value
 
     def forward(self, space, scaling: Scaling = Scaling.NONE) -> jax.Array:
         """Space -> frequency across the mesh. Returns the padded sharded
@@ -396,7 +399,10 @@ class DistributedTransformPlan:
         scaling = Scaling(scaling)
         if not isinstance(space, jax.Array):
             space = self.shard_space(space)
-        return self._forward_jit[scaling](space, *self._device_tables)
+        with timed_transform("forward") as box:
+            box.value = self._forward_jit[scaling](space,
+                                                   *self._device_tables)
+        return box.value
 
 
 def make_distributed_plan(transform_type: TransformType,

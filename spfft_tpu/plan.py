@@ -31,6 +31,7 @@ import numpy as np
 from .errors import InvalidParameterError
 from .indexing import IndexPlan, build_index_plan
 from .ops import stages
+from .timing import timed_transform
 from .types import Scaling, TransformType
 from .utils.dtypes import (as_interleaved, complex_dtype,
                            complex_to_interleaved, interleaved_to_complex,
@@ -147,7 +148,9 @@ class TransformPlan:
         dim_x) for R2C. Unnormalised inverse DFT (details.rst
         "Transform Definition")."""
         values_il = self._coerce_values(values)
-        return self._backward_jit(values_il)
+        with timed_transform("backward") as box:
+            box.value = self._backward_jit(values_il)
+        return box.value
 
     def forward(self, space, scaling: Scaling = Scaling.NONE):
         """Space -> frequency. Returns (num_values, 2) interleaved sparse
@@ -155,7 +158,9 @@ class TransformPlan:
         (details.rst "Normalization")."""
         scaling = Scaling(scaling)
         space = self._coerce_space(space)
-        return self._forward_jit[scaling](space)
+        with timed_transform("forward") as box:
+            box.value = self._forward_jit[scaling](space)
+        return box.value
 
     # -- input coercion ------------------------------------------------------
     def _coerce_values(self, values):

@@ -1,0 +1,199 @@
+"""Hierarchical scope timing — the rt_graph equivalent.
+
+Reimplements the semantics of the reference's self-contained scope timer
+(reference: src/timing/rt_graph.hpp:106-177 ``Timer``, :83-102
+``TimingResult``; hooked in via HOST_TIMING_* macros, src/timing/timing.hpp:44-62):
+nested named scopes accumulate start/stop timestamps, ``process()``
+reconstructs the call tree, and the result prints a Count/Total/%/Parent%/
+Median/Min/Max table or exports JSON — the same stats the reference benchmark
+dumps (tests/programs/benchmark.cpp:276-308).
+
+TPU caveat, stated honestly: jitted work is dispatched asynchronously, so a
+host-side scope around a jitted call measures dispatch unless the scope blocks.
+``timed_transform(label)`` yields a box; assigning the produced arrays to
+``box.value`` inside the scope makes the measurement ``block_until_ready`` on
+them, so enabled timing measures real wall-clock. Device-side phase
+attribution comes from ``jax.profiler`` traces instead — the pipeline stages
+are wrapped in ``jax.named_scope`` so XLA profiles show z/exchange/xy phases
+by name.
+
+Timing is off by default (the reference compiles the macros out unless
+SPFFT_TIMING, CMakeLists.txt:181-184); enable with ``enable()`` or the
+SPFFT_TPU_TIMING=1 env var.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json as _json
+import os
+import statistics
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+
+
+class _Node:
+    __slots__ = ("label", "times", "children")
+
+    def __init__(self, label: str):
+        self.label = label
+        self.times: List[float] = []
+        self.children: "Dict[str, _Node]" = {}
+
+
+class TimingResult:
+    """Processed call tree with per-scope statistics
+    (reference: rt_graph.hpp:83-102)."""
+
+    def __init__(self, root: _Node):
+        self._root = root
+
+    def _rows(self):
+        rows = []
+        total_all = sum(sum(c.times) for c in self._root.children.values())
+
+        def visit(node: _Node, depth: int, parent_total: float):
+            total = sum(node.times)
+            rows.append({
+                "label": node.label, "depth": depth,
+                "count": len(node.times), "total": total,
+                "pct": 100.0 * total / total_all if total_all else 0.0,
+                "parent_pct": (100.0 * total / parent_total
+                               if parent_total else 100.0),
+                "median": statistics.median(node.times) if node.times else 0.0,
+                "min": min(node.times) if node.times else 0.0,
+                "max": max(node.times) if node.times else 0.0,
+            })
+            for child in node.children.values():
+                visit(child, depth + 1, total)
+
+        for child in self._root.children.values():
+            visit(child, 0, total_all)
+        return rows
+
+    def print(self) -> None:
+        """Print the stats table (reference: TimingResult::print)."""
+        rows = self._rows()
+        if not rows:
+            print("(no timings recorded)")
+            return
+        hdr = (f"{'scope':<40}{'count':>7}{'total[s]':>12}{'%':>8}"
+               f"{'parent%':>9}{'median[s]':>12}{'min[s]':>12}{'max[s]':>12}")
+        print(hdr)
+        print("-" * len(hdr))
+        for r in rows:
+            label = "  " * r["depth"] + r["label"]
+            print(f"{label:<40}{r['count']:>7}{r['total']:>12.6f}"
+                  f"{r['pct']:>8.2f}{r['parent_pct']:>9.2f}"
+                  f"{r['median']:>12.6f}{r['min']:>12.6f}{r['max']:>12.6f}")
+
+    def json(self) -> str:
+        """JSON export (reference: TimingResult::json)."""
+
+        def dump(node: _Node) -> Dict[str, Any]:
+            return {
+                "label": node.label,
+                "count": len(node.times),
+                "total": sum(node.times),
+                "times": node.times,
+                "sub": [dump(c) for c in node.children.values()],
+            }
+
+        return _json.dumps(
+            {"timings": [dump(c) for c in self._root.children.values()]})
+
+
+class Timer:
+    """Nested scope timer (reference: rt_graph.hpp:106-155)."""
+
+    def __init__(self):
+        self._root = _Node("<root>")
+        self._stack: List[_Node] = [self._root]
+
+    def reset(self) -> None:
+        self._root = _Node("<root>")
+        self._stack = [self._root]
+
+    @contextlib.contextmanager
+    def scoped(self, label: str, block: Any = None):
+        """Time a scope; if ``block`` is given, ``block_until_ready`` it
+        before closing the measurement (for async device work)."""
+        parent = self._stack[-1]
+        node = parent.children.get(label)
+        if node is None:
+            node = parent.children[label] = _Node(label)
+        self._stack.append(node)
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            if block is not None:
+                jax.block_until_ready(block)
+            node.times.append(time.perf_counter() - t0)
+            self._stack.pop()
+
+    def process(self) -> TimingResult:
+        return TimingResult(self._root)
+
+
+#: Global timer, mirroring the reference's GlobalTimer singleton
+#: (reference: src/timing/timing.cpp:36).
+GlobalTimer = Timer()
+
+_enabled = os.environ.get("SPFFT_TPU_TIMING") == "1"
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+@contextlib.contextmanager
+def suppressed():
+    """Temporarily disable timing inside a scope. Used by the batched
+    multi-transform API so per-transform timing does not serialise the batch
+    (blocking between dispatches would destroy the compute/comm overlap the
+    batching exists for)."""
+    global _enabled
+    prev = _enabled
+    _enabled = False
+    try:
+        yield
+    finally:
+        _enabled = prev
+
+
+class _ResultBox:
+    """Mutable late-binding holder so a scope can block on a result produced
+    inside it."""
+
+    def __init__(self):
+        self.value: Optional[Any] = None
+
+
+@contextlib.contextmanager
+def timed_transform(label: str):
+    """Scope for one transform execution: ``box.value = <result>`` inside the
+    scope makes the timing block on it."""
+    if not _enabled:
+        yield _ResultBox()
+        return
+    box = _ResultBox()
+    parent = GlobalTimer
+    with parent.scoped(label):
+        try:
+            yield box
+        finally:
+            if box.value is not None:
+                jax.block_until_ready(box.value)

@@ -20,9 +20,11 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax  # noqa: E402
 
+from spfft_tpu.utils.platform import force_virtual_cpu_devices  # noqa: E402
+
 # The container's sitecustomize imports jax (axon TPU plugin) before this
 # conftest runs, so the env vars above may be read too late — force the
-# platform through the live config as well.
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+# platform through the live config as well (trust_env=False: tests always
+# run on the virtual CPU mesh).
+force_virtual_cpu_devices(8, trust_env=False)
 jax.config.update("jax_enable_x64", True)

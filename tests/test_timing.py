@@ -1,0 +1,78 @@
+"""Timing subsystem tests (reference: src/timing/rt_graph.{hpp,cpp} and the
+HOST_TIMING macro gating, src/timing/timing.hpp:44-62)."""
+
+import json
+import time
+
+import numpy as np
+
+from spfft_tpu import TransformType, make_local_plan, timing
+
+
+def test_scope_tree_and_stats():
+    t = timing.Timer()
+    for _ in range(3):
+        with t.scoped("outer"):
+            with t.scoped("inner"):
+                time.sleep(0.001)
+    res = t.process()
+    rows = res._rows()
+    labels = [(r["label"], r["depth"], r["count"]) for r in rows]
+    assert ("outer", 0, 3) in labels
+    assert ("inner", 1, 3) in labels
+    inner = next(r for r in rows if r["label"] == "inner")
+    assert inner["min"] >= 0.001
+    assert inner["median"] <= inner["max"]
+    # json export parses and mirrors the tree
+    data = json.loads(res.json())
+    assert data["timings"][0]["label"] == "outer"
+    assert data["timings"][0]["sub"][0]["label"] == "inner"
+
+
+def test_disabled_by_default_and_gated():
+    timing.GlobalTimer.reset()
+    plan = make_local_plan(TransformType.C2C, 4, 4, 4,
+                           np.array([[0, 0, 0]]), precision="double")
+    plan.backward(np.ones(1, np.complex128))
+    assert not timing.GlobalTimer.process()._rows()  # off by default
+
+    timing.enable()
+    try:
+        plan.backward(np.ones(1, np.complex128))
+        plan.forward(plan.backward(np.ones(1, np.complex128)))
+        rows = timing.GlobalTimer.process()._rows()
+        labels = {r["label"]: r["count"] for r in rows}
+        assert labels["backward"] == 2
+        assert labels["forward"] == 1
+    finally:
+        timing.disable()
+        timing.GlobalTimer.reset()
+
+
+def test_print_does_not_crash(capsys):
+    t = timing.Timer()
+    with t.scoped("a"):
+        pass
+    t.process().print()
+    out = capsys.readouterr().out
+    assert "a" in out and "count" in out
+
+
+def test_multi_transform_batch_timing():
+    """Batched execution records one batch scope, not per-transform scopes
+    (per-transform blocking would serialise the batch)."""
+    from spfft_tpu import (Grid, ProcessingUnit, multi_transform_backward)
+    grid = Grid(4, 4, 4, 16, precision="double")
+    t = grid.create_transform(ProcessingUnit.HOST, TransformType.C2C,
+                              4, 4, 4, indices=np.array([[0, 0, 0]]))
+    ts = [t.clone() for _ in range(3)]
+    timing.GlobalTimer.reset()
+    timing.enable()
+    try:
+        multi_transform_backward(ts, [np.ones(1, np.complex128)] * 3)
+        rows = timing.GlobalTimer.process()._rows()
+        labels = {r["label"]: r["count"] for r in rows}
+        assert labels == {"multi_backward": 1}
+    finally:
+        timing.disable()
+        timing.GlobalTimer.reset()
