@@ -84,16 +84,23 @@ def main() -> None:
     values_il = jax.device_put(
         np.asarray(as_interleaved(values, "single")))
 
+    def sync(arr):
+        # On remote-attached TPUs block_until_ready can return before the
+        # device work completes; a host readback of one element is a hard
+        # sync. Device programs execute FIFO per core, so syncing the last
+        # enqueued output syncs the whole queue.
+        return float(np.asarray(arr.ravel()[0]))
+
     # warm-up / compile
     space = plan.backward(values_il)
     out = plan.forward(space)
-    out.block_until_ready()
+    sync(out)
 
     t0 = time.perf_counter()
     for _ in range(reps):
         space = plan.backward(values_il)
         out = plan.forward(space)
-    out.block_until_ready()
+    sync(out)
     pair_s = (time.perf_counter() - t0) / reps
 
     # accuracy: L2 error of the backward result vs a dense oracle

@@ -173,11 +173,55 @@ class IndexPlan:
         return (self.stick_y * self.dim_x_freq + self.stick_x).astype(np.int32)
 
     @property
+    def slot_src(self) -> np.ndarray:
+        """Inverse value map for the gather-based decompress (see
+        :func:`inverse_slot_map`)."""
+        return inverse_slot_map(self.value_indices,
+                                self.num_sticks * self.dim_z,
+                                self.num_values)
+
+    @property
+    def col_inv(self) -> np.ndarray:
+        """Inverse column map for the gather-based backward unpack (see
+        :func:`inverse_col_map`)."""
+        return inverse_col_map(self.scatter_cols,
+                               self.dim_y * self.dim_x_freq,
+                               self.num_sticks)
+
+    @property
     def zero_stick_id(self) -> Optional[int]:
         """Position of the (x=0, y=0) stick, or None if absent — the stick that
         receives hermitian completion for R2C (reference: parameters.cpp:133-139)."""
         hits = np.nonzero(self.stick_keys == 0)[0]
         return int(hits[0]) if hits.size else None
+
+
+def inverse_slot_map(value_indices: np.ndarray, num_slots: int,
+                     num_values: int) -> np.ndarray:
+    """Invert the value->slot map: ``src[slot] = value index feeding that
+    slot``, sentinel ``num_values`` for empty slots.
+
+    Turns the reference's decompress *scatter*
+    (compression_host.hpp:76-93) into a TPU-friendly *gather*: XLA lowers
+    arbitrary-index scatters on TPU to near-serial updates (~1s for 8.8M
+    values on v5e), while the equivalent gather through this precomputed
+    inverse runs an order of magnitude faster. If the same slot is named by
+    several duplicate triplets, the last occurrence wins (the reference's
+    scatter order is unspecified for duplicates).
+    """
+    src = np.full(num_slots, num_values, np.int32)
+    src[value_indices] = np.arange(num_values, dtype=np.int32)
+    return src
+
+
+def inverse_col_map(scatter_cols: np.ndarray, num_cols: int,
+                    num_sticks: int) -> np.ndarray:
+    """Invert the stick->plane-column map: ``col_inv[c] = stick id at column
+    c``, sentinel ``num_sticks`` for empty columns. Turns the backward
+    unpack scatter (transpose_host.hpp:132-154) into a row gather."""
+    col_inv = np.full(num_cols, num_sticks, np.int32)
+    col_inv[scatter_cols] = np.arange(num_sticks, dtype=np.int32)
+    return col_inv
 
 
 def build_index_plan(transform_type: TransformType,

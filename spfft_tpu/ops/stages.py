@@ -35,30 +35,47 @@ import jax.numpy as jnp
 # Compression: sparse values <-> packed z-stick array
 # ---------------------------------------------------------------------------
 
-def decompress(values, value_indices, num_sticks: int, dim_z: int):
-    """Scatter sparse values into a zeroed packed stick array.
+def gather_rows_with_sentinel(rows, idx):
+    """Gather ``rows[idx]`` where index ``rows.shape[0]`` (the sentinel of
+    the plan-time inverse maps) selects a zero row. The shared idiom of every
+    gather-based placement stage: append one zero row, then gather."""
+    zero = jnp.zeros((1,) + rows.shape[1:], rows.dtype)
+    return jnp.concatenate([rows, zero], axis=0)[idx]
 
-    reference: compression_host.hpp:76-93 (zero sticks then scatter by the
-    flat ``stick_id * dim_z + z`` index list).
+
+def decompress(values_il, slot_src, num_sticks: int, dim_z: int):
+    """Fill the packed stick array from sparse values — as a *gather*.
+
+    Same semantics as the reference decompress scatter
+    (compression_host.hpp:76-93: zero sticks, place each value at its flat
+    ``stick_id * dim_z + z`` slot), but expressed through the plan-time
+    inverse map ``slot_src`` (indexing.inverse_slot_map): XLA lowers
+    arbitrary-index scatters on TPU to near-serial updates, an order of
+    magnitude slower than this gather. Duplicate triplets resolve to the
+    last occurrence (unspecified order in the reference).
 
     Args:
-      values: (num_values,) complex.
-      value_indices: (num_values,) int32 flat indices.
+      values_il: (num_values, 2) real interleaved sparse values.
+      slot_src: (num_sticks * dim_z,) int32; sentinel num_values -> zero.
     Returns:
       (num_sticks, dim_z) complex stick array.
     """
-    flat = jnp.zeros((num_sticks * dim_z,), values.dtype)
-    flat = flat.at[value_indices].set(values, mode="drop")
-    return flat.reshape(num_sticks, dim_z)
+    flat = gather_rows_with_sentinel(values_il, slot_src)
+    return (flat[:, 0] + 1j * flat[:, 1]).reshape(num_sticks, dim_z)
 
 
 def compress(sticks, value_indices, scale=None):
     """Gather sparse values out of the packed stick array, optionally scaled
-    (reference: compression_host.hpp:50-72)."""
-    flat = sticks.reshape(-1)
+    (reference: compression_host.hpp:50-72). Gathers interleaved real rows —
+    element gathers of complex dtype lower poorly on TPU.
+
+    Returns (num_values, 2) real interleaved values.
+    """
+    flat = jnp.stack([jnp.real(sticks).reshape(-1),
+                      jnp.imag(sticks).reshape(-1)], axis=-1)
     values = flat[value_indices]
     if scale is not None:
-        values = values * jnp.asarray(scale, values.real.dtype)
+        values = values * jnp.asarray(scale, values.dtype)
     return values
 
 
@@ -83,23 +100,24 @@ def z_forward(sticks):
 # Local transpose: packed sticks <-> frequency-domain planes
 # ---------------------------------------------------------------------------
 
-def sticks_to_grid(sticks, scatter_cols, num_planes: int, dim_y: int,
-                   dim_x_freq: int):
-    """Scatter z-transformed sticks into a zeroed plane grid.
+def sticks_to_grid(sticks, col_inv, dim_y: int, dim_x_freq: int):
+    """Place z-transformed sticks into the plane grid — as a row *gather*.
 
-    reference: transpose_host.hpp:132-154 (backward unpack: zero the grid,
-    then place each stick at its xy index). The grid layout is x-innermost
-    ``(planes, dim_y, dim_x_freq)`` — see IndexPlan.scatter_cols.
+    Same semantics as the reference backward unpack scatter
+    (transpose_host.hpp:132-154: zero the grid, place each stick at its xy
+    index), via the plan-time inverse column map (indexing.inverse_col_map).
+    Each gathered row is a whole stick (contiguous), which XLA lowers to
+    fast slice gathers.
 
     Args:
       sticks: (num_sticks, num_planes) complex — stick-major, z-restricted.
-      scatter_cols: (num_sticks,) int32 — ``y * dim_x_freq + x`` per stick.
+      col_inv: (dim_y * dim_x_freq,) int32; sentinel num_sticks -> zero row.
     Returns:
       (num_planes, dim_y, dim_x_freq) complex.
     """
-    flat = jnp.zeros((num_planes, dim_y * dim_x_freq), sticks.dtype)
-    flat = flat.at[:, scatter_cols].set(sticks.T, mode="drop")
-    return flat.reshape(num_planes, dim_y, dim_x_freq)
+    num_planes = sticks.shape[1]
+    grid_t = gather_rows_with_sentinel(sticks, col_inv)
+    return grid_t.T.reshape(num_planes, dim_y, dim_x_freq)
 
 
 def grid_to_sticks(grid, scatter_cols):

@@ -184,13 +184,18 @@ class DistributedTransformPlan:
         # hot path — SURVEY.md §3.1's plan/execute split).
         self._device_tables = (
             jax.device_put(self._vi, self._sharded),
+            jax.device_put(self._slot_src, self._sharded),
             jax.device_put(self._onehot, self._sharded),
             jax.device_put(self._cols_flat, self._replicated),
-            jax.device_put(self._zmap, self._replicated))
+            jax.device_put(self._col_inv, self._replicated),
+            jax.device_put(self._zmap, self._replicated),
+            jax.device_put(self._z_src, self._replicated))
         shmap = functools.partial(
             jax.shard_map, mesh=self.mesh,
-            in_specs=(P(self.axis_name), P(self.axis_name), P(self.axis_name),
-                      P(), P()),
+            in_specs=(P(self.axis_name),                       # data
+                      P(self.axis_name), P(self.axis_name),    # vi, slot_src
+                      P(self.axis_name),                       # onehot
+                      P(), P(), P(), P()),     # cols, col_inv, zmap, z_src
             out_specs=P(self.axis_name))
         self._backward_jit = jax.jit(shmap(self._backward_body))
         self._forward_jit = {
@@ -205,12 +210,20 @@ class DistributedTransformPlan:
         S, ms, mp_, mv = (dp.num_shards, dp.max_sticks, dp.max_planes,
                           dp.max_values)
         dim_z = dp.dim_z
-        # Per-shard value indices, padded with an out-of-range sentinel so
-        # scatter mode='drop' / gather mode='fill' ignore padding lanes.
+        # Per-shard value indices, padded with an out-of-range sentinel
+        # (gathers route sentinels to an appended zero row). All data
+        # movement is gather-based with plan-time inverse maps — runtime
+        # scatters lower near-serially on TPU (see indexing.inverse_slot_map).
         pad_vi = ms * dim_z
         vi = np.full((S, mv), pad_vi, np.int32)
         for r, p in enumerate(dp.shard_plans):
             vi[r, :p.num_values] = p.value_indices
+        # Per-shard inverse slot map for the gather-based decompress
+        # (sharded): slot -> local value position, sentinel mv.
+        slot_src = np.full((S, ms * dim_z), mv, np.int32)
+        for r, p in enumerate(dp.shard_plans):
+            slot_src[r, :p.num_sticks * dim_z] = \
+                np.where(p.slot_src == p.num_values, mv, p.slot_src)
         # Every shard's scatter columns (replicated): the global stick table,
         # the analogue of the reference's plan-time stick-list exchange
         # (indices.hpp:58-102 create_distributed_transform_indices).
@@ -218,12 +231,24 @@ class DistributedTransformPlan:
         cols = np.full((S, ms), pad_col, np.int32)
         for r, p in enumerate(dp.shard_plans):
             cols[r, :p.num_sticks] = p.scatter_cols
+        # Global inverse column map (replicated): plane column -> global
+        # padded stick index shard*ms + i, sentinel S*ms.
+        col_inv = np.full(dp.dim_y * dp.dim_x_freq, S * ms, np.int32)
+        for r, p in enumerate(dp.shard_plans):
+            col_inv[p.scatter_cols] = r * ms + np.arange(p.num_sticks)
         # z index owned by each shard's p-th plane (replicated), sentinel
-        # dim_z for slab padding.
+        # dim_z for slab padding — drives the backward pack.
         zmap = np.full((S, mp_), dim_z, np.int32)
         for r in range(S):
             n = dp.num_planes[r]
             zmap[r, :n] = dp.plane_offsets[r] + np.arange(n)
+        # Inverse: global z -> owner_shard * mp_ + plane (total map) — drives
+        # the forward unpack gather.
+        z_src = np.empty(dim_z, np.int32)
+        for r in range(S):
+            n = dp.num_planes[r]
+            z_src[dp.plane_offsets[r]:dp.plane_offsets[r] + n] = \
+                r * mp_ + np.arange(n)
         # One-hot mask of the (0,0) stick per shard (sharded) — drives the
         # R2C stick-symmetry fixup without per-shard Python branches
         # (reference: parameters.cpp:133-139 locates the stick; the owner is
@@ -233,15 +258,19 @@ class DistributedTransformPlan:
             if p.zero_stick_id is not None:
                 onehot[r, p.zero_stick_id] = 1.0
         self._vi = vi
+        self._slot_src = slot_src
         self._cols_flat = cols.reshape(-1)
+        self._col_inv = col_inv
         self._zmap = zmap
+        self._z_src = z_src
         self._onehot = onehot
 
     # -- SPMD bodies ---------------------------------------------------------
-    def _backward_body(self, values_il, vi, onehot, cols_flat, zmap):
+    def _backward_body(self, values_il, vi, slot_src, onehot, cols_flat,
+                       col_inv, zmap, z_src):
         dp = self.dist_plan
-        values = interleaved_to_complex(values_il[0]).astype(self._cdt)
-        sticks = stages.decompress(values, vi[0], dp.max_sticks, dp.dim_z)
+        sticks = stages.decompress(values_il[0].astype(self._rdt),
+                                   slot_src[0], dp.max_sticks, dp.dim_z)
         if dp.hermitian:
             # Complete every stick, then blend by the one-hot (0,0)-stick
             # mask — SPMD-safe stand-in for the reference's "owner rank
@@ -252,15 +281,15 @@ class DistributedTransformPlan:
         sticks = stages.z_backward(sticks)
         blocks = pack_freq_to_blocks(sticks, zmap)
         blocks = all_to_all_blocks(blocks, self.axis_name, self._wire_dtype)
-        grid = unpack_blocks_to_grid(blocks, cols_flat, dp.dim_y,
+        grid = unpack_blocks_to_grid(blocks, col_inv, dp.dim_y,
                                      dp.dim_x_freq)
         if dp.hermitian:
             grid = stages.complete_plane_hermitian(grid)
             return stages.xy_backward_r2c(grid, dp.dim_x)[None]
         return complex_to_interleaved(stages.xy_backward_c2c(grid))[None]
 
-    def _forward_body(self, space, vi, onehot, cols_flat, zmap, *,
-                      scaled: bool):
+    def _forward_body(self, space, vi, slot_src, onehot, cols_flat, col_inv,
+                      zmap, z_src, *, scaled: bool):
         dp = self.dist_plan
         if dp.hermitian:
             grid = stages.xy_forward_r2c(space[0].astype(self._rdt))
@@ -270,14 +299,16 @@ class DistributedTransformPlan:
         blocks = pack_space_to_blocks(grid, cols_flat, dp.num_shards,
                                       dp.max_sticks)
         blocks = all_to_all_blocks(blocks, self.axis_name, self._wire_dtype)
-        sticks = unpack_blocks_to_sticks(blocks, zmap, dp.dim_z)
+        sticks = unpack_blocks_to_sticks(blocks, z_src)
         sticks = stages.z_forward(sticks)
         scale = 1.0 / self.global_size if scaled else None
-        flat = sticks.reshape(-1)
-        values = jnp.take(flat, vi[0], mode="fill", fill_value=0)
+        # vi carries the sentinel max_sticks*dim_z for value padding
+        flat = jnp.stack([jnp.real(sticks).reshape(-1),
+                          jnp.imag(sticks).reshape(-1)], axis=-1)
+        values = stages.gather_rows_with_sentinel(flat, vi[0])
         if scale is not None:
             values = values * jnp.asarray(scale, self._rdt)
-        return complex_to_interleaved(values)[None]
+        return values[None]
 
     # -- getters (reference transform.hpp:91-171) ---------------------------
     @property

@@ -31,6 +31,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from ..ops import stages
 from ..utils.dtypes import complex_to_interleaved, interleaved_to_complex
 
 
@@ -48,25 +49,25 @@ def pack_freq_to_blocks(sticks, z_map):
     return jnp.transpose(blocks, (1, 0, 2))
 
 
-def unpack_blocks_to_grid(blocks, all_scatter_cols, dim_y: int,
+def unpack_blocks_to_grid(blocks, global_col_inv, dim_y: int,
                           dim_x_freq: int):
-    """Scatter received stick segments into the local frequency plane grid.
+    """Place received stick segments into the local frequency plane grid —
+    as a row *gather* through the plan-time inverse column map (runtime
+    scatters lower near-serially on TPU; see indexing.inverse_col_map).
 
     Args:
       blocks: (num_shards, max_sticks, max_planes) complex — blocks[s] holds
         shard s's sticks restricted to this shard's planes.
-      all_scatter_cols: (num_shards * max_sticks,) int32 — every shard's
-        stick xy column (``y * dim_x_freq + x``), sentinel out-of-range for
-        padding sticks (dropped by the scatter).
+      global_col_inv: (dim_y * dim_x_freq,) int32 — plane column -> global
+        padded stick index ``shard * max_sticks + i``, sentinel
+        ``num_shards * max_sticks`` for empty columns.
     Returns:
       (max_planes, dim_y, dim_x_freq) complex.
     """
     num_shards, max_sticks, max_planes = blocks.shape
-    flat = jnp.transpose(blocks, (2, 0, 1)).reshape(max_planes,
-                                                    num_shards * max_sticks)
-    grid = jnp.zeros((max_planes, dim_y * dim_x_freq), blocks.dtype)
-    grid = grid.at[:, all_scatter_cols].set(flat, mode="drop")
-    return grid.reshape(max_planes, dim_y, dim_x_freq)
+    rows = blocks.reshape(num_shards * max_sticks, max_planes)
+    grid_t = stages.gather_rows_with_sentinel(rows, global_col_inv)
+    return grid_t.T.reshape(max_planes, dim_y, dim_x_freq)
 
 
 def pack_space_to_blocks(grid, all_scatter_cols, num_shards: int,
@@ -88,22 +89,23 @@ def pack_space_to_blocks(grid, all_scatter_cols, num_shards: int,
     return jnp.transpose(blocks, (1, 2, 0))
 
 
-def unpack_blocks_to_sticks(blocks, z_map, dim_z: int):
+def unpack_blocks_to_sticks(blocks, z_src):
     """Forward-direction unpack: reassemble full-z local sticks from received
     per-source-shard plane blocks (reference unpack_forward,
-    transpose_mpi_compact_buffered_host.cpp:245-266).
+    transpose_mpi_compact_buffered_host.cpp:245-266) — as a column gather
+    through the total map ``z_src`` (every z plane has exactly one owner).
 
     Args:
       blocks: (num_shards, max_sticks, max_planes) complex — blocks[s] holds
         this shard's sticks restricted to shard s's planes.
+      z_src: (dim_z,) int32 — global z -> ``owner_shard * max_planes + p``.
     Returns:
       (max_sticks, dim_z) complex.
     """
     num_shards, max_sticks, max_planes = blocks.shape
     flat = jnp.transpose(blocks, (1, 0, 2)).reshape(max_sticks,
                                                     num_shards * max_planes)
-    sticks = jnp.zeros((max_sticks, dim_z), blocks.dtype)
-    return sticks.at[:, z_map.reshape(-1)].set(flat, mode="drop")
+    return flat[:, z_src]
 
 
 def all_to_all_blocks(blocks, axis_name: str,

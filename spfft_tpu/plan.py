@@ -46,15 +46,25 @@ class TransformPlan:
     precision, arbitrary sparse frequency triplets.
     """
 
-    def __init__(self, index_plan: IndexPlan, precision: str = "single"):
+    def __init__(self, index_plan: IndexPlan, precision: str = "single",
+                 use_pallas: Optional[bool] = None):
         self.index_plan = index_plan
         self.precision = precision
         self._rdt = real_dtype(precision)
         self._cdt = complex_dtype(precision)
         # Static tables, device-committed once (plan time, never at execute
-        # time — mirroring SURVEY.md §3.1's plan/execute split).
-        self._value_indices = jnp.asarray(index_plan.value_indices)
-        self._scatter_cols = jnp.asarray(index_plan.scatter_cols)
+        # time — mirroring SURVEY.md §3.1's plan/execute split). They are
+        # passed to the jitted pipelines as arguments, not closure constants:
+        # both the gather-based decompress/unpack (inverse maps) and the
+        # forward gathers need them, and embedding multi-MB constants in the
+        # executable is slower on remote-attached TPUs.
+        self._tables = {
+            "slot_src": jnp.asarray(index_plan.slot_src),
+            "col_inv": jnp.asarray(index_plan.col_inv),
+            "value_indices": jnp.asarray(index_plan.value_indices),
+            "scatter_cols": jnp.asarray(index_plan.scatter_cols),
+        }
+        self._init_pallas(use_pallas)
         self._backward_jit = jax.jit(self._backward_impl)
         self._forward_jit = {
             Scaling.NONE: jax.jit(functools.partial(self._forward_impl,
@@ -62,6 +72,57 @@ class TransformPlan:
             Scaling.FULL: jax.jit(functools.partial(self._forward_impl,
                                                     scaled=True)),
         }
+
+    def _init_pallas(self, use_pallas: Optional[bool]) -> None:
+        """Enable the Pallas monotone-gather compression path when the value
+        order is stick-major/z-ascending (strictly increasing flat indices —
+        the layout the reference recommends for performance, details.rst
+        "Data Distribution") on a TPU backend in single precision. Otherwise
+        the XLA gather path is used.
+
+        ``use_pallas=True`` on a non-TPU backend builds the tables (useful
+        for interpret-mode testing) but execution stays on the XLA path; the
+        kernel is float32-only, so forcing it on a double-precision plan is
+        an error rather than a silent downcast."""
+        from .ops import gather_kernel as gk
+
+        p = self.index_plan
+        self._pallas = None
+        self._pallas_active = False
+        backend_ok = jax.default_backend() == "tpu"
+        if use_pallas is True and self.precision != "single":
+            raise InvalidParameterError(
+                "the Pallas compression kernel is single-precision only")
+        auto = backend_ok and self.precision == "single"
+        if use_pallas is False or (use_pallas is None and not auto):
+            return
+        vi = p.value_indices.astype(np.int64)
+        if p.num_values == 0 or p.num_sticks == 0 \
+                or (np.diff(vi) <= 0).any():
+            return
+        num_slots = p.num_sticks * p.dim_z
+        occupied = np.zeros(num_slots, bool)
+        occupied[vi] = True
+        dec_idx = np.maximum(np.cumsum(occupied) - 1, 0)
+        # Decompress (slot <- value) has increments <= 1, so its tile spans
+        # are always bounded; compress (value <- slot) spans grow with slot
+        # gaps (near-empty sticks) and may exceed the VMEM bound — each
+        # direction is enabled independently, the other falls back to XLA.
+        dec = gk.build_monotone_gather_tables(dec_idx, occupied, p.num_values)
+        cmp_ = gk.build_monotone_gather_tables(
+            vi, np.ones(p.num_values, bool), num_slots)
+        self._pallas = {"dec": dec, "cmp": cmp_}
+        if dec is None and cmp_ is None:
+            self._pallas = None
+            return
+        self._pallas_active = backend_ok
+        for name, t in (("dec", dec), ("cmp", cmp_)):
+            if t is None:
+                continue
+            self._tables[name + "_row0"] = jnp.asarray(t.row0)
+            self._tables[name + "_lane"] = jnp.asarray(t.lane_sel)
+            self._tables[name + "_rowsel"] = jnp.asarray(t.row_sel)
+            self._tables[name + "_mask"] = jnp.asarray(t.mask)
 
     # -- reference Transform getters (transform.hpp:91-151) -----------------
     @property
@@ -110,35 +171,68 @@ class TransformPlan:
     def _is_r2c(self) -> bool:
         return self.index_plan.hermitian
 
-    def _backward_impl(self, values_il):
+    def _decompress(self, values_il, tables):
         p = self.index_plan
-        values = interleaved_to_complex(values_il).astype(self._cdt)
-        sticks = stages.decompress(values, self._value_indices,
-                                   p.num_sticks, p.dim_z)
+        if not self._pallas_active or self._pallas["dec"] is None:
+            return stages.decompress(values_il.astype(self._rdt),
+                                     tables["slot_src"], p.num_sticks,
+                                     p.dim_z)
+        from .ops import gather_kernel as gk
+        t = self._pallas["dec"]
+        re, im = gk.planar_from_interleaved(values_il.astype(np.float32),
+                                            t.src_rows)
+        out_re, out_im = gk.monotone_gather(
+            re, im, tables["dec_row0"], tables["dec_lane"],
+            tables["dec_rowsel"], tables["dec_mask"],
+            span_rows=t.span_rows, src_rows=t.src_rows)
+        flat = (out_re.reshape(-1)[:t.num_out]
+                + 1j * out_im.reshape(-1)[:t.num_out])
+        return flat.reshape(p.num_sticks, p.dim_z)
+
+    def _compress(self, sticks, tables, scale):
+        p = self.index_plan
+        if not self._pallas_active or self._pallas["cmp"] is None:
+            return stages.compress(sticks, tables["value_indices"], scale)
+        from .ops import gather_kernel as gk
+        t = self._pallas["cmp"]
+        flat_il = jnp.stack([jnp.real(sticks).reshape(-1),
+                             jnp.imag(sticks).reshape(-1)], axis=-1)
+        re, im = gk.planar_from_interleaved(flat_il, t.src_rows)
+        out_re, out_im = gk.monotone_gather(
+            re, im, tables["cmp_row0"], tables["cmp_lane"],
+            tables["cmp_rowsel"], tables["cmp_mask"],
+            span_rows=t.span_rows, src_rows=t.src_rows)
+        values = gk.interleaved_from_planar(out_re, out_im, t.num_out)
+        if scale is not None:
+            values = values * jnp.asarray(scale, values.dtype)
+        return values
+
+    def _backward_impl(self, values_il, tables):
+        p = self.index_plan
+        sticks = self._decompress(values_il, tables)
         if self._is_r2c and p.zero_stick_id is not None:
             zid = p.zero_stick_id
             sticks = sticks.at[zid].set(
                 stages.complete_stick_hermitian(sticks[zid]))
         sticks = stages.z_backward(sticks)
-        grid = stages.sticks_to_grid(sticks, self._scatter_cols, p.dim_z,
-                                     p.dim_y, p.dim_x_freq)
+        grid = stages.sticks_to_grid(sticks, tables["col_inv"], p.dim_y,
+                                     p.dim_x_freq)
         if self._is_r2c:
             grid = stages.complete_plane_hermitian(grid)
             return stages.xy_backward_r2c(grid, p.dim_x)
         return complex_to_interleaved(stages.xy_backward_c2c(grid))
 
-    def _forward_impl(self, space, *, scaled: bool):
+    def _forward_impl(self, space, tables, *, scaled: bool):
         p = self.index_plan
         if self._is_r2c:
             grid = stages.xy_forward_r2c(space.astype(self._rdt))
         else:
             grid = stages.xy_forward_c2c(
                 interleaved_to_complex(space).astype(self._cdt))
-        sticks = stages.grid_to_sticks(grid, self._scatter_cols)
+        sticks = stages.grid_to_sticks(grid, tables["scatter_cols"])
         sticks = stages.z_forward(sticks)
         scale = 1.0 / self.global_size if scaled else None
-        values = stages.compress(sticks, self._value_indices, scale)
-        return complex_to_interleaved(values)
+        return self._compress(sticks, tables, scale)
 
     # -- public execution (reference: transform.hpp:198-211) -----------------
     def backward(self, values):
@@ -149,7 +243,7 @@ class TransformPlan:
         "Transform Definition")."""
         values_il = self._coerce_values(values)
         with timed_transform("backward") as box:
-            box.value = self._backward_jit(values_il)
+            box.value = self._backward_jit(values_il, self._tables)
         return box.value
 
     def forward(self, space, scaling: Scaling = Scaling.NONE):
@@ -159,7 +253,7 @@ class TransformPlan:
         scaling = Scaling(scaling)
         space = self._coerce_space(space)
         with timed_transform("forward") as box:
-            box.value = self._forward_jit[scaling](space)
+            box.value = self._forward_jit[scaling](space, self._tables)
         return box.value
 
     # -- input coercion ------------------------------------------------------
@@ -197,10 +291,10 @@ class TransformPlan:
 
 def make_local_plan(transform_type: TransformType, dim_x: int, dim_y: int,
                     dim_z: int, triplets, precision: str = "single",
-                    ) -> TransformPlan:
+                    use_pallas: Optional[bool] = None) -> TransformPlan:
     """Build a local plan from raw index triplets — the moral equivalent of
     ``Grid::create_transform`` without a communicator (reference:
     grid.hpp:138-141)."""
     plan = build_index_plan(TransformType(transform_type), dim_x, dim_y,
                             dim_z, np.asarray(triplets))
-    return TransformPlan(plan, precision=precision)
+    return TransformPlan(plan, precision=precision, use_pallas=use_pallas)
