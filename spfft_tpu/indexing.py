@@ -85,6 +85,11 @@ def convert_index_triplets(hermitian: bool, dim_x: int, dim_y: int, dim_z: int,
         raise InvalidParameterError(
             "more frequency values than grid elements (indices.hpp:126-128)")
 
+    from . import native
+    res = native.plan_indices(hermitian, dim_x, dim_y, dim_z, triplets)
+    if res is not None:
+        return res
+
     x, y, z = (triplets[:, 0].astype(np.int64), triplets[:, 1].astype(np.int64),
                triplets[:, 2].astype(np.int64))
     centered = bool((triplets < 0).any())
@@ -209,6 +214,10 @@ def inverse_slot_map(value_indices: np.ndarray, num_slots: int,
     several duplicate triplets, the last occurrence wins (the reference's
     scatter order is unspecified for duplicates).
     """
+    from . import native
+    out = native.inverse_map(value_indices, num_slots, num_values)
+    if out is not None:
+        return out
     src = np.full(num_slots, num_values, np.int32)
     src[value_indices] = np.arange(num_values, dtype=np.int32)
     return src
@@ -219,6 +228,10 @@ def inverse_col_map(scatter_cols: np.ndarray, num_cols: int,
     """Invert the stick->plane-column map: ``col_inv[c] = stick id at column
     c``, sentinel ``num_sticks`` for empty columns. Turns the backward
     unpack scatter (transpose_host.hpp:132-154) into a row gather."""
+    from . import native
+    out = native.inverse_map(scatter_cols, num_cols, num_sticks)
+    if out is not None:
+        return out
     col_inv = np.full(num_cols, num_sticks, np.int32)
     col_inv[scatter_cols] = np.arange(num_sticks, dtype=np.int32)
     return col_inv

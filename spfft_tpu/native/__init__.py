@@ -1,0 +1,140 @@
+"""Loader for the native (C++) plan-time kernels.
+
+The C++ sources in this directory are compiled on demand into a shared
+library next to the sources (``g++ -O3 -fopenmp -shared -fPIC``) and loaded
+with ctypes — this image has no pybind11, and a plain C ABI keeps the
+boundary trivial. Everything here has a NumPy fallback in
+:mod:`spfft_tpu.indexing`; the native path only accelerates plan
+construction (the reference's plan-time index conversion,
+src/compression/indices.hpp:120-186), never the jitted transform itself.
+
+Set ``SPFFT_TPU_NO_NATIVE=1`` to force the NumPy fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+import threading
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "planner.cpp")
+_LIB = os.path.join(_DIR, f"_planner_{sys.implementation.cache_tag}.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _compile() -> None:
+    """Compile to a temp file and rename atomically: concurrent processes
+    (multi-host plan construction, pytest-xdist) may race on first use, and
+    a partially written .so must never be dlopen'd."""
+    tmp = f"{_LIB}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-fopenmp",
+           _SRC, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, _LIB)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.spfft_tpu_plan_indices.restype = ctypes.c_int64
+    lib.spfft_tpu_plan_indices.argtypes = [
+        ctypes.c_int32, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+    lib.spfft_tpu_inverse_map.restype = ctypes.c_int32
+    lib.spfft_tpu_inverse_map.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+        ctypes.c_int64, ctypes.c_int32]
+    return lib
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    """Compile (if stale) and load the native library; None if unavailable."""
+    global _lib, _load_failed
+    if _lib is not None:
+        return _lib
+    if _load_failed or os.environ.get("SPFFT_TPU_NO_NATIVE") == "1":
+        return None
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        try:
+            if (not os.path.exists(_LIB)
+                    or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+                _compile()
+            try:
+                _lib = _bind(ctypes.CDLL(_LIB))
+            except OSError:
+                # A stale/foreign binary (e.g. restored with a fresh mtime by
+                # a checkout) — rebuild once before giving up.
+                _compile()
+                _lib = _bind(ctypes.CDLL(_LIB))
+        except (OSError, subprocess.CalledProcessError):
+            _load_failed = True
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def plan_indices(hermitian: bool, dim_x: int, dim_y: int, dim_z: int,
+                 triplets: np.ndarray):
+    """Native ``convert_index_triplets`` core. Returns
+    ``(value_indices, stick_keys, centered)`` or None if the native library
+    is unavailable. Raises the same exception types as the NumPy path for
+    invalid input (mapped from the C error codes)."""
+    lib = _load()
+    if lib is None:
+        return None
+    from ..errors import InvalidIndicesError, InvalidParameterError
+
+    xyz = np.ascontiguousarray(triplets, dtype=np.int64)
+    if xyz.ndim != 2 or xyz.shape[1] != 3:
+        raise InvalidParameterError(
+            f"expected (n, 3) index triplets, got shape {xyz.shape}")
+    n = xyz.shape[0]
+    value_indices = np.empty(n, np.int32)
+    stick_keys = np.empty(max(n, 1), np.int32)
+    centered = ctypes.c_int32(0)
+    num_sticks = lib.spfft_tpu_plan_indices(
+        ctypes.c_int32(1 if hermitian else 0), dim_x, dim_y, dim_z,
+        xyz.ctypes.data, n, value_indices.ctypes.data,
+        stick_keys.ctypes.data, ctypes.byref(centered))
+    if num_sticks == -1:
+        raise InvalidIndicesError(
+            f"index triplet out of bounds for dims ({dim_x},{dim_y},{dim_z}),"
+            f" hermitian={hermitian}")
+    if num_sticks == -2:
+        raise InvalidParameterError(
+            "more frequency values than grid elements (indices.hpp:126-128)")
+    return value_indices, stick_keys[:num_sticks].copy(), bool(centered.value)
+
+
+def inverse_map(indices: np.ndarray, num_slots: int,
+                sentinel: int) -> Optional[np.ndarray]:
+    """Native inverse map (scatter of iota with last-wins duplicates), or
+    None if the native library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    idx = np.ascontiguousarray(indices, dtype=np.int32).reshape(-1)
+    out = np.empty(num_slots, np.int32)
+    status = lib.spfft_tpu_inverse_map(idx.ctypes.data, idx.shape[0],
+                                       out.ctypes.data, num_slots,
+                                       ctypes.c_int32(sentinel))
+    if status != 0:
+        raise IndexError(
+            f"inverse map index out of range [0, {num_slots})")
+    return out

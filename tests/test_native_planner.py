@@ -1,0 +1,128 @@
+"""Parity tests: native C++ planner vs the NumPy reference semantics.
+
+The NumPy path in spfft_tpu.indexing is the executable specification of the
+reference index conversion (reference: src/compression/indices.hpp:120-186);
+the native library must agree bit-for-bit on valid inputs and raise the same
+exception types on invalid ones.
+"""
+
+import numpy as np
+import pytest
+
+from spfft_tpu import native
+from spfft_tpu.errors import InvalidIndicesError
+from spfft_tpu.indexing import (build_index_plan, inverse_col_map,
+                                inverse_slot_map)
+from spfft_tpu.types import TransformType
+
+from test_util import center_triplets, random_sparse_triplets
+
+
+def _make_triplets(rng, dims, centered, hermitian):
+    """Random triplet set valid for the given mode: hermitian restricts
+    storage x to [0, dim_x//2]; centered converts to negative-frequency
+    indexing (x stays non-negative for hermitian)."""
+    t = random_sparse_triplets(rng, dims)
+    if hermitian:
+        t = t[t[:, 0] <= dims[0] // 2]
+        if t.shape[0] == 0:
+            t = np.array([[0, 0, 0]], np.int32)
+    if centered:
+        c = center_triplets(t, dims)
+        if hermitian:
+            c[:, 0] = t[:, 0]
+        t = c
+    return t
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native planner unavailable")
+
+
+def _numpy_reference(hermitian, dims, triplets):
+    """The pure-NumPy conversion, bypassing the native fast path."""
+    dim_x, dim_y, dim_z = dims
+    x, y, z = (triplets[:, 0].astype(np.int64),
+               triplets[:, 1].astype(np.int64),
+               triplets[:, 2].astype(np.int64))
+    xs = np.where(x < 0, x + dim_x, x)
+    ys = np.where(y < 0, y + dim_y, y)
+    zs = np.where(z < 0, z + dim_z, z)
+    keys = xs * dim_y + ys
+    stick_keys, stick_ids = np.unique(keys, return_inverse=True)
+    value_indices = stick_ids.astype(np.int64) * dim_z + zs
+    return value_indices.astype(np.int32), stick_keys.astype(np.int32)
+
+
+DIMS = [(1, 1, 1), (2, 3, 4), (11, 12, 13), (13, 11, 12), (32, 32, 32),
+        (100, 13, 2)]
+
+
+@pytest.mark.parametrize("dims", DIMS)
+@pytest.mark.parametrize("centered", [False, True])
+@pytest.mark.parametrize("hermitian", [False, True])
+def test_plan_indices_parity(dims, centered, hermitian):
+    rng = np.random.default_rng(hash((dims, centered, hermitian)) % 2**32)
+    triplets = _make_triplets(rng, dims, centered, hermitian)
+    res = native.plan_indices(hermitian, *dims, triplets)
+    assert res is not None
+    vi, keys, got_centered = res
+    ref_vi, ref_keys = _numpy_reference(hermitian, dims, triplets)
+    np.testing.assert_array_equal(vi, ref_vi)
+    np.testing.assert_array_equal(keys, ref_keys)
+    assert got_centered == bool((triplets < 0).any())
+
+
+def test_plan_indices_empty():
+    res = native.plan_indices(False, 4, 4, 4,
+                              np.empty((0, 3), np.int64))
+    vi, keys, centered = res
+    assert vi.size == 0 and keys.size == 0 and not centered
+
+
+@pytest.mark.parametrize("bad", [
+    np.array([[4, 0, 0]]),    # x beyond dim-1
+    np.array([[0, -3, 0]]),   # centered y below floor(4/2) - 4 + 1 = -1
+    np.array([[0, 0, 99]]),   # z far out of range
+])
+def test_plan_indices_bounds(bad):
+    with pytest.raises(InvalidIndicesError):
+        build_index_plan(TransformType.C2C, 4, 4, 4, bad)
+
+
+def test_hermitian_negative_x_rejected():
+    with pytest.raises(InvalidIndicesError):
+        build_index_plan(TransformType.R2C, 8, 8, 8,
+                         np.array([[-1, 0, 0]]))
+
+
+def test_inverse_map_parity():
+    rng = np.random.default_rng(7)
+    n_slots = 1000
+    idx = rng.choice(n_slots, size=300, replace=False).astype(np.int32)
+    got = native.inverse_map(idx, n_slots, 300)
+    ref = np.full(n_slots, 300, np.int32)
+    ref[idx] = np.arange(300, dtype=np.int32)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_inverse_map_duplicates_last_wins():
+    idx = np.array([5, 5, 2, 5], np.int32)
+    got = native.inverse_map(idx, 8, 4)
+    assert got[5] == 3 and got[2] == 2
+    assert all(got[i] == 4 for i in (0, 1, 3, 4, 6, 7))
+
+
+def test_full_plan_through_native_matches_numpy(monkeypatch):
+    """build_index_plan with and without the native path must agree."""
+    rng = np.random.default_rng(3)
+    dims = (12, 13, 11)
+    triplets = random_sparse_triplets(rng, dims)
+    plan_native = build_index_plan(TransformType.C2C, *dims, triplets)
+    monkeypatch.setenv("SPFFT_TPU_NO_NATIVE", "1")
+    plan_numpy = build_index_plan(TransformType.C2C, *dims, triplets)
+    np.testing.assert_array_equal(plan_native.value_indices,
+                                  plan_numpy.value_indices)
+    np.testing.assert_array_equal(plan_native.stick_keys,
+                                  plan_numpy.stick_keys)
+    np.testing.assert_array_equal(plan_native.slot_src, plan_numpy.slot_src)
+    np.testing.assert_array_equal(plan_native.col_inv, plan_numpy.col_inv)
