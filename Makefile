@@ -1,0 +1,43 @@
+# Native components of spfft_tpu.
+#
+# `make native` builds the plan-time planner kernels (also auto-built on
+# first import, see spfft_tpu/native/__init__.py); `make capi` builds the
+# embeddable C API library libspfft_tpu.so (include/spfft_tpu.h);
+# `make example-c` builds and runs the C example against it.
+
+PY_INCLUDES := $(shell python3-config --includes)
+PY_LDFLAGS  := $(shell python3-config --ldflags --embed)
+CXX         ?= g++
+CXXFLAGS    ?= -O3 -std=c++17 -Wall -fPIC
+
+NATIVE_DIR  := spfft_tpu/native
+CACHE_TAG   := $(shell python3 -c "import sys; print(sys.implementation.cache_tag)")
+PLANNER_SO  := $(NATIVE_DIR)/_planner_$(CACHE_TAG).so
+CAPI_SO     := lib/libspfft_tpu.so
+
+.PHONY: all native capi example-c test clean
+
+all: native capi
+
+native: $(PLANNER_SO)
+
+$(PLANNER_SO): $(NATIVE_DIR)/planner.cpp
+	$(CXX) $(CXXFLAGS) -fopenmp -shared $< -o $@
+
+capi: $(CAPI_SO)
+
+$(CAPI_SO): $(NATIVE_DIR)/capi.cpp include/spfft_tpu.h
+	@mkdir -p lib
+	$(CXX) $(CXXFLAGS) -shared $(PY_INCLUDES) $< -o $@ $(PY_LDFLAGS)
+
+example-c: $(CAPI_SO)
+	@mkdir -p build
+	$(CXX) -O2 -Iinclude examples/example.c -o build/example_c -Llib \
+	  -lspfft_tpu -Wl,-rpath,'$$ORIGIN/../lib'
+	SPFFT_TPU_PACKAGE_PATH=$(CURDIR) ./build/example_c
+
+test:
+	python -m pytest tests/ -q
+
+clean:
+	rm -rf lib build $(NATIVE_DIR)/_planner_*.so

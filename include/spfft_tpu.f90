@@ -1,0 +1,131 @@
+!> spfft_tpu Fortran interface — bind(C) declarations over the C API.
+!>
+!> Role-equivalent of the reference Fortran module (reference:
+!> include/spfft/spfft.f90 — a bind(C) interface module mirroring the whole
+!> C API). Compile this file into your Fortran project and link against
+!> libspfft_tpu.so (built with `make capi`); see include/spfft_tpu.h for
+!> the buffer-layout and threading contracts.
+!>
+!> Note: this image ships no Fortran compiler, so unlike the C path this
+!> module is not exercised by the test suite; it tracks include/spfft_tpu.h
+!> declaration-for-declaration.
+
+module spfft_tpu
+  use iso_c_binding
+  implicit none
+
+  ! Error codes (include/spfft_tpu.h SpfftTpuError)
+  integer(c_int), parameter :: SPFFT_TPU_SUCCESS = 0
+  integer(c_int), parameter :: SPFFT_TPU_UNKNOWN_ERROR = 1
+  integer(c_int), parameter :: SPFFT_TPU_INVALID_HANDLE_ERROR = 2
+  integer(c_int), parameter :: SPFFT_TPU_OVERFLOW_ERROR = 3
+  integer(c_int), parameter :: SPFFT_TPU_ALLOCATION_ERROR = 4
+  integer(c_int), parameter :: SPFFT_TPU_INVALID_PARAMETER_ERROR = 5
+  integer(c_int), parameter :: SPFFT_TPU_DUPLICATE_INDICES_ERROR = 6
+  integer(c_int), parameter :: SPFFT_TPU_INVALID_INDICES_ERROR = 7
+  integer(c_int), parameter :: SPFFT_TPU_DISTRIBUTED_SUPPORT_ERROR = 8
+  integer(c_int), parameter :: SPFFT_TPU_DISTRIBUTED_ERROR = 9
+  integer(c_int), parameter :: SPFFT_TPU_PARAMETER_MISMATCH_ERROR = 10
+  integer(c_int), parameter :: SPFFT_TPU_HOST_EXECUTION_ERROR = 11
+  integer(c_int), parameter :: SPFFT_TPU_FFT_ERROR = 12
+  integer(c_int), parameter :: SPFFT_TPU_DEVICE_ERROR = 13
+  integer(c_int), parameter :: SPFFT_TPU_DEVICE_SUPPORT_ERROR = 15
+  integer(c_int), parameter :: SPFFT_TPU_DEVICE_ALLOCATION_ERROR = 16
+  integer(c_int), parameter :: SPFFT_TPU_DEVICE_FFT_ERROR = 22
+  integer(c_int), parameter :: SPFFT_TPU_RUNTIME_INIT_ERROR = 100
+
+  ! Transform types (SpfftTpuTransformType)
+  integer(c_int), parameter :: SPFFT_TPU_TRANS_C2C = 0
+  integer(c_int), parameter :: SPFFT_TPU_TRANS_R2C = 1
+
+  ! Scaling (SpfftTpuScalingType)
+  integer(c_int), parameter :: SPFFT_TPU_NO_SCALING = 0
+  integer(c_int), parameter :: SPFFT_TPU_FULL_SCALING = 1
+
+  ! Precision (SpfftTpuPrecision)
+  integer(c_int), parameter :: SPFFT_TPU_PREC_SINGLE = 0
+  integer(c_int), parameter :: SPFFT_TPU_PREC_DOUBLE = 1
+
+  interface
+
+    integer(c_int) function spfft_tpu_init(package_path) &
+        bind(C, name="spfft_tpu_init")
+      use iso_c_binding
+      type(c_ptr), value :: package_path
+    end function
+
+    integer(c_int) function spfft_tpu_plan_create(plan, transform_type, &
+        dim_x, dim_y, dim_z, num_values, index_triplets, precision) &
+        bind(C, name="spfft_tpu_plan_create")
+      use iso_c_binding
+      type(c_ptr), intent(out) :: plan
+      integer(c_int), value :: transform_type
+      integer(c_int), value :: dim_x
+      integer(c_int), value :: dim_y
+      integer(c_int), value :: dim_z
+      integer(c_long_long), value :: num_values
+      integer(c_int), dimension(*), intent(in) :: index_triplets
+      integer(c_int), value :: precision
+    end function
+
+    integer(c_int) function spfft_tpu_plan_destroy(plan) &
+        bind(C, name="spfft_tpu_plan_destroy")
+      use iso_c_binding
+      type(c_ptr), value :: plan
+    end function
+
+    integer(c_int) function spfft_tpu_backward(plan, values, space) &
+        bind(C, name="spfft_tpu_backward")
+      use iso_c_binding
+      type(c_ptr), value :: plan
+      type(c_ptr), value :: values
+      type(c_ptr), value :: space
+    end function
+
+    integer(c_int) function spfft_tpu_forward(plan, space, scaling, values) &
+        bind(C, name="spfft_tpu_forward")
+      use iso_c_binding
+      type(c_ptr), value :: plan
+      type(c_ptr), value :: space
+      integer(c_int), value :: scaling
+      type(c_ptr), value :: values
+    end function
+
+    integer(c_int) function spfft_tpu_plan_dim_x(plan, out) &
+        bind(C, name="spfft_tpu_plan_dim_x")
+      use iso_c_binding
+      type(c_ptr), value :: plan
+      integer(c_int), intent(out) :: out
+    end function
+
+    integer(c_int) function spfft_tpu_plan_dim_y(plan, out) &
+        bind(C, name="spfft_tpu_plan_dim_y")
+      use iso_c_binding
+      type(c_ptr), value :: plan
+      integer(c_int), intent(out) :: out
+    end function
+
+    integer(c_int) function spfft_tpu_plan_dim_z(plan, out) &
+        bind(C, name="spfft_tpu_plan_dim_z")
+      use iso_c_binding
+      type(c_ptr), value :: plan
+      integer(c_int), intent(out) :: out
+    end function
+
+    integer(c_int) function spfft_tpu_plan_num_values(plan, out) &
+        bind(C, name="spfft_tpu_plan_num_values")
+      use iso_c_binding
+      type(c_ptr), value :: plan
+      integer(c_long_long), intent(out) :: out
+    end function
+
+    integer(c_int) function spfft_tpu_plan_transform_type(plan, out) &
+        bind(C, name="spfft_tpu_plan_transform_type")
+      use iso_c_binding
+      type(c_ptr), value :: plan
+      integer(c_int), intent(out) :: out
+    end function
+
+  end interface
+
+end module spfft_tpu

@@ -1,0 +1,127 @@
+/*
+ * spfft_tpu C API — native entry points for C/C++/Fortran callers.
+ *
+ * Role-equivalent of the reference C API (reference: include/spfft/grid.h,
+ * transform.h, errors.h): opaque plan handles, integer error codes, and
+ * interleaved-complex buffers. The compute path behind these calls is the
+ * JAX/XLA pipeline of the spfft_tpu Python package, hosted by an embedded
+ * CPython interpreter inside libspfft_tpu.so (see native/capi.cpp).
+ *
+ * Buffer conventions (identical to the Python API, and to the reference's
+ * space-domain layout (z*Ny + y)*Nx + x, docs/source/details.rst "Indexing"):
+ *   - frequency values: interleaved complex, 2*num_values reals
+ *   - C2C space domain: interleaved complex, 2*dimX*dimY*dimZ reals
+ *   - R2C space domain: dimX*dimY*dimZ reals
+ *   - element type: float for SPFFT_TPU_PREC_SINGLE, double for DOUBLE
+ *
+ * Thread-safety: calls may come from any thread; the library serialises on
+ * the embedded interpreter's GIL. A plan handle must not be used after
+ * spfft_tpu_plan_destroy.
+ */
+
+#ifndef SPFFT_TPU_H
+#define SPFFT_TPU_H
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Error codes, matching spfft_tpu.ErrorCode (Python) which mirrors the
+ * reference SpfftError enum (reference: include/spfft/errors.h:33-126). */
+typedef enum SpfftTpuError {
+  SPFFT_TPU_SUCCESS = 0,
+  SPFFT_TPU_UNKNOWN_ERROR = 1,
+  SPFFT_TPU_INVALID_HANDLE_ERROR = 2,
+  SPFFT_TPU_OVERFLOW_ERROR = 3,
+  SPFFT_TPU_ALLOCATION_ERROR = 4,
+  SPFFT_TPU_INVALID_PARAMETER_ERROR = 5,
+  SPFFT_TPU_DUPLICATE_INDICES_ERROR = 6,
+  SPFFT_TPU_INVALID_INDICES_ERROR = 7,
+  SPFFT_TPU_DISTRIBUTED_SUPPORT_ERROR = 8,
+  SPFFT_TPU_DISTRIBUTED_ERROR = 9,
+  SPFFT_TPU_PARAMETER_MISMATCH_ERROR = 10,
+  SPFFT_TPU_HOST_EXECUTION_ERROR = 11,
+  SPFFT_TPU_FFT_ERROR = 12,
+  SPFFT_TPU_DEVICE_ERROR = 13,
+  SPFFT_TPU_DEVICE_SUPPORT_ERROR = 15,
+  SPFFT_TPU_DEVICE_ALLOCATION_ERROR = 16,
+  SPFFT_TPU_DEVICE_FFT_ERROR = 22,
+  /* C-layer-only: the embedded interpreter could not be started or the
+   * spfft_tpu package could not be imported. */
+  SPFFT_TPU_RUNTIME_INIT_ERROR = 100
+} SpfftTpuError;
+
+/* Transform type (reference: types.h:85-95). */
+typedef enum SpfftTpuTransformType {
+  SPFFT_TPU_TRANS_C2C = 0,
+  SPFFT_TPU_TRANS_R2C = 1
+} SpfftTpuTransformType;
+
+/* Forward-transform scaling (reference: types.h:97-106). */
+typedef enum SpfftTpuScalingType {
+  SPFFT_TPU_NO_SCALING = 0,
+  SPFFT_TPU_FULL_SCALING = 1
+} SpfftTpuScalingType;
+
+/* Element precision (reference float twins GridFloat/TransformFloat). */
+typedef enum SpfftTpuPrecision {
+  SPFFT_TPU_PREC_SINGLE = 0,
+  SPFFT_TPU_PREC_DOUBLE = 1
+} SpfftTpuPrecision;
+
+/* Opaque plan handle (reference: SpfftTransform, transform.h). */
+typedef void* SpfftTpuPlan;
+
+/*
+ * Start the embedded interpreter and import the spfft_tpu package.
+ * package_path may name a directory to prepend to the module search path
+ * (pass NULL if spfft_tpu is already importable). Safe to call more than
+ * once; implicit on first plan creation.
+ */
+int spfft_tpu_init(const char* package_path);
+
+/*
+ * Create a plan for a local sparse 3D FFT (reference:
+ * spfft_grid_create + spfft_transform_create collapsed into one call —
+ * XLA owns buffer pooling, so the Grid layer's pre-allocation role is
+ * moot in C; see Python Grid for the API-parity wrapper).
+ *
+ * index_triplets: num_values x 3 ints (x, y, z per value), centered
+ * (negative) or storage indexing (reference: types.h SPFFT_INDEX_TRIPLETS).
+ */
+int spfft_tpu_plan_create(SpfftTpuPlan* plan, int transform_type, int dim_x,
+                          int dim_y, int dim_z, long long num_values,
+                          const int* index_triplets, int precision);
+
+int spfft_tpu_plan_destroy(SpfftTpuPlan plan);
+
+/*
+ * Frequency -> space (reference: spfft_transform_backward, transform.h).
+ * values: 2*num_values reals (interleaved). space: the full local cube in
+ * the layout documented above. Unnormalised inverse DFT.
+ */
+int spfft_tpu_backward(SpfftTpuPlan plan, const void* values, void* space);
+
+/*
+ * Space -> frequency (reference: spfft_transform_forward, transform.h).
+ * scaling: SPFFT_TPU_NO_SCALING or SPFFT_TPU_FULL_SCALING (1/(Nx*Ny*Nz)).
+ */
+int spfft_tpu_forward(SpfftTpuPlan plan, const void* space, int scaling,
+                      void* values);
+
+/* Getters (reference: spfft_transform_get_* accessors, transform.h). Each
+ * writes one value and returns an error code. */
+int spfft_tpu_plan_dim_x(SpfftTpuPlan plan, int* out);
+int spfft_tpu_plan_dim_y(SpfftTpuPlan plan, int* out);
+int spfft_tpu_plan_dim_z(SpfftTpuPlan plan, int* out);
+int spfft_tpu_plan_num_values(SpfftTpuPlan plan, long long* out);
+int spfft_tpu_plan_transform_type(SpfftTpuPlan plan, int* out);
+
+/* Static message for an error code (never NULL). */
+const char* spfft_tpu_error_string(int code);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* SPFFT_TPU_H */

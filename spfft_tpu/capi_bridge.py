@@ -1,0 +1,136 @@
+"""Python side of the C API (see include/spfft_tpu.h, native/capi.cpp).
+
+Every function here is called from the embedded interpreter inside
+``libspfft_tpu.so`` with plain integers (addresses, sizes, enum values) and
+returns ``(error_code, payload)`` — exceptions never cross the C boundary.
+The error-code mapping reproduces the reference C API's try/catch->code
+pattern (reference: src/spfft/grid.cpp:88-103 wraps every C entry point and
+returns SpfftError).
+
+Caller-owned memory is viewed (never copied on input, one copy on output)
+through ``ctypes`` pointers; layout contracts are documented in the header.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import itertools
+import traceback
+from typing import Dict, Tuple
+
+import numpy as np
+
+from .errors import ErrorCode, GenericError, InvalidParameterError
+from .plan import TransformPlan, make_local_plan
+from .types import Scaling, TransformType
+
+_plans: Dict[int, TransformPlan] = {}
+_next_id = itertools.count(1)
+
+_INVALID_HANDLE = 2  # SPFFT_TPU_INVALID_HANDLE_ERROR
+
+
+def _code_for(exc: BaseException) -> int:
+    if isinstance(exc, GenericError):
+        return int(exc.error_code())
+    return int(ErrorCode.UNKNOWN)
+
+
+def _guarded(fn):
+    def wrapper(*args) -> Tuple[int, int]:
+        try:
+            payload = fn(*args)
+            return (int(ErrorCode.SUCCESS), 0 if payload is None
+                    else int(payload))
+        except BaseException as exc:  # noqa: BLE001 — C boundary
+            traceback.print_exc()
+            return (_code_for(exc), 0)
+    wrapper.__name__ = fn.__name__
+    return wrapper
+
+
+def _real_ctype(precision: str):
+    return ctypes.c_float if precision == "single" else ctypes.c_double
+
+
+def _view(addr: int, n: int, precision: str) -> np.ndarray:
+    """View n reals of caller memory at addr (no copy)."""
+    ptr = ctypes.cast(addr, ctypes.POINTER(_real_ctype(precision)))
+    return np.ctypeslib.as_array(ptr, shape=(n,))
+
+
+def _get_plan(pid: int) -> TransformPlan:
+    plan = _plans.get(pid)
+    if plan is None:
+        raise _InvalidHandle()
+    return plan
+
+
+class _InvalidHandle(GenericError):
+    code = ErrorCode.INVALID_HANDLE
+
+
+@_guarded
+def plan_create(transform_type: int, dim_x: int, dim_y: int, dim_z: int,
+                num_values: int, triplets_addr: int, precision: int) -> int:
+    if transform_type not in (0, 1):
+        raise InvalidParameterError(f"bad transform type {transform_type}")
+    if precision not in (0, 1):
+        raise InvalidParameterError(f"bad precision {precision}")
+    if num_values < 0:
+        raise InvalidParameterError(f"negative num_values {num_values}")
+    if num_values == 0:
+        trip = np.empty((0, 3), np.int32)
+    else:
+        ptr = ctypes.cast(triplets_addr, ctypes.POINTER(ctypes.c_int32))
+        trip = np.array(np.ctypeslib.as_array(ptr, shape=(num_values, 3)),
+                        np.int32, copy=True)
+    plan = make_local_plan(
+        TransformType.C2C if transform_type == 0 else TransformType.R2C,
+        dim_x, dim_y, dim_z, trip,
+        precision="single" if precision == 0 else "double")
+    pid = next(_next_id)
+    _plans[pid] = plan
+    return pid
+
+
+@_guarded
+def plan_destroy(pid: int) -> None:
+    if _plans.pop(pid, None) is None:
+        raise _InvalidHandle()
+
+
+@_guarded
+def backward(pid: int, values_addr: int, space_addr: int) -> None:
+    plan = _get_plan(pid)
+    p = plan.index_plan
+    values = _view(values_addr, 2 * p.num_values,
+                   plan.precision).reshape(p.num_values, 2)
+    space = np.asarray(plan.backward(values.copy()))
+    n_space = p.dim_z * p.dim_y * p.dim_x * (1 if p.hermitian else 2)
+    _view(space_addr, n_space, plan.precision)[:] = space.reshape(-1)
+
+
+@_guarded
+def forward(pid: int, space_addr: int, scaling: int,
+            values_addr: int) -> None:
+    plan = _get_plan(pid)
+    p = plan.index_plan
+    n_space = p.dim_z * p.dim_y * p.dim_x * (1 if p.hermitian else 2)
+    space = _view(space_addr, n_space, plan.precision)
+    shape = (p.dim_z, p.dim_y, p.dim_x) + (() if p.hermitian else (2,))
+    if scaling not in (0, 1):
+        raise InvalidParameterError(f"bad scaling {scaling}")
+    values = np.asarray(plan.forward(
+        space.copy().reshape(shape),
+        Scaling.FULL if scaling == 1 else Scaling.NONE))
+    _view(values_addr, 2 * p.num_values,
+          plan.precision)[:] = values.reshape(-1)
+
+
+@_guarded
+def plan_info(pid: int, what: int) -> int:
+    plan = _get_plan(pid)
+    p = plan.index_plan
+    return {0: p.dim_x, 1: p.dim_y, 2: p.dim_z, 3: p.num_values,
+            4: 0 if p.transform_type == TransformType.C2C else 1}[what]

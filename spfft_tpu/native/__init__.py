@@ -75,12 +75,13 @@ def _load() -> Optional[ctypes.CDLL]:
                 _compile()
             try:
                 _lib = _bind(ctypes.CDLL(_LIB))
-            except OSError:
-                # A stale/foreign binary (e.g. restored with a fresh mtime by
-                # a checkout) — rebuild once before giving up.
+            except (OSError, AttributeError):
+                # A stale/foreign binary (restored with a fresh mtime by a
+                # checkout, or built from an older source revision missing a
+                # symbol) — rebuild once before giving up.
                 _compile()
                 _lib = _bind(ctypes.CDLL(_LIB))
-        except (OSError, subprocess.CalledProcessError):
+        except (OSError, AttributeError, subprocess.CalledProcessError):
             _load_failed = True
     return _lib
 
@@ -119,6 +120,10 @@ def plan_indices(hermitian: bool, dim_x: int, dim_y: int, dim_z: int,
     if num_sticks == -2:
         raise InvalidParameterError(
             "more frequency values than grid elements (indices.hpp:126-128)")
+    if num_sticks == -3:
+        # Grid too large for the dense-bitmap algorithm (allocation failed)
+        # — let the NumPy path handle it.
+        return None
     return value_indices, stick_keys[:num_sticks].copy(), bool(centered.value)
 
 

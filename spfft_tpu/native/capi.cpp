@@ -1,0 +1,248 @@
+// Native C API host: embeds CPython and dispatches to spfft_tpu.capi_bridge.
+//
+// Role-equivalent of the reference C API implementation (reference:
+// src/spfft/grid.cpp:88-103, transform.cpp — C entry points wrapping C++ in
+// try/catch and returning SpfftError codes). Here the "C++ core" is the
+// JAX/XLA pipeline of the Python package; this translation unit owns only
+// the runtime embedding: interpreter lifecycle, the GIL, and marshalling
+// plain integers across the ABI. All argument validation, numpy buffer
+// wrapping, and error-code mapping happens in spfft_tpu/capi_bridge.py,
+// which returns (code, payload) tuples and never raises across the
+// boundary.
+//
+// Build (see Makefile target `capi`):
+//   g++ -O3 -std=c++17 -shared -fPIC capi.cpp -o libspfft_tpu.so
+//       $(python3-config --includes) $(python3-config --ldflags --embed)
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <mutex>
+
+namespace {
+
+constexpr int kSuccess = 0;
+constexpr int kInvalidHandle = 2;        // SPFFT_TPU_INVALID_HANDLE_ERROR
+constexpr int kInvalidParameter = 5;     // SPFFT_TPU_INVALID_PARAMETER_ERROR
+constexpr int kUnknown = 1;              // SPFFT_TPU_UNKNOWN_ERROR
+constexpr int kRuntimeInit = 100;        // SPFFT_TPU_RUNTIME_INIT_ERROR
+
+std::mutex g_init_mutex;
+PyObject* g_bridge = nullptr;  // spfft_tpu.capi_bridge module (owned)
+bool g_we_initialized = false;
+
+// Plan handles are the bridge's integer plan ids, stored directly in the
+// opaque pointer (id 0 is never issued).
+inline void* id_to_handle(long long id) {
+  return reinterpret_cast<void*>(static_cast<intptr_t>(id));
+}
+inline long long handle_to_id(void* h) {
+  return static_cast<long long>(reinterpret_cast<intptr_t>(h));
+}
+
+// Ensure the interpreter is running and the bridge module is imported.
+// Returns 0 or an error code. On success the caller still must take the
+// GIL via PyGILState_Ensure for its own calls.
+int ensure_runtime(const char* package_path) {
+  std::lock_guard<std::mutex> lock(g_init_mutex);
+  if (g_bridge != nullptr) return kSuccess;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(/*install_sigint_handler=*/0);
+    if (!Py_IsInitialized()) return kRuntimeInit;
+    g_we_initialized = true;
+  }
+  PyGILState_STATE st = PyGILState_Ensure();
+  int code = kSuccess;
+  if (package_path != nullptr) {
+    PyObject* sys_path = PySys_GetObject("path");  // borrowed
+    PyObject* p = PyUnicode_FromString(package_path);
+    if (sys_path == nullptr || p == nullptr ||
+        PyList_Insert(sys_path, 0, p) != 0) {
+      code = kRuntimeInit;
+    }
+    Py_XDECREF(p);
+  }
+  if (code == kSuccess) {
+    g_bridge = PyImport_ImportModule("spfft_tpu.capi_bridge");
+    if (g_bridge == nullptr) {
+      PyErr_Print();
+      code = kRuntimeInit;
+    }
+  }
+  PyGILState_Release(st);
+  // If we started the interpreter, detach this thread's state so any
+  // thread (including this one) can re-acquire via PyGILState_Ensure —
+  // unconditionally, or a failed import would leave the GIL held forever
+  // and deadlock every later call instead of returning an error code.
+  static bool detached = false;
+  if (g_we_initialized && !detached) {
+    PyEval_SaveThread();
+    detached = true;
+  }
+  return code;
+}
+
+// Call bridge.<fn>(args...) where every argument is a long long; the bridge
+// returns (code, payload). Writes payload to *payload_out if non-null.
+int call_bridge(const char* fn, std::initializer_list<long long> args,
+                long long* payload_out) {
+  int code = ensure_runtime(nullptr);
+  if (code != kSuccess) return code;
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyObject* tuple = PyTuple_New(static_cast<Py_ssize_t>(args.size()));
+  if (tuple == nullptr) {
+    PyErr_Clear();
+    PyGILState_Release(st);
+    return kUnknown;
+  }
+  Py_ssize_t i = 0;
+  for (long long a : args) {
+    PyObject* v = PyLong_FromLongLong(a);
+    if (v == nullptr) {
+      PyErr_Clear();
+      Py_DECREF(tuple);
+      PyGILState_Release(st);
+      return kUnknown;
+    }
+    PyTuple_SET_ITEM(tuple, i++, v);
+  }
+  PyObject* callable = PyObject_GetAttrString(g_bridge, fn);
+  PyObject* result =
+      callable != nullptr ? PyObject_CallObject(callable, tuple) : nullptr;
+  Py_XDECREF(callable);
+  Py_DECREF(tuple);
+  if (result == nullptr) {
+    PyErr_Print();
+    PyGILState_Release(st);
+    return kUnknown;
+  }
+  long long payload = 0;
+  if (PyTuple_Check(result) && PyTuple_GET_SIZE(result) == 2) {
+    code = static_cast<int>(PyLong_AsLongLong(PyTuple_GET_ITEM(result, 0)));
+    payload = PyLong_AsLongLong(PyTuple_GET_ITEM(result, 1));
+  } else {
+    code = kUnknown;
+  }
+  Py_DECREF(result);
+  if (PyErr_Occurred()) {
+    PyErr_Print();
+    code = kUnknown;
+  }
+  if (payload_out != nullptr) *payload_out = payload;
+  PyGILState_Release(st);
+  return code;
+}
+
+}  // namespace
+
+extern "C" {
+
+int spfft_tpu_init(const char* package_path) {
+  return ensure_runtime(package_path);
+}
+
+int spfft_tpu_plan_create(void** plan, int transform_type, int dim_x,
+                          int dim_y, int dim_z, long long num_values,
+                          const int* index_triplets, int precision) {
+  if (plan == nullptr || (index_triplets == nullptr && num_values > 0)) {
+    return kInvalidParameter;
+  }
+  long long pid = 0;
+  int code = call_bridge(
+      "plan_create",
+      {transform_type, dim_x, dim_y, dim_z, num_values,
+       static_cast<long long>(reinterpret_cast<intptr_t>(index_triplets)),
+       precision},
+      &pid);
+  if (code == kSuccess) *plan = id_to_handle(pid);
+  return code;
+}
+
+int spfft_tpu_plan_destroy(void* plan) {
+  return call_bridge("plan_destroy", {handle_to_id(plan)}, nullptr);
+}
+
+int spfft_tpu_backward(void* plan, const void* values, void* space) {
+  if (values == nullptr || space == nullptr) return kInvalidParameter;
+  return call_bridge(
+      "backward",
+      {handle_to_id(plan),
+       static_cast<long long>(reinterpret_cast<intptr_t>(values)),
+       static_cast<long long>(reinterpret_cast<intptr_t>(space))},
+      nullptr);
+}
+
+int spfft_tpu_forward(void* plan, const void* space, int scaling,
+                      void* values) {
+  if (values == nullptr || space == nullptr) return kInvalidParameter;
+  return call_bridge(
+      "forward",
+      {handle_to_id(plan),
+       static_cast<long long>(reinterpret_cast<intptr_t>(space)), scaling,
+       static_cast<long long>(reinterpret_cast<intptr_t>(values))},
+      nullptr);
+}
+
+static int plan_info(void* plan, int what, long long* out) {
+  if (out == nullptr) return kInvalidParameter;
+  return call_bridge("plan_info", {handle_to_id(plan), what}, out);
+}
+
+int spfft_tpu_plan_dim_x(void* plan, int* out) {
+  long long v = 0;
+  int code = plan_info(plan, 0, &v);
+  if (code == kSuccess) *out = static_cast<int>(v);
+  return code;
+}
+
+int spfft_tpu_plan_dim_y(void* plan, int* out) {
+  long long v = 0;
+  int code = plan_info(plan, 1, &v);
+  if (code == kSuccess) *out = static_cast<int>(v);
+  return code;
+}
+
+int spfft_tpu_plan_dim_z(void* plan, int* out) {
+  long long v = 0;
+  int code = plan_info(plan, 2, &v);
+  if (code == kSuccess) *out = static_cast<int>(v);
+  return code;
+}
+
+int spfft_tpu_plan_num_values(void* plan, long long* out) {
+  return plan_info(plan, 3, out);
+}
+
+int spfft_tpu_plan_transform_type(void* plan, int* out) {
+  long long v = 0;
+  int code = plan_info(plan, 4, &v);
+  if (code == kSuccess) *out = static_cast<int>(v);
+  return code;
+}
+
+const char* spfft_tpu_error_string(int code) {
+  switch (code) {
+    case 0: return "success";
+    case 1: return "unknown error";
+    case 2: return "invalid plan handle";
+    case 3: return "size overflow";
+    case 4: return "allocation failure";
+    case 5: return "invalid parameter";
+    case 6: return "duplicate z-stick indices";
+    case 7: return "frequency index out of bounds";
+    case 8: return "distributed support missing";
+    case 9: return "distributed/collective failure";
+    case 10: return "plan parameters mismatch across shards";
+    case 11: return "host execution failure";
+    case 12: return "FFT backend failure";
+    case 13: return "device (TPU/XLA) failure";
+    case 15: return "device support missing";
+    case 16: return "device allocation failure";
+    case 22: return "device FFT failure";
+    case 100: return "embedded Python runtime initialisation failed";
+    default: return "unrecognised error code";
+  }
+}
+
+}  // extern "C"

@@ -24,6 +24,9 @@ namespace {
 // Error codes mirrored in spfft_tpu/native/__init__.py.
 constexpr int64_t kErrInvalidBounds = -1;
 constexpr int64_t kErrTooManyValues = -2;
+// Allocation failure / grid too large for the dense-bitmap algorithm — the
+// caller falls back to the NumPy path (no C++ exception may cross the C ABI).
+constexpr int64_t kErrNoNativePath = -3;
 
 }  // namespace
 
@@ -60,7 +63,14 @@ int64_t spfft_tpu_plan_indices(int32_t hermitian, int64_t dim_x,
   const int64_t min_z = max_z - dim_z + 1;
 
   const int64_t plane = dim_x * dim_y;
-  std::vector<uint8_t> present(static_cast<size_t>(plane), 0);
+  std::vector<uint8_t> present;
+  std::vector<int32_t> rank;
+  try {
+    present.assign(static_cast<size_t>(plane), 0);
+    rank.resize(static_cast<size_t>(plane));
+  } catch (...) {
+    return kErrNoNativePath;
+  }
 
   // Pass 2: bounds check + mark present stick keys. Benign write races on
   // the bitmap (all writers store 1).
@@ -83,7 +93,6 @@ int64_t spfft_tpu_plan_indices(int32_t hermitian, int64_t dim_x,
 
   // Pass 3: rank present keys in ascending order (the ordered-map semantics
   // of indices.hpp:152-165, without the map).
-  std::vector<int32_t> rank(static_cast<size_t>(plane));
   int32_t num_sticks = 0;
   for (int64_t k = 0; k < plane; ++k) {
     if (present[static_cast<size_t>(k)]) {
