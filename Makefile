@@ -28,7 +28,7 @@ capi: $(CAPI_SO)
 
 $(CAPI_SO): $(NATIVE_DIR)/capi.cpp include/spfft_tpu.h
 	@mkdir -p lib
-	$(CXX) $(CXXFLAGS) -shared $(PY_INCLUDES) $< -o $@ $(PY_LDFLAGS)
+	$(CXX) $(CXXFLAGS) -shared -Iinclude $(PY_INCLUDES) $< -o $@ $(PY_LDFLAGS)
 
 example-c: $(CAPI_SO)
 	@mkdir -p build

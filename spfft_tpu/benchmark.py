@@ -1,0 +1,200 @@
+"""Benchmark CLI: ``python -m spfft_tpu.benchmark``.
+
+Rebuild of the reference benchmark program (reference:
+tests/programs/benchmark.cpp) with the same knobs and output schema:
+
+* workload: dense-within-cutoff stick set — all (x, y) sticks with
+  ``x < dim_x_freq * sparsity``, full z sticks, split round-robin over
+  shards when distributed (reference: benchmark.cpp:176-205);
+* measurement: warm-up pass, then repeated backward+forward pairs
+  (reference: benchmark.cpp:84-96), wall-clock with a hard device sync at
+  the end of the timed loop;
+* output: per-phase timing tree + JSON dump with ``timings`` and
+  ``parameters`` sections (reference: benchmark.cpp:276-308).
+
+Flags mirror reference benchmark.cpp:138-156: -d dims, -r repeats,
+-s sparsity, -t c2c|r2c, -e exchange, -p host|device, -m num transforms,
+-o json output; plus --shards to run distributed over a device mesh and
+--precision for the float twin.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def cutoff_stick_triplets(dim_x: int, dim_y: int, dim_z: int,
+                          sparsity: float, hermitian: bool) -> np.ndarray:
+    """Dense-within-cutoff stick set (reference: benchmark.cpp:176-205):
+    every (x, y) stick with x below ``dim_x_freq * sparsity``, full z."""
+    dim_x_freq = dim_x // 2 + 1 if hermitian else dim_x
+    num_x = max(1, min(dim_x_freq, int(round(dim_x_freq * sparsity))))
+    x = np.arange(num_x, dtype=np.int32)
+    y = np.arange(dim_y, dtype=np.int32)
+    z = np.arange(dim_z, dtype=np.int32)
+    X, Y, Z = np.meshgrid(x, y, z, indexing="ij")
+    return np.stack([X.ravel(), Y.ravel(), Z.ravel()], axis=1)
+
+
+def _parse_args(argv):
+    p = argparse.ArgumentParser(
+        prog="python -m spfft_tpu.benchmark",
+        description="spfft_tpu benchmark (reference: tests/programs/"
+                    "benchmark.cpp)")
+    p.add_argument("-d", "--dimensions", type=int, nargs="+", required=True,
+                   metavar="N", help="grid dims: one value (cubic) or three")
+    p.add_argument("-r", "--repeats", type=int, default=10)
+    p.add_argument("-w", "--warmups", type=int, default=1)
+    p.add_argument("-s", "--sparsity", type=float, default=1.0,
+                   help="fraction of x range covered by sticks (default 1)")
+    p.add_argument("-t", "--transform", choices=["c2c", "r2c"],
+                   default="c2c")
+    p.add_argument("-e", "--exchange",
+                   choices=["default", "buffered", "bufferedFloat",
+                            "compact", "compactFloat", "unbuffered"],
+                   default="default")
+    p.add_argument("-p", "--proc", choices=["host", "device"],
+                   default="device",
+                   help="host: numpy I/O every repeat; device: arrays stay "
+                        "resident (reference -p cpu|gpu|gpu-gpu)")
+    p.add_argument("-m", "--num-transforms", type=int, default=1)
+    p.add_argument("-o", "--output", default=None, metavar="FILE.json")
+    p.add_argument("--shards", type=int, default=1,
+                   help="distribute over an N-device mesh (default local)")
+    p.add_argument("--cpu", action="store_true",
+                   help="force a virtual CPU platform with --shards devices "
+                        "(multi-chip simulation, like the test conftest)")
+    p.add_argument("--precision", choices=["single", "double"],
+                   default="single")
+    return p.parse_args(argv)
+
+
+_EXCHANGE = {
+    "default": "default", "buffered": "buffered",
+    "bufferedFloat": "buffered_float", "compact": "compact_buffered",
+    "compactFloat": "compact_buffered_float", "unbuffered": "unbuffered",
+}
+
+
+def main(argv=None) -> int:
+    args = _parse_args(argv if argv is not None else sys.argv[1:])
+    dims = args.dimensions
+    if len(dims) == 1:
+        dims = dims * 3
+    if len(dims) != 3:
+        print("error: -d takes one or three values", file=sys.stderr)
+        return 2
+    nx, ny, nz = dims
+
+    if args.cpu:
+        from .utils.platform import force_virtual_cpu_devices
+        force_virtual_cpu_devices(max(args.shards, 1), trust_env=False)
+
+    import jax
+    from . import timing
+    from .grid import Transform
+    from .plan import make_local_plan
+    from .parallel import make_distributed_plan, make_mesh
+    from .multi import multi_transform_backward, multi_transform_forward
+    from .types import ExchangeType, Scaling, TransformType
+    from .utils.dtypes import as_interleaved
+    from .utils.workloads import (even_plane_split,
+                                  round_robin_stick_partition)
+
+    ttype = TransformType.C2C if args.transform == "c2c" else TransformType.R2C
+    hermitian = ttype == TransformType.R2C
+    exchange = ExchangeType(_EXCHANGE[args.exchange])
+    triplets = cutoff_stick_triplets(nx, ny, nz, args.sparsity, hermitian)
+    rng = np.random.default_rng(42)
+    cdt = np.complex64 if args.precision == "single" else np.complex128
+
+    t0 = time.perf_counter()
+    if args.shards > 1:
+        if len(jax.devices()) < args.shards:
+            print(f"error: {args.shards} shards but only "
+                  f"{len(jax.devices())} devices", file=sys.stderr)
+            return 2
+        parts = round_robin_stick_partition(triplets, dims, args.shards)
+        planes = even_plane_split(nz, args.shards)
+        plan = make_distributed_plan(ttype, nx, ny, nz, parts, planes,
+                                     mesh=make_mesh(args.shards),
+                                     precision=args.precision,
+                                     exchange=exchange)
+        values_np = [
+            (rng.uniform(-1, 1, len(p)) + 1j * rng.uniform(-1, 1, len(p)))
+            .astype(cdt) for p in parts]
+        values = plan.shard_values(values_np)
+    else:
+        plan = make_local_plan(ttype, nx, ny, nz, triplets,
+                               precision=args.precision)
+        n = len(triplets)
+        v = (rng.uniform(-1, 1, n) + 1j * rng.uniform(-1, 1, n)).astype(cdt)
+        values_np = np.asarray(as_interleaved(v, args.precision))
+        values = jax.device_put(values_np)
+    plan_s = time.perf_counter() - t0
+
+    transforms = [Transform(plan) for _ in range(args.num_transforms)]
+    m = args.num_transforms
+
+    def run_pair(vals):
+        spaces = multi_transform_backward(transforms, [vals] * m)
+        outs = multi_transform_forward(transforms, spaces,
+                                       [Scaling.NONE] * m)
+        return outs
+
+    def sync(arrs):
+        jax.block_until_ready(arrs)
+        # Hard sync: a host readback defeats any queue-ahead on
+        # remote-attached devices (device programs execute FIFO per core).
+        np.asarray(jax.tree_util.tree_leaves(arrs)[-1]).ravel()[:1]
+
+    if args.repeats < 1 or args.warmups < 0:
+        print("error: -r must be >= 1 and -w >= 0", file=sys.stderr)
+        return 2
+    host_io = args.proc == "host"
+    feed = values_np if host_io else values
+    for _ in range(args.warmups):
+        last = run_pair(feed)
+    if args.warmups:
+        sync(last)
+
+    timing.enable()
+    timing.GlobalTimer.reset()
+    t0 = time.perf_counter()
+    for _ in range(args.repeats):
+        outs = run_pair(feed)
+    sync(outs)
+    total = time.perf_counter() - t0
+    timing.disable()
+
+    pair_s = total / args.repeats
+    result = timing.GlobalTimer.process()
+    params = {
+        "proc": args.proc, "shards": args.shards,
+        "devices": len(jax.devices()), "backend": jax.default_backend(),
+        "dim_x": nx, "dim_y": ny, "dim_z": nz,
+        "exchange": args.exchange, "repeats": args.repeats,
+        "transform_type": args.transform, "num_transforms": m,
+        "sparsity": args.sparsity, "precision": args.precision,
+        "num_values": int(len(triplets)),
+        "plan_seconds": round(plan_s, 4),
+        "pair_seconds": round(pair_s, 6),
+    }
+    print(json.dumps(params, indent=2))
+    result.print()
+    if args.output:
+        payload = json.loads(result.json())
+        payload["parameters"] = params
+        with open(args.output, "w") as f:
+            json.dump(payload, f, indent=2)
+        print(f"wrote {args.output}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,6 +20,8 @@
 #include <cstdio>
 #include <mutex>
 
+#include "spfft_tpu.h"  // keep definitions checked against the public ABI
+
 namespace {
 
 constexpr int kSuccess = 0;

@@ -53,8 +53,8 @@ from ..utils.dtypes import (as_interleaved, complex_dtype,
                             complex_to_interleaved, interleaved_to_complex,
                             real_dtype)
 from .exchange import (all_to_all_blocks, pack_freq_to_blocks,
-                       pack_space_to_blocks, unpack_blocks_to_grid,
-                       unpack_blocks_to_sticks)
+                       pack_space_to_blocks, ring_exchange_blocks,
+                       unpack_blocks_to_grid, unpack_blocks_to_sticks)
 from .mesh import SHARD_AXIS, make_mesh
 
 
@@ -177,6 +177,11 @@ class DistributedTransformPlan:
         if self.exchange.float_wire:
             self._wire_dtype = (np.float32 if precision == "double"
                                 else jnp.bfloat16)
+        # UNBUFFERED selects the ppermute-ring mechanism; every other
+        # variant uses the single fused all_to_all (see exchange.py).
+        self._exchange_fn = (ring_exchange_blocks
+                             if self.exchange == ExchangeType.UNBUFFERED
+                             else all_to_all_blocks)
         self._build_tables()
         self._sharded = NamedSharding(self.mesh, P(self.axis_name))
         self._replicated = NamedSharding(self.mesh, P())
@@ -280,7 +285,7 @@ class DistributedTransformPlan:
             sticks = sticks * (1 - oh) + completed * oh
         sticks = stages.z_backward(sticks)
         blocks = pack_freq_to_blocks(sticks, zmap)
-        blocks = all_to_all_blocks(blocks, self.axis_name, self._wire_dtype)
+        blocks = self._exchange_fn(blocks, self.axis_name, self._wire_dtype)
         grid = unpack_blocks_to_grid(blocks, col_inv, dp.dim_y,
                                      dp.dim_x_freq)
         if dp.hermitian:
@@ -298,7 +303,7 @@ class DistributedTransformPlan:
                 interleaved_to_complex(space[0]).astype(self._cdt))
         blocks = pack_space_to_blocks(grid, cols_flat, dp.num_shards,
                                       dp.max_sticks)
-        blocks = all_to_all_blocks(blocks, self.axis_name, self._wire_dtype)
+        blocks = self._exchange_fn(blocks, self.axis_name, self._wire_dtype)
         sticks = unpack_blocks_to_sticks(blocks, z_src)
         sticks = stages.z_forward(sticks)
         scale = 1.0 / self.global_size if scaled else None

@@ -108,6 +108,41 @@ def unpack_blocks_to_sticks(blocks, z_src):
     return flat[:, z_src]
 
 
+def ring_exchange_blocks(blocks, axis_name: str,
+                         wire_real_dtype: Optional[jnp.dtype] = None):
+    """All-to-all block exchange as S-1 ``ppermute`` ring steps.
+
+    Mechanically distinct alternative to the single fused ``all_to_all``
+    (the reference likewise ships three mechanically different exchange
+    algorithms, Alltoall/Alltoallv/Alltoallw — SURVEY.md §2.5): each step k
+    sends exactly one peer block to the shard k hops away, so XLA can
+    software-pipeline the steps with surrounding compute, and each transfer
+    rides a single ICI hop on a ring topology. Semantically identical to
+    :func:`all_to_all_blocks`; selected via ``ExchangeType.UNBUFFERED``
+    (the reference variant that also trades fewer big copies for more
+    transfer operations).
+    """
+    num_shards = blocks.shape[0]
+    if num_shards == 1:
+        return blocks
+    if wire_real_dtype is not None:
+        rdt = blocks.real.dtype
+        il = complex_to_interleaved(blocks).astype(wire_real_dtype)
+        out = ring_exchange_blocks(il, axis_name, None)
+        return interleaved_to_complex(out.astype(rdt))
+    idx = jax.lax.axis_index(axis_name)
+    # received[k] = source shard (r - k)'s block addressed to r
+    received = [blocks[idx]]
+    for k in range(1, num_shards):
+        perm = [(j, (j + k) % num_shards) for j in range(num_shards)]
+        send = blocks[(idx + k) % num_shards]
+        received.append(jax.lax.ppermute(send, axis_name, perm))
+    stacked = jnp.stack(received, axis=0)
+    # out[s] must be shard s's block = received[(r - s) % S]; as a function
+    # of s that is a reversal followed by a roll of r + 1.
+    return jnp.roll(stacked[::-1], idx + 1, axis=0)
+
+
 def all_to_all_blocks(blocks, axis_name: str,
                       wire_real_dtype: Optional[jnp.dtype] = None):
     """Exchange blocks between shards; block (r -> s) lands at (s, slot r).

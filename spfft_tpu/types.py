@@ -26,13 +26,21 @@ import enum
 class ExchangeType(enum.Enum):
     """Distributed exchange algorithm selector (reference: types.h:33-62).
 
-    On TPU every variant lowers to ``lax.all_to_all`` on a padded
-    ``(shards, max_sticks, max_planes)`` block; the only distinction that is
-    currently meaningful is wire precision (``*_FLOAT``). BUFFERED,
-    COMPACT_BUFFERED and UNBUFFERED are accepted for API parity and behave
-    identically (the reference's Alltoallv/Alltoallw layouts exist to avoid
-    padding bytes on the MPI wire; a compact ragged wire layout is a possible
-    future optimisation for highly non-uniform distributions).
+    Two mechanisms exist on TPU, both on the padded
+    ``(shards, max_sticks, max_planes)`` block layout:
+
+    * DEFAULT / BUFFERED / COMPACT_BUFFERED — one fused ``lax.all_to_all``
+      over the mesh axis (the natural fit for XLA's fixed-shape
+      collectives; the reference's Alltoallv/Alltoallw layouts exist to
+      avoid padding bytes on the MPI wire, so BUFFERED and COMPACT_BUFFERED
+      collapse to the same padded collective here).
+    * UNBUFFERED — S-1 single-hop ``ppermute`` ring steps
+      (exchange.ring_exchange_blocks), a mechanically different exchange
+      that XLA can software-pipeline with surrounding compute.
+
+    The ``*_FLOAT`` variants additionally reduce the on-wire precision
+    around the exchange, halving ICI bytes exactly as the reference halves
+    MPI bytes (docs/source/details.rst "MPI Exchange").
     """
 
     DEFAULT = "default"

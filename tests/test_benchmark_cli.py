@@ -1,0 +1,42 @@
+"""Benchmark CLI smoke tests (reference: tests/programs/benchmark.cpp —
+the harness itself is part of the deliverable, SURVEY.md §6)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from spfft_tpu.benchmark import cutoff_stick_triplets, main
+
+
+def test_cutoff_stick_workload_shape():
+    t = cutoff_stick_triplets(8, 6, 4, 0.5, hermitian=False)
+    # x < 8 * 0.5 = 4 sticks in x, all y, full z
+    assert t.shape == (4 * 6 * 4, 3)
+    assert t[:, 0].max() == 3
+    assert set(np.unique(t[:, 2])) == set(range(4))
+
+
+def test_cutoff_stick_workload_hermitian():
+    t = cutoff_stick_triplets(8, 6, 4, 1.0, hermitian=True)
+    assert t[:, 0].max() == 8 // 2  # dim_x_freq - 1
+
+
+@pytest.mark.parametrize("flags", [
+    ["-d", "12", "-r", "2", "-t", "c2c", "-m", "2"],
+    ["-d", "8", "10", "12", "-r", "1", "-t", "r2c", "-s", "0.5"],
+    ["-d", "16", "-r", "1", "--shards", "4", "-e", "compactFloat"],
+    ["-d", "16", "-r", "1", "--shards", "2", "-t", "r2c", "-p", "host"],
+])
+def test_cli_runs(flags, tmp_path, capsys):
+    out = tmp_path / "bench.json"
+    assert main(flags + ["-o", str(out)]) == 0
+    payload = json.loads(out.read_text())
+    assert "parameters" in payload and "timings" in payload
+    assert payload["parameters"]["pair_seconds"] > 0
+    assert capsys.readouterr().out  # params + tree printed
+
+
+def test_cli_bad_dims():
+    assert main(["-d", "4", "4"]) == 2
