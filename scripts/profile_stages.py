@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""Per-stage wall-clock breakdown of the 256^3 north-star pipeline on the
+real device — identifies which phase dominates the backward+forward pair."""
+import sys
+import os
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from spfft_tpu import TransformType, make_local_plan
+from spfft_tpu.ops import stages
+from spfft_tpu.utils.workloads import spherical_cutoff_triplets
+from spfft_tpu.utils import as_interleaved
+
+n = int(os.environ.get("DIM", 256))
+triplets = spherical_cutoff_triplets(n)
+plan = make_local_plan(TransformType.C2C, n, n, n, triplets,
+                       precision="single")
+p = plan.index_plan
+print(f"dim={n} num_values={p.num_values} num_sticks={p.num_sticks} "
+      f"pallas_active={plan._pallas_active}")
+
+rng = np.random.default_rng(0)
+values = (rng.uniform(-1, 1, len(triplets))
+          + 1j * rng.uniform(-1, 1, len(triplets))).astype(np.complex64)
+values_il = jnp.asarray(as_interleaved(values, "single"))
+tables = plan._tables
+
+
+def timeit(name, fn, *args, reps=5):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{name:24s} {dt*1e3:8.2f} ms")
+    return out
+
+
+# backward stages
+dec = jax.jit(lambda v: plan._decompress(v, tables))
+sticks = timeit("decompress", dec, values_il)
+zb = jax.jit(stages.z_backward)
+sticks_z = timeit("z_backward (ifft)", zb, sticks)
+s2g = jax.jit(lambda s: stages.sticks_to_grid(s, tables["col_inv"], p.dim_y,
+                                              p.dim_x_freq))
+grid = timeit("sticks_to_grid", s2g, sticks_z)
+xyb = jax.jit(stages.xy_backward_c2c)
+space = timeit("xy_backward (ifft2)", xyb, grid)
+
+# forward stages
+xyf = jax.jit(stages.xy_forward_c2c)
+gridf = timeit("xy_forward (fft2)", xyf, space)
+g2s = jax.jit(lambda g: stages.grid_to_sticks(g, tables["scatter_cols"]))
+sticksf = timeit("grid_to_sticks", g2s, gridf)
+zf = jax.jit(stages.z_forward)
+sticks_zf = timeit("z_forward (fft)", zf, sticksf)
+cmp_ = jax.jit(lambda s: plan._compress(s, tables, None))
+vals = timeit("compress", cmp_, sticks_zf)
+
+# full fused pair
+pair = jax.jit(lambda v: plan._forward_impl(
+    plan._backward_impl(v, tables), tables, scaled=False))
+timeit("FULL fused pair", pair, values_il)

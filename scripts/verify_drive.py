@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Canonical verify drive (see .claude/skills/verify/SKILL.md).
+
+Runs on whatever platform jax selects (TPU when the axon tunnel is up;
+set PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu to force CPU)."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+
+import spfft_tpu as sp
+from spfft_tpu.utils import as_complex_np
+from spfft_tpu.utils.workloads import spherical_cutoff_triplets
+
+print("platform:", jax.default_backend(), jax.devices())
+
+# 1. dense 2x2x2 C2C round trip (reference example.cpp equivalent)
+n = 2
+triplets = np.array([[x, y, z] for x in range(n) for y in range(n)
+                     for z in range(n)])
+plan = sp.make_local_plan(sp.TransformType.C2C, n, n, n, triplets,
+                          precision="single")
+rng = np.random.default_rng(0)
+v = (rng.uniform(-1, 1, len(triplets))
+     + 1j * rng.uniform(-1, 1, len(triplets))).astype(np.complex64)
+space = plan.backward(v)
+freq = as_complex_np(np.asarray(plan.forward(space)))
+assert np.allclose(freq, v * n**3, atol=1e-4), "dense round trip failed"
+print("1. dense 2^3 round trip: OK")
+
+# 2. R2C vs numpy oracle: random real field, fftn coefficients at the
+# non-redundant hermitian triplets; unnormalised backward returns field * N.
+n = 8
+herm = [(x, y, z) for x in range(n // 2 + 1) for y in range(n)
+        for z in range(n)]
+herm = np.asarray(herm)
+field = rng.uniform(-1, 1, (n, n, n))
+cube = np.fft.fftn(field)  # cube[z, y, x] with positive storage indexing
+vals = np.array([cube[t[2], t[1], t[0]] for t in herm], np.complex64)
+rplan = sp.make_local_plan(sp.TransformType.R2C, n, n, n, herm,
+                           precision="single")
+got = np.asarray(rplan.backward(vals))
+err = np.abs(got - field * n**3).max()
+assert err < 1e-2, f"r2c backward mismatch {err}"
+print("2. R2C vs numpy oracle: OK")
+
+# 3. error surface
+try:
+    sp.make_local_plan(sp.TransformType.C2C, 4, 4, 4, np.array([[9, 0, 0]]))
+    raise SystemExit("expected InvalidIndicesError")
+except sp.InvalidIndicesError:
+    pass
+try:
+    plan.backward(v[:3])
+    raise SystemExit("expected InvalidParameterError")
+except sp.InvalidParameterError:
+    pass
+print("3. error surface: OK")
+
+# 4. scale probe: 128^3 spherical cutoff
+n = 128
+t0 = time.perf_counter()
+trip = spherical_cutoff_triplets(n)
+plan = sp.make_local_plan(sp.TransformType.C2C, n, n, n, trip,
+                          precision="single")
+plan_s = time.perf_counter() - t0
+vals = (rng.uniform(-1, 1, len(trip))
+        + 1j * rng.uniform(-1, 1, len(trip))).astype(np.complex64)
+space = plan.backward(vals)
+jax.block_until_ready(space)
+t0 = time.perf_counter()
+reps = 5
+for _ in range(reps):
+    out = plan.forward(plan.backward(vals), sp.Scaling.FULL)
+jax.block_until_ready(out)
+per = (time.perf_counter() - t0) / reps
+got = as_complex_np(np.asarray(out))
+err = np.abs(got - vals).max()
+assert err < 1e-4, f"128^3 roundtrip err {err}"
+print(f"4. 128^3 probe: OK — plan {plan_s:.2f}s, pair {per*1e3:.1f} ms/iter, "
+      f"pallas={plan._pallas_active}, err={err:.2e}")
+print("VERIFY DRIVE: ALL OK")

@@ -10,21 +10,28 @@ When the user's value order is stick-major and z-ascending — the layout the
 reference itself recommends for performance (docs/source/details.rst "Data
 Distribution") and the natural output of index generators — both directions
 become *monotone* gathers: ``out[j] = src[idx[j]] * mask[j]`` with ``idx``
-non-decreasing. Monotonicity bounds the source span of any 1024-slot output
-tile, so a tile's sources fit in VMEM and the gather decomposes into
+non-decreasing. Monotonicity localises the source span of any 1024-slot
+output tile, so the gather decomposes into
 
-  1. a contiguous DMA of the span rows (double-buffered across grid steps),
+  1. contiguous DMAs of K-row source windows (double-buffered across grid
+     steps),
   2. K in-register row gathers via Mosaic's ``dynamic_gather``
      (``take_along_axis`` along lanes, indices < 128),
   3. a select-accumulate over the K candidate rows.
 
-Tables (span start row, lane/row selectors, validity mask) are precomputed on
-host at plan time. Non-monotone value orders fall back to the XLA gather path
-(plan.py decides).
+A tile whose span exceeds one K-row window is split into several *chunks*:
+consecutive grid steps that map to the same output tile and accumulate into
+it (the standard Pallas revisiting-reduction pattern), so arbitrarily gappy
+index sets — e.g. the near-empty edge sticks of a spherical cutoff — stay on
+the fast path instead of falling back to the XLA gather. K is chosen per
+plan from the span distribution (small K wastes nothing on dense tiles;
+gappy tiles just emit more chunks).
 
-Data is planar (separate real/imag (rows, 128) arrays): the TPU lane
-dimension must be the innermost 128 and complex dtypes cannot cross the
-pallas boundary.
+Per-chunk selector tables are precomputed on host at plan time and packed
+into one int32 word per output slot: lane (bits 0-6), window row (bits 7-19),
+validity (bit 20). Data is planar (separate real/imag (rows, 128) arrays):
+the TPU lane dimension must be the innermost 128 and complex dtypes cannot
+cross the pallas boundary.
 """
 
 from __future__ import annotations
@@ -40,38 +47,46 @@ from jax.experimental.pallas import tpu as pltpu
 
 TILE_SUB = 8
 TILE_LANE = 128
-TILE = TILE_SUB * TILE_LANE  # output slots per grid step
+TILE = TILE_SUB * TILE_LANE  # output slots per tile
 
-#: Fall back to the XLA gather when a tile's source span exceeds this many
-#: 128-element rows (pathologically gappy index sets; VMEM scratch is
-#: 2 buffers x 2 channels x K x 128 x 4B).
-MAX_SPAN_ROWS = 64
+#: Candidate source-window heights (rows) for the chunk decomposition; the
+#: builder picks the one minimising modelled DMA + compute cost.
+K_CANDIDATES = (8, 16, 32, 64, 128)
+
+_LANE_BITS = 7
+_ROW_SHIFT = _LANE_BITS
+_VALID_SHIFT = 20
+_ROW_MASK = (1 << (_VALID_SHIFT - _ROW_SHIFT)) - 1
 
 
 @dataclasses.dataclass(frozen=True)
 class MonotoneGatherTables:
     """Plan-time tables for one monotone gather direction."""
 
-    row0: np.ndarray      # (G,) int32 — first source row of each tile's span
-    lane_sel: np.ndarray  # (G, 8, 128) int32 in [0, 128)
-    row_sel: np.ndarray   # (G, 8, 128) int32 in [0, K)
-    mask: np.ndarray      # (G, 8, 128) float32 — 0 for invalid slots
-    num_out: int          # valid output slots (<= G * TILE)
+    row0: np.ndarray      # (C,) int32 — first source row of each chunk's DMA
+    out_tile: np.ndarray  # (C,) int32 — output tile the chunk accumulates into
+    first: np.ndarray     # (C,) int32 — 1 on a tile's first chunk
+    packed: np.ndarray    # (C, 8, 128) int32 — lane | row << 7 | valid << 20
+    num_out: int          # valid output slots (<= num_tiles * TILE)
+    num_tiles: int        # G: output tiles
     src_rows: int         # M: padded source array rows
-    span_rows: int        # K
+    span_rows: int        # K: DMA window height
 
 
 def build_monotone_gather_tables(idx: np.ndarray, valid: np.ndarray,
-                                 num_src: int):
+                                 num_src: int, k_rows: int = 0):
     """Build tables for ``out[j] = src[idx[j]] * valid[j]``.
 
     Args:
-      idx: (L,) non-decreasing source indices (any value where invalid).
+      idx: (L,) non-decreasing source indices (any in-range value where
+        invalid, as long as the whole sequence stays non-decreasing).
       valid: (L,) bool.
       num_src: size of the source array.
+      k_rows: force the DMA window height (0 = choose from the span
+        distribution).
     Returns:
-      MonotoneGatherTables, or None if the monotone-span precondition fails
-      (span of some tile exceeds MAX_SPAN_ROWS).
+      MonotoneGatherTables, or None if ``idx`` is empty or not monotone
+      (caller falls back to the XLA gather).
     """
     L = int(idx.shape[0])
     if L == 0:
@@ -82,30 +97,43 @@ def build_monotone_gather_tables(idx: np.ndarray, valid: np.ndarray,
     G = -(-L // TILE)
     pad = G * TILE - L
     idx_p = np.concatenate([idx, np.full(pad, idx[-1], np.int64)])
-    valid_p = np.concatenate([np.asarray(valid, bool),
-                              np.zeros(pad, bool)])
+    valid_p = np.concatenate([np.asarray(valid, bool), np.zeros(pad, bool)])
     tiles = idx_p.reshape(G, TILE)
-    row0 = (tiles[:, 0] // TILE_LANE).astype(np.int32)
-    rel = tiles - row0[:, None].astype(np.int64) * TILE_LANE
-    span = int(rel.max()) // TILE_LANE + 1
-    if span > MAX_SPAN_ROWS:
-        return None
-    lane_sel = (rel % TILE_LANE).astype(np.int32)
-    row_sel = (rel // TILE_LANE).astype(np.int32)
+    rows = tiles // TILE_LANE
+    row0_t = rows[:, 0].astype(np.int64)
+    span_t = rows[:, -1] - row0_t + 1  # rows touched by each tile
+    if k_rows:
+        K = int(k_rows)
+    else:
+        # cost ~ chunks * (K DMA rows + fixed per-step overhead)
+        K = min(K_CANDIDATES,
+                key=lambda k: int((-(-span_t // k)).sum()) * (k + 8))
+    chunks_t = (-(-span_t // K)).astype(np.int64)
+    tile_of = np.repeat(np.arange(G, dtype=np.int64), chunks_t)
+    c_of = np.concatenate([np.arange(n, dtype=np.int64) for n in chunks_t])
+    C = int(chunks_t.sum())
+    rel = rows[tile_of] - row0_t[tile_of, None]          # (C, TILE)
+    in_win = (rel // K) == c_of[:, None]
+    row_in = np.clip(rel - c_of[:, None] * K, 0, K - 1)
+    m = in_win & valid_p.reshape(G, TILE)[tile_of]
+    packed = ((tiles[tile_of] % TILE_LANE)
+              | (row_in << _ROW_SHIFT)
+              | (m.astype(np.int64) << _VALID_SHIFT)).astype(np.int32)
+    row0 = (row0_t[tile_of] + c_of * K).astype(np.int32)
     # Cover the whole source array, not just the last referenced span: the
     # planar source is built by zero-PADDING the (num_src,) array to
     # src_rows * 128, which requires src_rows * 128 >= num_src even when the
     # trailing source region is never referenced.
-    src_rows = max(int(row0.max()) + span, -(-int(num_src) // TILE_LANE))
+    src_rows = max(int(row0.max()) + K, -(-int(num_src) // TILE_LANE))
     return MonotoneGatherTables(
         row0=row0,
-        lane_sel=lane_sel.reshape(G, TILE_SUB, TILE_LANE),
-        row_sel=row_sel.reshape(G, TILE_SUB, TILE_LANE),
-        mask=valid_p.astype(np.float32).reshape(G, TILE_SUB, TILE_LANE),
-        num_out=L, src_rows=src_rows, span_rows=span)
+        out_tile=tile_of.astype(np.int32),
+        first=(c_of == 0).astype(np.int32),
+        packed=packed.reshape(C, TILE_SUB, TILE_LANE),
+        num_out=L, num_tiles=G, src_rows=src_rows, span_rows=K)
 
 
-def _kernel(K: int, row0_ref, lane_ref, rowsel_ref, mask_ref,
+def _kernel(K: int, row0_ref, out_tile_ref, first_ref, packed_ref,
             re_hbm, im_hbm, out_re_ref, out_im_ref, sc, sem):
     g = pl.program_id(0)
     n_g = pl.num_programs(0)
@@ -132,8 +160,10 @@ def _kernel(K: int, row0_ref, lane_ref, rowsel_ref, mask_ref,
     dma(g, slot, 0, re_hbm).wait()
     dma(g, slot, 1, im_hbm).wait()
 
-    lane = lane_ref[0]
-    row = rowsel_ref[0]
+    t = packed_ref[0]
+    lane = t & (TILE_LANE - 1)
+    row = (t >> _ROW_SHIFT) & _ROW_MASK
+    m = (t >> _VALID_SHIFT).astype(jnp.float32)
     acc_re = jnp.zeros((TILE_SUB, TILE_LANE), jnp.float32)
     acc_im = jnp.zeros((TILE_SUB, TILE_LANE), jnp.float32)
     for k in range(K):
@@ -144,51 +174,85 @@ def _kernel(K: int, row0_ref, lane_ref, rowsel_ref, mask_ref,
                                   (TILE_SUB, TILE_LANE))
         acc_re += jnp.where(sel, jnp.take_along_axis(src_re, lane, axis=1), 0)
         acc_im += jnp.where(sel, jnp.take_along_axis(src_im, lane, axis=1), 0)
-    m = mask_ref[0]
-    out_re_ref[0] = acc_re * m
-    out_im_ref[0] = acc_im * m
+    acc_re = acc_re * m
+    acc_im = acc_im * m
+
+    # Chunks of one output tile are consecutive grid steps mapping to the
+    # same out block (revisiting): initialise on the first, accumulate after.
+    @pl.when(first_ref[g] == 1)
+    def _():
+        out_re_ref[0] = acc_re
+        out_im_ref[0] = acc_im
+
+    @pl.when(first_ref[g] == 0)
+    def _():
+        out_re_ref[0] = out_re_ref[0] + acc_re
+        out_im_ref[0] = out_im_ref[0] + acc_im
 
 
 @functools.partial(jax.jit, static_argnames=("span_rows", "src_rows",
-                                             "interpret"))
-def monotone_gather(re, im, row0, lane_sel, row_sel, mask, *,
-                    span_rows: int, src_rows: int, interpret: bool = False):
+                                             "num_tiles", "interpret"))
+def monotone_gather(re, im, row0, out_tile, first, packed, *,
+                    span_rows: int, src_rows: int, num_tiles: int,
+                    interpret: bool = False):
     """Run the monotone gather.
 
     Args:
       re, im: (src_rows, 128) float32 planar source.
-      row0/lane_sel/row_sel/mask: device tables (see
+      row0/out_tile/first/packed: device tables (see
         build_monotone_gather_tables).
     Returns:
-      (out_re, out_im): each (G, 8, 128) float32.
+      (out_re, out_im): each (num_tiles, 8, 128) float32.
     """
-    G = row0.shape[0]
+    C = row0.shape[0]
     K = span_rows
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(G,),
+        num_scalar_prefetch=3,  # row0, out_tile, first
+        grid=(C,),
         in_specs=[
-            pl.BlockSpec((1, TILE_SUB, TILE_LANE), lambda g, r: (g, 0, 0)),
-            pl.BlockSpec((1, TILE_SUB, TILE_LANE), lambda g, r: (g, 0, 0)),
-            pl.BlockSpec((1, TILE_SUB, TILE_LANE), lambda g, r: (g, 0, 0)),
+            pl.BlockSpec((1, TILE_SUB, TILE_LANE),
+                         lambda g, r0, ot, fs: (g, 0, 0)),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=(
-            pl.BlockSpec((1, TILE_SUB, TILE_LANE), lambda g, r: (g, 0, 0)),
-            pl.BlockSpec((1, TILE_SUB, TILE_LANE), lambda g, r: (g, 0, 0)),
+            pl.BlockSpec((1, TILE_SUB, TILE_LANE),
+                         lambda g, r0, ot, fs: (ot[g], 0, 0)),
+            pl.BlockSpec((1, TILE_SUB, TILE_LANE),
+                         lambda g, r0, ot, fs: (ot[g], 0, 0)),
         ),
         scratch_shapes=[
             pltpu.VMEM((2, 2, K, TILE_LANE), jnp.float32),
             pltpu.SemaphoreType.DMA((2, 2)),
         ],
     )
-    out_shape = (jax.ShapeDtypeStruct((G, TILE_SUB, TILE_LANE), jnp.float32),
-                 jax.ShapeDtypeStruct((G, TILE_SUB, TILE_LANE), jnp.float32))
+    out_shape = (
+        jax.ShapeDtypeStruct((num_tiles, TILE_SUB, TILE_LANE), jnp.float32),
+        jax.ShapeDtypeStruct((num_tiles, TILE_SUB, TILE_LANE), jnp.float32))
     return pl.pallas_call(
         functools.partial(_kernel, K), out_shape=out_shape,
         grid_spec=grid_spec, interpret=interpret,
-    )(row0, lane_sel, row_sel, mask, re, im)
+    )(row0, out_tile, first, packed, re, im)
+
+
+def run_monotone_gather(values_il, tables: MonotoneGatherTables,
+                        device_tables=None, interpret: bool = False):
+    """Convenience wrapper: interleaved (N, 2) source -> (num_out, 2) output.
+
+    ``device_tables`` may supply pre-committed jax arrays
+    (row0, out_tile, first, packed) to keep table upload off the hot path.
+    """
+    re, im = planar_from_interleaved(values_il, tables.src_rows)
+    if device_tables is None:
+        device_tables = (jnp.asarray(tables.row0),
+                         jnp.asarray(tables.out_tile),
+                         jnp.asarray(tables.first),
+                         jnp.asarray(tables.packed))
+    out_re, out_im = monotone_gather(
+        re, im, *device_tables, span_rows=tables.span_rows,
+        src_rows=tables.src_rows, num_tiles=tables.num_tiles,
+        interpret=interpret)
+    return interleaved_from_planar(out_re, out_im, tables.num_out)
 
 
 def planar_from_interleaved(values_il, src_rows: int):

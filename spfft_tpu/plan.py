@@ -104,10 +104,9 @@ class TransformPlan:
         occupied = np.zeros(num_slots, bool)
         occupied[vi] = True
         dec_idx = np.maximum(np.cumsum(occupied) - 1, 0)
-        # Decompress (slot <- value) has increments <= 1, so its tile spans
-        # are always bounded; compress (value <- slot) spans grow with slot
-        # gaps (near-empty sticks) and may exceed the VMEM bound — each
-        # direction is enabled independently, the other falls back to XLA.
+        # Decompress gathers slot <- value (increments <= 1); compress
+        # gathers value <- slot (gaps at near-empty sticks become extra
+        # accumulation chunks, see gather_kernel).
         dec = gk.build_monotone_gather_tables(dec_idx, occupied, p.num_values)
         cmp_ = gk.build_monotone_gather_tables(
             vi, np.ones(p.num_values, bool), num_slots)
@@ -120,9 +119,9 @@ class TransformPlan:
             if t is None:
                 continue
             self._tables[name + "_row0"] = jnp.asarray(t.row0)
-            self._tables[name + "_lane"] = jnp.asarray(t.lane_sel)
-            self._tables[name + "_rowsel"] = jnp.asarray(t.row_sel)
-            self._tables[name + "_mask"] = jnp.asarray(t.mask)
+            self._tables[name + "_out_tile"] = jnp.asarray(t.out_tile)
+            self._tables[name + "_first"] = jnp.asarray(t.first)
+            self._tables[name + "_packed"] = jnp.asarray(t.packed)
 
     # -- reference Transform getters (transform.hpp:91-151) -----------------
     @property
@@ -182,9 +181,10 @@ class TransformPlan:
         re, im = gk.planar_from_interleaved(values_il.astype(np.float32),
                                             t.src_rows)
         out_re, out_im = gk.monotone_gather(
-            re, im, tables["dec_row0"], tables["dec_lane"],
-            tables["dec_rowsel"], tables["dec_mask"],
-            span_rows=t.span_rows, src_rows=t.src_rows)
+            re, im, tables["dec_row0"], tables["dec_out_tile"],
+            tables["dec_first"], tables["dec_packed"],
+            span_rows=t.span_rows, src_rows=t.src_rows,
+            num_tiles=t.num_tiles)
         flat = (out_re.reshape(-1)[:t.num_out]
                 + 1j * out_im.reshape(-1)[:t.num_out])
         return flat.reshape(p.num_sticks, p.dim_z)
@@ -199,9 +199,10 @@ class TransformPlan:
                              jnp.imag(sticks).reshape(-1)], axis=-1)
         re, im = gk.planar_from_interleaved(flat_il, t.src_rows)
         out_re, out_im = gk.monotone_gather(
-            re, im, tables["cmp_row0"], tables["cmp_lane"],
-            tables["cmp_rowsel"], tables["cmp_mask"],
-            span_rows=t.span_rows, src_rows=t.src_rows)
+            re, im, tables["cmp_row0"], tables["cmp_out_tile"],
+            tables["cmp_first"], tables["cmp_packed"],
+            span_rows=t.span_rows, src_rows=t.src_rows,
+            num_tiles=t.num_tiles)
         values = gk.interleaved_from_planar(out_re, out_im, t.num_out)
         if scale is not None:
             values = values * jnp.asarray(scale, values.dtype)

@@ -13,6 +13,10 @@ CPU-only — another reason tests pin JAX_PLATFORMS=cpu.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Python subprocesses spawned by tests (the C API example embeds an
+# interpreter) must not register the axon TPU plugin: they are CPU-intent,
+# and a wedged device tunnel would hang their interpreter start.
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

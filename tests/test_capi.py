@@ -44,7 +44,7 @@ def test_c_example_round_trip(capi_lib):
          "-Wl,-rpath," + os.path.join(REPO, "lib")],
         check=True, capture_output=True, text=True)
     env = dict(os.environ, SPFFT_TPU_PACKAGE_PATH=REPO,
-               JAX_PLATFORMS="cpu")
+               JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
     out = subprocess.run([exe], env=env, capture_output=True, text=True,
                          timeout=600)
     assert out.returncode == 0, out.stderr

@@ -1,5 +1,5 @@
 """Unit tests for the Pallas monotone-gather kernel (interpret mode on CPU)
-and its plan-time table builder."""
+and its plan-time chunked table builder."""
 
 import numpy as np
 import pytest
@@ -9,16 +9,13 @@ import jax.numpy as jnp
 from spfft_tpu.ops import gather_kernel as gk
 
 
-def run_gather(src: np.ndarray, idx: np.ndarray, valid: np.ndarray):
-    t = gk.build_monotone_gather_tables(idx, valid, len(src))
+def run_gather(src: np.ndarray, idx: np.ndarray, valid: np.ndarray,
+               k_rows: int = 0):
+    t = gk.build_monotone_gather_tables(idx, valid, len(src), k_rows=k_rows)
     assert t is not None
-    re, im = gk.planar_from_interleaved(jnp.asarray(src, jnp.float32),
-                                        t.src_rows)
-    out_re, out_im = gk.monotone_gather(
-        re, im, jnp.asarray(t.row0), jnp.asarray(t.lane_sel),
-        jnp.asarray(t.row_sel), jnp.asarray(t.mask),
-        span_rows=t.span_rows, src_rows=t.src_rows, interpret=True)
-    return np.asarray(gk.interleaved_from_planar(out_re, out_im, t.num_out))
+    out = gk.run_monotone_gather(jnp.asarray(src, jnp.float32), t,
+                                 interpret=True)
+    return np.asarray(out), t
 
 
 def test_expansion_pattern():
@@ -29,7 +26,7 @@ def test_expansion_pattern():
     n_src = int(mask.sum())
     src = rng.random((n_src, 2)).astype(np.float32)
     idx = np.maximum(np.cumsum(mask) - 1, 0)
-    out = run_gather(src, idx, mask)
+    out, _ = run_gather(src, idx, mask)
     ref = np.zeros((L, 2), np.float32)
     ref[mask] = src
     np.testing.assert_array_equal(out, ref)
@@ -41,7 +38,7 @@ def test_compaction_pattern():
     M = 5000
     idx = np.sort(rng.choice(M, 2500, replace=False)).astype(np.int64)
     src = rng.random((M, 2)).astype(np.float32)
-    out = run_gather(src, idx, np.ones(len(idx), bool))
+    out, _ = run_gather(src, idx, np.ones(len(idx), bool))
     np.testing.assert_array_equal(out, src[idx])
 
 
@@ -50,17 +47,33 @@ def test_single_tile_and_exact_tile():
     for L in (100, gk.TILE):
         idx = np.arange(L)
         src = rng.random((L, 2)).astype(np.float32)
-        out = run_gather(src, idx, np.ones(L, bool))
+        out, _ = run_gather(src, idx, np.ones(L, bool))
         np.testing.assert_array_equal(out, src)
 
 
-def test_span_bound_rejected():
-    """A tile whose source span exceeds MAX_SPAN_ROWS returns None (caller
-    falls back to the XLA gather)."""
+def test_large_span_chunks():
+    """A tile whose source span exceeds one K-row window splits into several
+    accumulation chunks instead of falling back (the spherical-cutoff edge
+    case: near-empty sticks with ~256-slot gaps)."""
+    rng = np.random.default_rng(3)
     idx = np.arange(gk.TILE) * 2 * gk.TILE_LANE  # gaps of 256 elements
-    t = gk.build_monotone_gather_tables(idx, np.ones(len(idx), bool),
-                                        int(idx[-1]) + 1)
-    assert t is None
+    n_src = int(idx[-1]) + 1
+    src = rng.random((n_src, 2)).astype(np.float32)
+    out, t = run_gather(src, idx, np.ones(len(idx), bool), k_rows=8)
+    assert len(t.row0) > t.num_tiles  # really multi-chunk
+    np.testing.assert_array_equal(out, src[idx])
+
+
+def test_chunking_across_k_choices():
+    """The result is invariant to the chosen window height."""
+    rng = np.random.default_rng(4)
+    M = 40000
+    idx = np.sort(rng.choice(M, 3000, replace=False)).astype(np.int64)
+    src = rng.random((M, 2)).astype(np.float32)
+    ref = src[idx]
+    for k in (8, 32, 128):
+        out, _ = run_gather(src, idx, np.ones(len(idx), bool), k_rows=k)
+        np.testing.assert_array_equal(out, ref)
 
 
 def test_non_monotone_rejected():
@@ -69,8 +82,8 @@ def test_non_monotone_rejected():
 
 
 def test_plan_pallas_path_interpret():
-    """The plan's Pallas path (forced on, interpret via CPU backend check is
-    bypassed by use_pallas=True) matches the XLA path."""
+    """The plan's Pallas decompress tables reproduce the XLA scatter result
+    when run through the kernel in interpret mode."""
     from spfft_tpu import TransformType, make_local_plan
     rng = np.random.default_rng(3)
     n = 16
@@ -83,28 +96,44 @@ def test_plan_pallas_path_interpret():
     triplets = np.asarray(triplets, np.int32)
     vals = (rng.uniform(-1, 1, len(triplets))
             + 1j * rng.uniform(-1, 1, len(triplets))).astype(np.complex64)
-    ref_plan = make_local_plan(TransformType.C2C, n, n, n, triplets,
-                               precision="single", use_pallas=False)
-    ref = np.asarray(ref_plan.backward(vals))
-    # CPU backend: pallas only via interpret mode — exercise kernel directly
-    # through the plan tables
     pl_plan = make_local_plan(TransformType.C2C, n, n, n, triplets,
                               precision="single", use_pallas=True)
     if pl_plan._pallas is None:
         pytest.skip("pallas tables unavailable for this index set")
     t = pl_plan._pallas["dec"]
     src_il = np.stack([vals.real, vals.imag], axis=-1).astype(np.float32)
-    re, im = gk.planar_from_interleaved(jnp.asarray(src_il), t.src_rows)
-    out_re, out_im = gk.monotone_gather(
-        re, im, jnp.asarray(t.row0), jnp.asarray(t.lane_sel),
-        jnp.asarray(t.row_sel), jnp.asarray(t.mask),
-        span_rows=t.span_rows, src_rows=t.src_rows, interpret=True)
-    sticks = np.asarray(gk.interleaved_from_planar(out_re, out_im, t.num_out))
+    sticks = np.asarray(gk.run_monotone_gather(jnp.asarray(src_il), t,
+                                               interpret=True))
     ip = pl_plan.index_plan
     expect = np.zeros((ip.num_sticks * n, 2), np.float32)
     expect[ip.value_indices] = src_il
     np.testing.assert_array_equal(sticks, expect)
-    del ref  # oracle comparison covered by test_local_transform on all paths
+
+
+def test_plan_compress_tables_interpret():
+    """The compress-direction tables invert decompress: gathering occupied
+    slots returns the original values."""
+    from spfft_tpu import TransformType, make_local_plan
+    rng = np.random.default_rng(7)
+    n = 16
+    # gappy sticks: only a couple of z values per stick — the edge-stick
+    # pattern that used to overflow the fixed span bound
+    triplets = []
+    for x in range(n):
+        for y in range(0, n, 2):
+            for z in (0, 1, n - 1):
+                triplets.append((x, y, z))
+    triplets = np.asarray(triplets, np.int32)
+    pl_plan = make_local_plan(TransformType.C2C, n, n, n, triplets,
+                              precision="single", use_pallas=True)
+    assert pl_plan._pallas is not None and pl_plan._pallas["cmp"] is not None
+    ip = pl_plan.index_plan
+    vals_il = rng.random((ip.num_values, 2)).astype(np.float32)
+    slots = np.zeros((ip.num_sticks * n, 2), np.float32)
+    slots[ip.value_indices] = vals_il
+    out = np.asarray(gk.run_monotone_gather(
+        jnp.asarray(slots), pl_plan._pallas["cmp"], interpret=True))
+    np.testing.assert_array_equal(out, vals_il)
 
 
 def test_src_rows_covers_whole_source():
@@ -117,12 +146,8 @@ def test_src_rows_covers_whole_source():
     assert t is not None
     assert t.src_rows * gk.TILE_LANE >= 2048
     src = np.random.default_rng(0).random((2048, 2)).astype(np.float32)
-    re, im = gk.planar_from_interleaved(jnp.asarray(src), t.src_rows)
-    out_re, out_im = gk.monotone_gather(
-        re, im, jnp.asarray(t.row0), jnp.asarray(t.lane_sel),
-        jnp.asarray(t.row_sel), jnp.asarray(t.mask),
-        span_rows=t.span_rows, src_rows=t.src_rows, interpret=True)
-    out = np.asarray(gk.interleaved_from_planar(out_re, out_im, t.num_out))
+    out = np.asarray(gk.run_monotone_gather(jnp.asarray(src), t,
+                                            interpret=True))
     np.testing.assert_array_equal(out, src[idx])
 
 
