@@ -96,7 +96,7 @@ def main(argv=None) -> int:
 
     if args.cpu:
         from .utils.platform import force_virtual_cpu_devices
-        force_virtual_cpu_devices(max(args.shards, 1), trust_env=False)
+        force_virtual_cpu_devices(max(args.shards, 1))
 
     import jax
     from . import timing

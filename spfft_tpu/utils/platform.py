@@ -1,35 +1,25 @@
 """Host-platform helpers for test/dry-run environments.
 
-This container's sitecustomize pre-configures the JAX TPU plugin and may
-clobber JAX_PLATFORMS/XLA_FLAGS, so forcing a virtual multi-device CPU
-platform must go through the live config — and must happen before the backend
-initialises. Shared by the driver entry point, examples and the test
+This container's sitecustomize pre-configures the JAX TPU plugin, which
+ignores JAX_PLATFORMS/XLA_FLAGS env vars — forcing a virtual multi-device CPU
+platform must go through the live config, before the backend initialises.
+Shared by the driver entry point, examples, benchmark CLI and the test
 conftest so the workaround lives in one place.
 """
 
 from __future__ import annotations
 
-import os
-
 import jax
 
 
-def env_provides_devices() -> bool:
-    """True if the environment already configures a multi-device platform
-    (the driver sets JAX_PLATFORMS=cpu plus
-    --xla_force_host_platform_device_count)."""
-    return (os.environ.get("JAX_PLATFORMS") == "cpu"
-            or "xla_force_host_platform_device_count"
-            in os.environ.get("XLA_FLAGS", ""))
+def force_virtual_cpu_devices(n: int) -> None:
+    """Force an ``n``-device virtual CPU platform through the live config.
 
-
-def force_virtual_cpu_devices(n: int, trust_env: bool = True) -> None:
-    """Force an ``n``-device virtual CPU platform through the live config,
-    unless the environment already provides one (and ``trust_env``). A no-op
-    if the backend is already initialised (config updates then raise and are
+    Env vars alone (JAX_PLATFORMS=cpu + --xla_force_host_platform_device_count)
+    are NOT sufficient in this container: the pre-registered TPU plugin ignores
+    them, so the platform is always forced through the live config. A no-op if
+    the backend is already initialised (config updates then raise and are
     swallowed — callers check ``len(jax.devices())`` afterwards)."""
-    if trust_env and env_provides_devices():
-        return
     try:
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_num_cpu_devices", max(n, 1))

@@ -27,8 +27,7 @@ import jax  # noqa: E402
 from spfft_tpu.utils.platform import force_virtual_cpu_devices  # noqa: E402
 
 # The container's sitecustomize imports jax (axon TPU plugin) before this
-# conftest runs, so the env vars above may be read too late — force the
-# platform through the live config as well (trust_env=False: tests always
-# run on the virtual CPU mesh).
-force_virtual_cpu_devices(8, trust_env=False)
+# conftest runs and ignores the env vars above — force the platform through
+# the live config as well (tests always run on the virtual CPU mesh).
+force_virtual_cpu_devices(8)
 jax.config.update("jax_enable_x64", True)
