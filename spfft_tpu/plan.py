@@ -65,6 +65,7 @@ class TransformPlan:
             "scatter_cols": jnp.asarray(index_plan.scatter_cols),
         }
         self._init_pallas(use_pallas)
+        self._batched = None
         self._backward_jit = jax.jit(self._backward_impl)
         self._forward_jit = {
             Scaling.NONE: jax.jit(functools.partial(self._forward_impl,
@@ -170,9 +171,10 @@ class TransformPlan:
     def _is_r2c(self) -> bool:
         return self.index_plan.hermitian
 
-    def _decompress(self, values_il, tables):
+    def _decompress(self, values_il, tables, pallas=True):
         p = self.index_plan
-        if not self._pallas_active or self._pallas["dec"] is None:
+        if not pallas or not self._pallas_active \
+                or self._pallas["dec"] is None:
             return stages.decompress(values_il.astype(self._rdt),
                                      tables["slot_src"], p.num_sticks,
                                      p.dim_z)
@@ -189,9 +191,10 @@ class TransformPlan:
                 + 1j * out_im.reshape(-1)[:t.num_out])
         return flat.reshape(p.num_sticks, p.dim_z)
 
-    def _compress(self, sticks, tables, scale):
+    def _compress(self, sticks, tables, scale, pallas=True):
         p = self.index_plan
-        if not self._pallas_active or self._pallas["cmp"] is None:
+        if not pallas or not self._pallas_active \
+                or self._pallas["cmp"] is None:
             return stages.compress(sticks, tables["value_indices"], scale)
         from .ops import gather_kernel as gk
         t = self._pallas["cmp"]
@@ -208,9 +211,9 @@ class TransformPlan:
             values = values * jnp.asarray(scale, values.dtype)
         return values
 
-    def _backward_impl(self, values_il, tables):
+    def _backward_impl(self, values_il, tables, *, pallas=True):
         p = self.index_plan
-        sticks = self._decompress(values_il, tables)
+        sticks = self._decompress(values_il, tables, pallas)
         if self._is_r2c and p.zero_stick_id is not None:
             zid = p.zero_stick_id
             sticks = sticks.at[zid].set(
@@ -223,7 +226,7 @@ class TransformPlan:
             return stages.xy_backward_r2c(grid, p.dim_x)
         return complex_to_interleaved(stages.xy_backward_c2c(grid))
 
-    def _forward_impl(self, space, tables, *, scaled: bool):
+    def _forward_impl(self, space, tables, *, scaled: bool, pallas=True):
         p = self.index_plan
         if self._is_r2c:
             grid = stages.xy_forward_r2c(space.astype(self._rdt))
@@ -233,7 +236,56 @@ class TransformPlan:
         sticks = stages.grid_to_sticks(grid, tables["scatter_cols"])
         sticks = stages.z_forward(sticks)
         scale = 1.0 / self.global_size if scaled else None
-        return self._compress(sticks, tables, scale)
+        return self._compress(sticks, tables, scale, pallas)
+
+    # -- batched execution ---------------------------------------------------
+    def _batched_jits(self):
+        """Lazily-built vmapped executables over a leading batch axis.
+
+        The reference's multi-transform hand-interleaves the phases of N
+        transforms for comm/compute overlap (reference:
+        multi_transform_internal.hpp:47-145). For N transforms sharing one
+        plan, the TPU-native form is a single executable with a batch
+        dimension: XLA sees N× larger FFT batches and one gather per stage
+        instead of N dispatches."""
+        if self._batched is None:
+            self._batched = {
+                "backward": jax.jit(jax.vmap(
+                    functools.partial(self._backward_impl, pallas=False),
+                    in_axes=(0, None))),
+                Scaling.NONE: jax.jit(jax.vmap(
+                    functools.partial(self._forward_impl, scaled=False,
+                                      pallas=False),
+                    in_axes=(0, None))),
+                Scaling.FULL: jax.jit(jax.vmap(
+                    functools.partial(self._forward_impl, scaled=True,
+                                      pallas=False),
+                    in_axes=(0, None))),
+            }
+        return self._batched
+
+    def backward_batched(self, values_batch):
+        """Backward-execute a batch: ``values_batch`` is (B, num_values)
+        complex or (B, num_values, 2) interleaved. Returns the (B, ...)
+        stacked space-domain result in one fused execution."""
+        batch = jnp.stack([self._coerce_values(v) for v in values_batch]) \
+            if not (isinstance(values_batch, jax.Array)
+                    and values_batch.ndim == 3) else values_batch
+        with timed_transform("backward_batched") as box:
+            box.value = self._batched_jits()["backward"](batch, self._tables)
+        return box.value
+
+    def forward_batched(self, space_batch, scaling: Scaling = Scaling.NONE):
+        """Forward-execute a batch of space-domain slabs in one fused
+        execution. Returns (B, num_values, 2) interleaved values."""
+        scaling = Scaling(scaling)
+        batch = jnp.stack([self._coerce_space(s) for s in space_batch]) \
+            if not (isinstance(space_batch, jax.Array)
+                    and space_batch.ndim
+                    == (4 if self._is_r2c else 5)) else space_batch
+        with timed_transform("forward_batched") as box:
+            box.value = self._batched_jits()[scaling](batch, self._tables)
+        return box.value
 
     # -- public execution (reference: transform.hpp:198-211) -----------------
     def backward(self, values):
