@@ -83,28 +83,36 @@ assert err < 1e-4, f"128^3 roundtrip err {err}"
 print(f"4. 128^3 probe: OK — plan {plan_s:.2f}s, pair {per*1e3:.1f} ms/iter, "
       f"pallas={plan._pallas_active}, err={err:.2e}")
 
-# 5. batched (vmapped) multi-transform path: fused path for shared-plan
-# handles must match the per-transform path.
+# 5. batched (vmapped) execution: drive the fused executable DIRECTLY
+# (multi_transform_* may legitimately route shared-plan batches to the
+# per-transform path when the Pallas kernel is active, so calling it would
+# not cover the vmap lowering on TPU), then the multi_transform wrapper.
 from spfft_tpu.grid import Transform
 from spfft_tpu import multi_transform_backward, multi_transform_forward
 
 vals_b = [(rng.uniform(-1, 1, len(trip))
            + 1j * rng.uniform(-1, 1, len(trip))).astype(np.complex64)
           for _ in range(3)]
-base = Transform(plan)
-clones = [base.clone() for _ in range(3)]
 t0 = time.perf_counter()
-outs = multi_transform_backward(clones, vals_b)
-jax.block_until_ready(outs)
+stacked = plan.backward_batched(vals_b)
+jax.block_until_ready(stacked)
 per_b = (time.perf_counter() - t0) / 3
-ref0 = np.asarray(plan.backward(vals_b[1]))
-err = np.abs(np.asarray(outs[1]) - ref0).max()
+ref1 = np.asarray(plan.backward(vals_b[1]))
+err = np.abs(np.asarray(stacked[1]) - ref1).max()
 assert err < 1e-4, f"batched backward mismatch {err}"
-fouts = multi_transform_forward(clones, [np.asarray(o) for o in outs],
-                                [sp.Scaling.FULL] * 3)
-gotf = as_complex_np(np.asarray(fouts[2]))
+fw = plan.forward_batched(list(np.asarray(stacked)), sp.Scaling.FULL)
+gotf = as_complex_np(np.asarray(fw[2]))
 err = np.abs(gotf - vals_b[2]).max()
 assert err < 1e-4, f"batched roundtrip mismatch {err}"
-print(f"5. batched multi-transform (B=3, incl. compile "
-      f"{per_b*1e3:.1f} ms/transform): OK")
+base = Transform(plan)
+clones = [base.clone() for _ in range(3)]
+outs = multi_transform_backward(clones, vals_b)
+err = np.abs(np.asarray(outs[1]) - ref1).max()
+assert err < 1e-4, f"multi_transform backward mismatch {err}"
+fouts = multi_transform_forward(clones, [np.asarray(o) for o in outs],
+                                [sp.Scaling.FULL] * 3)
+err = np.abs(as_complex_np(np.asarray(fouts[2])) - vals_b[2]).max()
+assert err < 1e-4, f"multi_transform roundtrip mismatch {err}"
+print(f"5. batched vmapped executable (B=3, incl. compile "
+      f"{per_b*1e3:.1f} ms/transform) + multi_transform wrapper: OK")
 print("VERIFY DRIVE: ALL OK")

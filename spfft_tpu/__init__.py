@@ -16,8 +16,10 @@ from .errors import (AllocationError, DeviceAllocationError, DeviceError,
                      ParameterMismatchError)
 from .indexing import IndexPlan, build_index_plan, check_stick_duplicates
 from .parallel import (DistributedIndexPlan, DistributedTransformPlan,
-                       build_distributed_plan, make_distributed_plan,
-                       make_mesh)
+                       build_distributed_plan,
+                       build_distributed_plan_multihost,
+                       initialize_multihost, make_distributed_plan,
+                       make_mesh, plan_fingerprint, validate_consistent)
 from . import timing
 from .grid import Grid, Transform
 from .multi import multi_transform_backward, multi_transform_forward
@@ -39,7 +41,9 @@ __all__ = [
     "IndexPlan", "build_index_plan", "check_stick_duplicates",
     "TransformPlan", "make_local_plan",
     "DistributedIndexPlan", "DistributedTransformPlan",
-    "build_distributed_plan", "make_distributed_plan", "make_mesh",
+    "build_distributed_plan", "build_distributed_plan_multihost",
+    "initialize_multihost", "make_distributed_plan", "make_mesh",
+    "plan_fingerprint", "validate_consistent",
     "Grid", "Transform",
     "multi_transform_backward", "multi_transform_forward",
 ]

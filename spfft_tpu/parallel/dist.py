@@ -450,8 +450,14 @@ def make_distributed_plan(transform_type: TransformType,
                           exchange: ExchangeType = ExchangeType.DEFAULT,
                           ) -> DistributedTransformPlan:
     """Plan a distributed transform in one call (the distributed analogue of
-    ``Grid::create_transform``, reference grid.hpp:138-141)."""
+    ``Grid::create_transform``, reference grid.hpp:138-141). Under
+    ``jax.distributed`` (multi-process), cross-checks that every process
+    built the identical plan, like the reference's plan-time allreduce
+    mismatch detection (grid_internal.cpp:148-167)."""
     dist = build_distributed_plan(TransformType(transform_type), dim_x, dim_y,
                                   dim_z, triplets_per_shard, planes_per_shard)
+    if jax.process_count() > 1:  # pragma: no cover - multi-host only
+        from .multihost import validate_consistent
+        validate_consistent(dist)
     return DistributedTransformPlan(dist, mesh=mesh, precision=precision,
                                     exchange=exchange)
