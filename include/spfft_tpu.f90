@@ -68,6 +68,23 @@ module spfft_tpu
       integer(c_int), value :: precision
     end function
 
+    integer(c_int) function spfft_tpu_plan_create_distributed(plan, &
+        transform_type, dim_x, dim_y, dim_z, num_shards, values_per_shard, &
+        index_triplets, planes_per_shard, precision) &
+        bind(C, name="spfft_tpu_plan_create_distributed")
+      use iso_c_binding
+      type(c_ptr), intent(out) :: plan
+      integer(c_int), value :: transform_type
+      integer(c_int), value :: dim_x
+      integer(c_int), value :: dim_y
+      integer(c_int), value :: dim_z
+      integer(c_int), value :: num_shards
+      integer(c_long_long), dimension(*), intent(in) :: values_per_shard
+      integer(c_int), dimension(*), intent(in) :: index_triplets
+      integer(c_int), dimension(*), intent(in) :: planes_per_shard
+      integer(c_int), value :: precision
+    end function
+
     integer(c_int) function spfft_tpu_plan_destroy(plan) &
         bind(C, name="spfft_tpu_plan_destroy")
       use iso_c_binding
@@ -121,6 +138,13 @@ module spfft_tpu
 
     integer(c_int) function spfft_tpu_plan_transform_type(plan, out) &
         bind(C, name="spfft_tpu_plan_transform_type")
+      use iso_c_binding
+      type(c_ptr), value :: plan
+      integer(c_int), intent(out) :: out
+    end function
+
+    integer(c_int) function spfft_tpu_plan_num_shards(plan, out) &
+        bind(C, name="spfft_tpu_plan_num_shards")
       use iso_c_binding
       type(c_ptr), value :: plan
       integer(c_int), intent(out) :: out

@@ -93,6 +93,30 @@ int spfft_tpu_plan_create(SpfftTpuPlan* plan, int transform_type, int dim_x,
                           int dim_y, int dim_z, long long num_values,
                           const int* index_triplets, int precision);
 
+/*
+ * Distributed plan over num_shards devices of this process (reference:
+ * spfft_grid_create_distributed + spfft_transform_create, grid.h — the MPI
+ * communicator is replaced by the local device mesh; one process drives
+ * all shards SPMD-style).
+ *
+ * values_per_shard: num_shards counts; index_triplets: the per-shard
+ * triplet lists concatenated in shard order (sum(values_per_shard) x 3
+ * ints); planes_per_shard: slab heights, summing to dim_z. A z-stick must
+ * live wholly on one shard.
+ *
+ * I/O convention for backward/forward on a distributed plan: values are
+ * the per-shard value arrays concatenated in shard order (interleaved
+ * reals); space is the FULL (dim_z, dim_y, dim_x) cube in global z order
+ * (slabs concatenated), interleaved complex for C2C / real for R2C.
+ */
+int spfft_tpu_plan_create_distributed(SpfftTpuPlan* plan, int transform_type,
+                                      int dim_x, int dim_y, int dim_z,
+                                      int num_shards,
+                                      const long long* values_per_shard,
+                                      const int* index_triplets,
+                                      const int* planes_per_shard,
+                                      int precision);
+
 int spfft_tpu_plan_destroy(SpfftTpuPlan plan);
 
 /*
@@ -116,6 +140,8 @@ int spfft_tpu_plan_dim_y(SpfftTpuPlan plan, int* out);
 int spfft_tpu_plan_dim_z(SpfftTpuPlan plan, int* out);
 int spfft_tpu_plan_num_values(SpfftTpuPlan plan, long long* out);
 int spfft_tpu_plan_transform_type(SpfftTpuPlan plan, int* out);
+/* 1 for local plans, the mesh size for distributed plans. */
+int spfft_tpu_plan_num_shards(SpfftTpuPlan plan, int* out);
 
 /* Static message for an error code (never NULL). */
 const char* spfft_tpu_error_string(int code);

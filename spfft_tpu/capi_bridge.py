@@ -23,8 +23,9 @@ import numpy as np
 from .errors import ErrorCode, GenericError, InvalidParameterError
 from .plan import TransformPlan, make_local_plan
 from .types import Scaling, TransformType
+from .utils.dtypes import as_interleaved
 
-_plans: Dict[int, TransformPlan] = {}
+_plans: Dict[int, object] = {}
 _next_id = itertools.count(1)
 
 _INVALID_HANDLE = 2  # SPFFT_TPU_INVALID_HANDLE_ERROR
@@ -95,14 +96,101 @@ def plan_create(transform_type: int, dim_x: int, dim_y: int, dim_z: int,
 
 
 @_guarded
+def plan_create_distributed(transform_type: int, dim_x: int, dim_y: int,
+                            dim_z: int, num_shards: int, vps_addr: int,
+                            triplets_addr: int, pps_addr: int,
+                            precision: int) -> int:
+    """Distributed plan over num_shards local devices (reference:
+    spfft_grid_create_distributed, grid.h — communicator -> device mesh)."""
+    from .parallel import make_distributed_plan, make_mesh
+
+    if transform_type not in (0, 1):
+        raise InvalidParameterError(f"bad transform type {transform_type}")
+    if precision not in (0, 1):
+        raise InvalidParameterError(f"bad precision {precision}")
+    vps = np.array(np.ctypeslib.as_array(
+        ctypes.cast(vps_addr, ctypes.POINTER(ctypes.c_longlong)),
+        shape=(num_shards,)), np.int64, copy=True)
+    pps = np.array(np.ctypeslib.as_array(
+        ctypes.cast(pps_addr, ctypes.POINTER(ctypes.c_int32)),
+        shape=(num_shards,)), np.int64, copy=True)
+    if (vps < 0).any():
+        raise InvalidParameterError("negative per-shard value count")
+    total = int(vps.sum())
+    if total == 0:
+        trip = np.empty((0, 3), np.int32)
+    else:
+        ptr = ctypes.cast(triplets_addr, ctypes.POINTER(ctypes.c_int32))
+        trip = np.array(np.ctypeslib.as_array(ptr, shape=(total, 3)),
+                        np.int32, copy=True)
+    offsets = np.concatenate([[0], np.cumsum(vps)]).astype(int)
+    per_shard = [trip[offsets[r]:offsets[r + 1]] for r in range(num_shards)]
+    plan = make_distributed_plan(
+        TransformType.C2C if transform_type == 0 else TransformType.R2C,
+        dim_x, dim_y, dim_z, per_shard, [int(p) for p in pps],
+        mesh=make_mesh(num_shards),
+        precision="single" if precision == 0 else "double")
+    pid = next(_next_id)
+    _plans[pid] = plan
+    return pid
+
+
+@_guarded
 def plan_destroy(pid: int) -> None:
     if _plans.pop(pid, None) is None:
         raise _InvalidHandle()
 
 
+def _is_distributed(plan) -> bool:
+    return not isinstance(plan, TransformPlan)
+
+
+def _dist_backward(plan, values_addr: int, space_addr: int) -> None:
+    """Concatenated per-shard values -> full cube in global z order."""
+    dp = plan.dist_plan
+    total = dp.num_global_elements
+    flat = _view(values_addr, 2 * total, plan.precision).reshape(total, 2)
+    per, off = [], 0
+    for sp in dp.shard_plans:
+        per.append(flat[off:off + sp.num_values])
+        off += sp.num_values
+    slabs = plan.unshard_space(plan.backward(per))
+    cube = np.concatenate([as_interleaved(s, plan.precision) if
+                           not dp.hermitian else np.asarray(s)
+                           for s in slabs], axis=0)
+    width = 1 if dp.hermitian else 2
+    n_space = dp.dim_z * dp.dim_y * dp.dim_x * width
+    _view(space_addr, n_space, plan.precision)[:] = cube.reshape(-1)
+
+
+def _dist_forward(plan, space_addr: int, scaling: int,
+                  values_addr: int) -> None:
+    """Full cube in global z order -> concatenated per-shard values."""
+    dp = plan.dist_plan
+    width = 1 if dp.hermitian else 2
+    n_space = dp.dim_z * dp.dim_y * dp.dim_x * width
+    shape = (dp.dim_z, dp.dim_y, dp.dim_x) + \
+        (() if dp.hermitian else (2,))
+    cube = _view(space_addr, n_space, plan.precision).reshape(shape)
+    slabs, off = [], 0
+    for n in dp.num_planes:
+        slabs.append(cube[off:off + n])
+        off += n
+    if scaling not in (0, 1):
+        raise InvalidParameterError(f"bad scaling {scaling}")
+    vals = plan.unshard_values(plan.forward(
+        slabs, Scaling.FULL if scaling == 1 else Scaling.NONE))
+    out = np.concatenate([as_interleaved(v, plan.precision) for v in vals],
+                         axis=0)
+    total = dp.num_global_elements
+    _view(values_addr, 2 * total, plan.precision)[:] = out.reshape(-1)
+
+
 @_guarded
 def backward(pid: int, values_addr: int, space_addr: int) -> None:
     plan = _get_plan(pid)
+    if _is_distributed(plan):
+        return _dist_backward(plan, values_addr, space_addr)
     p = plan.index_plan
     values = _view(values_addr, 2 * p.num_values,
                    plan.precision).reshape(p.num_values, 2)
@@ -115,6 +203,8 @@ def backward(pid: int, values_addr: int, space_addr: int) -> None:
 def forward(pid: int, space_addr: int, scaling: int,
             values_addr: int) -> None:
     plan = _get_plan(pid)
+    if _is_distributed(plan):
+        return _dist_forward(plan, space_addr, scaling, values_addr)
     p = plan.index_plan
     n_space = p.dim_z * p.dim_y * p.dim_x * (1 if p.hermitian else 2)
     space = _view(space_addr, n_space, plan.precision)
@@ -131,6 +221,13 @@ def forward(pid: int, space_addr: int, scaling: int,
 @_guarded
 def plan_info(pid: int, what: int) -> int:
     plan = _get_plan(pid)
+    if _is_distributed(plan):
+        dp = plan.dist_plan
+        return {0: dp.dim_x, 1: dp.dim_y, 2: dp.dim_z,
+                3: sum(sp.num_values for sp in dp.shard_plans),
+                4: 0 if dp.transform_type == TransformType.C2C else 1,
+                5: dp.num_shards}[what]
     p = plan.index_plan
     return {0: p.dim_x, 1: p.dim_y, 2: p.dim_z, 3: p.num_values,
-            4: 0 if p.transform_type == TransformType.C2C else 1}[what]
+            4: 0 if p.transform_type == TransformType.C2C else 1,
+            5: 1}[what]

@@ -161,6 +161,33 @@ int spfft_tpu_plan_create(void** plan, int transform_type, int dim_x,
   return code;
 }
 
+int spfft_tpu_plan_create_distributed(void** plan, int transform_type,
+                                      int dim_x, int dim_y, int dim_z,
+                                      int num_shards,
+                                      const long long* values_per_shard,
+                                      const int* index_triplets,
+                                      const int* planes_per_shard,
+                                      int precision) {
+  if (plan == nullptr || values_per_shard == nullptr ||
+      planes_per_shard == nullptr || num_shards < 1) {
+    return kInvalidParameter;
+  }
+  long long total = 0;
+  for (int r = 0; r < num_shards; ++r) total += values_per_shard[r];
+  if (index_triplets == nullptr && total > 0) return kInvalidParameter;
+  long long pid = 0;
+  int code = call_bridge(
+      "plan_create_distributed",
+      {transform_type, dim_x, dim_y, dim_z, num_shards,
+       static_cast<long long>(reinterpret_cast<intptr_t>(values_per_shard)),
+       static_cast<long long>(reinterpret_cast<intptr_t>(index_triplets)),
+       static_cast<long long>(reinterpret_cast<intptr_t>(planes_per_shard)),
+       precision},
+      &pid);
+  if (code == kSuccess) *plan = id_to_handle(pid);
+  return code;
+}
+
 int spfft_tpu_plan_destroy(void* plan) {
   return call_bridge("plan_destroy", {handle_to_id(plan)}, nullptr);
 }
@@ -192,6 +219,7 @@ static int plan_info(void* plan, int what, long long* out) {
 }
 
 int spfft_tpu_plan_dim_x(void* plan, int* out) {
+  if (out == nullptr) return kInvalidParameter;
   long long v = 0;
   int code = plan_info(plan, 0, &v);
   if (code == kSuccess) *out = static_cast<int>(v);
@@ -199,6 +227,7 @@ int spfft_tpu_plan_dim_x(void* plan, int* out) {
 }
 
 int spfft_tpu_plan_dim_y(void* plan, int* out) {
+  if (out == nullptr) return kInvalidParameter;
   long long v = 0;
   int code = plan_info(plan, 1, &v);
   if (code == kSuccess) *out = static_cast<int>(v);
@@ -206,6 +235,7 @@ int spfft_tpu_plan_dim_y(void* plan, int* out) {
 }
 
 int spfft_tpu_plan_dim_z(void* plan, int* out) {
+  if (out == nullptr) return kInvalidParameter;
   long long v = 0;
   int code = plan_info(plan, 2, &v);
   if (code == kSuccess) *out = static_cast<int>(v);
@@ -217,8 +247,17 @@ int spfft_tpu_plan_num_values(void* plan, long long* out) {
 }
 
 int spfft_tpu_plan_transform_type(void* plan, int* out) {
+  if (out == nullptr) return kInvalidParameter;
   long long v = 0;
   int code = plan_info(plan, 4, &v);
+  if (code == kSuccess) *out = static_cast<int>(v);
+  return code;
+}
+
+int spfft_tpu_plan_num_shards(void* plan, int* out) {
+  if (out == nullptr) return kInvalidParameter;
+  long long v = 0;
+  int code = plan_info(plan, 5, &v);
   if (code == kSuccess) *out = static_cast<int>(v);
   return code;
 }

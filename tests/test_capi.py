@@ -128,3 +128,51 @@ def test_null_arguments(lib):
 def test_error_strings(lib):
     assert lib.spfft_tpu_error_string(0) == b"success"
     assert b"unrecognised" in lib.spfft_tpu_error_string(9999)
+
+
+def test_ctypes_distributed_round_trip(lib):
+    """Distributed C plan over the forced 8-device CPU mesh: concatenated
+    per-shard values <-> full cube, against the local-plan result."""
+    lib.spfft_tpu_plan_create_distributed.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p), ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int]
+    lib.spfft_tpu_plan_num_shards.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int)]
+    n, shards = 8, 4
+    # split sticks round-robin: shard r gets sticks with (x*n+y) % shards == r
+    trip_all = np.array([[x, y, z] for x in range(n) for y in range(n)
+                         for z in range(n)], np.int32)
+    order = np.argsort((trip_all[:, 0] * n + trip_all[:, 1]) % shards,
+                       kind="stable")
+    trip = np.ascontiguousarray(trip_all[order])
+    vps = np.array([(((trip_all[:, 0] * n + trip_all[:, 1]) % shards) == r)
+                    .sum() for r in range(shards)], np.int64)
+    pps = np.full(shards, n // shards, np.int32)
+    values = np.random.default_rng(1).standard_normal(
+        (len(trip), 2)).astype(np.float32)
+    space = np.empty((n, n, n, 2), np.float32)
+    out = np.empty_like(values)
+    plan = ctypes.c_void_p()
+    assert lib.spfft_tpu_plan_create_distributed(
+        ctypes.byref(plan), 0, n, n, n, shards, vps.ctypes.data,
+        trip.ctypes.data, pps.ctypes.data, 0) == 0
+    ns = ctypes.c_int()
+    assert lib.spfft_tpu_plan_num_shards(plan, ctypes.byref(ns)) == 0
+    assert ns.value == shards
+    assert lib.spfft_tpu_backward(plan, values.ctypes.data,
+                                  space.ctypes.data) == 0
+    # oracle: the same transform through a local plan
+    lplan = ctypes.c_void_p()
+    assert lib.spfft_tpu_plan_create(
+        ctypes.byref(lplan), 0, n, n, n, ctypes.c_longlong(len(trip)),
+        trip.ctypes.data, 0) == 0
+    lspace = np.empty((n, n, n, 2), np.float32)
+    assert lib.spfft_tpu_backward(lplan, values.ctypes.data,
+                                  lspace.ctypes.data) == 0
+    np.testing.assert_allclose(space, lspace, atol=1e-4)
+    assert lib.spfft_tpu_forward(plan, space.ctypes.data, 1,
+                                 out.ctypes.data) == 0
+    np.testing.assert_allclose(out, values, atol=1e-5)
+    assert lib.spfft_tpu_plan_destroy(plan) == 0
+    assert lib.spfft_tpu_plan_destroy(lplan) == 0
