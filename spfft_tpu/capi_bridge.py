@@ -23,7 +23,6 @@ import numpy as np
 from .errors import ErrorCode, GenericError, InvalidParameterError
 from .plan import TransformPlan, make_local_plan
 from .types import Scaling, TransformType
-from .utils.dtypes import as_interleaved
 
 _plans: Dict[int, object] = {}
 _next_id = itertools.count(1)
@@ -142,7 +141,8 @@ def plan_destroy(pid: int) -> None:
 
 
 def _is_distributed(plan) -> bool:
-    return not isinstance(plan, TransformPlan)
+    from .parallel.dist import DistributedTransformPlan
+    return isinstance(plan, DistributedTransformPlan)
 
 
 def _dist_backward(plan, values_addr: int, space_addr: int) -> None:
@@ -154,10 +154,11 @@ def _dist_backward(plan, values_addr: int, space_addr: int) -> None:
     for sp in dp.shard_plans:
         per.append(flat[off:off + sp.num_values])
         off += sp.num_values
-    slabs = plan.unshard_space(plan.backward(per))
-    cube = np.concatenate([as_interleaved(s, plan.precision) if
-                           not dp.hermitian else np.asarray(s)
-                           for s in slabs], axis=0)
+    # The padded device result is already interleaved (C2C) / real (R2C):
+    # slice each shard's true slab out directly, no complex round trip.
+    padded = np.asarray(plan.backward(per))
+    cube = np.concatenate([padded[r, :dp.num_planes[r]]
+                           for r in range(dp.num_shards)], axis=0)
     width = 1 if dp.hermitian else 2
     n_space = dp.dim_z * dp.dim_y * dp.dim_x * width
     _view(space_addr, n_space, plan.precision)[:] = cube.reshape(-1)
@@ -178,10 +179,10 @@ def _dist_forward(plan, space_addr: int, scaling: int,
         off += n
     if scaling not in (0, 1):
         raise InvalidParameterError(f"bad scaling {scaling}")
-    vals = plan.unshard_values(plan.forward(
+    padded = np.asarray(plan.forward(
         slabs, Scaling.FULL if scaling == 1 else Scaling.NONE))
-    out = np.concatenate([as_interleaved(v, plan.precision) for v in vals],
-                         axis=0)
+    out = np.concatenate([padded[r, :dp.shard_plans[r].num_values]
+                          for r in range(dp.num_shards)], axis=0)
     total = dp.num_global_elements
     _view(values_addr, 2 * total, plan.precision)[:] = out.reshape(-1)
 
@@ -224,7 +225,7 @@ def plan_info(pid: int, what: int) -> int:
     if _is_distributed(plan):
         dp = plan.dist_plan
         return {0: dp.dim_x, 1: dp.dim_y, 2: dp.dim_z,
-                3: sum(sp.num_values for sp in dp.shard_plans),
+                3: dp.num_global_elements,
                 4: 0 if dp.transform_type == TransformType.C2C else 1,
                 5: dp.num_shards}[what]
     p = plan.index_plan

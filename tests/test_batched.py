@@ -89,6 +89,36 @@ def test_multi_transform_takes_fused_path_for_shared_plan():
                                    atol=1e-12, rtol=0)
 
 
+def test_apply_pointwise_identity_and_fn():
+    rng = np.random.default_rng(12)
+    plan, vals = _c2c_plan_and_values(1, rng)
+    v = vals[0]
+    # identity round trip == forward(backward(v)) == N * v
+    got = np.asarray(plan.apply_pointwise(v))
+    ref = np.asarray(plan.forward(as_complex_np(np.asarray(plan.backward(v)))))
+    np.testing.assert_allclose(got, ref, atol=1e-10, rtol=0)
+    # FULL scaling returns the input
+    got_s = np.asarray(plan.apply_pointwise(v, scaling=Scaling.FULL))
+    v_il = np.stack([v.real, v.imag], axis=-1)
+    np.testing.assert_allclose(got_s, v_il, atol=1e-12, rtol=0)
+    # a pointwise fn (doubling the space field doubles the output)
+    got_2 = np.asarray(plan.apply_pointwise(v, fn=lambda s: 2.0 * s))
+    np.testing.assert_allclose(got_2, 2.0 * ref, atol=1e-10, rtol=0)
+
+
+def test_apply_pointwise_r2c():
+    rng = np.random.default_rng(13)
+    triplets = hermitian_triplets(rng, DIMS)
+    plan = make_local_plan(TransformType.R2C, *DIMS, triplets,
+                           precision="double")
+    v = random_values(rng, len(triplets))
+    got = np.asarray(plan.apply_pointwise(v, fn=lambda s: s * s,
+                                          scaling=Scaling.FULL))
+    space = np.asarray(plan.backward(v))
+    ref = np.asarray(plan.forward(space * space, Scaling.FULL))
+    np.testing.assert_allclose(got, ref, atol=1e-10, rtol=0)
+
+
 def test_multi_transform_distinct_plans_still_works():
     rng = np.random.default_rng(11)
     plan_a, vals_a = _c2c_plan_and_values(1, rng)
