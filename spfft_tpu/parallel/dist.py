@@ -202,6 +202,8 @@ class DistributedTransformPlan:
                       P(self.axis_name),                       # onehot
                       P(), P(), P(), P()),     # cols, col_inv, zmap, z_src
             out_specs=P(self.axis_name))
+        self._shmap = shmap
+        self._pair_jits = {}
         self._backward_jit = jax.jit(shmap(self._backward_body))
         self._forward_jit = {
             s: jax.jit(shmap(functools.partial(self._forward_body,
@@ -314,6 +316,55 @@ class DistributedTransformPlan:
         if scale is not None:
             values = values * jnp.asarray(scale, self._rdt)
         return values[None]
+
+    def _pair_body(self, values_il, vi, slot_src, onehot, cols_flat,
+                   col_inv, zmap, z_src, *fn_args, scaled: bool, fn):
+        space = self._backward_body(values_il, vi, slot_src, onehot,
+                                    cols_flat, col_inv, zmap, z_src)
+        if fn is not None:
+            space = fn(space, *fn_args)
+        return self._forward_body(space, vi, slot_src, onehot, cols_flat,
+                                  col_inv, zmap, z_src, scaled=scaled)
+
+    def apply_pointwise(self, values, fn=None, *fn_args,
+                        scaling: Scaling = Scaling.NONE):
+        """backward → ``fn(space, *fn_args)`` → forward as ONE fused SPMD
+        executable (both collectives inside a single program, so XLA can
+        overlap the exchanges with neighbouring compute).
+
+        ``fn`` runs *per shard inside shard_map* on the padded local slab
+        — shape ``(1, max_planes, dim_y, dim_x, 2)`` interleaved for C2C,
+        ``(1, max_planes, dim_y, dim_x)`` real for R2C; rows at and beyond
+        the shard's true ``num_planes`` are padding and whatever ``fn``
+        writes there is ignored (the z-selection tables read true planes
+        only — tested in test_distributed.py). Each ``fn_args`` entry is a
+        sharded array over the mesh axis (leading dim ``num_shards``),
+        split like the data — the way to feed a shard-dependent operator
+        (e.g. a potential field laid out as padded slabs) or step-varying
+        data without recompiling.
+
+        The compiled SPMD program is cached per ``(fn, scaling)`` by object
+        identity: pass a stable callable, not a fresh lambda per call.
+        Returns the padded sharded values array."""
+        scaling = Scaling(scaling)
+        if not isinstance(values, jax.Array):
+            values = self.shard_values(values)
+        key = (fn, scaling, len(fn_args))
+        jitted = self._pair_jits.get(key)
+        if jitted is None:
+            n_extra = len(fn_args)
+            shmap = functools.partial(
+                jax.shard_map, mesh=self.mesh,
+                in_specs=(P(self.axis_name),) * 4
+                + (P(), P(), P(), P())
+                + (P(self.axis_name),) * n_extra,
+                out_specs=P(self.axis_name))
+            jitted = jax.jit(shmap(functools.partial(
+                self._pair_body, scaled=(scaling is Scaling.FULL), fn=fn)))
+            self._pair_jits[key] = jitted
+        with timed_transform("apply_pointwise") as box:
+            box.value = jitted(values, *self._device_tables, *fn_args)
+        return box.value
 
     # -- getters (reference transform.hpp:91-171) ---------------------------
     @property

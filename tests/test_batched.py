@@ -106,6 +106,26 @@ def test_apply_pointwise_identity_and_fn():
     np.testing.assert_allclose(got_2, 2.0 * ref, atol=1e-10, rtol=0)
 
 
+def test_apply_pointwise_fn_args_traced():
+    """fn_args flow as traced arguments: same fn object + different data
+    must produce different results with ONE cached executable."""
+    rng = np.random.default_rng(14)
+    plan, vals = _c2c_plan_and_values(1, rng)
+    v = vals[0]
+
+    def scale_by(space, factor):
+        return space * factor
+
+    a = np.asarray(plan.apply_pointwise(v, scale_by, 2.0,
+                                        scaling=Scaling.FULL))
+    b = np.asarray(plan.apply_pointwise(v, scale_by, 3.0,
+                                        scaling=Scaling.FULL))
+    v_il = np.stack([v.real, v.imag], axis=-1)
+    np.testing.assert_allclose(a, 2.0 * v_il, atol=1e-12, rtol=0)
+    np.testing.assert_allclose(b, 3.0 * v_il, atol=1e-12, rtol=0)
+    assert len(plan._pair_jits) == 1
+
+
 def test_apply_pointwise_r2c():
     rng = np.random.default_rng(13)
     triplets = hermitian_triplets(rng, DIMS)
