@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""SCF-style inner loop: apply a local potential in the space domain.
+
+The workload SpFFT exists for (plane-wave DFT codes): each iteration takes
+sparse frequency coefficients, transforms to real space, multiplies by a
+potential field, and transforms back. Here the whole step is ONE fused
+executable via ``apply_pointwise`` — the potential flows through ``fn_args``
+as a traced argument, so updating it between iterations never recompiles.
+
+Run: python examples/example_scf.py
+"""
+
+import os
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+import spfft_tpu as sp  # noqa: E402
+from spfft_tpu.utils.workloads import spherical_cutoff_triplets  # noqa: E402
+
+n = 32
+triplets = spherical_cutoff_triplets(n)
+plan = sp.make_local_plan(sp.TransformType.C2C, n, n, n, triplets,
+                          precision="single")
+
+rng = np.random.default_rng(0)
+coeffs = (rng.uniform(-1, 1, len(triplets))
+          + 1j * rng.uniform(-1, 1, len(triplets))).astype(np.complex64)
+coeffs = jnp.asarray(np.stack([coeffs.real, coeffs.imag], -1))
+
+
+def apply_potential(space, potential):
+    # space is (nz, ny, nx, 2) interleaved; the potential is real and
+    # multiplies both components
+    return space * potential[..., None]
+
+
+potential = jnp.ones((n, n, n), jnp.float32)
+for it in range(5):
+    # one fused step: backward -> V*psi -> forward, scaled back to
+    # coefficient convention
+    coeffs = plan.apply_pointwise(coeffs, apply_potential, potential,
+                                  scaling=sp.Scaling.FULL)
+    # update the potential between steps (traced argument: no recompile)
+    potential = potential * 0.99 + 0.01 * jnp.cos(
+        jnp.linspace(0, np.pi, n))[None, None, :]
+    norm = float(jnp.linalg.norm(coeffs))
+    print(f"iter {it}: |coeffs| = {norm:.6f}, "
+          f"compiled executables: {len(plan._pair_jits)}")
+
+assert len(plan._pair_jits) == 1, "potential updates must not recompile"
+print("OK")

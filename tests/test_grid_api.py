@@ -117,3 +117,18 @@ def test_multi_transform():
 
     with pytest.raises(InvalidParameterError):
         multi_transform_backward(transforms, batches[:2])
+
+
+def test_python_examples_run():
+    """The shipped Python examples execute end-to-end (on the test CPU
+    platform; the C example is exercised by test_capi.py)."""
+    import os
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    for name in ("example.py", "example_distributed.py", "example_scf.py"):
+        out = subprocess.run(
+            [sys.executable, os.path.join(repo, "examples", name)],
+            env=env, capture_output=True, text=True, timeout=300)
+        assert out.returncode == 0, f"{name}: {out.stderr[-2000:]}"
