@@ -228,6 +228,29 @@ class Transform:
         return isinstance(self._plan, DistributedTransformPlan)
 
     @property
+    def processing_unit(self) -> ProcessingUnit:
+        """DEVICE semantics always: results stay in HBM; numpy in/out is
+        accepted everywhere (reference transform.hpp:151 returns the unit
+        the transform was created with — here there is only one compute
+        path, the accelerator)."""
+        return ProcessingUnit.DEVICE
+
+    @property
+    def precision(self) -> str:
+        return self._plan.precision
+
+    @property
+    def exchange_type(self) -> ExchangeType:
+        """The exchange mechanism of a distributed plan; local transforms
+        report DEFAULT (no exchange exists — reference grid.hpp only
+        defines the exchange on distributed grids)."""
+        return getattr(self._plan, "exchange", ExchangeType.DEFAULT)
+
+    @property
+    def num_shards(self) -> int:
+        return self._plan.dist_plan.num_shards if self.distributed else 1
+
+    @property
     def global_size(self) -> int:
         return self._plan.global_size
 

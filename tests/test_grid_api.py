@@ -132,3 +132,31 @@ def test_python_examples_run():
             [sys.executable, os.path.join(repo, "examples", name)],
             env=env, capture_output=True, text=True, timeout=300)
         assert out.returncode == 0, f"{name}: {out.stderr[-2000:]}"
+
+
+def test_transform_property_getters():
+    """The reference transform.hpp:91-171 getter surface on both plan
+    kinds."""
+    import numpy as np
+    from spfft_tpu import (ExchangeType, ProcessingUnit, TransformType,
+                           make_local_plan)
+    from spfft_tpu.grid import Transform
+    from spfft_tpu.parallel import make_distributed_plan, make_mesh
+
+    trip = np.array([[0, 0, 0], [1, 2, 3]])
+    local = Transform(make_local_plan(TransformType.C2C, 4, 4, 4, trip,
+                                      precision="double"))
+    assert local.processing_unit == ProcessingUnit.DEVICE
+    assert local.precision == "double"
+    assert local.exchange_type == ExchangeType.DEFAULT
+    assert local.num_shards == 1
+
+    parts = [trip[:1], trip[1:], trip[:0], trip[:0]]
+    dist = Transform(make_distributed_plan(
+        TransformType.C2C, 4, 4, 4, parts, [1, 1, 1, 1],
+        mesh=make_mesh(4), precision="double",
+        exchange=ExchangeType.UNBUFFERED))
+    assert dist.processing_unit == ProcessingUnit.DEVICE
+    assert dist.precision == "double"
+    assert dist.exchange_type == ExchangeType.UNBUFFERED
+    assert dist.num_shards == 4
