@@ -109,12 +109,18 @@ def build_monotone_gather_tables(idx: np.ndarray, valid: np.ndarray,
         K = min(K_CANDIDATES,
                 key=lambda k: int((-(-span_t // k)).sum()) * (k + 8))
     chunks_t = (-(-span_t // K)).astype(np.int64)
-    tile_of = np.repeat(np.arange(G, dtype=np.int64), chunks_t)
-    c_of = np.concatenate([np.arange(n, dtype=np.int64) for n in chunks_t])
     C = int(chunks_t.sum())
-    rel = rows[tile_of] - row0_t[tile_of, None]          # (C, TILE)
-    in_win = (rel // K) == c_of[:, None]
-    row_in = np.clip(rel - c_of[:, None] * K, 0, K - 1)
+    tile_of = np.repeat(np.arange(G, dtype=np.int64), chunks_t)
+    # chunk ordinal within its tile, vectorised (a per-tile arange concat
+    # is a Python loop over ~L/1024 tiles and dominated plan time)
+    c_of = np.arange(C, dtype=np.int64) - np.repeat(
+        np.cumsum(chunks_t) - chunks_t, chunks_t)
+    rows32 = rows.astype(np.int32)  # int32 up front: the (C, TILE)
+    row0_32 = row0_t.astype(np.int32)  # temporaries are the peak allocation
+    rel = rows32[tile_of] - row0_32[tile_of, None]       # (C, TILE)
+    c32 = c_of[:, None].astype(np.int32)
+    in_win = (rel // K) == c32
+    row_in = np.clip(rel - c32 * K, 0, K - 1)
     m = in_win & valid_p.reshape(G, TILE)[tile_of]
     packed = ((tiles[tile_of] % TILE_LANE)
               | (row_in << _ROW_SHIFT)
