@@ -283,9 +283,17 @@ class Transform:
         return Transform(self._plan)
 
     # -- space-domain access (reference transform.hpp:184) -------------------
-    def space_domain_data(self):
+    def space_domain_data(self, location: Optional[ProcessingUnit] = None):
         """The current space-domain data: set by ``backward``, consumed by
-        ``forward``. None until one of them ran or the setter was used."""
+        ``forward``. None until one of them ran or the setter was used.
+
+        ``location`` mirrors the reference's processing-unit argument
+        (transform.hpp:184): ``ProcessingUnit.HOST`` returns a numpy array,
+        ``DEVICE`` (or None) returns the data where it lives."""
+        if self._space is None or location is None:
+            return self._space
+        if ProcessingUnit(location) == ProcessingUnit.HOST:
+            return np.asarray(self._space)
         return self._space
 
     def set_space_domain_data(self, space) -> None:

@@ -127,7 +127,8 @@ def test_python_examples_run():
     import sys
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
-    for name in ("example.py", "example_distributed.py", "example_scf.py"):
+    for name in ("example.py", "example_distributed.py", "example_scf.py",
+                 "example_multihost.py"):
         out = subprocess.run(
             [sys.executable, os.path.join(repo, "examples", name)],
             env=env, capture_output=True, text=True, timeout=300)
@@ -160,3 +161,19 @@ def test_transform_property_getters():
     assert dist.precision == "double"
     assert dist.exchange_type == ExchangeType.UNBUFFERED
     assert dist.num_shards == 4
+
+
+def test_space_domain_data_location():
+    import numpy as np
+    from spfft_tpu import ProcessingUnit, TransformType, make_local_plan
+    from spfft_tpu.grid import Transform
+
+    trip = np.array([[0, 0, 0], [1, 2, 3]])
+    t = Transform(make_local_plan(TransformType.C2C, 4, 4, 4, trip,
+                                  precision="double"))
+    assert t.space_domain_data() is None
+    t.backward(np.array([1 + 1j, 2 - 1j]))
+    host = t.space_domain_data(ProcessingUnit.HOST)
+    assert isinstance(host, np.ndarray)
+    np.testing.assert_array_equal(
+        host, np.asarray(t.space_domain_data(ProcessingUnit.DEVICE)))
