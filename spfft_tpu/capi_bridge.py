@@ -70,13 +70,17 @@ class _InvalidHandle(GenericError):
     code = ErrorCode.INVALID_HANDLE
 
 
-@_guarded
-def plan_create(transform_type: int, dim_x: int, dim_y: int, dim_z: int,
-                num_values: int, triplets_addr: int, precision: int) -> int:
+def _check_create_enums(transform_type: int, precision: int) -> None:
     if transform_type not in (0, 1):
         raise InvalidParameterError(f"bad transform type {transform_type}")
     if precision not in (0, 1):
         raise InvalidParameterError(f"bad precision {precision}")
+
+
+@_guarded
+def plan_create(transform_type: int, dim_x: int, dim_y: int, dim_z: int,
+                num_values: int, triplets_addr: int, precision: int) -> int:
+    _check_create_enums(transform_type, precision)
     if num_values < 0:
         raise InvalidParameterError(f"negative num_values {num_values}")
     if num_values == 0:
@@ -103,10 +107,7 @@ def plan_create_distributed(transform_type: int, dim_x: int, dim_y: int,
     spfft_grid_create_distributed, grid.h — communicator -> device mesh)."""
     from .parallel import make_distributed_plan, make_mesh
 
-    if transform_type not in (0, 1):
-        raise InvalidParameterError(f"bad transform type {transform_type}")
-    if precision not in (0, 1):
-        raise InvalidParameterError(f"bad precision {precision}")
+    _check_create_enums(transform_type, precision)
     vps = np.array(np.ctypeslib.as_array(
         ctypes.cast(vps_addr, ctypes.POINTER(ctypes.c_longlong)),
         shape=(num_shards,)), np.int64, copy=True)

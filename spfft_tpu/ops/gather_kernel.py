@@ -122,9 +122,10 @@ def build_monotone_gather_tables(idx: np.ndarray, valid: np.ndarray,
     in_win = (rel // K) == c32
     row_in = np.clip(rel - c32 * K, 0, K - 1)
     m = in_win & valid_p.reshape(G, TILE)[tile_of]
-    packed = ((tiles[tile_of] % TILE_LANE)
+    lanes = (tiles % TILE_LANE).astype(np.int32)  # (G, TILE), not (C, TILE)
+    packed = (lanes[tile_of]
               | (row_in << _ROW_SHIFT)
-              | (m.astype(np.int64) << _VALID_SHIFT)).astype(np.int32)
+              | (m.astype(np.int32) << _VALID_SHIFT))
     row0 = (row0_t[tile_of] + c_of * K).astype(np.int32)
     # Cover the whole source array, not just the last referenced span: the
     # planar source is built by zero-PADDING the (num_src,) array to

@@ -202,7 +202,6 @@ class DistributedTransformPlan:
                       P(self.axis_name),                       # onehot
                       P(), P(), P(), P()),     # cols, col_inv, zmap, z_src
             out_specs=P(self.axis_name))
-        self._shmap = shmap
         self._pair_jits = {}
         self._backward_jit = jax.jit(shmap(self._backward_body))
         self._forward_jit = {
