@@ -70,6 +70,10 @@ def lib(capi_lib):
                                       ctypes.c_int, ctypes.c_void_p]
     lib.spfft_tpu_plan_num_values.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong)]
+    lib.spfft_tpu_plan_create_distributed.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p), ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int]
     code = lib.spfft_tpu_init(None)
     assert code == 0
     return lib
@@ -133,10 +137,6 @@ def test_error_strings(lib):
 def test_ctypes_distributed_round_trip(lib):
     """Distributed C plan over the forced 8-device CPU mesh: concatenated
     per-shard values <-> full cube, against the local-plan result."""
-    lib.spfft_tpu_plan_create_distributed.argtypes = [
-        ctypes.POINTER(ctypes.c_void_p), ctypes.c_int, ctypes.c_int,
-        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_void_p,
-        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int]
     lib.spfft_tpu_plan_num_shards.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_int)]
     n, shards = 8, 4
@@ -176,3 +176,19 @@ def test_ctypes_distributed_round_trip(lib):
     np.testing.assert_allclose(out, values, atol=1e-5)
     assert lib.spfft_tpu_plan_destroy(plan) == 0
     assert lib.spfft_tpu_plan_destroy(lplan) == 0
+
+
+def test_distributed_too_many_shards_code(lib):
+    """Requesting more shards than devices surfaces as a clean error code
+    (InvalidParameterError -> 5), not a crash."""
+    shards = 64  # more than the 8 virtual devices
+    trip = np.array([[0, 0, 0]], np.int32)
+    vps = np.zeros(shards, np.int64)
+    vps[0] = 1
+    pps = np.zeros(shards, np.int32)
+    pps[0] = 4
+    plan = ctypes.c_void_p()
+    code = lib.spfft_tpu_plan_create_distributed(
+        ctypes.byref(plan), 0, 4, 4, 4, shards, vps.ctypes.data,
+        trip.ctypes.data, pps.ctypes.data, 0)
+    assert code == 5

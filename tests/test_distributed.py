@@ -373,3 +373,30 @@ def test_distributed_forward_ignores_padding_rows():
         poisoned, plan._sharded)))
     for g, c in zip(got, clean):
         np.testing.assert_allclose(g, c, atol=0, rtol=0)
+
+
+@pytest.mark.parametrize("precision", ["double", "single"])
+def test_distributed_r2c_double_and_single(precision):
+    """Distributed R2C in both precisions against the dense oracle (the
+    reference's SPFFT_SINGLE_PRECISION twins run the same test matrix)."""
+    dims = (12, 11, 13)
+    rng = np.random.default_rng(41)
+    triplets = hermitian_triplets(rng, dims)
+    parts = split_by_sticks(triplets, dims, [1, 2, 1, 0])
+    planes = split_planes(dims[2], [2, 1, 1, 2])
+    plan = make_distributed_plan(TransformType.R2C, *dims, parts, planes,
+                                 mesh=make_mesh(4), precision=precision)
+    # build consistent hermitian values from a real space field
+    space_field = rng.standard_normal((dims[2], dims[1], dims[0]))
+    freq = dense_forward(space_field.astype(np.complex128))
+    values = [sample_cube(freq, p, dims) for p in parts]
+    space = plan.backward(values)
+    got = np.concatenate(plan.unshard_space(space), axis=0)
+    tol = tolerance_for(precision, space_field) * np.prod(dims) ** 0.5
+    np.testing.assert_allclose(got, space_field * np.prod(dims), atol=tol,
+                               rtol=0)
+    got_parts = plan.unshard_values(plan.forward(space, Scaling.FULL))
+    for r, part in enumerate(parts):
+        expected = sample_cube(freq, part, dims)
+        np.testing.assert_allclose(got_parts[r], expected, atol=tol,
+                                   rtol=0)
