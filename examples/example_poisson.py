@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""Spectral Poisson solver on the sparse frequency set.
+
+Solves ∇²φ = -ρ on a periodic box the way plane-wave DFT codes do
+(Hartree potential): forward-transform the density, scale each sparse
+coefficient by 1/|G|² (the whole point of the sparse representation — the
+multiplier is applied only to the stored coefficients, no dense cube
+exists), and transform back.
+
+Run: python examples/example_poisson.py
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+import spfft_tpu as sp  # noqa: E402
+from spfft_tpu.utils import as_complex_np  # noqa: E402
+from spfft_tpu.utils.workloads import spherical_cutoff_triplets  # noqa: E402
+
+n = 32
+box = 2 * np.pi  # box length -> G vectors are integer frequencies
+triplets = spherical_cutoff_triplets(n)  # centered indexing
+plan = sp.make_local_plan(sp.TransformType.C2C, n, n, n, triplets,
+                          precision="single")
+
+# a density: two opposite Gaussian blobs (net neutral), dense on the grid
+zz, yy, xx = np.meshgrid(*(np.linspace(0, box, n, endpoint=False),) * 3,
+                         indexing="ij")
+def blob(cx, cy, cz, sign):
+    r2 = (xx - cx) ** 2 + (yy - cy) ** 2 + (zz - cz) ** 2
+    return sign * np.exp(-r2 / 0.5)
+rho = blob(2.0, 2.0, 2.0, +1.0) + blob(4.5, 4.5, 4.5, -1.0)
+rho = rho.astype(np.complex64)
+
+# forward: dense space field -> sparse coefficients (with 1/N scaling)
+rho_g = as_complex_np(np.asarray(plan.forward(rho, sp.Scaling.FULL)))
+
+# spectral solve: phi_G = rho_G / |G|^2, G=0 mode fixed to 0 (neutrality)
+g2 = (triplets.astype(np.float64) ** 2).sum(axis=1)
+phi_g = np.where(g2 > 0, rho_g / np.maximum(g2, 1), 0).astype(np.complex64)
+
+# backward: sparse potential coefficients -> dense potential
+phi = as_complex_np(np.asarray(plan.backward(phi_g)))
+
+# residual check: -∇²φ computed spectrally must reproduce rho (within the
+# cutoff sphere — the solver lives entirely in the sparse set)
+lap_g = (-g2 * phi_g).astype(np.complex64)
+lap = as_complex_np(np.asarray(plan.backward(lap_g.astype(np.complex64))))
+rho_in_cutoff = as_complex_np(np.asarray(plan.backward(rho_g)))
+err = np.abs(lap + rho_in_cutoff).max() / np.abs(rho_in_cutoff).max()
+print(f"grid {n}^3, {len(triplets)} plane waves "
+      f"({len(triplets) / n**3:.0%} of dense)")
+print(f"max |∇²φ + ρ| / max|ρ| = {err:.2e}")
+assert err < 1e-4
+print("OK")

@@ -128,7 +128,8 @@ def test_python_examples_run():
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
     for name in ("example.py", "example_distributed.py", "example_scf.py",
-                 "example_multihost.py"):
+                 "example_multihost.py",
+                 "example_poisson.py"):
         out = subprocess.run(
             [sys.executable, os.path.join(repo, "examples", name)],
             env=env, capture_output=True, text=True, timeout=300)
