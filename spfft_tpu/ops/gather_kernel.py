@@ -140,6 +140,35 @@ def build_monotone_gather_tables(idx: np.ndarray, valid: np.ndarray,
         num_out=L, num_tiles=G, src_rows=src_rows, span_rows=K)
 
 
+def pad_tables_to(t: "MonotoneGatherTables", c_max: int):
+    """Pad a table set to ``c_max`` chunks so shape-heterogeneous per-shard
+    tables can be stacked into one SPMD-sharded array.
+
+    Padding chunks are no-ops targeting a DUMMY output tile (index
+    ``t.num_tiles``): all-zero packed words (valid=0, lane=0, row=0) and
+    row0=0 (src_rows >= K always holds, so the DMA window is in range).
+    The first padding chunk has first=1 so the dummy tile is initialised,
+    never read-modify-written uninitialised. Callers must pass
+    ``num_tiles + 1`` to ``monotone_gather`` and slice off the dummy tile
+    (the flat real-output prefix is unchanged because the dummy is last).
+
+    Returns (row0, out_tile, first, packed) padded to c_max rows.
+    """
+    pad = c_max - t.row0.shape[0]
+    if pad < 0:
+        raise ValueError("c_max smaller than existing chunk count")
+    if pad == 0:
+        return t.row0, t.out_tile, t.first, t.packed
+    row0 = np.concatenate([t.row0, np.zeros(pad, np.int32)])
+    out_tile = np.concatenate(
+        [t.out_tile, np.full(pad, t.num_tiles, np.int32)])
+    first = np.concatenate(
+        [t.first, np.ones(1, np.int32), np.zeros(pad - 1, np.int32)])
+    packed = np.concatenate(
+        [t.packed, np.zeros((pad, TILE_SUB, TILE_LANE), np.int32)])
+    return row0, out_tile, first, packed
+
+
 def _kernel(K: int, row0_ref, out_tile_ref, first_ref, packed_ref,
             re_hbm, im_hbm, out_re_ref, out_im_ref, sc, sem):
     g = pl.program_id(0)

@@ -158,7 +158,8 @@ class DistributedTransformPlan:
 
     def __init__(self, dist_plan: DistributedIndexPlan,
                  mesh: Optional[Mesh] = None, precision: str = "single",
-                 exchange: ExchangeType = ExchangeType.DEFAULT):
+                 exchange: ExchangeType = ExchangeType.DEFAULT,
+                 use_pallas: Optional[bool] = None):
         self.dist_plan = dist_plan
         self.precision = precision
         self.exchange = ExchangeType(exchange)
@@ -183,6 +184,7 @@ class DistributedTransformPlan:
                              if self.exchange == ExchangeType.UNBUFFERED
                              else all_to_all_blocks)
         self._build_tables()
+        self._init_pallas(use_pallas)
         self._sharded = NamedSharding(self.mesh, P(self.axis_name))
         self._replicated = NamedSharding(self.mesh, P())
         # Commit the static tables to device once, at plan time (never on the
@@ -195,13 +197,25 @@ class DistributedTransformPlan:
             jax.device_put(self._col_inv, self._replicated),
             jax.device_put(self._zmap, self._replicated),
             jax.device_put(self._z_src, self._replicated))
+        if self._pallas_dist is not None:
+            self._device_tables = self._device_tables + tuple(
+                jax.device_put(a, self._sharded)
+                for a in self._pallas_dist["stacked"])
+        self._n_ptables = (len(self._pallas_dist["stacked"])
+                           if self._pallas_dist is not None else 0)
+        self._base_in_specs = (
+            (P(self.axis_name),                       # data
+             P(self.axis_name), P(self.axis_name),    # vi, slot_src
+             P(self.axis_name),                       # onehot
+             P(), P(), P(), P())      # cols, col_inv, zmap, z_src
+            + (P(self.axis_name),) * self._n_ptables)
+        # pallas_call outputs carry no varying-mesh-axes metadata, so the
+        # vma consistency check must be off when the kernel is in the body;
+        # XLA-path plans keep the check (specs pin every sharding anyway)
+        self._check_vma = self._pallas_dist is None
         shmap = functools.partial(
-            jax.shard_map, mesh=self.mesh,
-            in_specs=(P(self.axis_name),                       # data
-                      P(self.axis_name), P(self.axis_name),    # vi, slot_src
-                      P(self.axis_name),                       # onehot
-                      P(), P(), P(), P()),     # cols, col_inv, zmap, z_src
-            out_specs=P(self.axis_name))
+            jax.shard_map, mesh=self.mesh, in_specs=self._base_in_specs,
+            out_specs=P(self.axis_name), check_vma=self._check_vma)
         self._pair_jits = {}
         self._backward_jit = jax.jit(shmap(self._backward_body))
         self._forward_jit = {
@@ -271,12 +285,108 @@ class DistributedTransformPlan:
         self._z_src = z_src
         self._onehot = onehot
 
+    def _init_pallas(self, use_pallas: Optional[bool]) -> None:
+        """Build per-shard Pallas monotone-gather tables for the compression
+        stages, stacked into SPMD-sharded arrays (the same kernel the local
+        plan uses; see ops/gather_kernel.py).
+
+        Per-shard chunk counts differ, so each shard's tables are padded to
+        the maximum with no-op chunks targeting a dummy output tile
+        (gather_kernel.pad_tables_to); the DMA window height K and source
+        rows are unified across shards (the SPMD body is one program).
+        Active when every shard's value order is stick-major/z-ascending,
+        precision is single, and the backend is TPU; ``use_pallas=True`` on
+        a non-TPU backend runs the kernel in interpret mode (testing)."""
+        from ..ops import gather_kernel as gk
+
+        dp = self.dist_plan
+        self._pallas_dist = None
+        self._pallas_interpret = False
+        backend_ok = jax.default_backend() == "tpu"
+        if use_pallas is True and self.precision != "single":
+            raise InvalidParameterError(
+                "the Pallas compression kernel is single-precision only")
+        if use_pallas is False or (use_pallas is None and not backend_ok):
+            return
+        if use_pallas is None and self.precision != "single":
+            return
+        ms, mv, dim_z = dp.max_sticks, dp.max_values, dp.dim_z
+        num_slots = ms * dim_z
+        if mv == 0 or num_slots == 0:
+            return
+        for p in dp.shard_plans:
+            vi64 = p.value_indices.astype(np.int64)
+            if p.num_values and (np.diff(vi64) <= 0).any():
+                return  # non-monotone shard: XLA gather path for all
+
+        def shard_inputs(p):
+            vi64 = p.value_indices.astype(np.int64)
+            occupied = np.zeros(num_slots, bool)
+            occupied[vi64] = True
+            dec_idx = np.maximum(np.cumsum(occupied) - 1, 0)
+            cmp_idx = np.zeros(mv, np.int64)
+            if p.num_values:
+                cmp_idx[:p.num_values] = vi64
+                cmp_idx[p.num_values:] = vi64[-1]  # monotone padding
+            cmp_valid = np.arange(mv) < p.num_values
+            return (dec_idx, occupied), (cmp_idx, cmp_valid)
+
+        per_shard = [shard_inputs(p) for p in dp.shard_plans]
+
+        def build_all(which, num_src, num_out):
+            # two passes: discover each shard's preferred K, then rebuild
+            # with the common (max) K so the SPMD program is uniform
+            tables = [gk.build_monotone_gather_tables(idx, valid, num_src)
+                      for (idx, valid) in (s[which] for s in per_shard)]
+            if any(t is None for t in tables):
+                return None
+            k = max(t.span_rows for t in tables)
+            if any(t.span_rows != k for t in tables):
+                tables = [gk.build_monotone_gather_tables(
+                    idx, valid, num_src, k_rows=k)
+                    for (idx, valid) in (s[which] for s in per_shard)]
+            c_max = max(t.row0.shape[0] for t in tables)
+            src_rows = max(t.src_rows for t in tables)
+            padded = [gk.pad_tables_to(t, c_max) for t in tables]
+            stacked = [np.stack([p[i] for p in padded]) for i in range(4)]
+            return {"stacked": stacked, "k": k, "src_rows": src_rows,
+                    "tiles_p1": tables[0].num_tiles + 1, "num_out": num_out}
+
+        dec = build_all(0, num_src=mv, num_out=num_slots)
+        cmp_ = build_all(1, num_src=num_slots, num_out=mv)
+        if dec is None or cmp_ is None:
+            return
+        self._pallas_dist = {
+            "dec": dec, "cmp": cmp_,
+            "stacked": dec["stacked"] + cmp_["stacked"],
+        }
+        self._pallas_interpret = not backend_ok
+
+    def _pallas_gather(self, flat_il, t, tables):
+        """Run the monotone gather on one shard's (N, 2) interleaved data."""
+        from ..ops import gather_kernel as gk
+        row0, out_tile, first, packed = (a[0] for a in tables)
+        re, im = gk.planar_from_interleaved(
+            flat_il.astype(np.float32), t["src_rows"])
+        out_re, out_im = gk.monotone_gather(
+            re, im, row0, out_tile, first, packed,
+            span_rows=t["k"], src_rows=t["src_rows"],
+            num_tiles=t["tiles_p1"], interpret=self._pallas_interpret)
+        return gk.interleaved_from_planar(out_re, out_im, t["num_out"])
+
     # -- SPMD bodies ---------------------------------------------------------
     def _backward_body(self, values_il, vi, slot_src, onehot, cols_flat,
-                       col_inv, zmap, z_src):
+                       col_inv, zmap, z_src, *ptables):
         dp = self.dist_plan
-        sticks = stages.decompress(values_il[0].astype(self._rdt),
-                                   slot_src[0], dp.max_sticks, dp.dim_z)
+        if self._pallas_dist is not None:
+            dec_il = self._pallas_gather(values_il[0],
+                                         self._pallas_dist["dec"],
+                                         ptables[:4])
+            sticks = (dec_il[:, 0] + 1j * dec_il[:, 1]).reshape(
+                dp.max_sticks, dp.dim_z)
+        else:
+            sticks = stages.decompress(values_il[0].astype(self._rdt),
+                                       slot_src[0], dp.max_sticks, dp.dim_z)
         if dp.hermitian:
             # Complete every stick, then blend by the one-hot (0,0)-stick
             # mask — SPMD-safe stand-in for the reference's "owner rank
@@ -295,7 +405,7 @@ class DistributedTransformPlan:
         return complex_to_interleaved(stages.xy_backward_c2c(grid))[None]
 
     def _forward_body(self, space, vi, slot_src, onehot, cols_flat, col_inv,
-                      zmap, z_src, *, scaled: bool):
+                      zmap, z_src, *ptables, scaled: bool):
         dp = self.dist_plan
         if dp.hermitian:
             grid = stages.xy_forward_r2c(space[0].astype(self._rdt))
@@ -311,19 +421,26 @@ class DistributedTransformPlan:
         # vi carries the sentinel max_sticks*dim_z for value padding
         flat = jnp.stack([jnp.real(sticks).reshape(-1),
                           jnp.imag(sticks).reshape(-1)], axis=-1)
-        values = stages.gather_rows_with_sentinel(flat, vi[0])
+        if self._pallas_dist is not None:
+            values = self._pallas_gather(flat, self._pallas_dist["cmp"],
+                                         ptables[4:8])
+        else:
+            values = stages.gather_rows_with_sentinel(flat, vi[0])
         if scale is not None:
             values = values * jnp.asarray(scale, self._rdt)
         return values[None]
 
     def _pair_body(self, values_il, vi, slot_src, onehot, cols_flat,
-                   col_inv, zmap, z_src, *fn_args, scaled: bool, fn):
+                   col_inv, zmap, z_src, *rest, scaled: bool, fn):
+        ptables, fn_args = rest[:self._n_ptables], rest[self._n_ptables:]
         space = self._backward_body(values_il, vi, slot_src, onehot,
-                                    cols_flat, col_inv, zmap, z_src)
+                                    cols_flat, col_inv, zmap, z_src,
+                                    *ptables)
         if fn is not None:
             space = fn(space, *fn_args)
         return self._forward_body(space, vi, slot_src, onehot, cols_flat,
-                                  col_inv, zmap, z_src, scaled=scaled)
+                                  col_inv, zmap, z_src, *ptables,
+                                  scaled=scaled)
 
     def apply_pointwise(self, values, fn=None, *fn_args,
                         scaling: Scaling = Scaling.NONE):
@@ -351,13 +468,11 @@ class DistributedTransformPlan:
         key = (fn, scaling, len(fn_args))
         jitted = self._pair_jits.get(key)
         if jitted is None:
-            n_extra = len(fn_args)
             shmap = functools.partial(
                 jax.shard_map, mesh=self.mesh,
-                in_specs=(P(self.axis_name),) * 4
-                + (P(), P(), P(), P())
-                + (P(self.axis_name),) * n_extra,
-                out_specs=P(self.axis_name))
+                in_specs=self._base_in_specs
+                + (P(self.axis_name),) * len(fn_args),
+                out_specs=P(self.axis_name), check_vma=self._check_vma)
             jitted = jax.jit(shmap(functools.partial(
                 self._pair_body, scaled=(scaling is Scaling.FULL), fn=fn)))
             self._pair_jits[key] = jitted
@@ -498,6 +613,7 @@ def make_distributed_plan(transform_type: TransformType,
                           mesh: Optional[Mesh] = None,
                           precision: str = "single",
                           exchange: ExchangeType = ExchangeType.DEFAULT,
+                          use_pallas: Optional[bool] = None,
                           ) -> DistributedTransformPlan:
     """Plan a distributed transform in one call (the distributed analogue of
     ``Grid::create_transform``, reference grid.hpp:138-141). Under
@@ -510,4 +626,4 @@ def make_distributed_plan(transform_type: TransformType,
         from .multihost import validate_consistent
         validate_consistent(dist)
     return DistributedTransformPlan(dist, mesh=mesh, precision=precision,
-                                    exchange=exchange)
+                                    exchange=exchange, use_pallas=use_pallas)

@@ -43,3 +43,20 @@ def spherical_cutoff_triplets(n: int, radius: int | None = None) -> np.ndarray:
     X, Y, Z = np.meshgrid(c, c, c, indexing="ij")
     mask = X * X + Y * Y + Z * Z <= r * r
     return np.stack([X[mask], Y[mask], Z[mask]], axis=1)
+
+
+def sort_triplets_stick_major(triplets: np.ndarray, dims) -> np.ndarray:
+    """Sort sparse triplets stick-major (by storage (x, y)) and z-ascending
+    within each stick — the value order the Pallas compression kernel's
+    monotone-gather fast path requires (and the layout the reference
+    recommends for performance, docs/source/details.rst "Data
+    Distribution"). Returns a new array; the caller's value arrays must be
+    reordered the same way."""
+    from ..indexing import to_storage_index
+    t = np.asarray(triplets).reshape(-1, 3)
+    storage = np.stack([to_storage_index(n, t[:, axis])
+                        for axis, n in enumerate(dims)], axis=1)
+    order = np.lexsort((storage[:, 2],
+                        storage[:, 0].astype(np.int64) * dims[1]
+                        + storage[:, 1]))
+    return t[order]

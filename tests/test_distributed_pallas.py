@@ -1,0 +1,97 @@
+"""Distributed Pallas compression path (interpret mode on the CPU mesh).
+
+The distributed plan can route its compression stages through the same
+monotone-gather kernel the local plan uses, with per-shard tables padded to
+uniform shapes (gather_kernel.pad_tables_to). On CPU, ``use_pallas=True``
+runs the kernel in interpret mode inside shard_map — validating the padded
+multi-shard tables exactly; the compiled kernel itself is exercised on the
+real chip by scripts/verify_drive.py step 6. Measured on TPU v5e
+(128³ sphere, 1-shard mesh, same session): 18.6 ms (XLA gathers) ->
+4.6 ms (Pallas) per fused pair."""
+
+import numpy as np
+import pytest
+
+from spfft_tpu import ExchangeType, Scaling, TransformType
+from spfft_tpu.parallel import make_distributed_plan, make_mesh
+
+from spfft_tpu.utils.workloads import sort_triplets_stick_major
+
+from test_util import (dense_backward, dense_cube_from_values, dense_forward,
+                       hermitian_triplets, random_sparse_triplets,
+                       random_values, sample_cube, tolerance_for)
+from test_distributed import split_by_sticks, split_planes
+
+DIMS = (12, 11, 13)
+
+
+def _plans(transform_type, parts, planes, exchange=ExchangeType.DEFAULT):
+    mk = lambda up: make_distributed_plan(  # noqa: E731
+        transform_type, *DIMS, parts, planes, mesh=make_mesh(4),
+        precision="single", exchange=exchange, use_pallas=up)
+    ref, pal = mk(False), mk(True)
+    assert pal._pallas_dist is not None, "pallas tables must build"
+    assert pal._pallas_interpret, "CPU backend must use interpret mode"
+    return ref, pal
+
+
+def test_pallas_matches_xla_c2c():
+    rng = np.random.default_rng(51)
+    triplets = random_sparse_triplets(rng, DIMS)
+    parts = split_by_sticks(triplets, DIMS, [2, 1, 0, 1])  # empty shard
+    planes = split_planes(DIMS[2], [1, 3, 1, 2])
+    ref, pal = _plans(TransformType.C2C, parts, planes)
+    vals = [random_values(rng, len(p)).astype(np.complex64) for p in parts]
+    np.testing.assert_array_equal(np.asarray(pal.backward(vals)),
+                                  np.asarray(ref.backward(vals)))
+    got = pal.unshard_values(pal.apply_pointwise(vals,
+                                                 scaling=Scaling.FULL))
+    for g, v in zip(got, vals):
+        np.testing.assert_allclose(g, v, atol=1e-4, rtol=0)
+
+
+def test_pallas_matches_xla_r2c():
+    rng = np.random.default_rng(52)
+    space = rng.uniform(-1, 1, (DIMS[2], DIMS[1], DIMS[0]))
+    freq = dense_forward(space.astype(np.complex128))
+    triplets = hermitian_triplets(rng, DIMS)
+    parts = [sort_triplets_stick_major(p, DIMS)
+             for p in split_by_sticks(triplets, DIMS, [1, 2, 1, 1])]
+    planes = split_planes(DIMS[2], [2, 1, 1, 1])
+    ref, pal = _plans(TransformType.R2C, parts, planes)
+    vals = [sample_cube(freq, p, DIMS).astype(np.complex64) for p in parts]
+    a = np.asarray(ref.backward(vals))
+    b = np.asarray(pal.backward(vals))
+    np.testing.assert_allclose(b, a, atol=1e-5, rtol=0)
+    oracle = space * space.size
+    got = np.concatenate(pal.unshard_space(pal.backward(vals)), axis=0)
+    np.testing.assert_allclose(got, oracle, atol=1e-2, rtol=0)
+
+
+def test_pallas_with_ring_exchange():
+    rng = np.random.default_rng(53)
+    triplets = random_sparse_triplets(rng, DIMS)
+    parts = split_by_sticks(triplets, DIMS, [1, 1, 1, 1])
+    planes = split_planes(DIMS[2], [1, 1, 1, 1])
+    ref, pal = _plans(TransformType.C2C, parts, planes,
+                      exchange=ExchangeType.UNBUFFERED)
+    vals = [random_values(rng, len(p)).astype(np.complex64) for p in parts]
+    np.testing.assert_array_equal(np.asarray(pal.backward(vals)),
+                                  np.asarray(ref.backward(vals)))
+
+
+def test_pallas_auto_off_on_cpu_and_double_guard():
+    rng = np.random.default_rng(54)
+    triplets = random_sparse_triplets(rng, DIMS)
+    parts = split_by_sticks(triplets, DIMS, [1, 1, 1, 1])
+    planes = split_planes(DIMS[2], [1, 1, 1, 1])
+    # auto (None) on CPU: stays on the XLA path
+    plan = make_distributed_plan(TransformType.C2C, *DIMS, parts, planes,
+                                 mesh=make_mesh(4), precision="single")
+    assert plan._pallas_dist is None
+    # forcing the kernel on a double plan is an error, like the local plan
+    from spfft_tpu.errors import InvalidParameterError
+    with pytest.raises(InvalidParameterError):
+        make_distributed_plan(TransformType.C2C, *DIMS, parts, planes,
+                              mesh=make_mesh(4), precision="double",
+                              use_pallas=True)
