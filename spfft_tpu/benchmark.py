@@ -194,6 +194,8 @@ def main(argv=None) -> int:
         "transform_type": args.transform, "num_transforms": m,
         "sparsity": args.sparsity, "precision": args.precision,
         "num_values": int(len(triplets)),
+        "pallas": bool(getattr(plan, "_pallas_active", False)
+                       or getattr(plan, "_pallas_dist", None) is not None),
         "plan_seconds": round(plan_s, 4),
         "pair_seconds": round(pair_s, 6),
     }

@@ -140,6 +140,31 @@ def build_monotone_gather_tables(idx: np.ndarray, valid: np.ndarray,
         num_out=L, num_tiles=G, src_rows=src_rows, span_rows=K)
 
 
+def compression_gather_inputs(value_indices, num_slots: int,
+                              pad_values_to=None):
+    """The (idx, valid) pairs for both compression directions.
+
+    Decompress gathers slot <- value (idx increments <= 1: the running
+    count of occupied slots); compress gathers value <- slot (idx = the
+    flat value indices, optionally padded with monotone repeats of the
+    last index and valid=False — the padded-value layout of distributed
+    shards). Single source of truth for local plan._init_pallas and the
+    distributed per-shard tables.
+    """
+    vi = np.asarray(value_indices, np.int64)
+    n = len(vi)
+    occupied = np.zeros(num_slots, bool)
+    occupied[vi] = True
+    dec_idx = np.maximum(np.cumsum(occupied) - 1, 0)
+    out_n = n if pad_values_to is None else pad_values_to
+    cmp_idx = np.zeros(out_n, np.int64)
+    if n:
+        cmp_idx[:n] = vi
+        cmp_idx[n:] = vi[-1]
+    cmp_valid = np.arange(out_n) < n
+    return (dec_idx, occupied), (cmp_idx, cmp_valid)
+
+
 def pad_tables_to(t: "MonotoneGatherTables", c_max: int):
     """Pad a table set to ``c_max`` chunks so shape-heterogeneous per-shard
     tables can be stacked into one SPMD-sharded array.

@@ -319,19 +319,9 @@ class DistributedTransformPlan:
             if p.num_values and (np.diff(vi64) <= 0).any():
                 return  # non-monotone shard: XLA gather path for all
 
-        def shard_inputs(p):
-            vi64 = p.value_indices.astype(np.int64)
-            occupied = np.zeros(num_slots, bool)
-            occupied[vi64] = True
-            dec_idx = np.maximum(np.cumsum(occupied) - 1, 0)
-            cmp_idx = np.zeros(mv, np.int64)
-            if p.num_values:
-                cmp_idx[:p.num_values] = vi64
-                cmp_idx[p.num_values:] = vi64[-1]  # monotone padding
-            cmp_valid = np.arange(mv) < p.num_values
-            return (dec_idx, occupied), (cmp_idx, cmp_valid)
-
-        per_shard = [shard_inputs(p) for p in dp.shard_plans]
+        per_shard = [gk.compression_gather_inputs(
+            p.value_indices, num_slots, pad_values_to=mv)
+            for p in dp.shard_plans]
 
         def build_all(which, num_src, num_out):
             # two passes: discover each shard's preferred K, then rebuild
@@ -341,10 +331,11 @@ class DistributedTransformPlan:
             if any(t is None for t in tables):
                 return None
             k = max(t.span_rows for t in tables)
-            if any(t.span_rows != k for t in tables):
-                tables = [gk.build_monotone_gather_tables(
-                    idx, valid, num_src, k_rows=k)
-                    for (idx, valid) in (s[which] for s in per_shard)]
+            tables = [t if t.span_rows == k else
+                      gk.build_monotone_gather_tables(
+                          per_shard[r][which][0], per_shard[r][which][1],
+                          num_src, k_rows=k)
+                      for r, t in enumerate(tables)]
             c_max = max(t.row0.shape[0] for t in tables)
             src_rows = max(t.src_rows for t in tables)
             padded = [gk.pad_tables_to(t, c_max) for t in tables]

@@ -103,15 +103,10 @@ class TransformPlan:
                 or (np.diff(vi) <= 0).any():
             return
         num_slots = p.num_sticks * p.dim_z
-        occupied = np.zeros(num_slots, bool)
-        occupied[vi] = True
-        dec_idx = np.maximum(np.cumsum(occupied) - 1, 0)
-        # Decompress gathers slot <- value (increments <= 1); compress
-        # gathers value <- slot (gaps at near-empty sticks become extra
-        # accumulation chunks, see gather_kernel).
+        (dec_idx, occupied), (cmp_idx, cmp_valid) = \
+            gk.compression_gather_inputs(vi, num_slots)
         dec = gk.build_monotone_gather_tables(dec_idx, occupied, p.num_values)
-        cmp_ = gk.build_monotone_gather_tables(
-            vi, np.ones(p.num_values, bool), num_slots)
+        cmp_ = gk.build_monotone_gather_tables(cmp_idx, cmp_valid, num_slots)
         self._pallas = {"dec": dec, "cmp": cmp_}
         if dec is None and cmp_ is None:
             self._pallas = None

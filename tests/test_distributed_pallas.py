@@ -68,6 +68,35 @@ def test_pallas_matches_xla_r2c():
     np.testing.assert_allclose(got, oracle, atol=1e-2, rtol=0)
 
 
+def test_pallas_apply_pointwise_with_fn_args():
+    """fn/fn_args on the Pallas path: the *rest split in _pair_body must
+    hand the 8 ptables to the bodies and the trailing args to fn."""
+    import jax
+    rng = np.random.default_rng(55)
+    triplets = random_sparse_triplets(rng, DIMS)
+    parts = split_by_sticks(triplets, DIMS, [1, 2, 0, 1])
+    planes = split_planes(DIMS[2], [1, 1, 1, 1])
+    ref, pal = _plans(TransformType.C2C, parts, planes)
+    vals = [random_values(rng, len(p)).astype(np.complex64) for p in parts]
+
+    def scale_field(space, field):
+        return space * field[..., None]
+
+    dp = pal.dist_plan
+    field = np.full((dp.num_shards, dp.max_planes, DIMS[1], DIMS[0]), 2.0,
+                    np.float32)
+    field_ref = jax.device_put(field, ref._sharded)
+    field_pal = jax.device_put(field, pal._sharded)
+    a = np.asarray(ref.apply_pointwise(vals, scale_field, field_ref,
+                                       scaling=Scaling.FULL))
+    b = np.asarray(pal.apply_pointwise(vals, scale_field, field_pal,
+                                       scaling=Scaling.FULL))
+    np.testing.assert_allclose(b, a, atol=1e-5, rtol=0)
+    got = pal.unshard_values(b)
+    for g, v in zip(got, vals):
+        np.testing.assert_allclose(g, 2.0 * v, atol=1e-4, rtol=0)
+
+
 def test_pallas_with_ring_exchange():
     rng = np.random.default_rng(53)
     triplets = random_sparse_triplets(rng, DIMS)
