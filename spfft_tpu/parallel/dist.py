@@ -421,6 +421,15 @@ class DistributedTransformPlan:
             values = values * jnp.asarray(scale, self._rdt)
         return values[None]
 
+    def _pair_shmap(self, n_fn_args: int):
+        """shard_map wrapper for the fused-pair entry points: base specs
+        plus one sharded spec per fn_arg."""
+        return functools.partial(
+            jax.shard_map, mesh=self.mesh,
+            in_specs=self._base_in_specs
+            + (P(self.axis_name),) * n_fn_args,
+            out_specs=P(self.axis_name), check_vma=self._check_vma)
+
     def _pair_body(self, values_il, vi, slot_src, onehot, cols_flat,
                    col_inv, zmap, z_src, *rest, scaled: bool, fn):
         ptables, fn_args = rest[:self._n_ptables], rest[self._n_ptables:]
@@ -459,15 +468,44 @@ class DistributedTransformPlan:
         key = (fn, scaling, len(fn_args))
         jitted = self._pair_jits.get(key)
         if jitted is None:
-            shmap = functools.partial(
-                jax.shard_map, mesh=self.mesh,
-                in_specs=self._base_in_specs
-                + (P(self.axis_name),) * len(fn_args),
-                out_specs=P(self.axis_name), check_vma=self._check_vma)
-            jitted = jax.jit(shmap(functools.partial(
-                self._pair_body, scaled=(scaling is Scaling.FULL), fn=fn)))
+            jitted = jax.jit(self._pair_shmap(len(fn_args))(
+                functools.partial(self._pair_body,
+                                  scaled=(scaling is Scaling.FULL), fn=fn)))
             self._pair_jits[key] = jitted
         with timed_transform("apply_pointwise") as box:
+            box.value = jitted(values, *self._device_tables, *fn_args)
+        return box.value
+
+    def iterate_pointwise(self, values, fn, *fn_args, steps: int,
+                          scaling: Scaling = Scaling.FULL):
+        """``steps`` fused distributed round trips as ONE SPMD executable
+        (``lax.scan`` inside shard_map — 2·steps collectives in a single
+        program, one dispatch). Semantics as :meth:`apply_pointwise`;
+        ``scaling`` defaults to FULL so the iteration is a fixed-point map.
+        Returns the final padded sharded values array."""
+        scaling = Scaling(scaling)
+        if not isinstance(values, jax.Array):
+            values = self.shard_values(values)
+        # scan carry dtype must match the step output (_rdt)
+        values = values.astype(self._rdt)
+        key = (fn, scaling, int(steps), "scan", len(fn_args))
+        jitted = self._pair_jits.get(key)
+        if jitted is None:
+            scaled = scaling is Scaling.FULL
+
+            def run_body(values_il, vi, slot_src, onehot, cols_flat,
+                         col_inv, zmap, z_src, *rest):
+                def step(v, _):
+                    return self._pair_body(
+                        v, vi, slot_src, onehot, cols_flat, col_inv, zmap,
+                        z_src, *rest, scaled=scaled, fn=fn), None
+                out, _ = jax.lax.scan(step, values_il, None,
+                                      length=int(steps))
+                return out
+
+            jitted = jax.jit(self._pair_shmap(len(fn_args))(run_body))
+            self._pair_jits[key] = jitted
+        with timed_transform("iterate_pointwise") as box:
             box.value = jitted(values, *self._device_tables, *fn_args)
         return box.value
 

@@ -325,6 +325,40 @@ class TransformPlan:
             box.value = jitted(values_il, self._tables, *fn_args)
         return box.value
 
+    def iterate_pointwise(self, values, fn, *fn_args, steps: int,
+                          scaling: Scaling = Scaling.FULL):
+        """Run ``steps`` fused round trips values → backward → fn(space) →
+        forward → values as ONE executable (``lax.scan`` over the pair), so
+        an N-step iterative solver costs a single dispatch.
+
+        ``fn(space, *fn_args)`` as in :meth:`apply_pointwise`; ``fn_args``
+        are loop-invariant traced arguments. ``scaling`` defaults to FULL
+        so the iteration is a fixed-point map (NONE would multiply by the
+        grid size every step). Returns the final (num_values, 2) values.
+        Cached per ``(fn, scaling, steps)``; pass a stable callable."""
+        scaling = Scaling(scaling)
+        # the scan carry dtype must match the step output (_rdt); coerce
+        # up-front rather than per step
+        values_il = self._coerce_values(values).astype(self._rdt)
+        key = (fn, scaling, int(steps), "scan")
+        jitted = self._pair_jits.get(key)
+        if jitted is None:
+            scaled = scaling is Scaling.FULL
+
+            def run(values_il, tables, *fn_args):
+                def step(v, _):
+                    return self._pair_impl(v, tables, *fn_args,
+                                           scaled=scaled, fn=fn), None
+                out, _ = jax.lax.scan(step, values_il, None,
+                                      length=int(steps))
+                return out
+
+            jitted = jax.jit(run)
+            self._pair_jits[key] = jitted
+        with timed_transform("iterate_pointwise") as box:
+            box.value = jitted(values_il, self._tables, *fn_args)
+        return box.value
+
     # -- public execution (reference: transform.hpp:198-211) -----------------
     def backward(self, values):
         """Frequency -> space. ``values`` is (num_values,) complex (or

@@ -155,3 +155,22 @@ def test_multi_transform_distinct_plans_still_works():
     np.testing.assert_allclose(np.asarray(outs[1]),
                                np.asarray(plan_b.backward(vals[1])),
                                atol=1e-12, rtol=0)
+
+
+def test_iterate_pointwise_matches_sequential():
+    """N scanned steps == N sequential apply_pointwise calls."""
+    rng = np.random.default_rng(15)
+    plan, vals = _c2c_plan_and_values(1, rng)
+    v = vals[0]
+
+    def damp(space, factor):
+        return space * factor
+
+    out = np.asarray(plan.iterate_pointwise(v, damp, 0.5, steps=3))
+    seq = v
+    for _ in range(3):
+        seq_il = np.asarray(plan.apply_pointwise(seq, damp, 0.5,
+                                                 scaling=Scaling.FULL))
+        seq = seq_il[:, 0] + 1j * seq_il[:, 1]
+    np.testing.assert_allclose(out[:, 0] + 1j * out[:, 1], seq,
+                               atol=1e-10, rtol=0)

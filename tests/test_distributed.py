@@ -400,3 +400,26 @@ def test_distributed_r2c_double_and_single(precision):
         expected = sample_cube(freq, part, dims)
         np.testing.assert_allclose(got_parts[r], expected, atol=tol,
                                    rtol=0)
+
+
+def test_distributed_iterate_pointwise():
+    """Scanned distributed steps == sequential apply_pointwise calls."""
+    dims = (8, 8, 8)
+    rng = np.random.default_rng(25)
+    triplets = random_sparse_triplets(rng, dims)
+    parts = split_by_sticks(triplets, dims, [1, 1, 1, 1])
+    planes = split_planes(dims[2], [1, 1, 1, 1])
+    plan = make_distributed_plan(TransformType.C2C, *dims, parts, planes,
+                                 mesh=make_mesh(4), precision="double")
+    values = [random_values(rng, len(p)) for p in parts]
+
+    def damp(space):
+        return 0.5 * space
+
+    out = plan.unshard_values(plan.iterate_pointwise(values, damp, steps=3))
+    seq = values
+    for _ in range(3):
+        seq = plan.unshard_values(plan.apply_pointwise(
+            seq, damp, scaling=Scaling.FULL))
+    for g, s in zip(out, seq):
+        np.testing.assert_allclose(g, s, atol=1e-10, rtol=0)
