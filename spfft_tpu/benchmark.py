@@ -64,6 +64,9 @@ def _parse_args(argv):
                         "resident (reference -p cpu|gpu|gpu-gpu)")
     p.add_argument("-m", "--num-transforms", type=int, default=1)
     p.add_argument("-o", "--output", default=None, metavar="FILE.json")
+    p.add_argument("--fused-pair", action="store_true",
+                   help="time backward+forward as ONE fused executable "
+                        "(apply_pointwise identity; requires -m 1)")
     p.add_argument("--shards", type=int, default=1,
                    help="distribute over an N-device mesh (default local)")
     p.add_argument("--cpu", action="store_true",
@@ -71,7 +74,10 @@ def _parse_args(argv):
                         "(multi-chip simulation, like the test conftest)")
     p.add_argument("--precision", choices=["single", "double"],
                    default="single")
-    return p.parse_args(argv)
+    args = p.parse_args(argv)
+    if args.fused_pair and args.num_transforms != 1:
+        p.error("--fused-pair requires -m 1")
+    return args
 
 
 _EXCHANGE = {
@@ -144,11 +150,17 @@ def main(argv=None) -> int:
     transforms = [Transform(plan) for _ in range(args.num_transforms)]
     m = args.num_transforms
 
-    def run_pair(vals):
-        spaces = multi_transform_backward(transforms, [vals] * m)
-        outs = multi_transform_forward(transforms, spaces,
-                                       [Scaling.NONE] * m)
-        return outs
+    if args.fused_pair:
+        def run_pair(vals):
+            # one executable for backward+forward (apply_pointwise with
+            # the identity operator) — the layout bench.py measures
+            return plan.apply_pointwise(vals)
+    else:
+        def run_pair(vals):
+            spaces = multi_transform_backward(transforms, [vals] * m)
+            outs = multi_transform_forward(transforms, spaces,
+                                           [Scaling.NONE] * m)
+            return outs
 
     def sync(arrs):
         jax.block_until_ready(arrs)
@@ -192,6 +204,7 @@ def main(argv=None) -> int:
         "dim_x": nx, "dim_y": ny, "dim_z": nz,
         "exchange": args.exchange, "repeats": args.repeats,
         "transform_type": args.transform, "num_transforms": m,
+        "fused_pair": bool(args.fused_pair),
         "sparsity": args.sparsity, "precision": args.precision,
         "num_values": int(len(triplets)),
         "pallas": bool(getattr(plan, "_pallas_active", False)

@@ -251,6 +251,25 @@ class Transform:
         return self._plan.dist_plan.num_shards if self.distributed else 1
 
     @property
+    def device_id(self) -> int:
+        """For distributed plans, the ordinal of the mesh's first device;
+        for local plans, the default device (a local executable follows
+        its input's placement, so this is where it runs unless the caller
+        device_put its data elsewhere). Reference transform.hpp:157
+        returns the GPU device id."""
+        if self.distributed:
+            return int(self._plan.mesh.devices.flat[0].id)
+        import jax
+        return int(jax.devices()[0].id)
+
+    @property
+    def num_threads(self) -> int:
+        """Intra-op parallelism is XLA's; reported as the device count the
+        plan spans (reference transform.hpp:164 returns the OpenMP thread
+        count — the per-rank compute-lane analogue)."""
+        return self.num_shards
+
+    @property
     def global_size(self) -> int:
         return self._plan.global_size
 

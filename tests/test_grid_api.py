@@ -162,6 +162,9 @@ def test_transform_property_getters():
     assert dist.precision == "double"
     assert dist.exchange_type == ExchangeType.UNBUFFERED
     assert dist.num_shards == 4
+    assert isinstance(dist.device_id, int)
+    assert dist.num_threads == 4
+    assert local.num_threads == 1
 
 
 def test_space_domain_data_location():
