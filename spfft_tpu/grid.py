@@ -260,7 +260,9 @@ class Transform:
         if self.distributed:
             return int(self._plan.mesh.devices.flat[0].id)
         import jax
-        return int(jax.devices()[0].id)
+        default = jax.config.jax_default_device
+        return int(default.id) if default is not None \
+            else int(jax.devices()[0].id)
 
     @property
     def num_threads(self) -> int:

@@ -95,7 +95,11 @@ class TransformPlan:
         if use_pallas is True and self.precision != "single":
             raise InvalidParameterError(
                 "the Pallas compression kernel is single-precision only")
-        auto = backend_ok and self.precision == "single"
+        # Auto threshold: below ~half a million values the XLA gather wins
+        # (64^3 sphere ~137k values: 5.0 ms XLA vs 7.5 ms Pallas pair;
+        # 128^3 ~1.1M: 21 vs 11 ms — scripts/sweep.py on TPU v5e).
+        auto = backend_ok and self.precision == "single" \
+            and self.index_plan.num_values >= 500_000
         if use_pallas is False or (use_pallas is None and not auto):
             return
         vi = p.value_indices.astype(np.int64)
