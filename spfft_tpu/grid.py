@@ -261,8 +261,11 @@ class Transform:
             return int(self._plan.mesh.devices.flat[0].id)
         import jax
         default = jax.config.jax_default_device
-        return int(default.id) if default is not None \
-            else int(jax.devices()[0].id)
+        if default is None:
+            return int(jax.devices()[0].id)
+        if isinstance(default, str):  # platform name, e.g. "cpu"
+            return int(jax.devices(default)[0].id)
+        return int(default.id)
 
     @property
     def num_threads(self) -> int:

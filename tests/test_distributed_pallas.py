@@ -124,3 +124,32 @@ def test_pallas_auto_off_on_cpu_and_double_guard():
         make_distributed_plan(TransformType.C2C, *DIMS, parts, planes,
                               mesh=make_mesh(4), precision="double",
                               use_pallas=True)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_pallas_random_config_property(seed):
+    """Random dims/distributions through the padded-table construction:
+    the kernel path must agree with the XLA path bit-for-bit."""
+    rng = np.random.default_rng(3000 + seed)
+    dims = tuple(int(d) for d in rng.integers(4, 18, 3))
+    shards = int(rng.integers(2, 5))
+    triplets = random_sparse_triplets(rng, dims)
+    if len(triplets) == 0:
+        pytest.skip("degenerate empty set")
+    parts = [sort_triplets_stick_major(p, dims) for p in
+             split_by_sticks(triplets, dims, rng.integers(0, 3, shards) + 1)]
+    planes = split_planes(dims[2], rng.integers(0, 3, shards) + 1)
+
+    def mk(up):
+        return make_distributed_plan(
+            TransformType.C2C, *dims, parts, planes,
+            mesh=make_mesh(shards), precision="single", use_pallas=up)
+    ref, pal = mk(False), mk(True)
+    if pal._pallas_dist is None:
+        pytest.skip("tables not buildable for this config")
+    vals = [random_values(rng, len(p)).astype(np.complex64) for p in parts]
+    np.testing.assert_array_equal(np.asarray(pal.backward(vals)),
+                                  np.asarray(ref.backward(vals)))
+    np.testing.assert_array_equal(
+        np.asarray(pal.forward(pal.backward(vals))),
+        np.asarray(ref.forward(ref.backward(vals))))
