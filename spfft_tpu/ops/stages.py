@@ -172,10 +172,10 @@ def xy_backward_c2c(grid):
     """Unnormalised inverse DFT over (y, x) per plane:
     ``ifft2 * (dim_y * dim_x)``.
 
-    The reference transforms y over only the non-empty x-rows then x over full
-    planes (execution_host.cpp:139-145, 328-352); on TPU a dense batched 2D
-    FFT is one XLA Fft HLO and the row-sparsity bookkeeping would serialise
-    it, so density is the faster choice here.
+    The dense path: one XLA Fft HLO, used when the occupied x columns span
+    most of the extent. Narrow-x sets use the split variants below, which
+    implement the reference's y-over-non-empty-rows optimization
+    (execution_host.cpp:139-145, 328-352).
     """
     dim_y, dim_x = grid.shape[-2], grid.shape[-1]
     scale = grid.real.dtype.type(dim_y * dim_x)
@@ -185,6 +185,29 @@ def xy_backward_c2c(grid):
 def xy_forward_c2c(grid):
     """Forward DFT over (y, x) per plane."""
     return jnp.fft.fft2(grid, axes=(-2, -1))
+
+
+def xy_backward_c2c_split(sub, x0: int, dim_x: int):
+    """Backward xy-stage exploiting x-row sparsity (the reference's
+    "y transform over non-empty x-rows only", execution_host.cpp:139-145,
+    328-352): ``sub`` holds only the occupied x columns ``[x0, x0+w)`` of
+    the plane grid, (planes, dim_y, w) complex. The y-IFFT runs on those w
+    columns (all other columns are zero, and ifft(0)=0), the result is
+    zero-padded back to full x extent, and the x-IFFT runs dense (the
+    space-domain output is dense). Returns (planes, dim_y, dim_x)."""
+    dim_y, w = sub.shape[-2], sub.shape[-1]
+    scale = sub.real.dtype.type(dim_y * dim_x)
+    sub = jnp.fft.ifft(sub, axis=-2)
+    full = jnp.pad(sub, ((0, 0), (0, 0), (x0, dim_x - x0 - w)))
+    return jnp.fft.ifft(full, axis=-1) * scale
+
+
+def xy_forward_c2c_split(space, x0: int, w: int):
+    """Forward mirror of :func:`xy_backward_c2c_split`: dense x-DFT, then
+    the y-DFT only on the occupied x columns ``[x0, x0+w)`` — the only
+    columns the stick gather reads. Returns (planes, dim_y, w)."""
+    grid = jnp.fft.fft(space, axis=-1)
+    return jnp.fft.fft(grid[..., x0:x0 + w], axis=-2)
 
 
 def xy_backward_r2c(grid, dim_x: int):
@@ -240,3 +263,5 @@ xy_backward_c2c = _named(xy_backward_c2c, "xy_backward")
 xy_forward_c2c = _named(xy_forward_c2c, "xy_forward")
 xy_backward_r2c = _named(xy_backward_r2c, "xy_backward")
 xy_forward_r2c = _named(xy_forward_r2c, "xy_forward")
+xy_backward_c2c_split = _named(xy_backward_c2c_split, "xy_backward_split")
+xy_forward_c2c_split = _named(xy_forward_c2c_split, "xy_forward_split")

@@ -65,6 +65,7 @@ class TransformPlan:
             "scatter_cols": jnp.asarray(index_plan.scatter_cols),
         }
         self._init_pallas(use_pallas)
+        self._init_split_x()
         self._batched = None
         self._pair_jits = {}
         self._backward_jit = jax.jit(self._backward_impl)
@@ -123,6 +124,31 @@ class TransformPlan:
             self._tables[name + "_out_tile"] = jnp.asarray(t.out_tile)
             self._tables[name + "_first"] = jnp.asarray(t.first)
             self._tables[name + "_packed"] = jnp.asarray(t.packed)
+
+    def _init_split_x(self) -> None:
+        """Enable the sparse-x xy-stage when the occupied x columns span
+        under 70% of the x extent (the reference's "y transform over
+        non-empty x-rows only", execution_host.cpp:139-145): the y-FFT then
+        runs only on the occupied x range ``[x0, x1)`` instead of the full
+        plane. C2C only — the R2C x-stage already halves x, and its plane
+        symmetry needs the full x=0 plane."""
+        p = self.index_plan
+        self._split_x = None
+        if self._is_r2c or p.num_sticks == 0:
+            return
+        xf = p.dim_x_freq
+        xs = p.scatter_cols % xf
+        x0, x1 = int(xs.min()), int(xs.max()) + 1
+        w = x1 - x0
+        if w > 0.7 * xf:
+            return
+        ys = p.scatter_cols // xf
+        cols_sub = (ys * w + (xs - x0)).astype(np.int32)
+        from .indexing import inverse_col_map
+        col_inv_sub = inverse_col_map(cols_sub, p.dim_y * w, p.num_sticks)
+        self._split_x = (x0, w)
+        self._tables["col_inv_sub"] = jnp.asarray(col_inv_sub)
+        self._tables["scatter_cols_sub"] = jnp.asarray(cols_sub)
 
     # -- reference Transform getters (transform.hpp:91-151) -----------------
     @property
@@ -219,6 +245,12 @@ class TransformPlan:
             sticks = sticks.at[zid].set(
                 stages.complete_stick_hermitian(sticks[zid]))
         sticks = stages.z_backward(sticks)
+        if self._split_x is not None:
+            x0, w = self._split_x
+            sub = stages.sticks_to_grid(sticks, tables["col_inv_sub"],
+                                        p.dim_y, w)
+            return complex_to_interleaved(
+                stages.xy_backward_c2c_split(sub, x0, p.dim_x))
         grid = stages.sticks_to_grid(sticks, tables["col_inv"], p.dim_y,
                                      p.dim_x_freq)
         if self._is_r2c:
@@ -230,10 +262,17 @@ class TransformPlan:
         p = self.index_plan
         if self._is_r2c:
             grid = stages.xy_forward_r2c(space.astype(self._rdt))
+            sticks = stages.grid_to_sticks(grid, tables["scatter_cols"])
+        elif self._split_x is not None:
+            x0, w = self._split_x
+            grid = stages.xy_forward_c2c_split(
+                interleaved_to_complex(space).astype(self._cdt), x0, w)
+            sticks = stages.grid_to_sticks(grid,
+                                           tables["scatter_cols_sub"])
         else:
             grid = stages.xy_forward_c2c(
                 interleaved_to_complex(space).astype(self._cdt))
-        sticks = stages.grid_to_sticks(grid, tables["scatter_cols"])
+            sticks = stages.grid_to_sticks(grid, tables["scatter_cols"])
         sticks = stages.z_forward(sticks)
         scale = 1.0 / self.global_size if scaled else None
         return self._compress(sticks, tables, scale, pallas)

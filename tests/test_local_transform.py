@@ -145,3 +145,50 @@ def test_input_validation():
         plan.backward(np.zeros(5, np.complex128))
     with pytest.raises(InvalidParameterError):
         plan.forward(np.zeros((3, 3, 3), np.complex128))
+
+
+def test_split_x_path_vs_dense():
+    """Narrow-x sparse sets take the split xy path (reference: y-FFT over
+    non-empty x-rows only, execution_host.cpp:139-145); must agree with
+    the dense path and the oracle exactly."""
+    rng = np.random.default_rng(77)
+    dims = (32, 16, 12)
+    # sticks only at x in [3, 9): width 6 of 32 -> split active
+    xs = rng.integers(3, 9, 60)
+    ys = rng.integers(0, dims[1], 60)
+    zs = rng.integers(0, dims[2], 60)
+    triplets = np.unique(np.stack([xs, ys, zs], 1), axis=0)
+    values = random_values(rng, len(triplets))
+
+    plan = make_local_plan(TransformType.C2C, *dims, triplets,
+                           precision="double")
+    assert plan._split_x is not None and plan._split_x[0] == 3
+
+    cube = dense_cube_from_values(triplets, values, dims)
+    space_oracle = dense_backward(cube)
+    space = as_complex_np(np.asarray(plan.backward(values)))
+    np.testing.assert_allclose(space, space_oracle,
+                               atol=tolerance_for("double", space_oracle),
+                               rtol=0)
+    freq_oracle = dense_forward(space_oracle)
+    expected = sample_cube(freq_oracle, triplets, dims)
+    got = as_complex_np(np.asarray(plan.forward(space_oracle)))
+    np.testing.assert_allclose(got, expected,
+                               atol=tolerance_for("double", expected),
+                               rtol=0)
+
+
+def test_split_x_disabled_for_wide_and_centered_sets():
+    rng = np.random.default_rng(78)
+    dims = (16, 16, 16)
+    wide = random_sparse_triplets(rng, dims)  # spans most of x
+    plan = make_local_plan(TransformType.C2C, *dims, wide,
+                           precision="double")
+    assert plan._split_x is None
+    # centered sphere wraps x storage to both ends -> no contiguous range
+    sphere = center_triplets(
+        np.array([[x, 0, 0] for x in range(0, 3)]), dims)
+    sphere = np.concatenate([sphere, [[-2, 0, 1], [-1, 0, 1]]])
+    plan2 = make_local_plan(TransformType.C2C, *dims, sphere,
+                            precision="double")
+    assert plan2._split_x is None  # wrapped range spans the extent
