@@ -52,7 +52,8 @@ from ..types import ExchangeType, Scaling, TransformType
 from ..utils.dtypes import (as_interleaved, complex_dtype,
                             complex_to_interleaved, interleaved_to_complex,
                             real_dtype)
-from .exchange import (all_to_all_blocks, pack_freq_to_blocks,
+from .exchange import (all_to_all_blocks, build_compact_schedule,
+                       compact_exchange, pack_freq_to_blocks,
                        pack_space_to_blocks, ring_exchange_blocks,
                        unpack_blocks_to_grid, unpack_blocks_to_sticks)
 from .mesh import SHARD_AXIS, make_mesh
@@ -178,11 +179,17 @@ class DistributedTransformPlan:
         if self.exchange.float_wire:
             self._wire_dtype = (np.float32 if precision == "double"
                                 else jnp.bfloat16)
-        # UNBUFFERED selects the ppermute-ring mechanism; every other
-        # variant uses the single fused all_to_all (see exchange.py).
-        self._exchange_fn = (ring_exchange_blocks
-                             if self.exchange == ExchangeType.UNBUFFERED
-                             else all_to_all_blocks)
+        # UNBUFFERED selects the ppermute-ring mechanism; COMPACT_BUFFERED
+        # the exact-count schedule (no padded-block exchange at all); every
+        # other variant the single fused all_to_all (see exchange.py).
+        self._compact = (build_compact_schedule(dist_plan)
+                         if self.exchange.compact else None)
+        if self._compact is not None:
+            self._exchange_fn = None
+        elif self.exchange == ExchangeType.UNBUFFERED:
+            self._exchange_fn = ring_exchange_blocks
+        else:
+            self._exchange_fn = all_to_all_blocks
         self._build_tables()
         self._init_pallas(use_pallas)
         self._sharded = NamedSharding(self.mesh, P(self.axis_name))
@@ -203,12 +210,23 @@ class DistributedTransformPlan:
                 for a in self._pallas_dist["stacked"])
         self._n_ptables = (len(self._pallas_dist["stacked"])
                            if self._pallas_dist is not None else 0)
+        # Exact-count exchange tables (all sharded): per-hop pack tables +
+        # the unpack table, both directions.
+        self._n_ctables = 0
+        if self._compact is not None:
+            ctables = (list(self._compact.bwd_pack)
+                       + [self._compact.bwd_unpack]
+                       + list(self._compact.fwd_pack)
+                       + [self._compact.fwd_unpack])
+            self._n_ctables = len(ctables)
+            self._device_tables = self._device_tables + tuple(
+                jax.device_put(a, self._sharded) for a in ctables)
         self._base_in_specs = (
             (P(self.axis_name),                       # data
              P(self.axis_name), P(self.axis_name),    # vi, slot_src
              P(self.axis_name),                       # onehot
              P(), P(), P(), P())      # cols, col_inv, zmap, z_src
-            + (P(self.axis_name),) * self._n_ptables)
+            + (P(self.axis_name),) * (self._n_ptables + self._n_ctables))
         # pallas_call outputs carry no varying-mesh-axes metadata, so the
         # vma consistency check must be off when the kernel is in the body;
         # XLA-path plans keep the check (specs pin every sharding anyway)
@@ -366,9 +384,51 @@ class DistributedTransformPlan:
         return gk.interleaved_from_planar(out_re, out_im, t["num_out"])
 
     # -- SPMD bodies ---------------------------------------------------------
-    def _backward_body(self, values_il, vi, slot_src, onehot, cols_flat,
-                       col_inv, zmap, z_src, *ptables):
+    def _exchange_freq_to_grid(self, sticks, zmap, col_inv, ctables):
+        """z-sticks -> local plane grid across the mesh, via the selected
+        exchange mechanism."""
         dp = self.dist_plan
+        if self._compact is not None:
+            nb = len(self._compact.hop_sizes)
+            flat = sticks.reshape(-1)
+            bufs = [jnp.take(flat, t[0], mode="fill", fill_value=0)
+                    for t in ctables[:nb]]
+            recv = compact_exchange(bufs, self._compact.hops,
+                                    dp.num_shards, self.axis_name,
+                                    reverse=False,
+                                    wire_real_dtype=self._wire_dtype)
+            return jnp.take(recv, ctables[nb][0], mode="fill",
+                            fill_value=0).reshape(dp.max_planes, dp.dim_y,
+                                                  dp.dim_x_freq)
+        blocks = pack_freq_to_blocks(sticks, zmap)
+        blocks = self._exchange_fn(blocks, self.axis_name, self._wire_dtype)
+        return unpack_blocks_to_grid(blocks, col_inv, dp.dim_y,
+                                     dp.dim_x_freq)
+
+    def _exchange_grid_to_sticks(self, grid, cols_flat, z_src, ctables):
+        """Local plane grid -> z-sticks across the mesh (forward mirror)."""
+        dp = self.dist_plan
+        if self._compact is not None:
+            nb = len(self._compact.hop_sizes)
+            flat = grid.reshape(-1)
+            bufs = [jnp.take(flat, t[0], mode="fill", fill_value=0)
+                    for t in ctables[nb + 1:2 * nb + 1]]
+            recv = compact_exchange(bufs, self._compact.hops,
+                                    dp.num_shards, self.axis_name,
+                                    reverse=True,
+                                    wire_real_dtype=self._wire_dtype)
+            return jnp.take(recv, ctables[2 * nb + 1][0], mode="fill",
+                            fill_value=0).reshape(dp.max_sticks, dp.dim_z)
+        blocks = pack_space_to_blocks(grid, cols_flat, dp.num_shards,
+                                      dp.max_sticks)
+        blocks = self._exchange_fn(blocks, self.axis_name, self._wire_dtype)
+        return unpack_blocks_to_sticks(blocks, z_src)
+
+    def _backward_body(self, values_il, vi, slot_src, onehot, cols_flat,
+                       col_inv, zmap, z_src, *xtables):
+        dp = self.dist_plan
+        ptables = xtables[:self._n_ptables]
+        ctables = xtables[self._n_ptables:self._n_ptables + self._n_ctables]
         if self._pallas_dist is not None:
             dec_il = self._pallas_gather(values_il[0],
                                          self._pallas_dist["dec"],
@@ -386,27 +446,24 @@ class DistributedTransformPlan:
             oh = onehot[0][:, None].astype(self._rdt)
             sticks = sticks * (1 - oh) + completed * oh
         sticks = stages.z_backward(sticks)
-        blocks = pack_freq_to_blocks(sticks, zmap)
-        blocks = self._exchange_fn(blocks, self.axis_name, self._wire_dtype)
-        grid = unpack_blocks_to_grid(blocks, col_inv, dp.dim_y,
-                                     dp.dim_x_freq)
+        grid = self._exchange_freq_to_grid(sticks, zmap, col_inv, ctables)
         if dp.hermitian:
             grid = stages.complete_plane_hermitian(grid)
             return stages.xy_backward_r2c(grid, dp.dim_x)[None]
         return complex_to_interleaved(stages.xy_backward_c2c(grid))[None]
 
     def _forward_body(self, space, vi, slot_src, onehot, cols_flat, col_inv,
-                      zmap, z_src, *ptables, scaled: bool):
+                      zmap, z_src, *xtables, scaled: bool):
         dp = self.dist_plan
+        ptables = xtables[:self._n_ptables]
+        ctables = xtables[self._n_ptables:self._n_ptables + self._n_ctables]
         if dp.hermitian:
             grid = stages.xy_forward_r2c(space[0].astype(self._rdt))
         else:
             grid = stages.xy_forward_c2c(
                 interleaved_to_complex(space[0]).astype(self._cdt))
-        blocks = pack_space_to_blocks(grid, cols_flat, dp.num_shards,
-                                      dp.max_sticks)
-        blocks = self._exchange_fn(blocks, self.axis_name, self._wire_dtype)
-        sticks = unpack_blocks_to_sticks(blocks, z_src)
+        sticks = self._exchange_grid_to_sticks(grid, cols_flat, z_src,
+                                               ctables)
         sticks = stages.z_forward(sticks)
         scale = 1.0 / self.global_size if scaled else None
         # vi carries the sentinel max_sticks*dim_z for value padding
@@ -432,14 +489,15 @@ class DistributedTransformPlan:
 
     def _pair_body(self, values_il, vi, slot_src, onehot, cols_flat,
                    col_inv, zmap, z_src, *rest, scaled: bool, fn):
-        ptables, fn_args = rest[:self._n_ptables], rest[self._n_ptables:]
+        n_tab = self._n_ptables + self._n_ctables
+        xtables, fn_args = rest[:n_tab], rest[n_tab:]
         space = self._backward_body(values_il, vi, slot_src, onehot,
                                     cols_flat, col_inv, zmap, z_src,
-                                    *ptables)
+                                    *xtables)
         if fn is not None:
             space = fn(space, *fn_args)
         return self._forward_body(space, vi, slot_src, onehot, cols_flat,
-                                  col_inv, zmap, z_src, *ptables,
+                                  col_inv, zmap, z_src, *xtables,
                                   scaled=scaled)
 
     def apply_pointwise(self, values, fn=None, *fn_args,
@@ -545,6 +603,21 @@ class DistributedTransformPlan:
 
     def num_local_elements(self, shard: int) -> int:
         return self.dist_plan.shard_plans[shard].num_values
+
+    def exchange_wire_bytes(self) -> int:
+        """Model of per-shard off-shard bytes for ONE exchange under the
+        selected mechanism (the quantity the reference's Alltoallv layout
+        exists to minimise — transpose_mpi_compact_buffered_host.cpp:83-105).
+        Padded layouts ship ``(S-1) * max_sticks * max_planes`` complex
+        elements regardless of the distribution; the compact schedule ships
+        the per-hop exact maxima only."""
+        dp = self.dist_plan
+        elem = np.dtype(self._cdt).itemsize
+        if self._wire_dtype is not None:
+            elem = 2 * np.dtype(self._wire_dtype).itemsize
+        if self._compact is not None:
+            return self._compact.wire_elements() * elem
+        return (dp.num_shards - 1) * dp.max_sticks * dp.max_planes * elem
 
     # -- data movement helpers ----------------------------------------------
     def shard_values(self, values_per_shard: Sequence) -> jax.Array:

@@ -26,10 +26,12 @@ double transforms, f32 -> bf16 for single.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..ops import stages
 from ..utils.dtypes import complex_to_interleaved, interleaved_to_complex
@@ -141,6 +143,168 @@ def ring_exchange_blocks(blocks, axis_name: str,
     # out[s] must be shard s's block = received[(r - s) % S]; as a function
     # of s that is a reversal followed by a roll of r + 1.
     return jnp.roll(stacked[::-1], idx + 1, axis=0)
+
+
+@dataclasses.dataclass(frozen=True)
+class CompactSchedule:
+    """Plan-time schedule for the exact-count (ragged) exchange — the
+    Alltoallv analogue (reference:
+    src/transpose/transpose_mpi_compact_buffered_host.cpp:83-105 computes
+    per-rank counts/displacements at plan time; :183-200 runs the
+    MPI_Alltoallv).
+
+    XLA collectives are fixed-shape, so "ragged" becomes a *per-hop* static
+    schedule: hop ``k`` moves the (stick-owner ``j`` -> plane-owner
+    ``(j+k) % S``) blocks, whose exact element count
+    ``ns(j) * np((j+k) % S)`` is a plan-time constant; the hop buffer is
+    sized to the max over ``j`` only. Total off-shard wire elements are
+    ``sum_k L_k`` instead of the padded layout's
+    ``(S-1) * max_sticks * max_planes`` — on non-uniform distributions the
+    difference is the padding waste SURVEY.md §7.3 flags as the scaling
+    risk. The same hop widths serve both directions (the same
+    (stick-owner, plane-owner) pairs flow, reversed).
+
+    Pack/unpack are element gathers through plan-time index tables with
+    out-of-range sentinels (``jnp.take`` fill mode), sharded over the mesh
+    axis. Layout of hop ``k``'s flat buffer, sent by shard ``j`` to
+    ``d = (j+k) % S`` (backward; forward reverses the direction): element
+    ``i * np(d) + p`` is stick ``i``, plane ``p`` of shard ``d``'s slab.
+    """
+
+    num_shards: int
+    hops: tuple                      # kept hop distances k (zero-count hops
+                                     # are dropped at plan time; no dummy
+                                     # collectives on skewed distributions)
+    hop_sizes: tuple                 # L_k per kept hop
+    bwd_pack: tuple                  # per-hop (S, L_k) into flat sticks
+    bwd_unpack: np.ndarray           # (S, mp*Y*Xf) into concat recv buffer
+    fwd_pack: tuple                  # per-hop (S, L_k) into flat grid
+    fwd_unpack: np.ndarray           # (S, ms*dz) into concat recv buffer
+
+    @property
+    def total_recv(self) -> int:
+        return int(sum(self.hop_sizes))
+
+    def wire_elements(self) -> int:
+        """Off-shard complex elements per shard per exchange (hop 0 is
+        local)."""
+        return int(sum(sz for k, sz in zip(self.hops, self.hop_sizes)
+                       if k != 0))
+
+
+def build_compact_schedule(dp) -> CompactSchedule:
+    """Build the exact-count exchange schedule from a
+    ``DistributedIndexPlan`` (duck-typed to avoid a circular import)."""
+    S = dp.num_shards
+    ms, mp_ = dp.max_sticks, dp.max_planes
+    dz, Y, Xf = dp.dim_z, dp.dim_y, dp.dim_x_freq
+    ns = [p.num_sticks for p in dp.shard_plans]
+    npl = list(dp.num_planes)
+    off = list(dp.plane_offsets)
+    L_raw = [max(ns[j] * npl[(j + k) % S] for j in range(S))
+             for k in range(S)]
+    hops = [k for k in range(S) if L_raw[k] > 0]
+    if not hops:  # degenerate: no sticks anywhere — keep one dummy slot
+        hops, L_raw = [0], [1] + L_raw[1:]
+    L = [L_raw[k] for k in hops]
+    offs = np.concatenate([[0], np.cumsum(L)]).astype(np.int64)
+    total = int(offs[-1])
+    # recv-buffer offset of each hop distance (only kept hops referenced)
+    offs_by_k = np.zeros(S, np.int64)
+    offs_by_k[hops] = offs[:-1]
+
+    bwd_pack = []
+    for m, k in enumerate(hops):
+        tbl = np.full((S, L[m]), ms * dz, np.int32)  # sentinel: off-range
+        for j in range(S):
+            d = (j + k) % S
+            n = ns[j] * npl[d]
+            if n:
+                i = np.arange(ns[j])[:, None]
+                z = off[d] + np.arange(npl[d])[None, :]
+                tbl[j, :n] = (i * dz + z).reshape(-1)
+        bwd_pack.append(tbl)
+
+    # backward unpack: grid flat index p*Y*Xf + col -> recv position
+    bwd_unpack = np.full((S, mp_ * Y * Xf), total, np.int32)
+    for r in range(S):
+        if npl[r] == 0:
+            continue
+        for s in range(S):
+            if ns[s] == 0:
+                continue
+            k = (r - s) % S
+            cols = dp.shard_plans[s].scatter_cols.astype(np.int64)
+            i = np.arange(ns[s])[:, None]
+            p = np.arange(npl[r])[None, :]
+            pos = offs_by_k[k] + i * npl[r] + p
+            flat_idx = p * (Y * Xf) + cols[:, None]
+            bwd_unpack[r][flat_idx.reshape(-1)] = pos.reshape(-1)
+
+    # forward pack: shard j sends to d = (j-k) % S the block
+    # (ns(d), np(j)) gathered from its local grid
+    fwd_pack = []
+    for m, k in enumerate(hops):
+        tbl = np.full((S, L[m]), mp_ * Y * Xf, np.int32)
+        for j in range(S):
+            d = (j - k) % S
+            n = ns[d] * npl[j]
+            if n:
+                cols = dp.shard_plans[d].scatter_cols.astype(np.int64)
+                p = np.arange(npl[j])[None, :]
+                tbl[j, :n] = (p * (Y * Xf) + cols[:, None]).reshape(-1)
+        fwd_pack.append(tbl)
+
+    # forward unpack: stick flat index i*dz + z -> recv position
+    fwd_unpack = np.full((S, ms * dz), total, np.int32)
+    z_owner = np.empty(dz, np.int64)
+    z_plane = np.empty(dz, np.int64)
+    for s in range(S):
+        z_owner[off[s]:off[s] + npl[s]] = s
+        z_plane[off[s]:off[s] + npl[s]] = np.arange(npl[s])
+    for r in range(S):
+        if ns[r] == 0:
+            continue
+        k_z = (z_owner - r) % S
+        base = offs_by_k[k_z] + z_plane       # (dz,)
+        npl_z = np.asarray(npl)[z_owner]      # (dz,)
+        i = np.arange(ns[r])[:, None]
+        idx = base[None, :] + i * npl_z[None, :]
+        fwd_unpack[r, :ns[r] * dz] = idx.reshape(-1)
+
+    return CompactSchedule(num_shards=S, hops=tuple(hops),
+                           hop_sizes=tuple(L), bwd_pack=tuple(bwd_pack),
+                           bwd_unpack=bwd_unpack, fwd_pack=tuple(fwd_pack),
+                           fwd_unpack=fwd_unpack)
+
+
+def compact_exchange(bufs, hops, num_shards: int, axis_name: str,
+                     reverse: bool,
+                     wire_real_dtype: Optional[jnp.dtype] = None):
+    """Run the per-hop exact-size exchange: each kept hop distance ``k`` is
+    one ``ppermute`` of a ``(L_k,)`` complex buffer to the shard ``k`` hops
+    away (backward: ``j -> (j+k) % S``; forward ``reverse=True``:
+    ``j -> (j-k) % S``). Hop 0 is the shard's own block and never crosses
+    the wire. Returns the hop buffers concatenated in schedule order — the
+    layout the unpack tables of :class:`CompactSchedule` index into.
+    """
+    S = num_shards
+    out = []
+    for b, k in zip(bufs, hops):
+        if k == 0:
+            out.append(b)
+            continue
+        perm = [(j, (j - k) % S if reverse else (j + k) % S)
+                for j in range(S)]
+        if wire_real_dtype is not None:
+            rdt = b.real.dtype
+            il = complex_to_interleaved(b).astype(wire_real_dtype)
+            il = jax.lax.ppermute(il, axis_name, perm)
+            b = interleaved_to_complex(il.astype(rdt))
+        else:
+            b = jax.lax.ppermute(b, axis_name, perm)
+        out.append(b)
+    return jnp.concatenate(out) if len(out) > 1 else out[0]
 
 
 def all_to_all_blocks(blocks, axis_name: str,

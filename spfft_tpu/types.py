@@ -26,17 +26,22 @@ import enum
 class ExchangeType(enum.Enum):
     """Distributed exchange algorithm selector (reference: types.h:33-62).
 
-    Two mechanisms exist on TPU, both on the padded
-    ``(shards, max_sticks, max_planes)`` block layout:
+    Three mechanically distinct exchanges exist on TPU, mirroring the
+    reference's Alltoall / Alltoallv / Alltoallw trio:
 
-    * DEFAULT / BUFFERED / COMPACT_BUFFERED — one fused ``lax.all_to_all``
-      over the mesh axis (the natural fit for XLA's fixed-shape
-      collectives; the reference's Alltoallv/Alltoallw layouts exist to
-      avoid padding bytes on the MPI wire, so BUFFERED and COMPACT_BUFFERED
-      collapse to the same padded collective here).
-    * UNBUFFERED — S-1 single-hop ``ppermute`` ring steps
-      (exchange.ring_exchange_blocks), a mechanically different exchange
-      that XLA can software-pipeline with surrounding compute.
+    * DEFAULT / BUFFERED — one fused ``lax.all_to_all`` on the padded
+      ``(shards, max_sticks, max_planes)`` block layout (the natural fit
+      for XLA's fixed-shape collectives — reference BUFFERED,
+      types.h:40-46).
+    * COMPACT_BUFFERED — the exact-count schedule
+      (exchange.CompactSchedule): per-hop exact-size ``ppermute`` buffers
+      sized from the plan-time per-pair stick×plane counts, so padding
+      bytes stay off the wire on non-uniform distributions (reference
+      COMPACT_BUFFERED / MPI_Alltoallv,
+      transpose_mpi_compact_buffered_host.cpp:183-200).
+    * UNBUFFERED — S-1 single-hop ``ppermute`` ring steps on the padded
+      block layout (exchange.ring_exchange_blocks), pipelinable with
+      surrounding compute (reference UNBUFFERED / MPI_Alltoallw).
 
     The ``*_FLOAT`` variants additionally reduce the on-wire precision
     around the exchange, halving ICI bytes exactly as the reference halves
@@ -54,6 +59,12 @@ class ExchangeType(enum.Enum):
     def float_wire(self) -> bool:
         """True if the on-wire precision is reduced (reference: types.h:43-57)."""
         return self in (ExchangeType.BUFFERED_FLOAT,
+                        ExchangeType.COMPACT_BUFFERED_FLOAT)
+
+    @property
+    def compact(self) -> bool:
+        """True if the exact-count (ragged) schedule is selected."""
+        return self in (ExchangeType.COMPACT_BUFFERED,
                         ExchangeType.COMPACT_BUFFERED_FLOAT)
 
 
