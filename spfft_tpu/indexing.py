@@ -237,6 +237,46 @@ def inverse_col_map(scatter_cols: np.ndarray, num_cols: int,
     return col_inv
 
 
+def occupied_x_window(xs: np.ndarray, dim_x_freq: int,
+                      allow_wrap: bool) -> tuple:
+    """Minimal window ``[x0, x0 + w)`` (cyclic when ``allow_wrap``) covering
+    the occupied storage-x columns — the analogue of the reference's
+    unique-x-index collection that drives its y-FFT-over-non-empty-rows
+    optimization (reference: execution_host.cpp:139-145; centered sets wrap
+    x, so the minimal cover is cyclic, not linear).
+
+    Returns ``(x0, w)`` with ``0 <= x0 < dim_x_freq`` and
+    ``1 <= w <= dim_x_freq``; column ``x`` maps to sub-column
+    ``(x - x0) % dim_x_freq`` (< w).
+    """
+    u = np.unique(np.asarray(xs, np.int64))
+    if u.size == 0:
+        return 0, 1
+    if u.size == dim_x_freq:
+        return 0, dim_x_freq
+    if not allow_wrap:
+        return int(u[0]), int(u[-1] - u[0] + 1)
+    # Largest cyclic gap between consecutive occupied columns: the window
+    # is its complement.
+    gaps = np.diff(np.concatenate([u, [u[0] + dim_x_freq]]))
+    g = int(np.argmax(gaps))
+    x0 = int(u[(g + 1) % u.size])
+    w = dim_x_freq - int(gaps[g]) + 1
+    return x0, w
+
+
+def window_sub_cols(cols: np.ndarray, dim_x_freq: int, x0: int,
+                    w: int) -> np.ndarray:
+    """Map full-plane columns ``y * dim_x_freq + x`` to occupied-window
+    columns ``y * w + (x - x0) % dim_x_freq`` (see
+    :func:`occupied_x_window`). Every split-x consumer (local plan,
+    distributed tables, compact-exchange schedule) MUST use this one
+    mapping so grid layout and exchange tables cannot desynchronise."""
+    cols = np.asarray(cols, np.int64)
+    return ((cols // dim_x_freq) * w
+            + (cols % dim_x_freq - x0) % dim_x_freq).astype(np.int32)
+
+
 def build_index_plan(transform_type: TransformType,
                      dim_x: int, dim_y: int, dim_z: int,
                      triplets: np.ndarray) -> IndexPlan:

@@ -187,26 +187,72 @@ def xy_forward_c2c(grid):
     return jnp.fft.fft2(grid, axes=(-2, -1))
 
 
+def _expand_x_window(sub, x0: int, dim_x: int):
+    """Zero-pad the occupied-x window ``[x0, x0+w) mod dim_x`` back to the
+    full x extent. Centered frequency sets occupy a *wrapped* window
+    (negative indices store high), so the window may straddle the x
+    boundary — then the pad lands at the front and the columns roll into
+    place."""
+    w = sub.shape[-1]
+    pad = [(0, 0)] * (sub.ndim - 1)
+    if x0 + w <= dim_x:
+        return jnp.pad(sub, pad + [(x0, dim_x - x0 - w)])
+    return jnp.roll(jnp.pad(sub, pad + [(0, dim_x - w)]), x0, axis=-1)
+
+
+def _extract_x_window(grid, x0: int, w: int):
+    """Take the occupied-x window ``[x0, x0+w) mod dim_x`` out of a full
+    grid (mirror of :func:`_expand_x_window`)."""
+    dim_x = grid.shape[-1]
+    if x0 + w <= dim_x:
+        return grid[..., x0:x0 + w]
+    return jnp.concatenate([grid[..., x0:], grid[..., :x0 + w - dim_x]],
+                           axis=-1)
+
+
 def xy_backward_c2c_split(sub, x0: int, dim_x: int):
     """Backward xy-stage exploiting x-row sparsity (the reference's
     "y transform over non-empty x-rows only", execution_host.cpp:139-145,
-    328-352): ``sub`` holds only the occupied x columns ``[x0, x0+w)`` of
-    the plane grid, (planes, dim_y, w) complex. The y-IFFT runs on those w
-    columns (all other columns are zero, and ifft(0)=0), the result is
-    zero-padded back to full x extent, and the x-IFFT runs dense (the
-    space-domain output is dense). Returns (planes, dim_y, dim_x)."""
-    dim_y, w = sub.shape[-2], sub.shape[-1]
+    328-352): ``sub`` holds only the occupied x columns
+    ``[x0, x0+w) mod dim_x`` of the plane grid, (planes, dim_y, w) complex
+    — possibly a wrapped window (centered sets). The y-IFFT runs only on
+    those w columns (all other columns are zero, and ifft(0)=0), the
+    result is zero-expanded back to full x extent, and the x-IFFT runs
+    dense (the space-domain output is dense). Returns
+    (planes, dim_y, dim_x)."""
+    dim_y = sub.shape[-2]
     scale = sub.real.dtype.type(dim_y * dim_x)
     sub = jnp.fft.ifft(sub, axis=-2)
-    full = jnp.pad(sub, ((0, 0), (0, 0), (x0, dim_x - x0 - w)))
-    return jnp.fft.ifft(full, axis=-1) * scale
+    return jnp.fft.ifft(_expand_x_window(sub, x0, dim_x), axis=-1) * scale
 
 
 def xy_forward_c2c_split(space, x0: int, w: int):
     """Forward mirror of :func:`xy_backward_c2c_split`: dense x-DFT, then
-    the y-DFT only on the occupied x columns ``[x0, x0+w)`` — the only
-    columns the stick gather reads. Returns (planes, dim_y, w)."""
+    the y-DFT only on the occupied x columns ``[x0, x0+w) mod dim_x`` —
+    the only columns the stick gather reads. Returns (planes, dim_y, w)."""
     grid = jnp.fft.fft(space, axis=-1)
+    return jnp.fft.fft(_extract_x_window(grid, x0, w), axis=-2)
+
+
+def xy_backward_r2c_split(sub, x0: int, dim_x: int, dim_x_freq: int):
+    """R2C backward xy-stage on the occupied half-spectrum window
+    ``[x0, x0+w)`` (no wrap — the half spectrum has no negative x): y-IFFT
+    on the w occupied columns, zero-pad to the full half extent, then the
+    dense c2r x-IFFT. ``sub`` is (planes, dim_y, w) complex; returns real
+    (planes, dim_y, dim_x). Reference: the per-selected-row vertical plan,
+    transform_1d_host.hpp:137-196."""
+    dim_y, w = sub.shape[-2], sub.shape[-1]
+    rdtype = sub.real.dtype
+    sub = jnp.fft.ifft(sub, axis=-2) * rdtype.type(dim_y)
+    full = jnp.pad(sub, ((0, 0), (0, 0), (x0, dim_x_freq - x0 - w)))
+    return jnp.fft.irfft(full, n=dim_x, axis=-1) * rdtype.type(dim_x)
+
+
+def xy_forward_r2c_split(space, x0: int, w: int):
+    """Forward mirror of :func:`xy_backward_r2c_split`: dense r2c x-DFT,
+    then the y-DFT only on the occupied half-spectrum columns. ``space``
+    is real (planes, dim_y, dim_x); returns (planes, dim_y, w) complex."""
+    grid = jnp.fft.rfft(space, axis=-1)
     return jnp.fft.fft(grid[..., x0:x0 + w], axis=-2)
 
 
@@ -265,3 +311,5 @@ xy_backward_r2c = _named(xy_backward_r2c, "xy_backward")
 xy_forward_r2c = _named(xy_forward_r2c, "xy_forward")
 xy_backward_c2c_split = _named(xy_backward_c2c_split, "xy_backward_split")
 xy_forward_c2c_split = _named(xy_forward_c2c_split, "xy_forward_split")
+xy_backward_r2c_split = _named(xy_backward_r2c_split, "xy_backward_split")
+xy_forward_r2c_split = _named(xy_forward_r2c_split, "xy_forward_split")

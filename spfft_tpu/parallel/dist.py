@@ -45,7 +45,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..errors import InvalidParameterError, ParameterMismatchError
-from ..indexing import IndexPlan, build_index_plan, check_stick_duplicates
+from ..indexing import (IndexPlan, build_index_plan, check_stick_duplicates,
+                        occupied_x_window, window_sub_cols)
 from ..ops import stages
 from ..timing import timed_transform
 from ..types import ExchangeType, Scaling, TransformType
@@ -179,10 +180,12 @@ class DistributedTransformPlan:
         if self.exchange.float_wire:
             self._wire_dtype = (np.float32 if precision == "double"
                                 else jnp.bfloat16)
+        self._init_split_x()
         # UNBUFFERED selects the ppermute-ring mechanism; COMPACT_BUFFERED
         # the exact-count schedule (no padded-block exchange at all); every
         # other variant the single fused all_to_all (see exchange.py).
-        self._compact = (build_compact_schedule(dist_plan)
+        self._compact = (build_compact_schedule(dist_plan,
+                                                x_window=self._split_x)
                          if self.exchange.compact else None)
         if self._compact is not None:
             self._exchange_fn = None
@@ -243,6 +246,35 @@ class DistributedTransformPlan:
         }
 
     # -- static tables -------------------------------------------------------
+    def _init_split_x(self) -> None:
+        """Global sparse-x xy-stage (the distributed form of the reference's
+        y-over-non-empty-rows optimization, execution_host.cpp:139-145):
+        when the union of all shards' occupied x columns spans under 70% of
+        the x extent, every shard's plane grid — and both exchange unpack
+        layouts — shrink to the occupied window, and the y-FFT runs only on
+        it. Cyclic (wrapped) window for C2C centered sets; linear window of
+        the half spectrum for R2C."""
+        dp = self.dist_plan
+        self._split_x = None
+        self._xf_eff = dp.dim_x_freq
+        cols = [p.scatter_cols for p in dp.shard_plans if p.num_sticks]
+        if not cols:
+            return
+        xs = np.concatenate(cols) % dp.dim_x_freq
+        x0, w = occupied_x_window(xs, dp.dim_x_freq,
+                                  allow_wrap=not dp.hermitian)
+        if w > 0.7 * dp.dim_x_freq:
+            return
+        self._split_x = (x0, w)
+        self._xf_eff = w
+
+    def _sub_cols(self, cols: np.ndarray) -> np.ndarray:
+        """Map full-grid plane columns to occupied-window columns."""
+        if self._split_x is None:
+            return cols
+        x0, w = self._split_x
+        return window_sub_cols(cols, self.dist_plan.dim_x_freq, x0, w)
+
     def _build_tables(self) -> None:
         dp = self.dist_plan
         S, ms, mp_, mv = (dp.num_shards, dp.max_sticks, dp.max_planes,
@@ -264,16 +296,19 @@ class DistributedTransformPlan:
                 np.where(p.slot_src == p.num_values, mv, p.slot_src)
         # Every shard's scatter columns (replicated): the global stick table,
         # the analogue of the reference's plan-time stick-list exchange
-        # (indices.hpp:58-102 create_distributed_transform_indices).
-        pad_col = dp.dim_y * dp.dim_x_freq
+        # (indices.hpp:58-102 create_distributed_transform_indices). When
+        # the split-x window is active, columns index the occupied window
+        # (width _xf_eff), not the full plane.
+        pad_col = dp.dim_y * self._xf_eff
         cols = np.full((S, ms), pad_col, np.int32)
         for r, p in enumerate(dp.shard_plans):
-            cols[r, :p.num_sticks] = p.scatter_cols
+            cols[r, :p.num_sticks] = self._sub_cols(p.scatter_cols)
         # Global inverse column map (replicated): plane column -> global
         # padded stick index shard*ms + i, sentinel S*ms.
-        col_inv = np.full(dp.dim_y * dp.dim_x_freq, S * ms, np.int32)
+        col_inv = np.full(dp.dim_y * self._xf_eff, S * ms, np.int32)
         for r, p in enumerate(dp.shard_plans):
-            col_inv[p.scatter_cols] = r * ms + np.arange(p.num_sticks)
+            col_inv[self._sub_cols(p.scatter_cols)] = \
+                r * ms + np.arange(p.num_sticks)
         # z index owned by each shard's p-th plane (replicated), sentinel
         # dim_z for slab padding — drives the backward pack.
         zmap = np.full((S, mp_), dim_z, np.int32)
@@ -399,11 +434,11 @@ class DistributedTransformPlan:
                                     wire_real_dtype=self._wire_dtype)
             return jnp.take(recv, ctables[nb][0], mode="fill",
                             fill_value=0).reshape(dp.max_planes, dp.dim_y,
-                                                  dp.dim_x_freq)
+                                                  self._xf_eff)
         blocks = pack_freq_to_blocks(sticks, zmap)
         blocks = self._exchange_fn(blocks, self.axis_name, self._wire_dtype)
         return unpack_blocks_to_grid(blocks, col_inv, dp.dim_y,
-                                     dp.dim_x_freq)
+                                     self._xf_eff)
 
     def _exchange_grid_to_sticks(self, grid, cols_flat, z_src, ctables):
         """Local plane grid -> z-sticks across the mesh (forward mirror)."""
@@ -448,8 +483,18 @@ class DistributedTransformPlan:
         sticks = stages.z_backward(sticks)
         grid = self._exchange_freq_to_grid(sticks, zmap, col_inv, ctables)
         if dp.hermitian:
+            if self._split_x is not None:
+                x0, _ = self._split_x
+                if x0 == 0:
+                    grid = stages.complete_plane_hermitian(grid)
+                return stages.xy_backward_r2c_split(
+                    grid, x0, dp.dim_x, dp.dim_x_freq)[None]
             grid = stages.complete_plane_hermitian(grid)
             return stages.xy_backward_r2c(grid, dp.dim_x)[None]
+        if self._split_x is not None:
+            x0, _ = self._split_x
+            return complex_to_interleaved(
+                stages.xy_backward_c2c_split(grid, x0, dp.dim_x))[None]
         return complex_to_interleaved(stages.xy_backward_c2c(grid))[None]
 
     def _forward_body(self, space, vi, slot_src, onehot, cols_flat, col_inv,
@@ -458,7 +503,16 @@ class DistributedTransformPlan:
         ptables = xtables[:self._n_ptables]
         ctables = xtables[self._n_ptables:self._n_ptables + self._n_ctables]
         if dp.hermitian:
-            grid = stages.xy_forward_r2c(space[0].astype(self._rdt))
+            if self._split_x is not None:
+                x0, w = self._split_x
+                grid = stages.xy_forward_r2c_split(
+                    space[0].astype(self._rdt), x0, w)
+            else:
+                grid = stages.xy_forward_r2c(space[0].astype(self._rdt))
+        elif self._split_x is not None:
+            x0, w = self._split_x
+            grid = stages.xy_forward_c2c_split(
+                interleaved_to_complex(space[0]).astype(self._cdt), x0, w)
         else:
             grid = stages.xy_forward_c2c(
                 interleaved_to_complex(space[0]).astype(self._cdt))

@@ -192,12 +192,25 @@ class CompactSchedule:
                        if k != 0))
 
 
-def build_compact_schedule(dp) -> CompactSchedule:
+def build_compact_schedule(dp, x_window=None) -> CompactSchedule:
     """Build the exact-count exchange schedule from a
-    ``DistributedIndexPlan`` (duck-typed to avoid a circular import)."""
+    ``DistributedIndexPlan`` (duck-typed to avoid a circular import).
+
+    ``x_window=(x0, w)`` composes the schedule with the split-x grid: the
+    unpack/pack grid tables then index the occupied-x window (width ``w``)
+    instead of the full plane (see dist._init_split_x).
+    """
+    from ..indexing import window_sub_cols
+
     S = dp.num_shards
     ms, mp_ = dp.max_sticks, dp.max_planes
     dz, Y, Xf = dp.dim_z, dp.dim_y, dp.dim_x_freq
+    Xe = Xf if x_window is None else x_window[1]
+
+    def grid_cols(cols):
+        if x_window is None:
+            return np.asarray(cols, np.int64)
+        return window_sub_cols(cols, Xf, *x_window).astype(np.int64)
     ns = [p.num_sticks for p in dp.shard_plans]
     npl = list(dp.num_planes)
     off = list(dp.plane_offsets)
@@ -225,8 +238,8 @@ def build_compact_schedule(dp) -> CompactSchedule:
                 tbl[j, :n] = (i * dz + z).reshape(-1)
         bwd_pack.append(tbl)
 
-    # backward unpack: grid flat index p*Y*Xf + col -> recv position
-    bwd_unpack = np.full((S, mp_ * Y * Xf), total, np.int32)
+    # backward unpack: grid flat index p*Y*Xe + col -> recv position
+    bwd_unpack = np.full((S, mp_ * Y * Xe), total, np.int32)
     for r in range(S):
         if npl[r] == 0:
             continue
@@ -234,25 +247,25 @@ def build_compact_schedule(dp) -> CompactSchedule:
             if ns[s] == 0:
                 continue
             k = (r - s) % S
-            cols = dp.shard_plans[s].scatter_cols.astype(np.int64)
+            cols = grid_cols(dp.shard_plans[s].scatter_cols)
             i = np.arange(ns[s])[:, None]
             p = np.arange(npl[r])[None, :]
             pos = offs_by_k[k] + i * npl[r] + p
-            flat_idx = p * (Y * Xf) + cols[:, None]
+            flat_idx = p * (Y * Xe) + cols[:, None]
             bwd_unpack[r][flat_idx.reshape(-1)] = pos.reshape(-1)
 
     # forward pack: shard j sends to d = (j-k) % S the block
     # (ns(d), np(j)) gathered from its local grid
     fwd_pack = []
     for m, k in enumerate(hops):
-        tbl = np.full((S, L[m]), mp_ * Y * Xf, np.int32)
+        tbl = np.full((S, L[m]), mp_ * Y * Xe, np.int32)
         for j in range(S):
             d = (j - k) % S
             n = ns[d] * npl[j]
             if n:
-                cols = dp.shard_plans[d].scatter_cols.astype(np.int64)
+                cols = grid_cols(dp.shard_plans[d].scatter_cols)
                 p = np.arange(npl[j])[None, :]
-                tbl[j, :n] = (p * (Y * Xf) + cols[:, None]).reshape(-1)
+                tbl[j, :n] = (p * (Y * Xe) + cols[:, None]).reshape(-1)
         fwd_pack.append(tbl)
 
     # forward unpack: stick flat index i*dz + z -> recv position

@@ -129,22 +129,26 @@ class TransformPlan:
         """Enable the sparse-x xy-stage when the occupied x columns span
         under 70% of the x extent (the reference's "y transform over
         non-empty x-rows only", execution_host.cpp:139-145): the y-FFT then
-        runs only on the occupied x range ``[x0, x1)`` instead of the full
-        plane. C2C only — the R2C x-stage already halves x, and its plane
-        symmetry needs the full x=0 plane."""
+        runs only on the occupied x window instead of the full plane. For
+        C2C the window is *cyclic* — centered sets (negative x stored high)
+        occupy a wrapped window, the flagship plane-wave sphere on a
+        2x-cutoff grid included. For R2C it is a linear window of the half
+        spectrum; plane symmetry applies to the x=0 sub-column when the
+        window starts at 0 (when it doesn't, no x=0 stick exists and there
+        is nothing to complete)."""
+        from .indexing import (inverse_col_map, occupied_x_window,
+                               window_sub_cols)
+
         p = self.index_plan
         self._split_x = None
-        if self._is_r2c or p.num_sticks == 0:
+        if p.num_sticks == 0:
             return
         xf = p.dim_x_freq
         xs = p.scatter_cols % xf
-        x0, x1 = int(xs.min()), int(xs.max()) + 1
-        w = x1 - x0
+        x0, w = occupied_x_window(xs, xf, allow_wrap=not self._is_r2c)
         if w > 0.7 * xf:
             return
-        ys = p.scatter_cols // xf
-        cols_sub = (ys * w + (xs - x0)).astype(np.int32)
-        from .indexing import inverse_col_map
+        cols_sub = window_sub_cols(p.scatter_cols, xf, x0, w)
         col_inv_sub = inverse_col_map(cols_sub, p.dim_y * w, p.num_sticks)
         self._split_x = (x0, w)
         self._tables["col_inv_sub"] = jnp.asarray(col_inv_sub)
@@ -249,6 +253,11 @@ class TransformPlan:
             x0, w = self._split_x
             sub = stages.sticks_to_grid(sticks, tables["col_inv_sub"],
                                         p.dim_y, w)
+            if self._is_r2c:
+                if x0 == 0:
+                    sub = stages.complete_plane_hermitian(sub)
+                return stages.xy_backward_r2c_split(sub, x0, p.dim_x,
+                                                    p.dim_x_freq)
             return complex_to_interleaved(
                 stages.xy_backward_c2c_split(sub, x0, p.dim_x))
         grid = stages.sticks_to_grid(sticks, tables["col_inv"], p.dim_y,
@@ -261,8 +270,15 @@ class TransformPlan:
     def _forward_impl(self, space, tables, *, scaled: bool, pallas=True):
         p = self.index_plan
         if self._is_r2c:
-            grid = stages.xy_forward_r2c(space.astype(self._rdt))
-            sticks = stages.grid_to_sticks(grid, tables["scatter_cols"])
+            if self._split_x is not None:
+                x0, w = self._split_x
+                grid = stages.xy_forward_r2c_split(
+                    space.astype(self._rdt), x0, w)
+                sticks = stages.grid_to_sticks(grid,
+                                               tables["scatter_cols_sub"])
+            else:
+                grid = stages.xy_forward_r2c(space.astype(self._rdt))
+                sticks = stages.grid_to_sticks(grid, tables["scatter_cols"])
         elif self._split_x is not None:
             x0, w = self._split_x
             grid = stages.xy_forward_c2c_split(

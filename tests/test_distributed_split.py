@@ -1,0 +1,133 @@
+"""Distributed sparse-y (split-x) stage: the reference runs its y-FFT
+only over non-empty x rows in ALL paths including MPI ones
+(reference: execution_host.cpp:139-145 uses uniqueXIndices from all ranks);
+here the occupied-x window shrinks every shard's plane grid and both
+exchange unpack layouts."""
+
+import numpy as np
+import pytest
+
+from spfft_tpu import ExchangeType, Scaling, TransformType
+from spfft_tpu.parallel import make_distributed_plan, make_mesh
+from spfft_tpu.utils.workloads import spherical_cutoff_triplets
+
+from test_distributed import split_by_sticks, split_planes
+from test_util import (dense_backward, dense_cube_from_values, dense_forward,
+                      random_values, sample_cube, tolerance_for)
+
+
+@pytest.mark.parametrize("exchange", [ExchangeType.BUFFERED,
+                                      ExchangeType.COMPACT_BUFFERED,
+                                      ExchangeType.UNBUFFERED])
+def test_distributed_split_wrapped_sphere(exchange):
+    """Centered sphere on a 2x-cutoff grid (the realistic plane-wave shape):
+    the wrapped occupied-x window activates the distributed split path on
+    every exchange mechanism."""
+    dims = (24, 24, 24)
+    rng = np.random.default_rng(55)
+    triplets = spherical_cutoff_triplets(24, radius=6)
+    values = random_values(rng, len(triplets))
+    cube = dense_cube_from_values(triplets, values, dims)
+    space_oracle = dense_backward(cube)
+    parts = split_by_sticks(triplets, dims, [2, 1, 0, 1])
+    planes = split_planes(dims[2], [1, 2, 1, 2])
+    plan = make_distributed_plan(TransformType.C2C, *dims, parts, planes,
+                                 mesh=make_mesh(4), precision="double",
+                                 exchange=exchange)
+    assert plan._split_x == (18, 13), plan._split_x
+    values_parts = [sample_cube(cube, p, dims) for p in parts]
+    space = plan.backward(values_parts)
+    got = np.concatenate([s for s in plan.unshard_space(space) if s.size],
+                         axis=0)
+    np.testing.assert_allclose(got, space_oracle,
+                               atol=tolerance_for("double", space_oracle),
+                               rtol=0)
+    back = plan.unshard_values(plan.forward(space, Scaling.FULL))
+    for g, v in zip(back, values_parts):
+        np.testing.assert_allclose(g, v, atol=1e-10, rtol=0)
+
+
+@pytest.mark.parametrize("exchange", [ExchangeType.BUFFERED,
+                                      ExchangeType.COMPACT_BUFFERED])
+def test_distributed_split_r2c(exchange):
+    """Distributed R2C split: occupied window of the half spectrum, plane
+    symmetry on the x=0 sub-column."""
+    dims = (24, 20, 18)
+    nx, ny, nz = dims
+    rng = np.random.default_rng(56)
+    space_field = rng.standard_normal((nz, ny, nx))
+    freq = dense_forward(space_field.astype(np.complex128))
+    triplets = np.array([[x, y, z] for x in range(5)
+                         for y in range(ny) for z in range(nz)])
+    mask = np.zeros((nz, ny, nx), bool)
+    for x, y, z in triplets:
+        mask[z, y, x] = True
+        mask[(-z) % nz, (-y) % ny, (-x) % nx] = True
+    freq_bl = freq * mask
+    space_bl = np.fft.ifftn(freq_bl).real
+    parts = split_by_sticks(triplets, dims, [1, 2, 1, 1])
+    planes = split_planes(nz, [2, 1, 2, 1])
+    plan = make_distributed_plan(TransformType.R2C, *dims, parts, planes,
+                                 mesh=make_mesh(4), precision="double",
+                                 exchange=exchange)
+    assert plan._split_x == (0, 5), plan._split_x
+    values_parts = [sample_cube(freq_bl, p, dims) for p in parts]
+    space = plan.backward(values_parts)
+    got = np.concatenate([s for s in plan.unshard_space(space) if s.size],
+                         axis=0)
+    oracle = space_bl * space_bl.size
+    np.testing.assert_allclose(got, oracle,
+                               atol=tolerance_for("double", oracle), rtol=0)
+    slabs_in = [space_bl[plan.local_z_offset(r):
+                         plan.local_z_offset(r) + planes[r]]
+                for r in range(4)]
+    got_parts = plan.unshard_values(plan.forward(slabs_in))
+    for r, part in enumerate(parts):
+        expected = sample_cube(freq_bl, part, dims)
+        np.testing.assert_allclose(got_parts[r], expected,
+                                   atol=tolerance_for("double", expected),
+                                   rtol=0)
+
+
+def test_distributed_split_disabled_for_wide_sets():
+    """A full-width set keeps the dense path (window > 70% of x)."""
+    from test_util import random_sparse_triplets
+    dims = (12, 12, 12)
+    rng = np.random.default_rng(57)
+    triplets = random_sparse_triplets(rng, dims)
+    parts = split_by_sticks(triplets, dims, [1, 1])
+    planes = split_planes(dims[2], [1, 1])
+    plan = make_distributed_plan(TransformType.C2C, *dims, parts, planes,
+                                 mesh=make_mesh(2), precision="double")
+    assert plan._split_x is None
+
+
+def test_distributed_split_with_pallas_interpret():
+    """Split-x composes with the Pallas compression tables (interpret mode
+    on CPU) — the two optimizations are orthogonal stages."""
+    dims = (24, 16, 16)
+    rng = np.random.default_rng(58)
+    triplets = spherical_cutoff_triplets(16, radius=4)
+    # rescale x to the 24-wide grid: keep as-is (|x|<=4 fits any)
+    values = random_values(rng, len(triplets))
+    cube = dense_cube_from_values(triplets, values, dims)
+    space_oracle = dense_backward(cube)
+    from spfft_tpu.utils.workloads import (even_plane_split,
+                                           round_robin_stick_partition,
+                                           sort_triplets_stick_major)
+    triplets_sorted = sort_triplets_stick_major(triplets, dims)
+    values_sorted = sample_cube(cube, triplets_sorted, dims)
+    parts = round_robin_stick_partition(triplets_sorted, dims, 4)
+    planes = even_plane_split(dims[2], 4)
+    plan = make_distributed_plan(
+        TransformType.C2C, *dims, parts, planes, mesh=make_mesh(4),
+        precision="single", use_pallas=True)
+    assert plan._split_x is not None
+    assert plan._pallas_dist is not None
+    values_parts = [sample_cube(cube, p, dims) for p in parts]
+    space = plan.backward(values_parts)
+    got = np.concatenate([s for s in plan.unshard_space(space) if s.size],
+                         axis=0)
+    np.testing.assert_allclose(got, space_oracle,
+                               atol=tolerance_for("single", space_oracle),
+                               rtol=0)

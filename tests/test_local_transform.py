@@ -178,17 +178,80 @@ def test_split_x_path_vs_dense():
                                rtol=0)
 
 
-def test_split_x_disabled_for_wide_and_centered_sets():
+def test_split_x_wide_disabled_wrapped_enabled():
     rng = np.random.default_rng(78)
     dims = (16, 16, 16)
     wide = random_sparse_triplets(rng, dims)  # spans most of x
     plan = make_local_plan(TransformType.C2C, *dims, wide,
                            precision="double")
     assert plan._split_x is None
-    # centered sphere wraps x storage to both ends -> no contiguous range
+    # centered set wraps x storage to both ends -> cyclic (wrapped) window
+    # [14, 16) U [0, 3), width 5 of 16
     sphere = center_triplets(
         np.array([[x, 0, 0] for x in range(0, 3)]), dims)
     sphere = np.concatenate([sphere, [[-2, 0, 1], [-1, 0, 1]]])
     plan2 = make_local_plan(TransformType.C2C, *dims, sphere,
                             precision="double")
-    assert plan2._split_x is None  # wrapped range spans the extent
+    assert plan2._split_x == (14, 5)
+
+
+def test_split_x_wrapped_vs_oracle():
+    """The wrapped (two-slice) split window — a centered plane-wave sphere
+    on a 2x-cutoff grid, the flagship workload shape — agrees with the
+    dense oracle in both directions (reference: execution_host.cpp:139-145
+    runs sparse-y in ALL paths, wrapped ranges included)."""
+    from spfft_tpu.utils.workloads import spherical_cutoff_triplets
+    dims = (24, 24, 24)
+    rng = np.random.default_rng(79)
+    triplets = spherical_cutoff_triplets(24, radius=6)  # x in [-6, 6]
+    values = random_values(rng, len(triplets))
+    plan = make_local_plan(TransformType.C2C, *dims, triplets,
+                           precision="double")
+    assert plan._split_x == (18, 13), plan._split_x  # wrapped window
+    cube = dense_cube_from_values(triplets, values, dims)
+    space_oracle = dense_backward(cube)
+    space = as_complex_np(np.asarray(plan.backward(values)))
+    np.testing.assert_allclose(space, space_oracle,
+                               atol=tolerance_for("double", space_oracle),
+                               rtol=0)
+    freq_oracle = dense_forward(space_oracle)
+    expected = sample_cube(freq_oracle, triplets, dims)
+    got = as_complex_np(np.asarray(plan.forward(space_oracle)))
+    np.testing.assert_allclose(got, expected,
+                               atol=tolerance_for("double", expected),
+                               rtol=0)
+
+
+def test_split_x_r2c_vs_oracle():
+    """R2C split window (y-FFT over occupied x of the half spectrum) with
+    plane symmetry on the x=0 sub-column."""
+    dims = (24, 20, 18)
+    rng = np.random.default_rng(80)
+    space_field = rng.standard_normal((dims[2], dims[1], dims[0]))
+    freq = dense_forward(space_field.astype(np.complex128))
+    # occupied x of the half spectrum: [0, 5) of 13 -> split active
+    triplets = np.array([[x, y, z] for x in range(5)
+                         for y in range(dims[1]) for z in range(dims[2])])
+    plan = make_local_plan(TransformType.R2C, *dims, triplets,
+                           precision="double")
+    assert plan._split_x == (0, 5), plan._split_x
+    # band-limit the field to the hermitian closure of the triplet set so
+    # the sparse samples fully determine a real space field
+    nx, ny, nz = dims
+    mask = np.zeros((nz, ny, nx), bool)
+    for x, y, z in triplets:
+        mask[z, y, x] = True
+        mask[(-z) % nz, (-y) % ny, (-x) % nx] = True
+    freq_bl = freq * mask
+    space_bl = np.fft.ifftn(freq_bl)
+    assert np.abs(space_bl.imag).max() < 1e-12
+    space_bl = space_bl.real
+    values = sample_cube(freq_bl, triplets, dims)
+    got = np.asarray(plan.backward(values))
+    oracle = space_bl * space_bl.size
+    np.testing.assert_allclose(got, oracle,
+                               atol=tolerance_for("double", oracle), rtol=0)
+    fwd = as_complex_np(np.asarray(plan.forward(space_bl)))
+    np.testing.assert_allclose(fwd, values,
+                               atol=tolerance_for("double", values),
+                               rtol=0)
