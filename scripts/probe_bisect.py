@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Bisect the pair compile blow-up: compile growing prefixes of the real
+backward/forward pipeline at a given dim with the real plan tables."""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def timed_compile(name, fn, *args):
+    t0 = time.perf_counter()
+    compiled = jax.jit(fn).lower(*args).compile()
+    tc = time.perf_counter() - t0
+    print(f"{name:35s} compile {tc:8.2f}s", flush=True)
+    return compiled
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 320
+    from spfft_tpu import TransformType, make_local_plan
+    from spfft_tpu.ops import stages
+    from spfft_tpu.utils import as_interleaved
+    from spfft_tpu.utils.workloads import spherical_cutoff_triplets
+
+    triplets = spherical_cutoff_triplets(n)
+    plan = make_local_plan(TransformType.C2C, n, n, n, triplets,
+                           precision="single", use_pallas=False)
+    p = plan.index_plan
+    print(f"n={n} sticks={p.num_sticks} values={p.num_values}", flush=True)
+
+    rng = np.random.default_rng(42)
+    values = (rng.uniform(-1, 1, p.num_values)
+              + 1j * rng.uniform(-1, 1, p.num_values)).astype(np.complex64)
+    values_il = jax.device_put(np.asarray(as_interleaved(values, "single")))
+    tables = plan._tables
+
+    timed_compile("1 decompress",
+                  lambda v, t: stages.decompress(
+                      v, t["slot_src"], p.num_sticks, p.dim_z),
+                  values_il, tables)
+    timed_compile("2 +z_backward",
+                  lambda v, t: stages.z_backward(stages.decompress(
+                      v, t["slot_src"], p.num_sticks, p.dim_z)),
+                  values_il, tables)
+    timed_compile("3 +sticks_to_grid",
+                  lambda v, t: stages.sticks_to_grid(
+                      stages.z_backward(stages.decompress(
+                          v, t["slot_src"], p.num_sticks, p.dim_z)),
+                      t["col_inv"], p.dim_y, p.dim_x_freq),
+                  values_il, tables)
+    timed_compile("4 full backward",
+                  lambda v, t: plan._backward_impl(v, t, pallas=False),
+                  values_il, tables)
+
+    space = plan.backward(values_il)
+    timed_compile("5 fwd xy only",
+                  lambda s: stages.xy_forward_c2c(
+                      (s[..., 0] + 1j * s[..., 1])), space)
+    timed_compile("6 fwd xy+pack",
+                  lambda s, t: stages.grid_to_sticks(
+                      stages.xy_forward_c2c(s[..., 0] + 1j * s[..., 1]),
+                      t["scatter_cols"]),
+                  space, tables)
+    timed_compile("7 full forward",
+                  lambda s, t: plan._forward_impl(s, t, scaled=False,
+                                                  pallas=False),
+                  space, tables)
+    timed_compile("8 full pair",
+                  lambda v, t: plan._pair_impl(v, t, scaled=False, fn=None),
+                  values_il, tables)
+
+
+if __name__ == "__main__":
+    main()

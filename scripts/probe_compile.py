@@ -1,0 +1,58 @@
+#!/usr/bin/env python
+"""Micro-probe: compile-time of each pipeline op in isolation at a given dim,
+to attribute the envelope's compile blow-up to a specific XLA op."""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timed_compile(name, fn, *args):
+    t0 = time.perf_counter()
+    compiled = jax.jit(fn).lower(*args).compile()
+    tc = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = compiled(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    out = compiled(*args)
+    jax.block_until_ready(out)
+    te = time.perf_counter() - t0
+    print(f"{name:30s} compile {tc:8.2f}s  exec {te * 1e3:8.2f}ms", flush=True)
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 320
+    num_sticks = int(np.pi * (n // 2) ** 2)
+    nxf = n
+    print(f"n={n} sticks={num_sticks}", flush=True)
+
+    sticks = jnp.ones((num_sticks, n), jnp.complex64)
+    grid = jnp.ones((n, n, n), jnp.complex64)
+    col_inv = jnp.zeros((n * nxf,), jnp.int32)
+    slot_src = jnp.zeros((num_sticks * n,), jnp.int32)
+    values = jnp.ones((num_sticks * n // 2, 2), jnp.float32)
+
+    timed_compile("z ifft (sticks,n)",
+                  lambda s: jnp.fft.ifft(s, axis=1), sticks)
+    timed_compile("xy ifft2 (n,n,n)",
+                  lambda g: jnp.fft.ifft2(g, axes=(1, 2)), grid)
+    timed_compile("gather sticks_to_grid",
+                  lambda s, ci: jnp.take(
+                      jnp.concatenate(
+                          [s.T.reshape(n, -1),
+                           jnp.zeros((n, 1), s.dtype)], axis=1),
+                      ci, axis=1).reshape(n, n, nxf),
+                  sticks, col_inv)
+    timed_compile("gather decompress",
+                  lambda v, ss: jnp.take(
+                      jnp.concatenate([v, jnp.zeros((1, 2), v.dtype)]),
+                      ss, axis=0),
+                  values, slot_src)
+
+
+if __name__ == "__main__":
+    main()

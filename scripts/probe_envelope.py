@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Probe the single-chip grid-size envelope: build/compile/run one pair at a
+given dim with per-step progress prints, so a stall is attributable to a
+specific step (plan build, table transfer, compile, execute)."""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 320
+    use_pallas = None if "--no-pallas" not in sys.argv else False
+    stage = "pair"
+    for a in sys.argv[2:]:
+        if a.startswith("--stage="):
+            stage = a.split("=", 1)[1]
+    import jax
+    from spfft_tpu import TransformType, make_local_plan
+    from spfft_tpu.utils import as_interleaved
+    from spfft_tpu.utils.workloads import spherical_cutoff_triplets
+
+    t = time.perf_counter()
+
+    def mark(msg):
+        nonlocal t
+        now = time.perf_counter()
+        print(f"[{now - t:8.2f}s] {msg}", flush=True)
+        t = now
+
+    print(f"devices: {jax.devices()}", flush=True)
+    triplets = spherical_cutoff_triplets(n)
+    mark(f"triplets built: {len(triplets)} values")
+    rng = np.random.default_rng(42)
+    values = (rng.uniform(-1, 1, len(triplets))
+              + 1j * rng.uniform(-1, 1, len(triplets))).astype(np.complex64)
+    mark("values built")
+
+    plan = make_local_plan(TransformType.C2C, n, n, n, triplets,
+                           precision="single", use_pallas=use_pallas)
+    mark(f"plan built (pallas_active={plan._pallas_active}, "
+         f"split_x={plan._split_x})")
+
+    values_il = jax.device_put(np.asarray(as_interleaved(values, "single")))
+    values_il.block_until_ready()
+    mark("values on device")
+
+    for name, table in plan._tables.items():
+        table.block_until_ready()
+    mark("tables on device")
+
+    if stage == "pair":
+        run = lambda: plan.apply_pointwise(values_il)
+    elif stage == "backward":
+        run = lambda: plan.backward(values_il)
+    elif stage == "forward":
+        space = plan.backward(values_il)
+        float(np.asarray(space.ravel()[0]))
+        mark("backward done (forward-stage setup)")
+        run = lambda: plan.forward(space)
+    else:
+        raise SystemExit(f"unknown stage {stage}")
+    out = run()
+    float(np.asarray(out.ravel()[0]))
+    mark(f"{stage} compiled + first run")
+
+    reps = 5
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = run()
+    float(np.asarray(out.ravel()[0]))
+    mark(f"{stage} x{reps}: "
+         f"{(time.perf_counter() - t0) / reps * 1e3:.2f} ms each")
+
+
+if __name__ == "__main__":
+    main()

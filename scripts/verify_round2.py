@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Round-2 verify drive: the canonical checks from .claude/skills/verify on
+the real TPU, plus the new batched entry points."""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import jax
+    from spfft_tpu import (InvalidIndicesError, InvalidParameterError,
+                           Scaling, TransformType, make_local_plan)
+    from spfft_tpu.utils import as_complex_np, as_interleaved
+    from spfft_tpu.utils.workloads import spherical_cutoff_triplets
+
+    print(f"devices: {jax.devices()}", flush=True)
+
+    # 1. dense 2x2x2 C2C round trip (reference example.cpp equivalent)
+    n = 2
+    triplets = np.array([(x, y, z) for x in range(n) for y in range(n)
+                         for z in range(n)], np.int32)
+    plan = make_local_plan(TransformType.C2C, n, n, n, triplets,
+                           precision="single")
+    rng = np.random.default_rng(0)
+    v = (rng.uniform(-1, 1, 8) + 1j * rng.uniform(-1, 1, 8)).astype(
+        np.complex64)
+    space = plan.backward(v)
+    out = as_complex_np(np.asarray(plan.forward(space, Scaling.FULL)))
+    assert np.allclose(out, v, atol=1e-4), "2x2x2 round trip failed"
+    print("1. dense 2x2x2 C2C round trip OK", flush=True)
+
+    # 2. R2C vs numpy oracle
+    dims = (8, 6, 10)
+    space_ref = rng.uniform(-1, 1, (dims[2], dims[1], dims[0])).astype(
+        np.float64)
+    freq = np.fft.fftn(space_ref)
+    trips = np.asarray([(x, y, z) for x in range(dims[0] // 2 + 1)
+                        for y in range(dims[1]) for z in range(dims[2])],
+                       np.int32)
+    rplan = make_local_plan(TransformType.R2C, *dims, trips,
+                            precision="single")
+    st = trips
+    vals = freq[st[:, 2], st[:, 1], st[:, 0]].astype(np.complex64)
+    got = np.asarray(rplan.backward(vals))
+    ref = space_ref * space_ref.size
+    err = np.abs(got - ref).max() / np.abs(ref).max()
+    assert err < 1e-4, f"R2C backward error {err}"
+    print(f"2. R2C oracle OK (rel err {err:.2e})", flush=True)
+
+    # 3. error surface
+    try:
+        make_local_plan(TransformType.C2C, 4, 4, 4, np.array([[9, 0, 0]]))
+        raise AssertionError("expected InvalidIndicesError")
+    except InvalidIndicesError:
+        pass
+    try:
+        plan.backward(np.zeros(3, np.complex64))
+        raise AssertionError("expected InvalidParameterError")
+    except InvalidParameterError:
+        pass
+    print("3. error surface OK", flush=True)
+
+    # 4. scale probe: 128^3 sphere, timed pairs + batched path
+    n = 128
+    trips = spherical_cutoff_triplets(n)
+    t0 = time.perf_counter()
+    plan = make_local_plan(TransformType.C2C, n, n, n, trips,
+                           precision="single")
+    t_plan = time.perf_counter() - t0
+    v = (rng.uniform(-1, 1, len(trips))
+         + 1j * rng.uniform(-1, 1, len(trips))).astype(np.complex64)
+    v_il = jax.device_put(np.asarray(as_interleaved(v, "single")))
+    out = plan.apply_pointwise(v_il, scaling=Scaling.FULL)
+    float(np.asarray(out.ravel()[0]))
+    t0 = time.perf_counter()
+    for _ in range(5):
+        out = plan.apply_pointwise(v_il, scaling=Scaling.FULL)
+    float(np.asarray(out.ravel()[0]))
+    pair_ms = (time.perf_counter() - t0) / 5 * 1e3
+    got = np.asarray(out)
+    err = np.abs(got[:, 0] + 1j * got[:, 1] - v).max()
+    assert err < 1e-3, f"128^3 round trip err {err}"
+    print(f"4. 128^3 sphere: plan {t_plan:.2f}s, pair {pair_ms:.2f} ms, "
+          f"pallas_active={plan.pallas_active}, err {err:.2e}", flush=True)
+
+    # 5. batched path on chip (new this round): B=3 fused == singles
+    batch = [np.roll(v, i) for i in range(3)]
+    t0 = time.perf_counter()
+    stacked = plan.backward_batched([as_interleaved(b, "single")
+                                     for b in batch])
+    float(np.asarray(stacked.ravel()[0]))
+    t_b3 = time.perf_counter() - t0
+    single = np.asarray(plan.backward(batch[1]))
+    err = np.abs(np.asarray(stacked[1]) - single).max()
+    assert err < 1e-3, f"batched vs single err {err}"
+    t0 = time.perf_counter()
+    stacked = plan.backward_batched(stacked_in := jax.device_put(
+        np.stack([np.asarray(as_interleaved(b, "single")) for b in batch])))
+    float(np.asarray(stacked.ravel()[0]))
+    t_warm = time.perf_counter() - t0
+    print(f"5. batched B=3 on chip OK (compile+run {t_b3:.2f}s, "
+          f"warm {t_warm * 1e3:.1f} ms, err {err:.2e})", flush=True)
+
+    print("VERIFY OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -34,22 +34,15 @@ def _check(transforms: Sequence[Transform], args: Sequence, what: str):
             f"got {len(transforms)} transforms but {len(args)} {what}")
 
 
-def _shared_local_plan(transforms: Sequence[Transform]):
-    """If every transform wraps the *same* local plan object (clones share
-    their plan), return it — the batch then runs as ONE vmapped executable
-    instead of N dispatches. Returns None otherwise."""
+def _shared_plan(transforms: Sequence[Transform]):
+    """If every transform wraps the *same* plan object (clones share their
+    plan), return it — the batch then runs as ONE fused executable (local:
+    vmapped + batched-grid kernel; distributed: one SPMD program with a
+    per-shard batch axis) instead of N dispatches. Returns None otherwise."""
     if len(transforms) < 2:
         return None
     plan = transforms[0].plan
     if any(t.plan is not plan for t in transforms[1:]):
-        return None
-    if not isinstance(plan, TransformPlan):
-        return None  # distributed plans have no vmapped batch path
-    if plan._pallas_active:
-        # vmap cannot lower the Pallas gather kernel, so the fused
-        # executable falls back to XLA gathers — measured slower than N
-        # Pallas-backed dispatches (128^3 sphere, B=3, TPU v5e: 106 ms vs
-        # 125 ms). Keep per-transform dispatch when the kernel is active.
         return None
     return plan
 
@@ -64,10 +57,14 @@ def multi_transform_backward(transforms: Sequence[Transform],
     # batch; time the whole batch as one scope instead.
     with timed_transform("multi_backward") as box:
         with suppressed():
-            plan = _shared_local_plan(transforms)
+            plan = _shared_plan(transforms)
             if plan is not None:
                 stacked = plan.backward_batched(values_batch)
-                box.value = [stacked[i] for i in range(len(transforms))]
+                if isinstance(plan, TransformPlan):
+                    box.value = [stacked[i] for i in range(len(transforms))]
+                else:  # distributed: (S, B, planes, ...)
+                    box.value = [stacked[:, i]
+                                 for i in range(len(transforms))]
                 for t, s in zip(transforms, box.value):
                     t.set_space_domain_data(s)
             else:
@@ -90,12 +87,18 @@ def multi_transform_forward(transforms: Sequence[Transform],
     _check(transforms, scalings, "scalings")
     with timed_transform("multi_forward") as box:
         with suppressed():
-            plan = _shared_local_plan(transforms)
-            if plan is not None and all(s is not None for s in space_batch) \
-                    and len(set(scalings)) == 1:
+            plan = _shared_plan(transforms)
+            fused = plan is not None \
+                and all(s is not None for s in space_batch) \
+                and len(set(scalings)) == 1
+            if fused:
                 stacked = plan.forward_batched(space_batch,
                                                Scaling(scalings[0]))
-                box.value = [stacked[i] for i in range(len(transforms))]
+                if isinstance(plan, TransformPlan):
+                    box.value = [stacked[i] for i in range(len(transforms))]
+                else:  # distributed: (S, B, mv, 2)
+                    box.value = [stacked[:, i]
+                                 for i in range(len(transforms))]
                 for t, s in zip(transforms, space_batch):
                     t.set_space_domain_data(s)
             else:

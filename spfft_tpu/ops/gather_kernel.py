@@ -1,4 +1,4 @@
-"""Pallas TPU kernel for monotone gathers — the sparse compression hot path.
+"""Pallas TPU kernel for windowed gathers — the sparse compression hot path.
 
 The decompress/compress stages move millions of sparse values between the
 user's value array and the packed stick array (reference:
@@ -6,12 +6,8 @@ src/compression/compression_host.hpp, compression_gpu kernels). XLA lowers
 arbitrary-index gathers on TPU to near-serial element loads (~80 ms for 13M
 elements on v5e — measured), two orders of magnitude off HBM bandwidth.
 
-When the user's value order is stick-major and z-ascending — the layout the
-reference itself recommends for performance (docs/source/details.rst "Data
-Distribution") and the natural output of index generators — both directions
-become *monotone* gathers: ``out[j] = src[idx[j]] * mask[j]`` with ``idx``
-non-decreasing. Monotonicity localises the source span of any 1024-slot
-output tile, so the gather decomposes into
+The kernel computes ``out[j] = src[idx[j]] * mask[j]`` for an *arbitrary*
+plan-time-constant index list by decomposing it into
 
   1. contiguous DMAs of K-row source windows (double-buffered across grid
      steps),
@@ -19,13 +15,18 @@ output tile, so the gather decomposes into
      (``take_along_axis`` along lanes, indices < 128),
   3. a select-accumulate over the K candidate rows.
 
-A tile whose span exceeds one K-row window is split into several *chunks*:
-consecutive grid steps that map to the same output tile and accumulate into
-it (the standard Pallas revisiting-reduction pattern), so arbitrarily gappy
-index sets — e.g. the near-empty edge sticks of a spherical cutoff — stay on
-the fast path instead of falling back to the XLA gather. K is chosen per
-plan from the span distribution (small K wastes nothing on dense tiles;
-gappy tiles just emit more chunks).
+Each 1024-slot output tile owns one *chunk* per distinct K-row source
+window its indices touch; a tile's chunks are consecutive grid steps that
+accumulate into the same output block (the standard Pallas revisiting-
+reduction pattern). When the value order is stick-major and z-ascending —
+the layout the reference itself recommends for performance
+(docs/source/details.rst "Data Distribution") — indices are monotone, every
+tile touches the minimal number of windows, and the decomposition is
+optimal. Locally-coherent but unsorted orders (shuffled sticks, z-sorted
+within each) just emit more chunks and stay on the fast path; a truly
+random order would blow the chunk count up, so the builder falls back
+(returns None) when the modelled DMA traffic exceeds the measured XLA
+gather cost. K is chosen per plan from the window-count distribution.
 
 Per-chunk selector tables are precomputed on host at plan time and packed
 into one int32 word per output slot: lane (bits 0-6), window row (bits 7-19),
@@ -73,60 +74,78 @@ class MonotoneGatherTables:
     span_rows: int        # K: DMA window height
 
 
+#: Fallback ceiling: the kernel's cost scales with the chunk count C while
+#: the XLA gather's scales with the output size (~G tiles); the measured
+#: kernel advantage at C ≈ G is ~6x (scripts/sweep.py, 256^3 on v5e), so
+#: past C ≈ 6G the decomposition stops paying for itself.
+_CHUNK_BLOWUP_LIMIT = 6
+
+
 def build_monotone_gather_tables(idx: np.ndarray, valid: np.ndarray,
                                  num_src: int, k_rows: int = 0):
     """Build tables for ``out[j] = src[idx[j]] * valid[j]``.
 
     Args:
-      idx: (L,) non-decreasing source indices (any in-range value where
-        invalid, as long as the whole sequence stays non-decreasing).
+      idx: (L,) source indices, in any order (any in-range value where
+        invalid). Monotone (non-decreasing) indices give the minimal chunk
+        count; arbitrary order works as long as each 1024-slot output tile
+        touches a bounded set of K-row source windows.
       valid: (L,) bool.
       num_src: size of the source array.
-      k_rows: force the DMA window height (0 = choose from the span
+      k_rows: force the DMA window height (0 = choose from the window-count
         distribution).
     Returns:
-      MonotoneGatherTables, or None if ``idx`` is empty or not monotone
-      (caller falls back to the XLA gather).
+      MonotoneGatherTables, or None if ``idx`` is empty or so disordered
+      that the chunk decomposition would be slower than the XLA gather
+      (caller falls back).
     """
     L = int(idx.shape[0])
     if L == 0:
         return None
     idx = np.asarray(idx, np.int64)
-    if (np.diff(idx) < 0).any():
-        return None
     G = -(-L // TILE)
     pad = G * TILE - L
     idx_p = np.concatenate([idx, np.full(pad, idx[-1], np.int64)])
     valid_p = np.concatenate([np.asarray(valid, bool), np.zeros(pad, bool)])
     tiles = idx_p.reshape(G, TILE)
-    rows = tiles // TILE_LANE
-    row0_t = rows[:, 0].astype(np.int64)
-    span_t = rows[:, -1] - row0_t + 1  # rows touched by each tile
+    rows = tiles // TILE_LANE                      # (G, TILE)
+    rows_sorted = np.sort(rows, axis=1)            # per-tile, for windowing
+
+    def chunks_per_tile(k):
+        win = rows_sorted // k
+        return 1 + (np.diff(win, axis=1) != 0).sum(axis=1)
+
     if k_rows:
         K = int(k_rows)
     else:
         # cost ~ chunks * (K DMA rows + fixed per-step overhead)
         K = min(K_CANDIDATES,
-                key=lambda k: int((-(-span_t // k)).sum()) * (k + 8))
-    chunks_t = (-(-span_t // K)).astype(np.int64)
+                key=lambda k: int(chunks_per_tile(k).sum()) * (k + 8))
+    win_sorted = rows_sorted // K
+    # one chunk per (tile, distinct window); windows ascend within a tile so
+    # a tile's chunks are consecutive grid steps (the revisiting pattern)
+    new_win = np.concatenate([np.ones((G, 1), bool),
+                              np.diff(win_sorted, axis=1) != 0], axis=1)
+    chunks_t = new_win.sum(axis=1).astype(np.int64)
     C = int(chunks_t.sum())
+    if C > _CHUNK_BLOWUP_LIMIT * G + 64:
+        return None  # too disordered: XLA gather is the better program
     tile_of = np.repeat(np.arange(G, dtype=np.int64), chunks_t)
-    # chunk ordinal within its tile, vectorised (a per-tile arange concat
-    # is a Python loop over ~L/1024 tiles and dominated plan time)
-    c_of = np.arange(C, dtype=np.int64) - np.repeat(
-        np.cumsum(chunks_t) - chunks_t, chunks_t)
-    rows32 = rows.astype(np.int32)  # int32 up front: the (C, TILE)
-    row0_32 = row0_t.astype(np.int32)  # temporaries are the peak allocation
-    rel = rows32[tile_of] - row0_32[tile_of, None]       # (C, TILE)
-    c32 = c_of[:, None].astype(np.int32)
-    in_win = (rel // K) == c32
-    row_in = np.clip(rel - c32 * K, 0, K - 1)
+    win_ids = win_sorted[new_win].astype(np.int64)  # (C,) window per chunk
+    win32 = (rows // K).astype(np.int32)  # int32 up front: the (C, TILE)
+    rows32 = rows.astype(np.int32)  # temporaries are the peak allocation
+    wc = win_ids[:, None].astype(np.int32)
+    in_win = win32[tile_of] == wc                        # (C, TILE)
+    row_in = np.clip(rows32[tile_of] - wc * K, 0, K - 1)
     m = in_win & valid_p.reshape(G, TILE)[tile_of]
     lanes = (tiles % TILE_LANE).astype(np.int32)  # (G, TILE), not (C, TILE)
     packed = (lanes[tile_of]
               | (row_in << _ROW_SHIFT)
               | (m.astype(np.int32) << _VALID_SHIFT))
-    row0 = (row0_t[tile_of] + c_of * K).astype(np.int32)
+    row0 = (win_ids * K).astype(np.int32)
+    # first chunk of each tile initialises its output block
+    first = np.zeros(C, np.int32)
+    first[np.cumsum(chunks_t) - chunks_t] = 1
     # Cover the whole source array, not just the last referenced span: the
     # planar source is built by zero-PADDING the (num_src,) array to
     # src_rows * 128, which requires src_rows * 128 >= num_src even when the
@@ -135,7 +154,7 @@ def build_monotone_gather_tables(idx: np.ndarray, valid: np.ndarray,
     return MonotoneGatherTables(
         row0=row0,
         out_tile=tile_of.astype(np.int32),
-        first=(c_of == 0).astype(np.int32),
+        first=first,
         packed=packed.reshape(C, TILE_SUB, TILE_LANE),
         num_out=L, num_tiles=G, src_rows=src_rows, span_rows=K)
 
@@ -144,18 +163,33 @@ def compression_gather_inputs(value_indices, num_slots: int,
                               pad_values_to=None):
     """The (idx, valid) pairs for both compression directions.
 
-    Decompress gathers slot <- value (idx increments <= 1: the running
-    count of occupied slots); compress gathers value <- slot (idx = the
-    flat value indices, optionally padded with monotone repeats of the
-    last index and valid=False — the padded-value layout of distributed
-    shards). Single source of truth for local plan._init_pallas and the
-    distributed per-shard tables.
+    Decompress gathers slot <- value (idx = each occupied slot's position
+    in the user's value array, forward-filled over unoccupied slots so a
+    locally-coherent value order keeps the windows local); compress gathers
+    value <- slot (idx = the flat value indices, optionally padded with
+    repeats of the last index and valid=False — the padded-value layout of
+    distributed shards). Works for ANY value order (duplicates resolve to
+    the last occurrence, matching stages.decompress); single source of
+    truth for local plan._init_pallas and the distributed per-shard tables.
     """
     vi = np.asarray(value_indices, np.int64)
     n = len(vi)
     occupied = np.zeros(num_slots, bool)
     occupied[vi] = True
-    dec_idx = np.maximum(np.cumsum(occupied) - 1, 0)
+    pos = np.zeros(num_slots, np.int64)
+    pos[vi] = np.arange(n, dtype=np.int64)  # last occurrence wins
+    # forward-fill each unoccupied slot with the nearest occupied slot at or
+    # below it (leading gap: the first occupied slot), so idx stays local
+    # when the value order is; for sorted vi this reduces to the running
+    # occupied count.
+    if n:
+        filled = np.maximum.accumulate(
+            np.where(occupied, np.arange(num_slots, dtype=np.int64), -1))
+        filled = np.where(filled < 0, int(np.flatnonzero(occupied)[0]),
+                          filled)
+        dec_idx = pos[filled]
+    else:
+        dec_idx = np.zeros(num_slots, np.int64)
     out_n = n if pad_values_to is None else pad_values_to
     cmp_idx = np.zeros(out_n, np.int64)
     if n:
@@ -194,6 +228,26 @@ def pad_tables_to(t: "MonotoneGatherTables", c_max: int):
     return row0, out_tile, first, packed
 
 
+def _tile_compute(K: int, packed_ref, sc, slot):
+    """Shared per-tile compute: decode the packed selector words, gather K
+    candidate rows from the VMEM window, select-accumulate."""
+    t = packed_ref[0]
+    lane = t & (TILE_LANE - 1)
+    row = (t >> _ROW_SHIFT) & _ROW_MASK
+    m = (t >> _VALID_SHIFT).astype(jnp.float32)
+    acc_re = jnp.zeros((TILE_SUB, TILE_LANE), jnp.float32)
+    acc_im = jnp.zeros((TILE_SUB, TILE_LANE), jnp.float32)
+    for k in range(K):
+        sel = row == k
+        src_re = jnp.broadcast_to(sc[slot, 0, k][None, :],
+                                  (TILE_SUB, TILE_LANE))
+        src_im = jnp.broadcast_to(sc[slot, 1, k][None, :],
+                                  (TILE_SUB, TILE_LANE))
+        acc_re += jnp.where(sel, jnp.take_along_axis(src_re, lane, axis=1), 0)
+        acc_im += jnp.where(sel, jnp.take_along_axis(src_im, lane, axis=1), 0)
+    return acc_re * m, acc_im * m
+
+
 def _kernel(K: int, row0_ref, out_tile_ref, first_ref, packed_ref,
             re_hbm, im_hbm, out_re_ref, out_im_ref, sc, sem):
     g = pl.program_id(0)
@@ -221,22 +275,7 @@ def _kernel(K: int, row0_ref, out_tile_ref, first_ref, packed_ref,
     dma(g, slot, 0, re_hbm).wait()
     dma(g, slot, 1, im_hbm).wait()
 
-    t = packed_ref[0]
-    lane = t & (TILE_LANE - 1)
-    row = (t >> _ROW_SHIFT) & _ROW_MASK
-    m = (t >> _VALID_SHIFT).astype(jnp.float32)
-    acc_re = jnp.zeros((TILE_SUB, TILE_LANE), jnp.float32)
-    acc_im = jnp.zeros((TILE_SUB, TILE_LANE), jnp.float32)
-    for k in range(K):
-        sel = row == k
-        src_re = jnp.broadcast_to(sc[slot, 0, k][None, :],
-                                  (TILE_SUB, TILE_LANE))
-        src_im = jnp.broadcast_to(sc[slot, 1, k][None, :],
-                                  (TILE_SUB, TILE_LANE))
-        acc_re += jnp.where(sel, jnp.take_along_axis(src_re, lane, axis=1), 0)
-        acc_im += jnp.where(sel, jnp.take_along_axis(src_im, lane, axis=1), 0)
-    acc_re = acc_re * m
-    acc_im = acc_im * m
+    acc_re, acc_im = _tile_compute(K, packed_ref, sc, slot)
 
     # Chunks of one output tile are consecutive grid steps mapping to the
     # same out block (revisiting): initialise on the first, accumulate after.
@@ -251,22 +290,104 @@ def _kernel(K: int, row0_ref, out_tile_ref, first_ref, packed_ref,
         out_im_ref[0] = out_im_ref[0] + acc_im
 
 
+def _kernel_batched(K: int, row0_ref, out_tile_ref, first_ref, packed_ref,
+                    re_hbm, im_hbm, out_re_ref, out_im_ref, sc, sem):
+    """Batched variant: grid (B, C); batch b gathers from source slab b into
+    output slab b through the SAME (batch-invariant) tables. The
+    double-buffered DMA pipeline runs across the flattened (b, g) step
+    sequence, prefetching across the batch boundary."""
+    b = pl.program_id(0)
+    g = pl.program_id(1)
+    n_b = pl.num_programs(0)
+    n_g = pl.num_programs(1)
+    step = b * n_g + g
+
+    def dma(bb, gg, slot, chan, hbm):
+        return pltpu.make_async_copy(
+            hbm.at[bb, pl.ds(row0_ref[gg], K), :], sc.at[slot, chan],
+            sem.at[slot, chan])
+
+    def start(bb, gg, slot):
+        dma(bb, gg, slot, 0, re_hbm).start()
+        dma(bb, gg, slot, 1, im_hbm).start()
+
+    @pl.when(step == 0)
+    def _():
+        start(0, 0, 0)
+
+    @pl.when(step + 1 < n_b * n_g)
+    def _():
+        nxt_b = jnp.where(g + 1 < n_g, b, b + 1)
+        nxt_g = jnp.where(g + 1 < n_g, g + 1, 0)
+        start(nxt_b, nxt_g, jax.lax.rem(step + 1, jnp.int32(2)))
+
+    slot = jax.lax.rem(step, jnp.int32(2))
+    dma(b, g, slot, 0, re_hbm).wait()
+    dma(b, g, slot, 1, im_hbm).wait()
+
+    acc_re, acc_im = _tile_compute(K, packed_ref, sc, slot)
+
+    @pl.when(first_ref[g] == 1)
+    def _():
+        out_re_ref[0, 0] = acc_re
+        out_im_ref[0, 0] = acc_im
+
+    @pl.when(first_ref[g] == 0)
+    def _():
+        out_re_ref[0, 0] = out_re_ref[0, 0] + acc_re
+        out_im_ref[0, 0] = out_im_ref[0, 0] + acc_im
+
+
 @functools.partial(jax.jit, static_argnames=("span_rows", "src_rows",
                                              "num_tiles", "interpret"))
 def monotone_gather(re, im, row0, out_tile, first, packed, *,
                     span_rows: int, src_rows: int, num_tiles: int,
                     interpret: bool = False):
-    """Run the monotone gather.
+    """Run the windowed gather.
 
     Args:
-      re, im: (src_rows, 128) float32 planar source.
+      re, im: (src_rows, 128) float32 planar source — or (B, src_rows, 128)
+        for a batch sharing the tables (each batch slab gathered into its
+        own output slab).
       row0/out_tile/first/packed: device tables (see
         build_monotone_gather_tables).
     Returns:
-      (out_re, out_im): each (num_tiles, 8, 128) float32.
+      (out_re, out_im): each (num_tiles, 8, 128) float32, with a leading B
+      when the source was batched.
     """
     C = row0.shape[0]
     K = span_rows
+    if re.ndim == 3:
+        B = re.shape[0]
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,  # row0, out_tile, first
+            grid=(B, C),
+            in_specs=[
+                pl.BlockSpec((1, TILE_SUB, TILE_LANE),
+                             lambda b, g, r0, ot, fs: (g, 0, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=(
+                pl.BlockSpec((1, 1, TILE_SUB, TILE_LANE),
+                             lambda b, g, r0, ot, fs: (b, ot[g], 0, 0)),
+                pl.BlockSpec((1, 1, TILE_SUB, TILE_LANE),
+                             lambda b, g, r0, ot, fs: (b, ot[g], 0, 0)),
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((2, 2, K, TILE_LANE), jnp.float32),
+                pltpu.SemaphoreType.DMA((2, 2)),
+            ],
+        )
+        out_shape = (
+            jax.ShapeDtypeStruct((B, num_tiles, TILE_SUB, TILE_LANE),
+                                 jnp.float32),
+            jax.ShapeDtypeStruct((B, num_tiles, TILE_SUB, TILE_LANE),
+                                 jnp.float32))
+        return pl.pallas_call(
+            functools.partial(_kernel_batched, K), out_shape=out_shape,
+            grid_spec=grid_spec, interpret=interpret,
+        )(row0, out_tile, first, packed, re, im)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,  # row0, out_tile, first
         grid=(C,),
@@ -317,16 +438,25 @@ def run_monotone_gather(values_il, tables: MonotoneGatherTables,
 
 
 def planar_from_interleaved(values_il, src_rows: int):
-    """(N, 2) interleaved -> two zero-padded (src_rows, 128) planar arrays."""
-    n = values_il.shape[0]
+    """(N, 2) interleaved -> two zero-padded (src_rows, 128) planar arrays;
+    a leading batch dim (B, N, 2) maps to (B, src_rows, 128)."""
+    n = values_il.shape[-2]
     pad = src_rows * TILE_LANE - n
-    re = jnp.pad(values_il[:, 0], (0, pad)).reshape(src_rows, TILE_LANE)
-    im = jnp.pad(values_il[:, 1], (0, pad)).reshape(src_rows, TILE_LANE)
+    batch = [(0, 0)] * (values_il.ndim - 2)
+    shape = values_il.shape[:-2] + (src_rows, TILE_LANE)
+    re = jnp.pad(values_il[..., 0], batch + [(0, pad)]).reshape(shape)
+    im = jnp.pad(values_il[..., 1], batch + [(0, pad)]).reshape(shape)
     return re, im
 
 
 def interleaved_from_planar(out_re, out_im, num_out: int):
-    """Kernel outputs -> (num_out, 2) interleaved."""
-    re = out_re.reshape(-1)[:num_out]
-    im = out_im.reshape(-1)[:num_out]
+    """Kernel outputs -> (num_out, 2) interleaved ((B, num_out, 2) when
+    batched)."""
+    if out_re.ndim == 4:
+        B = out_re.shape[0]
+        re = out_re.reshape(B, -1)[:, :num_out]
+        im = out_im.reshape(B, -1)[:, :num_out]
+    else:
+        re = out_re.reshape(-1)[:num_out]
+        im = out_im.reshape(-1)[:num_out]
     return jnp.stack([re, im], axis=-1)

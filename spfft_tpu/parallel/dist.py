@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import logging
 from typing import Optional, Sequence
 
 import jax
@@ -58,6 +59,8 @@ from .exchange import (all_to_all_blocks, build_compact_schedule,
                        pack_space_to_blocks, ring_exchange_blocks,
                        unpack_blocks_to_grid, unpack_blocks_to_sticks)
 from .mesh import SHARD_AXIS, make_mesh
+
+logger = logging.getLogger("spfft_tpu")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -238,6 +241,7 @@ class DistributedTransformPlan:
             jax.shard_map, mesh=self.mesh, in_specs=self._base_in_specs,
             out_specs=P(self.axis_name), check_vma=self._check_vma)
         self._pair_jits = {}
+        self._batched = None
         self._backward_jit = jax.jit(shmap(self._backward_body))
         self._forward_jit = {
             s: jax.jit(shmap(functools.partial(self._forward_body,
@@ -339,7 +343,7 @@ class DistributedTransformPlan:
         self._onehot = onehot
 
     def _init_pallas(self, use_pallas: Optional[bool]) -> None:
-        """Build per-shard Pallas monotone-gather tables for the compression
+        """Build per-shard Pallas windowed-gather tables for the compression
         stages, stacked into SPMD-sharded arrays (the same kernel the local
         plan uses; see ops/gather_kernel.py).
 
@@ -347,8 +351,10 @@ class DistributedTransformPlan:
         the maximum with no-op chunks targeting a dummy output tile
         (gather_kernel.pad_tables_to); the DMA window height K and source
         rows are unified across shards (the SPMD body is one program).
-        Active when every shard's value order is stick-major/z-ascending,
-        precision is single, and the backend is TPU; ``use_pallas=True`` on
+        The kernel handles any value order (stick-major/z-ascending is
+        optimal); a shard whose order is too scattered for the chunk
+        decomposition drops ALL shards to the XLA path with a logged
+        notice. Active in single precision on TPU; ``use_pallas=True`` on
         a non-TPU backend runs the kernel in interpret mode (testing)."""
         from ..ops import gather_kernel as gk
 
@@ -367,10 +373,6 @@ class DistributedTransformPlan:
         num_slots = ms * dim_z
         if mv == 0 or num_slots == 0:
             return
-        for p in dp.shard_plans:
-            vi64 = p.value_indices.astype(np.int64)
-            if p.num_values and (np.diff(vi64) <= 0).any():
-                return  # non-monotone shard: XLA gather path for all
 
         per_shard = [gk.compression_gather_inputs(
             p.value_indices, num_slots, pad_values_to=mv)
@@ -389,6 +391,8 @@ class DistributedTransformPlan:
                           per_shard[r][which][0], per_shard[r][which][1],
                           num_src, k_rows=k)
                       for r, t in enumerate(tables)]
+            if any(t is None for t in tables):
+                return None  # a forced-K rebuild crossed the chunk ceiling
             c_max = max(t.row0.shape[0] for t in tables)
             src_rows = max(t.src_rows for t in tables)
             padded = [gk.pad_tables_to(t, c_max) for t in tables]
@@ -399,6 +403,11 @@ class DistributedTransformPlan:
         dec = build_all(0, num_src=mv, num_out=num_slots)
         cmp_ = build_all(1, num_src=num_slots, num_out=mv)
         if dec is None or cmp_ is None:
+            logger.warning(
+                "spfft_tpu: a shard's value order is too scattered for the "
+                "Pallas compression kernel — using the slower XLA gather "
+                "path (sort triplets with utils.workloads."
+                "sort_triplets_stick_major for the fast path)")
             return
         self._pallas_dist = {
             "dec": dec, "cmp": cmp_,
@@ -459,20 +468,30 @@ class DistributedTransformPlan:
         blocks = self._exchange_fn(blocks, self.axis_name, self._wire_dtype)
         return unpack_blocks_to_sticks(blocks, z_src)
 
-    def _backward_body(self, values_il, vi, slot_src, onehot, cols_flat,
-                       col_inv, zmap, z_src, *xtables):
+    def _decompress_shard(self, values_il, slot_src, ptables):
+        """Per-shard decompress: (mv, 2) -> (max_sticks, dim_z) sticks —
+        or batched (B, mv, 2) -> (B, max_sticks, dim_z) through the same
+        kernel tables (batched pallas grid / vmapped XLA gather)."""
         dp = self.dist_plan
-        ptables = xtables[:self._n_ptables]
-        ctables = xtables[self._n_ptables:self._n_ptables + self._n_ctables]
         if self._pallas_dist is not None:
-            dec_il = self._pallas_gather(values_il[0],
+            dec_il = self._pallas_gather(values_il,
                                          self._pallas_dist["dec"],
                                          ptables[:4])
-            sticks = (dec_il[:, 0] + 1j * dec_il[:, 1]).reshape(
-                dp.max_sticks, dp.dim_z)
-        else:
-            sticks = stages.decompress(values_il[0].astype(self._rdt),
-                                       slot_src[0], dp.max_sticks, dp.dim_z)
+            flat = dec_il[..., 0] + 1j * dec_il[..., 1]
+            return flat.reshape(values_il.shape[:-2]
+                                + (dp.max_sticks, dp.dim_z))
+        dec = lambda v: stages.decompress(v.astype(self._rdt), slot_src[0],
+                                          dp.max_sticks, dp.dim_z)
+        if values_il.ndim == 3:
+            return jax.vmap(dec)(values_il)
+        return dec(values_il)
+
+    def _backward_tail(self, sticks, onehot, col_inv, zmap, ctables):
+        """Per-shard pipeline after decompress: symmetry, z-IFFT, exchange,
+        plane symmetry, xy-IFFT. Input (max_sticks, dim_z); output the
+        per-shard space slab (unbatched — batched callers vmap this, the
+        collectives inside batch cleanly)."""
+        dp = self.dist_plan
         if dp.hermitian:
             # Complete every stick, then blend by the one-hot (0,0)-stick
             # mask — SPMD-safe stand-in for the reference's "owner rank
@@ -488,49 +507,95 @@ class DistributedTransformPlan:
                 if x0 == 0:
                     grid = stages.complete_plane_hermitian(grid)
                 return stages.xy_backward_r2c_split(
-                    grid, x0, dp.dim_x, dp.dim_x_freq)[None]
+                    grid, x0, dp.dim_x, dp.dim_x_freq)
             grid = stages.complete_plane_hermitian(grid)
-            return stages.xy_backward_r2c(grid, dp.dim_x)[None]
+            return stages.xy_backward_r2c(grid, dp.dim_x)
         if self._split_x is not None:
             x0, _ = self._split_x
             return complex_to_interleaved(
-                stages.xy_backward_c2c_split(grid, x0, dp.dim_x))[None]
-        return complex_to_interleaved(stages.xy_backward_c2c(grid))[None]
+                stages.xy_backward_c2c_split(grid, x0, dp.dim_x))
+        return complex_to_interleaved(stages.xy_backward_c2c(grid))
 
-    def _forward_body(self, space, vi, slot_src, onehot, cols_flat, col_inv,
-                      zmap, z_src, *xtables, scaled: bool):
-        dp = self.dist_plan
+    def _backward_body(self, values_il, vi, slot_src, onehot, cols_flat,
+                       col_inv, zmap, z_src, *xtables):
         ptables = xtables[:self._n_ptables]
         ctables = xtables[self._n_ptables:self._n_ptables + self._n_ctables]
+        sticks = self._decompress_shard(values_il[0], slot_src, ptables)
+        return self._backward_tail(sticks, onehot, col_inv, zmap,
+                                   ctables)[None]
+
+    def _backward_body_batched(self, values_il, vi, slot_src, onehot,
+                               cols_flat, col_inv, zmap, z_src, *xtables):
+        """Batched SPMD body: data carries a per-shard batch axis
+        (1, B, ...); compression runs ONE batched-grid kernel launch, the
+        rest of the pipeline (collectives included) is vmapped over B —
+        the distributed analogue of the local plan's fused batch
+        (reference interleaves N transforms by hand,
+        multi_transform_internal.hpp:47-94)."""
+        ptables = xtables[:self._n_ptables]
+        ctables = xtables[self._n_ptables:self._n_ptables + self._n_ctables]
+        sticks_b = self._decompress_shard(values_il[0], slot_src, ptables)
+        return jax.vmap(
+            lambda s: self._backward_tail(s, onehot, col_inv, zmap,
+                                          ctables))(sticks_b)[None]
+
+    def _forward_head(self, space, cols_flat, z_src, ctables):
+        """Per-shard pipeline before compress: xy-FFT, exchange, z-FFT.
+        Input the per-shard space slab; output (max_sticks, dim_z)."""
+        dp = self.dist_plan
         if dp.hermitian:
             if self._split_x is not None:
                 x0, w = self._split_x
                 grid = stages.xy_forward_r2c_split(
-                    space[0].astype(self._rdt), x0, w)
+                    space.astype(self._rdt), x0, w)
             else:
-                grid = stages.xy_forward_r2c(space[0].astype(self._rdt))
+                grid = stages.xy_forward_r2c(space.astype(self._rdt))
         elif self._split_x is not None:
             x0, w = self._split_x
             grid = stages.xy_forward_c2c_split(
-                interleaved_to_complex(space[0]).astype(self._cdt), x0, w)
+                interleaved_to_complex(space).astype(self._cdt), x0, w)
         else:
             grid = stages.xy_forward_c2c(
-                interleaved_to_complex(space[0]).astype(self._cdt))
+                interleaved_to_complex(space).astype(self._cdt))
         sticks = self._exchange_grid_to_sticks(grid, cols_flat, z_src,
                                                ctables)
-        sticks = stages.z_forward(sticks)
+        return stages.z_forward(sticks)
+
+    def _compress_shard(self, sticks, vi, ptables, scaled: bool):
+        """Per-shard compress: (max_sticks, dim_z) -> (mv, 2) values —
+        or batched (B, ...) -> (B, mv, 2)."""
         scale = 1.0 / self.global_size if scaled else None
+        batch = sticks.shape[:-2]
         # vi carries the sentinel max_sticks*dim_z for value padding
-        flat = jnp.stack([jnp.real(sticks).reshape(-1),
-                          jnp.imag(sticks).reshape(-1)], axis=-1)
+        flat = jnp.stack([jnp.real(sticks).reshape(batch + (-1,)),
+                          jnp.imag(sticks).reshape(batch + (-1,))], axis=-1)
         if self._pallas_dist is not None:
             values = self._pallas_gather(flat, self._pallas_dist["cmp"],
                                          ptables[4:8])
+        elif flat.ndim == 3:
+            values = jax.vmap(
+                lambda f: stages.gather_rows_with_sentinel(f, vi[0]))(flat)
         else:
             values = stages.gather_rows_with_sentinel(flat, vi[0])
         if scale is not None:
             values = values * jnp.asarray(scale, self._rdt)
-        return values[None]
+        return values
+
+    def _forward_body(self, space, vi, slot_src, onehot, cols_flat, col_inv,
+                      zmap, z_src, *xtables, scaled: bool):
+        ptables = xtables[:self._n_ptables]
+        ctables = xtables[self._n_ptables:self._n_ptables + self._n_ctables]
+        sticks = self._forward_head(space[0], cols_flat, z_src, ctables)
+        return self._compress_shard(sticks, vi, ptables, scaled)[None]
+
+    def _forward_body_batched(self, space, vi, slot_src, onehot, cols_flat,
+                              col_inv, zmap, z_src, *xtables, scaled: bool):
+        ptables = xtables[:self._n_ptables]
+        ctables = xtables[self._n_ptables:self._n_ptables + self._n_ctables]
+        sticks_b = jax.vmap(
+            lambda s: self._forward_head(s, cols_flat, z_src,
+                                         ctables))(space[0])
+        return self._compress_shard(sticks_b, vi, ptables, scaled)[None]
 
     def _pair_shmap(self, n_fn_args: int):
         """shard_map wrapper for the fused-pair entry points: base specs
@@ -759,6 +824,68 @@ class DistributedTransformPlan:
         with timed_transform("forward") as box:
             box.value = self._forward_jit[scaling](space,
                                                    *self._device_tables)
+        return box.value
+
+    # -- batched execution ---------------------------------------------------
+    def _batched_jits(self):
+        """Lazily-built fused batch executables: one SPMD program with a
+        per-shard batch axis (S, B, ...) — N shared-plan transforms become
+        one program with B× larger FFT batches, one batched-grid kernel
+        launch per compression stage and vmapped collectives, instead of N
+        dispatches (the reference's hand-interleaved multi-transform
+        overlap, multi_transform_internal.hpp:47-94)."""
+        if self._batched is None:
+            shmap = functools.partial(
+                jax.shard_map, mesh=self.mesh, in_specs=self._base_in_specs,
+                out_specs=P(self.axis_name), check_vma=self._check_vma)
+            self._batched = {
+                "backward": jax.jit(shmap(self._backward_body_batched)),
+                Scaling.NONE: jax.jit(shmap(functools.partial(
+                    self._forward_body_batched, scaled=False))),
+                Scaling.FULL: jax.jit(shmap(functools.partial(
+                    self._forward_body_batched, scaled=True))),
+            }
+        return self._batched
+
+    def shard_values_batch(self, values_batch: Sequence) -> jax.Array:
+        """B per-transform value sets (each a per-shard list or a padded
+        sharded (S, mv, 2) array) -> one (S, B, mv, 2) sharded array."""
+        arrs = [v if isinstance(v, jax.Array) else self.shard_values(v)
+                for v in values_batch]
+        return jnp.stack(arrs, axis=1)
+
+    def unshard_values_batch(self, values: jax.Array):
+        """(S, B, mv, 2) -> list of B per-shard numpy complex value lists."""
+        arr = np.asarray(values)
+        return [self.unshard_values(arr[:, b]) for b in range(arr.shape[1])]
+
+    def backward_batched(self, values_batch) -> jax.Array:
+        """Backward-execute a shared-plan batch as ONE fused SPMD program.
+        ``values_batch``: a (S, B, mv, 2) sharded array or a sequence of B
+        value sets. Returns the (S, B, planes, ...) sharded space array."""
+        if not (isinstance(values_batch, jax.Array)
+                and values_batch.ndim == 4):
+            values_batch = self.shard_values_batch(values_batch)
+        with timed_transform("backward_batched") as box:
+            box.value = self._batched_jits()["backward"](
+                values_batch, *self._device_tables)
+        return box.value
+
+    def forward_batched(self, space_batch,
+                        scaling: Scaling = Scaling.NONE) -> jax.Array:
+        """Forward-execute a shared-plan batch as ONE fused SPMD program.
+        ``space_batch``: a (S, B, planes, ...) sharded array or a sequence
+        of B per-shard slab lists. Returns the (S, B, mv, 2) values."""
+        scaling = Scaling(scaling)
+        nd = 4 if self.dist_plan.hermitian else 5
+        if not (isinstance(space_batch, jax.Array)
+                and space_batch.ndim == nd + 1):
+            space_batch = jnp.stack(
+                [s if isinstance(s, jax.Array) else self.shard_space(s)
+                 for s in space_batch], axis=1)
+        with timed_transform("forward_batched") as box:
+            box.value = self._batched_jits()[scaling](
+                space_batch, *self._device_tables)
         return box.value
 
 

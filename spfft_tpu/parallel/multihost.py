@@ -49,14 +49,27 @@ def initialize(coordinator_address: Optional[str] = None,
         return  # single-process mode
     # Must not touch jax.devices()/process_count() here: any backend query
     # initializes XLA, after which jax.distributed.initialize refuses to
-    # run. Detect prior bring-up via the distributed client state instead.
-    from jax._src import distributed as _dist_state
-    if getattr(_dist_state.global_state, "client", None) is not None:
+    # run. Detect prior bring-up via the distributed client state — a
+    # private JAX module, so probe it defensively: if the internals moved,
+    # fall through and let initialize() itself report double bring-up.
+    try:
+        from jax._src import distributed as _dist_state
+        already = getattr(_dist_state.global_state, "client", None) is not None
+    except Exception:  # pragma: no cover - depends on JAX version
+        already = False
+    if already:
         return  # already initialized
     try:
         jax.distributed.initialize(coordinator_address=coordinator_address,
                                    num_processes=num_processes,
                                    process_id=process_id)
+    except RuntimeError as e:  # pragma: no cover - environment-dependent
+        msg = str(e).lower()
+        # jax's double-init message is "...should only be called once";
+        # match loosely in case the wording shifts again
+        if "already initialized" in msg or "only be called once" in msg:
+            return
+        raise DistributedError(f"jax.distributed initialization failed: {e}")
     except Exception as e:  # pragma: no cover - environment-dependent
         raise DistributedError(f"jax.distributed initialization failed: {e}")
 
@@ -80,9 +93,12 @@ def plan_fingerprint(dist_plan: DistributedIndexPlan) -> bytes:
     return h.digest()
 
 
-def _check_digests(digests: np.ndarray, local: bytes) -> None:
+def _check_digests(digests: np.ndarray, local: bytes,
+                   process_index: Optional[int] = None) -> None:
     """Compare per-process digests (rows of a (P, 16) uint8 array); raise
     naming the mismatching processes. Split out for unit testing."""
+    if process_index is None:
+        process_index = jax.process_index()
     rows = np.asarray(digests, np.uint8).reshape(-1, len(local))
     local_row = np.frombuffer(local, np.uint8)
     bad = [p for p in range(rows.shape[0])
@@ -90,23 +106,47 @@ def _check_digests(digests: np.ndarray, local: bytes) -> None:
     if bad:
         raise ParameterMismatchError(
             "distributed plan parameters differ across processes: "
-            f"process(es) {bad} disagree with process {jax.process_index()} "
+            f"process(es) {bad} disagree with process {process_index} "
             "(all hosts must construct the plan with identical dims, "
             "transform type, plane split and stick sets)")
 
 
-def validate_consistent(dist_plan: DistributedIndexPlan) -> None:
+def _default_collective():
+    """(allgather, process_count, process_index) from the live JAX process
+    group — the production collective behind the injectable seam."""
+    from jax.experimental import multihost_utils
+    return (multihost_utils.process_allgather, jax.process_count(),
+            jax.process_index())
+
+
+def _resolve_collective(collective):
+    """An injected collective triple wins; otherwise the live process group
+    (queried only when multi-process, so single-process callers never touch
+    the backend here)."""
+    if collective is not None:
+        return collective
+    if jax.process_count() > 1:
+        return _default_collective()
+    return (None, 1, 0)
+
+
+def validate_consistent(dist_plan: DistributedIndexPlan, *,
+                        collective=None) -> None:
     """Cross-host parameter-mismatch detection (reference:
     grid_internal.cpp:148-167 allreduce check). Collective: every process
     must call it with its locally-built plan; raises
-    ``ParameterMismatchError`` on any process whose plan differs."""
-    local = plan_fingerprint(dist_plan)
-    if jax.process_count() == 1:
+    ``ParameterMismatchError`` on any process whose plan differs.
+
+    ``collective`` is an injectable ``(allgather, process_count,
+    process_index)`` triple (default: the live ``jax.distributed`` process
+    group via ``multihost_utils.process_allgather``) so the multi-process
+    logic is unit-testable without a real cluster."""
+    allgather, process_count, process_index = _resolve_collective(collective)
+    if process_count == 1:
         return
-    from jax.experimental import multihost_utils
-    gathered = multihost_utils.process_allgather(
-        np.frombuffer(local, np.uint8))
-    _check_digests(gathered, local)
+    local = plan_fingerprint(dist_plan)
+    gathered = allgather(np.frombuffer(local, np.uint8))
+    _check_digests(gathered, local, process_index)
 
 
 def _pad_gather_triplets(triplets: Sequence[np.ndarray], max_rows: int):
@@ -125,7 +165,8 @@ def build_distributed_plan_multihost(
         transform_type: TransformType, dim_x: int, dim_y: int, dim_z: int,
         local_triplets: Sequence[np.ndarray],
         local_planes: Sequence[int],
-        shards_per_process: Optional[int] = None) -> DistributedIndexPlan:
+        shards_per_process: Optional[int] = None, *,
+        collective=None) -> DistributedIndexPlan:
     """Build the global distribution plan when each process only knows its
     own shards' sparse indices.
 
@@ -136,39 +177,65 @@ def build_distributed_plan_multihost(
     stick lists are exchanged with one process-level allgather, mirroring
     the reference's P2P stick-list exchange (indices.hpp:58-102), and the
     identical global plan is built and validated on every process.
+
+    ``collective`` is an injectable ``(allgather, process_count,
+    process_index)`` triple (default: the live ``jax.distributed`` process
+    group) — see :func:`validate_consistent`.
     """
     if shards_per_process is None:
         shards_per_process = len(local_triplets)
+    if shards_per_process < 1:
+        raise ParameterMismatchError(
+            "shards_per_process must be >= 1: every process must own at "
+            "least one shard (an empty shard is a valid owner of zero "
+            "sticks/planes, a shardless process is not)")
     if len(local_triplets) != shards_per_process \
             or len(local_planes) != shards_per_process:
         raise ParameterMismatchError(
             f"expected {shards_per_process} local shards, got "
             f"{len(local_triplets)} triplet lists / {len(local_planes)} "
             "plane counts")
-    if jax.process_count() == 1:
+    allgather, process_count, process_index = _resolve_collective(collective)
+    if process_count == 1:
         return build_distributed_plan(transform_type, dim_x, dim_y, dim_z,
                                       local_triplets, local_planes)
-    from jax.experimental import multihost_utils
     # Fail fast on unequal shard counts BEFORE any shaped collective: a
     # (2,) vs (3,) allgather mismatch would hang or die opaquely inside XLA.
-    all_nshards = np.asarray(multihost_utils.process_allgather(
-        np.int64(shards_per_process))).reshape(-1)
+    all_nshards = np.asarray(
+        allgather(np.int64(shards_per_process))).reshape(-1)
     if not (all_nshards == shards_per_process).all():
         raise ParameterMismatchError(
             "shards_per_process differs across processes: "
             f"{all_nshards.tolist()}")
+    # Cross-check the scalar constructor parameters BEFORE building anything
+    # (the reference's first allreduce, grid_internal.cpp:148-167): a dim
+    # mismatch must raise on EVERY process in the same collective round —
+    # discovering it later through a local Σplanes!=dim_z failure would
+    # leave the agreeing processes hanging in the next collective.
+    params = np.asarray([dim_x, dim_y, dim_z,
+                         int(TransformType(transform_type) is
+                             TransformType.R2C)], np.int64)
+    all_params = np.asarray(allgather(params)).reshape(-1, 4)
+    if not (all_params == params).all():
+        bad = [p for p in range(all_params.shape[0])
+               if not np.array_equal(all_params[p], params)]
+        raise ParameterMismatchError(
+            "transform parameters differ across processes: process(es) "
+            f"{bad} disagree with process {process_index} on "
+            "(dim_x, dim_y, dim_z, transform_type): "
+            f"{all_params.tolist()}")
     counts = np.asarray([len(np.asarray(t).reshape(-1, 3))
                          for t in local_triplets], np.int64)
-    all_counts = multihost_utils.process_allgather(counts)
+    all_counts = allgather(counts)
     max_rows = max(1, int(np.asarray(all_counts).max()))
     block = _pad_gather_triplets(local_triplets, max_rows)
-    all_blocks = multihost_utils.process_allgather(block)
-    all_planes = multihost_utils.process_allgather(
-        np.asarray(local_planes, np.int64))
+    all_blocks = allgather(block)
+    all_planes = allgather(np.asarray(local_planes, np.int64))
     all_blocks = np.asarray(all_blocks).reshape(-1, max_rows, 4)
     all_planes = np.asarray(all_planes).reshape(-1)
     triplets_per_shard = [b[b[:, 3] == 1][:, :3] for b in all_blocks]
     plan = build_distributed_plan(transform_type, dim_x, dim_y, dim_z,
                                   triplets_per_shard, list(all_planes))
-    validate_consistent(plan)
+    validate_consistent(
+        plan, collective=(allgather, process_count, process_index))
     return plan

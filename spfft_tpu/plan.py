@@ -22,11 +22,14 @@ complex format.
 from __future__ import annotations
 
 import functools
+import logging
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+logger = logging.getLogger("spfft_tpu")
 
 from .errors import InvalidParameterError
 from .indexing import IndexPlan, build_index_plan
@@ -77,11 +80,14 @@ class TransformPlan:
         }
 
     def _init_pallas(self, use_pallas: Optional[bool]) -> None:
-        """Enable the Pallas monotone-gather compression path when the value
-        order is stick-major/z-ascending (strictly increasing flat indices —
-        the layout the reference recommends for performance, details.rst
-        "Data Distribution") on a TPU backend in single precision. Otherwise
-        the XLA gather path is used.
+        """Enable the Pallas windowed-gather compression path (TPU backend,
+        single precision). The kernel handles any value order; stick-major/
+        z-ascending order (the layout the reference recommends for
+        performance, details.rst "Data Distribution";
+        ``utils.workloads.sort_triplets_stick_major``) gives the minimal
+        chunk decomposition. A value order so scattered that the chunk
+        decomposition would lose to the XLA gather falls back with a logged
+        notice.
 
         ``use_pallas=True`` on a non-TPU backend builds the tables (useful
         for interpret-mode testing) but execution stays on the XLA path; the
@@ -103,16 +109,24 @@ class TransformPlan:
             and self.index_plan.num_values >= 500_000
         if use_pallas is False or (use_pallas is None and not auto):
             return
-        vi = p.value_indices.astype(np.int64)
-        if p.num_values == 0 or p.num_sticks == 0 \
-                or (np.diff(vi) <= 0).any():
+        if p.num_values == 0 or p.num_sticks == 0:
             return
+        vi = p.value_indices.astype(np.int64)
         num_slots = p.num_sticks * p.dim_z
         (dec_idx, occupied), (cmp_idx, cmp_valid) = \
             gk.compression_gather_inputs(vi, num_slots)
         dec = gk.build_monotone_gather_tables(dec_idx, occupied, p.num_values)
         cmp_ = gk.build_monotone_gather_tables(cmp_idx, cmp_valid, num_slots)
         self._pallas = {"dec": dec, "cmp": cmp_}
+        if dec is None or cmp_ is None:
+            fell_back = [n for n, t in (("decompress", dec),
+                                        ("compress", cmp_)) if t is None]
+            logger.warning(
+                "spfft_tpu: value order too scattered for the Pallas "
+                "compression kernel (%s) — using the slower XLA gather "
+                "path there (sort triplets with utils.workloads."
+                "sort_triplets_stick_major for the fast path)",
+                " and ".join(fell_back))
         if dec is None and cmp_ is None:
             self._pallas = None
             return
@@ -153,6 +167,13 @@ class TransformPlan:
         self._split_x = (x0, w)
         self._tables["col_inv_sub"] = jnp.asarray(col_inv_sub)
         self._tables["scatter_cols_sub"] = jnp.asarray(cols_sub)
+
+    @property
+    def pallas_active(self) -> bool:
+        """True when the compression stages run the Pallas windowed-gather
+        kernel (TPU backend, single precision, value order coherent enough
+        for the chunk decomposition). False means the XLA gather path."""
+        return self._pallas_active
 
     # -- reference Transform getters (transform.hpp:91-151) -----------------
     @property
@@ -241,9 +262,9 @@ class TransformPlan:
             values = values * jnp.asarray(scale, values.dtype)
         return values
 
-    def _backward_impl(self, values_il, tables, *, pallas=True):
+    def _backward_rest(self, sticks, tables):
+        """Everything after decompress: symmetry, z-IFFT, unpack, xy-IFFT."""
         p = self.index_plan
-        sticks = self._decompress(values_il, tables, pallas)
         if self._is_r2c and p.zero_stick_id is not None:
             zid = p.zero_stick_id
             sticks = sticks.at[zid].set(
@@ -267,8 +288,12 @@ class TransformPlan:
             return stages.xy_backward_r2c(grid, p.dim_x)
         return complex_to_interleaved(stages.xy_backward_c2c(grid))
 
-    def _forward_impl(self, space, tables, *, scaled: bool, pallas=True):
-        p = self.index_plan
+    def _backward_impl(self, values_il, tables, *, pallas=True):
+        return self._backward_rest(
+            self._decompress(values_il, tables, pallas), tables)
+
+    def _forward_head(self, space, tables):
+        """Everything before compress: xy-FFT, pack, z-FFT -> sticks."""
         if self._is_r2c:
             if self._split_x is not None:
                 x0, w = self._split_x
@@ -289,33 +314,89 @@ class TransformPlan:
             grid = stages.xy_forward_c2c(
                 interleaved_to_complex(space).astype(self._cdt))
             sticks = stages.grid_to_sticks(grid, tables["scatter_cols"])
-        sticks = stages.z_forward(sticks)
+        return stages.z_forward(sticks)
+
+    def _forward_impl(self, space, tables, *, scaled: bool, pallas=True):
+        sticks = self._forward_head(space, tables)
         scale = 1.0 / self.global_size if scaled else None
         return self._compress(sticks, tables, scale, pallas)
 
     # -- batched execution ---------------------------------------------------
+    def _decompress_batched(self, values_b, tables):
+        """(B, num_values, 2) -> (B, num_sticks, dim_z) — one batched-grid
+        kernel launch when the Pallas path is active, vmapped XLA gather
+        otherwise."""
+        p = self.index_plan
+        if not self._pallas_active or self._pallas["dec"] is None:
+            return jax.vmap(
+                lambda v: stages.decompress(v.astype(self._rdt),
+                                            tables["slot_src"],
+                                            p.num_sticks, p.dim_z))(values_b)
+        from .ops import gather_kernel as gk
+        t = self._pallas["dec"]
+        re, im = gk.planar_from_interleaved(values_b.astype(np.float32),
+                                            t.src_rows)
+        out_re, out_im = gk.monotone_gather(
+            re, im, tables["dec_row0"], tables["dec_out_tile"],
+            tables["dec_first"], tables["dec_packed"],
+            span_rows=t.span_rows, src_rows=t.src_rows,
+            num_tiles=t.num_tiles)
+        B = values_b.shape[0]
+        flat = (out_re.reshape(B, -1)[:, :t.num_out]
+                + 1j * out_im.reshape(B, -1)[:, :t.num_out])
+        return flat.reshape(B, p.num_sticks, p.dim_z)
+
+    def _compress_batched(self, sticks_b, tables, scale):
+        """(B, num_sticks, dim_z) -> (B, num_values, 2)."""
+        p = self.index_plan
+        if not self._pallas_active or self._pallas["cmp"] is None:
+            return jax.vmap(
+                lambda s: stages.compress(s, tables["value_indices"],
+                                          scale))(sticks_b)
+        from .ops import gather_kernel as gk
+        t = self._pallas["cmp"]
+        B = sticks_b.shape[0]
+        flat_il = jnp.stack([jnp.real(sticks_b).reshape(B, -1),
+                             jnp.imag(sticks_b).reshape(B, -1)], axis=-1)
+        re, im = gk.planar_from_interleaved(flat_il, t.src_rows)
+        out_re, out_im = gk.monotone_gather(
+            re, im, tables["cmp_row0"], tables["cmp_out_tile"],
+            tables["cmp_first"], tables["cmp_packed"],
+            span_rows=t.span_rows, src_rows=t.src_rows,
+            num_tiles=t.num_tiles)
+        values = gk.interleaved_from_planar(out_re, out_im, t.num_out)
+        if scale is not None:
+            values = values * jnp.asarray(scale, values.dtype)
+        return values
+
+    def _backward_impl_batched(self, values_b, tables):
+        sticks_b = self._decompress_batched(values_b, tables)
+        return jax.vmap(self._backward_rest,
+                        in_axes=(0, None))(sticks_b, tables)
+
+    def _forward_impl_batched(self, space_b, tables, *, scaled: bool):
+        sticks_b = jax.vmap(self._forward_head,
+                            in_axes=(0, None))(space_b, tables)
+        scale = 1.0 / self.global_size if scaled else None
+        return self._compress_batched(sticks_b, tables, scale)
+
     def _batched_jits(self):
-        """Lazily-built vmapped executables over a leading batch axis.
+        """Lazily-built batched executables over a leading batch axis.
 
         The reference's multi-transform hand-interleaves the phases of N
         transforms for comm/compute overlap (reference:
         multi_transform_internal.hpp:47-145). For N transforms sharing one
         plan, the TPU-native form is a single executable with a batch
         dimension: XLA sees N× larger FFT batches and one gather per stage
-        instead of N dispatches."""
+        instead of N dispatches. The compression stages run the Pallas
+        kernel with a batched grid (same tables, one launch) when active."""
         if self._batched is None:
             self._batched = {
-                "backward": jax.jit(jax.vmap(
-                    functools.partial(self._backward_impl, pallas=False),
-                    in_axes=(0, None))),
-                Scaling.NONE: jax.jit(jax.vmap(
-                    functools.partial(self._forward_impl, scaled=False,
-                                      pallas=False),
-                    in_axes=(0, None))),
-                Scaling.FULL: jax.jit(jax.vmap(
-                    functools.partial(self._forward_impl, scaled=True,
-                                      pallas=False),
-                    in_axes=(0, None))),
+                "backward": jax.jit(self._backward_impl_batched),
+                Scaling.NONE: jax.jit(functools.partial(
+                    self._forward_impl_batched, scaled=False)),
+                Scaling.FULL: jax.jit(functools.partial(
+                    self._forward_impl_batched, scaled=True)),
             }
         return self._batched
 

@@ -14,7 +14,7 @@ import pytest
 from spfft_tpu import (Scaling, TransformType, make_local_plan,
                        multi_transform_backward, multi_transform_forward)
 from spfft_tpu.grid import Transform
-from spfft_tpu.multi import _shared_local_plan
+from spfft_tpu.multi import _shared_plan
 from spfft_tpu.utils import as_complex_np
 
 from test_util import (hermitian_triplets, random_sparse_triplets,
@@ -75,7 +75,7 @@ def test_multi_transform_takes_fused_path_for_shared_plan():
     plan, vals = _c2c_plan_and_values(3, rng)
     base = Transform(plan)
     clones = [base.clone() for _ in range(3)]
-    assert _shared_local_plan(clones) is plan
+    assert _shared_plan(clones) is plan
     outs = multi_transform_backward(clones, vals)
     for i, v in enumerate(vals):
         np.testing.assert_allclose(np.asarray(outs[i]),
@@ -146,7 +146,7 @@ def test_multi_transform_distinct_plans_still_works():
     plan_b = make_local_plan(TransformType.C2C, 8, 8, 8, triplets,
                              precision="double")
     transforms = [Transform(plan_a), Transform(plan_b)]
-    assert _shared_local_plan(transforms) is None
+    assert _shared_plan(transforms) is None
     vals = [vals_a[0], random_values(rng, len(triplets))]
     outs = multi_transform_backward(transforms, vals)
     np.testing.assert_allclose(np.asarray(outs[0]),
@@ -155,6 +155,100 @@ def test_multi_transform_distinct_plans_still_works():
     np.testing.assert_allclose(np.asarray(outs[1]),
                                np.asarray(plan_b.backward(vals[1])),
                                atol=1e-12, rtol=0)
+
+
+def _distributed_plan_and_values(batch, rng, shards=4,
+                                 exchange=None):
+    from spfft_tpu.parallel import make_distributed_plan, make_mesh
+    from test_distributed import split_by_sticks, split_planes
+    dims = (10, 9, 11)
+    triplets = random_sparse_triplets(rng, dims)
+    parts = split_by_sticks(triplets, dims, [2, 1, 0, 1])
+    planes = split_planes(dims[2], [1, 2, 1, 1])
+    kwargs = {} if exchange is None else {"exchange": exchange}
+    plan = make_distributed_plan(TransformType.C2C, *dims, parts, planes,
+                                 mesh=make_mesh(shards), precision="double",
+                                 **kwargs)
+    vals = [[random_values(rng, len(p)) for p in parts]
+            for _ in range(batch)]
+    return plan, vals
+
+
+@pytest.mark.parametrize("exchange", [None, "compact", "unbuffered"])
+def test_distributed_batched_backward_matches_single(exchange):
+    """One fused SPMD batch program == N sequential distributed dispatches,
+    for every exchange mechanism (vmapped collectives included)."""
+    from spfft_tpu import ExchangeType
+    exch = {None: None, "compact": ExchangeType.COMPACT_BUFFERED,
+            "unbuffered": ExchangeType.UNBUFFERED}[exchange]
+    rng = np.random.default_rng(21)
+    plan, vals = _distributed_plan_and_values(3, rng, exchange=exch)
+    stacked = np.asarray(plan.backward_batched(vals))
+    assert stacked.shape[1] == 3  # (S, B, planes, y, x, 2)
+    for i, v in enumerate(vals):
+        single = np.asarray(plan.backward(v))
+        np.testing.assert_allclose(stacked[:, i], single, atol=1e-12,
+                                   rtol=0)
+
+
+def test_distributed_batched_forward_matches_single():
+    rng = np.random.default_rng(22)
+    plan, vals = _distributed_plan_and_values(3, rng)
+    spaces = [plan.backward(v) for v in vals]
+    stacked = np.asarray(plan.forward_batched(spaces, Scaling.FULL))
+    for i, s in enumerate(spaces):
+        single = np.asarray(plan.forward(s, Scaling.FULL))
+        np.testing.assert_allclose(stacked[:, i], single, atol=1e-12,
+                                   rtol=0)
+
+
+def test_multi_transform_fused_distributed_batch():
+    """multi_transform_* on clones of one distributed plan takes the fused
+    SPMD batch path and matches per-transform execution."""
+    from spfft_tpu.multi import _shared_plan
+    rng = np.random.default_rng(23)
+    plan, vals = _distributed_plan_and_values(3, rng)
+    base = Transform(plan)
+    clones = [base.clone() for _ in range(3)]
+    assert _shared_plan(clones) is plan
+    outs = multi_transform_backward(clones, vals)
+    for i, v in enumerate(vals):
+        np.testing.assert_allclose(np.asarray(outs[i]),
+                                   np.asarray(plan.backward(v)),
+                                   atol=1e-12, rtol=0)
+    fouts = multi_transform_forward(clones, outs)
+    for i, o in enumerate(outs):
+        np.testing.assert_allclose(np.asarray(fouts[i]),
+                                   np.asarray(plan.forward(o)),
+                                   atol=1e-12, rtol=0)
+
+
+def test_distributed_batched_r2c():
+    from spfft_tpu.parallel import make_distributed_plan, make_mesh
+    from test_distributed import split_by_sticks, split_planes
+    rng = np.random.default_rng(24)
+    dims = (8, 9, 10)
+    triplets = hermitian_triplets(rng, dims)
+    parts = split_by_sticks(triplets, dims, [1, 1, 1, 1])
+    planes = split_planes(dims[2], [1, 1, 1, 1])
+    plan = make_distributed_plan(TransformType.R2C, *dims, parts, planes,
+                                 mesh=make_mesh(4), precision="double")
+    vals = [[random_values(rng, len(p)) for p in parts] for _ in range(2)]
+    # hermitian-consistent values: sample a real field's spectrum per batch
+    for b in range(2):
+        space = rng.standard_normal((dims[2], dims[1], dims[0]))
+        freq = np.fft.fftn(space)
+        for r, p in enumerate(parts):
+            st = p.copy()
+            for ax, d in enumerate(dims):
+                st[:, ax] = np.where(st[:, ax] < 0, st[:, ax] + d,
+                                     st[:, ax])
+            vals[b][r] = freq[st[:, 2], st[:, 1], st[:, 0]]
+    stacked = np.asarray(plan.backward_batched(vals))
+    for i, v in enumerate(vals):
+        single = np.asarray(plan.backward(v))
+        np.testing.assert_allclose(stacked[:, i], single, atol=1e-10,
+                                   rtol=0)
 
 
 def test_iterate_pointwise_matches_sequential():

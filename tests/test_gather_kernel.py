@@ -1,5 +1,6 @@
-"""Unit tests for the Pallas monotone-gather kernel (interpret mode on CPU)
-and its plan-time chunked table builder."""
+"""Unit tests for the Pallas windowed-gather kernel (interpret mode on CPU)
+and its plan-time chunked table builder, including non-monotone index
+orders (the generalized decomposition) and the disorder fallback."""
 
 import numpy as np
 import pytest
@@ -54,14 +55,23 @@ def test_single_tile_and_exact_tile():
 def test_large_span_chunks():
     """A tile whose source span exceeds one K-row window splits into several
     accumulation chunks instead of falling back (the spherical-cutoff edge
-    case: near-empty sticks with ~256-slot gaps)."""
+    case: sparsely-filled sticks with regular gaps)."""
     rng = np.random.default_rng(3)
-    idx = np.arange(gk.TILE) * 2 * gk.TILE_LANE  # gaps of 256 elements
+    idx = np.arange(gk.TILE) * 16  # gaps of 16 elements: 128-row span
     n_src = int(idx[-1]) + 1
     src = rng.random((n_src, 2)).astype(np.float32)
     out, t = run_gather(src, idx, np.ones(len(idx), bool), k_rows=8)
     assert len(t.row0) > t.num_tiles  # really multi-chunk
     np.testing.assert_array_equal(out, src[idx])
+
+
+def test_extreme_gaps_fall_back():
+    """~0.4% DMA efficiency (one useful value per two 128-lane rows) is past
+    the chunk ceiling: the builder declines and the XLA gather runs."""
+    idx = np.arange(gk.TILE) * 2 * gk.TILE_LANE
+    n_src = int(idx[-1]) + 1
+    assert gk.build_monotone_gather_tables(
+        idx, np.ones(len(idx), bool), n_src, k_rows=8) is None
 
 
 def test_chunking_across_k_choices():
@@ -76,9 +86,51 @@ def test_chunking_across_k_choices():
         np.testing.assert_array_equal(out, ref)
 
 
-def test_non_monotone_rejected():
+def test_non_monotone_small_supported():
+    """Non-monotone indices within one window are handled directly."""
     idx = np.array([5, 3, 7])
-    assert gk.build_monotone_gather_tables(idx, np.ones(3, bool), 10) is None
+    src = np.arange(20, dtype=np.float32).reshape(10, 2)
+    out, _ = run_gather(src, idx, np.ones(3, bool))
+    np.testing.assert_array_equal(out, src[idx])
+
+
+def test_block_shuffled_order_supported():
+    """Sticks visited in shuffled order (z-sorted within each) — the
+    realistic unsorted layout: per-tile windows stay bounded, the kernel
+    path stays active, results match."""
+    rng = np.random.default_rng(11)
+    n_sticks, dim_z = 80, 64
+    order = rng.permutation(n_sticks)
+    idx = (order[:, None] * dim_z + np.arange(dim_z)[None, :]).reshape(-1)
+    src = rng.random((n_sticks * dim_z, 2)).astype(np.float32)
+    out, t = run_gather(src, idx, np.ones(len(idx), bool))
+    np.testing.assert_array_equal(out, src[idx])
+
+
+def test_fully_random_large_order_falls_back():
+    """A big fully-shuffled index set exceeds the chunk ceiling and the
+    builder declines (the XLA gather is the better program there)."""
+    rng = np.random.default_rng(12)
+    L = 1 << 17
+    idx = rng.permutation(L).astype(np.int64)
+    assert gk.build_monotone_gather_tables(idx, np.ones(L, bool), L) is None
+
+
+def test_gather_inputs_unsorted_values():
+    """compression_gather_inputs for an unsorted value order: decompress
+    indices point at each slot's position in the USER order; round-trip
+    through both directions reproduces the values."""
+    rng = np.random.default_rng(13)
+    num_slots = 400
+    vi = rng.choice(num_slots, 120, replace=False)  # unsorted, unique
+    (dec_idx, occ), (cmp_idx, cmp_valid) = \
+        gk.compression_gather_inputs(vi, num_slots)
+    vals = rng.random(120)
+    slots = np.where(occ, vals[dec_idx], 0.0)
+    expect = np.zeros(num_slots)
+    expect[vi] = vals
+    np.testing.assert_array_equal(slots, expect)
+    np.testing.assert_array_equal(slots[cmp_idx][cmp_valid], vals)
 
 
 def test_plan_pallas_path_interpret():
@@ -133,6 +185,36 @@ def test_plan_compress_tables_interpret():
     slots[ip.value_indices] = vals_il
     out = np.asarray(gk.run_monotone_gather(
         jnp.asarray(slots), pl_plan._pallas["cmp"], interpret=True))
+    np.testing.assert_array_equal(out, vals_il)
+
+
+def test_plan_shuffled_triplets_kernel_path():
+    """Shuffled triplet order (not stick-major) still builds Pallas tables
+    via the generalized windowed decomposition; both direction kernels
+    reproduce the XLA scatter/gather semantics for the USER's order."""
+    from spfft_tpu import TransformType, make_local_plan
+    rng = np.random.default_rng(21)
+    n = 12
+    triplets = [(x, y, z) for x in range(n) for y in range(n)
+                if (x + y) % 2 == 0 for z in range(n)]
+    triplets = np.asarray(triplets, np.int32)
+    triplets = triplets[rng.permutation(len(triplets))]
+    plan = make_local_plan(TransformType.C2C, n, n, n, triplets,
+                           precision="single", use_pallas=True)
+    assert plan._pallas is not None
+    assert plan._pallas["dec"] is not None
+    assert plan._pallas["cmp"] is not None
+    ip = plan.index_plan
+    vals_il = rng.random((ip.num_values, 2)).astype(np.float32)
+    # decompress: slots in plan storage order from user-order values
+    sticks = np.asarray(gk.run_monotone_gather(
+        jnp.asarray(vals_il), plan._pallas["dec"], interpret=True))
+    expect = np.zeros((ip.num_sticks * n, 2), np.float32)
+    expect[ip.value_indices] = vals_il
+    np.testing.assert_array_equal(sticks, expect)
+    # compress: user-order values back out of the slots
+    out = np.asarray(gk.run_monotone_gather(
+        jnp.asarray(expect), plan._pallas["cmp"], interpret=True))
     np.testing.assert_array_equal(out, vals_il)
 
 

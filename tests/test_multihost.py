@@ -6,6 +6,8 @@ process; these tests exercise (a) the single-process fallbacks end-to-end,
 mirroring the reference's allreduce parameter-mismatch detection tests
 (reference: grid_internal.cpp:148-167, parameters.cpp:81-109)."""
 
+import threading
+
 import numpy as np
 import pytest
 
@@ -16,6 +18,46 @@ from spfft_tpu import (ParameterMismatchError, TransformType,
 from spfft_tpu.parallel import multihost
 
 from test_util import random_sparse_triplets
+
+
+class StubWorld:
+    """A P-process world for the injectable multihost collective: each
+    simulated process runs on its own thread; ``allgather`` is a
+    barrier-synchronised stack of every process's contribution — the same
+    lockstep semantics as ``multihost_utils.process_allgather``."""
+
+    def __init__(self, num_processes: int):
+        self.num_processes = num_processes
+        self._barrier = threading.Barrier(num_processes, timeout=30)
+        self._slots = [None] * num_processes
+
+    def collective(self, process_index: int):
+        def allgather(x):
+            self._slots[process_index] = np.asarray(x)
+            self._barrier.wait()  # everyone wrote
+            out = np.stack([np.asarray(s) for s in self._slots])
+            self._barrier.wait()  # everyone read before the next round
+            return out
+        return (allgather, self.num_processes, process_index)
+
+    def run(self, fn):
+        """Run ``fn(process_index, collective)`` on every process; returns
+        the per-process result or raised exception."""
+        results = [None] * self.num_processes
+
+        def worker(p):
+            try:
+                results[p] = ("ok", fn(p, self.collective(p)))
+            except Exception as e:  # noqa: BLE001 - surfaced to the test
+                results[p] = ("err", e)
+
+        threads = [threading.Thread(target=worker, args=(p,))
+                   for p in range(self.num_processes)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        return results
 
 
 def _split_triplets(rng, dims, shards):
@@ -86,3 +128,138 @@ def test_shards_per_process_mismatch():
 
 def test_initialize_single_process_noop():
     multihost.initialize()  # no coordinator -> no-op
+
+
+@pytest.mark.parametrize("num_processes,shards_per_process",
+                         [(2, 2), (3, 1)])
+def test_multihost_build_stub_world_matches_global(num_processes,
+                                                   shards_per_process):
+    """2- and 3-process builds through the real lockstep protocol (stub
+    collective): every process ends with the identical global plan, equal
+    to the single-process build over all shards."""
+    rng = np.random.default_rng(7)
+    dims = (11, 12, 13)
+    shards = num_processes * shards_per_process
+    parts = _split_triplets(rng, dims, shards)
+    base, extra = divmod(dims[2], shards)
+    planes = [base + (1 if s < extra else 0) for s in range(shards)]
+    expect = build_distributed_plan(TransformType.C2C, *dims, parts, planes)
+
+    def one_process(p, collective):
+        lo = p * shards_per_process
+        hi = lo + shards_per_process
+        return build_distributed_plan_multihost(
+            TransformType.C2C, *dims, parts[lo:hi], planes[lo:hi],
+            collective=collective)
+
+    results = StubWorld(num_processes).run(one_process)
+    for status, plan in results:
+        assert status == "ok", plan
+        assert plan_fingerprint(plan) == plan_fingerprint(expect)
+
+
+def test_multihost_build_stub_world_empty_shard():
+    """A process owning only an empty shard (zero sticks) is valid — the
+    reference supports empty ranks (execution guarded on numLocalZSticks>0,
+    execution_host.cpp:167-179)."""
+    rng = np.random.default_rng(8)
+    dims = (8, 9, 10)
+    parts = _split_triplets(rng, dims, 1) + [np.zeros((0, 3), np.int64)]
+    planes = [6, 4]
+    expect = build_distributed_plan(TransformType.C2C, *dims, parts, planes)
+
+    def one_process(p, collective):
+        return build_distributed_plan_multihost(
+            TransformType.C2C, *dims, [parts[p]], [planes[p]],
+            collective=collective)
+
+    results = StubWorld(2).run(one_process)
+    for status, plan in results:
+        assert status == "ok", plan
+        assert plan_fingerprint(plan) == plan_fingerprint(expect)
+
+
+def test_multihost_build_stub_world_unequal_shard_counts():
+    """Unequal shards_per_process across processes fails fast on EVERY
+    process, before any data-shaped collective (which would hang)."""
+    rng = np.random.default_rng(9)
+    dims = (8, 9, 10)
+    parts = _split_triplets(rng, dims, 3)
+
+    def one_process(p, collective):
+        mine = [parts[0], parts[1]] if p == 0 else [parts[2]]
+        planes = [5, 5] if p == 0 else [10]
+        return build_distributed_plan_multihost(
+            TransformType.C2C, *dims, mine, planes, collective=collective)
+
+    results = StubWorld(2).run(one_process)
+    for status, err in results:
+        assert status == "err"
+        assert isinstance(err, ParameterMismatchError)
+        assert "shards_per_process differs" in str(err)
+
+
+def test_multihost_build_stub_world_mismatched_dims():
+    """A process passing different dims builds a different global plan; the
+    digest validation raises on every process, naming the disagreement
+    (reference: grid_internal.cpp:148-167)."""
+    rng = np.random.default_rng(10)
+    dims = (8, 9, 10)
+    parts = _split_triplets(rng, dims, 2)
+
+    def one_process(p, collective):
+        my_dims = dims if p == 0 else (8, 9, 11)
+        planes = 5 if p == 0 else 6
+        return build_distributed_plan_multihost(
+            TransformType.C2C, *my_dims, [parts[p]], [planes],
+            collective=collective)
+
+    results = StubWorld(2).run(one_process)
+    # process 1's plan has a different dim_z: at least the digest check
+    # must catch it on every process (plane-sum validation may fire first
+    # on either side — both are ParameterMismatchError by design)
+    for status, err in results:
+        assert status == "err"
+        assert isinstance(err, ParameterMismatchError)
+
+
+def test_validate_consistent_stub_world_mismatch():
+    rng = np.random.default_rng(11)
+    dims = (8, 9, 10)
+    parts = _split_triplets(rng, dims, 2)
+    plans = [
+        build_distributed_plan(TransformType.C2C, *dims, parts, [5, 5]),
+        build_distributed_plan(TransformType.C2C, *dims, parts, [6, 4]),
+    ]
+
+    def one_process(p, collective):
+        return validate_consistent(plans[p], collective=collective)
+
+    results = StubWorld(2).run(one_process)
+    for p, (status, err) in enumerate(results):
+        assert status == "err"
+        assert isinstance(err, ParameterMismatchError)
+        other = 1 - p
+        assert f"[{other}]" in str(err)
+
+
+def test_validate_consistent_stub_world_agreement():
+    rng = np.random.default_rng(12)
+    dims = (8, 9, 10)
+    parts = _split_triplets(rng, dims, 2)
+    plan = build_distributed_plan(TransformType.C2C, *dims, parts, [5, 5])
+
+    def one_process(p, collective):
+        validate_consistent(plan, collective=collective)
+        return True
+
+    for status, ok in StubWorld(3).run(one_process):
+        assert status == "ok" and ok
+
+
+def test_zero_shards_per_process_rejected():
+    with pytest.raises(ParameterMismatchError, match=">= 1"):
+        build_distributed_plan_multihost(
+            TransformType.C2C, 8, 8, 8, [], [], shards_per_process=0)
+    with pytest.raises(ParameterMismatchError, match=">= 1"):
+        build_distributed_plan_multihost(TransformType.C2C, 8, 8, 8, [], [])
