@@ -30,6 +30,7 @@ int main(void) {
   float values[2 * DIM * DIM * DIM];
   float space[2 * DIM * DIM * DIM];
   float roundtrip[2 * DIM * DIM * DIM];
+  float pair[2 * DIM * DIM * DIM];
 
   int i = 0;
   for (int x = 0; x < DIM; ++x) {
@@ -67,8 +68,18 @@ int main(void) {
   }
   printf("round-trip max abs error: %.3e\n", max_err);
 
+  /* the fused pair (ONE device program) must agree with the separate
+   * backward+forward round trip */
+  CHECK(spfft_tpu_execute_pair(plan, values, SPFFT_TPU_FULL_SCALING, pair));
+  double pair_err = 0.0;
+  for (i = 0; i < 2 * n; ++i) {
+    double err = fabs((double)pair[i] - (double)roundtrip[i]);
+    if (err > pair_err) pair_err = err;
+  }
+  printf("fused pair vs backward+forward max abs error: %.3e\n", pair_err);
+
   CHECK(spfft_tpu_plan_destroy(plan));
-  if (max_err > 1e-3) {
+  if (max_err > 1e-3 || pair_err > 1e-3) {
     fprintf(stderr, "FAIL: round-trip error too large\n");
     return 1;
   }

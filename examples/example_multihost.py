@@ -14,6 +14,12 @@ On a pod slice, launch with the standard JAX multi-process environment
 
 Run without arguments it degenerates to a single process and exercises the
 same code path (this is what the test suite does).
+
+STATUS: the multi-process launch path is UNTESTED on real multi-host
+hardware — this container cannot start a >1-process JAX group (see
+ROADMAP.md). The collective protocol behind it is unit-tested with 2- and
+3-process stub worlds (tests/test_multihost.py), but treat the coordinator
+invocation above as a recipe to validate on a pod, not a tested path.
 """
 
 import argparse

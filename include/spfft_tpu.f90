@@ -108,6 +108,17 @@ module spfft_tpu
       type(c_ptr), value :: values
     end function
 
+    !> Fused backward+forward round trip as one device program (the
+    !> benchmark pair / SCF inner loop); values_out may equal values_in.
+    integer(c_int) function spfft_tpu_execute_pair(plan, values_in, &
+        scaling, values_out) bind(C, name="spfft_tpu_execute_pair")
+      use iso_c_binding
+      type(c_ptr), value :: plan
+      type(c_ptr), value :: values_in
+      integer(c_int), value :: scaling
+      type(c_ptr), value :: values_out
+    end function
+
     integer(c_int) function spfft_tpu_plan_dim_x(plan, out) &
         bind(C, name="spfft_tpu_plan_dim_x")
       use iso_c_binding

@@ -133,6 +133,21 @@ int spfft_tpu_backward(SpfftTpuPlan plan, const void* values, void* space);
 int spfft_tpu_forward(SpfftTpuPlan plan, const void* space, int scaling,
                       void* values);
 
+/*
+ * Fused round trip: backward, then forward with the given scaling, as ONE
+ * device program — the plane-wave-code inner loop (the reference
+ * benchmark's repeated backward+forward pair, tests/programs/benchmark.cpp
+ * :84-96), without the two dispatch round trips and four marshalling
+ * copies of calling spfft_tpu_backward + spfft_tpu_forward.
+ *
+ * values_in/values_out: 2*num_values reals each (interleaved; per-shard
+ * arrays concatenated in shard order for distributed plans). In-place
+ * operation (values_out == values_in) is allowed. With
+ * SPFFT_TPU_FULL_SCALING the pair is the identity up to roundoff.
+ */
+int spfft_tpu_execute_pair(SpfftTpuPlan plan, const void* values_in,
+                           int scaling, void* values_out);
+
 /* Getters (reference: spfft_transform_get_* accessors, transform.h). Each
  * writes one value and returns an error code. */
 int spfft_tpu_plan_dim_x(SpfftTpuPlan plan, int* out);

@@ -146,8 +146,10 @@ def _is_distributed(plan) -> bool:
     return isinstance(plan, DistributedTransformPlan)
 
 
-def _dist_backward(plan, values_addr: int, space_addr: int) -> None:
-    """Concatenated per-shard values -> full cube in global z order."""
+def _split_values_view(plan, values_addr: int) -> list:
+    """View the C caller's concatenated per-shard value array as one numpy
+    list per shard (shard order, no copy). Shared by every distributed
+    entry so the shard-order convention lives in one place."""
     dp = plan.dist_plan
     total = dp.num_global_elements
     flat = _view(values_addr, 2 * total, plan.precision).reshape(total, 2)
@@ -155,6 +157,21 @@ def _dist_backward(plan, values_addr: int, space_addr: int) -> None:
     for sp in dp.shard_plans:
         per.append(flat[off:off + sp.num_values])
         off += sp.num_values
+    return per
+
+
+def _concat_padded_values(plan, padded: np.ndarray) -> np.ndarray:
+    """Padded sharded (S, max_values, 2) device result -> concatenated
+    true per-shard values (the C API wire layout)."""
+    dp = plan.dist_plan
+    return np.concatenate([padded[r, :dp.shard_plans[r].num_values]
+                           for r in range(dp.num_shards)], axis=0)
+
+
+def _dist_backward(plan, values_addr: int, space_addr: int) -> None:
+    """Concatenated per-shard values -> full cube in global z order."""
+    dp = plan.dist_plan
+    per = _split_values_view(plan, values_addr)
     # The padded device result is already interleaved (C2C) / real (R2C):
     # slice each shard's true slab out directly, no complex round trip.
     padded = np.asarray(plan.backward(per))
@@ -182,8 +199,7 @@ def _dist_forward(plan, space_addr: int, scaling: int,
         raise InvalidParameterError(f"bad scaling {scaling}")
     padded = np.asarray(plan.forward(
         slabs, Scaling.FULL if scaling == 1 else Scaling.NONE))
-    out = np.concatenate([padded[r, :dp.shard_plans[r].num_values]
-                          for r in range(dp.num_shards)], axis=0)
+    out = _concat_padded_values(plan, padded)
     total = dp.num_global_elements
     _view(values_addr, 2 * total, plan.precision)[:] = out.reshape(-1)
 
@@ -218,6 +234,33 @@ def forward(pid: int, space_addr: int, scaling: int,
         Scaling.FULL if scaling == 1 else Scaling.NONE))
     _view(values_addr, 2 * p.num_values,
           plan.precision)[:] = values.reshape(-1)
+
+
+@_guarded
+def execute_pair(pid: int, values_in_addr: int, scaling: int,
+                 values_out_addr: int) -> None:
+    """Fused backward+forward round trip (ONE device program via
+    plan.apply_pointwise) — the C API's SCF-inner-loop entry. In-place
+    (out == in) allowed: the input is copied into device memory before the
+    output view is written."""
+    plan = _get_plan(pid)
+    if scaling not in (0, 1):
+        raise InvalidParameterError(f"bad scaling {scaling}")
+    sc = Scaling.FULL if scaling == 1 else Scaling.NONE
+    if _is_distributed(plan):
+        total = plan.dist_plan.num_global_elements
+        per = [p.copy() for p in _split_values_view(plan, values_in_addr)]
+        padded = np.asarray(plan.apply_pointwise(per, scaling=sc))
+        out = _concat_padded_values(plan, padded)
+        _view(values_out_addr, 2 * total,
+              plan.precision)[:] = out.reshape(-1)
+        return
+    p = plan.index_plan
+    values = _view(values_in_addr, 2 * p.num_values,
+                   plan.precision).reshape(p.num_values, 2)
+    out = np.asarray(plan.apply_pointwise(values.copy(), scaling=sc))
+    _view(values_out_addr, 2 * p.num_values,
+          plan.precision)[:] = out.reshape(-1)
 
 
 @_guarded

@@ -313,7 +313,13 @@ class Transform:
 
         ``location`` mirrors the reference's processing-unit argument
         (transform.hpp:184): ``ProcessingUnit.HOST`` returns a numpy array,
-        ``DEVICE`` (or None) returns the data where it lives."""
+        ``DEVICE`` (or None) returns the data where it lives.
+
+        Unlike the reference — whose pointer is a writable buffer users
+        fill before ``forward`` (transform.hpp:184) — the HOST result is a
+        SNAPSHOT: writing into the returned numpy array has no effect on
+        the transform. To feed modified space-domain data into ``forward``,
+        pass it explicitly or call :meth:`set_space_domain_data`."""
         if self._space is None or location is None:
             return self._space
         if ProcessingUnit(location) == ProcessingUnit.HOST:

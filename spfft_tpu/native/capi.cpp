@@ -213,6 +213,17 @@ int spfft_tpu_forward(void* plan, const void* space, int scaling,
       nullptr);
 }
 
+int spfft_tpu_execute_pair(void* plan, const void* values_in, int scaling,
+                           void* values_out) {
+  if (values_in == nullptr || values_out == nullptr) return kInvalidParameter;
+  return call_bridge(
+      "execute_pair",
+      {handle_to_id(plan),
+       static_cast<long long>(reinterpret_cast<intptr_t>(values_in)), scaling,
+       static_cast<long long>(reinterpret_cast<intptr_t>(values_out))},
+      nullptr);
+}
+
 static int plan_info(void* plan, int what, long long* out) {
   if (out == nullptr) return kInvalidParameter;
   return call_bridge("plan_info", {handle_to_id(plan), what}, out);

@@ -355,7 +355,11 @@ class DistributedTransformPlan:
         optimal); a shard whose order is too scattered for the chunk
         decomposition drops ALL shards to the XLA path with a logged
         notice. Active in single precision on TPU; ``use_pallas=True`` on
-        a non-TPU backend runs the kernel in interpret mode (testing)."""
+        a non-TPU backend runs the kernel in interpret mode (testing) —
+        note the asymmetry with the local ``TransformPlan``, whose
+        ``use_pallas=True`` on non-TPU builds tables but executes the XLA
+        path (interpret mode per value-array would dominate local
+        runtimes; here the SPMD body must be one program)."""
         from ..ops import gather_kernel as gk
 
         dp = self.dist_plan

@@ -90,7 +90,10 @@ class TransformPlan:
         notice.
 
         ``use_pallas=True`` on a non-TPU backend builds the tables (useful
-        for interpret-mode testing) but execution stays on the XLA path; the
+        for table-level testing) but execution stays on the XLA path — note
+        the asymmetry with ``DistributedTransformPlan``, whose
+        ``use_pallas=True`` runs the kernel in *interpret mode* on non-TPU
+        (its SPMD body must execute the same program on every backend); the
         kernel is float32-only, so forcing it on a double-precision plan is
         an error rather than a silent downcast."""
         from .ops import gather_kernel as gk

@@ -103,6 +103,72 @@ def test_ctypes_round_trip(lib):
     assert lib.spfft_tpu_plan_destroy(plan) == 0
 
 
+def test_ctypes_execute_pair(lib):
+    """The fused pair entry point matches separate backward+forward and
+    supports in-place operation."""
+    lib.spfft_tpu_execute_pair.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p]
+    n = 4
+    trip = np.array([[x, y, z] for x in range(n) for y in range(n)
+                     for z in range(n)], np.int32)
+    values = np.random.default_rng(2).standard_normal(
+        (len(trip), 2)).astype(np.float32)
+    space = np.empty((n, n, n, 2), np.float32)
+    seq = np.empty_like(values)
+    fused = np.empty_like(values)
+    plan = ctypes.c_void_p()
+    assert lib.spfft_tpu_plan_create(
+        ctypes.byref(plan), 0, n, n, n, ctypes.c_longlong(len(trip)),
+        trip.ctypes.data, 0) == 0
+    assert lib.spfft_tpu_backward(plan, values.ctypes.data,
+                                  space.ctypes.data) == 0
+    assert lib.spfft_tpu_forward(plan, space.ctypes.data, 1,
+                                 seq.ctypes.data) == 0
+    assert lib.spfft_tpu_execute_pair(plan, values.ctypes.data, 1,
+                                      fused.ctypes.data) == 0
+    np.testing.assert_allclose(fused, seq, atol=1e-5)
+    # in-place: out == in
+    inplace = values.copy()
+    assert lib.spfft_tpu_execute_pair(plan, inplace.ctypes.data, 1,
+                                      inplace.ctypes.data) == 0
+    np.testing.assert_allclose(inplace, seq, atol=1e-5)
+    # NONE scaling == N * values
+    assert lib.spfft_tpu_execute_pair(plan, values.ctypes.data, 0,
+                                      fused.ctypes.data) == 0
+    np.testing.assert_allclose(fused, values * len(trip), atol=1e-3)
+    # bad scaling -> invalid parameter
+    assert lib.spfft_tpu_execute_pair(plan, values.ctypes.data, 7,
+                                      fused.ctypes.data) == 5
+    assert lib.spfft_tpu_execute_pair(plan, None, 1, None) == 5
+    assert lib.spfft_tpu_plan_destroy(plan) == 0
+
+
+def test_ctypes_execute_pair_distributed(lib):
+    """Fused pair on a distributed C plan (concatenated per-shard values)."""
+    lib.spfft_tpu_execute_pair.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p]
+    n, shards = 8, 4
+    trip_all = np.array([[x, y, z] for x in range(n) for y in range(n)
+                         for z in range(n)], np.int32)
+    order = np.argsort((trip_all[:, 0] * n + trip_all[:, 1]) % shards,
+                       kind="stable")
+    trip = np.ascontiguousarray(trip_all[order])
+    vps = np.array([(((trip_all[:, 0] * n + trip_all[:, 1]) % shards) == r)
+                    .sum() for r in range(shards)], np.int64)
+    pps = np.full(shards, n // shards, np.int32)
+    values = np.random.default_rng(3).standard_normal(
+        (len(trip), 2)).astype(np.float32)
+    fused = np.empty_like(values)
+    plan = ctypes.c_void_p()
+    assert lib.spfft_tpu_plan_create_distributed(
+        ctypes.byref(plan), 0, n, n, n, shards, vps.ctypes.data,
+        trip.ctypes.data, pps.ctypes.data, 0) == 0
+    assert lib.spfft_tpu_execute_pair(plan, values.ctypes.data, 1,
+                                      fused.ctypes.data) == 0
+    np.testing.assert_allclose(fused, values, atol=1e-5)
+    assert lib.spfft_tpu_plan_destroy(plan) == 0
+
+
 def test_invalid_indices_code(lib):
     trip = np.array([[99, 0, 0]], np.int32)
     plan = ctypes.c_void_p()
