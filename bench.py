@@ -123,10 +123,19 @@ def main() -> None:
     else:
         baseline_s = cpu_baseline_pair_seconds(plan, values)
 
+    # Effective bandwidth: logical bytes of the pair (each stage's elements
+    # read + written once, c64 = 8 B; see scripts/profile_stages.py for the
+    # per-stage model and the measured copy floor it compares against).
+    ip = plan.index_plan
+    sz = ip.num_sticks * ip.dim_z
+    pair_bytes = (2 * ip.num_values + 8 * sz + 6 * n ** 3) * 8
+    gbs = pair_bytes / pair_s / 1e9
+
     result = {
         "metric": f"{n}^3 spherical-cutoff C2C fwd+bwd pair wall-clock "
                   f"(l2_err_vs_dense={l2:.2e}, plan_s={t_plan:.2f}, "
                   f"n_values={len(triplets)}, "
+                  f"effective_GBps={gbs:.0f}, "
                   f"baseline=pocketfft[{os.cpu_count()}cpu] "
                   f"{baseline_s:.3f}s)",
         "value": round(pair_s, 6),

@@ -1,6 +1,16 @@
 #!/usr/bin/env python
-"""Per-stage wall-clock breakdown of the 256^3 north-star pipeline on the
-real device — identifies which phase dominates the backward+forward pair."""
+"""Per-stage wall-clock + achieved-GB/s breakdown of the north-star pipeline
+on the real device, against a measured device-copy floor.
+
+Timing is hard-synced (host readback of one element — ``block_until_ready``
+returns early on this remote-attached platform, see bench.py). GB/s is
+*effective*: the stage's logical bytes (elements read + written once, c64=8B)
+over wall-clock — FFT stages do more internal passes, so their effective
+number understates the hardware traffic; the copy floor row calibrates what
+"bandwidth-bound" means on this chip+tunnel.
+
+Usage: DIM=256 python scripts/profile_stages.py   (or DIMS="64 128 256")
+"""
 import sys
 import os
 import time
@@ -16,55 +26,103 @@ from spfft_tpu.ops import stages
 from spfft_tpu.utils.workloads import spherical_cutoff_triplets
 from spfft_tpu.utils import as_interleaved
 
-n = int(os.environ.get("DIM", 256))
-triplets = spherical_cutoff_triplets(n)
-plan = make_local_plan(TransformType.C2C, n, n, n, triplets,
-                       precision="single")
-p = plan.index_plan
-print(f"dim={n} num_values={p.num_values} num_sticks={p.num_sticks} "
-      f"pallas_active={plan._pallas_active}")
-
-rng = np.random.default_rng(0)
-values = (rng.uniform(-1, 1, len(triplets))
-          + 1j * rng.uniform(-1, 1, len(triplets))).astype(np.complex64)
-values_il = jnp.asarray(as_interleaved(values, "single"))
-tables = plan._tables
+C64 = 8  # bytes
 
 
-def timeit(name, fn, *args, reps=5):
+def sync(out):
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    float(np.asarray(jax.numpy.real(leaf).ravel()[0]))
+
+
+def timeit(name, fn, *args, reps=10, nbytes=0):
     out = fn(*args)
-    jax.block_until_ready(out)
+    sync(out)
     t0 = time.perf_counter()
     for _ in range(reps):
         out = fn(*args)
-    jax.block_until_ready(out)
+    sync(out)
     dt = (time.perf_counter() - t0) / reps
-    print(f"{name:24s} {dt*1e3:8.2f} ms")
-    return out
+    gbs = nbytes / dt / 1e9 if nbytes else 0.0
+    print(f"{name:24s} {dt*1e3:8.2f} ms   {gbs:7.1f} GB/s "
+          f"({nbytes/1e6:8.1f} MB logical)", flush=True)
+    return out, dt
 
 
-# backward stages
-dec = jax.jit(lambda v: plan._decompress(v, tables))
-sticks = timeit("decompress", dec, values_il)
-zb = jax.jit(stages.z_backward)
-sticks_z = timeit("z_backward (ifft)", zb, sticks)
-s2g = jax.jit(lambda s: stages.sticks_to_grid(s, tables["col_inv"], p.dim_y,
-                                              p.dim_x_freq))
-grid = timeit("sticks_to_grid", s2g, sticks_z)
-xyb = jax.jit(stages.xy_backward_c2c)
-space = timeit("xy_backward (ifft2)", xyb, grid)
+def copy_floor(n_elems_c64: int, reps=10):
+    """Device copy floor: out = in + 0 on an n-element c64 array (one read +
+    one write per element, no compute)."""
+    x = jnp.zeros((n_elems_c64, 2), jnp.float32)
+    f = jax.jit(lambda a: a + jnp.float32(0))
+    out = f(x)
+    sync(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = f(out)
+    sync(out)
+    dt = (time.perf_counter() - t0) / reps
+    return 2 * n_elems_c64 * C64 / dt / 1e9, dt
 
-# forward stages
-xyf = jax.jit(stages.xy_forward_c2c)
-gridf = timeit("xy_forward (fft2)", xyf, space)
-g2s = jax.jit(lambda g: stages.grid_to_sticks(g, tables["scatter_cols"]))
-sticksf = timeit("grid_to_sticks", g2s, gridf)
-zf = jax.jit(stages.z_forward)
-sticks_zf = timeit("z_forward (fft)", zf, sticksf)
-cmp_ = jax.jit(lambda s: plan._compress(s, tables, None))
-vals = timeit("compress", cmp_, sticks_zf)
 
-# full fused pair
-pair = jax.jit(lambda v: plan._forward_impl(
-    plan._backward_impl(v, tables), tables, scaled=False))
-timeit("FULL fused pair", pair, values_il)
+def profile(n: int):
+    triplets = spherical_cutoff_triplets(n)
+    plan = make_local_plan(TransformType.C2C, n, n, n, triplets,
+                           precision="single")
+    p = plan.index_plan
+    N, S, Z = p.num_values, p.num_sticks, p.dim_z
+    SZ, G = S * Z, n * n * n
+    print(f"\n== dim={n} values={N} sticks={S} "
+          f"pallas={plan._pallas_active} ==", flush=True)
+    floor_gbs, _ = copy_floor(G)
+    print(f"{'copy floor (n^3 c64)':24s} {'':8s}      {floor_gbs:7.1f} GB/s",
+          flush=True)
+
+    rng = np.random.default_rng(0)
+    values = (rng.uniform(-1, 1, N)
+              + 1j * rng.uniform(-1, 1, N)).astype(np.complex64)
+    values_il = jax.device_put(np.asarray(as_interleaved(values, "single")))
+    tables = plan._tables
+
+    total_bytes = 0
+    total_time = 0.0
+
+    def stage(name, fn, arg, nbytes):
+        nonlocal total_bytes, total_time
+        out, dt = timeit(name, fn, arg, nbytes=nbytes)
+        total_bytes += nbytes
+        total_time += dt
+        return out
+
+    dec = jax.jit(lambda v: plan._decompress(v, tables))
+    sticks = stage("decompress", dec, values_il, (N + SZ) * C64)
+    zb = jax.jit(stages.z_backward)
+    sticks_z = stage("z_backward (ifft)", zb, sticks, 2 * SZ * C64)
+    s2g = jax.jit(lambda s: stages.sticks_to_grid(
+        s, tables["col_inv"], p.dim_y, p.dim_x_freq))
+    grid = stage("sticks_to_grid", s2g, sticks_z, (SZ + G) * C64)
+    xyb = jax.jit(stages.xy_backward_c2c)
+    space = stage("xy_backward (ifft2)", xyb, grid, 2 * G * C64)
+
+    xyf = jax.jit(stages.xy_forward_c2c)
+    gridf = stage("xy_forward (fft2)", xyf, space, 2 * G * C64)
+    g2s = jax.jit(lambda g: stages.grid_to_sticks(g, tables["scatter_cols"]))
+    sticksf = stage("grid_to_sticks", g2s, gridf, (G + SZ) * C64)
+    zf = jax.jit(stages.z_forward)
+    sticks_zf = stage("z_forward (fft)", zf, sticksf, 2 * SZ * C64)
+    cmp_ = jax.jit(lambda s: plan._compress(s, tables, None))
+    stage("compress", cmp_, sticks_zf, (SZ + N) * C64)
+
+    print(f"{'sum of stages':24s} {total_time*1e3:8.2f} ms   "
+          f"{total_bytes/total_time/1e9:7.1f} GB/s", flush=True)
+
+    pair = jax.jit(lambda v: plan._forward_impl(
+        plan._backward_impl(v, tables), tables, scaled=False))
+    _, dt = timeit("FULL fused pair", pair, values_il, nbytes=total_bytes)
+    print(f"{'fusion saving':24s} {(total_time-dt)*1e3:8.2f} ms "
+          f"({(1 - dt/total_time)*100:.0f}% vs stage sum)", flush=True)
+
+
+if __name__ == "__main__":
+    dims = os.environ.get("DIMS") or os.environ.get("DIM", "256")
+    print(f"devices: {jax.devices()}", flush=True)
+    for d in dims.split():
+        profile(int(d))

@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Wire-bytes model at scale: padded all_to_all vs the exact-count compact
+schedule for the 256^3 spherical-cutoff workload over many shards (plan-time
+computation only — no devices needed; the distributed analogue runs on a pod).
+
+Two metrics per layout: TOTAL off-shard bytes (aggregate ICI traffic,
+summed over shards) and the BUSIEST LINK (max over shards of
+max(sent, received) — the bottleneck; a shard owning most of the slab
+receives that payload under any exact layout, so plane-skew savings show
+up in the aggregate, not here). The padded layout ships
+(S-1) * max_sticks * max_planes complex elements per shard regardless of
+distribution; the compact schedule's size-classed exact ops track the true
+per-pair Alltoallv counts (reference
+transpose_mpi_compact_buffered_host.cpp:83-105)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from spfft_tpu.parallel.dist import build_distributed_plan
+from spfft_tpu.parallel.exchange import build_compact_schedule
+from spfft_tpu.types import TransformType
+from spfft_tpu.utils.workloads import (even_plane_split,
+                                       round_robin_stick_partition,
+                                       spherical_cutoff_triplets)
+
+
+def skewed_plane_split(dim_z, S):
+    """First shard owns half the planes, the rest split evenly — the skewed
+    slab layout of a DFT code mixing a dense rank with light ranks."""
+    first = dim_z // 2
+    rest = even_plane_split(dim_z - first, S - 1)
+    return [first] + rest
+
+
+def model(n, S, skew):
+    triplets = spherical_cutoff_triplets(n)
+    parts = round_robin_stick_partition(triplets, (n, n, n), S)
+    planes = skewed_plane_split(n, S) if skew else even_plane_split(n, S)
+    dp = build_distributed_plan(TransformType.C2C, n, n, n, parts, planes)
+    sched = build_compact_schedule(dp)
+    pad_total = S * (S - 1) * dp.max_sticks * dp.max_planes * 8
+    pad_link = (S - 1) * dp.max_sticks * dp.max_planes * 8
+    c_total = sched.wire_elements() * 8
+    c_link = sched.busiest_link_elements() * 8
+    name = "skewed-planes" if skew else "uniform"
+    print(f"| {n}^3, S={S:2d}, {name:13s} | {pad_total/1e6:9.2f} "
+          f"| {c_total/1e6:9.2f} | {100*(1-c_total/max(pad_total,1)):5.1f}% "
+          f"| {pad_link/1e6:8.2f} | {c_link/1e6:8.2f} |",
+          flush=True)
+
+
+if __name__ == "__main__":
+    print("| workload | padded total MB | compact total MB | saved "
+          "| padded link MB | compact link MB |")
+    print("|---|---|---|---|---|---|")
+    for n in (128, 256):
+        for S in (8, 32):
+            for skew in (False, True):
+                model(n, S, skew)

@@ -441,7 +441,7 @@ class DistributedTransformPlan:
             flat = sticks.reshape(-1)
             bufs = [jnp.take(flat, t[0], mode="fill", fill_value=0)
                     for t in ctables[:nb]]
-            recv = compact_exchange(bufs, self._compact.hops,
+            recv = compact_exchange(bufs, self._compact.ops,
                                     dp.num_shards, self.axis_name,
                                     reverse=False,
                                     wire_real_dtype=self._wire_dtype)
@@ -461,7 +461,7 @@ class DistributedTransformPlan:
             flat = grid.reshape(-1)
             bufs = [jnp.take(flat, t[0], mode="fill", fill_value=0)
                     for t in ctables[nb + 1:2 * nb + 1]]
-            recv = compact_exchange(bufs, self._compact.hops,
+            recv = compact_exchange(bufs, self._compact.ops,
                                     dp.num_shards, self.axis_name,
                                     reverse=True,
                                     wire_real_dtype=self._wire_dtype)
@@ -727,19 +727,38 @@ class DistributedTransformPlan:
     def num_local_elements(self, shard: int) -> int:
         return self.dist_plan.shard_plans[shard].num_values
 
-    def exchange_wire_bytes(self) -> int:
-        """Model of per-shard off-shard bytes for ONE exchange under the
-        selected mechanism (the quantity the reference's Alltoallv layout
-        exists to minimise — transpose_mpi_compact_buffered_host.cpp:83-105).
-        Padded layouts ship ``(S-1) * max_sticks * max_planes`` complex
-        elements regardless of the distribution; the compact schedule ships
-        the per-hop exact maxima only."""
-        dp = self.dist_plan
+    def _wire_elem_bytes(self) -> int:
         elem = np.dtype(self._cdt).itemsize
         if self._wire_dtype is not None:
             elem = 2 * np.dtype(self._wire_dtype).itemsize
+        return elem
+
+    def exchange_wire_bytes(self) -> int:
+        """TOTAL off-shard bytes (summed over all shards) for ONE exchange
+        under the selected mechanism — the aggregate-ICI-traffic model (the
+        quantity the reference's Alltoallv layout exists to minimise,
+        transpose_mpi_compact_buffered_host.cpp:83-105). Padded layouts
+        ship ``S * (S-1) * max_sticks * max_planes`` complex elements
+        regardless of the distribution; the compact schedule's size-classed
+        ops track the true per-pair counts. See
+        :meth:`exchange_busiest_link_bytes` for the bottleneck-link view."""
+        dp = self.dist_plan
+        elem = self._wire_elem_bytes()
         if self._compact is not None:
             return self._compact.wire_elements() * elem
+        return (dp.num_shards * (dp.num_shards - 1)
+                * dp.max_sticks * dp.max_planes * elem)
+
+    def exchange_busiest_link_bytes(self) -> int:
+        """Max over shards of max(sent, received) off-shard bytes for ONE
+        exchange — the bottleneck-link model. A shard that genuinely owns
+        most of the slab receives that payload under ANY exact layout, so
+        plane-skew savings show up in :meth:`exchange_wire_bytes`
+        (aggregate), not here; stick-skew savings show up in both."""
+        dp = self.dist_plan
+        elem = self._wire_elem_bytes()
+        if self._compact is not None:
+            return self._compact.busiest_link_elements() * elem
         return (dp.num_shards - 1) * dp.max_sticks * dp.max_planes * elem
 
     # -- data movement helpers ----------------------------------------------

@@ -153,43 +153,94 @@ class CompactSchedule:
     per-rank counts/displacements at plan time; :183-200 runs the
     MPI_Alltoallv).
 
-    XLA collectives are fixed-shape, so "ragged" becomes a *per-hop* static
-    schedule: hop ``k`` moves the (stick-owner ``j`` -> plane-owner
-    ``(j+k) % S``) blocks, whose exact element count
-    ``ns(j) * np((j+k) % S)`` is a plan-time constant; the hop buffer is
-    sized to the max over ``j`` only. Total off-shard wire elements are
-    ``sum_k L_k`` instead of the padded layout's
-    ``(S-1) * max_sticks * max_planes`` — on non-uniform distributions the
-    difference is the padding waste SURVEY.md §7.3 flags as the scaling
-    risk. The same hop widths serve both directions (the same
-    (stick-owner, plane-owner) pairs flow, reversed).
+    XLA collectives are fixed-shape, so "ragged" becomes a static schedule
+    of exact-size ``ppermute`` ops: the (stick-owner ``j`` -> plane-owner
+    ``d``) pairs of each hop distance ``k = (d - j) % S`` are grouped into
+    *size classes* (exact element count ``ns(j) * np(d)``, a plan-time
+    constant; factor-2 buckets when a hop has more than 4 distinct sizes),
+    and each (hop, class) becomes one ppermute carrying ONLY its member
+    pairs — a ppermute transfers nothing along pairs absent from its
+    permutation, so a pair never pays for a bigger pair in the same hop.
+    Total off-shard wire elements per shard therefore track the true
+    per-pair counts (the padded layout ships
+    ``(S-1) * max_sticks * max_planes`` regardless — the padding waste
+    SURVEY.md §7.3 flags as the scaling risk); with a skewed PLANE
+    distribution, a plain per-hop-max schedule would pad every hop to the
+    big destination's size and save nothing. The same ops serve both
+    directions (the pairs flow reversed).
 
     Pack/unpack are element gathers through plan-time index tables with
     out-of-range sentinels (``jnp.take`` fill mode), sharded over the mesh
-    axis. Layout of hop ``k``'s flat buffer, sent by shard ``j`` to
-    ``d = (j+k) % S`` (backward; forward reverses the direction): element
-    ``i * np(d) + p`` is stick ``i``, plane ``p`` of shard ``d``'s slab.
+    axis. Layout of an op's flat buffer, sent by shard ``j`` to ``d``
+    (backward; forward reverses): element ``i * np(d) + p`` is stick ``i``,
+    plane ``p`` of shard ``d``'s slab.
     """
 
     num_shards: int
-    hops: tuple                      # kept hop distances k (zero-count hops
-                                     # are dropped at plan time; no dummy
-                                     # collectives on skewed distributions)
-    hop_sizes: tuple                 # L_k per kept hop
-    bwd_pack: tuple                  # per-hop (S, L_k) into flat sticks
+    ops: tuple                       # (k, L, pairs) — hop distance, buffer
+                                     # elements, tuple of (src, dst) pairs
+                                     # carried (backward direction)
+    bwd_pack: tuple                  # per-op (S, L) into flat sticks
     bwd_unpack: np.ndarray           # (S, mp*Y*Xf) into concat recv buffer
-    fwd_pack: tuple                  # per-hop (S, L_k) into flat grid
+    fwd_pack: tuple                  # per-op (S, L) into flat grid
     fwd_unpack: np.ndarray           # (S, ms*dz) into concat recv buffer
+
+    @property
+    def hop_sizes(self) -> tuple:
+        """Buffer elements per op (kept name: op count == len(hop_sizes))."""
+        return tuple(L for _, L, _ in self.ops)
 
     @property
     def total_recv(self) -> int:
         return int(sum(self.hop_sizes))
 
+    def _send_recv_per_shard(self):
+        send = np.zeros(self.num_shards, np.int64)
+        recv = np.zeros(self.num_shards, np.int64)
+        for k, L, pairs in self.ops:
+            if k == 0:
+                continue
+            for j, d in pairs:
+                send[j] += L
+                recv[d] += L
+        return send, recv
+
     def wire_elements(self) -> int:
-        """Off-shard complex elements per shard per exchange (hop 0 is
-        local)."""
-        return int(sum(sz for k, sz in zip(self.hops, self.hop_sizes)
-                       if k != 0))
+        """TOTAL off-shard complex elements per exchange, summed over all
+        shards (hop 0 is local). The aggregate-ICI-traffic metric; compare
+        with the padded layout's ``S * (S-1) * max_sticks * max_planes``."""
+        send, _ = self._send_recv_per_shard()
+        return int(send.sum())
+
+    def busiest_link_elements(self) -> int:
+        """Max over shards of max(sent, received) off-shard complex
+        elements per exchange — the bottleneck-link metric. On a skewed
+        PLANE distribution the big plane-owner's ingress is real payload
+        (a true Alltoallv ships the same bytes), so this metric does NOT
+        shrink the way the aggregate does; capacity planning should read
+        this one."""
+        send, recv = self._send_recv_per_shard()
+        both = np.maximum(send, recv)
+        return int(both.max()) if self.num_shards else 0
+
+
+def _size_classes(sizes_by_src: dict, max_exact: int = 4) -> list:
+    """Group a hop's pairs by exact payload size; if more than ``max_exact``
+    distinct sizes, merge into factor-2 buckets clamped to the hop's max
+    exact size (wire <= 2x exact AND <= the per-hop-max schedule, so the
+    compact layout never exceeds the padded one; op count <= log2 range).
+    Returns [(L, [srcs])] sorted by L."""
+    groups: dict = {}
+    for j, e in sizes_by_src.items():
+        groups.setdefault(int(e), []).append(j)
+    if len(groups) > max_exact:
+        hop_max = max(groups)
+        buckets: dict = {}
+        for e, js in groups.items():
+            b = min(1 << (e - 1).bit_length(), hop_max)
+            buckets.setdefault(b, []).extend(js)
+        groups = buckets
+    return sorted((L, sorted(js)) for L, js in groups.items())
 
 
 def build_compact_schedule(dp, x_window=None) -> CompactSchedule:
@@ -214,28 +265,32 @@ def build_compact_schedule(dp, x_window=None) -> CompactSchedule:
     ns = [p.num_sticks for p in dp.shard_plans]
     npl = list(dp.num_planes)
     off = list(dp.plane_offsets)
-    L_raw = [max(ns[j] * npl[(j + k) % S] for j in range(S))
-             for k in range(S)]
-    hops = [k for k in range(S) if L_raw[k] > 0]
-    if not hops:  # degenerate: no sticks anywhere — keep one dummy slot
-        hops, L_raw = [0], [1] + L_raw[1:]
-    L = [L_raw[k] for k in hops]
+
+    ops = []  # (k, L, pairs)
+    for k in range(S):
+        sizes = {j: ns[j] * npl[(j + k) % S] for j in range(S)
+                 if ns[j] * npl[(j + k) % S] > 0}
+        for L, js in _size_classes(sizes):
+            ops.append((k, int(L), tuple((j, (j + k) % S) for j in js)))
+    if not ops:  # degenerate: no sticks anywhere — keep one dummy slot
+        ops = [(0, 1, ())]
+    L = [o[1] for o in ops]
     offs = np.concatenate([[0], np.cumsum(L)]).astype(np.int64)
     total = int(offs[-1])
-    # recv-buffer offset of each hop distance (only kept hops referenced)
-    offs_by_k = np.zeros(S, np.int64)
-    offs_by_k[hops] = offs[:-1]
+    # recv-buffer offset of each pair's op
+    op_of_pair = {}
+    for oi, (k, _, pairs) in enumerate(ops):
+        for pr in pairs:
+            op_of_pair[pr] = oi
 
     bwd_pack = []
-    for m, k in enumerate(hops):
-        tbl = np.full((S, L[m]), ms * dz, np.int32)  # sentinel: off-range
-        for j in range(S):
-            d = (j + k) % S
+    for oi, (k, Lo, pairs) in enumerate(ops):
+        tbl = np.full((S, Lo), ms * dz, np.int32)  # sentinel: off-range
+        for j, d in pairs:
             n = ns[j] * npl[d]
-            if n:
-                i = np.arange(ns[j])[:, None]
-                z = off[d] + np.arange(npl[d])[None, :]
-                tbl[j, :n] = (i * dz + z).reshape(-1)
+            i = np.arange(ns[j])[:, None]
+            z = off[d] + np.arange(npl[d])[None, :]
+            tbl[j, :n] = (i * dz + z).reshape(-1)
         bwd_pack.append(tbl)
 
     # backward unpack: grid flat index p*Y*Xe + col -> recv position
@@ -246,26 +301,23 @@ def build_compact_schedule(dp, x_window=None) -> CompactSchedule:
         for s in range(S):
             if ns[s] == 0:
                 continue
-            k = (r - s) % S
             cols = grid_cols(dp.shard_plans[s].scatter_cols)
             i = np.arange(ns[s])[:, None]
             p = np.arange(npl[r])[None, :]
-            pos = offs_by_k[k] + i * npl[r] + p
+            pos = offs[op_of_pair[(s, r)]] + i * npl[r] + p
             flat_idx = p * (Y * Xe) + cols[:, None]
             bwd_unpack[r][flat_idx.reshape(-1)] = pos.reshape(-1)
 
-    # forward pack: shard j sends to d = (j-k) % S the block
-    # (ns(d), np(j)) gathered from its local grid
+    # forward pack: for backward pair (d, j) the forward sender is j,
+    # receiver d, payload = (ns(d), np(j)) gathered from j's local grid
     fwd_pack = []
-    for m, k in enumerate(hops):
-        tbl = np.full((S, L[m]), mp_ * Y * Xe, np.int32)
-        for j in range(S):
-            d = (j - k) % S
+    for oi, (k, Lo, pairs) in enumerate(ops):
+        tbl = np.full((S, Lo), mp_ * Y * Xe, np.int32)
+        for d, j in pairs:  # backward (src=d, dst=j): forward j sends to d
             n = ns[d] * npl[j]
-            if n:
-                cols = grid_cols(dp.shard_plans[d].scatter_cols)
-                p = np.arange(npl[j])[None, :]
-                tbl[j, :n] = (p * (Y * Xe) + cols[:, None]).reshape(-1)
+            cols = grid_cols(dp.shard_plans[d].scatter_cols)
+            p = np.arange(npl[j])[None, :]
+            tbl[j, :n] = (p * (Y * Xe) + cols[:, None]).reshape(-1)
         fwd_pack.append(tbl)
 
     # forward unpack: stick flat index i*dz + z -> recv position
@@ -278,37 +330,39 @@ def build_compact_schedule(dp, x_window=None) -> CompactSchedule:
     for r in range(S):
         if ns[r] == 0:
             continue
-        k_z = (z_owner - r) % S
-        base = offs_by_k[k_z] + z_plane       # (dz,)
+        # stick-owner r receives from plane-owner o = z_owner[z]; that is
+        # backward pair (r, o)
+        base = np.asarray([offs[op_of_pair[(r, int(o))]] for o in z_owner],
+                          np.int64) + z_plane
         npl_z = np.asarray(npl)[z_owner]      # (dz,)
         i = np.arange(ns[r])[:, None]
         idx = base[None, :] + i * npl_z[None, :]
         fwd_unpack[r, :ns[r] * dz] = idx.reshape(-1)
 
-    return CompactSchedule(num_shards=S, hops=tuple(hops),
-                           hop_sizes=tuple(L), bwd_pack=tuple(bwd_pack),
+    return CompactSchedule(num_shards=S, ops=tuple(ops),
+                           bwd_pack=tuple(bwd_pack),
                            bwd_unpack=bwd_unpack, fwd_pack=tuple(fwd_pack),
                            fwd_unpack=fwd_unpack)
 
 
-def compact_exchange(bufs, hops, num_shards: int, axis_name: str,
+def compact_exchange(bufs, ops, num_shards: int, axis_name: str,
                      reverse: bool,
                      wire_real_dtype: Optional[jnp.dtype] = None):
-    """Run the per-hop exact-size exchange: each kept hop distance ``k`` is
-    one ``ppermute`` of a ``(L_k,)`` complex buffer to the shard ``k`` hops
-    away (backward: ``j -> (j+k) % S``; forward ``reverse=True``:
-    ``j -> (j-k) % S``). Hop 0 is the shard's own block and never crosses
-    the wire. Returns the hop buffers concatenated in schedule order — the
-    layout the unpack tables of :class:`CompactSchedule` index into.
+    """Run the exact-size op schedule: each op is one ``ppermute`` of a
+    ``(L,)`` complex buffer along ONLY its member pairs (backward:
+    ``j -> d`` as stored; forward ``reverse=True``: ``d -> j``). Pairs
+    absent from an op's permutation transfer nothing (their shards receive
+    zeros, which the sentinel unpack tables never read). Hop-0 ops are the
+    shard's own block and never cross the wire. Returns the op buffers
+    concatenated in schedule order — the layout the unpack tables of
+    :class:`CompactSchedule` index into.
     """
-    S = num_shards
     out = []
-    for b, k in zip(bufs, hops):
-        if k == 0:
+    for b, (k, _, pairs) in zip(bufs, ops):
+        if k == 0 or not pairs:
             out.append(b)
             continue
-        perm = [(j, (j - k) % S if reverse else (j + k) % S)
-                for j in range(S)]
+        perm = [((d, j) if reverse else (j, d)) for j, d in pairs]
         if wire_real_dtype is not None:
             rdt = b.real.dtype
             il = complex_to_interleaved(b).astype(wire_real_dtype)

@@ -120,9 +120,9 @@ def test_compact_hlo_mechanically_distinct():
 
 
 def test_wire_bytes_model():
-    """exchange_wire_bytes: compact <= padded always; strictly less on a
-    non-uniform distribution; equal-stick equal-plane distributions come
-    out identical up to the hop-max model. Float wire halves both."""
+    """Wire models: compact <= padded always, on BOTH the aggregate and
+    the busiest-link metric; strictly less (aggregate) on a non-uniform
+    distribution. Float wire halves them."""
     rng = np.random.default_rng(19)
     dims = (16, 16, 16)
     triplets = random_sparse_triplets(rng, dims)
@@ -138,11 +138,74 @@ def test_wire_bytes_model():
         b_pad, b_cmp = (padded.exchange_wire_bytes(),
                         compact.exchange_wire_bytes())
         assert b_cmp <= b_pad
+        assert compact.exchange_busiest_link_bytes() \
+            <= padded.exchange_busiest_link_bytes()
         if strict:
             assert b_cmp < b_pad, (b_cmp, b_pad)
         cf = _make_plan(dims, parts, planes,
                         ExchangeType.COMPACT_BUFFERED_FLOAT)
         assert cf.exchange_wire_bytes() == b_cmp // 2
+
+
+def test_bucketing_never_exceeds_padded():
+    """Regression: pair sizes just above a power of two must not bucket
+    past the hop max (unclamped pow2 buckets once shipped MORE than the
+    padded layout)."""
+    from spfft_tpu.parallel.exchange import _size_classes
+    sizes = {j: 1040 + 16 * j for j in range(6)}  # 6 distinct, >4 forces
+    classes = _size_classes(sizes)                # bucketing
+    hop_max = max(sizes.values())
+    assert all(L <= hop_max for L, _ in classes)
+    assert sum(len(js) for _, js in classes) == 6
+
+
+def test_plane_skew_saves_wire():
+    """Uniform sticks + one big-plane shard: a per-hop-max schedule would
+    pad every hop to the big destination and save nothing; the size-classed
+    schedule must track the true per-pair counts (≈ Alltoallv)."""
+    rng = np.random.default_rng(29)
+    dims = (12, 12, 16)
+    triplets = random_sparse_triplets(rng, dims)
+    parts = split_by_sticks(triplets, dims, [1, 1, 1, 1])
+    planes = [10, 2, 2, 2]  # one shard owns most planes
+    padded = _make_plan(dims, parts, planes, ExchangeType.BUFFERED)
+    compact = _make_plan(dims, parts, planes, ExchangeType.COMPACT_BUFFERED)
+    b_pad = padded.exchange_wire_bytes()
+    b_cmp = compact.exchange_wire_bytes()
+    # aggregate: each shard sends ~ns*(10+2+2) vs padded 3*ns_max*10 —
+    # must save >40%. The busiest LINK (the big plane-owner's ingress) is
+    # real payload and must not regress vs padded.
+    assert b_cmp < 0.6 * b_pad, (b_cmp, b_pad)
+    assert compact.exchange_busiest_link_bytes() \
+        <= padded.exchange_busiest_link_bytes()
+    # and stays correct
+    values = [random_values(rng, len(p)) for p in parts]
+    got = compact.unshard_values(
+        compact.apply_pointwise(values, scaling=Scaling.FULL))
+    for g, v in zip(got, values):
+        np.testing.assert_allclose(g, v, atol=1e-10, rtol=0)
+
+
+def test_size_class_bucketing_round_trip():
+    """More than 4 distinct pair sizes per hop forces factor-2 bucketing;
+    the schedule must stay correct (8 shards, all-different plane counts
+    and stick counts)."""
+    rng = np.random.default_rng(30)
+    dims = (14, 14, 36)
+    triplets = random_sparse_triplets(rng, dims)
+    parts = split_by_sticks(triplets, dims, [1, 2, 3, 4, 5, 6, 7, 8])
+    planes = [1, 2, 3, 4, 5, 6, 7, 8]
+    plan = make_distributed_plan(
+        TransformType.C2C, *dims, parts, planes, mesh=make_mesh(8),
+        precision="double", exchange=ExchangeType.COMPACT_BUFFERED)
+    sched = plan._compact
+    assert any(len({L for k2, L, _ in sched.ops if k2 == k}) > 1
+               for k in range(8)), "expected multiple size classes in a hop"
+    values = [random_values(rng, len(p)) for p in parts]
+    got = plan.unshard_values(
+        plan.apply_pointwise(values, scaling=Scaling.FULL))
+    for g, v in zip(got, values):
+        np.testing.assert_allclose(g, v, atol=1e-10, rtol=0)
 
 
 def test_schedule_tables_consistent():
@@ -159,12 +222,19 @@ def test_schedule_tables_consistent():
     sched = build_compact_schedule(dp)
     S = dp.num_shards
     ns = [p.num_sticks for p in dp.shard_plans]
-    size_of_hop = dict(zip(sched.hops, sched.hop_sizes))
+    op_of_pair = {}
+    for k, L, pairs in sched.ops:
+        for pr in pairs:
+            assert pr not in op_of_pair, "pair carried by two ops"
+            op_of_pair[pr] = L
     for k in range(S):
         for j in range(S):
-            count = ns[j] * dp.num_planes[(j + k) % S]
-            if count:  # zero-count hops may be dropped from the schedule
-                assert count <= size_of_hop[k]
+            d = (j + k) % S
+            count = ns[j] * dp.num_planes[d]
+            if count:  # every nonzero pair is carried, with enough room
+                assert count <= op_of_pair[(j, d)]
+            else:
+                assert (j, d) not in op_of_pair
     # backward unpack covers each shard's true (plane, occupied column)
     # cells exactly once, with sentinels everywhere else
     total = sched.total_recv
