@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""On-chip measurement: fused shared-plan batch vs sequential dispatches,
+local and distributed (1-shard mesh on the single available chip).
+
+Criterion (VERDICT round-1 item 4): B=3 shared-plan distributed batch must
+not exceed sequential dispatch wall-clock."""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def sync(arr):
+    import jax
+    leaf = jax.tree_util.tree_leaves(arr)[0]
+    float(np.asarray(jax.numpy.real(leaf).ravel()[0]))
+
+
+def bench(fn, reps=10):
+    out = fn()
+    sync(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn()
+    sync(out)
+    return (time.perf_counter() - t0) / reps * 1e3
+
+
+def main() -> None:
+    import jax
+    from spfft_tpu import TransformType, make_local_plan
+    from spfft_tpu.parallel import make_distributed_plan, make_mesh
+    from spfft_tpu.utils import as_interleaved
+    from spfft_tpu.utils.workloads import spherical_cutoff_triplets
+
+    n = int(os.environ.get("DIM", "128"))
+    B = int(os.environ.get("B", "3"))
+    print(f"devices: {jax.devices()}  dim={n} B={B}", flush=True)
+    rng = np.random.default_rng(0)
+    triplets = spherical_cutoff_triplets(n)
+    vals = [(rng.uniform(-1, 1, len(triplets))
+             + 1j * rng.uniform(-1, 1, len(triplets))).astype(np.complex64)
+            for _ in range(B)]
+
+    # local
+    plan = make_local_plan(TransformType.C2C, n, n, n, triplets,
+                           precision="single")
+    ils = [jax.device_put(np.asarray(as_interleaved(v, "single")))
+           for v in vals]
+    stacked = jax.device_put(np.stack([np.asarray(i) for i in ils]))
+    t_seq = bench(lambda: [plan.backward(v) for v in ils])
+    t_bat = bench(lambda: plan.backward_batched(stacked))
+    print(f"local   backward: sequential {t_seq:8.2f} ms   "
+          f"fused batch {t_bat:8.2f} ms   "
+          f"({t_seq / t_bat:.2f}x, pallas={plan.pallas_active})", flush=True)
+
+    # distributed (1-shard mesh: the only real-chip mesh available)
+    dplan = make_distributed_plan(TransformType.C2C, n, n, n, [triplets],
+                                  [n], mesh=make_mesh(1),
+                                  precision="single")
+    dvals = [dplan.shard_values([v]) for v in vals]
+    dstacked = dplan.shard_values_batch(dvals)
+    t_seq = bench(lambda: [dplan.backward(v) for v in dvals])
+    t_bat = bench(lambda: dplan.backward_batched(dstacked))
+    print(f"dist(1) backward: sequential {t_seq:8.2f} ms   "
+          f"fused batch {t_bat:8.2f} ms   ({t_seq / t_bat:.2f}x)",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

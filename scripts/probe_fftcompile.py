@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""Compile-only experiments: which composition of decompress-gather + 1D FFT
+triggers the XLA compile blow-up at large sizes (no device execution)."""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+n = int(sys.argv[1]) if len(sys.argv) > 1 else 320
+S = 80379
+N = 17155322
+SLOTS = S * n
+
+
+def t(name, fn, *shapes):
+    args = [jax.ShapeDtypeStruct(s, d) for s, d in shapes]
+    t0 = time.perf_counter()
+    jax.jit(fn).lower(*args).compile()
+    print(f"{name:50s} {time.perf_counter() - t0:8.2f}s", flush=True)
+
+
+# a) plain ifft
+t("a: ifft (S,n) c64 param",
+  lambda x: jnp.fft.ifft(x, axis=-1), ((S, n), jnp.complex64))
+
+# b) complex construction from f32 param, no gather
+t("b: f32(SLOTS,2) -> complex -> reshape -> ifft",
+  lambda v: jnp.fft.ifft((v[:, 0] + 1j * v[:, 1]).reshape(S, n), axis=-1),
+  ((SLOTS, 2), jnp.float32))
+
+# c) gather -> complex -> ifft (the decompress composition)
+def c_fn(v, idx):
+    zero = jnp.zeros((1, 2), v.dtype)
+    flat = jnp.concatenate([v, zero], axis=0)[idx]
+    return jnp.fft.ifft((flat[:, 0] + 1j * flat[:, 1]).reshape(S, n),
+                        axis=-1)
+t("c: gather -> complex -> ifft", c_fn,
+  ((N, 2), jnp.float32), ((SLOTS,), jnp.int32))
+
+# d) same with optimization_barrier before the fft
+def d_fn(v, idx):
+    zero = jnp.zeros((1, 2), v.dtype)
+    flat = jnp.concatenate([v, zero], axis=0)[idx]
+    sticks = (flat[:, 0] + 1j * flat[:, 1]).reshape(S, n)
+    sticks = jax.lax.optimization_barrier(sticks)
+    return jnp.fft.ifft(sticks, axis=-1)
+t("d: gather -> barrier -> ifft", d_fn,
+  ((N, 2), jnp.float32), ((SLOTS,), jnp.int32))
+
+# e) gather feeding an elementwise op instead of fft (control)
+def e_fn(v, idx):
+    zero = jnp.zeros((1, 2), v.dtype)
+    flat = jnp.concatenate([v, zero], axis=0)[idx]
+    return (flat[:, 0] + 1j * flat[:, 1]).reshape(S, n) * 2.0
+t("e: gather -> complex -> mul (control)", e_fn,
+  ((N, 2), jnp.float32), ((SLOTS,), jnp.int32))

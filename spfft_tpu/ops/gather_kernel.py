@@ -60,9 +60,15 @@ _VALID_SHIFT = 20
 _ROW_MASK = (1 << (_VALID_SHIFT - _ROW_SHIFT)) - 1
 
 
+#: Max chunks per kernel launch: the three scalar-prefetch tables live in
+#: SMEM (1 MB on v5e); 3 arrays x 4 B x 70k = 840 KB leaves headroom for
+#: spills. Larger chunk counts are split into tile-aligned segments.
+SEG_CHUNK_LIMIT = 70_000
+
+
 @dataclasses.dataclass(frozen=True)
 class MonotoneGatherTables:
-    """Plan-time tables for one monotone gather direction."""
+    """Plan-time tables for one windowed gather direction."""
 
     row0: np.ndarray      # (C,) int32 — first source row of each chunk's DMA
     out_tile: np.ndarray  # (C,) int32 — output tile the chunk accumulates into
@@ -72,6 +78,36 @@ class MonotoneGatherTables:
     num_tiles: int        # G: output tiles
     src_rows: int         # M: padded source array rows
     span_rows: int        # K: DMA window height
+    segs: tuple = ()      # ((c0, c1, t0, t1), ...) — tile-aligned launch
+                          # segments keeping scalar-prefetch SMEM in budget;
+                          # empty = single launch
+
+
+def _tile_aligned_segments(first: np.ndarray, out_tile: np.ndarray,
+                           num_tiles: int, limit: int) -> tuple:
+    """Split chunk range [0, C) into segments of <= ``limit`` chunks whose
+    boundaries land on a tile's FIRST chunk (so no output tile spans two
+    launches and the revisiting accumulation stays within one call)."""
+    C = int(first.shape[0])
+    if C <= limit:
+        return ()
+    starts = np.flatnonzero(first == 1)
+    segs = []
+    c0 = 0
+    while c0 < C:
+        if C - c0 <= limit:
+            c1 = C
+        else:
+            cand = starts[(starts > c0) & (starts <= c0 + limit)]
+            if len(cand) == 0:  # one tile alone exceeds the limit: give up
+                return None
+            c1 = int(cand[-1])
+        t0 = int(out_tile[c0])
+        t1 = int(out_tile[c1 - 1]) + 1  # c1 > c0 always: cand > c0 or C
+        segs.append((c0, c1, t0, t1))
+        c0 = c1
+    assert segs[-1][3] == num_tiles
+    return tuple(segs)
 
 
 #: Fallback ceiling: the kernel's cost scales with the chunk count C while
@@ -82,7 +118,8 @@ _CHUNK_BLOWUP_LIMIT = 6
 
 
 def build_monotone_gather_tables(idx: np.ndarray, valid: np.ndarray,
-                                 num_src: int, k_rows: int = 0):
+                                 num_src: int, k_rows: int = 0,
+                                 allow_segments: bool = True):
     """Build tables for ``out[j] = src[idx[j]] * valid[j]``.
 
     Args:
@@ -94,6 +131,10 @@ def build_monotone_gather_tables(idx: np.ndarray, valid: np.ndarray,
       num_src: size of the source array.
       k_rows: force the DMA window height (0 = choose from the window-count
         distribution).
+      allow_segments: past SEG_CHUNK_LIMIT chunks the gather runs as
+        several tile-aligned launches (scalar-prefetch SMEM budget);
+        ``False`` declines instead — the stacked-uniform-table layout of
+        distributed plans cannot segment per shard.
     Returns:
       MonotoneGatherTables, or None if ``idx`` is empty or so disordered
       that the chunk decomposition would be slower than the XLA gather
@@ -151,12 +192,17 @@ def build_monotone_gather_tables(idx: np.ndarray, valid: np.ndarray,
     # src_rows * 128, which requires src_rows * 128 >= num_src even when the
     # trailing source region is never referenced.
     src_rows = max(int(row0.max()) + K, -(-int(num_src) // TILE_LANE))
+    out_tile32 = tile_of.astype(np.int32)
+    segs = _tile_aligned_segments(first, out_tile32, G, SEG_CHUNK_LIMIT)
+    if segs is None or (segs and not allow_segments):
+        return None
     return MonotoneGatherTables(
         row0=row0,
-        out_tile=tile_of.astype(np.int32),
+        out_tile=out_tile32,
         first=first,
         packed=packed.reshape(C, TILE_SUB, TILE_LANE),
-        num_out=L, num_tiles=G, src_rows=src_rows, span_rows=K)
+        num_out=L, num_tiles=G, src_rows=src_rows, span_rows=K,
+        segs=segs)
 
 
 def compression_gather_inputs(value_indices, num_slots: int,
@@ -339,10 +385,11 @@ def _kernel_batched(K: int, row0_ref, out_tile_ref, first_ref, packed_ref,
 
 
 @functools.partial(jax.jit, static_argnames=("span_rows", "src_rows",
-                                             "num_tiles", "interpret"))
+                                             "num_tiles", "interpret",
+                                             "segs"))
 def monotone_gather(re, im, row0, out_tile, first, packed, *,
                     span_rows: int, src_rows: int, num_tiles: int,
-                    interpret: bool = False):
+                    interpret: bool = False, segs: tuple = ()):
     """Run the windowed gather.
 
     Args:
@@ -351,10 +398,34 @@ def monotone_gather(re, im, row0, out_tile, first, packed, *,
         own output slab).
       row0/out_tile/first/packed: device tables (see
         build_monotone_gather_tables).
+      segs: tile-aligned launch segments from the table builder (static);
+        each runs as its own pallas_call over its chunk slice and the
+        per-segment outputs concatenate along the tile axis.
     Returns:
       (out_re, out_im): each (num_tiles, 8, 128) float32, with a leading B
       when the source was batched.
     """
+    if segs:
+        outs_re, outs_im = [], []
+        for (c0, c1, t0, t1) in segs:
+            o_re, o_im = _monotone_gather_call(
+                re, im, row0[c0:c1], out_tile[c0:c1] - t0, first[c0:c1],
+                packed[c0:c1], span_rows=span_rows, num_tiles=t1 - t0,
+                interpret=interpret)
+            outs_re.append(o_re)
+            outs_im.append(o_im)
+        axis = 1 if re.ndim == 3 else 0
+        return (jnp.concatenate(outs_re, axis=axis),
+                jnp.concatenate(outs_im, axis=axis))
+    return _monotone_gather_call(re, im, row0, out_tile, first, packed,
+                                 span_rows=span_rows, num_tiles=num_tiles,
+                                 interpret=interpret)
+
+
+def _monotone_gather_call(re, im, row0, out_tile, first, packed, *,
+                          span_rows: int, num_tiles: int, interpret: bool):
+    """One pallas_call over one chunk range (the whole table when
+    unsegmented)."""
     C = row0.shape[0]
     K = span_rows
     if re.ndim == 3:
@@ -433,7 +504,7 @@ def run_monotone_gather(values_il, tables: MonotoneGatherTables,
     out_re, out_im = monotone_gather(
         re, im, *device_tables, span_rows=tables.span_rows,
         src_rows=tables.src_rows, num_tiles=tables.num_tiles,
-        interpret=interpret)
+        interpret=interpret, segs=tables.segs)
     return interleaved_from_planar(out_re, out_im, tables.num_out)
 
 

@@ -83,17 +83,32 @@ def compress(sticks, value_indices, scale=None):
 # z-stage: batched 1D FFT over sticks
 # ---------------------------------------------------------------------------
 
+def _mat(x):
+    """Materialise an FFT operand behind an optimization barrier.
+
+    XLA's TPU FFT compile time explodes when the operand is a *computed*
+    value rather than a materialised buffer: a (80379, 320) c64 ifft
+    compiles in ~13 s from a parameter but ~560 s when fed by the
+    decompress gather (or even a bare complex construction) — the 320^3
+    "stall" of round 1. The barrier forces a materialised operand (which
+    the FFT custom call needs anyway) and restores O(10 s) compiles with
+    no runtime cost measured at 256^3. Probe: scripts/probe_fftcompile.py.
+    """
+    return jax.lax.optimization_barrier(x)
+
+
 def z_backward(sticks):
     """Unnormalised inverse DFT along z for every stick:
     ``ifft * dim_z`` (reference backward z, execution_host.cpp:311-315)."""
     dim_z = sticks.shape[-1]
-    return jnp.fft.ifft(sticks, axis=-1) * sticks.real.dtype.type(dim_z)
+    return jnp.fft.ifft(_mat(sticks), axis=-1) \
+        * sticks.real.dtype.type(dim_z)
 
 
 def z_forward(sticks):
     """Forward DFT along z for every stick (reference forward z,
     execution_host.cpp:283-290)."""
-    return jnp.fft.fft(sticks, axis=-1)
+    return jnp.fft.fft(_mat(sticks), axis=-1)
 
 
 # ---------------------------------------------------------------------------
@@ -179,12 +194,12 @@ def xy_backward_c2c(grid):
     """
     dim_y, dim_x = grid.shape[-2], grid.shape[-1]
     scale = grid.real.dtype.type(dim_y * dim_x)
-    return jnp.fft.ifft2(grid, axes=(-2, -1)) * scale
+    return jnp.fft.ifft2(_mat(grid), axes=(-2, -1)) * scale
 
 
 def xy_forward_c2c(grid):
     """Forward DFT over (y, x) per plane."""
-    return jnp.fft.fft2(grid, axes=(-2, -1))
+    return jnp.fft.fft2(_mat(grid), axes=(-2, -1))
 
 
 def _expand_x_window(sub, x0: int, dim_x: int):
@@ -222,16 +237,16 @@ def xy_backward_c2c_split(sub, x0: int, dim_x: int):
     (planes, dim_y, dim_x)."""
     dim_y = sub.shape[-2]
     scale = sub.real.dtype.type(dim_y * dim_x)
-    sub = jnp.fft.ifft(sub, axis=-2)
-    return jnp.fft.ifft(_expand_x_window(sub, x0, dim_x), axis=-1) * scale
+    sub = jnp.fft.ifft(_mat(sub), axis=-2)
+    return jnp.fft.ifft(_mat(_expand_x_window(sub, x0, dim_x)), axis=-1) * scale
 
 
 def xy_forward_c2c_split(space, x0: int, w: int):
     """Forward mirror of :func:`xy_backward_c2c_split`: dense x-DFT, then
     the y-DFT only on the occupied x columns ``[x0, x0+w) mod dim_x`` —
     the only columns the stick gather reads. Returns (planes, dim_y, w)."""
-    grid = jnp.fft.fft(space, axis=-1)
-    return jnp.fft.fft(_extract_x_window(grid, x0, w), axis=-2)
+    grid = jnp.fft.fft(_mat(space), axis=-1)
+    return jnp.fft.fft(_mat(_extract_x_window(grid, x0, w)), axis=-2)
 
 
 def xy_backward_r2c_split(sub, x0: int, dim_x: int, dim_x_freq: int):
@@ -243,17 +258,17 @@ def xy_backward_r2c_split(sub, x0: int, dim_x: int, dim_x_freq: int):
     transform_1d_host.hpp:137-196."""
     dim_y, w = sub.shape[-2], sub.shape[-1]
     rdtype = sub.real.dtype
-    sub = jnp.fft.ifft(sub, axis=-2) * rdtype.type(dim_y)
+    sub = jnp.fft.ifft(_mat(sub), axis=-2) * rdtype.type(dim_y)
     full = jnp.pad(sub, ((0, 0), (0, 0), (x0, dim_x_freq - x0 - w)))
-    return jnp.fft.irfft(full, n=dim_x, axis=-1) * rdtype.type(dim_x)
+    return jnp.fft.irfft(_mat(full), n=dim_x, axis=-1) * rdtype.type(dim_x)
 
 
 def xy_forward_r2c_split(space, x0: int, w: int):
     """Forward mirror of :func:`xy_backward_r2c_split`: dense r2c x-DFT,
     then the y-DFT only on the occupied half-spectrum columns. ``space``
     is real (planes, dim_y, dim_x); returns (planes, dim_y, w) complex."""
-    grid = jnp.fft.rfft(space, axis=-1)
-    return jnp.fft.fft(grid[..., x0:x0 + w], axis=-2)
+    grid = jnp.fft.rfft(_mat(space), axis=-1)
+    return jnp.fft.fft(_mat(grid[..., x0:x0 + w]), axis=-2)
 
 
 def xy_backward_r2c(grid, dim_x: int):
@@ -265,8 +280,8 @@ def xy_backward_r2c(grid, dim_x: int):
     """
     dim_y = grid.shape[-2]
     rdtype = grid.real.dtype
-    grid = jnp.fft.ifft(grid, axis=-2) * rdtype.type(dim_y)
-    return jnp.fft.irfft(grid, n=dim_x, axis=-1) * rdtype.type(dim_x)
+    grid = jnp.fft.ifft(_mat(grid), axis=-2) * rdtype.type(dim_y)
+    return jnp.fft.irfft(_mat(grid), n=dim_x, axis=-1) * rdtype.type(dim_x)
 
 
 def xy_forward_r2c(space):
@@ -275,8 +290,8 @@ def xy_forward_r2c(space):
     ``space`` is real (planes, dim_y, dim_x); returns
     (planes, dim_y, dim_x//2+1) complex.
     """
-    grid = jnp.fft.rfft(space, axis=-1)
-    return jnp.fft.fft(grid, axis=-2)
+    grid = jnp.fft.rfft(_mat(space), axis=-1)
+    return jnp.fft.fft(_mat(grid), axis=-2)
 
 
 # ---------------------------------------------------------------------------

@@ -385,7 +385,8 @@ class DistributedTransformPlan:
         def build_all(which, num_src, num_out):
             # two passes: discover each shard's preferred K, then rebuild
             # with the common (max) K so the SPMD program is uniform
-            tables = [gk.build_monotone_gather_tables(idx, valid, num_src)
+            tables = [gk.build_monotone_gather_tables(idx, valid, num_src,
+                                                      allow_segments=False)
                       for (idx, valid) in (s[which] for s in per_shard)]
             if any(t is None for t in tables):
                 return None
@@ -393,7 +394,8 @@ class DistributedTransformPlan:
             tables = [t if t.span_rows == k else
                       gk.build_monotone_gather_tables(
                           per_shard[r][which][0], per_shard[r][which][1],
-                          num_src, k_rows=k)
+                          num_src, k_rows=k,
+                          allow_segments=False)
                       for r, t in enumerate(tables)]
             if any(t is None for t in tables):
                 return None  # a forced-K rebuild crossed the chunk ceiling

@@ -240,7 +240,7 @@ class TransformPlan:
             re, im, tables["dec_row0"], tables["dec_out_tile"],
             tables["dec_first"], tables["dec_packed"],
             span_rows=t.span_rows, src_rows=t.src_rows,
-            num_tiles=t.num_tiles)
+            num_tiles=t.num_tiles, segs=t.segs)
         flat = (out_re.reshape(-1)[:t.num_out]
                 + 1j * out_im.reshape(-1)[:t.num_out])
         return flat.reshape(p.num_sticks, p.dim_z)
@@ -259,7 +259,7 @@ class TransformPlan:
             re, im, tables["cmp_row0"], tables["cmp_out_tile"],
             tables["cmp_first"], tables["cmp_packed"],
             span_rows=t.span_rows, src_rows=t.src_rows,
-            num_tiles=t.num_tiles)
+            num_tiles=t.num_tiles, segs=t.segs)
         values = gk.interleaved_from_planar(out_re, out_im, t.num_out)
         if scale is not None:
             values = values * jnp.asarray(scale, values.dtype)
@@ -343,7 +343,7 @@ class TransformPlan:
             re, im, tables["dec_row0"], tables["dec_out_tile"],
             tables["dec_first"], tables["dec_packed"],
             span_rows=t.span_rows, src_rows=t.src_rows,
-            num_tiles=t.num_tiles)
+            num_tiles=t.num_tiles, segs=t.segs)
         B = values_b.shape[0]
         flat = (out_re.reshape(B, -1)[:, :t.num_out]
                 + 1j * out_im.reshape(B, -1)[:, :t.num_out])
@@ -366,7 +366,7 @@ class TransformPlan:
             re, im, tables["cmp_row0"], tables["cmp_out_tile"],
             tables["cmp_first"], tables["cmp_packed"],
             span_rows=t.span_rows, src_rows=t.src_rows,
-            num_tiles=t.num_tiles)
+            num_tiles=t.num_tiles, segs=t.segs)
         values = gk.interleaved_from_planar(out_re, out_im, t.num_out)
         if scale is not None:
             values = values * jnp.asarray(scale, values.dtype)

@@ -233,6 +233,42 @@ def test_src_rows_covers_whole_source():
     np.testing.assert_array_equal(out, src[idx])
 
 
+def test_segmented_launch_matches_single(monkeypatch):
+    """Past the SMEM chunk budget the gather splits into tile-aligned
+    launches; force a tiny limit and check the concatenated segments equal
+    the single-launch result."""
+    monkeypatch.setattr(gk, "SEG_CHUNK_LIMIT", 7)
+    rng = np.random.default_rng(41)
+    M = 30000
+    idx = np.sort(rng.choice(M, 15000, replace=False)).astype(np.int64)
+    src = rng.random((M, 2)).astype(np.float32)
+    t = gk.build_monotone_gather_tables(idx, np.ones(len(idx), bool), M)
+    assert t is not None and len(t.segs) >= 2
+    # segments are tile-aligned and cover everything exactly once
+    assert t.segs[0][0] == 0 and t.segs[-1][1] == len(t.row0)
+    assert t.segs[0][2] == 0 and t.segs[-1][3] == t.num_tiles
+    for (a, b) in zip(t.segs, t.segs[1:]):
+        assert a[1] == b[0] and a[3] == b[2]
+    out = np.asarray(gk.run_monotone_gather(jnp.asarray(src), t,
+                                            interpret=True))
+    np.testing.assert_array_equal(out, src[idx])
+    # batched source through the same segments: per-batch results equal
+    src_b = np.stack([src, src * 2, src[::-1]])
+    re, im = gk.planar_from_interleaved(jnp.asarray(src_b), t.src_rows)
+    out_re, out_im = gk.monotone_gather(
+        re, im, jnp.asarray(t.row0), jnp.asarray(t.out_tile),
+        jnp.asarray(t.first), jnp.asarray(t.packed),
+        span_rows=t.span_rows, src_rows=t.src_rows,
+        num_tiles=t.num_tiles, interpret=True, segs=t.segs)
+    out_b = np.asarray(gk.interleaved_from_planar(out_re, out_im,
+                                                  t.num_out))
+    for b in range(3):
+        np.testing.assert_array_equal(out_b[b], src_b[b][idx])
+    # distributed builds refuse segmentation (uniform stacked tables)
+    assert gk.build_monotone_gather_tables(
+        idx, np.ones(len(idx), bool), M, allow_segments=False) is None
+
+
 def test_forced_pallas_on_double_rejected():
     from spfft_tpu import InvalidParameterError, TransformType, make_local_plan
     with pytest.raises(InvalidParameterError):
