@@ -45,7 +45,12 @@ def main() -> None:
     mark(f"plan built (pallas_active={plan._pallas_active}, "
          f"split_x={plan._split_x})")
 
-    values_il = jax.device_put(np.asarray(as_interleaved(values, "single")))
+    if getattr(plan, "pair_values_io", False):
+        values_il = jax.device_put(
+            np.stack([values.real, values.imag], axis=0))
+    else:
+        values_il = jax.device_put(
+            np.asarray(as_interleaved(values, "single")))
     values_il.block_until_ready()
     mark("values on device")
 
@@ -53,26 +58,33 @@ def main() -> None:
         table.block_until_ready()
     mark("tables on device")
 
+    def sync_one(out):
+        # index a single element WITHOUT ravel: a device-side ravel of a
+        # trailing-2 array launches a standalone relayout that tiles the
+        # minor dim 2 -> 128 (64x memory; OOM at 512^3)
+        first = out[(0,) * (out.ndim - 1)][:1]
+        return float(np.asarray(first).ravel()[0])
+
     if stage == "pair":
         run = lambda: plan.apply_pointwise(values_il)
     elif stage == "backward":
         run = lambda: plan.backward(values_il)
     elif stage == "forward":
         space = plan.backward(values_il)
-        float(np.asarray(space.ravel()[0]))
+        sync_one(space)
         mark("backward done (forward-stage setup)")
         run = lambda: plan.forward(space)
     else:
         raise SystemExit(f"unknown stage {stage}")
     out = run()
-    float(np.asarray(out.ravel()[0]))
+    sync_one(out)
     mark(f"{stage} compiled + first run")
 
     reps = 5
     t0 = time.perf_counter()
     for _ in range(reps):
         out = run()
-    float(np.asarray(out.ravel()[0]))
+    sync_one(out)
     mark(f"{stage} x{reps}: "
          f"{(time.perf_counter() - t0) / reps * 1e3:.2f} ms each")
 

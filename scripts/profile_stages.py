@@ -2,12 +2,18 @@
 """Per-stage wall-clock + achieved-GB/s breakdown of the north-star pipeline
 on the real device, against a measured device-copy floor.
 
-Timing is hard-synced (host readback of one element — ``block_until_ready``
-returns early on this remote-attached platform, see bench.py). GB/s is
-*effective*: the stage's logical bytes (elements read + written once, c64=8B)
-over wall-clock — FFT stages do more internal passes, so their effective
-number understates the hardware traffic; the copy floor row calibrates what
-"bandwidth-bound" means on this chip+tunnel.
+Dispatch through this remote-attached platform costs ~10 ms per call, which
+swamps per-stage device time at any size — so each stage is timed as ONE
+executable running R scanned iterations (carry = the stage input, perturbed
+by a cheap elementwise pass each step so XLA cannot hoist the loop-invariant
+stage out of the scan). The perturbation pass is measured by a calibration
+scan and subtracted. Hard-synced via host readback (``block_until_ready``
+returns early here, see bench.py).
+
+GB/s is *effective*: the stage's logical bytes (elements read + written
+once, c64=8B) over device time — FFT stages do more internal passes, so
+their effective number understates hardware traffic; the copy-floor row
+calibrates what "bandwidth-bound" means on this chip.
 
 Usage: DIM=256 python scripts/profile_stages.py   (or DIMS="64 128 256")
 """
@@ -27,6 +33,7 @@ from spfft_tpu.utils.workloads import spherical_cutoff_triplets
 from spfft_tpu.utils import as_interleaved
 
 C64 = 8  # bytes
+R = int(os.environ.get("REPS", 20))
 
 
 def sync(out):
@@ -34,32 +41,56 @@ def sync(out):
     float(np.asarray(jax.numpy.real(leaf).ravel()[0]))
 
 
-def timeit(name, fn, *args, reps=10, nbytes=0):
-    out = fn(*args)
-    sync(out)
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = fn(*args)
-    sync(out)
-    dt = (time.perf_counter() - t0) / reps
-    gbs = nbytes / dt / 1e9 if nbytes else 0.0
-    print(f"{name:24s} {dt*1e3:8.2f} ms   {gbs:7.1f} GB/s "
-          f"({nbytes/1e6:8.1f} MB logical)", flush=True)
-    return out, dt
+def _perturb(x):
+    return x * x.dtype.type(1.0 + 1e-7)
 
 
-def copy_floor(n_elems_c64: int, reps=10):
-    """Device copy floor: out = in + 0 on an n-element c64 array (one read +
-    one write per element, no compute)."""
-    x = jnp.zeros((n_elems_c64, 2), jnp.float32)
-    f = jax.jit(lambda a: a + jnp.float32(0))
+def _scan_seconds(body, x, reps=3):
+    """Wall-clock of ONE dispatch of R scanned body(x) steps (body must
+    consume the perturbed carry so nothing hoists)."""
+    def run(x0):
+        def step(c, _):
+            xp = _perturb(c)
+            y = body(xp)
+            leaf = jax.tree_util.tree_leaves(y)[0]
+            return xp, jnp.real(leaf).ravel()[0]
+        _, ys = jax.lax.scan(step, x0, None, length=R)
+        return ys
+    f = jax.jit(run)
     out = f(x)
     sync(out)
     t0 = time.perf_counter()
     for _ in range(reps):
-        out = f(out)
+        out = f(x)
     sync(out)
-    dt = (time.perf_counter() - t0) / reps
+    return (time.perf_counter() - t0) / reps
+
+
+def scan_time(name, body, x, nbytes, calib_s):
+    """Per-step stage seconds: scanned time minus the calibration scan
+    (perturbation pass + scan overhead), divided by R."""
+    total = _scan_seconds(body, x)
+    dt = max((total - calib_s) / R, 1e-9)
+    gbs = nbytes / dt / 1e9 if nbytes else 0.0
+    print(f"{name:24s} {dt*1e3:8.3f} ms   {gbs:7.1f} GB/s "
+          f"({nbytes/1e6:8.1f} MB logical)", flush=True)
+    return dt
+
+
+def calibration(x):
+    """The scan with an identity body: measures perturbation + overhead."""
+    return _scan_seconds(lambda xp: xp, x)
+
+
+def copy_floor(n_elems_c64: int):
+    """Device copy floor: one elementwise read+write pass over an n-element
+    c64-sized array, amortised in a scan. The body multiplier must not be
+    exactly 1.0 — XLA folds ``x * 1.0f`` away and the step would be one
+    pass, not two."""
+    x = jnp.ones((n_elems_c64, 2), jnp.float32)
+    total = _scan_seconds(lambda xp: xp * jnp.float32(1.0 - 1e-7), x)
+    # each step is perturb + body = two full passes
+    dt = total / R / 2
     return 2 * n_elems_c64 * C64 / dt / 1e9, dt
 
 
@@ -71,7 +102,8 @@ def profile(n: int):
     N, S, Z = p.num_values, p.num_sticks, p.dim_z
     SZ, G = S * Z, n * n * n
     print(f"\n== dim={n} values={N} sticks={S} "
-          f"pallas={plan._pallas_active} ==", flush=True)
+          f"pallas={plan._pallas_active} (R={R} scanned steps/stage) ==",
+          flush=True)
     floor_gbs, _ = copy_floor(G)
     print(f"{'copy floor (n^3 c64)':24s} {'':8s}      {floor_gbs:7.1f} GB/s",
           flush=True)
@@ -79,46 +111,61 @@ def profile(n: int):
     rng = np.random.default_rng(0)
     values = (rng.uniform(-1, 1, N)
               + 1j * rng.uniform(-1, 1, N)).astype(np.complex64)
-    values_il = jax.device_put(np.asarray(as_interleaved(values, "single")))
+    if getattr(plan, "pair_values_io", False):
+        values_il = jax.device_put(
+            np.stack([values.real, values.imag], axis=0))
+    else:
+        values_il = jax.device_put(
+            np.asarray(as_interleaved(values, "single")))
     tables = plan._tables
 
     total_bytes = 0
     total_time = 0.0
 
-    def stage(name, fn, arg, nbytes):
+    def stage(name, body, arg, nbytes, calib_s):
         nonlocal total_bytes, total_time
-        out, dt = timeit(name, fn, arg, nbytes=nbytes)
+        dt = scan_time(name, body, arg, nbytes, calib_s)
         total_bytes += nbytes
         total_time += dt
-        return out
 
-    dec = jax.jit(lambda v: plan._decompress(v, tables))
-    sticks = stage("decompress", dec, values_il, (N + SZ) * C64)
-    zb = jax.jit(stages.z_backward)
-    sticks_z = stage("z_backward (ifft)", zb, sticks, 2 * SZ * C64)
-    s2g = jax.jit(lambda s: stages.sticks_to_grid(
-        s, tables["col_inv"], p.dim_y, p.dim_x_freq))
-    grid = stage("sticks_to_grid", s2g, sticks_z, (SZ + G) * C64)
-    xyb = jax.jit(stages.xy_backward_c2c)
-    space = stage("xy_backward (ifft2)", xyb, grid, 2 * G * C64)
+    # calibration per carry shape (the perturbation pass scales with it)
+    cal_values = calibration(values_il)
+    sticks0 = jax.jit(lambda v: plan._decompress(v, tables))(values_il)
+    cal_sticks = calibration(sticks0)
+    grid0 = jax.jit(lambda s: stages.sticks_to_grid(
+        s, tables["col_inv"], p.dim_y, p.dim_x_freq))(sticks0)
+    cal_grid = calibration(grid0)
 
-    xyf = jax.jit(stages.xy_forward_c2c)
-    gridf = stage("xy_forward (fft2)", xyf, space, 2 * G * C64)
-    g2s = jax.jit(lambda g: stages.grid_to_sticks(g, tables["scatter_cols"]))
-    sticksf = stage("grid_to_sticks", g2s, gridf, (G + SZ) * C64)
-    zf = jax.jit(stages.z_forward)
-    sticks_zf = stage("z_forward (fft)", zf, sticksf, 2 * SZ * C64)
-    cmp_ = jax.jit(lambda s: plan._compress(s, tables, None))
-    stage("compress", cmp_, sticks_zf, (SZ + N) * C64)
+    stage("decompress", lambda v: plan._decompress(v, tables), values_il,
+          (N + SZ) * C64, cal_values)
+    stage("z_backward (ifft)", stages.z_backward, sticks0,
+          2 * SZ * C64, cal_sticks)
+    stage("sticks_to_grid", lambda s: stages.sticks_to_grid(
+        s, tables["col_inv"], p.dim_y, p.dim_x_freq), sticks0,
+        (SZ + G) * C64, cal_sticks)
+    stage("xy_backward (ifft2)", stages.xy_backward_c2c, grid0,
+          2 * G * C64, cal_grid)
+    stage("xy_forward (fft2)", stages.xy_forward_c2c, grid0,
+          2 * G * C64, cal_grid)
+    stage("grid_to_sticks", lambda g: stages.grid_to_sticks(
+        g, tables["scatter_cols"]), grid0, (G + SZ) * C64, cal_grid)
+    stage("z_forward (fft)", stages.z_forward, sticks0,
+          2 * SZ * C64, cal_sticks)
+    stage("compress", lambda s: plan._compress(s, tables, None), sticks0,
+          (SZ + N) * C64, cal_sticks)
 
     print(f"{'sum of stages':24s} {total_time*1e3:8.2f} ms   "
           f"{total_bytes/total_time/1e9:7.1f} GB/s", flush=True)
 
-    pair = jax.jit(lambda v: plan._forward_impl(
-        plan._backward_impl(v, tables), tables, scaled=False))
-    _, dt = timeit("FULL fused pair", pair, values_il, nbytes=total_bytes)
-    print(f"{'fusion saving':24s} {(total_time-dt)*1e3:8.2f} ms "
-          f"({(1 - dt/total_time)*100:.0f}% vs stage sum)", flush=True)
+    # the fused pair, scanned through iterate-style composition
+    pair_t = scan_time(
+        "FULL fused pair",
+        lambda v: plan._forward_impl(plan._backward_impl(v, tables), tables,
+                                     scaled=False),
+        values_il, total_bytes, cal_values)
+    print(f"{'vs stage sum':24s} {(total_time-pair_t)*1e3:8.2f} ms "
+          f"({(1 - pair_t/max(total_time,1e-12))*100:.0f}% saved by fusion)",
+          flush=True)
 
 
 if __name__ == "__main__":

@@ -508,21 +508,55 @@ def run_monotone_gather(values_il, tables: MonotoneGatherTables,
     return interleaved_from_planar(out_re, out_im, tables.num_out)
 
 
-def planar_from_interleaved(values_il, src_rows: int):
-    """(N, 2) interleaved -> two zero-padded (src_rows, 128) planar arrays;
-    a leading batch dim (B, N, 2) maps to (B, src_rows, 128)."""
-    n = values_il.shape[-2]
+def planar_from_interleaved(values_il, src_rows: int, pair: bool = False):
+    """Value array -> two zero-padded (src_rows, 128) planar arrays.
+
+    Default layout is interleaved rows (N, 2) (batched: (B, N, 2));
+    ``pair=True`` reads the planar-pair layout (2, N) (batched: (B, 2, N))
+    — row 0 real, row 1 imaginary. The pair form exists because a large
+    (N, 2) array at the jit boundary can be assigned TPU's T(8,128) tiled
+    layout, padding the minor dim 2 -> 128 (64x memory — 36 GB at 512^3),
+    while strided flat interleaves lower ~70x too slow; (2, N) row slices
+    are both compact (4x sublane pad at most) and fast.
+    """
+    if pair:
+        n = values_il.shape[-1]
+        re_flat = values_il[..., 0, :]
+        im_flat = values_il[..., 1, :]
+    else:
+        n = values_il.shape[-2]
+        re_flat = values_il[..., 0]
+        im_flat = values_il[..., 1]
     pad = src_rows * TILE_LANE - n
-    batch = [(0, 0)] * (values_il.ndim - 2)
-    shape = values_il.shape[:-2] + (src_rows, TILE_LANE)
-    re = jnp.pad(values_il[..., 0], batch + [(0, pad)]).reshape(shape)
-    im = jnp.pad(values_il[..., 1], batch + [(0, pad)]).reshape(shape)
+    batch = [(0, 0)] * (re_flat.ndim - 1)
+    shape = re_flat.shape[:-1] + (src_rows, TILE_LANE)
+    re = jnp.pad(re_flat, batch + [(0, pad)]).reshape(shape)
+    im = jnp.pad(im_flat, batch + [(0, pad)]).reshape(shape)
     return re, im
 
 
-def interleaved_from_planar(out_re, out_im, num_out: int):
+def planar_from_complex(x, src_rows: int):
+    """Complex (S, Z) sticks — or batched (B, S, Z) — -> two zero-padded
+    (src_rows, 128) planar arrays (leading B preserved). Goes straight
+    from the complex values to planar so no big interleaved (N, 2)
+    intermediate can be assigned the 64x-padded tiled layout (see
+    planar_from_interleaved)."""
+    batch = x.shape[:1] if x.ndim == 3 else ()
+    re_flat = jnp.real(x).reshape(batch + (-1,))
+    im_flat = jnp.imag(x).reshape(batch + (-1,))
+    pad = [(0, 0)] * len(batch) + [(0, src_rows * TILE_LANE
+                                    - re_flat.shape[-1])]
+    shape = batch + (src_rows, TILE_LANE)
+    return (jnp.pad(re_flat, pad).reshape(shape),
+            jnp.pad(im_flat, pad).reshape(shape))
+
+
+def interleaved_from_planar(out_re, out_im, num_out: int,
+                            pair: bool = False):
     """Kernel outputs -> (num_out, 2) interleaved ((B, num_out, 2) when
-    batched)."""
+    batched); ``pair=True`` returns the planar-pair layout (2, num_out) /
+    (B, 2, num_out) instead, never materialising a big (N, 2) shape (see
+    planar_from_interleaved on why)."""
     if out_re.ndim == 4:
         B = out_re.shape[0]
         re = out_re.reshape(B, -1)[:, :num_out]
@@ -530,4 +564,4 @@ def interleaved_from_planar(out_re, out_im, num_out: int):
     else:
         re = out_re.reshape(-1)[:num_out]
         im = out_im.reshape(-1)[:num_out]
-    return jnp.stack([re, im], axis=-1)
+    return jnp.stack([re, im], axis=-2 if pair else -1)

@@ -31,6 +31,17 @@ import numpy as np
 
 logger = logging.getLogger("spfft_tpu")
 
+#: Above this many sparse values, the plan's device boundary for value
+#: arrays is the planar PAIR layout (2, N) — row 0 real, row 1 imag —
+#: instead of interleaved rows (N, 2): XLA can assign a large (N, 2)
+#: boundary array TPU's T(8,128) tiled layout, padding the minor dim
+#: 2 -> 128 — 64x memory, 36 GB at 512^3 (measured), while flat (2N,)
+#: strided interleaves lower ~70x too slow; (2, N) is compact AND fast
+#: (see ops/gather_kernel.planar_from_interleaved). 16M keeps the
+#: battle-tested (N, 2) layout for every grid up to 256^3 and switches
+#: 320^3+.
+PAIR_IO_THRESHOLD = 16_000_000
+
 from .errors import InvalidParameterError
 from .indexing import IndexPlan, build_index_plan
 from .ops import stages
@@ -55,6 +66,7 @@ class TransformPlan:
         self.precision = precision
         self._rdt = real_dtype(precision)
         self._cdt = complex_dtype(precision)
+        self._pair_io = index_plan.num_values >= PAIR_IO_THRESHOLD
         # Static tables, device-committed once (plan time, never at execute
         # time — mirroring SURVEY.md §3.1's plan/execute split). They are
         # passed to the jitted pipelines as arguments, not closure constants:
@@ -178,6 +190,16 @@ class TransformPlan:
         for the chunk decomposition). False means the XLA gather path."""
         return self._pallas_active
 
+    @property
+    def pair_values_io(self) -> bool:
+        """True when this plan's device-side value arrays use the planar
+        PAIR layout (2, num_values) — row 0 real, row 1 imaginary —
+        instead of interleaved rows (num_values, 2); large plans only
+        (see PAIR_IO_THRESHOLD). ``backward`` accepts both (and complex/
+        numpy inputs as always); ``forward``/``apply_pointwise`` then
+        RETURN the pair layout; ``np.asarray(out).T`` gives rows."""
+        return self._pair_io
+
     # -- reference Transform getters (transform.hpp:91-151) -----------------
     @property
     def transform_type(self) -> TransformType:
@@ -229,13 +251,15 @@ class TransformPlan:
         p = self.index_plan
         if not pallas or not self._pallas_active \
                 or self._pallas["dec"] is None:
+            if self._pair_io and values_il.shape[0] == 2:
+                values_il = values_il.T  # pair boundary -> rows, XLA path
             return stages.decompress(values_il.astype(self._rdt),
                                      tables["slot_src"], p.num_sticks,
                                      p.dim_z)
         from .ops import gather_kernel as gk
         t = self._pallas["dec"]
         re, im = gk.planar_from_interleaved(values_il.astype(np.float32),
-                                            t.src_rows)
+                                            t.src_rows, pair=self._pair_io)
         out_re, out_im = gk.monotone_gather(
             re, im, tables["dec_row0"], tables["dec_out_tile"],
             tables["dec_first"], tables["dec_packed"],
@@ -249,18 +273,18 @@ class TransformPlan:
         p = self.index_plan
         if not pallas or not self._pallas_active \
                 or self._pallas["cmp"] is None:
-            return stages.compress(sticks, tables["value_indices"], scale)
+            values = stages.compress(sticks, tables["value_indices"], scale)
+            return values.T if self._pair_io else values
         from .ops import gather_kernel as gk
         t = self._pallas["cmp"]
-        flat_il = jnp.stack([jnp.real(sticks).reshape(-1),
-                             jnp.imag(sticks).reshape(-1)], axis=-1)
-        re, im = gk.planar_from_interleaved(flat_il, t.src_rows)
+        re, im = gk.planar_from_complex(sticks, t.src_rows)
         out_re, out_im = gk.monotone_gather(
             re, im, tables["cmp_row0"], tables["cmp_out_tile"],
             tables["cmp_first"], tables["cmp_packed"],
             span_rows=t.span_rows, src_rows=t.src_rows,
             num_tiles=t.num_tiles, segs=t.segs)
-        values = gk.interleaved_from_planar(out_re, out_im, t.num_out)
+        values = gk.interleaved_from_planar(out_re, out_im, t.num_out,
+                                            pair=self._pair_io)
         if scale is not None:
             values = values * jnp.asarray(scale, values.dtype)
         return values
@@ -331,6 +355,8 @@ class TransformPlan:
         otherwise."""
         p = self.index_plan
         if not self._pallas_active or self._pallas["dec"] is None:
+            if self._pair_io and values_b.shape[1] == 2:
+                values_b = jnp.swapaxes(values_b, 1, 2)  # pair -> rows
             return jax.vmap(
                 lambda v: stages.decompress(v.astype(self._rdt),
                                             tables["slot_src"],
@@ -338,7 +364,8 @@ class TransformPlan:
         from .ops import gather_kernel as gk
         t = self._pallas["dec"]
         re, im = gk.planar_from_interleaved(values_b.astype(np.float32),
-                                            t.src_rows)
+                                            t.src_rows,
+                                            pair=self._pair_io)
         out_re, out_im = gk.monotone_gather(
             re, im, tables["dec_row0"], tables["dec_out_tile"],
             tables["dec_first"], tables["dec_packed"],
@@ -350,24 +377,24 @@ class TransformPlan:
         return flat.reshape(B, p.num_sticks, p.dim_z)
 
     def _compress_batched(self, sticks_b, tables, scale):
-        """(B, num_sticks, dim_z) -> (B, num_values, 2)."""
+        """(B, num_sticks, dim_z) -> (B, num_values, 2) — or the planar
+        pair (B, 2, num_values) for large plans (see pair_values_io)."""
         p = self.index_plan
         if not self._pallas_active or self._pallas["cmp"] is None:
-            return jax.vmap(
+            values = jax.vmap(
                 lambda s: stages.compress(s, tables["value_indices"],
                                           scale))(sticks_b)
+            return jnp.swapaxes(values, 1, 2) if self._pair_io else values
         from .ops import gather_kernel as gk
         t = self._pallas["cmp"]
-        B = sticks_b.shape[0]
-        flat_il = jnp.stack([jnp.real(sticks_b).reshape(B, -1),
-                             jnp.imag(sticks_b).reshape(B, -1)], axis=-1)
-        re, im = gk.planar_from_interleaved(flat_il, t.src_rows)
+        re, im = gk.planar_from_complex(sticks_b, t.src_rows)
         out_re, out_im = gk.monotone_gather(
             re, im, tables["cmp_row0"], tables["cmp_out_tile"],
             tables["cmp_first"], tables["cmp_packed"],
             span_rows=t.span_rows, src_rows=t.src_rows,
             num_tiles=t.num_tiles, segs=t.segs)
-        values = gk.interleaved_from_planar(out_re, out_im, t.num_out)
+        values = gk.interleaved_from_planar(out_re, out_im, t.num_out,
+                                            pair=self._pair_io)
         if scale is not None:
             values = values * jnp.asarray(scale, values.dtype)
         return values
@@ -405,18 +432,23 @@ class TransformPlan:
 
     def backward_batched(self, values_batch):
         """Backward-execute a batch: ``values_batch`` is (B, num_values)
-        complex or (B, num_values, 2) interleaved. Returns the (B, ...)
-        stacked space-domain result in one fused execution."""
-        batch = jnp.stack([self._coerce_values(v) for v in values_batch]) \
-            if not (isinstance(values_batch, jax.Array)
-                    and values_batch.ndim == 3) else values_batch
+        complex or (B, num_values, 2) interleaved ((B, 2, num_values) for
+        pair_values_io plans). Returns the (B, ...) stacked space-domain
+        result in one fused execution."""
+        per = ((2, self.index_plan.num_values) if self._pair_io
+               else (self.index_plan.num_values, 2))
+        batch = values_batch \
+            if isinstance(values_batch, jax.Array) \
+            and values_batch.shape[1:] == per \
+            else jnp.stack([self._coerce_values(v) for v in values_batch])
         with timed_transform("backward_batched") as box:
             box.value = self._batched_jits()["backward"](batch, self._tables)
         return box.value
 
     def forward_batched(self, space_batch, scaling: Scaling = Scaling.NONE):
         """Forward-execute a batch of space-domain slabs in one fused
-        execution. Returns (B, num_values, 2) interleaved values."""
+        execution. Returns (B, num_values, 2) interleaved values —
+        (B, 2, num_values) for pair_values_io plans."""
         scaling = Scaling(scaling)
         batch = jnp.stack([self._coerce_space(s) for s in space_batch]) \
             if not (isinstance(space_batch, jax.Array)
@@ -455,7 +487,8 @@ class TransformPlan:
         ``fn_args``, which are traced arguments, not compile-time
         constants.
 
-        Returns the (num_values, 2) interleaved frequency values."""
+        Returns the (num_values, 2) interleaved frequency values —
+        (2, num_values) for pair_values_io plans."""
         scaling = Scaling(scaling)
         values_il = self._coerce_values(values)
         key = (fn, scaling)
@@ -516,7 +549,8 @@ class TransformPlan:
 
     def forward(self, space, scaling: Scaling = Scaling.NONE):
         """Space -> frequency. Returns (num_values, 2) interleaved sparse
-        values; ``scaling=Scaling.FULL`` multiplies by 1/(Nx·Ny·Nz)
+        values — (2, num_values) for pair_values_io plans;
+        ``scaling=Scaling.FULL`` multiplies by 1/(Nx·Ny·Nz)
         (details.rst "Normalization")."""
         scaling = Scaling(scaling)
         space = self._coerce_space(space)
@@ -526,13 +560,29 @@ class TransformPlan:
 
     # -- input coercion ------------------------------------------------------
     def _coerce_values(self, values):
+        N = self.index_plan.num_values
+        if self._pair_io:
+            # planar pair (2, N) device boundary (see pair_values_io)
+            if isinstance(values, jax.Array):
+                if values.shape == (2, N):
+                    return values
+                if values.shape == (N, 2):
+                    # relayout via host: an on-device transpose materialises
+                    # the tiled (N, 2) copy this layout exists to avoid
+                    values = np.asarray(values)
+            arr = np.asarray(as_interleaved(values, self.precision))
+            if arr.shape != (N, 2):
+                raise InvalidParameterError(
+                    f"expected {N} frequency values, "
+                    f"got shape {arr.shape[:-1]}")
+            return jnp.asarray(np.ascontiguousarray(arr.T))
         if isinstance(values, jax.Array) and values.ndim == 2 \
-                and values.shape == (self.index_plan.num_values, 2):
+                and values.shape == (N, 2):
             return values
         arr = as_interleaved(values, self.precision)
-        if arr.shape != (self.index_plan.num_values, 2):
+        if arr.shape != (N, 2):
             raise InvalidParameterError(
-                f"expected {self.index_plan.num_values} frequency values, "
+                f"expected {N} frequency values, "
                 f"got shape {arr.shape[:-1]}")
         return arr
 

@@ -306,3 +306,40 @@ def test_iterate_pointwise_matches_sequential():
         seq = seq_il[:, 0] + 1j * seq_il[:, 1]
     np.testing.assert_allclose(out[:, 0] + 1j * out[:, 1], seq,
                                atol=1e-10, rtol=0)
+
+
+def test_local_batched_pallas_pair_io_interpret(monkeypatch):
+    """The batched kernel branches with the planar-pair (2, N) boundary
+    (pair_values_io): force the threshold + interpret mode and check both
+    directions against the vmapped XLA path (regression: the batched
+    decompress once dropped the pair flag, silently gathering 2 values)."""
+    import functools
+    import jax
+    from spfft_tpu.ops import gather_kernel as gk
+    from spfft_tpu import plan as plan_mod
+
+    monkeypatch.setattr(plan_mod, "PAIR_IO_THRESHOLD", 1)
+    n = 12
+    triplets = np.asarray([(x, y, z) for x in range(n) for y in range(n)
+                           if (x + y) % 2 == 0 for z in range(n)], np.int32)
+    plan = make_local_plan(TransformType.C2C, n, n, n, triplets,
+                           precision="single", use_pallas=True)
+    assert plan.pair_values_io and plan._pallas is not None
+    monkeypatch.setattr(gk, "monotone_gather",
+                        functools.partial(gk.monotone_gather,
+                                          interpret=True))
+    monkeypatch.setattr(plan, "_pallas_active", True)
+    rng = np.random.default_rng(32)
+    N = plan.index_plan.num_values
+    vals_b = jax.numpy.asarray(rng.random((3, 2, N)).astype(np.float32))
+    got = np.asarray(plan._decompress_batched(vals_b, plan._tables))
+    want = np.asarray(jax.vmap(
+        lambda v: plan._decompress(v, plan._tables, pallas=False))(vals_b))
+    np.testing.assert_allclose(got, want, atol=0, rtol=0)
+    sticks_b = jax.numpy.asarray(want)
+    got_c = np.asarray(plan._compress_batched(sticks_b, plan._tables, None))
+    want_c = np.asarray(jax.vmap(
+        lambda s: plan._compress(s, plan._tables, None,
+                                 pallas=False))(sticks_b))
+    assert got_c.shape == (3, 2, N)
+    np.testing.assert_allclose(got_c, want_c, atol=1e-7, rtol=0)

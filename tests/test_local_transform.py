@@ -255,3 +255,51 @@ def test_split_x_r2c_vs_oracle():
     np.testing.assert_allclose(fwd, values,
                                atol=tolerance_for("double", values),
                                rtol=0)
+
+
+def test_pair_values_io_round_trip(monkeypatch):
+    """Large plans use a planar-pair (2, N) device boundary for value
+    arrays (the (N,2) shape can be assigned a 64x-padded tiled layout on
+    TPU; flat strided interleaves lower too slow). Force the threshold
+    down and check the pair plan matches the rows plan on every public
+    entry."""
+    import jax.numpy as jnp
+    from spfft_tpu import Scaling, TransformType, make_local_plan
+    from spfft_tpu import plan as plan_mod
+
+    rng = np.random.default_rng(61)
+    dims = (10, 9, 8)
+    triplets = random_sparse_triplets(rng, dims)
+    v = random_values(rng, len(triplets))
+    ref = make_local_plan(TransformType.C2C, *dims, triplets,
+                          precision="double")
+    assert not ref.pair_values_io
+    monkeypatch.setattr(plan_mod, "PAIR_IO_THRESHOLD", 1)
+    pplan = make_local_plan(TransformType.C2C, *dims, triplets,
+                            precision="double")
+    assert pplan.pair_values_io
+    # backward from complex input
+    np.testing.assert_allclose(np.asarray(pplan.backward(v)),
+                               np.asarray(ref.backward(v)),
+                               atol=1e-12, rtol=0)
+    # forward returns the PAIR layout; transpose equals the reference rows
+    space = ref.backward(v)
+    out_pair = np.asarray(pplan.forward(space, Scaling.FULL))
+    out_rows = np.asarray(ref.forward(space, Scaling.FULL))
+    assert out_pair.shape == (2, len(triplets))
+    np.testing.assert_allclose(out_pair.T, out_rows, atol=1e-12, rtol=0)
+    # fused pair accepts complex and pair-layout device arrays
+    pair = np.asarray(pplan.apply_pointwise(v, scaling=Scaling.FULL))
+    v_pair = np.stack([v.real, v.imag], axis=0)
+    np.testing.assert_allclose(pair, v_pair, atol=1e-12, rtol=0)
+    pair2 = np.asarray(pplan.apply_pointwise(jnp.asarray(v_pair),
+                                             scaling=Scaling.FULL))
+    np.testing.assert_allclose(pair2, v_pair, atol=1e-12, rtol=0)
+    # batched
+    batch = [v, np.roll(v, 1)]
+    got_b = np.asarray(pplan.backward_batched(batch))
+    ref_b = np.asarray(ref.backward_batched(batch))
+    np.testing.assert_allclose(got_b, ref_b, atol=1e-12, rtol=0)
+    fwd_b = np.asarray(pplan.forward_batched(
+        [np.asarray(space), np.asarray(space)], Scaling.FULL))
+    assert fwd_b.shape == (2, 2, len(triplets))
