@@ -45,12 +45,8 @@ def main() -> None:
     mark(f"plan built (pallas_active={plan._pallas_active}, "
          f"split_x={plan._split_x})")
 
-    if getattr(plan, "pair_values_io", False):
-        values_il = jax.device_put(
-            np.stack([values.real, values.imag], axis=0))
-    else:
-        values_il = jax.device_put(
-            np.asarray(as_interleaved(values, "single")))
+    # the plan's own coercion produces the correct boundary layout
+    values_il = jax.device_put(plan._coerce_values(values))
     values_il.block_until_ready()
     mark("values on device")
 

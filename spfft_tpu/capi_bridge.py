@@ -66,6 +66,16 @@ def _get_plan(pid: int) -> TransformPlan:
     return plan
 
 
+def _values_rows(plan, out) -> np.ndarray:
+    """A plan's value result as interleaved rows for the C ABI buffer —
+    large local plans return the planar-pair (2, N) layout
+    (plan.pair_values_io), which must be transposed host-side."""
+    arr = np.asarray(out)
+    if getattr(plan, "pair_values_io", False) and arr.shape[0] == 2:
+        return np.ascontiguousarray(arr.T)
+    return arr
+
+
 class _InvalidHandle(GenericError):
     code = ErrorCode.INVALID_HANDLE
 
@@ -229,7 +239,7 @@ def forward(pid: int, space_addr: int, scaling: int,
     shape = (p.dim_z, p.dim_y, p.dim_x) + (() if p.hermitian else (2,))
     if scaling not in (0, 1):
         raise InvalidParameterError(f"bad scaling {scaling}")
-    values = np.asarray(plan.forward(
+    values = _values_rows(plan, plan.forward(
         space.copy().reshape(shape),
         Scaling.FULL if scaling == 1 else Scaling.NONE))
     _view(values_addr, 2 * p.num_values,
@@ -258,7 +268,8 @@ def execute_pair(pid: int, values_in_addr: int, scaling: int,
     p = plan.index_plan
     values = _view(values_in_addr, 2 * p.num_values,
                    plan.precision).reshape(p.num_values, 2)
-    out = np.asarray(plan.apply_pointwise(values.copy(), scaling=sc))
+    out = _values_rows(plan, plan.apply_pointwise(values.copy(),
+                                                  scaling=sc))
     _view(values_out_addr, 2 * p.num_values,
           plan.precision)[:] = out.reshape(-1)
 

@@ -570,6 +570,12 @@ class TransformPlan:
                     # relayout via host: an on-device transpose materialises
                     # the tiled (N, 2) copy this layout exists to avoid
                     values = np.asarray(values)
+            else:
+                arr = np.asarray(values)
+                if arr.shape == (2, N) and not np.iscomplexobj(arr):
+                    # the plan's own output layout, round-tripped via host
+                    return jnp.asarray(np.ascontiguousarray(
+                        arr.astype(self._rdt)))
             arr = np.asarray(as_interleaved(values, self.precision))
             if arr.shape != (N, 2):
                 raise InvalidParameterError(

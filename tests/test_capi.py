@@ -258,3 +258,37 @@ def test_distributed_too_many_shards_code(lib):
         ctypes.byref(plan), 0, 4, 4, 4, shards, vps.ctypes.data,
         trip.ctypes.data, pps.ctypes.data, 0)
     assert code == 5
+
+
+def test_ctypes_pair_layout_plan(lib, monkeypatch):
+    """C ABI buffers stay interleaved rows even when the plan internally
+    uses the planar-pair (2, N) boundary (regression: forward once wrote
+    the transposed layout straight into the caller's buffer)."""
+    from spfft_tpu import plan as plan_mod
+    monkeypatch.setattr(plan_mod, "PAIR_IO_THRESHOLD", 1)
+    lib.spfft_tpu_execute_pair.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p]
+    n = 4
+    trip = np.array([[x, y, z] for x in range(n) for y in range(n)
+                     for z in range(n)], np.int32)
+    values = np.random.default_rng(5).standard_normal(
+        (len(trip), 2)).astype(np.float32)
+    space = np.empty((n, n, n, 2), np.float32)
+    out = np.empty_like(values)
+    plan = ctypes.c_void_p()
+    assert lib.spfft_tpu_plan_create(
+        ctypes.byref(plan), 0, n, n, n, ctypes.c_longlong(len(trip)),
+        trip.ctypes.data, 0) == 0
+    import spfft_tpu.capi_bridge as bridge
+    pid = max(bridge._plans)
+    assert bridge._plans[pid].pair_values_io
+    assert lib.spfft_tpu_backward(plan, values.ctypes.data,
+                                  space.ctypes.data) == 0
+    assert lib.spfft_tpu_forward(plan, space.ctypes.data, 1,
+                                 out.ctypes.data) == 0
+    np.testing.assert_allclose(out, values, atol=1e-5)
+    fused = np.empty_like(values)
+    assert lib.spfft_tpu_execute_pair(plan, values.ctypes.data, 1,
+                                      fused.ctypes.data) == 0
+    np.testing.assert_allclose(fused, values, atol=1e-5)
+    assert lib.spfft_tpu_plan_destroy(plan) == 0
