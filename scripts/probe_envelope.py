@@ -21,7 +21,6 @@ def main() -> None:
             stage = a.split("=", 1)[1]
     import jax
     from spfft_tpu import TransformType, make_local_plan
-    from spfft_tpu.utils import as_interleaved
     from spfft_tpu.utils.workloads import spherical_cutoff_triplets
 
     t = time.perf_counter()

@@ -167,8 +167,12 @@ def profile(n: int):
     stage("compress", lambda s: plan._compress(s, tables, None), sticks0,
           (SZ + N) * C64, cal_sticks)
 
-    print(f"{'sum of stages':24s} {total_time*1e3:8.2f} ms   "
-          f"{total_bytes/total_time/1e9:7.1f} GB/s", flush=True)
+    if total_time > 0:
+        print(f"{'sum of stages':24s} {total_time*1e3:8.2f} ms   "
+              f"{total_bytes/total_time/1e9:7.1f} GB/s", flush=True)
+    else:
+        print(f"{'sum of stages':24s} below noise floor at this size",
+              flush=True)
 
     # the fused pair, scanned through iterate-style composition
     pair_t = scan_time(

@@ -34,20 +34,22 @@ def _check(transforms: Sequence[Transform], args: Sequence, what: str):
             f"got {len(transforms)} transforms but {len(args)} {what}")
 
 
-#: Fuse shared-plan batches only up to this many per-transform values.
-#: Below it, per-dispatch latency dominates and ONE fused executable wins
-#: (128^3 sphere, B=3, TPU v5e: distributed fused 13.9 vs 15.9 ms
-#: sequential); above it, device work dominates, async dispatch already
-#: pipelines the N executions, and the vmapped pipeline is mildly less
-#: efficient than N stock dispatches (256^3: fused 63 vs 49 ms) — so big
-#: batches stay on per-transform dispatch (scripts/measure_batch.py).
-FUSED_BATCH_MAX_VALUES = 4_000_000
+#: Fuse shared-plan batches only up to this many per-transform (per-shard
+#: when distributed) GRID elements — device work scales with the grid, so
+#: the gate does too. Below it, per-dispatch latency dominates and ONE
+#: fused executable wins (128^3 = 2.1M grid elements, B=3, TPU v5e:
+#: distributed fused 13.9 vs 15.9 ms sequential); above it, device work
+#: dominates, async dispatch already pipelines the N executions, and the
+#: vmapped pipeline is mildly less efficient than N stock dispatches
+#: (256^3 = 16.8M: fused 63 vs 49 ms) — so big batches stay on
+#: per-transform dispatch (scripts/measure_batch.py).
+FUSED_BATCH_MAX_GRID = 8_000_000
 
 
 def _shared_plan(transforms: Sequence[Transform]):
     """If every transform wraps the *same* plan object (clones share their
-    plan) AND the per-transform size is in the regime where fusion wins
-    (FUSED_BATCH_MAX_VALUES), return it — the batch then runs as ONE fused
+    plan) AND the per-transform grid is in the regime where fusion wins
+    (FUSED_BATCH_MAX_GRID), return it — the batch then runs as ONE fused
     executable (local: vmapped + batched-grid kernel; distributed: one
     SPMD program with a per-shard batch axis) instead of N dispatches.
     Returns None otherwise (per-transform async dispatch, which XLA
@@ -58,10 +60,11 @@ def _shared_plan(transforms: Sequence[Transform]):
     if any(t.plan is not plan for t in transforms[1:]):
         return None
     if isinstance(plan, TransformPlan):
-        size = plan.index_plan.num_values
+        size = plan.global_size
     else:
-        size = plan.dist_plan.max_values
-    if size > FUSED_BATCH_MAX_VALUES:
+        dp = plan.dist_plan
+        size = dp.dim_x * dp.dim_y * dp.max_planes  # per-shard slab
+    if size > FUSED_BATCH_MAX_GRID:
         return None
     return plan
 

@@ -159,9 +159,13 @@ def build_monotone_gather_tables(idx: np.ndarray, valid: np.ndarray,
     if k_rows:
         K = int(k_rows)
     else:
-        # cost ~ chunks * (K DMA rows + fixed per-step overhead)
+        # cost ~ chunks * (K DMA rows + fixed per-step overhead). The
+        # overhead term is large: each grid step costs ~400-500 ns of
+        # scalar bookkeeping + DMA issue regardless of K (measured at
+        # 256^3: K=8 pair 30.7 ms vs K=32 23.9 ms) — weight it like ~64
+        # DMA rows so the chooser trades window waste against step count.
         K = min(K_CANDIDATES,
-                key=lambda k: int(chunks_per_tile(k).sum()) * (k + 8))
+                key=lambda k: int(chunks_per_tile(k).sum()) * (k + 64))
     win_sorted = rows_sorted // K
     # one chunk per (tile, distinct window); windows ascend within a tile so
     # a tile's chunks are consecutive grid steps (the revisiting pattern)
