@@ -83,18 +83,30 @@ def compress(sticks, value_indices, scale=None):
 # z-stage: batched 1D FFT over sticks
 # ---------------------------------------------------------------------------
 
-def _mat(x):
-    """Materialise an FFT operand behind an optimization barrier.
+#: FFT operands above this many elements get an optimization barrier.
+#: Known-good without barrier: every 256^3 operand (13.2-16.8M, compiles
+#: ~16 s); known-pathological: the 320^3 stick array (25.7M, ~560 s) —
+#: the threshold sits at the top of the verified-good range.
+_MAT_THRESHOLD = 1 << 24
 
-    XLA's TPU FFT compile time explodes when the operand is a *computed*
+
+def _mat(x):
+    """Materialise a LARGE FFT operand behind an optimization barrier.
+
+    XLA's TPU FFT compile time explodes when a big operand is a *computed*
     value rather than a materialised buffer: a (80379, 320) c64 ifft
     compiles in ~13 s from a parameter but ~560 s when fed by the
     decompress gather (or even a bare complex construction) — the 320^3
-    "stall" of round 1. The barrier forces a materialised operand (which
-    the FFT custom call needs anyway) and restores O(10 s) compiles with
-    no runtime cost measured at 256^3. Probe: scripts/probe_fftcompile.py.
+    "stall" of round 1. The barrier forces a materialised operand and
+    restores O(10 s) compiles with no runtime cost at those sizes. Below
+    the threshold the barrier is skipped: small-grid compiles were always
+    fine and the forced materialisation costs real time there (64^3 XLA
+    pair: 6.6 ms with barrier vs 4.7 ms without).
+    Probe: scripts/probe_fftcompile.py.
     """
-    return jax.lax.optimization_barrier(x)
+    if x.size > _MAT_THRESHOLD:
+        return jax.lax.optimization_barrier(x)
+    return x
 
 
 def z_backward(sticks):

@@ -117,11 +117,13 @@ class TransformPlan:
         if use_pallas is True and self.precision != "single":
             raise InvalidParameterError(
                 "the Pallas compression kernel is single-precision only")
-        # Auto threshold: below ~half a million values the XLA gather wins
-        # (64^3 sphere ~137k values: 5.0 ms XLA vs 7.5 ms Pallas pair;
-        # 128^3 ~1.1M: 21 vs 11 ms — scripts/sweep.py on TPU v5e).
+        # Auto threshold: with the overhead-weighted K chooser the kernel
+        # wins from ~32^3 up (32^3: 3.8 vs 5.2 ms XLA; 64^3: 4.8 vs 8.5;
+        # 96^3: 5.2 vs 13.3 — pair wall-clock, TPU v5e); below ~10k values
+        # everything is dispatch-dominated and the XLA path avoids the
+        # table build.
         auto = backend_ok and self.precision == "single" \
-            and self.index_plan.num_values >= 500_000
+            and self.index_plan.num_values >= 10_000
         if use_pallas is False or (use_pallas is None and not auto):
             return
         if p.num_values == 0 or p.num_sticks == 0:
