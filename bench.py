@@ -101,11 +101,42 @@ def main() -> None:
     out = plan.apply_pointwise(values_il)  # warm-up / compile
     sync(out)
 
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = plan.apply_pointwise(values_il)
-    sync(out)
-    pair_s = (time.perf_counter() - t0) / reps
+    # Variance-robust statistic: the hard-sync readback through the axon
+    # tunnel costs 80-120 ms regardless of queue depth (measured on a
+    # ready array), so any "time N reps then sync" number includes
+    # sync_cost/N of pure tunnel latency — the round-1/2 benches amortised
+    # ~3-4 ms/rep of it at reps=30, and its variance is why the headline
+    # moved 10% between rounds. The difference-of-group-sizes estimator
+    # cancels the constant exactly: pair = (T(g2) - T(g1)) / (g2 - g1),
+    # both groups pipelined and each ending in one sync. Reported value =
+    # min over trials (the best sustained rate the hardware delivered);
+    # observed trial spread at 256^3 is < 1.5% vs ~25% for group means.
+    def timed(g):
+        t0 = time.perf_counter()
+        o = None
+        for _ in range(g):
+            o = plan.apply_pointwise(values_il)
+        sync(o)
+        return time.perf_counter() - t0
+
+    g1 = max(1, reps // 6)
+    g2 = max(g1 + 1, reps - g1)
+    trials = [(timed(g2) - timed(g1)) / (g2 - g1) for _ in range(4)]
+    # Small grids can produce non-positive differences (the pair is below
+    # the sync-cost noise): keep positive trials only, and fall back to
+    # the plain pipelined average when none survive.
+    positive = [t for t in trials if t > 0]
+    if positive:
+        pair_s = min(positive)
+        spread = (max(positive) - pair_s) / pair_s
+        stat = (f"min of {len(positive)} sync-cancelling trials "
+                f"((T({g2})-T({g1}))/{g2 - g1}, trial spread "
+                f"+{spread * 100:.1f}%)")
+    else:
+        # pair below the sync-cost noise: the plain pipelined average
+        # (includes sync_cost/g2 of tunnel latency) is the honest fallback
+        pair_s = timed(g2) / g2
+        stat = f"pipelined mean of {g2} (diff estimator below noise)"
 
     # accuracy: L2 error of the backward result vs a dense oracle
     st = triplets.copy()
@@ -132,8 +163,9 @@ def main() -> None:
     gbs = pair_bytes / pair_s / 1e9
 
     result = {
-        "metric": f"{n}^3 spherical-cutoff C2C fwd+bwd pair wall-clock "
-                  f"(l2_err_vs_dense={l2:.2e}, plan_s={t_plan:.2f}, "
+        "metric": f"{n}^3 spherical-cutoff C2C fwd+bwd pair wall-clock, "
+                  f"{stat} ("
+                  f"l2_err_vs_dense={l2:.2e}, plan_s={t_plan:.2f}, "
                   f"n_values={len(triplets)}, "
                   f"effective_GBps={gbs:.0f}, "
                   f"baseline=pocketfft[{os.cpu_count()}cpu] "

@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Wide vs narrow gather kernel on the real chip, at north-star scale.
+
+Builds the 256^3 spherical-cutoff compression inputs (decompress and
+compress directions), runs both kernels, checks results against the XLA
+gather, and times each with the scanned-executable methodology
+(scripts/profile_stages.py). DIM=256 by default.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from spfft_tpu.ops import gather_kernel as gk
+from spfft_tpu.indexing import build_index_plan
+from spfft_tpu.types import TransformType
+from spfft_tpu.utils.workloads import spherical_cutoff_triplets
+
+R = int(os.environ.get("REPS", 20))
+
+
+def sync(x):
+    float(np.asarray(jnp.real(jax.tree_util.tree_leaves(x)[0]).ravel()[0]))
+
+
+def scan_seconds(body, x, reps=3):
+    def run(x0):
+        def step(c, _):
+            xp = jax.tree_util.tree_map(
+                lambda a: a * a.dtype.type(1.0 + 1e-7), c)
+            out = body(xp)
+            return xp, sum(jnp.mean(o) for o in jax.tree_util.tree_leaves(out))
+        _, ys = jax.lax.scan(step, x0, None, length=R)
+        return ys
+    f = jax.jit(run)
+    out = f(x)
+    sync(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = f(x)
+    sync(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def bench_direction(name, idx, valid, num_src):
+    rng = np.random.default_rng(1)
+    src = rng.standard_normal(num_src).astype(np.float32)
+    srci = rng.standard_normal(num_src).astype(np.float32)
+
+    wide = gk.build_wide_gather_tables(idx, valid, num_src)
+    narrow = gk.build_monotone_gather_tables(idx, valid, num_src)
+    want = np.where(valid, src[np.clip(idx, 0, num_src - 1)], 0)
+
+    for label, t in (("wide", wide), ("narrow", narrow)):
+        if t is None:
+            print(f"{name} {label}: tables=None")
+            continue
+        dev = gk.gather_device_tables(t)
+        pad = t.src_rows * 128 - num_src
+        re = jnp.asarray(np.pad(src, (0, pad)).reshape(t.src_rows, 128))
+        im = jnp.asarray(np.pad(srci, (0, pad)).reshape(t.src_rows, 128))
+
+        out = gk.run_gather(re, im, dev, t)
+        got = np.asarray(out[0]).reshape(-1)[:t.num_out]
+        ok = np.allclose(got, want, atol=1e-5)
+        C = t.row0.shape[0]
+        cal = scan_seconds(lambda x: (x[0], x[1]), (re, im))
+        tot = scan_seconds(lambda x: gk.run_gather(x[0], x[1], dev, t),
+                           (re, im))
+        dt = (tot - cal) / R
+        extra = (f"kp={t.kp_rows} " if isinstance(t, gk.WideGatherTables)
+                 else "")
+        print(f"{name} {label}: {'OK' if ok else 'MISMATCH'} C={C} "
+              f"K={t.span_rows} {extra}-> {dt*1e3:.3f} ms "
+              f"({dt/C*1e9:.0f} ns/step)", flush=True)
+
+
+def main():
+    n = int(os.environ.get("DIM", "256"))
+    triplets = spherical_cutoff_triplets(n)
+    p = build_index_plan(TransformType.C2C, n, n, n, triplets)
+    vi = p.value_indices.astype(np.int64)
+    num_slots = p.num_sticks * p.dim_z
+    print(f"dim={n} values={p.num_values} slots={num_slots}", flush=True)
+    (dec_idx, occ), (cmp_idx, cmp_valid) = gk.compression_gather_inputs(
+        vi, num_slots)
+    bench_direction("decompress", dec_idx, occ, p.num_values)
+    bench_direction("compress", cmp_idx, cmp_valid, num_slots)
+
+
+if __name__ == "__main__":
+    print("devices:", jax.devices(), flush=True)
+    main()

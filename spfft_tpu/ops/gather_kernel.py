@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -207,6 +208,206 @@ def build_monotone_gather_tables(idx: np.ndarray, valid: np.ndarray,
         packed=packed.reshape(C, TILE_SUB, TILE_LANE),
         num_out=L, num_tiles=G, src_rows=src_rows, span_rows=K,
         segs=segs)
+
+
+#: Wide-kernel geometry: output tiles processed per grid step. The narrow
+#: kernel's cost is per-grid-step overhead (~450-500 ns/step measured at
+#: 256^3 — BENCHMARKS.md roofline); amortising it over P tiles with ONE
+#: K-row DMA window and per-tile sub-windows cuts the per-slot cost ~10-20x
+#: (scripts/probe_wide_kernel.py: 196 ns/step for 8 tiles vs ~470 ns for 1).
+WIDE_P = 8
+
+#: Sub-window height candidates (rows selected per tile). Chosen from the
+#: per-tile row-spread distribution: monotone decompress spans <= 9 rows
+#: per 1024-slot tile (indices advance by <= 1 per slot), sparse compress
+#: spans ~ 8/fill_fraction.
+WIDE_KP_CANDIDATES = (8, 12, 16, 24, 32)
+
+#: Max chunks per wide launch. NOT an SMEM budget: the TPU compile helper
+#: deterministically crashes (subprocess exit 1) compiling this kernel with
+#: a grid of ~2000+ steps — C=1961 compiles, C=2000 does not, across every
+#: (P, kp, K) probed (scripts/probe_wide_sweep.py bisect, 2026-07-30) —
+#: while the narrow kernel compiles at C=17k+. 1536 leaves margin in case
+#: the threshold shifts with kernel-body size; larger tables run as
+#: multiple tile-aligned launches (one extra launch per ~12.6M output
+#: slots — negligible next to the per-step win).
+WIDE_SEG_CHUNK_LIMIT = 1536
+
+#: Wide fallback ceiling, in chunks per super-tile: per-slot unit cost of
+#: the wide step is C*(P*kp + 96)/(G_s*P*TILE); the XLA gather breaks even
+#: with the narrow kernel at ~6 chunks/tile * (K+64) units (see
+#: _CHUNK_BLOWUP_LIMIT), which translates to ~16 wide chunks per super-tile
+#: at P=8, kp=16. Past that the decomposition loses to the XLA gather.
+_WIDE_BLOWUP_LIMIT = 16
+
+
+@dataclasses.dataclass(frozen=True)
+class WideGatherTables:
+    """Plan-time tables for one wide windowed-gather direction.
+
+    Each chunk is one grid step covering a SUPER-TILE of ``p_tiles``
+    1024-slot output tiles: one K-row DMA window shared by the step, and
+    per-tile kp-row sub-windows at byte-packed offsets. Chunks of one
+    super-tile are consecutive grid steps (revisiting accumulation)."""
+
+    row0: np.ndarray      # (C,) int32 — DMA window start row
+    sub: np.ndarray       # (C, P//4) int32 — per-tile sub-window offsets,
+                          # byte-packed little-endian, relative to row0
+    out_tile: np.ndarray  # (C,) int32 — output super-tile index
+    first: np.ndarray     # (C,) int32 — 1 on a super-tile's first chunk
+    packed: np.ndarray    # (C, P, 8, 128) int32 — lane | row-in-sub << 7
+                          #  | valid << 20
+    num_out: int          # valid output slots
+    num_super: int        # G_s: super-tiles
+    src_rows: int         # padded source rows
+    span_rows: int        # K: DMA window height
+    kp_rows: int          # kp: per-tile sub-window height
+    p_tiles: int          # P: tiles per super-tile
+    segs: tuple = ()      # ((c0, c1, t0, t1), ...) in super-tile units
+
+
+def build_wide_gather_tables(idx: np.ndarray, valid: np.ndarray,
+                             num_src: int, *, p_tiles: int = WIDE_P,
+                             kp_rows: int = 0, k_rows: int = 0,
+                             allow_segments: bool = True):
+    """Build wide-kernel tables for ``out[j] = src[idx[j]] * valid[j]``.
+
+    Same contract as :func:`build_monotone_gather_tables` (any order works;
+    monotone is optimal; returns None on empty input or when the cover
+    would be slower than the XLA gather), but covers ``p_tiles`` output
+    tiles per chunk. ``kp_rows``/``k_rows`` force the sub-window/DMA-window
+    heights (0 = choose from the data) — the distributed builder forces
+    common values across shards so the SPMD program is uniform.
+    """
+    L = int(idx.shape[0])
+    if L == 0:
+        return None
+    P = int(p_tiles)
+    if P % 4 != 0:
+        raise ValueError("p_tiles must be a multiple of 4 (byte packing)")
+    SUPER = P * TILE
+    idx = np.asarray(idx, np.int64)
+    G_s = -(-L // SUPER)
+    pad = G_s * SUPER - L
+    idx_p = np.concatenate([idx, np.full(pad, idx[-1], np.int64)])
+    valid_p = np.concatenate([np.asarray(valid, bool), np.zeros(pad, bool)])
+    rows = (idx_p // TILE_LANE).astype(np.int32).reshape(G_s, P, TILE)
+    lanes = (idx_p % TILE_LANE).astype(np.int32).reshape(G_s, P, TILE)
+    vmask = valid_p.reshape(G_s, P, TILE)
+
+    BIG = np.int32(2 ** 30)
+    rmin = np.where(vmask, rows, BIG).min(axis=2)         # (G_s, P)
+    rmax = np.where(vmask, rows, -1).max(axis=2)
+    has = vmask.any(axis=2)
+    spread = np.where(has, rmax - np.where(has, rmin, 0) + 1, 1)
+
+    if kp_rows:
+        kp = int(kp_rows)
+    else:
+        # Cost model: chunks(kp) ~ sum over super-tiles of the max per-tile
+        # round count ceil(spread/kp); per-step cost ~ P*kp select rows plus
+        # ~64 rows-equivalent of fixed overhead (DMA issue + scalar work —
+        # calibrated against scripts/probe_wide_vs_narrow.py, where the
+        # coverage-percentile chooser picked kp=32 for the 256^3 compress
+        # direction and lost 2x per step to a barely-smaller chunk count).
+        def cost(kp_c):
+            rounds = -(-spread // kp_c)           # (G_s, P) ceil
+            c_est = int(rounds.max(axis=1).sum()) if G_s else 1
+            return c_est * (P * kp_c + 64)
+        kp = min(WIDE_KP_CANDIDATES, key=cost)
+    if k_rows:
+        K = int(k_rows)
+    else:
+        base = np.where(has, rmin, BIG)
+        b0 = base.min(axis=1)
+        bspan = np.where(has, base - b0[:, None], 0).max(axis=1)
+        q = int(np.quantile(bspan, 0.99)) if bspan.size else 0
+        K = max(kp + 8, min(512, kp + 248,
+                            int(np.ceil((q + kp) / 8.0) * 8)))
+    if K - kp > 255:
+        K = kp + 248  # sub-window offsets are byte-packed
+
+    # Multi-round cover: each round emits one chunk per still-active
+    # super-tile. The minimum-base tile is always inside the window, so
+    # every round covers at least kp rows of it — guaranteed progress.
+    uncovered = vmask.copy()
+    r0s, subs, packs, sts, rds = [], [], [], [], []
+    rounds = 0
+    total_chunks = 0
+    while True:
+        active = uncovered.any(axis=(1, 2))
+        if rounds == 0:
+            # every super-tile needs >= 1 chunk so its output block is
+            # initialised even when it has no valid slots at all
+            active = np.ones(G_s, bool)
+        if not active.any():
+            break
+        a = np.flatnonzero(active)
+        ar, av, al = rows[a], uncovered[a], lanes[a]
+        base = np.where(av, ar, BIG).min(axis=2)          # (n_a, P)
+        hasu = av.any(axis=2)
+        r0 = np.where(hasu, base, BIG).min(axis=1)
+        r0 = np.where(r0 == BIG, 0, r0).astype(np.int32)
+        inwin = hasu & (base <= r0[:, None] + (K - kp))
+        basec = np.where(inwin, base, r0[:, None])
+        cover = av & inwin[:, :, None] \
+            & (ar >= basec[:, :, None]) & (ar < basec[:, :, None] + kp)
+        sub_rel = np.clip(basec - r0[:, None], 0, K - kp).astype(np.int32)
+        rin = np.clip(ar - basec[:, :, None], 0, kp - 1)
+        packed = (al | (rin << _ROW_SHIFT)
+                  | (cover.astype(np.int32) << _VALID_SHIFT))
+        r0s.append(r0)
+        subs.append(sub_rel)
+        packs.append(packed.astype(np.int32))
+        sts.append(a.astype(np.int32))
+        rds.append(np.full(len(a), rounds, np.int32))
+        uncovered[a] = av & ~cover
+        rounds += 1
+        total_chunks += len(a)
+        if total_chunks > _WIDE_BLOWUP_LIMIT * G_s + 64:
+            return None  # too disordered: the cover loses to XLA
+
+    st_all = np.concatenate(sts)
+    order = np.lexsort((np.concatenate(rds), st_all))
+    st_o = st_all[order]
+    row0 = np.concatenate(r0s)[order]
+    sub_o = np.concatenate(subs)[order]                   # (C, P)
+    packed_o = np.concatenate(packs)[order]               # (C, P, TILE)
+    C = int(st_o.shape[0])
+    first = np.zeros(C, np.int32)
+    first[0] = 1
+    first[1:] = (st_o[1:] != st_o[:-1]).astype(np.int32)
+
+    words = np.zeros((C, P // 4), np.int32)
+    for j in range(P):
+        words[:, j // 4] |= sub_o[:, j].astype(np.int32) << (8 * (j % 4))
+
+    src_rows = max(int(row0.max()) + K, -(-int(num_src) // TILE_LANE))
+    segs = _tile_aligned_segments(first, st_o, G_s, WIDE_SEG_CHUNK_LIMIT)
+    if segs is None or (segs and not allow_segments):
+        return None
+    return WideGatherTables(
+        row0=row0, sub=words, out_tile=st_o, first=first,
+        packed=packed_o.reshape(C, P, TILE_SUB, TILE_LANE),
+        num_out=L, num_super=G_s, src_rows=src_rows, span_rows=K,
+        kp_rows=kp, p_tiles=P, segs=segs)
+
+
+def build_best_gather_tables(idx, valid, num_src, allow_segments=True,
+                             wide: Optional[bool] = None):
+    """The preferred decomposition: wide kernel tables, falling back to the
+    narrow single-tile kernel (whose per-tile windows tolerate somewhat
+    different disorder patterns), then None (caller uses the XLA gather).
+    ``wide=False`` forces narrow (testing)."""
+    if wide is not False:
+        t = build_wide_gather_tables(idx, valid, num_src,
+                                     allow_segments=allow_segments)
+        if t is not None:
+            return t
+    if wide is True:
+        return None
+    return build_monotone_gather_tables(idx, valid, num_src,
+                                        allow_segments=allow_segments)
 
 
 def compression_gather_inputs(value_indices, num_slots: int,
@@ -492,24 +693,323 @@ def _monotone_gather_call(re, im, row0, out_tile, first, packed, *,
     )(row0, out_tile, first, packed, re, im)
 
 
-def run_monotone_gather(values_il, tables: MonotoneGatherTables,
-                        device_tables=None, interpret: bool = False):
-    """Convenience wrapper: interleaved (N, 2) source -> (num_out, 2) output.
+def pad_wide_tables_to(t: WideGatherTables, c_max: int):
+    """Wide analogue of :func:`pad_tables_to`: pad to ``c_max`` chunks with
+    no-op chunks targeting a DUMMY super-tile (index ``t.num_super``) —
+    all-invalid packed words, row0=0 (src_rows >= K always), sub=0. The
+    first padding chunk has first=1 so the dummy block is initialised.
+    Callers pass ``num_super + 1`` to :func:`wide_gather` and rely on the
+    flat real-output prefix being unchanged (the dummy block is last).
 
-    ``device_tables`` may supply pre-committed jax arrays
-    (row0, out_tile, first, packed) to keep table upload off the hot path.
+    Returns (row0, sub, out_tile, first, packed) padded to c_max rows."""
+    pad = c_max - t.row0.shape[0]
+    if pad < 0:
+        raise ValueError("c_max smaller than existing chunk count")
+    if pad == 0:
+        return t.row0, t.sub, t.out_tile, t.first, t.packed
+    P = t.p_tiles
+    row0 = np.concatenate([t.row0, np.zeros(pad, np.int32)])
+    sub = np.concatenate([t.sub, np.zeros((pad, P // 4), np.int32)])
+    out_tile = np.concatenate(
+        [t.out_tile, np.full(pad, t.num_super, np.int32)])
+    first = np.concatenate(
+        [t.first, np.ones(1, np.int32), np.zeros(pad - 1, np.int32)])
+    packed = np.concatenate(
+        [t.packed, np.zeros((pad, P, TILE_SUB, TILE_LANE), np.int32)])
+    return row0, sub, out_tile, first, packed
+
+
+def _wide_tile_compute(kp: int, t, win_re, win_im):
+    """Per-tile compute of the wide kernel: decode one tile's packed block,
+    gather kp candidate rows from its (kp, 128) sub-window, select-
+    accumulate."""
+    lane = t & (TILE_LANE - 1)
+    row = (t >> _ROW_SHIFT) & _ROW_MASK
+    m = (t >> _VALID_SHIFT).astype(jnp.float32)
+    acc_re = jnp.zeros((TILE_SUB, TILE_LANE), jnp.float32)
+    acc_im = jnp.zeros((TILE_SUB, TILE_LANE), jnp.float32)
+    for k in range(kp):
+        sel = row == k
+        sre = jnp.broadcast_to(win_re[k][None, :], (TILE_SUB, TILE_LANE))
+        sim = jnp.broadcast_to(win_im[k][None, :], (TILE_SUB, TILE_LANE))
+        acc_re += jnp.where(sel, jnp.take_along_axis(sre, lane, axis=1), 0)
+        acc_im += jnp.where(sel, jnp.take_along_axis(sim, lane, axis=1), 0)
+    return acc_re * m, acc_im * m
+
+
+def _wide_step(kp: int, P: int, sub_ref, g, packed_blk, sc, slot, write):
+    """Shared per-step body of the wide kernels: decode each tile's byte-
+    packed sub-window offset, slice its (kp, 128) sub-window out of the
+    DMA'd window, compute, and hand (p, acc_re, acc_im) to ``write`` for
+    the output store."""
+    for p in range(P):
+        word = sub_ref[g, p // 4]
+        sub = (word >> (8 * (p % 4))) & 0xFF
+        win_re = sc[slot, 0, pl.ds(sub, kp), :]
+        win_im = sc[slot, 1, pl.ds(sub, kp), :]
+        acc_re, acc_im = _wide_tile_compute(kp, packed_blk[p],
+                                            win_re, win_im)
+        write(p, acc_re, acc_im)
+
+
+def _kernel_wide(K: int, kp: int, P: int, row0_ref, sub_ref, out_tile_ref,
+                 first_ref, packed_ref, re_hbm, im_hbm, out_re_ref,
+                 out_im_ref, sc, sem):
+    g = pl.program_id(0)
+    n_g = pl.num_programs(0)
+
+    def dma(gg, slot, chan, hbm):
+        return pltpu.make_async_copy(
+            hbm.at[pl.ds(row0_ref[gg], K), :], sc.at[slot, chan],
+            sem.at[slot, chan])
+
+    def start(gg):
+        slot = jax.lax.rem(jnp.asarray(gg, jnp.int32), jnp.int32(2))
+        dma(gg, slot, 0, re_hbm).start()
+        dma(gg, slot, 1, im_hbm).start()
+
+    @pl.when(g == 0)
+    def _():
+        start(0)
+
+    @pl.when(g + 1 < n_g)
+    def _():
+        start(g + 1)
+
+    slot = jax.lax.rem(jnp.asarray(g, jnp.int32), jnp.int32(2))
+    dma(g, slot, 0, re_hbm).wait()
+    dma(g, slot, 1, im_hbm).wait()
+
+    frst = first_ref[g]
+
+    def write(p, acc_re, acc_im):
+        @pl.when(frst == 1)
+        def _():
+            out_re_ref[p] = acc_re
+            out_im_ref[p] = acc_im
+
+        @pl.when(frst == 0)
+        def _():
+            out_re_ref[p] = out_re_ref[p] + acc_re
+            out_im_ref[p] = out_im_ref[p] + acc_im
+
+    _wide_step(kp, P, sub_ref, g, packed_ref[0], sc, slot, write)
+
+
+def _kernel_wide_batched(K: int, kp: int, P: int, row0_ref, sub_ref,
+                         out_tile_ref, first_ref, packed_ref, re_hbm,
+                         im_hbm, out_re_ref, out_im_ref, sc, sem):
+    """Batched wide variant: grid (B, C), batch-invariant tables, DMA
+    pipeline prefetching across the batch boundary (see _kernel_batched)."""
+    b = pl.program_id(0)
+    g = pl.program_id(1)
+    n_b = pl.num_programs(0)
+    n_g = pl.num_programs(1)
+    step = b * n_g + g
+
+    def dma(bb, gg, slot, chan, hbm):
+        return pltpu.make_async_copy(
+            hbm.at[bb, pl.ds(row0_ref[gg], K), :], sc.at[slot, chan],
+            sem.at[slot, chan])
+
+    def start(bb, gg, slot):
+        dma(bb, gg, slot, 0, re_hbm).start()
+        dma(bb, gg, slot, 1, im_hbm).start()
+
+    @pl.when(step == 0)
+    def _():
+        start(0, 0, 0)
+
+    @pl.when(step + 1 < n_b * n_g)
+    def _():
+        nxt_b = jnp.where(g + 1 < n_g, b, b + 1)
+        nxt_g = jnp.where(g + 1 < n_g, g + 1, 0)
+        start(nxt_b, nxt_g, jax.lax.rem(step + 1, jnp.int32(2)))
+
+    slot = jax.lax.rem(step, jnp.int32(2))
+    dma(b, g, slot, 0, re_hbm).wait()
+    dma(b, g, slot, 1, im_hbm).wait()
+
+    frst = first_ref[g]
+
+    def write(p, acc_re, acc_im):
+        @pl.when(frst == 1)
+        def _():
+            out_re_ref[0, p] = acc_re
+            out_im_ref[0, p] = acc_im
+
+        @pl.when(frst == 0)
+        def _():
+            out_re_ref[0, p] = out_re_ref[0, p] + acc_re
+            out_im_ref[0, p] = out_im_ref[0, p] + acc_im
+
+    _wide_step(kp, P, sub_ref, g, packed_ref[0], sc, slot, write)
+
+
+@functools.partial(jax.jit, static_argnames=("span_rows", "kp_rows",
+                                             "p_tiles", "src_rows",
+                                             "num_super", "interpret",
+                                             "segs"))
+def wide_gather(re, im, row0, sub, out_tile, first, packed, *,
+                span_rows: int, kp_rows: int, p_tiles: int, src_rows: int,
+                num_super: int, interpret: bool = False, segs: tuple = ()):
+    """Run the wide windowed gather.
+
+    Args:
+      re, im: (src_rows, 128) float32 planar source — or (B, src_rows, 128)
+        batched.
+      row0/sub/out_tile/first/packed: device tables (see
+        build_wide_gather_tables).
+    Returns:
+      (out_re, out_im): each (num_super * p_tiles, 8, 128) float32, with a
+      leading B when batched. Flat prefix = the num_out output slots.
+    """
+    if segs:
+        outs_re, outs_im = [], []
+        for (c0, c1, t0, t1) in segs:
+            o_re, o_im = wide_gather(
+                re, im, row0[c0:c1], sub[c0:c1], out_tile[c0:c1] - t0,
+                first[c0:c1], packed[c0:c1], span_rows=span_rows,
+                kp_rows=kp_rows, p_tiles=p_tiles, src_rows=src_rows,
+                num_super=t1 - t0, interpret=interpret)
+            outs_re.append(o_re)
+            outs_im.append(o_im)
+        axis = 1 if re.ndim == 3 else 0
+        return (jnp.concatenate(outs_re, axis=axis),
+                jnp.concatenate(outs_im, axis=axis))
+    if re.ndim == 3 and re.shape[0] * row0.shape[0] > WIDE_SEG_CHUNK_LIMIT:
+        # The compile-crash threshold (see WIDE_SEG_CHUNK_LIMIT) is on the
+        # TOTAL grid step count; a batched launch compiles B * C steps, so
+        # big batches run as per-slab launches instead (loses cross-batch
+        # DMA prefetch only).
+        outs = [_wide_gather_call(re[b], im[b], row0, sub, out_tile, first,
+                                  packed, span_rows=span_rows,
+                                  kp_rows=kp_rows, p_tiles=p_tiles,
+                                  num_super=num_super, interpret=interpret)
+                for b in range(re.shape[0])]
+        return (jnp.stack([o[0] for o in outs]),
+                jnp.stack([o[1] for o in outs]))
+    return _wide_gather_call(re, im, row0, sub, out_tile, first, packed,
+                             span_rows=span_rows, kp_rows=kp_rows,
+                             p_tiles=p_tiles, num_super=num_super,
+                             interpret=interpret)
+
+
+def _wide_gather_call(re, im, row0, sub, out_tile, first, packed, *,
+                      span_rows: int, kp_rows: int, p_tiles: int,
+                      num_super: int, interpret: bool):
+    C = row0.shape[0]
+    K, kp, P = span_rows, kp_rows, p_tiles
+    kern = functools.partial(_kernel_wide_batched if re.ndim == 3
+                             else _kernel_wide, K, kp, P)
+    scratch = [
+        pltpu.VMEM((2, 2, K, TILE_LANE), jnp.float32),
+        pltpu.SemaphoreType.DMA((2, 2)),
+    ]
+    if re.ndim == 3:
+        B = re.shape[0]
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,  # row0, sub, out_tile, first
+            grid=(B, C),
+            in_specs=[
+                pl.BlockSpec((1, P, TILE_SUB, TILE_LANE),
+                             lambda b, g, r0, sb, ot, fs: (g, 0, 0, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=(
+                pl.BlockSpec((1, P, TILE_SUB, TILE_LANE),
+                             lambda b, g, r0, sb, ot, fs: (b, ot[g], 0, 0)),
+                pl.BlockSpec((1, P, TILE_SUB, TILE_LANE),
+                             lambda b, g, r0, sb, ot, fs: (b, ot[g], 0, 0)),
+            ),
+            scratch_shapes=scratch,
+        )
+        out_shape = (
+            jax.ShapeDtypeStruct((B, num_super * P, TILE_SUB, TILE_LANE),
+                                 jnp.float32),
+            jax.ShapeDtypeStruct((B, num_super * P, TILE_SUB, TILE_LANE),
+                                 jnp.float32))
+        return pl.pallas_call(
+            kern, out_shape=out_shape, grid_spec=grid_spec,
+            interpret=interpret,
+        )(row0, sub, out_tile, first, packed, re, im)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,  # row0, sub, out_tile, first
+        grid=(C,),
+        in_specs=[
+            pl.BlockSpec((1, P, TILE_SUB, TILE_LANE),
+                         lambda g, r0, sb, ot, fs: (g, 0, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=(
+            pl.BlockSpec((P, TILE_SUB, TILE_LANE),
+                         lambda g, r0, sb, ot, fs: (ot[g], 0, 0)),
+            pl.BlockSpec((P, TILE_SUB, TILE_LANE),
+                         lambda g, r0, sb, ot, fs: (ot[g], 0, 0)),
+        ),
+        scratch_shapes=scratch,
+    )
+    out_shape = (
+        jax.ShapeDtypeStruct((num_super * P, TILE_SUB, TILE_LANE),
+                             jnp.float32),
+        jax.ShapeDtypeStruct((num_super * P, TILE_SUB, TILE_LANE),
+                             jnp.float32))
+    return pl.pallas_call(
+        kern, out_shape=out_shape, grid_spec=grid_spec,
+        interpret=interpret,
+    )(row0, sub, out_tile, first, packed, re, im)
+
+
+# -- uniform dispatch over the two table kinds -------------------------------
+
+def gather_device_tables(t) -> tuple:
+    """The device-committed jnp arrays for either table kind, in the order
+    the matching runner expects."""
+    if isinstance(t, WideGatherTables):
+        return (jnp.asarray(t.row0), jnp.asarray(t.sub),
+                jnp.asarray(t.out_tile), jnp.asarray(t.first),
+                jnp.asarray(t.packed))
+    return (jnp.asarray(t.row0), jnp.asarray(t.out_tile),
+            jnp.asarray(t.first), jnp.asarray(t.packed))
+
+
+def run_gather(re, im, dev_tables: tuple, t, interpret: bool = False):
+    """Run whichever kernel matches ``t`` (WideGatherTables or
+    MonotoneGatherTables) on planar sources; returns (out_re, out_im)
+    whose flat prefix holds the ``t.num_out`` output slots."""
+    if isinstance(t, WideGatherTables):
+        return wide_gather(re, im, *dev_tables, span_rows=t.span_rows,
+                           kp_rows=t.kp_rows, p_tiles=t.p_tiles,
+                           src_rows=t.src_rows, num_super=t.num_super,
+                           interpret=interpret, segs=t.segs)
+    return monotone_gather(re, im, *dev_tables, span_rows=t.span_rows,
+                           src_rows=t.src_rows, num_tiles=t.num_tiles,
+                           interpret=interpret, segs=t.segs)
+
+
+def run_gather_values(values_il, tables, device_tables=None,
+                      interpret: bool = False):
+    """Convenience wrapper for either table kind: interleaved (N, 2) source
+    -> (num_out, 2) output.
+
+    ``device_tables`` may supply the pre-committed jax arrays of
+    :func:`gather_device_tables` to keep table upload off the hot path.
     """
     re, im = planar_from_interleaved(values_il, tables.src_rows)
     if device_tables is None:
-        device_tables = (jnp.asarray(tables.row0),
-                         jnp.asarray(tables.out_tile),
-                         jnp.asarray(tables.first),
-                         jnp.asarray(tables.packed))
-    out_re, out_im = monotone_gather(
-        re, im, *device_tables, span_rows=tables.span_rows,
-        src_rows=tables.src_rows, num_tiles=tables.num_tiles,
-        interpret=interpret, segs=tables.segs)
+        device_tables = gather_device_tables(tables)
+    out_re, out_im = run_gather(re, im, device_tables, tables,
+                                interpret=interpret)
     return interleaved_from_planar(out_re, out_im, tables.num_out)
+
+
+def run_monotone_gather(values_il, tables: MonotoneGatherTables,
+                        device_tables=None, interpret: bool = False):
+    """Narrow-kernel alias of :func:`run_gather_values` (kept for callers
+    that build MonotoneGatherTables explicitly)."""
+    return run_gather_values(values_il, tables, device_tables, interpret)
 
 
 def planar_from_interleaved(values_il, src_rows: int, pair: bool = False):

@@ -165,6 +165,8 @@ class DistributedTransformPlan:
                  mesh: Optional[Mesh] = None, precision: str = "single",
                  exchange: ExchangeType = ExchangeType.DEFAULT,
                  use_pallas: Optional[bool] = None):
+        from ..utils.platform import enable_persistent_compilation_cache
+        enable_persistent_compilation_cache()
         self.dist_plan = dist_plan
         self.precision = precision
         self.exchange = ExchangeType(exchange)
@@ -382,29 +384,54 @@ class DistributedTransformPlan:
             p.value_indices, num_slots, pad_values_to=mv)
             for p in dp.shard_plans]
 
-        def build_all(which, num_src, num_out):
-            # two passes: discover each shard's preferred K, then rebuild
-            # with the common (max) K so the SPMD program is uniform
-            tables = [gk.build_monotone_gather_tables(idx, valid, num_src,
-                                                      allow_segments=False)
+        def build_uniform(which, num_src, num_out, builder, pad_fn,
+                          geom_keys, extra):
+            """Two passes: discover each shard's preferred geometry, then
+            rebuild with the common (max of each ``geom_keys`` attribute)
+            forced so the SPMD program is uniform; pad chunk counts to the
+            max and stack. Returns None if any shard declines (caller
+            falls through to the next kind / the XLA path)."""
+            tables = [builder(idx, valid, num_src, allow_segments=False)
                       for (idx, valid) in (s[which] for s in per_shard)]
             if any(t is None for t in tables):
                 return None
-            k = max(t.span_rows for t in tables)
-            tables = [t if t.span_rows == k else
-                      gk.build_monotone_gather_tables(
-                          per_shard[r][which][0], per_shard[r][which][1],
-                          num_src, k_rows=k,
-                          allow_segments=False)
+            forced = {kw: max(getattr(t, attr) for t in tables)
+                      for attr, kw in geom_keys.items()}
+            tables = [t if all(getattr(t, a) == forced[kw]
+                               for a, kw in geom_keys.items()) else
+                      builder(per_shard[r][which][0],
+                              per_shard[r][which][1], num_src,
+                              allow_segments=False, **forced)
                       for r, t in enumerate(tables)]
             if any(t is None for t in tables):
-                return None  # a forced-K rebuild crossed the chunk ceiling
+                return None  # a forced rebuild crossed the chunk ceiling
             c_max = max(t.row0.shape[0] for t in tables)
-            src_rows = max(t.src_rows for t in tables)
-            padded = [gk.pad_tables_to(t, c_max) for t in tables]
-            stacked = [np.stack([p[i] for p in padded]) for i in range(4)]
-            return {"stacked": stacked, "k": k, "src_rows": src_rows,
-                    "tiles_p1": tables[0].num_tiles + 1, "num_out": num_out}
+            padded = [pad_fn(t, c_max) for t in tables]
+            stacked = [np.stack([p[i] for p in padded])
+                       for i in range(len(padded[0]))]
+            out = {"stacked": stacked, "num_out": num_out,
+                   "src_rows": max(t.src_rows for t in tables),
+                   "k": forced["k_rows"]}
+            out.update(extra(tables[0]))
+            return out
+
+        def build_all(which, num_src, num_out):
+            # num_super / num_tiles are identical across shards already
+            # (the idx length is the padded uniform max_values /
+            # max_sticks * dim_z on every shard).
+            return build_uniform(
+                which, num_src, num_out, gk.build_wide_gather_tables,
+                gk.pad_wide_tables_to,
+                {"kp_rows": "kp_rows", "span_rows": "k_rows"},
+                lambda t0: {"kind": "wide", "kp": t0.kp_rows,
+                            "p_tiles": t0.p_tiles,
+                            "super_p1": t0.num_super + 1},
+            ) or build_uniform(
+                which, num_src, num_out, gk.build_monotone_gather_tables,
+                gk.pad_tables_to, {"span_rows": "k_rows"},
+                lambda t0: {"kind": "narrow",
+                            "tiles_p1": t0.num_tiles + 1},
+            )
 
         dec = build_all(0, num_src=mv, num_out=num_slots)
         cmp_ = build_all(1, num_src=num_slots, num_out=mv)
@@ -418,19 +445,27 @@ class DistributedTransformPlan:
         self._pallas_dist = {
             "dec": dec, "cmp": cmp_,
             "stacked": dec["stacked"] + cmp_["stacked"],
+            "n_dec": len(dec["stacked"]),  # wide = 5 tables, narrow = 4
         }
         self._pallas_interpret = not backend_ok
 
     def _pallas_gather(self, flat_il, t, tables):
-        """Run the monotone gather on one shard's (N, 2) interleaved data."""
+        """Run the windowed gather (wide or narrow kernel) on one shard's
+        (N, 2) interleaved data."""
         from ..ops import gather_kernel as gk
-        row0, out_tile, first, packed = (a[0] for a in tables)
+        shard_tabs = tuple(a[0] for a in tables)
         re, im = gk.planar_from_interleaved(
             flat_il.astype(np.float32), t["src_rows"])
-        out_re, out_im = gk.monotone_gather(
-            re, im, row0, out_tile, first, packed,
-            span_rows=t["k"], src_rows=t["src_rows"],
-            num_tiles=t["tiles_p1"], interpret=self._pallas_interpret)
+        if t["kind"] == "wide":
+            out_re, out_im = gk.wide_gather(
+                re, im, *shard_tabs, span_rows=t["k"], kp_rows=t["kp"],
+                p_tiles=t["p_tiles"], src_rows=t["src_rows"],
+                num_super=t["super_p1"], interpret=self._pallas_interpret)
+        else:
+            out_re, out_im = gk.monotone_gather(
+                re, im, *shard_tabs, span_rows=t["k"],
+                src_rows=t["src_rows"], num_tiles=t["tiles_p1"],
+                interpret=self._pallas_interpret)
         return gk.interleaved_from_planar(out_re, out_im, t["num_out"])
 
     # -- SPMD bodies ---------------------------------------------------------
@@ -480,9 +515,9 @@ class DistributedTransformPlan:
         kernel tables (batched pallas grid / vmapped XLA gather)."""
         dp = self.dist_plan
         if self._pallas_dist is not None:
-            dec_il = self._pallas_gather(values_il,
-                                         self._pallas_dist["dec"],
-                                         ptables[:4])
+            dec_il = self._pallas_gather(
+                values_il, self._pallas_dist["dec"],
+                ptables[:self._pallas_dist["n_dec"]])
             flat = dec_il[..., 0] + 1j * dec_il[..., 1]
             return flat.reshape(values_il.shape[:-2]
                                 + (dp.max_sticks, dp.dim_z))
@@ -576,8 +611,9 @@ class DistributedTransformPlan:
         flat = jnp.stack([jnp.real(sticks).reshape(batch + (-1,)),
                           jnp.imag(sticks).reshape(batch + (-1,))], axis=-1)
         if self._pallas_dist is not None:
-            values = self._pallas_gather(flat, self._pallas_dist["cmp"],
-                                         ptables[4:8])
+            values = self._pallas_gather(
+                flat, self._pallas_dist["cmp"],
+                ptables[self._pallas_dist["n_dec"]:])
         elif flat.ndim == 3:
             values = jax.vmap(
                 lambda f: stages.gather_rows_with_sentinel(f, vi[0]))(flat)

@@ -208,7 +208,13 @@ class CompactSchedule:
     def wire_elements(self) -> int:
         """TOTAL off-shard complex elements per exchange, summed over all
         shards (hop 0 is local). The aggregate-ICI-traffic metric; compare
-        with the padded layout's ``S * (S-1) * max_sticks * max_planes``."""
+        with the padded layout's ``S * (S-1) * max_sticks * max_planes``.
+
+        Counts what the ppermute ops actually ship: each pair is charged
+        its op's full buffer size L, so on a hop bucketed into factor-2
+        size classes a pair can be counted at up to 2x its exact payload
+        (exact when the hop has <= 4 distinct sizes and every op is an
+        exact class)."""
         send, _ = self._send_recv_per_shard()
         return int(send.sum())
 
@@ -218,7 +224,8 @@ class CompactSchedule:
         PLANE distribution the big plane-owner's ingress is real payload
         (a true Alltoallv ships the same bytes), so this metric does NOT
         shrink the way the aggregate does; capacity planning should read
-        this one."""
+        this one. Bucketed ops are counted at bucket size, as in
+        :meth:`wire_elements`."""
         send, recv = self._send_recv_per_shard()
         both = np.maximum(send, recv)
         return int(both.max()) if self.num_shards else 0

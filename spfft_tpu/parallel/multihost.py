@@ -63,10 +63,12 @@ def initialize(coordinator_address: Optional[str] = None,
         jax.distributed.initialize(coordinator_address=coordinator_address,
                                    num_processes=num_processes,
                                    process_id=process_id)
-    except RuntimeError as e:  # pragma: no cover - environment-dependent
+    except (RuntimeError, ValueError) as e:  # pragma: no cover - env-dep.
         msg = str(e).lower()
-        # jax's double-init message is "...should only be called once";
-        # match loosely in case the wording shifts again
+        # jax's double-init message (JAX 0.9: RuntimeError "...should only
+        # be called once"; some versions raise ValueError): match loosely in
+        # case the wording shifts again. The state probe above catches the
+        # common case even if these strings rot.
         if "already initialized" in msg or "only be called once" in msg:
             return
         raise DistributedError(f"jax.distributed initialization failed: {e}")

@@ -62,11 +62,21 @@ class TransformPlan:
 
     def __init__(self, index_plan: IndexPlan, precision: str = "single",
                  use_pallas: Optional[bool] = None):
+        from .utils.platform import enable_persistent_compilation_cache
+        enable_persistent_compilation_cache()
         self.index_plan = index_plan
         self.precision = precision
         self._rdt = real_dtype(precision)
         self._cdt = complex_dtype(precision)
         self._pair_io = index_plan.num_values >= PAIR_IO_THRESHOLD
+        if self._pair_io:
+            # Layout flip is observable by callers (forward/apply_pointwise
+            # return (2, N) instead of (N, 2)); say so once at plan build.
+            logger.info(
+                "spfft_tpu: plan has %d values (>= %d) — device value "
+                "arrays use the planar pair layout (2, N); see "
+                "TransformPlan.pair_values_io",
+                index_plan.num_values, PAIR_IO_THRESHOLD)
         # Static tables, device-committed once (plan time, never at execute
         # time — mirroring SURVEY.md §3.1's plan/execute split). They are
         # passed to the jitted pipelines as arguments, not closure constants:
@@ -132,13 +142,17 @@ class TransformPlan:
         num_slots = p.num_sticks * p.dim_z
         (dec_idx, occupied), (cmp_idx, cmp_valid) = \
             gk.compression_gather_inputs(vi, num_slots)
-        dec = gk.build_monotone_gather_tables(dec_idx, occupied, p.num_values)
-        cmp_ = gk.build_monotone_gather_tables(cmp_idx, cmp_valid, num_slots)
+        dec = gk.build_best_gather_tables(dec_idx, occupied, p.num_values)
+        cmp_ = gk.build_best_gather_tables(cmp_idx, cmp_valid, num_slots)
         self._pallas = {"dec": dec, "cmp": cmp_}
         if dec is None or cmp_ is None:
             fell_back = [n for n, t in (("decompress", dec),
                                         ("compress", cmp_)) if t is None]
-            logger.warning(
+            # WARNING only when the caller explicitly asked for the kernel;
+            # auto mode (use_pallas=None) logs at INFO — the user never
+            # requested the Pallas path, so a per-plan-build warning is noise.
+            log = logger.warning if use_pallas is True else logger.info
+            log(
                 "spfft_tpu: value order too scattered for the Pallas "
                 "compression kernel (%s) — using the slower XLA gather "
                 "path there (sort triplets with utils.workloads."
@@ -151,10 +165,7 @@ class TransformPlan:
         for name, t in (("dec", dec), ("cmp", cmp_)):
             if t is None:
                 continue
-            self._tables[name + "_row0"] = jnp.asarray(t.row0)
-            self._tables[name + "_out_tile"] = jnp.asarray(t.out_tile)
-            self._tables[name + "_first"] = jnp.asarray(t.first)
-            self._tables[name + "_packed"] = jnp.asarray(t.packed)
+            self._tables[name + "_tabs"] = gk.gather_device_tables(t)
 
     def _init_split_x(self) -> None:
         """Enable the sparse-x xy-stage when the occupied x columns span
@@ -262,11 +273,7 @@ class TransformPlan:
         t = self._pallas["dec"]
         re, im = gk.planar_from_interleaved(values_il.astype(np.float32),
                                             t.src_rows, pair=self._pair_io)
-        out_re, out_im = gk.monotone_gather(
-            re, im, tables["dec_row0"], tables["dec_out_tile"],
-            tables["dec_first"], tables["dec_packed"],
-            span_rows=t.span_rows, src_rows=t.src_rows,
-            num_tiles=t.num_tiles, segs=t.segs)
+        out_re, out_im = gk.run_gather(re, im, tables["dec_tabs"], t)
         flat = (out_re.reshape(-1)[:t.num_out]
                 + 1j * out_im.reshape(-1)[:t.num_out])
         return flat.reshape(p.num_sticks, p.dim_z)
@@ -280,11 +287,7 @@ class TransformPlan:
         from .ops import gather_kernel as gk
         t = self._pallas["cmp"]
         re, im = gk.planar_from_complex(sticks, t.src_rows)
-        out_re, out_im = gk.monotone_gather(
-            re, im, tables["cmp_row0"], tables["cmp_out_tile"],
-            tables["cmp_first"], tables["cmp_packed"],
-            span_rows=t.span_rows, src_rows=t.src_rows,
-            num_tiles=t.num_tiles, segs=t.segs)
+        out_re, out_im = gk.run_gather(re, im, tables["cmp_tabs"], t)
         values = gk.interleaved_from_planar(out_re, out_im, t.num_out,
                                             pair=self._pair_io)
         if scale is not None:
@@ -368,11 +371,7 @@ class TransformPlan:
         re, im = gk.planar_from_interleaved(values_b.astype(np.float32),
                                             t.src_rows,
                                             pair=self._pair_io)
-        out_re, out_im = gk.monotone_gather(
-            re, im, tables["dec_row0"], tables["dec_out_tile"],
-            tables["dec_first"], tables["dec_packed"],
-            span_rows=t.span_rows, src_rows=t.src_rows,
-            num_tiles=t.num_tiles, segs=t.segs)
+        out_re, out_im = gk.run_gather(re, im, tables["dec_tabs"], t)
         B = values_b.shape[0]
         flat = (out_re.reshape(B, -1)[:, :t.num_out]
                 + 1j * out_im.reshape(B, -1)[:, :t.num_out])
@@ -390,11 +389,7 @@ class TransformPlan:
         from .ops import gather_kernel as gk
         t = self._pallas["cmp"]
         re, im = gk.planar_from_complex(sticks_b, t.src_rows)
-        out_re, out_im = gk.monotone_gather(
-            re, im, tables["cmp_row0"], tables["cmp_out_tile"],
-            tables["cmp_first"], tables["cmp_packed"],
-            span_rows=t.span_rows, src_rows=t.src_rows,
-            num_tiles=t.num_tiles, segs=t.segs)
+        out_re, out_im = gk.run_gather(re, im, tables["cmp_tabs"], t)
         values = gk.interleaved_from_planar(out_re, out_im, t.num_out,
                                             pair=self._pair_io)
         if scale is not None:

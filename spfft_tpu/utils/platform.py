@@ -9,7 +9,55 @@ conftest so the workaround lives in one place.
 
 from __future__ import annotations
 
+import logging
+import os
+
 import jax
+
+logger = logging.getLogger("spfft_tpu")
+
+_cache_configured = False
+
+
+def enable_persistent_compilation_cache() -> None:
+    """Point XLA's persistent compilation cache at a durable directory.
+
+    TPU FFT compiles are the dominant plan-time cost for large grids (16 s
+    at 256^3, ~60 s at 512^3, measured — BENCHMARKS.md "envelope"); the
+    reference plans in sub-second time because FFTW_ESTIMATE does no
+    measurement (reference: src/parameters/parameters.cpp:43-140 plus plan
+    construction). A persistent cache makes every plan after the first
+    process-lifetime-independent: SCF codes that rebuild plans per geometry
+    step pay the compile once per (shape, pipeline) ever, not once per run.
+
+    Knob: ``SPFFT_TPU_CACHE_DIR`` — unset = ``~/.cache/spfft_tpu/xla``;
+    ``0``/``off``/empty = disabled. A user-set
+    ``jax_compilation_cache_dir`` (config or JAX_COMPILATION_CACHE_DIR env)
+    is respected and never overridden. Called lazily at the first plan
+    build (NOT at package import — merely importing the package must not
+    mutate global JAX config or touch the filesystem); safe to call
+    again."""
+    global _cache_configured
+    if _cache_configured:
+        return
+    _cache_configured = True
+    knob = os.environ.get("SPFFT_TPU_CACHE_DIR")
+    if knob is not None and knob.strip().lower() in ("", "0", "off"):
+        return
+    try:
+        if jax.config.jax_compilation_cache_dir:
+            return  # user already configured a cache; leave it alone
+        path = knob or os.path.join(
+            os.path.expanduser("~"), ".cache", "spfft_tpu", "xla")
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # Cache every compile that takes noticeable time: the default
+        # 1 s floor would skip the many small stage executables whose
+        # compiles still add up on remote-attached devices.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+    except Exception as e:  # pragma: no cover - config may be frozen
+        logger.info("spfft_tpu: persistent compilation cache not enabled "
+                    "(%s)", e)
 
 
 def force_virtual_cpu_devices(n: int) -> None:

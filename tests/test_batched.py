@@ -242,9 +242,8 @@ def test_local_batched_pallas_kernel_interpret(monkeypatch):
     plan = make_local_plan(TransformType.C2C, n, n, n, triplets,
                            precision="single", use_pallas=True)
     assert plan._pallas is not None
-    monkeypatch.setattr(gk, "monotone_gather",
-                        functools.partial(gk.monotone_gather,
-                                          interpret=True))
+    monkeypatch.setattr(gk, "run_gather",
+                        functools.partial(gk.run_gather, interpret=True))
     monkeypatch.setattr(plan, "_pallas_active", True)
     rng = np.random.default_rng(31)
     vals_b = jax.numpy.asarray(
@@ -325,9 +324,8 @@ def test_local_batched_pallas_pair_io_interpret(monkeypatch):
     plan = make_local_plan(TransformType.C2C, n, n, n, triplets,
                            precision="single", use_pallas=True)
     assert plan.pair_values_io and plan._pallas is not None
-    monkeypatch.setattr(gk, "monotone_gather",
-                        functools.partial(gk.monotone_gather,
-                                          interpret=True))
+    monkeypatch.setattr(gk, "run_gather",
+                        functools.partial(gk.run_gather, interpret=True))
     monkeypatch.setattr(plan, "_pallas_active", True)
     rng = np.random.default_rng(32)
     N = plan.index_plan.num_values

@@ -274,3 +274,170 @@ def test_forced_pallas_on_double_rejected():
     with pytest.raises(InvalidParameterError):
         make_local_plan(TransformType.C2C, 4, 4, 4, np.array([[0, 0, 0]]),
                         precision="double", use_pallas=True)
+
+
+# -- wide-kernel (P tiles per grid step) tests --------------------------------
+
+def run_wide(src: np.ndarray, idx: np.ndarray, valid: np.ndarray, **kw):
+    t = gk.build_wide_gather_tables(idx, valid, len(src), **kw)
+    assert t is not None
+    out = gk.run_gather_values(jnp.asarray(src, jnp.float32), t,
+                               interpret=True)
+    return np.asarray(out), t
+
+
+@pytest.mark.parametrize("fill", [0.55, 0.9])
+def test_wide_expansion_pattern(fill):
+    """Decompress-style: masked slots, increments <= 1 — two fill levels
+    exercise different kp/K auto choices."""
+    rng = np.random.default_rng(10)
+    L = 40_000
+    mask = rng.random(L) < fill
+    n_src = int(mask.sum())
+    src = rng.random((n_src, 2)).astype(np.float32)
+    idx = np.maximum(np.cumsum(mask) - 1, 0)
+    out, t = run_wide(src, idx, mask)
+    assert isinstance(t, gk.WideGatherTables)
+    ref = np.zeros((L, 2), np.float32)
+    ref[mask] = src
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_wide_compaction_pattern():
+    rng = np.random.default_rng(11)
+    M = 80_000
+    idx = np.sort(rng.choice(M, 40_000, replace=False)).astype(np.int64)
+    src = rng.random((M, 2)).astype(np.float32)
+    out, t = run_wide(src, idx, np.ones(len(idx), bool))
+    np.testing.assert_array_equal(out, src[idx])
+
+
+def test_wide_multi_round_cover():
+    """Per-tile spans exceeding kp force multiple rounds per super-tile
+    (the revisiting-accumulation path)."""
+    rng = np.random.default_rng(12)
+    L = 3 * gk.WIDE_P * gk.TILE
+    idx = (np.arange(L, dtype=np.int64) * 7) % (L // 2)  # scattered-ish
+    idx = np.sort(idx.reshape(-1, gk.TILE), axis=1).reshape(-1)
+    src = rng.random((L // 2, 2)).astype(np.float32)
+    t = gk.build_wide_gather_tables(idx, np.ones(L, bool), L // 2,
+                                    kp_rows=8)
+    if t is None:
+        pytest.skip("cover declined for this pattern")
+    assert t.row0.shape[0] > t.num_super  # at least one multi-chunk tile
+    out = np.asarray(gk.run_gather_values(
+        jnp.asarray(src, jnp.float32), t, interpret=True))
+    np.testing.assert_array_equal(out, src[idx])
+
+
+def test_wide_block_shuffled_order():
+    """Locally-coherent but globally shuffled order stays on the wide path."""
+    rng = np.random.default_rng(13)
+    M = 120_000
+    n = 57_344  # 14 * 4096
+    idx = np.sort(rng.choice(M, n, replace=False)).astype(np.int64)
+    idx = idx.reshape(-1, 4096)[rng.permutation(n // 4096)].reshape(-1)
+    src = rng.random((M, 2)).astype(np.float32)
+    out, t = run_wide(src, idx, np.ones(n, bool))
+    np.testing.assert_array_equal(out, src[idx])
+
+
+def test_wide_random_order_falls_back():
+    rng = np.random.default_rng(14)
+    idx = rng.integers(0, 2_000_000, 60_000)
+    assert gk.build_wide_gather_tables(
+        idx, np.ones(len(idx), bool), 2_000_000) is None
+    # build_best falls through to narrow, then None
+    assert gk.build_best_gather_tables(
+        idx, np.ones(len(idx), bool), 2_000_000) is None
+
+
+def test_wide_no_valid_slots_zeroes_output():
+    out, t = run_wide(np.ones((64, 2), np.float32),
+                      np.zeros(5000, np.int64), np.zeros(5000, bool))
+    np.testing.assert_array_equal(out, np.zeros((5000, 2), np.float32))
+
+
+def test_wide_duplicate_indices():
+    rng = np.random.default_rng(15)
+    idx = np.repeat(np.arange(3000), 3)[:8192]
+    src = rng.random((3000, 2)).astype(np.float32)
+    out, _ = run_wide(src, idx, np.ones(8192, bool))
+    np.testing.assert_array_equal(out, src[idx])
+
+
+def test_wide_forced_geometry_rebuild():
+    """Forcing common (kp, K) — the distributed uniformity pass — keeps
+    results exact."""
+    rng = np.random.default_rng(16)
+    M = 60_000
+    idx = np.sort(rng.choice(M, 30_000, replace=False)).astype(np.int64)
+    src = rng.random((M, 2)).astype(np.float32)
+    t0 = gk.build_wide_gather_tables(idx, np.ones(len(idx), bool), M)
+    t1 = gk.build_wide_gather_tables(idx, np.ones(len(idx), bool), M,
+                                     kp_rows=t0.kp_rows + 4,
+                                     k_rows=t0.span_rows + 8)
+    out = np.asarray(gk.run_gather_values(jnp.asarray(src, jnp.float32),
+                                          t1, interpret=True))
+    np.testing.assert_array_equal(out, src[idx])
+
+
+def test_wide_padded_tables_dummy_block():
+    """pad_wide_tables_to appends no-op chunks targeting a dummy super-tile;
+    running with num_super + 1 leaves the real output prefix unchanged."""
+    rng = np.random.default_rng(17)
+    M = 40_000
+    idx = np.sort(rng.choice(M, 20_000, replace=False)).astype(np.int64)
+    src = rng.random((M, 2)).astype(np.float32)
+    t = gk.build_wide_gather_tables(idx, np.ones(len(idx), bool), M)
+    padded = gk.pad_wide_tables_to(t, t.row0.shape[0] + 7)
+    re, im = gk.planar_from_interleaved(jnp.asarray(src, jnp.float32),
+                                        t.src_rows)
+    out_re, out_im = gk.wide_gather(
+        re, im, *(jnp.asarray(a) for a in padded), span_rows=t.span_rows,
+        kp_rows=t.kp_rows, p_tiles=t.p_tiles, src_rows=t.src_rows,
+        num_super=t.num_super + 1, interpret=True)
+    got = gk.interleaved_from_planar(out_re, out_im, t.num_out)
+    np.testing.assert_array_equal(np.asarray(got), src[idx])
+
+
+def test_wide_segments():
+    """Chunk counts past WIDE_SEG_CHUNK_LIMIT run as multiple tile-aligned
+    launches (the compile-crash workaround) with identical results."""
+    rng = np.random.default_rng(18)
+    L = 12 * gk.WIDE_P * gk.TILE
+    idx = np.arange(L, dtype=np.int64)
+    src = rng.random((L, 2)).astype(np.float32)
+    old = gk.WIDE_SEG_CHUNK_LIMIT
+    gk.WIDE_SEG_CHUNK_LIMIT = 5
+    try:
+        t = gk.build_wide_gather_tables(idx, np.ones(L, bool), L)
+    finally:
+        gk.WIDE_SEG_CHUNK_LIMIT = old
+    assert t is not None and len(t.segs) >= 2
+    out = np.asarray(gk.run_gather_values(jnp.asarray(src, jnp.float32), t,
+                                          interpret=True))
+    np.testing.assert_array_equal(out, src)
+
+
+def test_wide_batched_split_over_step_budget():
+    """A batched launch whose B*C exceeds the chunk limit splits into
+    per-slab launches (total-grid-step compile-crash guard)."""
+    rng = np.random.default_rng(19)
+    L = 4 * gk.WIDE_P * gk.TILE
+    idx = np.arange(L, dtype=np.int64)
+    src = rng.random((3, L, 2)).astype(np.float32)
+    t = gk.build_wide_gather_tables(idx, np.ones(L, bool), L)
+    assert t is not None
+    re, im = gk.planar_from_interleaved(jnp.asarray(src), t.src_rows)
+    old = gk.WIDE_SEG_CHUNK_LIMIT
+    gk.WIDE_SEG_CHUNK_LIMIT = 2 * t.row0.shape[0]  # B=3 crosses, C alone not
+    try:
+        out_re, out_im = gk.wide_gather(
+            re, im, *gk.gather_device_tables(t), span_rows=t.span_rows,
+            kp_rows=t.kp_rows, p_tiles=t.p_tiles, src_rows=t.src_rows,
+            num_super=t.num_super, interpret=True)
+    finally:
+        gk.WIDE_SEG_CHUNK_LIMIT = old
+    got = np.asarray(gk.interleaved_from_planar(out_re, out_im, t.num_out))
+    np.testing.assert_array_equal(got, src)
