@@ -76,6 +76,8 @@ def main() -> None:
     values = (rng.uniform(-1, 1, len(triplets))
               + 1j * rng.uniform(-1, 1, len(triplets))).astype(np.complex64)
 
+    jax.devices()  # backend bring-up (~7 s through the tunnel) is session
+    # cost, not plan cost — keep it out of plan_s
     t_plan = time.perf_counter()
     plan = make_local_plan(TransformType.C2C, n, n, n, triplets,
                            precision="single")

@@ -50,7 +50,8 @@ int main(void) {
 
   SpfftTpuPlan plan = NULL;
   CHECK(spfft_tpu_plan_create(&plan, SPFFT_TPU_TRANS_C2C, DIM, DIM, DIM, n,
-                              triplets, SPFFT_TPU_PREC_SINGLE));
+                              triplets, SPFFT_TPU_PREC_SINGLE,
+                              SPFFT_TPU_PALLAS_AUTO));
 
   long long num_values = 0;
   CHECK(spfft_tpu_plan_num_values(plan, &num_values));

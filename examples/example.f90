@@ -46,7 +46,7 @@ program example
   plan = c_null_ptr
   status = spfft_tpu_plan_create(plan, SPFFT_TPU_TRANS_C2C, dim, dim, dim, &
                                  int(n, c_long_long), triplets, &
-                                 SPFFT_TPU_PREC_SINGLE)
+                                 SPFFT_TPU_PREC_SINGLE, SPFFT_TPU_PALLAS_AUTO)
   if (status /= SPFFT_TPU_SUCCESS) stop "plan_create failed"
 
   status = spfft_tpu_plan_num_values(plan, num_values)

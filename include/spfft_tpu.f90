@@ -46,6 +46,19 @@ module spfft_tpu
   integer(c_int), parameter :: SPFFT_TPU_PREC_SINGLE = 0
   integer(c_int), parameter :: SPFFT_TPU_PREC_DOUBLE = 1
 
+  ! Exchange algorithm (SpfftTpuExchangeType; reference types.h:33-62)
+  integer(c_int), parameter :: SPFFT_TPU_EXCH_DEFAULT = 0
+  integer(c_int), parameter :: SPFFT_TPU_EXCH_BUFFERED = 1
+  integer(c_int), parameter :: SPFFT_TPU_EXCH_BUFFERED_FLOAT = 2
+  integer(c_int), parameter :: SPFFT_TPU_EXCH_COMPACT_BUFFERED = 3
+  integer(c_int), parameter :: SPFFT_TPU_EXCH_COMPACT_BUFFERED_FLOAT = 4
+  integer(c_int), parameter :: SPFFT_TPU_EXCH_UNBUFFERED = 5
+
+  ! Compression-kernel routing (SpfftTpuPallasMode)
+  integer(c_int), parameter :: SPFFT_TPU_PALLAS_AUTO = -1
+  integer(c_int), parameter :: SPFFT_TPU_PALLAS_OFF = 0
+  integer(c_int), parameter :: SPFFT_TPU_PALLAS_ON = 1
+
   interface
 
     integer(c_int) function spfft_tpu_init(package_path) &
@@ -55,7 +68,8 @@ module spfft_tpu
     end function
 
     integer(c_int) function spfft_tpu_plan_create(plan, transform_type, &
-        dim_x, dim_y, dim_z, num_values, index_triplets, precision) &
+        dim_x, dim_y, dim_z, num_values, index_triplets, precision, &
+        use_pallas) &
         bind(C, name="spfft_tpu_plan_create")
       use iso_c_binding
       type(c_ptr), intent(out) :: plan
@@ -66,11 +80,13 @@ module spfft_tpu
       integer(c_long_long), value :: num_values
       integer(c_int), dimension(*), intent(in) :: index_triplets
       integer(c_int), value :: precision
+      integer(c_int), value :: use_pallas
     end function
 
     integer(c_int) function spfft_tpu_plan_create_distributed(plan, &
         transform_type, dim_x, dim_y, dim_z, num_shards, values_per_shard, &
-        index_triplets, planes_per_shard, precision) &
+        index_triplets, planes_per_shard, precision, exchange_type, &
+        use_pallas) &
         bind(C, name="spfft_tpu_plan_create_distributed")
       use iso_c_binding
       type(c_ptr), intent(out) :: plan
@@ -83,6 +99,8 @@ module spfft_tpu
       integer(c_int), dimension(*), intent(in) :: index_triplets
       integer(c_int), dimension(*), intent(in) :: planes_per_shard
       integer(c_int), value :: precision
+      integer(c_int), value :: exchange_type
+      integer(c_int), value :: use_pallas
     end function
 
     integer(c_int) function spfft_tpu_plan_destroy(plan) &
@@ -156,6 +174,86 @@ module spfft_tpu
 
     integer(c_int) function spfft_tpu_plan_num_shards(plan, out) &
         bind(C, name="spfft_tpu_plan_num_shards")
+      use iso_c_binding
+      type(c_ptr), value :: plan
+      integer(c_int), intent(out) :: out
+    end function
+
+    integer(c_int) function spfft_tpu_multi_backward(num_transforms, &
+        plans, values, spaces) bind(C, name="spfft_tpu_multi_backward")
+      use iso_c_binding
+      integer(c_int), value :: num_transforms
+      type(c_ptr), dimension(*), intent(in) :: plans
+      type(c_ptr), dimension(*), intent(in) :: values
+      type(c_ptr), dimension(*), intent(in) :: spaces
+    end function
+
+    integer(c_int) function spfft_tpu_multi_forward(num_transforms, &
+        plans, spaces, scaling, values) &
+        bind(C, name="spfft_tpu_multi_forward")
+      use iso_c_binding
+      integer(c_int), value :: num_transforms
+      type(c_ptr), dimension(*), intent(in) :: plans
+      type(c_ptr), dimension(*), intent(in) :: spaces
+      integer(c_int), value :: scaling
+      type(c_ptr), dimension(*), intent(in) :: values
+    end function
+
+    integer(c_int) function spfft_tpu_plan_global_size(plan, out) &
+        bind(C, name="spfft_tpu_plan_global_size")
+      use iso_c_binding
+      type(c_ptr), value :: plan
+      integer(c_long_long), intent(out) :: out
+    end function
+
+    integer(c_int) function spfft_tpu_plan_num_global_elements(plan, out) &
+        bind(C, name="spfft_tpu_plan_num_global_elements")
+      use iso_c_binding
+      type(c_ptr), value :: plan
+      integer(c_long_long), intent(out) :: out
+    end function
+
+    integer(c_int) function spfft_tpu_plan_local_z_offset(plan, shard, &
+        out) bind(C, name="spfft_tpu_plan_local_z_offset")
+      use iso_c_binding
+      type(c_ptr), value :: plan
+      integer(c_int), value :: shard
+      integer(c_int), intent(out) :: out
+    end function
+
+    integer(c_int) function spfft_tpu_plan_local_z_length(plan, shard, &
+        out) bind(C, name="spfft_tpu_plan_local_z_length")
+      use iso_c_binding
+      type(c_ptr), value :: plan
+      integer(c_int), value :: shard
+      integer(c_int), intent(out) :: out
+    end function
+
+    integer(c_int) function spfft_tpu_plan_local_slice_size(plan, shard, &
+        out) bind(C, name="spfft_tpu_plan_local_slice_size")
+      use iso_c_binding
+      type(c_ptr), value :: plan
+      integer(c_int), value :: shard
+      integer(c_long_long), intent(out) :: out
+    end function
+
+    integer(c_int) function spfft_tpu_plan_num_local_elements(plan, &
+        shard, out) bind(C, name="spfft_tpu_plan_num_local_elements")
+      use iso_c_binding
+      type(c_ptr), value :: plan
+      integer(c_int), value :: shard
+      integer(c_long_long), intent(out) :: out
+    end function
+
+    integer(c_int) function spfft_tpu_plan_exchange_type(plan, out) &
+        bind(C, name="spfft_tpu_plan_exchange_type")
+      use iso_c_binding
+      type(c_ptr), value :: plan
+      integer(c_int), intent(out) :: out
+    end function
+
+    integer(c_int) function spfft_tpu_plan_pallas_active(plan, out) &
+        bind(C, name="spfft_tpu_plan_pallas_active")
       use iso_c_binding
       type(c_ptr), value :: plan
       integer(c_int), intent(out) :: out

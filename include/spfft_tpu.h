@@ -69,6 +69,28 @@ typedef enum SpfftTpuPrecision {
   SPFFT_TPU_PREC_DOUBLE = 1
 } SpfftTpuPrecision;
 
+/* Distributed exchange algorithm (reference: SpfftExchangeType,
+ * types.h:33-62 — same order/meaning; FLOAT variants halve on-wire
+ * precision). */
+typedef enum SpfftTpuExchangeType {
+  SPFFT_TPU_EXCH_DEFAULT = 0,
+  SPFFT_TPU_EXCH_BUFFERED = 1,
+  SPFFT_TPU_EXCH_BUFFERED_FLOAT = 2,
+  SPFFT_TPU_EXCH_COMPACT_BUFFERED = 3,
+  SPFFT_TPU_EXCH_COMPACT_BUFFERED_FLOAT = 4,
+  SPFFT_TPU_EXCH_UNBUFFERED = 5
+} SpfftTpuExchangeType;
+
+/* Compression-kernel routing: AUTO picks the Pallas windowed-gather kernel
+ * when it is expected to win (TPU backend, single precision, coherent
+ * value order); ON forces it (error if unsupported); OFF forces the plain
+ * XLA gather path. */
+typedef enum SpfftTpuPallasMode {
+  SPFFT_TPU_PALLAS_AUTO = -1,
+  SPFFT_TPU_PALLAS_OFF = 0,
+  SPFFT_TPU_PALLAS_ON = 1
+} SpfftTpuPallasMode;
+
 /* Opaque plan handle (reference: SpfftTransform, transform.h). */
 typedef void* SpfftTpuPlan;
 
@@ -88,10 +110,12 @@ int spfft_tpu_init(const char* package_path);
  *
  * index_triplets: num_values x 3 ints (x, y, z per value), centered
  * (negative) or storage indexing (reference: types.h SPFFT_INDEX_TRIPLETS).
+ * use_pallas: an SpfftTpuPallasMode value (pass SPFFT_TPU_PALLAS_AUTO).
  */
 int spfft_tpu_plan_create(SpfftTpuPlan* plan, int transform_type, int dim_x,
                           int dim_y, int dim_z, long long num_values,
-                          const int* index_triplets, int precision);
+                          const int* index_triplets, int precision,
+                          int use_pallas);
 
 /*
  * Distributed plan over num_shards devices of this process (reference:
@@ -108,6 +132,10 @@ int spfft_tpu_plan_create(SpfftTpuPlan* plan, int transform_type, int dim_x,
  * the per-shard value arrays concatenated in shard order (interleaved
  * reals); space is the FULL (dim_z, dim_y, dim_x) cube in global z order
  * (slabs concatenated), interleaved complex for C2C / real for R2C.
+ *
+ * exchange_type: an SpfftTpuExchangeType value (the reference's
+ * distributed-grid exchangeType parameter, grid.h:60-118).
+ * use_pallas: an SpfftTpuPallasMode value (pass SPFFT_TPU_PALLAS_AUTO).
  */
 int spfft_tpu_plan_create_distributed(SpfftTpuPlan* plan, int transform_type,
                                       int dim_x, int dim_y, int dim_z,
@@ -115,7 +143,8 @@ int spfft_tpu_plan_create_distributed(SpfftTpuPlan* plan, int transform_type,
                                       const long long* values_per_shard,
                                       const int* index_triplets,
                                       const int* planes_per_shard,
-                                      int precision);
+                                      int precision, int exchange_type,
+                                      int use_pallas);
 
 int spfft_tpu_plan_destroy(SpfftTpuPlan plan);
 
@@ -148,8 +177,24 @@ int spfft_tpu_forward(SpfftTpuPlan plan, const void* space, int scaling,
 int spfft_tpu_execute_pair(SpfftTpuPlan plan, const void* values_in,
                            int scaling, void* values_out);
 
-/* Getters (reference: spfft_transform_get_* accessors, transform.h). Each
- * writes one value and returns an error code. */
+/*
+ * Batched execution of num_transforms independent transforms (reference:
+ * spfft_multi_transform_backward / _forward, multi_transform.h:37-72).
+ * plans/values/spaces are arrays of num_transforms entries; buffer layouts
+ * per entry are exactly those of spfft_tpu_backward / spfft_tpu_forward.
+ * Passing the SAME plan handle for every entry executes the batch as one
+ * fused device program (the TPU-native form of the reference's
+ * comm/compute overlap schedule); distinct handles dispatch all transforms
+ * before any synchronisation.
+ */
+int spfft_tpu_multi_backward(int num_transforms, const SpfftTpuPlan* plans,
+                             const void* const* values, void* const* spaces);
+int spfft_tpu_multi_forward(int num_transforms, const SpfftTpuPlan* plans,
+                            const void* const* spaces, int scaling,
+                            void* const* values);
+
+/* Getters (reference: spfft_transform_get_* accessors, transform.h:84-245).
+ * Each writes one value and returns an error code. */
 int spfft_tpu_plan_dim_x(SpfftTpuPlan plan, int* out);
 int spfft_tpu_plan_dim_y(SpfftTpuPlan plan, int* out);
 int spfft_tpu_plan_dim_z(SpfftTpuPlan plan, int* out);
@@ -157,6 +202,24 @@ int spfft_tpu_plan_num_values(SpfftTpuPlan plan, long long* out);
 int spfft_tpu_plan_transform_type(SpfftTpuPlan plan, int* out);
 /* 1 for local plans, the mesh size for distributed plans. */
 int spfft_tpu_plan_num_shards(SpfftTpuPlan plan, int* out);
+/* dim_x * dim_y * dim_z (reference: Transform::global_size). */
+int spfft_tpu_plan_global_size(SpfftTpuPlan plan, long long* out);
+/* Total sparse elements across shards (== num_values; reference:
+ * num_global_elements). */
+int spfft_tpu_plan_num_global_elements(SpfftTpuPlan plan, long long* out);
+/* Per-shard accessors (reference per-rank getters: local_z_offset,
+ * local_z_length, local_slice_size, num_local_elements — transform.h).
+ * shard must be in [0, num_shards); local plans accept shard 0 only. */
+int spfft_tpu_plan_local_z_offset(SpfftTpuPlan plan, int shard, int* out);
+int spfft_tpu_plan_local_z_length(SpfftTpuPlan plan, int shard, int* out);
+int spfft_tpu_plan_local_slice_size(SpfftTpuPlan plan, int shard,
+                                    long long* out);
+int spfft_tpu_plan_num_local_elements(SpfftTpuPlan plan, int shard,
+                                      long long* out);
+/* The SpfftTpuExchangeType of a distributed plan (DEFAULT for local). */
+int spfft_tpu_plan_exchange_type(SpfftTpuPlan plan, int* out);
+/* 1 when the Pallas compression kernel is active for this plan. */
+int spfft_tpu_plan_pallas_active(SpfftTpuPlan plan, int* out);
 
 /* Static message for an error code (never NULL). */
 const char* spfft_tpu_error_string(int code);

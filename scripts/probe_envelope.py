@@ -50,7 +50,8 @@ def main() -> None:
     mark("values on device")
 
     for name, table in plan._tables.items():
-        table.block_until_ready()
+        for leaf in jax.tree_util.tree_leaves(table):
+            leaf.block_until_ready()
     mark("tables on device")
 
     def sync_one(out):

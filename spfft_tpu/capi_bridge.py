@@ -22,7 +22,7 @@ import numpy as np
 
 from .errors import ErrorCode, GenericError, InvalidParameterError
 from .plan import TransformPlan, make_local_plan
-from .types import Scaling, TransformType
+from .types import ExchangeType, Scaling, TransformType
 
 _plans: Dict[int, object] = {}
 _next_id = itertools.count(1)
@@ -80,6 +80,25 @@ class _InvalidHandle(GenericError):
     code = ErrorCode.INVALID_HANDLE
 
 
+#: C ABI <-> ExchangeType, in the reference's enum order (types.h:33-62).
+_EXCHANGE_BY_INT = {
+    0: ExchangeType.DEFAULT,
+    1: ExchangeType.BUFFERED,
+    2: ExchangeType.BUFFERED_FLOAT,
+    3: ExchangeType.COMPACT_BUFFERED,
+    4: ExchangeType.COMPACT_BUFFERED_FLOAT,
+    5: ExchangeType.UNBUFFERED,
+}
+_INT_BY_EXCHANGE = {v: k for k, v in _EXCHANGE_BY_INT.items()}
+
+
+def _pallas_mode(use_pallas: int):
+    """SpfftTpuPallasMode -> the Python use_pallas tri-state."""
+    if use_pallas not in (-1, 0, 1):
+        raise InvalidParameterError(f"bad pallas mode {use_pallas}")
+    return None if use_pallas == -1 else bool(use_pallas)
+
+
 def _check_create_enums(transform_type: int, precision: int) -> None:
     if transform_type not in (0, 1):
         raise InvalidParameterError(f"bad transform type {transform_type}")
@@ -89,7 +108,8 @@ def _check_create_enums(transform_type: int, precision: int) -> None:
 
 @_guarded
 def plan_create(transform_type: int, dim_x: int, dim_y: int, dim_z: int,
-                num_values: int, triplets_addr: int, precision: int) -> int:
+                num_values: int, triplets_addr: int, precision: int,
+                use_pallas: int) -> int:
     _check_create_enums(transform_type, precision)
     if num_values < 0:
         raise InvalidParameterError(f"negative num_values {num_values}")
@@ -102,7 +122,8 @@ def plan_create(transform_type: int, dim_x: int, dim_y: int, dim_z: int,
     plan = make_local_plan(
         TransformType.C2C if transform_type == 0 else TransformType.R2C,
         dim_x, dim_y, dim_z, trip,
-        precision="single" if precision == 0 else "double")
+        precision="single" if precision == 0 else "double",
+        use_pallas=_pallas_mode(use_pallas))
     pid = next(_next_id)
     _plans[pid] = plan
     return pid
@@ -112,12 +133,16 @@ def plan_create(transform_type: int, dim_x: int, dim_y: int, dim_z: int,
 def plan_create_distributed(transform_type: int, dim_x: int, dim_y: int,
                             dim_z: int, num_shards: int, vps_addr: int,
                             triplets_addr: int, pps_addr: int,
-                            precision: int) -> int:
+                            precision: int, exchange_type: int,
+                            use_pallas: int) -> int:
     """Distributed plan over num_shards local devices (reference:
-    spfft_grid_create_distributed, grid.h — communicator -> device mesh)."""
+    spfft_grid_create_distributed, grid.h — communicator -> device mesh;
+    exchange_type is the reference's distributed-grid exchangeType)."""
     from .parallel import make_distributed_plan, make_mesh
 
     _check_create_enums(transform_type, precision)
+    if exchange_type not in _EXCHANGE_BY_INT:
+        raise InvalidParameterError(f"bad exchange type {exchange_type}")
     vps = np.array(np.ctypeslib.as_array(
         ctypes.cast(vps_addr, ctypes.POINTER(ctypes.c_longlong)),
         shape=(num_shards,)), np.int64, copy=True)
@@ -139,7 +164,9 @@ def plan_create_distributed(transform_type: int, dim_x: int, dim_y: int,
         TransformType.C2C if transform_type == 0 else TransformType.R2C,
         dim_x, dim_y, dim_z, per_shard, [int(p) for p in pps],
         mesh=make_mesh(num_shards),
-        precision="single" if precision == 0 else "double")
+        precision="single" if precision == 0 else "double",
+        exchange=_EXCHANGE_BY_INT[exchange_type],
+        use_pallas=_pallas_mode(use_pallas))
     pid = next(_next_id)
     _plans[pid] = plan
     return pid
@@ -274,16 +301,143 @@ def execute_pair(pid: int, values_in_addr: int, scaling: int,
           plan.precision)[:] = out.reshape(-1)
 
 
+def _read_addr_array(addr: int, n: int) -> list:
+    """n pointer-sized entries of a caller array (plan handles or buffer
+    addresses)."""
+    ptr = ctypes.cast(addr, ctypes.POINTER(ctypes.c_void_p))
+    return [int(ptr[i] or 0) for i in range(n)]
+
+
+def _multi_io(pid_handles: list):
+    """Resolve plan handles; error early on nulls/unknowns."""
+    return [_get_plan(h) for h in pid_handles]
+
+
 @_guarded
-def plan_info(pid: int, what: int) -> int:
+def multi_backward(n: int, plans_addr: int, values_addr: int,
+                   spaces_addr: int) -> None:
+    """Batched backward over n transforms (reference:
+    spfft_multi_transform_backward, multi_transform.h:37-54). All same
+    handle -> ONE fused device program via backward_batched; mixed handles
+    dispatch every transform before the first host synchronisation (the
+    reference's overlap schedule, realised by XLA async dispatch)."""
+    handles = _read_addr_array(plans_addr, n)
+    vaddrs = _read_addr_array(values_addr, n)
+    saddrs = _read_addr_array(spaces_addr, n)
+    plans = _multi_io(handles)
+    if len(set(handles)) == 1 and not _is_distributed(plans[0]):
+        plan, p = plans[0], plans[0].index_plan
+        vals = [_view(a, 2 * p.num_values, plan.precision)
+                .reshape(p.num_values, 2).copy() for a in vaddrs]
+        batch = np.asarray(plan.backward_batched(vals))
+        width = 1 if p.hermitian else 2
+        n_space = p.dim_z * p.dim_y * p.dim_x * width
+        for i, a in enumerate(saddrs):
+            _view(a, n_space, plan.precision)[:] = batch[i].reshape(-1)
+        return
+    outs = []
+    for plan, va in zip(plans, vaddrs):
+        if _is_distributed(plan):
+            outs.append(None)  # handled below; dist path syncs internally
+        else:
+            p = plan.index_plan
+            v = _view(va, 2 * p.num_values,
+                      plan.precision).reshape(p.num_values, 2)
+            outs.append(plan.backward(v.copy()))  # async dispatch
+    for plan, va, sa, out in zip(plans, vaddrs, saddrs, outs):
+        if _is_distributed(plan):
+            _dist_backward(plan, va, sa)
+        else:
+            p = plan.index_plan
+            width = 1 if p.hermitian else 2
+            n_space = p.dim_z * p.dim_y * p.dim_x * width
+            _view(sa, n_space,
+                  plan.precision)[:] = np.asarray(out).reshape(-1)
+
+
+@_guarded
+def multi_forward(n: int, plans_addr: int, spaces_addr: int, scaling: int,
+                  values_addr: int) -> None:
+    """Batched forward over n transforms (reference:
+    spfft_multi_transform_forward, multi_transform.h:56-72)."""
+    if scaling not in (0, 1):
+        raise InvalidParameterError(f"bad scaling {scaling}")
+    sc = Scaling.FULL if scaling == 1 else Scaling.NONE
+    handles = _read_addr_array(plans_addr, n)
+    saddrs = _read_addr_array(spaces_addr, n)
+    vaddrs = _read_addr_array(values_addr, n)
+    plans = _multi_io(handles)
+    if len(set(handles)) == 1 and not _is_distributed(plans[0]):
+        plan, p = plans[0], plans[0].index_plan
+        width = 1 if p.hermitian else 2
+        n_space = p.dim_z * p.dim_y * p.dim_x * width
+        shape = (p.dim_z, p.dim_y, p.dim_x) + (() if p.hermitian else (2,))
+        slabs = [_view(a, n_space, plan.precision).copy().reshape(shape)
+                 for a in saddrs]
+        batch = plan.forward_batched(slabs, sc)
+        rows = np.asarray(batch)
+        if getattr(plan, "pair_values_io", False) and rows.shape[1] == 2:
+            rows = np.swapaxes(rows, 1, 2)
+        for i, a in enumerate(vaddrs):
+            _view(a, 2 * p.num_values,
+                  plan.precision)[:] = np.ascontiguousarray(
+                      rows[i]).reshape(-1)
+        return
+    outs = []
+    for plan, sa in zip(plans, saddrs):
+        if _is_distributed(plan):
+            outs.append(None)
+        else:
+            p = plan.index_plan
+            width = 1 if p.hermitian else 2
+            n_space = p.dim_z * p.dim_y * p.dim_x * width
+            shape = (p.dim_z, p.dim_y, p.dim_x) + \
+                (() if p.hermitian else (2,))
+            slab = _view(sa, n_space, plan.precision).copy().reshape(shape)
+            outs.append(plan.forward(slab, sc))  # async dispatch
+    for plan, sa, va, out in zip(plans, saddrs, vaddrs, outs):
+        if _is_distributed(plan):
+            _dist_forward(plan, sa, scaling, va)
+        else:
+            p = plan.index_plan
+            rows = _values_rows(plan, out)
+            _view(va, 2 * p.num_values,
+                  plan.precision)[:] = rows.reshape(-1)
+
+
+@_guarded
+def plan_info(pid: int, what: int, shard: int = 0) -> int:
     plan = _get_plan(pid)
     if _is_distributed(plan):
         dp = plan.dist_plan
-        return {0: dp.dim_x, 1: dp.dim_y, 2: dp.dim_z,
+        num_shards = dp.num_shards
+        base = {0: dp.dim_x, 1: dp.dim_y, 2: dp.dim_z,
                 3: dp.num_global_elements,
                 4: 0 if dp.transform_type == TransformType.C2C else 1,
-                5: dp.num_shards}[what]
+                5: num_shards,
+                6: dp.dim_x * dp.dim_y * dp.dim_z,
+                7: dp.num_global_elements,
+                12: _INT_BY_EXCHANGE[plan.exchange],
+                13: int(plan._pallas_dist is not None)}
+        if what in base:
+            return base[what]
+        if not 0 <= shard < num_shards:
+            raise InvalidParameterError(
+                f"shard {shard} out of range [0, {num_shards})")
+        return {8: int(dp.plane_offsets[shard]),
+                9: int(dp.num_planes[shard]),
+                10: dp.dim_x * dp.dim_y * int(dp.num_planes[shard]),
+                11: dp.shard_plans[shard].num_values}[what]
     p = plan.index_plan
-    return {0: p.dim_x, 1: p.dim_y, 2: p.dim_z, 3: p.num_values,
+    base = {0: p.dim_x, 1: p.dim_y, 2: p.dim_z, 3: p.num_values,
             4: 0 if p.transform_type == TransformType.C2C else 1,
-            5: 1}[what]
+            5: 1, 6: p.dim_x * p.dim_y * p.dim_z, 7: p.num_values,
+            12: _INT_BY_EXCHANGE[ExchangeType.DEFAULT],
+            13: int(plan.pallas_active)}
+    if what in base:
+        return base[what]
+    if shard != 0:
+        raise InvalidParameterError(
+            f"shard {shard} out of range [0, 1) for a local plan")
+    return {8: 0, 9: p.dim_z, 10: p.dim_x * p.dim_y * p.dim_z,
+            11: p.num_values}[what]

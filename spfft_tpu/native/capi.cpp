@@ -146,7 +146,8 @@ int spfft_tpu_init(const char* package_path) {
 
 int spfft_tpu_plan_create(void** plan, int transform_type, int dim_x,
                           int dim_y, int dim_z, long long num_values,
-                          const int* index_triplets, int precision) {
+                          const int* index_triplets, int precision,
+                          int use_pallas) {
   if (plan == nullptr || (index_triplets == nullptr && num_values > 0)) {
     return kInvalidParameter;
   }
@@ -155,7 +156,7 @@ int spfft_tpu_plan_create(void** plan, int transform_type, int dim_x,
       "plan_create",
       {transform_type, dim_x, dim_y, dim_z, num_values,
        static_cast<long long>(reinterpret_cast<intptr_t>(index_triplets)),
-       precision},
+       precision, use_pallas},
       &pid);
   if (code == kSuccess) *plan = id_to_handle(pid);
   return code;
@@ -167,7 +168,8 @@ int spfft_tpu_plan_create_distributed(void** plan, int transform_type,
                                       const long long* values_per_shard,
                                       const int* index_triplets,
                                       const int* planes_per_shard,
-                                      int precision) {
+                                      int precision, int exchange_type,
+                                      int use_pallas) {
   if (plan == nullptr || values_per_shard == nullptr ||
       planes_per_shard == nullptr || num_shards < 1) {
     return kInvalidParameter;
@@ -182,10 +184,52 @@ int spfft_tpu_plan_create_distributed(void** plan, int transform_type,
        static_cast<long long>(reinterpret_cast<intptr_t>(values_per_shard)),
        static_cast<long long>(reinterpret_cast<intptr_t>(index_triplets)),
        static_cast<long long>(reinterpret_cast<intptr_t>(planes_per_shard)),
-       precision},
+       precision, exchange_type, use_pallas},
       &pid);
   if (code == kSuccess) *plan = id_to_handle(pid);
   return code;
+}
+
+int spfft_tpu_multi_backward(int num_transforms, void* const* plans,
+                             const void* const* values,
+                             void* const* spaces) {
+  if (num_transforms < 1 || plans == nullptr || values == nullptr ||
+      spaces == nullptr) {
+    return kInvalidParameter;
+  }
+  for (int i = 0; i < num_transforms; ++i) {
+    if (values[i] == nullptr || spaces[i] == nullptr) {
+      return kInvalidParameter;
+    }
+  }
+  return call_bridge(
+      "multi_backward",
+      {num_transforms,
+       static_cast<long long>(reinterpret_cast<intptr_t>(plans)),
+       static_cast<long long>(reinterpret_cast<intptr_t>(values)),
+       static_cast<long long>(reinterpret_cast<intptr_t>(spaces))},
+      nullptr);
+}
+
+int spfft_tpu_multi_forward(int num_transforms, void* const* plans,
+                            const void* const* spaces, int scaling,
+                            void* const* values) {
+  if (num_transforms < 1 || plans == nullptr || values == nullptr ||
+      spaces == nullptr) {
+    return kInvalidParameter;
+  }
+  for (int i = 0; i < num_transforms; ++i) {
+    if (values[i] == nullptr || spaces[i] == nullptr) {
+      return kInvalidParameter;
+    }
+  }
+  return call_bridge(
+      "multi_forward",
+      {num_transforms,
+       static_cast<long long>(reinterpret_cast<intptr_t>(plans)),
+       static_cast<long long>(reinterpret_cast<intptr_t>(spaces)), scaling,
+       static_cast<long long>(reinterpret_cast<intptr_t>(values))},
+      nullptr);
 }
 
 int spfft_tpu_plan_destroy(void* plan) {
@@ -224,9 +268,19 @@ int spfft_tpu_execute_pair(void* plan, const void* values_in, int scaling,
       nullptr);
 }
 
-static int plan_info(void* plan, int what, long long* out) {
+static int plan_info(void* plan, int what, long long* out,
+                     long long shard = 0) {
   if (out == nullptr) return kInvalidParameter;
-  return call_bridge("plan_info", {handle_to_id(plan), what}, out);
+  return call_bridge("plan_info", {handle_to_id(plan), what, shard}, out);
+}
+
+static int plan_info_int(void* plan, int what, int* out,
+                         long long shard = 0) {
+  if (out == nullptr) return kInvalidParameter;
+  long long v = 0;
+  int code = plan_info(plan, what, &v, shard);
+  if (code == kSuccess) *out = static_cast<int>(v);
+  return code;
 }
 
 int spfft_tpu_plan_dim_x(void* plan, int* out) {
@@ -271,6 +325,39 @@ int spfft_tpu_plan_num_shards(void* plan, int* out) {
   int code = plan_info(plan, 5, &v);
   if (code == kSuccess) *out = static_cast<int>(v);
   return code;
+}
+
+int spfft_tpu_plan_global_size(void* plan, long long* out) {
+  return plan_info(plan, 6, out);
+}
+
+int spfft_tpu_plan_num_global_elements(void* plan, long long* out) {
+  return plan_info(plan, 7, out);
+}
+
+int spfft_tpu_plan_local_z_offset(void* plan, int shard, int* out) {
+  return plan_info_int(plan, 8, out, shard);
+}
+
+int spfft_tpu_plan_local_z_length(void* plan, int shard, int* out) {
+  return plan_info_int(plan, 9, out, shard);
+}
+
+int spfft_tpu_plan_local_slice_size(void* plan, int shard, long long* out) {
+  return plan_info(plan, 10, out, shard);
+}
+
+int spfft_tpu_plan_num_local_elements(void* plan, int shard,
+                                      long long* out) {
+  return plan_info(plan, 11, out, shard);
+}
+
+int spfft_tpu_plan_exchange_type(void* plan, int* out) {
+  return plan_info_int(plan, 12, out);
+}
+
+int spfft_tpu_plan_pallas_active(void* plan, int* out) {
+  return plan_info_int(plan, 13, out);
 }
 
 const char* spfft_tpu_error_string(int code) {

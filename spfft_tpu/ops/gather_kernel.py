@@ -60,6 +60,11 @@ _ROW_SHIFT = _LANE_BITS
 _VALID_SHIFT = 20
 _ROW_MASK = (1 << (_VALID_SHIFT - _ROW_SHIFT)) - 1
 
+# Wide-kernel packed-word layout (int16): lane 0-6, row-in-subwindow 7-11,
+# valid 12. kp_rows <= 32 so the row field needs only 5 bits.
+_W_VALID_SHIFT = 12
+_W_ROW_MASK = (1 << (_W_VALID_SHIFT - _ROW_SHIFT)) - 1
+
 
 #: Max chunks per kernel launch: the three scalar-prefetch tables live in
 #: SMEM (1 MB on v5e); 3 arrays x 4 B x 70k = 840 KB leaves headroom for
@@ -255,8 +260,10 @@ class WideGatherTables:
                           # byte-packed little-endian, relative to row0
     out_tile: np.ndarray  # (C,) int32 — output super-tile index
     first: np.ndarray     # (C,) int32 — 1 on a super-tile's first chunk
-    packed: np.ndarray    # (C, P, 8, 128) int32 — lane | row-in-sub << 7
-                          #  | valid << 20
+    packed: np.ndarray    # (C, P*8, 128) int16 — lane | row-in-sub << 7
+                          #  | valid << 12 (kp <= 32 keeps row in 5 bits;
+                          # int16 halves table upload + streaming traffic,
+                          # and P*8 sublanes align to the 16-row int16 tile)
     num_out: int          # valid output slots
     num_super: int        # G_s: super-tiles
     src_rows: int         # padded source rows
@@ -285,6 +292,10 @@ def build_wide_gather_tables(idx: np.ndarray, valid: np.ndarray,
     P = int(p_tiles)
     if P % 4 != 0:
         raise ValueError("p_tiles must be a multiple of 4 (byte packing)")
+    if kp_rows and not 0 < int(kp_rows) <= _W_ROW_MASK + 1:
+        raise ValueError(
+            f"kp_rows must be in [1, {_W_ROW_MASK + 1}] — the packed "
+            f"word's row field is {_W_VALID_SHIFT - _ROW_SHIFT} bits")
     SUPER = P * TILE
     idx = np.asarray(idx, np.int64)
     G_s = -(-L // SUPER)
@@ -355,10 +366,10 @@ def build_wide_gather_tables(idx: np.ndarray, valid: np.ndarray,
         sub_rel = np.clip(basec - r0[:, None], 0, K - kp).astype(np.int32)
         rin = np.clip(ar - basec[:, :, None], 0, kp - 1)
         packed = (al | (rin << _ROW_SHIFT)
-                  | (cover.astype(np.int32) << _VALID_SHIFT))
+                  | (cover.astype(np.int32) << _W_VALID_SHIFT))
         r0s.append(r0)
         subs.append(sub_rel)
-        packs.append(packed.astype(np.int32))
+        packs.append(packed.astype(np.int16))
         sts.append(a.astype(np.int32))
         rds.append(np.full(len(a), rounds, np.int32))
         uncovered[a] = av & ~cover
@@ -388,7 +399,7 @@ def build_wide_gather_tables(idx: np.ndarray, valid: np.ndarray,
         return None
     return WideGatherTables(
         row0=row0, sub=words, out_tile=st_o, first=first,
-        packed=packed_o.reshape(C, P, TILE_SUB, TILE_LANE),
+        packed=packed_o.reshape(C, P * TILE_SUB, TILE_LANE),
         num_out=L, num_super=G_s, src_rows=src_rows, span_rows=K,
         kp_rows=kp, p_tiles=P, segs=segs)
 
@@ -715,17 +726,18 @@ def pad_wide_tables_to(t: WideGatherTables, c_max: int):
     first = np.concatenate(
         [t.first, np.ones(1, np.int32), np.zeros(pad - 1, np.int32)])
     packed = np.concatenate(
-        [t.packed, np.zeros((pad, P, TILE_SUB, TILE_LANE), np.int32)])
+        [t.packed,
+         np.zeros((pad, P * TILE_SUB, TILE_LANE), np.int16)])
     return row0, sub, out_tile, first, packed
 
 
 def _wide_tile_compute(kp: int, t, win_re, win_im):
-    """Per-tile compute of the wide kernel: decode one tile's packed block,
-    gather kp candidate rows from its (kp, 128) sub-window, select-
-    accumulate."""
+    """Per-tile compute of the wide kernel: decode one tile's packed block
+    (already widened to int32), gather kp candidate rows from its
+    (kp, 128) sub-window, select-accumulate."""
     lane = t & (TILE_LANE - 1)
-    row = (t >> _ROW_SHIFT) & _ROW_MASK
-    m = (t >> _VALID_SHIFT).astype(jnp.float32)
+    row = (t >> _ROW_SHIFT) & _W_ROW_MASK
+    m = (t >> _W_VALID_SHIFT).astype(jnp.float32)
     acc_re = jnp.zeros((TILE_SUB, TILE_LANE), jnp.float32)
     acc_im = jnp.zeros((TILE_SUB, TILE_LANE), jnp.float32)
     for k in range(kp):
@@ -741,14 +753,16 @@ def _wide_step(kp: int, P: int, sub_ref, g, packed_blk, sc, slot, write):
     """Shared per-step body of the wide kernels: decode each tile's byte-
     packed sub-window offset, slice its (kp, 128) sub-window out of the
     DMA'd window, compute, and hand (p, acc_re, acc_im) to ``write`` for
-    the output store."""
+    the output store. The int16 packed block is loaded and widened ONCE
+    per step; per-tile rows are register slices."""
+    t_all = packed_blk[...].astype(jnp.int32)        # (P*8, 128)
     for p in range(P):
         word = sub_ref[g, p // 4]
         sub = (word >> (8 * (p % 4))) & 0xFF
         win_re = sc[slot, 0, pl.ds(sub, kp), :]
         win_im = sc[slot, 1, pl.ds(sub, kp), :]
-        acc_re, acc_im = _wide_tile_compute(kp, packed_blk[p],
-                                            win_re, win_im)
+        t = t_all[p * TILE_SUB:(p + 1) * TILE_SUB]
+        acc_re, acc_im = _wide_tile_compute(kp, t, win_re, win_im)
         write(p, acc_re, acc_im)
 
 
@@ -912,8 +926,8 @@ def _wide_gather_call(re, im, row0, sub, out_tile, first, packed, *,
             num_scalar_prefetch=4,  # row0, sub, out_tile, first
             grid=(B, C),
             in_specs=[
-                pl.BlockSpec((1, P, TILE_SUB, TILE_LANE),
-                             lambda b, g, r0, sb, ot, fs: (g, 0, 0, 0)),
+                pl.BlockSpec((1, P * TILE_SUB, TILE_LANE),
+                             lambda b, g, r0, sb, ot, fs: (g, 0, 0)),
                 pl.BlockSpec(memory_space=pl.ANY),
                 pl.BlockSpec(memory_space=pl.ANY),
             ],
@@ -938,8 +952,8 @@ def _wide_gather_call(re, im, row0, sub, out_tile, first, packed, *,
         num_scalar_prefetch=4,  # row0, sub, out_tile, first
         grid=(C,),
         in_specs=[
-            pl.BlockSpec((1, P, TILE_SUB, TILE_LANE),
-                         lambda g, r0, sb, ot, fs: (g, 0, 0, 0)),
+            pl.BlockSpec((1, P * TILE_SUB, TILE_LANE),
+                         lambda g, r0, sb, ot, fs: (g, 0, 0)),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
         ],

@@ -1,0 +1,160 @@
+/*
+ * C-API feature drive: exchange-type selection, pallas routing knob,
+ * extended getter surface, and batched multi-transform execution — the
+ * round-3 parity additions (reference: spfft_grid_create_distributed's
+ * exchangeType parameter, grid.h:60-118; spfft_multi_transform_*,
+ * multi_transform.h:37-72; the transform.h:84-245 getter set).
+ *
+ * Compiled and run by tests/test_capi.py::test_c_feature_drive. Prints
+ * "OK" and exits 0 on success.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <math.h>
+
+#include "spfft_tpu.h"
+
+#define CHECK(expr)                                                       \
+  do {                                                                    \
+    int _c = (expr);                                                      \
+    if (_c != SPFFT_TPU_SUCCESS) {                                        \
+      fprintf(stderr, "%s failed: %s (%d)\n", #expr,                      \
+              spfft_tpu_error_string(_c), _c);                            \
+      return 1;                                                           \
+    }                                                                     \
+  } while (0)
+
+#define DIM 8
+#define SHARDS 4
+#define BATCH 3
+
+int main(void) {
+  CHECK(spfft_tpu_init(getenv("SPFFT_TPU_PACKAGE_PATH")));
+
+  /* Dense stick set, split round-robin by stick id over SHARDS shards. */
+  static int triplets[DIM * DIM * DIM][3];
+  long long vps[SHARDS] = {0, 0, 0, 0};
+  int pps[SHARDS];
+  int n = 0;
+  for (int r = 0; r < SHARDS; ++r) {
+    for (int x = 0; x < DIM; ++x) {
+      for (int y = 0; y < DIM; ++y) {
+        if ((x * DIM + y) % SHARDS != r) continue;
+        for (int z = 0; z < DIM; ++z) {
+          triplets[n][0] = x;
+          triplets[n][1] = y;
+          triplets[n][2] = z;
+          ++n;
+        }
+        vps[r] += DIM;
+      }
+    }
+    pps[r] = DIM / SHARDS;
+  }
+
+  /* Distributed plan on the COMPACT_BUFFERED (Alltoallv-analogue)
+   * exchange, auto pallas routing. */
+  SpfftTpuPlan dplan = NULL;
+  CHECK(spfft_tpu_plan_create_distributed(
+      &dplan, SPFFT_TPU_TRANS_C2C, DIM, DIM, DIM, SHARDS, vps,
+      &triplets[0][0], pps, SPFFT_TPU_PREC_SINGLE,
+      SPFFT_TPU_EXCH_COMPACT_BUFFERED, SPFFT_TPU_PALLAS_AUTO));
+
+  int exch = -1;
+  CHECK(spfft_tpu_plan_exchange_type(dplan, &exch));
+  if (exch != SPFFT_TPU_EXCH_COMPACT_BUFFERED) {
+    fprintf(stderr, "exchange getter: got %d\n", exch);
+    return 1;
+  }
+  long long gsize = 0, gelem = 0;
+  CHECK(spfft_tpu_plan_global_size(dplan, &gsize));
+  CHECK(spfft_tpu_plan_num_global_elements(dplan, &gelem));
+  if (gsize != (long long)DIM * DIM * DIM || gelem != n) {
+    fprintf(stderr, "global getters: %lld %lld\n", gsize, gelem);
+    return 1;
+  }
+  int z_total = 0;
+  long long elem_total = 0;
+  for (int r = 0; r < SHARDS; ++r) {
+    int off = -1, len = -1;
+    long long slice = 0, elems = 0;
+    CHECK(spfft_tpu_plan_local_z_offset(dplan, r, &off));
+    CHECK(spfft_tpu_plan_local_z_length(dplan, r, &len));
+    CHECK(spfft_tpu_plan_local_slice_size(dplan, r, &slice));
+    CHECK(spfft_tpu_plan_num_local_elements(dplan, r, &elems));
+    if (off != z_total || len != pps[r] ||
+        slice != (long long)DIM * DIM * len || elems != vps[r]) {
+      fprintf(stderr, "shard %d getters: off=%d len=%d slice=%lld "
+              "elems=%lld\n", r, off, len, slice, elems);
+      return 1;
+    }
+    z_total += len;
+    elem_total += elems;
+  }
+  if (z_total != DIM || elem_total != n) return 1;
+  /* out-of-range shard -> invalid parameter */
+  int dummy;
+  if (spfft_tpu_plan_local_z_offset(dplan, SHARDS, &dummy) !=
+      SPFFT_TPU_INVALID_PARAMETER_ERROR) {
+    fprintf(stderr, "shard range check missing\n");
+    return 1;
+  }
+
+  /* Fused pair on the compact plan: identity under FULL scaling. */
+  static float vals[DIM * DIM * DIM][2];
+  static float out[DIM * DIM * DIM][2];
+  for (int i = 0; i < n; ++i) {
+    vals[i][0] = sinf(0.1f * i) * 0.5f;
+    vals[i][1] = cosf(0.2f * i) * 0.5f;
+  }
+  CHECK(spfft_tpu_execute_pair(dplan, vals, SPFFT_TPU_FULL_SCALING, out));
+  for (int i = 0; i < n; ++i) {
+    if (fabsf(out[i][0] - vals[i][0]) > 1e-4f ||
+        fabsf(out[i][1] - vals[i][1]) > 1e-4f) {
+      fprintf(stderr, "compact pair mismatch at %d\n", i);
+      return 1;
+    }
+  }
+  CHECK(spfft_tpu_plan_destroy(dplan));
+
+  /* Batched execution: BATCH value sets through ONE local plan handle
+   * (fused batch), backward then forward, identity check. */
+  SpfftTpuPlan lplan = NULL;
+  CHECK(spfft_tpu_plan_create(&lplan, SPFFT_TPU_TRANS_C2C, DIM, DIM, DIM,
+                              n, &triplets[0][0], SPFFT_TPU_PREC_SINGLE,
+                              SPFFT_TPU_PALLAS_AUTO));
+  int pallas = -1;
+  CHECK(spfft_tpu_plan_pallas_active(lplan, &pallas));
+  if (pallas != 0 && pallas != 1) return 1;
+
+  static float bvals[BATCH][DIM * DIM * DIM][2];
+  static float bspace[BATCH][DIM * DIM * DIM][2];
+  static float bout[BATCH][DIM * DIM * DIM][2];
+  for (int b = 0; b < BATCH; ++b) {
+    for (int i = 0; i < n; ++i) {
+      bvals[b][i][0] = sinf(0.05f * (i + 7 * b));
+      bvals[b][i][1] = cosf(0.03f * (i + 11 * b));
+    }
+  }
+  SpfftTpuPlan plans[BATCH] = {lplan, lplan, lplan};
+  const void* vptrs[BATCH] = {bvals[0], bvals[1], bvals[2]};
+  void* sptrs[BATCH] = {bspace[0], bspace[1], bspace[2]};
+  const void* csptrs[BATCH] = {bspace[0], bspace[1], bspace[2]};
+  void* optrs[BATCH] = {bout[0], bout[1], bout[2]};
+  CHECK(spfft_tpu_multi_backward(BATCH, plans, vptrs, sptrs));
+  CHECK(spfft_tpu_multi_forward(BATCH, plans, csptrs,
+                                SPFFT_TPU_FULL_SCALING, optrs));
+  for (int b = 0; b < BATCH; ++b) {
+    for (int i = 0; i < n; ++i) {
+      if (fabsf(bout[b][i][0] - bvals[b][i][0]) > 1e-4f ||
+          fabsf(bout[b][i][1] - bvals[b][i][1]) > 1e-4f) {
+        fprintf(stderr, "batch %d mismatch at %d\n", b, i);
+        return 1;
+      }
+    }
+  }
+  CHECK(spfft_tpu_plan_destroy(lplan));
+
+  printf("OK\n");
+  return 0;
+}

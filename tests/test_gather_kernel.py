@@ -375,8 +375,13 @@ def test_wide_forced_geometry_rebuild():
     src = rng.random((M, 2)).astype(np.float32)
     t0 = gk.build_wide_gather_tables(idx, np.ones(len(idx), bool), M)
     t1 = gk.build_wide_gather_tables(idx, np.ones(len(idx), bool), M,
-                                     kp_rows=t0.kp_rows + 4,
+                                     kp_rows=min(t0.kp_rows + 4, 32),
                                      k_rows=t0.span_rows + 8)
+    # out-of-range forced kp is rejected, not silently overflowed into the
+    # packed word's valid bit
+    with pytest.raises(ValueError):
+        gk.build_wide_gather_tables(idx, np.ones(len(idx), bool), M,
+                                    kp_rows=40)
     out = np.asarray(gk.run_gather_values(jnp.asarray(src, jnp.float32),
                                           t1, interpret=True))
     np.testing.assert_array_equal(out, src[idx])
