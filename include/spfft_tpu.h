@@ -182,10 +182,12 @@ int spfft_tpu_execute_pair(SpfftTpuPlan plan, const void* values_in,
  * spfft_multi_transform_backward / _forward, multi_transform.h:37-72).
  * plans/values/spaces are arrays of num_transforms entries; buffer layouts
  * per entry are exactly those of spfft_tpu_backward / spfft_tpu_forward.
- * Passing the SAME plan handle for every entry executes the batch as one
- * fused device program (the TPU-native form of the reference's
- * comm/compute overlap schedule); distinct handles dispatch all transforms
- * before any synchronisation.
+ * Passing the SAME plan handle for every entry (local or distributed)
+ * executes the batch as one fused device program (the TPU-native form of
+ * the reference's comm/compute overlap schedule). Distinct handles
+ * dispatch every local transform before the first synchronisation;
+ * distinct DISTRIBUTED handles synchronise per transform (their
+ * host-side marshalling is inherently synchronous).
  */
 int spfft_tpu_multi_backward(int num_transforms, const SpfftTpuPlan* plans,
                              const void* const* values, void* const* spaces);

@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Measured accuracy matrix: relative l2 of the on-device single-precision
+backward transform vs a dense float64 oracle (pocketfft), across grid
+sizes, C2C/R2C, and centered/positive indexing.
+
+The reference's accuracy contract is 1e-6 absolute against dense FFTW with
+unit-magnitude values (reference: tests/test_util/test_check_values.hpp:
+46-50); its default precision is f64 end-to-end. TPU f64 is emulated, so
+this framework's on-device path is f32 — this matrix documents where that
+meets the 1e-6 bar (docs/precision.md records the results; the CPU backend
+with precision="double" reproduces the reference's f64 contract exactly).
+
+Usage: DIMS="64 128 256" python scripts/precision_matrix.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def rel_l2(got, want):
+    return float(np.linalg.norm((got - want).ravel())
+                 / np.linalg.norm(want.ravel()))
+
+
+def measure(n: int, transform: str, centered: bool) -> float:
+    from scipy import fft as sfft
+    from spfft_tpu import TransformType, make_local_plan
+    from spfft_tpu.utils.workloads import spherical_cutoff_triplets
+
+    tt = TransformType.C2C if transform == "c2c" else TransformType.R2C
+    trip = spherical_cutoff_triplets(n)
+    if tt is TransformType.R2C:
+        x, y, z = trip[:, 0], trip[:, 1], trip[:, 2]
+        half = (x > 0) | ((x == 0) & ((y > 0) | ((y == 0) & (z >= 0))))
+        trip = trip[half]
+    if not centered:
+        trip = trip % n
+    rng = np.random.default_rng(7)
+    vals = (rng.uniform(-1, 1, len(trip))
+            + 1j * rng.uniform(-1, 1, len(trip)))
+    cube = np.zeros((n, n, n), np.complex128)
+    st = np.where(trip < 0, trip + n, trip)
+    cube[st[:, 2], st[:, 1], st[:, 0]] = vals
+    if tt is TransformType.R2C:
+        # mirror the hermitian half so the oracle backward is real
+        mz, my, mx = [(-st[:, i]) % n for i in (2, 1, 0)]
+        cube[mz, my, mx] = np.conj(vals)
+        zero_self = (st[:, 2] == mz) & (st[:, 1] == my) & (st[:, 0] == mx)
+        cube[st[zero_self, 2], st[zero_self, 1], st[zero_self, 0]] = \
+            vals[zero_self].real
+        vals = cube[st[:, 2], st[:, 1], st[:, 0]]
+    oracle = sfft.ifftn(cube, workers=-1) * cube.size
+    plan = make_local_plan(tt, n, n, n, trip, precision="single")
+    got = np.asarray(plan.backward(vals.astype(np.complex64)))
+    if tt is TransformType.C2C:
+        got = got[..., 0] + 1j * got[..., 1]
+        return rel_l2(got, oracle)
+    return rel_l2(got, oracle.real)
+
+
+def main():
+    dims = [int(d) for d in os.environ.get("DIMS", "64 128 256").split()]
+    print(f"{'dim':>5} {'transform':>9} {'indexing':>9} {'rel_l2':>10} "
+          f"{'<=1e-6':>7}", flush=True)
+    worst = 0.0
+    for n in dims:
+        # centered vs positive indexing measured bit-identical at 64-128
+        # (same arithmetic, different storage labels) — large dims run
+        # centered only to keep the f64 oracle cost bounded
+        indexings = (False, True) if n <= 128 else (True,)
+        transforms = os.environ.get("TRANSFORMS", "c2c r2c").split()
+        for transform in transforms:
+            for centered in indexings:
+                err = measure(n, transform, centered)
+                worst = max(worst, err)
+                print(f"{n:>5} {transform:>9} "
+                      f"{'centered' if centered else 'positive':>9} "
+                      f"{err:>10.2e} {'yes' if err <= 1e-6 else 'NO':>7}",
+                      flush=True)
+    print(f"worst: {worst:.2e}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Peak-HBM effect of input donation on the fused pair at large grids.
+
+Builds the spherical-cutoff C2C plan twice — donate_inputs False/True —
+runs the fused pair on device-resident values, and reports the device
+peak_bytes_in_use around each run (the TPU form of the reference's
+two-array in-place buffer economy, grid_internal.cpp:75-98).
+
+Usage: DIM=384 python scripts/probe_donation.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+
+from spfft_tpu import TransformType, make_local_plan
+from spfft_tpu.utils import as_interleaved
+from spfft_tpu.utils.workloads import spherical_cutoff_triplets
+
+
+def peak_mb():
+    stats = jax.devices()[0].memory_stats() or {}
+    return stats.get("peak_bytes_in_use", 0) / 1e6
+
+
+def run(n: int, donate: bool, triplets, values):
+    plan = make_local_plan(TransformType.C2C, n, n, n, triplets,
+                           precision="single", donate_inputs=donate)
+    vi = jax.device_put(plan._coerce_values(values))
+    out = plan.apply_pointwise(vi)   # compile + run (vi consumed if donate)
+    out.block_until_ready()
+    p0 = peak_mb()
+    vi2 = jax.device_put(plan._coerce_values(values))
+    t0 = time.perf_counter()
+    out = plan.apply_pointwise(vi2)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+    print(f"donate={donate}: peak {peak_mb():.0f} MB "
+          f"(pre-run {p0:.0f}), pair {dt * 1e3:.1f} ms", flush=True)
+    del out, vi2
+    return None
+
+
+def main():
+    n = int(os.environ.get("DIM", "384"))
+    triplets = spherical_cutoff_triplets(n)
+    rng = np.random.default_rng(0)
+    values = (rng.uniform(-1, 1, len(triplets))
+              + 1j * rng.uniform(-1, 1, len(triplets))).astype(np.complex64)
+    values = np.asarray(as_interleaved(values, "single"))
+    donate = os.environ.get("DONATE", "0") == "1"
+    print(f"dim={n}, values={len(triplets)}, donate={donate}", flush=True)
+    # peak_bytes_in_use is a process-lifetime high-water mark: run ONE
+    # configuration per process (drive both via the DONATE env var).
+    run(n, donate, triplets, values)
+
+
+if __name__ == "__main__":
+    main()

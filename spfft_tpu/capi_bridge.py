@@ -325,7 +325,20 @@ def multi_backward(n: int, plans_addr: int, values_addr: int,
     vaddrs = _read_addr_array(values_addr, n)
     saddrs = _read_addr_array(spaces_addr, n)
     plans = _multi_io(handles)
-    if len(set(handles)) == 1 and not _is_distributed(plans[0]):
+    if len(set(handles)) == 1 and _is_distributed(plans[0]):
+        plan, dp = plans[0], plans[0].dist_plan
+        per_b = [[v.copy() for v in _split_values_view(plan, a)]
+                 for a in vaddrs]
+        batch = np.asarray(plan.backward_batched(per_b))  # (S, B, ...)
+        width = 1 if dp.hermitian else 2
+        n_space = dp.dim_z * dp.dim_y * dp.dim_x * width
+        for b, a in enumerate(saddrs):
+            cube = np.concatenate(
+                [batch[r, b, :dp.num_planes[r]]
+                 for r in range(dp.num_shards)], axis=0)
+            _view(a, n_space, plan.precision)[:] = cube.reshape(-1)
+        return
+    if len(set(handles)) == 1:
         plan, p = plans[0], plans[0].index_plan
         vals = [_view(a, 2 * p.num_values, plan.precision)
                 .reshape(p.num_values, 2).copy() for a in vaddrs]
@@ -367,21 +380,39 @@ def multi_forward(n: int, plans_addr: int, spaces_addr: int, scaling: int,
     saddrs = _read_addr_array(spaces_addr, n)
     vaddrs = _read_addr_array(values_addr, n)
     plans = _multi_io(handles)
-    if len(set(handles)) == 1 and not _is_distributed(plans[0]):
+    if len(set(handles)) == 1 and _is_distributed(plans[0]):
+        plan, dp = plans[0], plans[0].dist_plan
+        width = 1 if dp.hermitian else 2
+        n_space = dp.dim_z * dp.dim_y * dp.dim_x * width
+        shape = (dp.dim_z, dp.dim_y, dp.dim_x) + \
+            (() if dp.hermitian else (2,))
+        per_b = []
+        for a in saddrs:
+            cube = _view(a, n_space, plan.precision).copy().reshape(shape)
+            slabs, off = [], 0
+            for np_ in dp.num_planes:
+                slabs.append(cube[off:off + np_])
+                off += np_
+            per_b.append(slabs)
+        batch = np.asarray(plan.forward_batched(per_b, sc))  # (S, B, mv, 2)
+        total = dp.num_global_elements
+        for b, a in enumerate(vaddrs):
+            out = _concat_padded_values(plan, batch[:, b])
+            _view(a, 2 * total, plan.precision)[:] = out.reshape(-1)
+        return
+    if len(set(handles)) == 1:
         plan, p = plans[0], plans[0].index_plan
         width = 1 if p.hermitian else 2
         n_space = p.dim_z * p.dim_y * p.dim_x * width
         shape = (p.dim_z, p.dim_y, p.dim_x) + (() if p.hermitian else (2,))
         slabs = [_view(a, n_space, plan.precision).copy().reshape(shape)
                  for a in saddrs]
-        batch = plan.forward_batched(slabs, sc)
-        rows = np.asarray(batch)
-        if getattr(plan, "pair_values_io", False) and rows.shape[1] == 2:
-            rows = np.swapaxes(rows, 1, 2)
+        batch = np.asarray(plan.forward_batched(slabs, sc))
         for i, a in enumerate(vaddrs):
+            rows = _values_rows(plan, batch[i])
             _view(a, 2 * p.num_values,
                   plan.precision)[:] = np.ascontiguousarray(
-                      rows[i]).reshape(-1)
+                      rows).reshape(-1)
         return
     outs = []
     for plan, sa in zip(plans, saddrs):

@@ -31,6 +31,12 @@ _lib: Optional[ctypes.CDLL] = None
 _load_failed = False
 
 
+class WideCoverBlowup(Exception):
+    """The wide-gather cover exceeded its chunk limit — the caller falls
+    back exactly where the NumPy builder returns None. A dedicated type so
+    unrelated ValueErrors are never misread as the fallback signal."""
+
+
 def _compile() -> None:
     """Compile to a temp file and rename atomically: concurrent processes
     (multi-host plan construction, pytest-xdist) may race on first use, and
@@ -56,6 +62,21 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.spfft_tpu_inverse_map.argtypes = [
         ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
         ctypes.c_int64, ctypes.c_int32]
+    lib.spfft_tpu_wide_tables_plan.restype = ctypes.c_int32
+    lib.spfft_tpu_wide_tables_plan.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p]
+    lib.spfft_tpu_compression_inputs.restype = ctypes.c_int32
+    lib.spfft_tpu_compression_inputs.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p,
+        ctypes.c_void_p]
+    lib.spfft_tpu_wide_tables_fill.restype = ctypes.c_int32
+    lib.spfft_tpu_wide_tables_fill.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int64, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p]
     return lib
 
 
@@ -125,6 +146,68 @@ def plan_indices(hermitian: bool, dim_x: int, dim_y: int, dim_z: int,
         # — let the NumPy path handle it.
         return None
     return value_indices, stick_keys[:num_sticks].copy(), bool(centered.value)
+
+
+def compression_inputs(value_indices: np.ndarray, num_slots: int):
+    """Native decompress-direction gather inputs (occupied mask +
+    forward-filled position map; see
+    ops/gather_kernel.compression_gather_inputs — the NumPy version is the
+    specification). Returns (dec_idx int64[num_slots], occupied bool) or
+    None if the native library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    vi = np.ascontiguousarray(value_indices, np.int64)
+    dec_idx = np.empty(num_slots, np.int64)
+    occupied = np.empty(num_slots, np.uint8)
+    st = lib.spfft_tpu_compression_inputs(
+        vi.ctypes.data, vi.shape[0], num_slots, dec_idx.ctypes.data,
+        occupied.ctypes.data)
+    if st != 0:
+        raise IndexError(f"value index out of range [0, {num_slots})")
+    return dec_idx, occupied.astype(bool)
+
+
+def wide_gather_tables(idx: np.ndarray, valid: np.ndarray, *,
+                       p_tiles: int, kp_rows: int, k_rows: int):
+    """Native wide-gather table build (the cover loop of
+    ops/gather_kernel.build_wide_gather_tables — its NumPy version is the
+    executable specification and the fallback).
+
+    Returns ``(row0, sub, out_tile, first, packed, kp, K, max_row0)`` or
+    None if the native library is unavailable / P != 8; raises
+    :class:`WideCoverBlowup` on a chunk-count blowup exactly where the
+    NumPy builder returns None — the caller maps that to its fallback."""
+    lib = _load()
+    if lib is None or p_tiles != 8:
+        return None
+    idx64 = np.ascontiguousarray(idx, np.int64)
+    val8 = np.ascontiguousarray(valid, np.uint8)
+    L = idx64.shape[0]
+    kp_o = ctypes.c_int32(0)
+    k_o = ctypes.c_int32(0)
+    c_o = ctypes.c_int64(0)
+    st = lib.spfft_tpu_wide_tables_plan(
+        idx64.ctypes.data, val8.ctypes.data, L, p_tiles, kp_rows, k_rows,
+        ctypes.byref(kp_o), ctypes.byref(k_o), ctypes.byref(c_o))
+    if st == -1:
+        raise WideCoverBlowup()  # caller falls back
+    if st != 0:
+        return None
+    C, kp, K = c_o.value, kp_o.value, k_o.value
+    row0 = np.empty(C, np.int32)
+    sub = np.empty((C, p_tiles // 4), np.int32)
+    out_tile = np.empty(C, np.int32)
+    first = np.empty(C, np.int32)
+    packed = np.empty((C, p_tiles * 8, 128), np.int16)
+    mx = ctypes.c_int32(0)
+    st = lib.spfft_tpu_wide_tables_fill(
+        idx64.ctypes.data, val8.ctypes.data, L, p_tiles, kp, K, C,
+        row0.ctypes.data, sub.ctypes.data, out_tile.ctypes.data,
+        first.ctypes.data, packed.ctypes.data, ctypes.byref(mx))
+    if st != 0:  # pragma: no cover - phase disagreement would be a bug
+        return None
+    return row0, sub, out_tile, first, packed, kp, K, mx.value
 
 
 def inverse_map(indices: np.ndarray, num_slots: int,

@@ -296,6 +296,10 @@ def build_wide_gather_tables(idx: np.ndarray, valid: np.ndarray,
         raise ValueError(
             f"kp_rows must be in [1, {_W_ROW_MASK + 1}] — the packed "
             f"word's row field is {_W_VALID_SHIFT - _ROW_SHIFT} bits")
+    native = _native_wide_tables(idx, valid, num_src, P, int(kp_rows),
+                                 int(k_rows), allow_segments)
+    if native is not None:
+        return None if native == "blowup" else native
     SUPER = P * TILE
     idx = np.asarray(idx, np.int64)
     G_s = -(-L // SUPER)
@@ -404,6 +408,40 @@ def build_wide_gather_tables(idx: np.ndarray, valid: np.ndarray,
         kp_rows=kp, p_tiles=P, segs=segs)
 
 
+def _native_wide_tables(idx, valid, num_src, P, kp_rows, k_rows,
+                        allow_segments):
+    """Run the C++ cover (native/planner.cpp) when available: identical
+    tables ~40x faster than the NumPy multi-round cover (which remains the
+    executable specification, the fallback, and the parity oracle —
+    tests/test_native_planner.py compares both table sets element-wise).
+    Returns a WideGatherTables, the string "blowup" (caller falls back to
+    the narrow kernel / XLA exactly as the NumPy builder's None), or None
+    when the native library is unavailable."""
+    from .. import native
+
+    try:
+        out = native.wide_gather_tables(
+            np.asarray(idx, np.int64),
+            np.asarray(valid, bool), p_tiles=P,
+            kp_rows=kp_rows, k_rows=k_rows)
+    except native.WideCoverBlowup:
+        return "blowup"
+    if out is None:
+        return None
+    row0, sub, out_tile, first, packed, kp, K, max_row0 = out
+    L = int(np.asarray(idx).shape[0])
+    G_s = -(-L // (P * TILE))
+    src_rows = max(int(max_row0) + K, -(-int(num_src) // TILE_LANE))
+    segs = _tile_aligned_segments(first, out_tile, G_s,
+                                  WIDE_SEG_CHUNK_LIMIT)
+    if segs is None or (segs and not allow_segments):
+        return "blowup"
+    return WideGatherTables(
+        row0=row0, sub=sub, out_tile=out_tile, first=first, packed=packed,
+        num_out=L, num_super=G_s, src_rows=src_rows, span_rows=K,
+        kp_rows=kp, p_tiles=P, segs=segs)
+
+
 def build_best_gather_tables(idx, valid, num_src, allow_segments=True,
                              wide: Optional[bool] = None):
     """The preferred decomposition: wide kernel tables, falling back to the
@@ -434,24 +472,35 @@ def compression_gather_inputs(value_indices, num_slots: int,
     the last occurrence, matching stages.decompress); single source of
     truth for local plan._init_pallas and the distributed per-shard tables.
     """
+    from .. import native
+
     vi = np.asarray(value_indices, np.int64)
     n = len(vi)
-    occupied = np.zeros(num_slots, bool)
-    occupied[vi] = True
-    pos = np.zeros(num_slots, np.int64)
-    pos[vi] = np.arange(n, dtype=np.int64)  # last occurrence wins
-    # forward-fill each unoccupied slot with the nearest occupied slot at or
-    # below it (leading gap: the first occupied slot), so idx stays local
-    # when the value order is; for sorted vi this reduces to the running
-    # occupied count.
-    if n:
-        filled = np.maximum.accumulate(
-            np.where(occupied, np.arange(num_slots, dtype=np.int64), -1))
-        filled = np.where(filled < 0, int(np.flatnonzero(occupied)[0]),
-                          filled)
-        dec_idx = pos[filled]
+    if n and (vi.min() < 0 or vi.max() >= num_slots):
+        # the native path rejects these; the NumPy fancy-indexing fallback
+        # would silently wrap negatives — fail identically on both
+        raise IndexError(f"value index out of range [0, {num_slots})")
+    nat = native.compression_inputs(vi, num_slots) if n else None
+    if nat is not None:
+        dec_idx, occupied = nat
     else:
-        dec_idx = np.zeros(num_slots, np.int64)
+        occupied = np.zeros(num_slots, bool)
+        occupied[vi] = True
+        pos = np.zeros(num_slots, np.int64)
+        pos[vi] = np.arange(n, dtype=np.int64)  # last occurrence wins
+        # forward-fill each unoccupied slot with the nearest occupied slot
+        # at or below it (leading gap: the first occupied slot), so idx
+        # stays local when the value order is; for sorted vi this reduces
+        # to the running occupied count.
+        if n:
+            filled = np.maximum.accumulate(
+                np.where(occupied, np.arange(num_slots, dtype=np.int64),
+                         -1))
+            filled = np.where(filled < 0, int(np.flatnonzero(occupied)[0]),
+                              filled)
+            dec_idx = pos[filled]
+        else:
+            dec_idx = np.zeros(num_slots, np.int64)
     out_n = n if pad_values_to is None else pad_values_to
     cmp_idx = np.zeros(out_n, np.int64)
     if n:

@@ -261,6 +261,21 @@ def xy_forward_c2c_split(space, x0: int, w: int):
     return jnp.fft.fft(_mat(_extract_x_window(grid, x0, w)), axis=-2)
 
 
+def _irfft_last(x, n: int):
+    """irfft along the last axis with the batch dims COLLAPSED to one.
+
+    XLA's TPU C2R silently corrupts rank-3 operands once the collapsed
+    batch exceeds ~2^16 rows (measured 2026-07-30: irfft of (256, 384, 193)
+    -> rel error 0.32, while the identical data as (98304, 193) and every
+    rank-2 batch size is exact; rfft, C2C ffts and 2D ffts are unaffected).
+    Collapsing to rank 2 is a free reshape (leading dims, row-major) and
+    sidesteps the bug for every shape this library produces.
+    """
+    batch = x.shape[:-1]
+    flat = jnp.fft.irfft(x.reshape(-1, x.shape[-1]), n=n, axis=-1)
+    return flat.reshape(batch + (n,))
+
+
 def xy_backward_r2c_split(sub, x0: int, dim_x: int, dim_x_freq: int):
     """R2C backward xy-stage on the occupied half-spectrum window
     ``[x0, x0+w)`` (no wrap — the half spectrum has no negative x): y-IFFT
@@ -272,7 +287,7 @@ def xy_backward_r2c_split(sub, x0: int, dim_x: int, dim_x_freq: int):
     rdtype = sub.real.dtype
     sub = jnp.fft.ifft(_mat(sub), axis=-2) * rdtype.type(dim_y)
     full = jnp.pad(sub, ((0, 0), (0, 0), (x0, dim_x_freq - x0 - w)))
-    return jnp.fft.irfft(_mat(full), n=dim_x, axis=-1) * rdtype.type(dim_x)
+    return _irfft_last(_mat(full), dim_x) * rdtype.type(dim_x)
 
 
 def xy_forward_r2c_split(space, x0: int, w: int):
@@ -293,7 +308,7 @@ def xy_backward_r2c(grid, dim_x: int):
     dim_y = grid.shape[-2]
     rdtype = grid.real.dtype
     grid = jnp.fft.ifft(_mat(grid), axis=-2) * rdtype.type(dim_y)
-    return jnp.fft.irfft(_mat(grid), n=dim_x, axis=-1) * rdtype.type(dim_x)
+    return _irfft_last(_mat(grid), dim_x) * rdtype.type(dim_x)
 
 
 def xy_forward_r2c(space):

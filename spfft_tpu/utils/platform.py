@@ -47,6 +47,11 @@ def enable_persistent_compilation_cache() -> None:
     try:
         if jax.config.jax_compilation_cache_dir:
             return  # user already configured a cache; leave it alone
+        if jax.default_backend() != "tpu":
+            # CPU compiles are fast, and XLA:CPU AOT cache entries embed
+            # host-feature strings that mismatch noisily across loads;
+            # the cache pays off on the TPU backend only.
+            return
         path = knob or os.path.join(
             os.path.expanduser("~"), ".cache", "spfft_tpu", "xla")
         os.makedirs(path, exist_ok=True)

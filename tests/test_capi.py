@@ -408,3 +408,45 @@ def test_ctypes_multi_entries(lib):
     assert lib.spfft_tpu_multi_backward(2, plans_arr, bad, sptr) == 5
     assert lib.spfft_tpu_plan_destroy(p1) == 0
     assert lib.spfft_tpu_plan_destroy(p2) == 0
+
+
+def test_ctypes_multi_distributed_fused(lib):
+    """Same-handle DISTRIBUTED multi entries run the fused per-shard-batch
+    SPMD program (header contract: one fused device program)."""
+    lib.spfft_tpu_multi_backward.argtypes = [
+        ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+    lib.spfft_tpu_multi_forward.argtypes = [
+        ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+        ctypes.c_void_p]
+    n, shards, B = 8, 4, 3
+    trip_all = np.array([[x, y, z] for x in range(n) for y in range(n)
+                         for z in range(n)], np.int32)
+    order = np.argsort((trip_all[:, 0] * n + trip_all[:, 1]) % shards,
+                       kind="stable")
+    trip = np.ascontiguousarray(trip_all[order])
+    vps = np.array([(((trip_all[:, 0] * n + trip_all[:, 1]) % shards) == r)
+                    .sum() for r in range(shards)], np.int64)
+    pps = np.full(shards, n // shards, np.int32)
+    plan = ctypes.c_void_p()
+    assert lib.spfft_tpu_plan_create_distributed(
+        ctypes.byref(plan), 0, n, n, n, shards, vps.ctypes.data,
+        trip.ctypes.data, pps.ctypes.data, 0, 0, -1) == 0
+    rng = np.random.default_rng(11)
+    vals = [rng.standard_normal((len(trip), 2)).astype(np.float32)
+            for _ in range(B)]
+    spaces = [np.empty((n, n, n, 2), np.float32) for _ in range(B)]
+    outs = [np.empty_like(vals[0]) for _ in range(B)]
+    plans_arr = (ctypes.c_void_p * B)(plan, plan, plan)
+    vptr = (ctypes.c_void_p * B)(*[v.ctypes.data for v in vals])
+    sptr = (ctypes.c_void_p * B)(*[s.ctypes.data for s in spaces])
+    optr = (ctypes.c_void_p * B)(*[o.ctypes.data for o in outs])
+    assert lib.spfft_tpu_multi_backward(B, plans_arr, vptr, sptr) == 0
+    assert lib.spfft_tpu_multi_forward(B, plans_arr, sptr, 1, optr) == 0
+    for v, o in zip(vals, outs):
+        np.testing.assert_allclose(o, v, atol=1e-5)
+    # and the batched result matches the single-transform path
+    single_space = np.empty((n, n, n, 2), np.float32)
+    assert lib.spfft_tpu_backward(plan, vals[1].ctypes.data,
+                                  single_space.ctypes.data) == 0
+    np.testing.assert_allclose(spaces[1], single_space, atol=1e-5)
+    assert lib.spfft_tpu_plan_destroy(plan) == 0

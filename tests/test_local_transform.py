@@ -303,3 +303,18 @@ def test_pair_values_io_round_trip(monkeypatch):
     fwd_b = np.asarray(pplan.forward_batched(
         [np.asarray(space), np.asarray(space)], Scaling.FULL))
     assert fwd_b.shape == (2, 2, len(triplets))
+
+
+def test_irfft_last_collapse_semantics():
+    """The rank-collapse irfft wrapper (the TPU C2R corruption workaround,
+    docs/precision.md) is semantically identical to the direct op for
+    every rank it can see."""
+    import jax.numpy as jnp
+    from spfft_tpu.ops.stages import _irfft_last
+
+    rng = np.random.default_rng(50)
+    for shape in ((6, 10), (3, 5, 10), (2, 3, 4, 10)):
+        field = rng.standard_normal(shape)
+        G = jnp.asarray(np.fft.rfft(field, axis=-1))
+        got = np.asarray(_irfft_last(G, shape[-1]))
+        np.testing.assert_allclose(got, field, atol=1e-12)

@@ -1,0 +1,25 @@
+"""Real-wire multihost smoke: a 2-process ``jax.distributed`` group on a
+localhost coordinator, collective plan build, one distributed transform.
+
+The real-rank analogue of the stub-world tests in tests/test_multihost.py
+(the reference runs its MPI tests under real ranks,
+reference: tests/run_mpi_tests.cpp:14-20). Round 2 recorded this as
+untestable in the container; it runs now (scripts/multihost_smoke.py) and
+this test keeps it running.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_two_process_distributed_smoke():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "multihost_smoke.py")],
+        env=dict(os.environ, SPFFT_SMOKE_PORT="12387"),
+        capture_output=True, text=True, timeout=560)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "MULTIHOST SMOKE: OK" in out.stdout

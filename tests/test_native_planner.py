@@ -126,3 +126,89 @@ def test_full_plan_through_native_matches_numpy(monkeypatch):
                                   plan_numpy.stick_keys)
     np.testing.assert_array_equal(plan_native.slot_src, plan_numpy.slot_src)
     np.testing.assert_array_equal(plan_native.col_inv, plan_numpy.col_inv)
+
+
+def test_native_wide_tables_parity(monkeypatch):
+    """The C++ wide-gather cover produces IDENTICAL tables to the NumPy
+    builder (the executable specification) — geometry choice, chunk order,
+    packed words, byte-packed sub offsets, everything."""
+    from spfft_tpu import native
+    from spfft_tpu.ops import gather_kernel as gk
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(44)
+
+    def cases():
+        L, num_src = 50_000, 34_000
+        steps = (rng.random(L) < 0.67).astype(np.int64)
+        yield ("decompress", np.minimum(np.cumsum(steps) - steps,
+                                        num_src - 1),
+               steps.astype(bool), num_src, {})
+        idx2 = np.sort(rng.choice(120_000, 60_000, replace=False))
+        yield ("compress", idx2, np.ones(60_000, bool), 120_000, {})
+        n = 57_344
+        idx3 = np.sort(rng.choice(99_000, n, replace=False))
+        idx3 = idx3.reshape(-1, 4096)[rng.permutation(n // 4096)].reshape(-1)
+        yield ("block-shuffled", idx3, np.ones(n, bool), 99_000, {})
+        yield ("tiny", np.arange(100), np.ones(100, bool), 100, {})
+        yield ("forced", idx2, np.ones(60_000, bool), 120_000,
+               {"kp_rows": 16, "k_rows": 128})
+
+    for name, idx, valid, num_src, kw in cases():
+        t_nat = gk.build_wide_gather_tables(idx, valid, num_src, **kw)
+        with monkeypatch.context() as m:
+            m.setattr(native, "wide_gather_tables",
+                      lambda *a, **k: None)
+            t_py = gk.build_wide_gather_tables(idx, valid, num_src, **kw)
+        assert (t_nat is None) == (t_py is None), name
+        if t_nat is None:
+            continue
+        for field in ("num_out", "num_super", "src_rows", "span_rows",
+                      "kp_rows", "p_tiles", "segs"):
+            assert getattr(t_nat, field) == getattr(t_py, field), \
+                f"{name}.{field}"
+        for field in ("row0", "sub", "out_tile", "first", "packed"):
+            a, b = getattr(t_nat, field), getattr(t_py, field)
+            assert a.dtype == b.dtype, f"{name}.{field} dtype"
+            np.testing.assert_array_equal(a, b, err_msg=f"{name}.{field}")
+
+
+def test_native_wide_tables_blowup_parity(monkeypatch):
+    """Random order falls back identically (native raises the internal
+    blowup signal exactly where the NumPy cover returns None)."""
+    from spfft_tpu import native
+    from spfft_tpu.ops import gather_kernel as gk
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(45)
+    idx = rng.integers(0, 2_000_000, 60_000)
+    assert gk.build_wide_gather_tables(idx, np.ones(60_000, bool),
+                                       2_000_000) is None
+    with monkeypatch.context() as m:
+        m.setattr(native, "wide_gather_tables", lambda *a, **k: None)
+        assert gk.build_wide_gather_tables(idx, np.ones(60_000, bool),
+                                           2_000_000) is None
+
+
+def test_native_compression_inputs_parity(monkeypatch):
+    """Native occupied/forward-fill matches the NumPy specification,
+    including duplicates (last wins), leading gaps, and empty slots."""
+    from spfft_tpu import native
+    from spfft_tpu.ops import gather_kernel as gk
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(46)
+    for trial in range(5):
+        num_slots = int(rng.integers(50, 5000))
+        n = int(rng.integers(1, num_slots))
+        vi = rng.integers(0, num_slots, n)  # duplicates likely
+        nat = gk.compression_gather_inputs(vi, num_slots)
+        with monkeypatch.context() as m:
+            m.setattr(native, "compression_inputs", lambda *a: None)
+            py = gk.compression_gather_inputs(vi, num_slots)
+        for got, want in zip(nat, py):
+            np.testing.assert_array_equal(got[0], want[0])
+            np.testing.assert_array_equal(got[1], want[1])
