@@ -7,8 +7,11 @@
 !> the buffer-layout and threading contracts.
 !>
 !> Note: this image ships no Fortran compiler, so unlike the C path this
-!> module is not exercised by the test suite; it tracks include/spfft_tpu.h
-!> declaration-for-declaration.
+!> module cannot be compiled by the test suite. It tracks
+!> include/spfft_tpu.h declaration-for-declaration, and
+!> tests/test_fortran_bindings.py mechanically pins every bind(C)
+!> declaration to the C header (names, argument counts, constant values)
+!> and to the symbols exported by libspfft_tpu.so.
 
 module spfft_tpu
   use iso_c_binding

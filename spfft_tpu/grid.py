@@ -317,13 +317,18 @@ class Transform:
 
         Unlike the reference — whose pointer is a writable buffer users
         fill before ``forward`` (transform.hpp:184) — the HOST result is a
-        SNAPSHOT: writing into the returned numpy array has no effect on
-        the transform. To feed modified space-domain data into ``forward``,
-        pass it explicitly or call :meth:`set_space_domain_data`."""
+        SNAPSHOT: the returned numpy array is marked READ-ONLY so ported
+        reference code that writes into it fails loudly (a silent no-op
+        would corrupt results). To feed modified space-domain data into
+        ``forward``, pass a writable copy explicitly or call
+        :meth:`set_space_domain_data`."""
         if self._space is None or location is None:
             return self._space
         if ProcessingUnit(location) == ProcessingUnit.HOST:
-            return np.asarray(self._space)
+            snap = np.asarray(self._space)
+            snap = snap.view()
+            snap.flags.writeable = False
+            return snap
         return self._space
 
     def set_space_domain_data(self, space) -> None:

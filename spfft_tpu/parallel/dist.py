@@ -375,6 +375,12 @@ class DistributedTransformPlan:
             return
         if use_pallas is None and self.precision != "single":
             return
+        if use_pallas is None and dp.max_values < 200_000:
+            # Same measured crossover as the local plan (plan._init_pallas,
+            # round-3 sweep): below ~200k per-shard values the XLA gather
+            # beats the kernel's fixed launch overhead (64^3 1-shard:
+            # XLA 1.35 vs kernel 3.6 ms; 96^3: kernel 1.5 vs XLA 5.4).
+            return
         ms, mv, dim_z = dp.max_sticks, dp.max_values, dp.dim_z
         num_slots = ms * dim_z
         if mv == 0 or num_slots == 0:

@@ -141,13 +141,15 @@ class TransformPlan:
         if use_pallas is True and self.precision != "single":
             raise InvalidParameterError(
                 "the Pallas compression kernel is single-precision only")
-        # Auto threshold: with the overhead-weighted K chooser the kernel
-        # wins from ~32^3 up (32^3: 3.8 vs 5.2 ms XLA; 64^3: 4.8 vs 8.5;
-        # 96^3: 5.2 vs 13.3 — pair wall-clock, TPU v5e); below ~10k values
-        # everything is dispatch-dominated and the XLA path avoids the
-        # table build.
+        # Auto threshold, re-measured round 3 with sync-cancelled timing
+        # (scripts/sweep.py; the round-2 numbers carried ~5 ms of tunnel
+        # readback per measurement, which hid the XLA path's small-size
+        # advantage): 64^3/137k values XLA 0.45 vs kernel 0.74 ms;
+        # 96^3/463k values kernel 1.0 vs XLA 5.2 ms; 128^3 kernel 0.4 vs
+        # 14.7; 256^3 kernel 12.4 vs 129.8. Crossover between 137k and
+        # 463k values -> 200k.
         auto = backend_ok and self.precision == "single" \
-            and self.index_plan.num_values >= 10_000
+            and self.index_plan.num_values >= 200_000
         if use_pallas is False or (use_pallas is None and not auto):
             return
         if p.num_values == 0 or p.num_sticks == 0:

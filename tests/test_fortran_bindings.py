@@ -1,0 +1,94 @@
+"""Mechanical validation of the Fortran bind(C) module against the C ABI.
+
+This image ships no Fortran compiler, so include/spfft_tpu.f90 cannot be
+compiled here (stated in the file). What CAN be checked without one:
+
+* every C entry point declared in include/spfft_tpu.h has a bind(C)
+  declaration in the Fortran module with the SAME argument count,
+* every bound name exists as a symbol in the built libspfft_tpu.so,
+* the enum/constant values mirror the header exactly.
+
+The reference's Fortran module is likewise a declaration mirror of its C
+API (reference: include/spfft/spfft.f90); drift between the two files is
+the realistic failure mode, and this pins it.
+"""
+
+import ctypes
+import os
+import re
+import shutil
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HEADER = os.path.join(REPO, "include", "spfft_tpu.h")
+F90 = os.path.join(REPO, "include", "spfft_tpu.f90")
+
+
+def parse_header_functions():
+    """{name: n_args} for every C prototype in the public header."""
+    src = open(HEADER).read()
+    src = re.sub(r"/\*.*?\*/", "", src, flags=re.S)
+    out = {}
+    for m in re.finditer(
+            r"^\s*(?:const\s+char\s*\*|int)\s+(spfft_tpu_\w+)\s*\(([^;]*?)\)\s*;",
+            src, re.M | re.S):
+        name, args = m.group(1), m.group(2)
+        args = args.strip()
+        n = 0 if args in ("", "void") else args.count(",") + 1
+        out[name] = n
+    return out
+
+
+def parse_f90_functions():
+    """{bound_name: n_args} for every bind(C) interface declaration."""
+    src = open(F90).read()
+    out = {}
+    for m in re.finditer(
+            r"function\s+\w+\s*\(([^)]*)\)\s*&?\s*\n?\s*"
+            r"bind\(C,\s*name=\"(\w+)\"\)", src, re.S):
+        args, name = m.group(1), m.group(2)
+        args = args.strip()
+        out[name] = 0 if not args else args.count(",") + 1
+    return out
+
+
+def test_fortran_declarations_match_header():
+    hdr = parse_header_functions()
+    f90 = parse_f90_functions()
+    assert hdr, "header parse produced nothing"
+    # error_string returns const char* — represented differently in
+    # Fortran (c_ptr function); everything else must match exactly.
+    missing = {n for n in hdr if n not in f90
+               and n != "spfft_tpu_error_string"}
+    assert not missing, f"C entry points missing from spfft_tpu.f90: " \
+                        f"{sorted(missing)}"
+    for name, n_args in f90.items():
+        assert name in hdr, f"Fortran binds unknown symbol {name}"
+        assert n_args == hdr[name], \
+            f"{name}: {n_args} Fortran args vs {hdr[name]} C args"
+
+
+def test_f90_constants_match_header():
+    hdr = open(HEADER).read()
+    f90 = open(F90).read()
+    hdr_consts = dict(re.findall(r"(SPFFT_TPU_\w+)\s*=\s*(-?\d+)", hdr))
+    f90_consts = dict(re.findall(
+        r"parameter\s*::\s*(SPFFT_TPU_\w+)\s*=\s*(-?\d+)", f90))
+    assert f90_consts, "no constants parsed from spfft_tpu.f90"
+    for name, val in f90_consts.items():
+        assert name in hdr_consts, f"{name} not in the C header"
+        assert val == hdr_consts[name], \
+            f"{name}: f90 {val} vs header {hdr_consts[name]}"
+    missing = set(hdr_consts) - set(f90_consts)
+    assert not missing, f"header constants missing from f90: {missing}"
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ compiler")
+def test_bound_symbols_exist_in_library():
+    subprocess.run(["make", "-s", "capi"], cwd=REPO, check=True,
+                   capture_output=True, text=True)
+    lib = ctypes.CDLL(os.path.join(REPO, "lib", "libspfft_tpu.so"))
+    for name in parse_f90_functions():
+        assert hasattr(lib, name), f"{name} not exported by libspfft_tpu.so"
