@@ -113,6 +113,8 @@ def main() -> None:
     # both groups pipelined and each ending in one sync. Reported value =
     # min over trials (the best sustained rate the hardware delivered);
     # observed trial spread at 256^3 is < 1.5% vs ~25% for group means.
+    from spfft_tpu.utils.benchtime import diff_estimate_seconds
+
     def timed(g):
         t0 = time.perf_counter()
         o = None
@@ -121,24 +123,17 @@ def main() -> None:
         sync(o)
         return time.perf_counter() - t0
 
+    pair_s, spread, fallback = diff_estimate_seconds(timed, reps=reps)
     g1 = max(1, reps // 6)
     g2 = max(g1 + 1, reps - g1)
-    trials = [(timed(g2) - timed(g1)) / (g2 - g1) for _ in range(4)]
-    # Small grids can produce non-positive differences (the pair is below
-    # the sync-cost noise): keep positive trials only, and fall back to
-    # the plain pipelined average when none survive.
-    positive = [t for t in trials if t > 0]
-    if positive:
-        pair_s = min(positive)
-        spread = (max(positive) - pair_s) / pair_s
-        stat = (f"min of {len(positive)} sync-cancelling trials "
-                f"((T({g2})-T({g1}))/{g2 - g1}, trial spread "
-                f"+{spread * 100:.1f}%)")
-    else:
+    if fallback:
         # pair below the sync-cost noise: the plain pipelined average
         # (includes sync_cost/g2 of tunnel latency) is the honest fallback
-        pair_s = timed(g2) / g2
         stat = f"pipelined mean of {g2} (diff estimator below noise)"
+    else:
+        stat = (f"min of sync-cancelling trials "
+                f"((T({g2})-T({g1}))/{g2 - g1}, trial spread "
+                f"+{spread * 100:.1f}%)")
 
     # accuracy: L2 error of the backward result vs a dense oracle
     st = triplets.copy()

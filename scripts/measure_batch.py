@@ -22,13 +22,25 @@ def sync(arr):
 
 
 def bench(fn, reps=10):
+    """Shared sync-cancelling estimator (spfft_tpu.utils.benchtime) —
+    identical methodology to bench.py."""
+    from spfft_tpu.utils.benchtime import diff_estimate_seconds
+
     out = fn()
     sync(out)
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = fn()
-    sync(out)
-    return (time.perf_counter() - t0) / reps * 1e3
+
+    def timed(g):
+        t0 = time.perf_counter()
+        for _ in range(g):
+            out = fn()
+        sync(out)
+        return time.perf_counter() - t0
+
+    sec, _, fallback = diff_estimate_seconds(timed, reps=reps, trials=3)
+    if fallback:
+        print("  (diff estimator below noise — pipelined mean reported)",
+              flush=True)
+    return sec * 1e3
 
 
 def main() -> None:

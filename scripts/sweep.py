@@ -29,9 +29,11 @@ probe = jax.jit(lambda x: x.reshape(-1)[:8].sum())
 
 
 def timeit(fn):
-    """Sync-cancelling difference estimator (see bench.py): the tunnel
-    readback costs 80-120 ms, so (T(g2)-T(g1))/(g2-g1) with one sync per
-    group cancels it exactly; min over trials."""
+    """Shared sync-cancelling estimator (spfft_tpu.utils.benchtime) —
+    identical methodology to bench.py so BENCHMARKS.md numbers from
+    different scripts are comparable."""
+    from spfft_tpu.utils.benchtime import diff_estimate_seconds
+
     float(np.asarray(probe(fn())))  # warm-up + compile
 
     def timed(g):
@@ -41,11 +43,11 @@ def timeit(fn):
         float(np.asarray(probe(out)))
         return time.perf_counter() - t0
 
-    g1 = max(1, REPS // 5)
-    g2 = max(g1 + 1, REPS)
-    trials = [(timed(g2) - timed(g1)) / (g2 - g1) for _ in range(3)]
-    positive = [t for t in trials if t > 0] or [timed(g2) / g2]
-    return min(positive)
+    sec, _, fallback = diff_estimate_seconds(timed, reps=REPS, trials=3)
+    if fallback:
+        print("  (diff estimator below noise — pipelined mean reported)",
+              flush=True)
+    return sec
 
 
 def main():

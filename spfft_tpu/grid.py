@@ -326,7 +326,14 @@ class Transform:
             return self._space
         if ProcessingUnit(location) == ProcessingUnit.HOST:
             snap = np.asarray(self._space)
-            snap = snap.view()
+            if snap is self._space or (isinstance(self._space, np.ndarray)
+                                       and snap.base is self._space):
+                # numpy-stored data: np.asarray aliases it — a true
+                # snapshot needs a copy or the caller's own reference
+                # could still mutate what we promised was frozen
+                snap = snap.copy()
+            else:
+                snap = snap.view()
             snap.flags.writeable = False
             return snap
         return self._space

@@ -34,37 +34,49 @@ def _check(transforms: Sequence[Transform], args: Sequence, what: str):
             f"got {len(transforms)} transforms but {len(args)} {what}")
 
 
-#: Fuse shared-plan batches only up to this many per-transform (per-shard
-#: when distributed) GRID elements — device work scales with the grid, so
-#: the gate does too. Below it, per-dispatch latency dominates and ONE
-#: fused executable wins (128^3 = 2.1M grid elements, B=3, TPU v5e:
-#: distributed fused 13.9 vs 15.9 ms sequential); above it, device work
-#: dominates, async dispatch already pipelines the N executions, and the
-#: vmapped pipeline is mildly less efficient than N stock dispatches
-#: (256^3 = 16.8M: fused 63 vs 49 ms) — so big batches stay on
-#: per-transform dispatch (scripts/measure_batch.py).
+#: Local fusion threshold on the TOTAL batch work, B * grid elements —
+#: re-measured round 3 with sync-cancelled timing
+#: (scripts/measure_batch.py; the round-2 per-transform gate missed the B
+#: dependence): 128^3 B=3 = 6.3M total fused wins 3.8x (0.73 vs 2.79 ms),
+#: 128^3 B=8 = 16.8M loses 0.47x, 256^3 B=3 = 50M loses 0.60x. Below the
+#: gate per-dispatch latency dominates and ONE fused executable wins;
+#: above it device work dominates, async dispatch already pipelines the N
+#: executions, and the vmapped pipeline is mildly less efficient.
 FUSED_BATCH_MAX_GRID = 8_000_000
+
+#: Distributed fusion threshold on the TOTAL per-shard batch work
+#: (B * slab elements): the distributed path pays more per dispatch
+#: (pack/exchange/unpack stages), so fusion stays profitable longer than
+#: locally — measured round 3 (sync-cancelled, scripts/measure_batch.py):
+#: 128^3 B=8 (16.8M total) fused wins 1.9x, 256^3 B=3 (50M) loses 0.64x.
+FUSED_BATCH_MAX_DIST_TOTAL = 32_000_000
 
 
 def _shared_plan(transforms: Sequence[Transform]):
     """If every transform wraps the *same* plan object (clones share their
-    plan) AND the per-transform grid is in the regime where fusion wins
-    (FUSED_BATCH_MAX_GRID), return it — the batch then runs as ONE fused
-    executable (local: vmapped + batched-grid kernel; distributed: one
-    SPMD program with a per-shard batch axis) instead of N dispatches.
-    Returns None otherwise (per-transform async dispatch, which XLA
-    pipelines per device queue)."""
+    plan) AND the batch is in the regime where fusion wins, return it —
+    the batch then runs as ONE fused executable (local: vmapped +
+    batched-grid kernel; distributed: one SPMD program with a per-shard
+    batch axis) instead of N dispatches. Returns None otherwise
+    (per-transform async dispatch, which XLA pipelines per device queue).
+
+    The local gate is on TOTAL batch work B * grid elements (round-3
+    sync-cancelled measurements: 128^3 B=3 = 6.3M fused wins 3.8x,
+    128^3 B=8 = 16.8M loses 0.47x, 256^3 B=3 = 50M loses 0.60x — the
+    round-2 per-transform-size gate missed the B dependence)."""
     if len(transforms) < 2:
         return None
     plan = transforms[0].plan
     if any(t.plan is not plan for t in transforms[1:]):
         return None
+    B = len(transforms)
     if isinstance(plan, TransformPlan):
-        size = plan.global_size
-    else:
-        dp = plan.dist_plan
-        size = dp.dim_x * dp.dim_y * dp.max_planes  # per-shard slab
-    if size > FUSED_BATCH_MAX_GRID:
+        if B * plan.global_size > FUSED_BATCH_MAX_GRID:
+            return None
+        return plan
+    dp = plan.dist_plan
+    slab = dp.dim_x * dp.dim_y * dp.max_planes  # per-shard slab
+    if B * slab > FUSED_BATCH_MAX_DIST_TOTAL:
         return None
     return plan
 

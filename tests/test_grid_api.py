@@ -202,3 +202,20 @@ def test_space_domain_host_snapshot_is_readonly():
     writable = snap.copy()
     writable[0, 0, 0, 0] = 7.0
     t.set_space_domain_data(writable)
+
+
+def test_space_domain_host_snapshot_does_not_alias_numpy_store():
+    """A numpy array passed to set_space_domain_data must not share memory
+    with the HOST snapshot (the snapshot promise; review r3)."""
+    n = 4
+    trip = np.array([[x, y, z] for x in range(n) for y in range(n)
+                     for z in range(n)], np.int32)
+    grid = Grid(n, n, n, n * n)
+    t = grid.create_transform(ProcessingUnit.DEVICE, TransformType.C2C,
+                              n, n, n, indices=trip)
+    a = np.zeros((n, n, n, 2), np.float32)
+    t.set_space_domain_data(a)
+    snap = t.space_domain_data(ProcessingUnit.HOST)
+    a[0, 0, 0, 0] = 7.0
+    assert snap[0, 0, 0, 0] == 0.0  # true snapshot, no aliasing
+    assert a.flags.writeable  # the caller's array is untouched
