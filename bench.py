@@ -123,17 +123,8 @@ def main() -> None:
         sync(o)
         return time.perf_counter() - t0
 
-    pair_s, spread, fallback = diff_estimate_seconds(timed, reps=reps)
-    g1 = max(1, reps // 6)
-    g2 = max(g1 + 1, reps - g1)
-    if fallback:
-        # pair below the sync-cost noise: the plain pipelined average
-        # (includes sync_cost/g2 of tunnel latency) is the honest fallback
-        stat = f"pipelined mean of {g2} (diff estimator below noise)"
-    else:
-        stat = (f"min of sync-cancelling trials "
-                f"((T({g2})-T({g1}))/{g2 - g1}, trial spread "
-                f"+{spread * 100:.1f}%)")
+    est = diff_estimate_seconds(timed, reps=reps)
+    pair_s, stat = est.seconds, est.label
 
     # accuracy: L2 error of the backward result vs a dense oracle
     st = triplets.copy()

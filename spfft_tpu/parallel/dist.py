@@ -492,7 +492,14 @@ class DistributedTransformPlan:
                             fill_value=0).reshape(dp.max_planes, dp.dim_y,
                                                   self._xf_eff)
         blocks = pack_freq_to_blocks(sticks, zmap)
-        blocks = self._exchange_fn(blocks, self.axis_name, self._wire_dtype)
+        if dp.num_shards > 1:
+            # comm-size-1 skips the collective entirely, like the
+            # reference treating a 1-rank communicator as local
+            # (grid_internal.cpp:182); the block transposes on a size-1
+            # leading axis are layout no-ops (256^3 dist1 pair:
+            # 20.2 -> 17.5 ms).
+            blocks = self._exchange_fn(blocks, self.axis_name,
+                                       self._wire_dtype)
         return unpack_blocks_to_grid(blocks, col_inv, dp.dim_y,
                                      self._xf_eff)
 
@@ -512,7 +519,10 @@ class DistributedTransformPlan:
                             fill_value=0).reshape(dp.max_sticks, dp.dim_z)
         blocks = pack_space_to_blocks(grid, cols_flat, dp.num_shards,
                                       dp.max_sticks)
-        blocks = self._exchange_fn(blocks, self.axis_name, self._wire_dtype)
+        if dp.num_shards > 1:
+            # comm-size-1 local collapse (see _exchange_freq_to_grid)
+            blocks = self._exchange_fn(blocks, self.axis_name,
+                                       self._wire_dtype)
         return unpack_blocks_to_sticks(blocks, z_src)
 
     def _decompress_shard(self, values_il, slot_src, ptables):

@@ -16,13 +16,29 @@ recorded in BENCHMARKS.md comes from the same estimator.
 
 from __future__ import annotations
 
+import dataclasses
 import math
-from typing import Callable, Tuple
+from typing import Callable
+
+
+@dataclasses.dataclass(frozen=True)
+class DiffEstimate:
+    """Result of :func:`diff_estimate_seconds`. ``label`` describes the
+    methodology that ACTUALLY produced ``seconds`` (so benchmark logs
+    cannot silently diverge from the estimator)."""
+
+    seconds: float
+    spread: float
+    fallback: bool
+    label: str
+
+    def __iter__(self):  # (seconds, spread, fallback) unpacking
+        return iter((self.seconds, self.spread, self.fallback))
 
 
 def diff_estimate_seconds(run_group: Callable[[int], float],
                           reps: int = 30,
-                          trials: int = 4) -> Tuple[float, float, bool]:
+                          trials: int = 4) -> DiffEstimate:
     """Estimate seconds per call from pipelined groups.
 
     Args:
@@ -34,11 +50,11 @@ def diff_estimate_seconds(run_group: Callable[[int], float],
         reported (the best sustained rate the hardware delivered).
 
     Returns:
-      ``(seconds_per_call, trial_spread, fallback_used)``. When every
-      difference is non-positive (the per-call time is below the sync-cost
-      noise — tiny workloads), falls back to the plain pipelined mean of
-      one g2 group, which re-includes sync_cost/g2; ``fallback_used`` is
-      True so callers can label the number honestly.
+      A :class:`DiffEstimate` (iterates as ``(seconds, spread,
+      fallback)``). When every difference is non-positive (the per-call
+      time is below the sync-cost noise — tiny workloads), falls back to
+      the plain pipelined mean of one g2 group, which re-includes
+      sync_cost/g2; ``fallback`` is True and ``label`` says so.
     """
     g1 = max(1, reps // 6)
     g2 = max(g1 + 1, reps - g1)
@@ -47,5 +63,11 @@ def diff_estimate_seconds(run_group: Callable[[int], float],
     positive = [d for d in diffs if d > 0]
     if positive:
         best = min(positive)
-        return best, (max(positive) - best) / best, False
-    return run_group(g2) / g2, math.nan, True
+        spread = (max(positive) - best) / best
+        return DiffEstimate(
+            best, spread, False,
+            f"min of sync-cancelling trials ((T({g2})-T({g1}))/{g2 - g1}, "
+            f"trial spread +{spread * 100:.1f}%)")
+    return DiffEstimate(run_group(g2) / g2, math.nan, True,
+                        f"pipelined mean of {g2} "
+                        f"(diff estimator below noise)")

@@ -31,10 +31,10 @@ def parse_header_functions():
     src = open(HEADER).read()
     src = re.sub(r"/\*.*?\*/", "", src, flags=re.S)
     out = {}
-    # any return type — a future entry point with a new return type must
-    # still be caught by the drift check
+    # any return type — a future entry point with a new return type (or a
+    # star attached to the name, C-style) must still be caught
     for m in re.finditer(
-            r"^\s*(?:[\w]+[\w\s]*\*?\s*?)\s(spfft_tpu_\w+)\s*\(([^;]*?)\)\s*;",
+            r"^\s*\w[\w\s]*[\s*]\s*(spfft_tpu_\w+)\s*\(([^;]*?)\)\s*;",
             src, re.M | re.S):
         name, args = m.group(1), m.group(2)
         args = args.strip()
