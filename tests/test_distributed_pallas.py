@@ -176,3 +176,56 @@ def test_pallas_batched_matches_xla_batched():
     fgot = np.asarray(pal.forward_batched(spaces, Scaling.FULL))
     fwant = np.asarray(ref.forward_batched(spaces, Scaling.FULL))
     np.testing.assert_allclose(fgot, fwant, atol=1e-6, rtol=0)
+
+
+def test_pallas_compact_float_split_r2c_combo():
+    """The riskiest interaction surface in one plan: COMPACT_BUFFERED_FLOAT
+    (exact-count schedule + reduced wire precision) x the split-x window
+    x R2C symmetry x the Pallas kernel, on a skewed 4-shard distribution
+    with an empty shard — against the XLA-path plan and the dense oracle."""
+    rng = np.random.default_rng(77)
+    dims = (24, 10, 12)  # narrow occupied x of the half spectrum -> split
+    triplets = hermitian_triplets(rng, dims)
+    triplets = triplets[triplets[:, 0] <= 4]  # force a narrow x window
+    triplets = sort_triplets_stick_major(triplets, dims)
+    parts = split_by_sticks(triplets, dims, [3, 1, 0, 2])
+    planes = split_planes(dims[2], [0, 5, 4, 3])
+    mk = lambda up: make_distributed_plan(  # noqa: E731
+        TransformType.R2C, *dims, parts, planes, mesh=make_mesh(4),
+        precision="single", exchange=ExchangeType.COMPACT_BUFFERED_FLOAT,
+        use_pallas=up)
+    ref, pal = mk(False), mk(True)
+    assert pal._pallas_dist is not None and pal._pallas_interpret
+    assert pal._split_x is not None, "split-x must engage for this set"
+    # hermitian-CONSISTENT values (sampled from a real field's spectrum):
+    # arbitrary values at x=0-plane mirror points are projected by the
+    # real transform and would fail an exact round trip
+    field = rng.uniform(-1, 1, (dims[2], dims[1], dims[0]))
+    freq = dense_forward(field.astype(np.complex128))
+    vals = [sample_cube(freq, p, dims).astype(np.complex64) for p in parts]
+    got_p = np.asarray(pal.backward(vals))
+    got_r = np.asarray(ref.backward(vals))
+    np.testing.assert_allclose(got_p, got_r, atol=1e-2)  # bf16 wire
+    # dense oracle: the provided values plus their hermitian mirrors
+    nx, ny, nz = dims
+    cube = dense_cube_from_values(np.concatenate(parts),
+                                  np.concatenate(vals), dims)
+    st = np.concatenate(parts) % np.array([nx, ny, nz])
+    mz, my, mx = (-st[:, 2]) % nz, (-st[:, 1]) % ny, (-st[:, 0]) % nx
+    selfc = (st[:, 2] == mz) & (st[:, 1] == my) & (st[:, 0] == mx)
+    cube[mz[~selfc], my[~selfc], mx[~selfc]] = \
+        np.conj(np.concatenate(vals)[~selfc])
+    cube[st[selfc, 2], st[selfc, 1], st[selfc, 0]] = \
+        np.concatenate(vals)[selfc].real
+    want = dense_backward(cube).real
+    space = np.concatenate(pal.unshard_space(got_p), axis=0)
+    # bf16 wire carries ~8 mantissa bits: bound the error relative to the
+    # field magnitude, not absolutely
+    np.testing.assert_allclose(space, want,
+                               atol=0.02 * np.abs(want).max())
+    # round trip through the fused pair
+    out = pal.unshard_values(pal.apply_pointwise(vals,
+                                                 scaling=Scaling.FULL))
+    vmax = max(np.abs(np.concatenate(vals)).max(), 1.0)
+    for g, v in zip(out, vals):
+        np.testing.assert_allclose(g, v, atol=0.01 * vmax, rtol=0)
