@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""Round-4 probe: matmul-DFT stages vs XLA's conv-FFT in the 256^3 pair.
+
+XLA:TPU lowers jnp.fft to DFT convolutions at operand_precision=highest
+plus layout-change copies for the non-minor axis. This probe swaps every
+FFT stage for an explicit dot_general against DFT-matrix constants:
+  - y axis contracted in place ('ky,zyx->zkx') — no transposes,
+  - x axis as '...x,xk->...k',
+  - z axis on sticks as 'sz,zk->sk',
+at both HIGHEST (f32) and HIGH (bf16_3x) precision, 4-mult complex vs
+3-mult Karatsuba. Accuracy via the FULL-scaled identity round trip
+(out == in for an exact pipeline). Timing via bench.py's difference
+estimator on real apply-style dispatches.
+
+Usage: DIM=256 python scripts/probe_r4_dft.py
+"""
+import os
+import sys
+import time
+import functools
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from spfft_tpu import TransformType, make_local_plan
+from spfft_tpu.utils.benchtime import diff_estimate_seconds
+from spfft_tpu.utils.workloads import spherical_cutoff_triplets
+
+P_HI = jax.lax.Precision.HIGHEST
+P_H3 = jax.lax.Precision.HIGH
+
+
+def dftmat(n, sign, scale=1.0):
+    k = np.arange(n)
+    return (np.exp(sign * 2j * np.pi * np.outer(k, k) / n) * scale)
+
+
+def cmats(n, sign, scale=1.0):
+    m = dftmat(n, sign, scale)
+    return (np.ascontiguousarray(m.real.astype(np.float32)),
+            np.ascontiguousarray(m.imag.astype(np.float32)))
+
+
+def cmul_mm(xr, xi, cr, ci, contract, prec, karatsuba=False):
+    """Complex matmul via real dot_generals; ``contract`` is a function
+    (a, b) -> dot_general(a, b) for the wanted axis structure."""
+    if karatsuba:
+        p1 = contract(xr, cr, prec)
+        p2 = contract(xi, ci, prec)
+        p3 = contract(xr + xi, cr + ci, prec)
+        return p1 - p2, p3 - p1 - p2
+    return (contract(xr, cr, prec) - contract(xi, ci, prec),
+            contract(xr, ci, prec) + contract(xi, cr, prec))
+
+
+def c_last(x, mats, prec, karatsuba):
+    """DFT along the last axis: '...x,xk->...k'."""
+    cr, ci = mats
+    f = lambda a, c, p: jax.lax.dot_general(
+        a, c, (((a.ndim - 1,), (0,)), ((), ())), precision=p)
+    yr, yi = cmul_mm(jnp.real(x), jnp.imag(x), jnp.asarray(cr),
+                     jnp.asarray(ci), f, prec, karatsuba)
+    return yr + 1j * yi
+
+
+def c_mid(x, mats, prec, karatsuba):
+    """DFT along axis -2 of (z, y, x): 'ky,zyx->zkx' — x stays minor."""
+    cr, ci = mats
+
+    def f(a, c, p):
+        # dot_general: lhs c (k, y), rhs a (z, y, x); contract y
+        out = jax.lax.dot_general(c, a, (((1,), (1,)), ((), ())),
+                                  precision=p)  # (k, z, x)
+        return jnp.moveaxis(out, 0, 1)  # (z, k, x)
+
+    yr, yi = cmul_mm(jnp.real(x), jnp.imag(x), jnp.asarray(cr),
+                     jnp.asarray(ci), f, prec, karatsuba)
+    return yr + 1j * yi
+
+
+def main(n: int):
+    triplets = spherical_cutoff_triplets(n)
+    plan = make_local_plan(TransformType.C2C, n, n, n, triplets,
+                           precision="single")
+    p = plan.index_plan
+    N = p.num_values
+    tables = plan._tables
+    from spfft_tpu.ops import stages
+    print(f"== dim={n} values={N} ==", flush=True)
+
+    rng = np.random.default_rng(0)
+    values = (rng.uniform(-1, 1, N)
+              + 1j * rng.uniform(-1, 1, N)).astype(np.complex64)
+    values_il = jax.device_put(plan._coerce_values(values))
+
+    def sync(arr):
+        return float(np.asarray(arr.ravel()[0]))
+
+    def make_pair(prec, karatsuba, scaled):
+        # backward: ifft_z * Z ; ifft2 * (y x)  [scale folded into mats]
+        mz_b = cmats(n, +1, 1.0)     # ifft*Z = conj-DFT (no 1/Z)
+        my_b = cmats(n, +1, 1.0)
+        mx_b = cmats(n, +1, 1.0)
+        s = 1.0 / (n ** 3) if scaled else 1.0
+        mz_f = cmats(n, -1, s)       # fold FULL scaling into the z-DFT
+        my_f = cmats(n, -1, 1.0)
+        mx_f = cmats(n, -1, 1.0)
+
+        def pair(v):
+            sticks = plan._decompress(v, tables)
+            sticks = c_last(sticks, mz_b, prec, karatsuba)
+            grid = stages.sticks_to_grid(sticks, tables["col_inv"],
+                                         p.dim_y, p.dim_x_freq)
+            grid = c_mid(grid, my_b, prec, karatsuba)
+            grid = c_last(grid, mx_b, prec, karatsuba)
+            # forward
+            grid = c_last(grid, mx_f, prec, karatsuba)
+            grid = c_mid(grid, my_f, prec, karatsuba)
+            sticks = stages.grid_to_sticks(grid, tables["scatter_cols"])
+            sticks = c_last(sticks, mz_f, prec, karatsuba)
+            return plan._compress(sticks, tables, None)
+        return jax.jit(pair)
+
+    def timed_ms(fn, arg):
+        def grp(g):
+            t0 = time.perf_counter()
+            o = None
+            for _ in range(g):
+                o = fn(arg)
+            sync(o)
+            return time.perf_counter() - t0
+        est = diff_estimate_seconds(grp, reps=20)
+        return est.seconds * 1e3
+
+    # reference: current pair
+    cur = jax.jit(functools.partial(plan._pair_impl, scaled=False, fn=None))
+    o = cur(values_il, plan._tables); sync(o)
+    print(f"current pair (XLA fft):      {timed_ms(lambda v: cur(v, plan._tables), values_il):8.3f} ms", flush=True)
+
+    for prec, pname in [(P_HI, "HIGHEST"), (P_H3, "HIGH")]:
+        for kara in (False, True):
+            f = make_pair(prec, kara, scaled=False)
+            o = f(values_il); sync(o)
+            t = timed_ms(f, values_il)
+            # accuracy: scaled pair should reproduce the input
+            fa = make_pair(prec, kara, scaled=True)
+            out = np.asarray(fa(values_il))
+            got = out[..., 0] + 1j * out[..., 1]
+            rel = np.linalg.norm(got - values) / np.linalg.norm(values)
+            print(f"matmul-DFT {pname:7s} kara={int(kara)}: {t:8.3f} ms   "
+                  f"roundtrip rel err {rel:.2e}", flush=True)
+
+
+if __name__ == "__main__":
+    print(f"devices: {jax.devices()}", flush=True)
+    main(int(os.environ.get("DIM", "256")))

@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""Round-4 probe 2: four-step (radix-2, 256=2x128) matmul-DFT at HIGHEST.
+
+The direct matmul-DFT matched XLA's conv-FFT (same FLOPs); HIGH precision
+halves MXU time but fails the 1e-6 bar. Four-step halves the MXU FLOPs at
+full f32 accuracy: DFT_256 = butterfly o twiddle o two DFT_128 matmuls on
+contiguous halves, with the even/odd input (DIT) or output (DIF)
+permutation ABSORBED into the plan's gather tables at plan time.
+
+Timing here uses an unpermuted stand-in (identical cost, wrong values);
+correctness of the permuted math is asserted separately at small scale.
+
+Pipeline shape probed: all minor-axis DFTs + 2 grid transposes
+(z,y,x)<->(z,x,y) instead of XLA fft2's 4 internal layout copies.
+
+Usage: DIM=256 python scripts/probe_r4_dft2.py
+"""
+import os
+import sys
+import time
+import functools
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from spfft_tpu import TransformType, make_local_plan
+from spfft_tpu.utils.benchtime import diff_estimate_seconds
+from spfft_tpu.utils.workloads import spherical_cutoff_triplets
+
+P_HI = jax.lax.Precision.HIGHEST
+P_H3 = jax.lax.Precision.HIGH
+
+
+def dftmat_ri(n, sign, scale=1.0):
+    k = np.arange(n)
+    m = np.exp(sign * 2j * np.pi * np.outer(k, k) / n) * scale
+    return (np.ascontiguousarray(m.real.astype(np.float32)),
+            np.ascontiguousarray(m.imag.astype(np.float32)))
+
+
+def _mm_last(xr, xi, cr, ci, prec):
+    f = lambda a, c: jax.lax.dot_general(
+        a, c, (((a.ndim - 1,), (0,)), ((), ())), precision=prec)
+    return (f(xr, cr) - f(xi, ci), f(xr, ci) + f(xi, cr))
+
+
+def direct_last(x, mats, prec):
+    yr, yi = _mm_last(jnp.real(x), jnp.imag(x), jnp.asarray(mats[0]),
+                      jnp.asarray(mats[1]), prec)
+    return yr + 1j * yi
+
+
+def make_fourstep_last(n, sign, scale=1.0, permute_input=True):
+    """Radix-2 DIT along the minor axis: input is [evens; odds] halves
+    (``permute_input=False`` treats the given halves as already split —
+    the table-absorbed form), output natural. Returns f(x)->y."""
+    h = n // 2
+    cr, ci = dftmat_ri(h, sign, scale)
+    w = np.exp(sign * 2j * np.pi * np.arange(h) / n).astype(np.complex64)
+    wr = jnp.asarray(np.ascontiguousarray(w.real))
+    wi = jnp.asarray(np.ascontiguousarray(w.imag))
+
+    def f(x):
+        if permute_input:
+            x = jnp.concatenate([x[..., 0::2], x[..., 1::2]], axis=-1)
+        xr, xi = jnp.real(x), jnp.imag(x)
+        er, ei = _mm_last(xr[..., :h], xi[..., :h], jnp.asarray(cr),
+                          jnp.asarray(ci), P_HI)
+        orr, oi = _mm_last(xr[..., h:], xi[..., h:], jnp.asarray(cr),
+                           jnp.asarray(ci), P_HI)
+        tr = orr * wr - oi * wi
+        ti = orr * wi + oi * wr
+        o = tr + 1j * ti
+        e = er + 1j * ei
+        return jnp.concatenate([e + o, e - o], axis=-1)
+    return f
+
+
+def main(n: int):
+    # correctness of the permuted four-step first (small, CPU-checkable)
+    f4 = make_fourstep_last(n, -1, permute_input=True)
+    rng = np.random.default_rng(3)
+    xs = (rng.standard_normal((500, n)) + 1j
+          * rng.standard_normal((500, n))).astype(np.complex64)
+    xs_dev = jax.jit(lambda a, b: a + 1j * b)(
+        jnp.asarray(xs.real.copy()), jnp.asarray(xs.imag.copy()))
+    take = jax.jit(lambda s: jnp.stack([jnp.real(s), jnp.imag(s)]))
+    got = np.asarray(take(jax.jit(f4)(xs_dev)))
+    ref = np.fft.fft(xs, axis=-1)
+    rel = np.linalg.norm((got[0] + 1j * got[1]) - ref) / np.linalg.norm(ref)
+    print(f"four-step DIT rel err vs numpy fft: {rel:.2e}", flush=True)
+
+    triplets = spherical_cutoff_triplets(n)
+    plan = make_local_plan(TransformType.C2C, n, n, n, triplets,
+                           precision="single")
+    p = plan.index_plan
+    N = p.num_values
+    tables = plan._tables
+    from spfft_tpu.ops import stages
+    print(f"== dim={n} values={N} ==", flush=True)
+
+    values = (rng.uniform(-1, 1, N)
+              + 1j * rng.uniform(-1, 1, N)).astype(np.complex64)
+    values_il = jax.device_put(plan._coerce_values(values))
+
+    def sync(arr):
+        return float(np.asarray(arr.ravel()[0]))
+
+    def timed_ms(fn, arg):
+        def grp(g):
+            t0 = time.perf_counter()
+            o = None
+            for _ in range(g):
+                o = fn(arg)
+            sync(o)
+            return time.perf_counter() - t0
+        est = diff_estimate_seconds(grp, reps=20)
+        return est.seconds * 1e3
+
+    cur = jax.jit(functools.partial(plan._pair_impl, scaled=False, fn=None))
+    o = cur(values_il, plan._tables); sync(o)
+    print(f"current pair (XLA fft):                  "
+          f"{timed_ms(lambda v: cur(v, plan._tables), values_il):8.3f} ms",
+          flush=True)
+
+    db = dftmat_ri(n, +1)      # direct backward (unnormalised inverse)
+    df = dftmat_ri(n, -1)      # direct forward
+    f4b = make_fourstep_last(n, +1, permute_input=False)  # table-absorbed
+    f4f = make_fourstep_last(n, -1, permute_input=False)
+
+    def make_pair(zf, yf, xf, zb, yb, xb):
+        def pair(v):
+            sticks = plan._decompress(v, tables)
+            sticks = zb(sticks)
+            grid = stages.sticks_to_grid(sticks, tables["col_inv"],
+                                         p.dim_y, p.dim_x_freq)
+            # pretend (z,x,y): minor DFT = y pass
+            grid = yb(grid)
+            grid = jnp.swapaxes(grid, -1, -2)
+            grid = xb(grid)            # space (z, y-ish, x) natural minor
+            grid = xf(grid)
+            grid = jnp.swapaxes(grid, -1, -2)
+            grid = yf(grid)
+            sticks = stages.grid_to_sticks(grid, tables["scatter_cols"])
+            sticks = zf(sticks)
+            return plan._compress(sticks, tables, None)
+        return jax.jit(pair)
+
+    d = lambda m: (lambda x: direct_last(x, m, P_HI))
+    pairs = {
+        "direct matmul minor + 2 transposes": make_pair(
+            d(df), d(df), d(df), d(db), d(db), d(db)),
+        "four-step minor + 2 transposes": make_pair(
+            f4f, f4f, f4f, f4b, f4b, f4b),
+        "four-step xy, direct z": make_pair(
+            d(df), f4f, f4f, d(db), f4b, f4b),
+    }
+    for name, f in pairs.items():
+        o = f(values_il); sync(o)
+        print(f"{name:40s} {timed_ms(f, values_il):8.3f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    print(f"devices: {jax.devices()}", flush=True)
+    main(int(os.environ.get("DIM", "256")))

@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""Round-4 probe: true FFT stage costs (non-collapsible consume) + a
+four-step DFT decomposition candidate.
+
+XLA:TPU lowers jnp.fft to DFT *convolutions* (O(N^2) matmuls on the MXU),
+so at 256^3 the xy FFTs are MXU-bound. A linear consume (mean) lets the
+compiler commute the reduction through the convolution and fake sub-ms
+FFTs — every stage here is consumed through mean(x*x) instead.
+
+Four-step candidate: 256 = 2 x 128. DFT_128 as an einsum against a
+(128,128) DFT matrix (perfect MXU shape) + twiddle + radix-2 butterfly
+= half the MXU cycles of the direct 256-point DFT convolution.
+
+Usage: DIM=256 python scripts/probe_r4_fft2.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+R = int(os.environ.get("REPS", 20))
+
+
+def sync(out):
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    float(np.asarray(jax.numpy.real(leaf).ravel()[0]))
+
+
+def _perturb(x):
+    return jax.tree_util.tree_map(lambda v: v * v.dtype.type(1.0 + 1e-7), x)
+
+
+def _consume(y):
+    tot = 0.0
+    for leaf in jax.tree_util.tree_leaves(y):
+        if jnp.iscomplexobj(leaf):
+            r, i = jnp.real(leaf), jnp.imag(leaf)
+            tot = tot + jnp.mean(r * r) + jnp.mean(i * i)
+        else:
+            tot = tot + jnp.mean(leaf * leaf)
+    return tot
+
+
+def _scan_seconds(body, x, reps=4):
+    def run(x0):
+        def step(c, _):
+            xp = _perturb(c)
+            return xp, _consume(body(xp))
+        _, ys = jax.lax.scan(step, x0, None, length=R)
+        return ys
+    f = jax.jit(run)
+    out = f(x)
+    sync(out)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = f(x)
+        sync(out)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def timeit(name, body, x, calib_s):
+    total = _scan_seconds(body, x)
+    dt = (total - calib_s) / R
+    print(f"{name:52s} {dt*1e3:8.3f} ms", flush=True)
+    return dt
+
+
+def _device_complex(arr):
+    """Commit a complex numpy array to device via its real/imag parts
+    (complex host->device transfers are UNIMPLEMENTED on this platform)."""
+    return jax.jit(lambda a, b: a + 1j * b)(
+        jnp.asarray(np.ascontiguousarray(arr.real.astype(np.float32))),
+        jnp.asarray(np.ascontiguousarray(arr.imag.astype(np.float32))))
+
+
+def dft_matrix(n, sign, dtype=np.complex64):
+    k = np.arange(n)
+    return np.exp(sign * 2j * np.pi * np.outer(k, k) / n).astype(dtype)
+
+
+def make_fourstep(n, sign):
+    """1D DFT of size n = 2*h along the MINOR axis via
+    butterfly(radix-2) o twiddle o DFT_h-einsum. sign=-1 forward."""
+    h = n // 2
+    F = dft_matrix(h, sign)  # host constants: XLA embeds them in-module
+    w = np.exp(sign * 2j * np.pi * np.arange(h) / n).astype(np.complex64)
+
+    def fft1(x):  # (..., n) -> (..., n)
+        shp = x.shape
+        # decimation in time: even/odd interleave on the minor axis
+        xe = x[..., 0::2]
+        xo = x[..., 1::2]
+        Ye = jnp.einsum("...i,ik->...k", xe, F)
+        Yo = jnp.einsum("...i,ik->...k", xo, F) * w
+        return jnp.concatenate([Ye + Yo, Ye - Yo], axis=-1).reshape(shp)
+    return fft1
+
+
+def main(n: int):
+    re = jnp.asarray(np.random.default_rng(0)
+                     .standard_normal((n, n, n)).astype(np.float32))
+    im = jnp.asarray(np.random.default_rng(1)
+                     .standard_normal((n, n, n)).astype(np.float32))
+    g0 = jax.jit(lambda a, b: a + 1j * b)(re, im)  # complex built on device
+    g0.block_until_ready()
+    sticks0 = jax.jit(lambda g: g.reshape(-1, n)[:51431])(g0)
+
+    cal_g = _scan_seconds(lambda g: g, g0)
+    cal_s = _scan_seconds(lambda s: s, sticks0)
+    print(f"calib grid {cal_g/R*1e3:.3f} ms/step, "
+          f"sticks {cal_s/R*1e3:.3f} ms/step", flush=True)
+
+    ifft1 = make_fourstep(n, +1)
+    fft1 = make_fourstep(n, -1)
+
+    # correctness spot-check first
+    take = jax.jit(lambda s: jnp.stack([jnp.real(s[:64]), jnp.imag(s[:64])]))
+    s64 = np.asarray(take(sticks0))
+    ref = np.fft.fft(s64[0] + 1j * s64[1], axis=-1)
+    gotp = np.asarray(take(jax.jit(fft1)(sticks0)))
+    got = gotp[0] + 1j * gotp[1]
+    rel = np.linalg.norm(got - ref) / np.linalg.norm(ref)
+    print(f"four-step fft rel err vs numpy: {rel:.2e}", flush=True)
+
+    timeit("xla ifft minor axis (grid)",
+           lambda g: jnp.fft.ifft(g, axis=-1), g0, cal_g)
+    timeit("xla ifft axis=-2 (grid)",
+           lambda g: jnp.fft.ifft(g, axis=-2), g0, cal_g)
+    timeit("swapaxes(-1,-2) copy",
+           lambda g: jnp.swapaxes(g, -1, -2), g0, cal_g)
+    timeit("xla ifft2 (grid)",
+           lambda g: jnp.fft.ifft2(g, axes=(-2, -1)), g0, cal_g)
+    timeit("xla ifft2+fft2 chain",
+           lambda g: jnp.fft.fft2(jnp.fft.ifft2(g, axes=(-2, -1)),
+                                  axes=(-2, -1)), g0, cal_g)
+    timeit("fourstep ifft minor (grid)", ifft1, g0, cal_g)
+    timeit("fourstep ifft2 = minor+swap+minor+swap",
+           lambda g: jnp.swapaxes(ifft1(jnp.swapaxes(ifft1(g), -1, -2)),
+                                  -1, -2), g0, cal_g)
+    timeit("fourstep pair chain (ifft2 then fft2)",
+           lambda g: jnp.swapaxes(
+               fft1(jnp.swapaxes(
+                   fft1(jnp.swapaxes(
+                       ifft1(jnp.swapaxes(ifft1(g), -1, -2)), -1, -2)
+                   ), -1, -2)), -1, -2),
+           g0, cal_g)
+    # round trip leaving the middle in swapped layout (saves 2 transposes:
+    # ifft_x, swap, ifft_y -> space in (z,x,y) -> fft_y, swap, fft_x)
+    timeit("fourstep pair chain, swapped-middle",
+           lambda g: fft1(jnp.swapaxes(
+               fft1(ifft1(jnp.swapaxes(ifft1(g), -1, -2))), -1, -2)),
+           g0, cal_g)
+    timeit("xla z ifft (sticks)",
+           lambda s: jnp.fft.ifft(s, axis=-1), sticks0, cal_s)
+    timeit("fourstep z ifft (sticks)", ifft1, sticks0, cal_s)
+
+
+if __name__ == "__main__":
+    print(f"devices: {jax.devices()}", flush=True)
+    main(int(os.environ.get("DIM", "256")))

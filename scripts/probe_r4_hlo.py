@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Round-4 probe: static analysis of the compiled 256^3 fused-pair HLO.
+
+Parses the optimized HLO of the apply_pointwise executable: convolution
+shapes (the DFT-matmul FFT lowering) with cycle estimates, every copy /
+transpose / concatenate over 10 MB, and fusion count — to locate the gap
+between the ~9 ms component estimate and the measured 12.5 ms pair.
+"""
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from spfft_tpu import TransformType, make_local_plan
+from spfft_tpu.utils.workloads import spherical_cutoff_triplets
+
+DT_BYTES = {"f32": 4, "c64": 8, "s32": 4, "s16": 2, "pred": 1, "f64": 8,
+            "c128": 16, "s64": 8, "u32": 4, "bf16": 2, "s8": 1, "u8": 1}
+
+
+def shape_bytes(s):
+    m = re.match(r"(\w+)\[([\d,]*)\]", s)
+    if not m:
+        return 0
+    dt, dims = m.group(1), m.group(2)
+    n = 1
+    for d in dims.split(","):
+        if d:
+            n *= int(d)
+    return n * DT_BYTES.get(dt, 4)
+
+
+def main(n=256):
+    triplets = spherical_cutoff_triplets(n)
+    plan = make_local_plan(TransformType.C2C, n, n, n, triplets,
+                           precision="single")
+    import functools
+    fn = jax.jit(functools.partial(plan._pair_impl, scaled=False, fn=None))
+    rng = np.random.default_rng(0)
+    N = plan.index_plan.num_values
+    values = (rng.uniform(-1, 1, N)
+              + 1j * rng.uniform(-1, 1, N)).astype(np.complex64)
+    values_il = plan._coerce_values(values)
+    lowered = fn.lower(values_il, plan._tables)
+    compiled = lowered.compile()
+    txt = compiled.as_text()
+    print(f"HLO: {len(txt)} chars")
+    try:
+        ma = compiled.memory_analysis()
+        print(f"peak memory: temp={ma.temp_size_in_bytes/1e6:.0f} MB "
+              f"args={ma.argument_size_in_bytes/1e6:.0f} MB "
+              f"out={ma.output_size_in_bytes/1e6:.0f} MB")
+    except Exception as e:
+        print("memory_analysis:", e)
+
+    convs = []
+    big = []
+    fusions = 0
+    pallas = 0
+    for line in txt.splitlines():
+        ls = line.strip()
+        m = re.match(r"%?\S+ = (\S+) (\w[\w-]*)\(", ls)
+        if not m:
+            continue
+        shape, op = m.group(1), m.group(2)
+        nbytes = shape_bytes(shape)
+        if op == "convolution":
+            # operand shapes
+            ops_shapes = re.findall(r"\(([^)]*)\)", ls)
+            convs.append((shape, ls[:160]))
+        elif op == "fusion":
+            fusions += 1
+        elif op == "custom-call" and "tpu_custom_call" in ls:
+            pallas += 1
+        if op in ("copy", "transpose", "concatenate", "reshape",
+                  "bitcast-convert", "slice", "pad") and nbytes > 10e6:
+            big.append((nbytes, op, shape, ls[:130]))
+
+    print(f"\n{len(convs)} convolutions, {fusions} fusions, "
+          f"{pallas} pallas custom-calls")
+    for shape, ls in convs:
+        print(f"  conv out={shape}")
+        print(f"    {ls}")
+    print(f"\nlarge data-movement ops (>10MB):")
+    tot = 0
+    for nbytes, op, shape, ls in sorted(big, reverse=True):
+        tot += nbytes
+        print(f"  {op:12s} {nbytes/1e6:8.1f} MB out  {shape}")
+    print(f"  total large-op output bytes: {tot/1e6:.0f} MB "
+          f"(~{tot*2/819e9*1e3:.2f} ms at HBM peak, r+w)")
+
+
+if __name__ == "__main__":
+    main(int(os.environ.get("DIM", "256")))
