@@ -1,0 +1,228 @@
+"""Matmul-DFT: FFT stages as MXU dot_generals against plan-time matrices.
+
+XLA:TPU lowers ``jnp.fft`` to DFT *convolutions* (O(N^2) matmuls at
+operand_precision=highest) plus 67 MB-class internal layout copies per 2D
+transform (measured at 256^3 — scripts/probe_r4_hlo.py). Expressing the
+same DFT as explicit minor-axis dot_generals against f32 matrix constants
+is strictly better on this hardware:
+
+  * same MXU cost, none of the internal layout copies (measured 1.5 ms
+    faster on the 256^3 fused pair, scripts/probe_r4_dft2.py);
+  * Karatsuba 3-mult complex multiply (3 dots instead of 4);
+  * normalisation constants fold into the matrices (zero extra passes);
+  * works for ANY length, primes included, and supports half-spectrum
+    real transforms directly — no XLA C2R op, which sidesteps the TPU
+    backend's rank-3 irfft corruption (see stages._irfft_last);
+  * stages can stay PLANAR (separate re/im f32 arrays), avoiding the
+    X64SplitLow/High machinery XLA wraps around complex dtypes.
+
+Accuracy: HIGHEST-precision dots measure ~1e-7 relative error per pass
+vs numpy's FFT (256-point, scripts/probe_r4_dft.py); lower precisions
+fail the library's 1e-6 contract and are not offered.
+
+The O(N^2) flop count is intentional: at the stick/plane lengths this
+library sees (<= ~512) the MXU eats the DFT matmul at a higher effective
+rate than any O(N log N) decomposition we measured — a four-step radix-2
+split halves MXU flops but loses the gain to butterfly HBM passes
+(scripts/probe_r4_dft2.py). ``MATMUL_DFT_MAX`` caps the direct form;
+longer axes fall back to ``jnp.fft`` in ops.stages.
+
+Reference parity: these replace the reference's FFTW/cuFFT plan objects
+(reference: src/fft/fftw_plan_1d.hpp:74-94, src/fft/transform_1d_gpu.hpp)
+— the "plan" here is the matrix constant pair embedded in the executable.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: Longest axis the direct matmul-DFT handles; beyond this ops.stages
+#: falls back to jnp.fft (the O(N^2) flops would dominate, and no
+#: workload in the reference's envelope exceeds it).
+MATMUL_DFT_MAX = 512
+
+_HIGHEST = jax.lax.Precision.HIGHEST
+
+BACKWARD = +1   # unnormalised inverse DFT (e^{+2 pi i k n / N})
+FORWARD = -1    # plain DFT
+
+
+@functools.lru_cache(maxsize=None)
+def _dft_mats(n: int, sign: int, scale: float):
+    """(Cr, Ci, Cs) f32 numpy constants for the length-``n`` DFT with
+    ``scale`` folded in; Cs = Cr + Ci pre-summed for the Karatsuba form."""
+    k = np.arange(n)
+    m = np.exp(sign * 2j * np.pi * np.outer(k, k) / n) * scale
+    cr = np.ascontiguousarray(m.real.astype(np.float32))
+    ci = np.ascontiguousarray(m.imag.astype(np.float32))
+    return cr, ci, np.ascontiguousarray(cr + ci)
+
+
+@functools.lru_cache(maxsize=None)
+def _rdft_mats(n: int, scale: float):
+    """Forward real-to-halfspectrum matrices (n, n//2+1): Yr = X @ Cr,
+    Yi = X @ Ci (reference rfft layout, dim_x_freq = n//2+1 —
+    reference: src/parameters/parameters.cpp:49)."""
+    xf = n // 2 + 1
+    k = np.arange(xf)
+    m = np.exp(-2j * np.pi * np.outer(np.arange(n), k) / n) * scale
+    return (np.ascontiguousarray(m.real.astype(np.float32)),
+            np.ascontiguousarray(m.imag.astype(np.float32)))
+
+
+@functools.lru_cache(maxsize=None)
+def _irdft_mats(n: int, scale: float):
+    """Halfspectrum-to-real matrices (n//2+1, n): x = Yr @ A + Yi @ B.
+
+    From hermitian symmetry: x[m] = sum_k w[k] (Yr[k] cos(2 pi k m / n)
+    - Yi[k] sin(2 pi k m / n)) with w = 1 for the self-conjugate bins
+    (k=0 and, for even n, k=n/2) and 2 otherwise. The doubling absorbs
+    the missing negative-frequency half; no complex op and no XLA C2R
+    involved (the TPU backend's rank-3 irfft silently corrupts large
+    batches — see stages._irfft_last).
+    """
+    xf = n // 2 + 1
+    k = np.arange(xf)
+    w = np.full(xf, 2.0)
+    w[0] = 1.0
+    if n % 2 == 0:
+        w[-1] = 1.0
+    ang = 2 * np.pi * np.outer(k, np.arange(n)) / n
+    # x[m] = sum_k w Re(Y[k] e^{+i ang}) = sum_k w (Yr cos - Yi sin)
+    a = (w[:, None] * np.cos(ang)) * scale
+    b = (w[:, None] * -np.sin(ang)) * scale
+    return (np.ascontiguousarray(a.astype(np.float32)),
+            np.ascontiguousarray(b.astype(np.float32)))
+
+
+def _sub_rows(mats, rows):
+    """Row-select a matrix pair/triple: the split-x path applies the DFT
+    from only the occupied input positions ``rows`` (wrapped windows are
+    just non-contiguous row selections — no roll/pad stage needed)."""
+    rows = np.asarray(rows)
+    return tuple(np.ascontiguousarray(m[rows]) for m in mats)
+
+
+def _sub_cols(mats, cols):
+    """Column-select a matrix pair/triple: produce only the occupied
+    output positions ``cols``."""
+    cols = np.asarray(cols)
+    return tuple(np.ascontiguousarray(m[:, cols]) for m in mats)
+
+
+def _dot(a, c):
+    """(..., K) @ (K, M) -> (..., M) at HIGHEST precision."""
+    return jax.lax.dot_general(a, jnp.asarray(c),
+                               (((a.ndim - 1,), (0,)), ((), ())),
+                               precision=_HIGHEST)
+
+
+# -- planar complex DFT ------------------------------------------------------
+
+def pdft_last(xr, xi, mats):
+    """Complex DFT along the minor axis on planar operands.
+
+    Karatsuba 3-mult: P1 = Xr Cr, P2 = Xi Ci, P3 = (Xr+Xi)(Cr+Ci);
+    Yr = P1 - P2, Yi = P3 - P1 - P2 (the (Cr+Ci) sum is a plan-time
+    constant, so the extra operand add is on the small matrix, not the
+    data).
+    """
+    cr, ci, cs = mats
+    p1 = _dot(xr, cr)
+    p2 = _dot(xi, ci)
+    p3 = _dot(xr + xi, cs)
+    return p1 - p2, p3 - p1 - p2
+
+
+def cdft_last(x, mats):
+    """Complex-dtype wrapper of :func:`pdft_last` (drop-in inside jit:
+    XLA splits/joins the complex pair for free)."""
+    yr, yi = pdft_last(jnp.real(x), jnp.imag(x), mats)
+    return yr + 1j * yi
+
+
+# -- real transforms ---------------------------------------------------------
+
+def prdft_last(x, mats):
+    """Real forward DFT along the minor axis -> planar half spectrum
+    (..., n//2+1): two dots, half the flops of the complex form."""
+    a, b = mats
+    return _dot(x, a), _dot(x, b)
+
+
+def pirdft_last(yr, yi, mats):
+    """Planar half spectrum -> real inverse along the minor axis
+    (..., n): two dots; hermitian doubling folded into the matrices."""
+    a, b = mats
+    return _dot(yr, a) + _dot(yi, b)
+
+
+# -- stage-level helpers (mats builders with scale folding) ------------------
+
+def c2c_mats(n: int, sign: int, scale: float = 1.0):
+    """Matrices for a complex length-``n`` DFT; ``scale`` is folded in.
+    ``sign=BACKWARD`` with ``scale=1`` gives the library's unnormalised
+    inverse (ifft * n — docs/source/details.rst 'Transform Definition'
+    semantics, matching stages.z_backward)."""
+    if sign == BACKWARD:
+        # unnormalised inverse: e^{+...} with no 1/n — fold the caller's
+        # extra scale directly
+        return _dft_mats(n, +1, float(scale))
+    return _dft_mats(n, -1, float(scale))
+
+
+def r2c_mats(n: int, scale: float = 1.0):
+    return _rdft_mats(n, float(scale))
+
+
+def c2r_mats(n: int, scale: float = 1.0):
+    """Unnormalised inverse real transform: irfft * n equivalents."""
+    return _irdft_mats(n, float(scale))
+
+
+@functools.lru_cache(maxsize=None)
+def sub_rows_mats(n: int, sign: int, rows: tuple, scale: float = 1.0):
+    """Row-selected complex DFT matrices (cached per window): the
+    split-x contraction from the occupied positions only."""
+    return _sub_rows(c2c_mats(n, sign, scale), np.asarray(rows))
+
+
+@functools.lru_cache(maxsize=None)
+def sub_cols_mats(n: int, sign: int, cols: tuple, scale: float = 1.0):
+    """Column-selected complex DFT matrices (cached per window)."""
+    return _sub_cols(c2c_mats(n, sign, scale), np.asarray(cols))
+
+
+@functools.lru_cache(maxsize=None)
+def sub_rows_c2r_mats(n: int, rows: tuple, scale: float = 1.0):
+    """Row-selected inverse-real matrices: half-spectrum window -> dense
+    real axis (hermitian weights ride along with their rows)."""
+    return _sub_rows(c2r_mats(n, scale), np.asarray(rows))
+
+
+@functools.lru_cache(maxsize=None)
+def sub_cols_r2c_mats(n: int, cols: tuple, scale: float = 1.0):
+    """Column-selected forward-real matrices: real axis -> half-spectrum
+    window."""
+    return _sub_cols(r2c_mats(n, scale), np.asarray(cols))
+
+
+def use_matmul_dft(n: int, dtype) -> bool:
+    """Route a length-``n`` axis through the matmul DFT? TPU backend,
+    single precision, within the direct-form cap. CPU keeps pocketfft
+    (a real O(N log N) FFT); double precision keeps jnp.fft (f64 dots
+    are emulated and slow on TPU, and the double path is CPU-bound
+    anyway — docs/precision.md)."""
+    import os
+    single = jnp.dtype(dtype) in (jnp.dtype(jnp.float32),
+                                  jnp.dtype(jnp.complex64))
+    if os.environ.get("SPFFT_TPU_FORCE_MATMUL_DFT") == "1":
+        return single and n <= MATMUL_DFT_MAX  # force past the backend gate
+    if os.environ.get("SPFFT_TPU_NO_MATMUL_DFT") == "1":
+        return False
+    return (jax.default_backend() == "tpu" and n <= MATMUL_DFT_MAX
+            and single)

@@ -29,6 +29,17 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+from . import dft
+
+
+def _cdft_mid(x, mats):
+    """Complex matmul-DFT along axis -2: swap to minor, contract, swap
+    back. The pair of transposes replaces XLA fft2's internal layout
+    copies (same traffic class, fewer total passes — probe_r4_hlo)."""
+    y = dft.cdft_last(jnp.swapaxes(x, -1, -2), mats)
+    return jnp.swapaxes(y, -1, -2)
 
 
 # ---------------------------------------------------------------------------
@@ -111,8 +122,11 @@ def _mat(x):
 
 def z_backward(sticks):
     """Unnormalised inverse DFT along z for every stick:
-    ``ifft * dim_z`` (reference backward z, execution_host.cpp:311-315)."""
+    ``ifft * dim_z`` (reference backward z, execution_host.cpp:311-315).
+    TPU single-precision routes through the matmul DFT (ops.dft)."""
     dim_z = sticks.shape[-1]
+    if dft.use_matmul_dft(dim_z, sticks.dtype):
+        return dft.cdft_last(sticks, dft.c2c_mats(dim_z, dft.BACKWARD))
     return jnp.fft.ifft(_mat(sticks), axis=-1) \
         * sticks.real.dtype.type(dim_z)
 
@@ -120,6 +134,9 @@ def z_backward(sticks):
 def z_forward(sticks):
     """Forward DFT along z for every stick (reference forward z,
     execution_host.cpp:283-290)."""
+    dim_z = sticks.shape[-1]
+    if dft.use_matmul_dft(dim_z, sticks.dtype):
+        return dft.cdft_last(sticks, dft.c2c_mats(dim_z, dft.FORWARD))
     return jnp.fft.fft(_mat(sticks), axis=-1)
 
 
@@ -199,18 +216,26 @@ def xy_backward_c2c(grid):
     """Unnormalised inverse DFT over (y, x) per plane:
     ``ifft2 * (dim_y * dim_x)``.
 
-    The dense path: one XLA Fft HLO, used when the occupied x columns span
-    most of the extent. Narrow-x sets use the split variants below, which
-    implement the reference's y-over-non-empty-rows optimization
-    (execution_host.cpp:139-145, 328-352).
+    The dense path, used when the occupied x columns span most of the
+    extent. Narrow-x sets use the split variants below, which implement
+    the reference's y-over-non-empty-rows optimization
+    (execution_host.cpp:139-145, 328-352). TPU single precision runs the
+    matmul DFT per axis; other configurations use the XLA Fft HLO.
     """
     dim_y, dim_x = grid.shape[-2], grid.shape[-1]
     scale = grid.real.dtype.type(dim_y * dim_x)
+    if dft.use_matmul_dft(max(dim_y, dim_x), grid.dtype):
+        grid = dft.cdft_last(grid, dft.c2c_mats(dim_x, dft.BACKWARD))
+        return _cdft_mid(grid, dft.c2c_mats(dim_y, dft.BACKWARD))
     return jnp.fft.ifft2(_mat(grid), axes=(-2, -1)) * scale
 
 
 def xy_forward_c2c(grid):
     """Forward DFT over (y, x) per plane."""
+    dim_y, dim_x = grid.shape[-2], grid.shape[-1]
+    if dft.use_matmul_dft(max(dim_y, dim_x), grid.dtype):
+        grid = dft.cdft_last(grid, dft.c2c_mats(dim_x, dft.FORWARD))
+        return _cdft_mid(grid, dft.c2c_mats(dim_y, dft.FORWARD))
     return jnp.fft.fft2(_mat(grid), axes=(-2, -1))
 
 
@@ -246,8 +271,17 @@ def xy_backward_c2c_split(sub, x0: int, dim_x: int):
     those w columns (all other columns are zero, and ifft(0)=0), the
     result is zero-expanded back to full x extent, and the x-IFFT runs
     dense (the space-domain output is dense). Returns
-    (planes, dim_y, dim_x)."""
-    dim_y = sub.shape[-2]
+    (planes, dim_y, dim_x).
+
+    Matmul-DFT form: the x-stage contracts the w occupied rows of the
+    DFT matrix directly — a wrapped window is just a non-contiguous row
+    selection, no roll/pad stage."""
+    dim_y, w = sub.shape[-2], sub.shape[-1]
+    if dft.use_matmul_dft(max(dim_y, dim_x), sub.dtype):
+        sub = _cdft_mid(sub, dft.c2c_mats(dim_y, dft.BACKWARD))
+        rows = tuple(int(r) for r in (x0 + np.arange(w)) % dim_x)
+        return dft.cdft_last(
+            sub, dft.sub_rows_mats(dim_x, dft.BACKWARD, rows))
     scale = sub.real.dtype.type(dim_y * dim_x)
     sub = jnp.fft.ifft(_mat(sub), axis=-2)
     return jnp.fft.ifft(_mat(_expand_x_window(sub, x0, dim_x)), axis=-1) * scale
@@ -257,6 +291,12 @@ def xy_forward_c2c_split(space, x0: int, w: int):
     """Forward mirror of :func:`xy_backward_c2c_split`: dense x-DFT, then
     the y-DFT only on the occupied x columns ``[x0, x0+w) mod dim_x`` —
     the only columns the stick gather reads. Returns (planes, dim_y, w)."""
+    dim_y, dim_x = space.shape[-2], space.shape[-1]
+    if dft.use_matmul_dft(max(dim_y, dim_x), space.dtype):
+        cols = tuple(int(c) for c in (x0 + np.arange(w)) % dim_x)
+        grid = dft.cdft_last(
+            space, dft.sub_cols_mats(dim_x, dft.FORWARD, cols))
+        return _cdft_mid(grid, dft.c2c_mats(dim_y, dft.FORWARD))
     grid = jnp.fft.fft(_mat(space), axis=-1)
     return jnp.fft.fft(_mat(_extract_x_window(grid, x0, w)), axis=-2)
 
@@ -284,6 +324,11 @@ def xy_backward_r2c_split(sub, x0: int, dim_x: int, dim_x_freq: int):
     (planes, dim_y, dim_x). Reference: the per-selected-row vertical plan,
     transform_1d_host.hpp:137-196."""
     dim_y, w = sub.shape[-2], sub.shape[-1]
+    if dft.use_matmul_dft(max(dim_y, dim_x), sub.dtype):
+        sub = _cdft_mid(sub, dft.c2c_mats(dim_y, dft.BACKWARD))
+        rows = tuple(range(x0, x0 + w))
+        return dft.pirdft_last(jnp.real(sub), jnp.imag(sub),
+                               dft.sub_rows_c2r_mats(dim_x, rows))
     rdtype = sub.real.dtype
     sub = jnp.fft.ifft(_mat(sub), axis=-2) * rdtype.type(dim_y)
     full = jnp.pad(sub, ((0, 0), (0, 0), (x0, dim_x_freq - x0 - w)))
@@ -294,6 +339,12 @@ def xy_forward_r2c_split(space, x0: int, w: int):
     """Forward mirror of :func:`xy_backward_r2c_split`: dense r2c x-DFT,
     then the y-DFT only on the occupied half-spectrum columns. ``space``
     is real (planes, dim_y, dim_x); returns (planes, dim_y, w) complex."""
+    dim_y, dim_x = space.shape[-2], space.shape[-1]
+    if dft.use_matmul_dft(max(dim_y, dim_x), space.dtype):
+        cols = tuple(range(x0, x0 + w))
+        yr, yi = dft.prdft_last(space,
+                                dft.sub_cols_r2c_mats(dim_x, cols))
+        return _cdft_mid(yr + 1j * yi, dft.c2c_mats(dim_y, dft.FORWARD))
     grid = jnp.fft.rfft(_mat(space), axis=-1)
     return jnp.fft.fft(_mat(grid[..., x0:x0 + w]), axis=-2)
 
@@ -304,8 +355,15 @@ def xy_backward_r2c(grid, dim_x: int):
     ``grid`` is (planes, dim_y, dim_x//2+1) complex; returns real
     (planes, dim_y, dim_x). Mirrors reference backward_xy with the c2r
     x-transform (execution_host.cpp:344-351, transform_real_1d_host.hpp).
+    The matmul path needs no XLA C2R op at all (the hermitian doubling
+    lives in the c2r matrices — ops.dft), sidestepping the TPU backend's
+    rank-3 irfft corruption by construction.
     """
     dim_y = grid.shape[-2]
+    if dft.use_matmul_dft(max(dim_y, dim_x), grid.dtype):
+        grid = _cdft_mid(grid, dft.c2c_mats(dim_y, dft.BACKWARD))
+        return dft.pirdft_last(jnp.real(grid), jnp.imag(grid),
+                               dft.c2r_mats(dim_x))
     rdtype = grid.real.dtype
     grid = jnp.fft.ifft(_mat(grid), axis=-2) * rdtype.type(dim_y)
     return _irfft_last(_mat(grid), dim_x) * rdtype.type(dim_x)
@@ -317,6 +375,10 @@ def xy_forward_r2c(space):
     ``space`` is real (planes, dim_y, dim_x); returns
     (planes, dim_y, dim_x//2+1) complex.
     """
+    dim_y, dim_x = space.shape[-2], space.shape[-1]
+    if dft.use_matmul_dft(max(dim_y, dim_x), space.dtype):
+        yr, yi = dft.prdft_last(space, dft.r2c_mats(dim_x))
+        return _cdft_mid(yr + 1j * yi, dft.c2c_mats(dim_y, dft.FORWARD))
     grid = jnp.fft.rfft(_mat(space), axis=-1)
     return jnp.fft.fft(_mat(grid), axis=-2)
 

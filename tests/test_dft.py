@@ -1,0 +1,168 @@
+"""Matmul-DFT stage library vs numpy's FFT (the oracle the whole suite
+uses — SURVEY.md §4's dense-FFTW-oracle pattern applied at the stage
+level)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from spfft_tpu.ops import dft
+
+DIMS = [1, 2, 3, 11, 12, 13, 100, 256]
+
+
+def _rand(shape, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal(shape) + 1j
+            * rng.standard_normal(shape)).astype(np.complex64)
+
+
+@pytest.mark.parametrize("n", DIMS)
+def test_forward_c2c(n):
+    x = _rand((7, n))
+    got = np.asarray(dft.cdft_last(jnp.asarray(x),
+                                   dft.c2c_mats(n, dft.FORWARD)))
+    ref = np.fft.fft(x, axis=-1)
+    np.testing.assert_allclose(got, ref, atol=2e-4 * max(n, 1), rtol=2e-5)
+
+
+@pytest.mark.parametrize("n", DIMS)
+def test_backward_unnormalised(n):
+    x = _rand((5, n), seed=1)
+    got = np.asarray(dft.cdft_last(jnp.asarray(x),
+                                   dft.c2c_mats(n, dft.BACKWARD)))
+    ref = np.fft.ifft(x, axis=-1) * n
+    np.testing.assert_allclose(got, ref, atol=2e-4 * max(n, 1), rtol=2e-5)
+
+
+def test_scale_folding():
+    n = 16
+    x = _rand((3, n), seed=2)
+    got = np.asarray(dft.cdft_last(
+        jnp.asarray(x), dft.c2c_mats(n, dft.FORWARD, scale=1.0 / n)))
+    ref = np.fft.fft(x, axis=-1) / n
+    np.testing.assert_allclose(got, ref, atol=1e-5, rtol=1e-5)
+
+
+def test_planar_matches_complex():
+    n = 64
+    x = _rand((4, n), seed=3)
+    mats = dft.c2c_mats(n, dft.FORWARD)
+    yr, yi = dft.pdft_last(jnp.asarray(x.real.copy()),
+                           jnp.asarray(x.imag.copy()), mats)
+    ref = np.asarray(dft.cdft_last(jnp.asarray(x), mats))
+    np.testing.assert_allclose(np.asarray(yr) + 1j * np.asarray(yi), ref,
+                               atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("n", DIMS)
+def test_real_forward(n):
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((6, n)).astype(np.float32)
+    yr, yi = dft.prdft_last(jnp.asarray(x), dft.r2c_mats(n))
+    ref = np.fft.rfft(x, axis=-1)
+    got = np.asarray(yr) + 1j * np.asarray(yi)
+    np.testing.assert_allclose(got, ref, atol=2e-4 * max(n, 1), rtol=2e-5)
+
+
+@pytest.mark.parametrize("n", DIMS)
+def test_real_inverse_unnormalised(n):
+    rng = np.random.default_rng(5)
+    xf = n // 2 + 1
+    y = (rng.standard_normal((6, xf)) + 1j
+         * rng.standard_normal((6, xf))).astype(np.complex64)
+    # make the self-conjugate bins real so y is a valid half spectrum
+    y[:, 0] = y[:, 0].real
+    if n % 2 == 0:
+        y[:, -1] = y[:, -1].real
+    got = np.asarray(dft.pirdft_last(jnp.asarray(y.real.copy()),
+                                     jnp.asarray(y.imag.copy()),
+                                     dft.c2r_mats(n)))
+    ref = np.fft.irfft(y, n=n, axis=-1) * n
+    np.testing.assert_allclose(got, ref, atol=2e-4 * max(n, 1), rtol=2e-5)
+
+
+def test_sub_rows_window():
+    """Row-selected matrices = DFT of a sparse input laid out in a
+    (possibly wrapped) window — the split-x path's contraction."""
+    n = 32
+    rows = np.array([28, 29, 30, 31, 0, 1, 2])  # wrapped window
+    xw = _rand((3, len(rows)), seed=6)
+    full = np.zeros((3, n), np.complex64)
+    full[:, rows] = xw
+    mats = dft._sub_rows(dft.c2c_mats(n, dft.FORWARD), rows)
+    got = np.asarray(dft.cdft_last(jnp.asarray(xw), mats))
+    ref = np.fft.fft(full, axis=-1)
+    np.testing.assert_allclose(got, ref, atol=1e-4, rtol=1e-5)
+
+
+def test_sub_cols_window():
+    n = 32
+    cols = np.array([30, 31, 0, 1, 2])
+    x = _rand((3, n), seed=7)
+    mats = dft._sub_cols(dft.c2c_mats(n, dft.FORWARD), cols)
+    got = np.asarray(dft.cdft_last(jnp.asarray(x), mats))
+    ref = np.fft.fft(x, axis=-1)[:, cols]
+    np.testing.assert_allclose(got, ref, atol=1e-4, rtol=1e-5)
+
+
+@pytest.mark.parametrize("ttype,dims", [("c2c", (12, 13, 11)),
+                                        ("r2c", (13, 12, 8))])
+def test_plan_roundtrip_through_matmul_path(monkeypatch, ttype, dims):
+    """End-to-end plan through the forced matmul-DFT stages (the suite
+    runs on CPU where the backend gate would pick jnp.fft; CI keeps this
+    path exercised without a TPU)."""
+    monkeypatch.setenv("SPFFT_TPU_FORCE_MATMUL_DFT", "1")
+    from spfft_tpu import TransformType, make_local_plan
+
+    nx, ny, nz = dims
+    tt = TransformType.C2C if ttype == "c2c" else TransformType.R2C
+    if ttype == "c2c":
+        tri = np.array([(x, y, z) for x in range(nx) for y in range(ny)
+                        for z in range(nz)
+                        if (x + y + z) % 3 != 0], np.int64)
+    else:
+        # R2C contract (details.rst "Real-To-Complex"): sticks at stick
+        # granularity — x>0 sticks all z; x=0 sticks one of each +-y
+        # pair; the (0,0) stick one of each +-z pair.
+        tri = []
+        for x in range(1, nx // 2 + 1):
+            tri += [(x, y, z) for y in range(ny) for z in range(nz)
+                    if (x + y + z) % 3 != 0]
+        tri += [(0, y, z) for y in range(1, ny // 2 + 1)
+                for z in range(nz)]
+        tri += [(0, 0, z) for z in range(nz // 2 + 1)]
+        tri = np.array(tri, np.int64)
+    plan = make_local_plan(tt, nx, ny, nz, tri, precision="single")
+    rng = np.random.default_rng(8)
+    if ttype == "c2c":
+        vals = (rng.standard_normal(len(tri)) + 1j
+                * rng.standard_normal(len(tri))).astype(np.complex64)
+        cube = np.zeros((nz, ny, nx), np.complex64)
+        cube[tri[:, 2], tri[:, 1], tri[:, 0]] = vals
+        oracle = np.fft.ifftn(cube) * cube.size
+        got = np.asarray(plan.backward(vals))
+        got = got[..., 0] + 1j * got[..., 1]
+    else:
+        # build values from a real field so the half spectrum is valid
+        field = rng.standard_normal((nz, ny, nx)).astype(np.float32)
+        spec = np.fft.fftn(field)
+        vals = spec[tri[:, 2], tri[:, 1], tri[:, 0]].astype(np.complex64)
+        cube = np.zeros((nz, ny, nx), np.complex128)
+        # dense oracle: scatter the half-spectrum values + conjugates
+        for (x, y, z), v in zip(tri, vals):
+            cube[z, y, x] = v
+            cube[(-z) % nz, (-y) % ny, (-x) % nx] = np.conj(v)
+        oracle = np.fft.ifftn(cube).real * cube.size
+        got = np.asarray(plan.backward(vals))
+    err = np.linalg.norm(got - oracle) / max(np.linalg.norm(oracle), 1e-30)
+    assert err < 2e-5, err
+
+
+def test_use_matmul_dft_gating(monkeypatch):
+    monkeypatch.setenv("SPFFT_TPU_FORCE_MATMUL_DFT", "1")
+    assert dft.use_matmul_dft(256, jnp.complex64)
+    assert not dft.use_matmul_dft(dft.MATMUL_DFT_MAX + 1, jnp.complex64)
+    monkeypatch.delenv("SPFFT_TPU_FORCE_MATMUL_DFT")
+    monkeypatch.setenv("SPFFT_TPU_NO_MATMUL_DFT", "1")
+    assert not dft.use_matmul_dft(256, jnp.complex64)
