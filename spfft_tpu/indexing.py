@@ -178,6 +178,23 @@ class IndexPlan:
         return (self.stick_y * self.dim_x_freq + self.stick_x).astype(np.int32)
 
     @property
+    def scatter_cols_t(self) -> np.ndarray:
+        """Column index of each stick in the *y-innermost* frequency plane
+        ``(dim_x_freq, dim_y)`` flattened: ``x * dim_y + y`` — which is
+        exactly the stick key. The matmul-DFT pipeline keeps the plane
+        grid transposed (planes, x, y) through the y-stage so both xy
+        DFT axes contract on the minor dimension with a single transpose
+        pair per round trip (ops/dft.py)."""
+        return self.stick_keys.astype(np.int32)
+
+    @property
+    def col_inv_t(self) -> np.ndarray:
+        """Inverse of :attr:`scatter_cols_t` (see :func:`inverse_col_map`)."""
+        return inverse_col_map(self.scatter_cols_t,
+                               self.dim_x_freq * self.dim_y,
+                               self.num_sticks)
+
+    @property
     def slot_src(self) -> np.ndarray:
         """Inverse value map for the gather-based decompress (see
         :func:`inverse_slot_map`)."""

@@ -688,11 +688,16 @@ def monotone_gather(re, im, row0, out_tile, first, packed, *,
 
 
 def _monotone_gather_call(re, im, row0, out_tile, first, packed, *,
-                          span_rows: int, num_tiles: int, interpret: bool):
+                          span_rows: int, num_tiles: int, interpret: bool,
+                          carry=None):
     """One pallas_call over one chunk range (the whole table when
-    unsegmented)."""
+    unsegmented). ``carry`` as in :func:`_wide_gather_call`."""
     C = row0.shape[0]
     K = span_rows
+    if carry is not None:
+        return _monotone_gather_call_aliased(
+            re, im, row0, out_tile, first, packed, span_rows=K,
+            num_tiles=num_tiles, interpret=interpret, carry=carry)
     if re.ndim == 3:
         B = re.shape[0]
         grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -751,6 +756,76 @@ def _monotone_gather_call(re, im, row0, out_tile, first, packed, *,
         functools.partial(_kernel, K), out_shape=out_shape,
         grid_spec=grid_spec, interpret=interpret,
     )(row0, out_tile, first, packed, re, im)
+
+
+def _monotone_gather_call_aliased(re, im, row0, out_tile, first, packed, *,
+                                  span_rows: int, num_tiles: int,
+                                  interpret: bool, carry):
+    """Narrow-kernel launch writing into an ALIASED full-size output pair
+    (see _wide_gather_call's carry)."""
+    C = row0.shape[0]
+    K = span_rows
+    base = functools.partial(_kernel_batched if re.ndim == 3 else _kernel, K)
+    kern = lambda *r: base(*r[:6], *r[8:])  # drop the 2 unused carry refs
+    scratch = [
+        pltpu.VMEM((2, 2, K, TILE_LANE), jnp.float32),
+        pltpu.SemaphoreType.DMA((2, 2)),
+    ]
+    carry_specs = [pl.BlockSpec(memory_space=pl.ANY),
+                   pl.BlockSpec(memory_space=pl.ANY)]
+    aliases = {6: 0, 7: 1}
+    if re.ndim == 3:
+        B = re.shape[0]
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=(B, C),
+            in_specs=[
+                pl.BlockSpec((1, TILE_SUB, TILE_LANE),
+                             lambda b, g, r0, ot, fs: (g, 0, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ] + carry_specs,
+            out_specs=(
+                pl.BlockSpec((1, 1, TILE_SUB, TILE_LANE),
+                             lambda b, g, r0, ot, fs: (b, ot[g], 0, 0)),
+                pl.BlockSpec((1, 1, TILE_SUB, TILE_LANE),
+                             lambda b, g, r0, ot, fs: (b, ot[g], 0, 0)),
+            ),
+            scratch_shapes=scratch,
+        )
+        out_shape = (
+            jax.ShapeDtypeStruct((B, num_tiles, TILE_SUB, TILE_LANE),
+                                 jnp.float32),
+            jax.ShapeDtypeStruct((B, num_tiles, TILE_SUB, TILE_LANE),
+                                 jnp.float32))
+        return pl.pallas_call(
+            kern, out_shape=out_shape, grid_spec=grid_spec,
+            interpret=interpret, input_output_aliases=aliases,
+        )(row0, out_tile, first, packed, re, im, *carry)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(C,),
+        in_specs=[
+            pl.BlockSpec((1, TILE_SUB, TILE_LANE),
+                         lambda g, r0, ot, fs: (g, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ] + carry_specs,
+        out_specs=(
+            pl.BlockSpec((1, TILE_SUB, TILE_LANE),
+                         lambda g, r0, ot, fs: (ot[g], 0, 0)),
+            pl.BlockSpec((1, TILE_SUB, TILE_LANE),
+                         lambda g, r0, ot, fs: (ot[g], 0, 0)),
+        ),
+        scratch_shapes=scratch,
+    )
+    out_shape = (
+        jax.ShapeDtypeStruct((num_tiles, TILE_SUB, TILE_LANE), jnp.float32),
+        jax.ShapeDtypeStruct((num_tiles, TILE_SUB, TILE_LANE), jnp.float32))
+    return pl.pallas_call(
+        kern, out_shape=out_shape, grid_spec=grid_spec,
+        interpret=interpret, input_output_aliases=aliases,
+    )(row0, out_tile, first, packed, re, im, *carry)
 
 
 def pad_wide_tables_to(t: WideGatherTables, c_max: int):
@@ -960,7 +1035,11 @@ def wide_gather(re, im, row0, sub, out_tile, first, packed, *,
 
 def _wide_gather_call(re, im, row0, sub, out_tile, first, packed, *,
                       span_rows: int, kp_rows: int, p_tiles: int,
-                      num_super: int, interpret: bool):
+                      num_super: int, interpret: bool, carry=None):
+    """One wide launch. ``carry`` (segmented tables only): the previous
+    segment's full-size output pair, ALIASED into this launch's output —
+    blocks this segment's ``out_tile`` never names keep the carried
+    content, so multi-launch tables accumulate with zero copy traffic."""
     C = row0.shape[0]
     K, kp, P = span_rows, kp_rows, p_tiles
     kern = functools.partial(_kernel_wide_batched if re.ndim == 3
@@ -969,8 +1048,29 @@ def _wide_gather_call(re, im, row0, sub, out_tile, first, packed, *,
         pltpu.VMEM((2, 2, K, TILE_LANE), jnp.float32),
         pltpu.SemaphoreType.DMA((2, 2)),
     ]
+    aliases = {7: 0, 8: 1} if carry is not None else {}
+    carry_in = () if carry is None else tuple(carry)
+    carry_specs = [] if carry is None else [
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+    if carry is not None:
+        base = kern
+        kern = lambda *r: base(*r[:7], *r[9:])  # drop unused carry refs
     if re.ndim == 3:
         B = re.shape[0]
+        if B * C > WIDE_SEG_CHUNK_LIMIT:
+            # The compile-crash threshold (WIDE_SEG_CHUNK_LIMIT) is on
+            # the TOTAL grid step count; big batches run per slab
+            # (loses cross-batch DMA prefetch only).
+            outs = [_wide_gather_call(
+                re[b], im[b], row0, sub, out_tile, first, packed,
+                span_rows=K, kp_rows=kp, p_tiles=P, num_super=num_super,
+                interpret=interpret,
+                carry=None if carry is None else (carry[0][b], carry[1][b]))
+                for b in range(B)]
+            return (jnp.stack([o[0] for o in outs]),
+                    jnp.stack([o[1] for o in outs]))
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=4,  # row0, sub, out_tile, first
             grid=(B, C),
@@ -979,7 +1079,7 @@ def _wide_gather_call(re, im, row0, sub, out_tile, first, packed, *,
                              lambda b, g, r0, sb, ot, fs: (g, 0, 0)),
                 pl.BlockSpec(memory_space=pl.ANY),
                 pl.BlockSpec(memory_space=pl.ANY),
-            ],
+            ] + carry_specs,
             out_specs=(
                 pl.BlockSpec((1, P, TILE_SUB, TILE_LANE),
                              lambda b, g, r0, sb, ot, fs: (b, ot[g], 0, 0)),
@@ -995,8 +1095,8 @@ def _wide_gather_call(re, im, row0, sub, out_tile, first, packed, *,
                                  jnp.float32))
         return pl.pallas_call(
             kern, out_shape=out_shape, grid_spec=grid_spec,
-            interpret=interpret,
-        )(row0, sub, out_tile, first, packed, re, im)
+            interpret=interpret, input_output_aliases=aliases,
+        )(row0, sub, out_tile, first, packed, re, im, *carry_in)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,  # row0, sub, out_tile, first
         grid=(C,),
@@ -1005,7 +1105,7 @@ def _wide_gather_call(re, im, row0, sub, out_tile, first, packed, *,
                          lambda g, r0, sb, ot, fs: (g, 0, 0)),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
-        ],
+        ] + carry_specs,
         out_specs=(
             pl.BlockSpec((P, TILE_SUB, TILE_LANE),
                          lambda g, r0, sb, ot, fs: (ot[g], 0, 0)),
@@ -1021,35 +1121,91 @@ def _wide_gather_call(re, im, row0, sub, out_tile, first, packed, *,
                              jnp.float32))
     return pl.pallas_call(
         kern, out_shape=out_shape, grid_spec=grid_spec,
-        interpret=interpret,
-    )(row0, sub, out_tile, first, packed, re, im)
+        interpret=interpret, input_output_aliases=aliases,
+    )(row0, sub, out_tile, first, packed, re, im, *carry_in)
 
 
 # -- uniform dispatch over the two table kinds -------------------------------
 
 def gather_device_tables(t) -> tuple:
-    """The device-committed jnp arrays for either table kind, in the order
-    the matching runner expects."""
-    if isinstance(t, WideGatherTables):
-        return (jnp.asarray(t.row0), jnp.asarray(t.sub),
-                jnp.asarray(t.out_tile), jnp.asarray(t.first),
-                jnp.asarray(t.packed))
-    return (jnp.asarray(t.row0), jnp.asarray(t.out_tile),
-            jnp.asarray(t.first), jnp.asarray(t.packed))
+    """Device-committed tables for either kind: a tuple of per-SEGMENT
+    table tuples (one entry for unsegmented tables). Slicing happens here
+    at plan time — slicing shared tables inside the jitted hot path costs
+    a 25 MB copy per execution (probe_r4_hlo)."""
+    wide = isinstance(t, WideGatherTables)
+    segs = t.segs if t.segs else ((0, t.row0.shape[0], 0,
+                                   t.num_super if wide else t.num_tiles),)
+    out = []
+    for (c0, c1, t0, t1) in segs:
+        if wide:
+            out.append((jnp.asarray(t.row0[c0:c1]),
+                        jnp.asarray(t.sub[c0:c1]),
+                        jnp.asarray(t.out_tile[c0:c1]),
+                        jnp.asarray(t.first[c0:c1]),
+                        jnp.asarray(t.packed[c0:c1])))
+        else:
+            out.append((jnp.asarray(t.row0[c0:c1]),
+                        jnp.asarray(t.out_tile[c0:c1]),
+                        jnp.asarray(t.first[c0:c1]),
+                        jnp.asarray(t.packed[c0:c1])))
+    return tuple(out)
 
 
 def run_gather(re, im, dev_tables: tuple, t, interpret: bool = False):
     """Run whichever kernel matches ``t`` (WideGatherTables or
     MonotoneGatherTables) on planar sources; returns (out_re, out_im)
-    whose flat prefix holds the ``t.num_out`` output slots."""
-    if isinstance(t, WideGatherTables):
-        return wide_gather(re, im, *dev_tables, span_rows=t.span_rows,
-                           kp_rows=t.kp_rows, p_tiles=t.p_tiles,
-                           src_rows=t.src_rows, num_super=t.num_super,
-                           interpret=interpret, segs=t.segs)
-    return monotone_gather(re, im, *dev_tables, span_rows=t.span_rows,
-                           src_rows=t.src_rows, num_tiles=t.num_tiles,
-                           interpret=interpret, segs=t.segs)
+    whose flat prefix holds the ``t.num_out`` output slots.
+
+    Segmented tables run as one launch per segment. On real hardware the
+    segments ACCUMULATE into one output buffer via pallas input/output
+    aliasing (out_tile indices are absolute; blocks a segment never
+    visits retain the previous launch's content) — zero concatenation
+    traffic. Interpret mode keeps the concat path (the interpreter does
+    not preserve unwritten blocks of aliased outputs).
+    """
+    wide = isinstance(t, WideGatherTables)
+    segs = t.segs
+    if not segs:
+        if wide:
+            return _wide_gather_call(
+                re, im, *dev_tables[0], span_rows=t.span_rows,
+                kp_rows=t.kp_rows, p_tiles=t.p_tiles,
+                num_super=t.num_super, interpret=interpret)
+        return _monotone_gather_call(
+            re, im, *dev_tables[0], span_rows=t.span_rows,
+            num_tiles=t.num_tiles, interpret=interpret)
+    total = t.num_super if wide else t.num_tiles
+    if interpret:
+        outs = []
+        for (c0, c1, t0, t1), tabs in zip(segs, dev_tables):
+            if wide:
+                row0, sub, ot, first, packed = tabs
+                outs.append(_wide_gather_call(
+                    re, im, row0, sub, ot - t0, first, packed,
+                    span_rows=t.span_rows, kp_rows=t.kp_rows,
+                    p_tiles=t.p_tiles, num_super=t1 - t0,
+                    interpret=True))
+            else:
+                row0, ot, first, packed = tabs
+                outs.append(_monotone_gather_call(
+                    re, im, row0, ot - t0, first, packed,
+                    span_rows=t.span_rows, num_tiles=t1 - t0,
+                    interpret=True))
+        axis = 1 if re.ndim == 3 else 0
+        return (jnp.concatenate([o[0] for o in outs], axis=axis),
+                jnp.concatenate([o[1] for o in outs], axis=axis))
+    carry = None
+    for tabs in dev_tables:
+        if wide:
+            carry = _wide_gather_call(
+                re, im, *tabs, span_rows=t.span_rows, kp_rows=t.kp_rows,
+                p_tiles=t.p_tiles, num_super=total, interpret=False,
+                carry=carry)
+        else:
+            carry = _monotone_gather_call(
+                re, im, *tabs, span_rows=t.span_rows, num_tiles=total,
+                interpret=False, carry=carry)
+    return carry
 
 
 def run_gather_values(values_il, tables, device_tables=None,

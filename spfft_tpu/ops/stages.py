@@ -164,6 +164,16 @@ def sticks_to_grid(sticks, col_inv, dim_y: int, dim_x_freq: int):
     return grid_t.T.reshape(num_planes, dim_y, dim_x_freq)
 
 
+def sticks_to_grid_padded(sticks, col_inv, dim_y: int, dim_x_freq: int):
+    """:func:`sticks_to_grid` for stick arrays that already carry >= 1
+    trailing ZERO pad row (plans with compression tables — see
+    plan._s_pad): the sentinel ``num_sticks`` in ``col_inv`` selects a
+    pad row directly, so the zero-row concatenation (a full copy of the
+    stick array) disappears."""
+    num_planes = sticks.shape[1]
+    return sticks[col_inv].T.reshape(num_planes, dim_y, dim_x_freq)
+
+
 def grid_to_sticks(grid, scatter_cols):
     """Gather sticks out of the plane grid (reference forward pack,
     transpose_host.hpp:94-116).
@@ -192,6 +202,17 @@ def complete_stick_hermitian(stick):
     """
     mirror = jnp.roll(stick[::-1], 1)  # mirror[i] = stick[(N - i) % N]
     return jnp.where(stick != 0, stick, jnp.conj(mirror))
+
+
+def complete_plane_hermitian_t(grid_t):
+    """Transposed-layout variant of :func:`complete_plane_hermitian`:
+    ``grid_t`` is (planes, dim_x_freq, dim_y), so the x=0 column is the
+    contiguous sub-plane ``grid_t[:, 0, :]`` (the matmul-DFT pipeline's
+    plane layout — ops/dft.py)."""
+    col = grid_t[:, 0, :]
+    mirror = jnp.roll(col[:, ::-1], 1, axis=-1)
+    col = jnp.where(col != 0, col, jnp.conj(mirror))
+    return grid_t.at[:, 0, :].set(col)
 
 
 def complete_plane_hermitian(grid):
@@ -406,9 +427,12 @@ compress = _named(compress, "compress")
 z_backward = _named(z_backward, "z_backward")
 z_forward = _named(z_forward, "z_forward")
 sticks_to_grid = _named(sticks_to_grid, "unpack")
+sticks_to_grid_padded = _named(sticks_to_grid_padded, "unpack")
 grid_to_sticks = _named(grid_to_sticks, "pack")
 complete_stick_hermitian = _named(complete_stick_hermitian, "stick_symmetry")
 complete_plane_hermitian = _named(complete_plane_hermitian, "plane_symmetry")
+complete_plane_hermitian_t = _named(complete_plane_hermitian_t,
+                                    "plane_symmetry")
 xy_backward_c2c = _named(xy_backward_c2c, "xy_backward")
 xy_forward_c2c = _named(xy_forward_c2c, "xy_forward")
 xy_backward_r2c = _named(xy_backward_r2c, "xy_backward")

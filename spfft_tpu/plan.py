@@ -83,6 +83,15 @@ class TransformPlan:
         self._rdt = real_dtype(precision)
         self._cdt = complex_dtype(precision)
         self._pair_io = index_plan.num_values >= PAIR_IO_THRESHOLD
+        from .ops import dft as _dft
+        #: Matmul-DFT (T-layout) pipeline: every DFT contracts the minor
+        #: axis against plan-time matrices, the plane grid stays
+        #: transposed (planes, x, y) through the y-stage, and the round
+        #: trip pays ONE transpose pair instead of XLA fft2's four
+        #: internal layout copies (ops/dft.py; scripts/probe_r4_dft2.py).
+        self._use_mdft = _dft.use_matmul_dft(
+            max(index_plan.dim_x, index_plan.dim_y, index_plan.dim_z),
+            self._cdt)
         if self._pair_io:
             # Layout flip is observable by callers (forward/apply_pointwise
             # return (2, N) instead of (N, 2)); say so once at plan build.
@@ -99,11 +108,32 @@ class TransformPlan:
         # executable is slower on remote-attached TPUs.
         self._tables = {
             "slot_src": jnp.asarray(index_plan.slot_src),
-            "col_inv": jnp.asarray(index_plan.col_inv),
             "value_indices": jnp.asarray(index_plan.value_indices),
             "scatter_cols": jnp.asarray(index_plan.scatter_cols),
         }
+        if self._use_mdft:
+            self._tables["col_inv_t"] = jnp.asarray(index_plan.col_inv_t)
+            self._tables["scatter_cols_t"] = jnp.asarray(
+                index_plan.scatter_cols_t)
+        else:
+            self._tables["col_inv"] = jnp.asarray(index_plan.col_inv)
         self._init_pallas(use_pallas)
+        if self._s_pad > index_plan.num_sticks:
+            # Stick-pad tables (see _init_pallas): the decompress map
+            # sends pad slots to the zero sentinel, and the pack tables
+            # gather column 0 into the pad rows (their content is never
+            # read — compression only touches real value indices).
+            extra = self._s_pad - index_plan.num_sticks
+            self._tables["slot_src"] = jnp.asarray(np.concatenate(
+                [index_plan.slot_src,
+                 np.full(extra * index_plan.dim_z, index_plan.num_values,
+                         np.int32)]))
+            pads = np.zeros(extra, np.int32)
+            self._tables["scatter_cols"] = jnp.asarray(
+                np.concatenate([index_plan.scatter_cols, pads]))
+            if self._use_mdft:
+                self._tables["scatter_cols_t"] = jnp.asarray(
+                    np.concatenate([index_plan.scatter_cols_t, pads]))
         self._init_split_x()
         self._batched = None
         self._pair_jits = {}
@@ -137,6 +167,13 @@ class TransformPlan:
         p = self.index_plan
         self._pallas = None
         self._pallas_active = False
+        #: Stick rows of the packed stick array. Plans with compression
+        #: tables pad to the next multiple of 32 past num_sticks: the pad
+        #: sticks are zeros, so (a) the unpack gather needs NO sentinel
+        #: concatenation (a 53 MB copy at 256^3 — probe_r4_hlo), and (b)
+        #: dim_z % 4 == 0 grids make num_slots a whole number of kernel
+        #: tiles, turning the kernel-output reshape into a bitcast.
+        self._s_pad = p.num_sticks
         backend_ok = jax.default_backend() == "tpu"
         if use_pallas is True and self.precision != "single":
             raise InvalidParameterError(
@@ -155,7 +192,8 @@ class TransformPlan:
         if p.num_values == 0 or p.num_sticks == 0:
             return
         vi = p.value_indices.astype(np.int64)
-        num_slots = p.num_sticks * p.dim_z
+        self._s_pad = -(-(p.num_sticks + 1) // 32) * 32
+        num_slots = self._s_pad * p.dim_z
         (dec_idx, occupied), (cmp_idx, cmp_valid) = \
             gk.compression_gather_inputs(vi, num_slots)
         dec = gk.build_best_gather_tables(dec_idx, occupied, p.num_values)
@@ -176,6 +214,7 @@ class TransformPlan:
                 " and ".join(fell_back))
         if dec is None and cmp_ is None:
             self._pallas = None
+            self._s_pad = p.num_sticks
             return
         self._pallas_active = backend_ok
         for name, t in (("dec", dec), ("cmp", cmp_)):
@@ -206,11 +245,24 @@ class TransformPlan:
         x0, w = occupied_x_window(xs, xf, allow_wrap=not self._is_r2c)
         if w > 0.7 * xf:
             return
-        cols_sub = window_sub_cols(p.scatter_cols, xf, x0, w)
-        col_inv_sub = inverse_col_map(cols_sub, p.dim_y * w, p.num_sticks)
         self._split_x = (x0, w)
-        self._tables["col_inv_sub"] = jnp.asarray(col_inv_sub)
-        self._tables["scatter_cols_sub"] = jnp.asarray(cols_sub)
+        pads = np.zeros(self._s_pad - p.num_sticks, np.int32)
+        if self._use_mdft:
+            # T layout: window-x-major columns x_w * dim_y + y
+            x_w = (p.stick_x.astype(np.int64) - x0) % xf
+            cols_sub_t = (x_w * p.dim_y
+                          + p.stick_y.astype(np.int64)).astype(np.int32)
+            self._tables["col_inv_sub_t"] = jnp.asarray(
+                inverse_col_map(cols_sub_t, w * p.dim_y, p.num_sticks))
+            self._tables["scatter_cols_sub_t"] = jnp.asarray(
+                np.concatenate([cols_sub_t, pads]))
+        else:
+            cols_sub = window_sub_cols(p.scatter_cols, xf, x0, w)
+            col_inv_sub = inverse_col_map(cols_sub, p.dim_y * w,
+                                          p.num_sticks)
+            self._tables["col_inv_sub"] = jnp.asarray(col_inv_sub)
+            self._tables["scatter_cols_sub"] = jnp.asarray(
+                np.concatenate([cols_sub, pads]))
 
     @property
     def pallas_active(self) -> bool:
@@ -283,7 +335,7 @@ class TransformPlan:
             if self._pair_io and values_il.shape[0] == 2:
                 values_il = values_il.T  # pair boundary -> rows, XLA path
             return stages.decompress(values_il.astype(self._rdt),
-                                     tables["slot_src"], p.num_sticks,
+                                     tables["slot_src"], self._s_pad,
                                      p.dim_z)
         from .ops import gather_kernel as gk
         t = self._pallas["dec"]
@@ -292,7 +344,7 @@ class TransformPlan:
         out_re, out_im = gk.run_gather(re, im, tables["dec_tabs"], t)
         flat = (out_re.reshape(-1)[:t.num_out]
                 + 1j * out_im.reshape(-1)[:t.num_out])
-        return flat.reshape(p.num_sticks, p.dim_z)
+        return flat.reshape(self._s_pad, p.dim_z)
 
     def _compress(self, sticks, tables, scale, pallas=True):
         p = self.index_plan
@@ -310,18 +362,93 @@ class TransformPlan:
             values = values * jnp.asarray(scale, values.dtype)
         return values
 
+    def _backward_rest_t(self, sticks, tables):
+        """Matmul-DFT T-layout tail of backward: z-DFT on sticks, unpack
+        into the TRANSPOSED plane grid (planes, x, y), y-DFT on the minor
+        axis, one swap, then the x-stage — the only transpose of the
+        backward half (see _use_mdft)."""
+        from .ops import dft
+        p = self.index_plan
+        if self._is_r2c and p.zero_stick_id is not None:
+            zid = p.zero_stick_id
+            sticks = sticks.at[zid].set(
+                stages.complete_stick_hermitian(sticks[zid]))
+        sticks = dft.cdft_last(sticks, dft.c2c_mats(p.dim_z, dft.BACKWARD))
+        xf = p.dim_x_freq
+        unpack = stages.sticks_to_grid_padded \
+            if self._s_pad > p.num_sticks else stages.sticks_to_grid
+        if self._split_x is not None:
+            x0, w = self._split_x
+            grid_t = unpack(sticks, tables["col_inv_sub_t"], w, p.dim_y)
+            rows = tuple(int(r) for r in (x0 + np.arange(w)) % xf)
+        else:
+            x0, w = 0, xf
+            grid_t = unpack(sticks, tables["col_inv_t"], xf, p.dim_y)
+            rows = None
+        if self._is_r2c and x0 == 0:
+            grid_t = stages.complete_plane_hermitian_t(grid_t)
+        grid_t = dft.cdft_last(grid_t, dft.c2c_mats(p.dim_y, dft.BACKWARD))
+        grid = jnp.swapaxes(grid_t, -1, -2)
+        if self._is_r2c:
+            mats = dft.c2r_mats(p.dim_x) if rows is None \
+                else dft.sub_rows_c2r_mats(p.dim_x, rows)
+            return dft.pirdft_last(jnp.real(grid), jnp.imag(grid), mats)
+        mats = dft.c2c_mats(p.dim_x, dft.BACKWARD) if rows is None \
+            else dft.sub_rows_mats(p.dim_x, dft.BACKWARD, rows)
+        return complex_to_interleaved(dft.cdft_last(grid, mats))
+
+    def _forward_head_t(self, space, tables, scale):
+        """Matmul-DFT T-layout head of forward: x-stage on the minor
+        axis, one swap into the transposed grid, y-DFT minor, pack, then
+        the z-DFT with any FULL scaling folded into its matrix (no
+        separate scale pass)."""
+        from .ops import dft
+        p = self.index_plan
+        xf = p.dim_x_freq
+        if self._split_x is not None:
+            x0, w = self._split_x
+            cols = tuple(int(c) for c in (x0 + np.arange(w)) % xf)
+            if self._is_r2c:
+                yr, yi = dft.prdft_last(space.astype(self._rdt),
+                                        dft.sub_cols_r2c_mats(p.dim_x, cols))
+                g = yr + 1j * yi
+            else:
+                g = dft.cdft_last(
+                    interleaved_to_complex(space).astype(self._cdt),
+                    dft.sub_cols_mats(p.dim_x, dft.FORWARD, cols))
+            cols_tab = tables["scatter_cols_sub_t"]
+        else:
+            if self._is_r2c:
+                yr, yi = dft.prdft_last(space.astype(self._rdt),
+                                        dft.r2c_mats(p.dim_x))
+                g = yr + 1j * yi
+            else:
+                g = dft.cdft_last(
+                    interleaved_to_complex(space).astype(self._cdt),
+                    dft.c2c_mats(p.dim_x, dft.FORWARD))
+            cols_tab = tables["scatter_cols_t"]
+        g = jnp.swapaxes(g, -1, -2)
+        g = dft.cdft_last(g, dft.c2c_mats(p.dim_y, dft.FORWARD))
+        sticks = stages.grid_to_sticks(g, cols_tab)
+        return dft.cdft_last(
+            sticks, dft.c2c_mats(p.dim_z, dft.FORWARD,
+                                 scale=scale if scale else 1.0))
+
     def _backward_rest(self, sticks, tables):
         """Everything after decompress: symmetry, z-IFFT, unpack, xy-IFFT."""
+        if self._use_mdft:
+            return self._backward_rest_t(sticks, tables)
         p = self.index_plan
         if self._is_r2c and p.zero_stick_id is not None:
             zid = p.zero_stick_id
             sticks = sticks.at[zid].set(
                 stages.complete_stick_hermitian(sticks[zid]))
         sticks = stages.z_backward(sticks)
+        unpack = stages.sticks_to_grid_padded \
+            if self._s_pad > p.num_sticks else stages.sticks_to_grid
         if self._split_x is not None:
             x0, w = self._split_x
-            sub = stages.sticks_to_grid(sticks, tables["col_inv_sub"],
-                                        p.dim_y, w)
+            sub = unpack(sticks, tables["col_inv_sub"], p.dim_y, w)
             if self._is_r2c:
                 if x0 == 0:
                     sub = stages.complete_plane_hermitian(sub)
@@ -329,8 +456,7 @@ class TransformPlan:
                                                     p.dim_x_freq)
             return complex_to_interleaved(
                 stages.xy_backward_c2c_split(sub, x0, p.dim_x))
-        grid = stages.sticks_to_grid(sticks, tables["col_inv"], p.dim_y,
-                                     p.dim_x_freq)
+        grid = unpack(sticks, tables["col_inv"], p.dim_y, p.dim_x_freq)
         if self._is_r2c:
             grid = stages.complete_plane_hermitian(grid)
             return stages.xy_backward_r2c(grid, p.dim_x)
@@ -340,8 +466,11 @@ class TransformPlan:
         return self._backward_rest(
             self._decompress(values_il, tables, pallas), tables)
 
-    def _forward_head(self, space, tables):
-        """Everything before compress: xy-FFT, pack, z-FFT -> sticks."""
+    def _forward_head(self, space, tables, scale=None):
+        """Everything before compress: xy-FFT, pack, z-FFT -> sticks.
+        ``scale`` (mdft path only) folds FULL scaling into the z matrix."""
+        if self._use_mdft:
+            return self._forward_head_t(space, tables, scale)
         if self._is_r2c:
             if self._split_x is not None:
                 x0, w = self._split_x
@@ -365,8 +494,11 @@ class TransformPlan:
         return stages.z_forward(sticks)
 
     def _forward_impl(self, space, tables, *, scaled: bool, pallas=True):
-        sticks = self._forward_head(space, tables)
         scale = 1.0 / self.global_size if scaled else None
+        if self._use_mdft:  # scale folded into the z-DFT matrix
+            sticks = self._forward_head(space, tables, scale)
+            return self._compress(sticks, tables, None, pallas)
+        sticks = self._forward_head(space, tables)
         return self._compress(sticks, tables, scale, pallas)
 
     # -- batched execution ---------------------------------------------------
@@ -381,7 +513,7 @@ class TransformPlan:
             return jax.vmap(
                 lambda v: stages.decompress(v.astype(self._rdt),
                                             tables["slot_src"],
-                                            p.num_sticks, p.dim_z))(values_b)
+                                            self._s_pad, p.dim_z))(values_b)
         from .ops import gather_kernel as gk
         t = self._pallas["dec"]
         re, im = gk.planar_from_interleaved(values_b.astype(np.float32),
@@ -391,7 +523,7 @@ class TransformPlan:
         B = values_b.shape[0]
         flat = (out_re.reshape(B, -1)[:, :t.num_out]
                 + 1j * out_im.reshape(B, -1)[:, :t.num_out])
-        return flat.reshape(B, p.num_sticks, p.dim_z)
+        return flat.reshape(B, self._s_pad, p.dim_z)
 
     def _compress_batched(self, sticks_b, tables, scale):
         """(B, num_sticks, dim_z) -> (B, num_values, 2) — or the planar
@@ -418,9 +550,14 @@ class TransformPlan:
                         in_axes=(0, None))(sticks_b, tables)
 
     def _forward_impl_batched(self, space_b, tables, *, scaled: bool):
+        scale = 1.0 / self.global_size if scaled else None
+        if self._use_mdft:
+            sticks_b = jax.vmap(
+                lambda s, t: self._forward_head(s, t, scale),
+                in_axes=(0, None))(space_b, tables)
+            return self._compress_batched(sticks_b, tables, None)
         sticks_b = jax.vmap(self._forward_head,
                             in_axes=(0, None))(space_b, tables)
-        scale = 1.0 / self.global_size if scaled else None
         return self._compress_batched(sticks_b, tables, scale)
 
     def _batched_jits(self):

@@ -157,7 +157,8 @@ def test_plan_pallas_path_interpret():
     sticks = np.asarray(gk.run_monotone_gather(jnp.asarray(src_il), t,
                                                interpret=True))
     ip = pl_plan.index_plan
-    expect = np.zeros((ip.num_sticks * n, 2), np.float32)
+    # tables cover the padded stick rows (plan._s_pad); pad slots are zero
+    expect = np.zeros((pl_plan._s_pad * n, 2), np.float32)
     expect[ip.value_indices] = src_il
     np.testing.assert_array_equal(sticks, expect)
 
@@ -209,7 +210,7 @@ def test_plan_shuffled_triplets_kernel_path():
     # decompress: slots in plan storage order from user-order values
     sticks = np.asarray(gk.run_monotone_gather(
         jnp.asarray(vals_il), plan._pallas["dec"], interpret=True))
-    expect = np.zeros((ip.num_sticks * n, 2), np.float32)
+    expect = np.zeros((plan._s_pad * n, 2), np.float32)
     expect[ip.value_indices] = vals_il
     np.testing.assert_array_equal(sticks, expect)
     # compress: user-order values back out of the slots
@@ -438,10 +439,8 @@ def test_wide_batched_split_over_step_budget():
     old = gk.WIDE_SEG_CHUNK_LIMIT
     gk.WIDE_SEG_CHUNK_LIMIT = 2 * t.row0.shape[0]  # B=3 crosses, C alone not
     try:
-        out_re, out_im = gk.wide_gather(
-            re, im, *gk.gather_device_tables(t), span_rows=t.span_rows,
-            kp_rows=t.kp_rows, p_tiles=t.p_tiles, src_rows=t.src_rows,
-            num_super=t.num_super, interpret=True)
+        out_re, out_im = gk.run_gather(re, im, gk.gather_device_tables(t),
+                                       t, interpret=True)
     finally:
         gk.WIDE_SEG_CHUNK_LIMIT = old
     got = np.asarray(gk.interleaved_from_planar(out_re, out_im, t.num_out))

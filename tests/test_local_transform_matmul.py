@@ -1,0 +1,19 @@
+"""Re-run the whole local-transform oracle matrix through the forced
+matmul-DFT path (ops/dft.py + the plan's T-layout pipeline).
+
+The suite runs on CPU, where the backend gate would route every FFT to
+jnp.fft; this module forces the matmul path for all tests it re-imports
+so CI exercises the TPU pipeline structure without a TPU. Double-
+precision cases inside still fall back (the gate respects dtype), which
+is itself the behavior under test.
+"""
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _force_matmul_dft(monkeypatch):
+    monkeypatch.setenv("SPFFT_TPU_FORCE_MATMUL_DFT", "1")
+
+
+from tests.test_local_transform import *  # noqa: F401,F403,E402
