@@ -64,19 +64,19 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_int64, ctypes.c_int32]
     lib.spfft_tpu_wide_tables_plan.restype = ctypes.c_int32
     lib.spfft_tpu_wide_tables_plan.argtypes = [
-        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
-        ctypes.c_int32, ctypes.c_int32, ctypes.c_void_p, ctypes.c_void_p,
-        ctypes.c_void_p]
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_void_p]
     lib.spfft_tpu_compression_inputs.restype = ctypes.c_int32
     lib.spfft_tpu_compression_inputs.argtypes = [
         ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p,
         ctypes.c_void_p]
     lib.spfft_tpu_wide_tables_fill.restype = ctypes.c_int32
     lib.spfft_tpu_wide_tables_fill.argtypes = [
-        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
-        ctypes.c_int32, ctypes.c_int32, ctypes.c_int64, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int64,
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
-        ctypes.c_void_p]
+        ctypes.c_void_p, ctypes.c_void_p]
     return lib
 
 
@@ -169,7 +169,8 @@ def compression_inputs(value_indices: np.ndarray, num_slots: int):
 
 
 def wide_gather_tables(idx: np.ndarray, valid: np.ndarray, *,
-                       p_tiles: int, kp_rows: int, k_rows: int):
+                       num_src: int, p_tiles: int, kp_rows: int,
+                       k_rows: int):
     """Native wide-gather table build (the cover loop of
     ops/gather_kernel.build_wide_gather_tables — its NumPy version is the
     executable specification and the fallback).
@@ -188,8 +189,9 @@ def wide_gather_tables(idx: np.ndarray, valid: np.ndarray, *,
     k_o = ctypes.c_int32(0)
     c_o = ctypes.c_int64(0)
     st = lib.spfft_tpu_wide_tables_plan(
-        idx64.ctypes.data, val8.ctypes.data, L, p_tiles, kp_rows, k_rows,
-        ctypes.byref(kp_o), ctypes.byref(k_o), ctypes.byref(c_o))
+        idx64.ctypes.data, val8.ctypes.data, L, int(num_src), p_tiles,
+        kp_rows, k_rows, ctypes.byref(kp_o), ctypes.byref(k_o),
+        ctypes.byref(c_o))
     if st == -1:
         raise WideCoverBlowup()  # caller falls back
     if st != 0:
@@ -202,8 +204,8 @@ def wide_gather_tables(idx: np.ndarray, valid: np.ndarray, *,
     packed = np.empty((C, p_tiles * 8, 128), np.int16)
     mx = ctypes.c_int32(0)
     st = lib.spfft_tpu_wide_tables_fill(
-        idx64.ctypes.data, val8.ctypes.data, L, p_tiles, kp, K, C,
-        row0.ctypes.data, sub.ctypes.data, out_tile.ctypes.data,
+        idx64.ctypes.data, val8.ctypes.data, L, int(num_src), p_tiles, kp,
+        K, C, row0.ctypes.data, sub.ctypes.data, out_tile.ctypes.data,
         first.ctypes.data, packed.ctypes.data, ctypes.byref(mx))
     if st != 0:  # pragma: no cover - phase disagreement would be a bug
         return None

@@ -166,6 +166,9 @@ struct WideGeom {
   int kp;
   int K;
   int64_t G_s;
+  int32_t r_clamp;  // max allowed row0 (kBig = unclamped): keeps every
+                    // DMA window inside ceil(num_src/128) source rows so
+                    // the runtime needs no source zero-padding pass
 };
 
 // Per-super-tile cover: returns the chunk count; when fill outputs are
@@ -206,11 +209,14 @@ int64_t cover_super_tile(const int64_t* idx_p, const uint8_t* valid_p,
       if (b < r0) r0 = b;
     }
     if (r0 == kBig) r0 = 0;
+    if (r0 > g.r_clamp) r0 = g.r_clamp;
     bool inwin[8];
     int32_t basec[8];
     for (int p = 0; p < P; ++p) {
-      inwin[p] = hasu[p] && base[p] <= r0 + (K - kp);
-      basec[p] = inwin[p] ? base[p] : r0;
+      // sub-window saturates at the window top so tail rows stay
+      // coverable when r0 is clamped (see r_clamp)
+      inwin[p] = hasu[p] && base[p] <= r0 + (K - 1);
+      basec[p] = inwin[p] ? std::min(base[p], r0 + (K - kp)) : r0;
     }
     if (row0_out != nullptr) {
       const int64_t cc = base_c + c;
@@ -277,9 +283,10 @@ extern "C" {
 // success writes kp/K/C and returns 0; returns -1 when the cover exceeds
 // the blowup limit (caller falls back), -2 on invalid arguments.
 int32_t spfft_tpu_wide_tables_plan(const int64_t* idx, const uint8_t* valid,
-                                   int64_t L, int32_t P, int32_t kp_in,
-                                   int32_t k_in, int32_t* kp_out,
-                                   int32_t* k_out, int64_t* c_out) {
+                                   int64_t L, int64_t num_src, int32_t P,
+                                   int32_t kp_in, int32_t k_in,
+                                   int32_t* kp_out, int32_t* k_out,
+                                   int64_t* c_out) {
   if (L <= 0 || P != 8) return -2;
   const int64_t SUPER = int64_t(P) * kTile;
   const int64_t G_s = (L + SUPER - 1) / SUPER;
@@ -374,7 +381,11 @@ int32_t spfft_tpu_wide_tables_plan(const int64_t* idx, const uint8_t* valid,
   }
   if (K - kp > 255) K = kp + 248;
 
-  const WideGeom geom{P, kp, K, G_s};
+  int32_t r_clamp = kBig;
+  const int64_t r_exact = (num_src + kLane - 1) / kLane;
+  if (num_src > 0 && r_exact >= K)
+    r_clamp = static_cast<int32_t>(r_exact - K);
+  const WideGeom geom{P, kp, K, G_s, r_clamp};
   const int64_t limit = 16 * G_s + 64;
   std::vector<int64_t> counts(G_s);
   bool blowup = false;
@@ -398,8 +409,9 @@ int32_t spfft_tpu_wide_tables_plan(const int64_t* idx, const uint8_t* valid,
 //   packed[C * P * 1024] i16, max_row0_out (for src_rows).
 // Returns 0, or -2 if the recomputed chunk count disagrees with C.
 int32_t spfft_tpu_wide_tables_fill(const int64_t* idx, const uint8_t* valid,
-                                   int64_t L, int32_t P, int32_t kp,
-                                   int32_t K, int64_t C, int32_t* row0,
+                                   int64_t L, int64_t num_src, int32_t P,
+                                   int32_t kp, int32_t K, int64_t C,
+                                   int32_t* row0,
                                    int32_t* sub, int32_t* out_tile,
                                    int32_t* first, int16_t* packed,
                                    int32_t* max_row0_out) {
@@ -415,7 +427,11 @@ int32_t spfft_tpu_wide_tables_fill(const int64_t* idx, const uint8_t* valid,
     idx_p[i] = idx[L - 1];
     valid_p[i] = 0;
   }
-  const WideGeom geom{P, kp, K, G_s};
+  int32_t r_clamp = kBig;
+  const int64_t r_exact = (num_src + kLane - 1) / kLane;
+  if (num_src > 0 && r_exact >= K)
+    r_clamp = static_cast<int32_t>(r_exact - K);
+  const WideGeom geom{P, kp, K, G_s, r_clamp};
   const int64_t limit = 16 * G_s + 64;
 
   std::vector<int64_t> counts(G_s);

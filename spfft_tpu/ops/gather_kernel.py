@@ -341,6 +341,15 @@ def build_wide_gather_tables(idx: np.ndarray, valid: np.ndarray,
                             int(np.ceil((q + kp) / 8.0) * 8)))
     if K - kp > 255:
         K = kp + 248  # sub-window offsets are byte-packed
+    # Clamp window starts so every DMA window lies inside the EXACT source
+    # extent ceil(num_src/128): src_rows then equals the exact extent and
+    # the runtime's source zero-padding pass (a 53 MB copy per direction at
+    # 256^3 — probe_r4_hlo) disappears. A clamped round covers fewer tiles
+    # and simply takes another round; tiny sources (r_exact < K) keep the
+    # padded form.
+    r_exact = -(-int(num_src) // TILE_LANE)
+    r_clamp = np.int32(r_exact - K) if (num_src > 0 and r_exact >= K) \
+        else None
 
     # Multi-round cover: each round emits one chunk per still-active
     # super-tile. The minimum-base tile is always inside the window, so
@@ -363,8 +372,14 @@ def build_wide_gather_tables(idx: np.ndarray, valid: np.ndarray,
         hasu = av.any(axis=2)
         r0 = np.where(hasu, base, BIG).min(axis=1)
         r0 = np.where(r0 == BIG, 0, r0).astype(np.int32)
-        inwin = hasu & (base <= r0[:, None] + (K - kp))
-        basec = np.where(inwin, base, r0[:, None])
+        if r_clamp is not None:
+            r0 = np.minimum(r0, r_clamp)
+        # A tile participates if any of its rows fall inside the DMA
+        # window; its kp-row sub-window saturates at the window top so
+        # tail rows stay coverable when r0 is clamped (see r_clamp).
+        inwin = hasu & (base <= r0[:, None] + (K - 1))
+        basec = np.where(inwin, np.minimum(base, r0[:, None] + (K - kp)),
+                         r0[:, None])
         cover = av & inwin[:, :, None] \
             & (ar >= basec[:, :, None]) & (ar < basec[:, :, None] + kp)
         sub_rel = np.clip(basec - r0[:, None], 0, K - kp).astype(np.int32)
@@ -422,7 +437,7 @@ def _native_wide_tables(idx, valid, num_src, P, kp_rows, k_rows,
     try:
         out = native.wide_gather_tables(
             np.asarray(idx, np.int64),
-            np.asarray(valid, bool), p_tiles=P,
+            np.asarray(valid, bool), num_src=int(num_src), p_tiles=P,
             kp_rows=kp_rows, k_rows=k_rows)
     except native.WideCoverBlowup:
         return "blowup"
