@@ -362,77 +362,152 @@ class TransformPlan:
             values = values * jnp.asarray(scale, values.dtype)
         return values
 
-    def _backward_rest_t(self, sticks, tables):
-        """Matmul-DFT T-layout tail of backward: z-DFT on sticks, unpack
-        into the TRANSPOSED plane grid (planes, x, y), y-DFT on the minor
-        axis, one swap, then the x-stage — the only transpose of the
-        backward half (see _use_mdft)."""
+    def _decompress_planar(self, values_il, tables, pallas=True):
+        """Values -> PLANAR stick channels (sr, si), each (s_pad, dim_z)
+        f32 — the mdft pipeline's native form (no complex interleave)."""
+        p = self.index_plan
+        if not pallas or not self._pallas_active \
+                or self._pallas["dec"] is None:
+            if self._pair_io and values_il.shape[0] == 2:
+                values_il = values_il.T
+            flat = stages.gather_rows_with_sentinel(
+                values_il.astype(self._rdt), tables["slot_src"])
+            return (flat[:, 0].reshape(self._s_pad, p.dim_z),
+                    flat[:, 1].reshape(self._s_pad, p.dim_z))
+        from .ops import gather_kernel as gk
+        t = self._pallas["dec"]
+        re, im = gk.planar_from_interleaved(values_il.astype(np.float32),
+                                            t.src_rows, pair=self._pair_io)
+        out_re, out_im = gk.run_gather(re, im, tables["dec_tabs"], t)
+        return (out_re.reshape(-1)[:t.num_out].reshape(self._s_pad,
+                                                       p.dim_z),
+                out_im.reshape(-1)[:t.num_out].reshape(self._s_pad,
+                                                       p.dim_z))
+
+    def _compress_planar(self, sr, si, tables, pallas=True):
+        """PLANAR stick channels -> the plan's value output layout
+        (scaling already folded into the z-DFT matrix upstream)."""
+        p = self.index_plan
+        if not pallas or not self._pallas_active \
+                or self._pallas["cmp"] is None:
+            flat = jnp.stack([sr.reshape(-1), si.reshape(-1)], axis=-1)
+            values = flat[tables["value_indices"]]
+            return values.T if self._pair_io else values
+        from .ops import gather_kernel as gk
+        t = self._pallas["cmp"]
+        pad = t.src_rows * 128 - sr.size
+        re = jnp.pad(sr.reshape(-1), (0, pad)).reshape(t.src_rows, 128)
+        im = jnp.pad(si.reshape(-1), (0, pad)).reshape(t.src_rows, 128)
+        out_re, out_im = gk.run_gather(re, im, tables["cmp_tabs"], t)
+        return gk.interleaved_from_planar(out_re, out_im, t.num_out,
+                                          pair=self._pair_io)
+
+    def _backward_rest_tp(self, sr, si, tables):
+        """Matmul-DFT T-layout tail of backward, fully PLANAR (separate
+        re/im f32 arrays — XLA stores c64 interleaved T(2,128), so every
+        complex materialisation between stages is an interleave copy the
+        planar form never pays): z-DFT on sticks, unpack into the
+        TRANSPOSED plane grid (planes, x, y), y-DFT on the minor axis,
+        one swap, then the x-stage. Returns (xr, xi) planar space for
+        C2C, the real space slab for R2C."""
         from .ops import dft
         p = self.index_plan
         if self._is_r2c and p.zero_stick_id is not None:
+            # complete the (0,0) stick: conj = im sign flip in planar form
             zid = p.zero_stick_id
-            sticks = sticks.at[zid].set(
-                stages.complete_stick_hermitian(sticks[zid]))
-        sticks = dft.cdft_last(sticks, dft.c2c_mats(p.dim_z, dft.BACKWARD))
+            rr, ri = sr[zid], si[zid]
+            nz = (rr != 0) | (ri != 0)
+            sr = sr.at[zid].set(jnp.where(nz, rr, jnp.roll(rr[::-1], 1)))
+            si = si.at[zid].set(jnp.where(nz, ri, -jnp.roll(ri[::-1], 1)))
+        sr, si = dft.pdft_last(sr, si, dft.c2c_mats(p.dim_z, dft.BACKWARD))
         xf = p.dim_x_freq
         unpack = stages.sticks_to_grid_padded \
             if self._s_pad > p.num_sticks else stages.sticks_to_grid
         if self._split_x is not None:
             x0, w = self._split_x
-            grid_t = unpack(sticks, tables["col_inv_sub_t"], w, p.dim_y)
+            col_tab = tables["col_inv_sub_t"]
             rows = tuple(int(r) for r in (x0 + np.arange(w)) % xf)
         else:
             x0, w = 0, xf
-            grid_t = unpack(sticks, tables["col_inv_t"], xf, p.dim_y)
+            col_tab = tables["col_inv_t"]
             rows = None
+        gr = unpack(sr, col_tab, w, p.dim_y)
+        gi = unpack(si, col_tab, w, p.dim_y)
         if self._is_r2c and x0 == 0:
-            grid_t = stages.complete_plane_hermitian_t(grid_t)
-        grid_t = dft.cdft_last(grid_t, dft.c2c_mats(p.dim_y, dft.BACKWARD))
-        grid = jnp.swapaxes(grid_t, -1, -2)
+            # complete the x=0 sub-plane along y (contiguous in T layout)
+            cr, ci = gr[:, 0, :], gi[:, 0, :]
+            nz = (cr != 0) | (ci != 0)
+            gr = gr.at[:, 0, :].set(
+                jnp.where(nz, cr, jnp.roll(cr[:, ::-1], 1, axis=-1)))
+            gi = gi.at[:, 0, :].set(
+                jnp.where(nz, ci, -jnp.roll(ci[:, ::-1], 1, axis=-1)))
+        gr, gi = dft.pdft_last(gr, gi, dft.c2c_mats(p.dim_y, dft.BACKWARD))
+        gr = jnp.swapaxes(gr, -1, -2)
+        gi = jnp.swapaxes(gi, -1, -2)
         if self._is_r2c:
             mats = dft.c2r_mats(p.dim_x) if rows is None \
                 else dft.sub_rows_c2r_mats(p.dim_x, rows)
-            return dft.pirdft_last(jnp.real(grid), jnp.imag(grid), mats)
+            return dft.pirdft_last(gr, gi, mats)
         mats = dft.c2c_mats(p.dim_x, dft.BACKWARD) if rows is None \
             else dft.sub_rows_mats(p.dim_x, dft.BACKWARD, rows)
-        return complex_to_interleaved(dft.cdft_last(grid, mats))
+        return dft.pdft_last(gr, gi, mats)
 
-    def _forward_head_t(self, space, tables, scale):
-        """Matmul-DFT T-layout head of forward: x-stage on the minor
-        axis, one swap into the transposed grid, y-DFT minor, pack, then
-        the z-DFT with any FULL scaling folded into its matrix (no
-        separate scale pass)."""
+    def _backward_rest_t(self, sticks, tables):
+        """Complex-dtype wrapper of :meth:`_backward_rest_tp` (the batched
+        path feeds complex sticks); returns the public interleaved (C2C)
+        or real (R2C) space layout."""
+        out = self._backward_rest_tp(jnp.real(sticks), jnp.imag(sticks),
+                                     tables)
+        if self._is_r2c:
+            return out
+        return jnp.stack([out[0], out[1]], axis=-1)
+
+    def _forward_head_tp(self, space_p, tables, scale):
+        """Planar T-layout head of forward: x-stage on the minor axis,
+        one swap into the transposed grid, y-DFT minor, pack, then the
+        z-DFT with any FULL scaling folded into its matrix. ``space_p``
+        is (xr, xi) planar for C2C, the real slab for R2C. Returns
+        (sr, si) planar sticks."""
         from .ops import dft
         p = self.index_plan
         xf = p.dim_x_freq
         if self._split_x is not None:
             x0, w = self._split_x
             cols = tuple(int(c) for c in (x0 + np.arange(w)) % xf)
-            if self._is_r2c:
-                yr, yi = dft.prdft_last(space.astype(self._rdt),
-                                        dft.sub_cols_r2c_mats(p.dim_x, cols))
-                g = yr + 1j * yi
-            else:
-                g = dft.cdft_last(
-                    interleaved_to_complex(space).astype(self._cdt),
-                    dft.sub_cols_mats(p.dim_x, dft.FORWARD, cols))
             cols_tab = tables["scatter_cols_sub_t"]
-        else:
             if self._is_r2c:
-                yr, yi = dft.prdft_last(space.astype(self._rdt),
-                                        dft.r2c_mats(p.dim_x))
-                g = yr + 1j * yi
+                gr, gi = dft.prdft_last(space_p.astype(self._rdt),
+                                        dft.sub_cols_r2c_mats(p.dim_x,
+                                                              cols))
             else:
-                g = dft.cdft_last(
-                    interleaved_to_complex(space).astype(self._cdt),
-                    dft.c2c_mats(p.dim_x, dft.FORWARD))
+                gr, gi = dft.pdft_last(
+                    space_p[0].astype(self._rdt),
+                    space_p[1].astype(self._rdt),
+                    dft.sub_cols_mats(p.dim_x, dft.FORWARD, cols))
+        else:
             cols_tab = tables["scatter_cols_t"]
-        g = jnp.swapaxes(g, -1, -2)
-        g = dft.cdft_last(g, dft.c2c_mats(p.dim_y, dft.FORWARD))
-        sticks = stages.grid_to_sticks(g, cols_tab)
-        return dft.cdft_last(
-            sticks, dft.c2c_mats(p.dim_z, dft.FORWARD,
+            if self._is_r2c:
+                gr, gi = dft.prdft_last(space_p.astype(self._rdt),
+                                        dft.r2c_mats(p.dim_x))
+            else:
+                gr, gi = dft.pdft_last(space_p[0].astype(self._rdt),
+                                       space_p[1].astype(self._rdt),
+                                       dft.c2c_mats(p.dim_x, dft.FORWARD))
+        gr = jnp.swapaxes(gr, -1, -2)
+        gi = jnp.swapaxes(gi, -1, -2)
+        gr, gi = dft.pdft_last(gr, gi, dft.c2c_mats(p.dim_y, dft.FORWARD))
+        sr = stages.grid_to_sticks(gr, cols_tab)
+        si = stages.grid_to_sticks(gi, cols_tab)
+        return dft.pdft_last(
+            sr, si, dft.c2c_mats(p.dim_z, dft.FORWARD,
                                  scale=scale if scale else 1.0))
+
+    def _forward_head_t(self, space, tables, scale):
+        """Complex-dtype wrapper of :meth:`_forward_head_tp` (batched
+        path): interleaved/real space in, complex sticks out."""
+        sp = space if self._is_r2c else (space[..., 0], space[..., 1])
+        sr, si = self._forward_head_tp(sp, tables, scale)
+        return sr + 1j * si
 
     def _backward_rest(self, sticks, tables):
         """Everything after decompress: symmetry, z-IFFT, unpack, xy-IFFT."""
@@ -463,6 +538,12 @@ class TransformPlan:
         return complex_to_interleaved(stages.xy_backward_c2c(grid))
 
     def _backward_impl(self, values_il, tables, *, pallas=True):
+        if self._use_mdft:
+            sr, si = self._decompress_planar(values_il, tables, pallas)
+            out = self._backward_rest_tp(sr, si, tables)
+            if self._is_r2c:
+                return out
+            return jnp.stack([out[0], out[1]], axis=-1)
         return self._backward_rest(
             self._decompress(values_il, tables, pallas), tables)
 
@@ -495,9 +576,10 @@ class TransformPlan:
 
     def _forward_impl(self, space, tables, *, scaled: bool, pallas=True):
         scale = 1.0 / self.global_size if scaled else None
-        if self._use_mdft:  # scale folded into the z-DFT matrix
-            sticks = self._forward_head(space, tables, scale)
-            return self._compress(sticks, tables, None, pallas)
+        if self._use_mdft:  # planar pipeline, scale folded into z matrix
+            sp = space if self._is_r2c else (space[..., 0], space[..., 1])
+            sr, si = self._forward_head_tp(sp, tables, scale)
+            return self._compress_planar(sr, si, tables, pallas)
         sticks = self._forward_head(space, tables)
         return self._compress(sticks, tables, scale, pallas)
 
@@ -610,6 +692,22 @@ class TransformPlan:
 
     # -- fused round trip ----------------------------------------------------
     def _pair_impl(self, values_il, tables, *fn_args, scaled, fn):
+        if self._use_mdft:
+            # fully planar round trip; the space domain is materialised
+            # in the public interleaved layout ONLY when a pointwise fn
+            # needs to see it
+            sr, si = self._decompress_planar(values_il, tables)
+            space = self._backward_rest_tp(sr, si, tables)
+            if fn is not None:
+                if self._is_r2c:
+                    space = fn(space, *fn_args)
+                else:
+                    s = fn(jnp.stack([space[0], space[1]], axis=-1),
+                           *fn_args)
+                    space = (s[..., 0], s[..., 1])
+            scale = 1.0 / self.global_size if scaled else None
+            out_sr, out_si = self._forward_head_tp(space, tables, scale)
+            return self._compress_planar(out_sr, out_si, tables)
         space = self._backward_impl(values_il, tables)
         if fn is not None:
             space = fn(space, *fn_args)
