@@ -91,8 +91,24 @@ typedef enum SpfftTpuPallasMode {
   SPFFT_TPU_PALLAS_ON = 1
 } SpfftTpuPallasMode;
 
+/*
+ * ABI version of this header. Incremented whenever an exported signature
+ * changes (ABI 2: plan-create entry points gained trailing use_pallas /
+ * exchange_type ints). A caller compiled against an older header keeps
+ * linking but passes garbage for new trailing arguments — check
+ *   spfft_tpu_abi_version() == SPFFT_TPU_ABI_VERSION
+ * once at startup to fail loudly instead (the reference pins
+ * compatibility the CMake-package way; a C macro plus runtime probe is
+ * the plain-linker equivalent).
+ */
+#define SPFFT_TPU_ABI_VERSION 2
+
 /* Opaque plan handle (reference: SpfftTransform, transform.h). */
 typedef void* SpfftTpuPlan;
+
+/* The ABI version the loaded library was BUILT with (compare against
+ * SPFFT_TPU_ABI_VERSION from the header you compiled against). */
+int spfft_tpu_abi_version(void);
 
 /*
  * Start the embedded interpreter and import the spfft_tpu package.

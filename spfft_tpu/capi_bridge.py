@@ -313,6 +313,21 @@ def _multi_io(pid_handles: list):
     return [_get_plan(h) for h in pid_handles]
 
 
+def _fuse_gate(plan, batch: int) -> bool:
+    """The SAME B-aware fusion gate as multi._shared_plan: shared-handle
+    batches through the C API must not fuse where the measured gates say
+    per-transform dispatch wins (large batches fuse at 0.47-0.64x the
+    speed — BENCHMARKS.md 'Fused shared-plan batches')."""
+    from .multi import FUSED_BATCH_MAX_DIST_TOTAL, FUSED_BATCH_MAX_GRID
+    if batch < 2:
+        return False
+    if _is_distributed(plan):
+        dp = plan.dist_plan
+        slab = dp.dim_x * dp.dim_y * dp.max_planes
+        return batch * slab <= FUSED_BATCH_MAX_DIST_TOTAL
+    return batch * plan.global_size <= FUSED_BATCH_MAX_GRID
+
+
 @_guarded
 def multi_backward(n: int, plans_addr: int, values_addr: int,
                    spaces_addr: int) -> None:
@@ -325,7 +340,8 @@ def multi_backward(n: int, plans_addr: int, values_addr: int,
     vaddrs = _read_addr_array(values_addr, n)
     saddrs = _read_addr_array(spaces_addr, n)
     plans = _multi_io(handles)
-    if len(set(handles)) == 1 and _is_distributed(plans[0]):
+    if len(set(handles)) == 1 and _fuse_gate(plans[0], n) \
+            and _is_distributed(plans[0]):
         plan, dp = plans[0], plans[0].dist_plan
         per_b = [[v.copy() for v in _split_values_view(plan, a)]
                  for a in vaddrs]
@@ -338,7 +354,7 @@ def multi_backward(n: int, plans_addr: int, values_addr: int,
                  for r in range(dp.num_shards)], axis=0)
             _view(a, n_space, plan.precision)[:] = cube.reshape(-1)
         return
-    if len(set(handles)) == 1:
+    if len(set(handles)) == 1 and _fuse_gate(plans[0], n):
         plan, p = plans[0], plans[0].index_plan
         vals = [_view(a, 2 * p.num_values, plan.precision)
                 .reshape(p.num_values, 2).copy() for a in vaddrs]
@@ -380,7 +396,8 @@ def multi_forward(n: int, plans_addr: int, spaces_addr: int, scaling: int,
     saddrs = _read_addr_array(spaces_addr, n)
     vaddrs = _read_addr_array(values_addr, n)
     plans = _multi_io(handles)
-    if len(set(handles)) == 1 and _is_distributed(plans[0]):
+    if len(set(handles)) == 1 and _fuse_gate(plans[0], n) \
+            and _is_distributed(plans[0]):
         plan, dp = plans[0], plans[0].dist_plan
         width = 1 if dp.hermitian else 2
         n_space = dp.dim_z * dp.dim_y * dp.dim_x * width
@@ -400,7 +417,7 @@ def multi_forward(n: int, plans_addr: int, spaces_addr: int, scaling: int,
             out = _concat_padded_values(plan, batch[:, b])
             _view(a, 2 * total, plan.precision)[:] = out.reshape(-1)
         return
-    if len(set(handles)) == 1:
+    if len(set(handles)) == 1 and _fuse_gate(plans[0], n):
         plan, p = plans[0], plans[0].index_plan
         width = 1 if p.hermitian else 2
         n_space = p.dim_z * p.dim_y * p.dim_x * width

@@ -294,6 +294,32 @@ def window_sub_cols(cols: np.ndarray, dim_x_freq: int, x0: int,
             + (cols % dim_x_freq - x0) % dim_x_freq).astype(np.int32)
 
 
+#: Largest representable element count for any derived size product: the
+#: C ABI and the index tables use 64-bit signed sizes, and per-value flat
+#: indices ``stick_id * dim_z + z`` are built in int64 — products beyond
+#: this overflow silently downstream, so construction fails loudly
+#: instead (reference: grid_internal.cpp:122-134 range-checks dimension
+#: products at construction and throws OverflowError).
+MAX_SIZE_PRODUCT = 2 ** 62
+
+
+def check_size_overflow(dim_x: int, dim_y: int, dim_z: int) -> None:
+    """Raise :class:`~spfft_tpu.errors.OverflowError_` when any size
+    product a plan derives (grid elements, interleaved real count, padded
+    stick slots) cannot be represented — at construction, matching the
+    reference's check placement (grid_internal.cpp:122-134)."""
+    from .errors import OverflowError_
+    if int(dim_x) > 2 ** 31 - 1 or int(dim_y) > 2 ** 31 - 1 \
+            or int(dim_z) > 2 ** 31 - 1:
+        raise OverflowError_(
+            f"dimension exceeds 32-bit index range "
+            f"({dim_x},{dim_y},{dim_z})")
+    if 2 * int(dim_x) * int(dim_y) * int(dim_z) > MAX_SIZE_PRODUCT:
+        raise OverflowError_(
+            f"grid size product 2*{dim_x}*{dim_y}*{dim_z} overflows the "
+            f"64-bit size range")
+
+
 def build_index_plan(transform_type: TransformType,
                      dim_x: int, dim_y: int, dim_z: int,
                      triplets: np.ndarray) -> IndexPlan:
@@ -305,6 +331,7 @@ def build_index_plan(transform_type: TransformType,
     if dim_x < 1 or dim_y < 1 or dim_z < 1:
         raise InvalidParameterError(
             f"dimensions must be >= 1, got ({dim_x},{dim_y},{dim_z})")
+    check_size_overflow(dim_x, dim_y, dim_z)
     transform_type = TransformType(transform_type)
     hermitian = transform_type == TransformType.R2C
     value_indices, stick_keys, centered = convert_index_triplets(

@@ -140,6 +140,10 @@ int call_bridge(const char* fn, std::initializer_list<long long> args,
 
 extern "C" {
 
+int spfft_tpu_abi_version(void) {
+  return 2;  // keep equal to SPFFT_TPU_ABI_VERSION in include/spfft_tpu.h
+}
+
 int spfft_tpu_init(const char* package_path) {
   return ensure_runtime(package_path);
 }

@@ -25,12 +25,17 @@ from typing import Callable
 class DiffEstimate:
     """Result of :func:`diff_estimate_seconds`. ``label`` describes the
     methodology that ACTUALLY produced ``seconds`` (so benchmark logs
-    cannot silently diverge from the estimator)."""
+    cannot silently diverge from the estimator). ``seconds`` is the min
+    over trials (downward-biased best case — fine for "best sustained
+    rate" headlines); ``median`` is the robust companion statistic for
+    threshold tuning, where the min's optimism would shift crossovers
+    (round-3 advisor finding)."""
 
     seconds: float
     spread: float
     fallback: bool
     label: str
+    median: float = math.nan
 
     def __iter__(self):  # (seconds, spread, fallback) unpacking
         return iter((self.seconds, self.spread, self.fallback))
@@ -64,10 +69,13 @@ def diff_estimate_seconds(run_group: Callable[[int], float],
     if positive:
         best = min(positive)
         spread = (max(positive) - best) / best
+        med = sorted(positive)[len(positive) // 2]
         return DiffEstimate(
             best, spread, False,
             f"min of sync-cancelling trials ((T({g2})-T({g1}))/{g2 - g1}, "
-            f"trial spread +{spread * 100:.1f}%)")
-    return DiffEstimate(run_group(g2) / g2, math.nan, True,
+            f"trial spread +{spread * 100:.1f}%, median "
+            f"{med * 1e3:.3g} ms)", med)
+    t = run_group(g2) / g2
+    return DiffEstimate(t, math.nan, True,
                         f"pipelined mean of {g2} "
-                        f"(diff estimator below noise)")
+                        f"(diff estimator below noise)", t)

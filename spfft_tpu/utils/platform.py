@@ -58,8 +58,14 @@ def enable_persistent_compilation_cache() -> None:
         jax.config.update("jax_compilation_cache_dir", path)
         # Cache every compile that takes noticeable time: the default
         # 1 s floor would skip the many small stage executables whose
-        # compiles still add up on remote-attached devices.
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+        # compiles still add up on remote-attached devices. Respect a
+        # user-configured floor (env var or non-default config value) —
+        # only lower it when it is still at jax's default.
+        if ("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS" not in os.environ
+                and float(jax.config.jax_persistent_cache_min_compile_time_secs)
+                == 1.0):
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              0.2)
     except Exception as e:  # pragma: no cover - config may be frozen
         logger.info("spfft_tpu: persistent compilation cache not enabled "
                     "(%s)", e)

@@ -29,6 +29,13 @@
 #define BATCH 3
 
 int main(void) {
+  /* Fail loudly on header/library ABI skew before any call that would
+   * otherwise read garbage trailing arguments. */
+  if (spfft_tpu_abi_version() != SPFFT_TPU_ABI_VERSION) {
+    fprintf(stderr, "ABI mismatch: header %d vs library %d\n",
+            SPFFT_TPU_ABI_VERSION, spfft_tpu_abi_version());
+    return 1;
+  }
   CHECK(spfft_tpu_init(getenv("SPFFT_TPU_PACKAGE_PATH")));
 
   /* Dense stick set, split round-robin by stick id over SHARDS shards. */

@@ -31,6 +31,18 @@ def capi_lib():
     return LIB
 
 
+def test_abi_version_matches_header(capi_lib):
+    """spfft_tpu_abi_version() equals the header's SPFFT_TPU_ABI_VERSION
+    (the runtime probe old callers use to detect signature skew)."""
+    hdr = open(os.path.join(REPO, "include", "spfft_tpu.h")).read()
+    import re
+    macro = int(re.search(r"#define SPFFT_TPU_ABI_VERSION (\d+)",
+                          hdr).group(1))
+    lib = ctypes.CDLL(capi_lib)
+    lib.spfft_tpu_abi_version.restype = ctypes.c_int
+    assert lib.spfft_tpu_abi_version() == macro
+
+
 def test_c_example_round_trip(capi_lib):
     """Compile and run the shipped C example end-to-end (subprocess: the
     example embeds its own interpreter)."""
@@ -178,6 +190,20 @@ def test_invalid_indices_code(lib):
                                      trip.ctypes.data, 0, -1)
     assert code == 7  # SPFFT_TPU_INVALID_INDICES_ERROR
     assert b"out of bounds" in lib.spfft_tpu_error_string(code)
+
+
+def test_overflow_code(lib):
+    """Dimension products past the 64-bit size range return the overflow
+    code at the ABI (reference: grid_internal.cpp:122-134 ->
+    SPFFT_OVERFLOW_ERROR)."""
+    trip = np.array([[0, 0, 0]], np.int32)
+    plan = ctypes.c_void_p()
+    n = 1 << 21
+    code = lib.spfft_tpu_plan_create(ctypes.byref(plan), 0, n, n, n,
+                                     ctypes.c_longlong(1),
+                                     trip.ctypes.data, 0, -1)
+    assert code == 3  # SPFFT_TPU_OVERFLOW_ERROR
+    assert lib.spfft_tpu_error_string(code)
 
 
 def test_invalid_handle_code(lib):

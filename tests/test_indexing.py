@@ -95,3 +95,20 @@ def test_zero_stick_absent():
     plan = build_index_plan(TransformType.C2C, 4, 4, 4,
                             np.array([[1, 1, 0]]))
     assert plan.zero_stick_id is None
+
+
+def test_size_product_overflow():
+    """Construction rejects unrepresentable size products with the typed
+    overflow error (reference: grid_internal.cpp:122-134 ->
+    exceptions.hpp:50-59)."""
+    import pytest
+    from spfft_tpu.errors import OverflowError_
+    from spfft_tpu.indexing import build_index_plan
+    from spfft_tpu.types import TransformType
+    n = 1 << 21
+    with pytest.raises(OverflowError_):
+        build_index_plan(TransformType.C2C, n, n, n,
+                         np.zeros((1, 3), np.int32))
+    with pytest.raises(OverflowError_):
+        build_index_plan(TransformType.C2C, 1 << 32, 1, 1,
+                         np.zeros((1, 3), np.int32))
