@@ -62,7 +62,16 @@ module spfft_tpu
   integer(c_int), parameter :: SPFFT_TPU_PALLAS_OFF = 0
   integer(c_int), parameter :: SPFFT_TPU_PALLAS_ON = 1
 
+  ! ABI version of the header these declarations mirror
+  ! (include/spfft_tpu.h SPFFT_TPU_ABI_VERSION)
+  integer(c_int), parameter :: SPFFT_TPU_ABI_VERSION = 2
+
   interface
+
+    integer(c_int) function spfft_tpu_abi_version() &
+        bind(C, name="spfft_tpu_abi_version")
+      use iso_c_binding
+    end function
 
     integer(c_int) function spfft_tpu_init(package_path) &
         bind(C, name="spfft_tpu_init")

@@ -76,6 +76,8 @@ def test_f90_constants_match_header():
     hdr = open(HEADER).read()
     f90 = open(F90).read()
     hdr_consts = dict(re.findall(r"(SPFFT_TPU_\w+)\s*=\s*(-?\d+)", hdr))
+    hdr_consts.update(
+        re.findall(r"#define\s+(SPFFT_TPU_\w+)\s+(-?\d+)", hdr))
     f90_consts = dict(re.findall(
         r"parameter\s*::\s*(SPFFT_TPU_\w+)\s*=\s*(-?\d+)", f90))
     assert f90_consts, "no constants parsed from spfft_tpu.f90"
