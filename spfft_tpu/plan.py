@@ -102,38 +102,34 @@ class TransformPlan:
                 index_plan.num_values, PAIR_IO_THRESHOLD)
         # Static tables, device-committed once (plan time, never at execute
         # time — mirroring SURVEY.md §3.1's plan/execute split). They are
-        # passed to the jitted pipelines as arguments, not closure constants:
-        # both the gather-based decompress/unpack (inverse maps) and the
-        # forward gathers need them, and embedding multi-MB constants in the
-        # executable is slower on remote-attached TPUs.
-        self._tables = {
-            "slot_src": jnp.asarray(index_plan.slot_src),
-            "value_indices": jnp.asarray(index_plan.value_indices),
-            "scatter_cols": jnp.asarray(index_plan.scatter_cols),
-        }
+        # passed to the jitted pipelines as arguments, not closure constants.
+        # Only the tables the ACTIVE path touches live in the hot dict (an
+        # unused pytree leaf would still ship to the device on every call);
+        # the fallback-path tables (slot_src, value_indices — 87 MB at
+        # 256^3) commit lazily via _commit_fallback / the _tables property.
+        self._pallas_box = None
+        self._pallas_active_flag = False
+        self._build_thread = None
+        self._build_exc = None
+        self._tables_full = None
+        will_build = self._decide_pallas(use_pallas)  # also sets _s_pad
+        p = index_plan
+        extra = self._s_pad - p.num_sticks
+        pads = np.zeros(extra, np.int32)
+        self._tables_hot = {}
         if self._use_mdft:
-            self._tables["col_inv_t"] = jnp.asarray(index_plan.col_inv_t)
-            self._tables["scatter_cols_t"] = jnp.asarray(
-                index_plan.scatter_cols_t)
+            self._tables_hot["col_inv_t"] = jnp.asarray(p.col_inv_t)
+            self._tables_hot["scatter_cols_t"] = jnp.asarray(
+                np.concatenate([p.scatter_cols_t, pads]) if extra
+                else p.scatter_cols_t)
         else:
-            self._tables["col_inv"] = jnp.asarray(index_plan.col_inv)
-        self._init_pallas(use_pallas)
-        if self._s_pad > index_plan.num_sticks:
-            # Stick-pad tables (see _init_pallas): the decompress map
-            # sends pad slots to the zero sentinel, and the pack tables
-            # gather column 0 into the pad rows (their content is never
-            # read — compression only touches real value indices).
-            extra = self._s_pad - index_plan.num_sticks
-            self._tables["slot_src"] = jnp.asarray(np.concatenate(
-                [index_plan.slot_src,
-                 np.full(extra * index_plan.dim_z, index_plan.num_values,
-                         np.int32)]))
-            pads = np.zeros(extra, np.int32)
-            self._tables["scatter_cols"] = jnp.asarray(
-                np.concatenate([index_plan.scatter_cols, pads]))
-            if self._use_mdft:
-                self._tables["scatter_cols_t"] = jnp.asarray(
-                    np.concatenate([index_plan.scatter_cols_t, pads]))
+            self._tables_hot["col_inv"] = jnp.asarray(p.col_inv)
+            self._tables_hot["scatter_cols"] = jnp.asarray(
+                np.concatenate([p.scatter_cols, pads]) if extra
+                else p.scatter_cols)
+        if not will_build:
+            self._commit_fallback("dec")
+            self._commit_fallback("cmp")
         self._init_split_x()
         self._batched = None
         self._pair_jits = {}
@@ -144,16 +140,24 @@ class TransformPlan:
             Scaling.FULL: jax.jit(functools.partial(self._forward_impl,
                                                     scaled=True)),
         }
+        if will_build:
+            # The compression-table build (native cover + device commit,
+            # ~2-3 s at 256^3) runs CONCURRENTLY with whatever the caller
+            # does next — typically the first execution's trace + XLA
+            # compile / cache load, which takes longer. Public execution
+            # methods join via _finalize(); plan construction itself
+            # returns in well under a second (the reference's sub-second
+            # plan construction, parameters.cpp + FFTW_ESTIMATE).
+            import threading
+            self._build_thread = threading.Thread(
+                target=self._build_compression_tables, daemon=True)
+            self._build_thread.start()
 
-    def _init_pallas(self, use_pallas: Optional[bool]) -> None:
-        """Enable the Pallas windowed-gather compression path (TPU backend,
-        single precision). The kernel handles any value order; stick-major/
-        z-ascending order (the layout the reference recommends for
-        performance, details.rst "Data Distribution";
-        ``utils.workloads.sort_triplets_stick_major``) gives the minimal
-        chunk decomposition. A value order so scattered that the chunk
-        decomposition would lose to the XLA gather falls back with a logged
-        notice.
+    def _decide_pallas(self, use_pallas: Optional[bool]) -> bool:
+        """Decide (cheaply, at construction) whether the Pallas
+        windowed-gather compression tables will be built, and fix
+        ``_s_pad`` accordingly. The heavy build itself runs in
+        :meth:`_build_compression_tables` on a background thread.
 
         ``use_pallas=True`` on a non-TPU backend builds the tables (useful
         for table-level testing) but execution stays on the XLA path — note
@@ -162,19 +166,17 @@ class TransformPlan:
         (its SPMD body must execute the same program on every backend); the
         kernel is float32-only, so forcing it on a double-precision plan is
         an error rather than a silent downcast."""
-        from .ops import gather_kernel as gk
-
         p = self.index_plan
-        self._pallas = None
-        self._pallas_active = False
-        #: Stick rows of the packed stick array. Plans with compression
-        #: tables pad to the next multiple of 32 past num_sticks: the pad
-        #: sticks are zeros, so (a) the unpack gather needs NO sentinel
-        #: concatenation (a 53 MB copy at 256^3 — probe_r4_hlo), and (b)
-        #: dim_z % 4 == 0 grids make num_slots a whole number of kernel
-        #: tiles, turning the kernel-output reshape into a bitcast.
+        #: Stick rows of the packed stick array. Plans that attempt
+        #: compression tables pad to the next multiple of 32 past
+        #: num_sticks: the pad sticks are zeros, so (a) the unpack gather
+        #: needs NO sentinel concatenation (a 53 MB copy at 256^3 —
+        #: probe_r4_hlo), and (b) dim_z % 4 == 0 grids make num_slots a
+        #: whole number of kernel tiles, turning the kernel-output
+        #: reshape into a bitcast.
         self._s_pad = p.num_sticks
-        backend_ok = jax.default_backend() == "tpu"
+        self._backend_ok = jax.default_backend() == "tpu"
+        self._use_pallas_req = use_pallas
         if use_pallas is True and self.precision != "single":
             raise InvalidParameterError(
                 "the Pallas compression kernel is single-precision only")
@@ -185,42 +187,140 @@ class TransformPlan:
         # 96^3/463k values kernel 1.0 vs XLA 5.2 ms; 128^3 kernel 0.4 vs
         # 14.7; 256^3 kernel 12.4 vs 129.8. Crossover between 137k and
         # 463k values -> 200k.
-        auto = backend_ok and self.precision == "single" \
-            and self.index_plan.num_values >= 200_000
+        auto = self._backend_ok and self.precision == "single" \
+            and p.num_values >= 200_000
         if use_pallas is False or (use_pallas is None and not auto):
-            return
+            return False
         if p.num_values == 0 or p.num_sticks == 0:
-            return
-        vi = p.value_indices.astype(np.int64)
+            return False
         self._s_pad = -(-(p.num_sticks + 1) // 32) * 32
-        num_slots = self._s_pad * p.dim_z
-        (dec_idx, occupied), (cmp_idx, cmp_valid) = \
-            gk.compression_gather_inputs(vi, num_slots)
-        dec = gk.build_best_gather_tables(dec_idx, occupied, p.num_values)
-        cmp_ = gk.build_best_gather_tables(cmp_idx, cmp_valid, num_slots)
-        self._pallas = {"dec": dec, "cmp": cmp_}
-        if dec is None or cmp_ is None:
-            fell_back = [n for n, t in (("decompress", dec),
-                                        ("compress", cmp_)) if t is None]
-            # WARNING only when the caller explicitly asked for the kernel;
-            # auto mode (use_pallas=None) logs at INFO — the user never
-            # requested the Pallas path, so a per-plan-build warning is noise.
-            log = logger.warning if use_pallas is True else logger.info
-            log(
-                "spfft_tpu: value order too scattered for the Pallas "
-                "compression kernel (%s) — using the slower XLA gather "
-                "path there (sort triplets with utils.workloads."
-                "sort_triplets_stick_major for the fast path)",
-                " and ".join(fell_back))
-        if dec is None and cmp_ is None:
-            self._pallas = None
-            self._s_pad = p.num_sticks
+        return True
+
+    def _build_compression_tables(self) -> None:
+        """The heavy half of the Pallas setup: gather inputs, the wide/
+        narrow cover builds (native C++), and the device commit of the
+        packed tables. Runs on the plan's background build thread;
+        :meth:`_finalize` joins and re-raises any failure. The value
+        order handling is unchanged: any order works, stick-major/
+        z-ascending (the layout the reference recommends,
+        details.rst 'Data Distribution') is optimal, and a too-scattered
+        order falls back to the XLA gather with a logged notice."""
+        from .ops import gather_kernel as gk
+        try:
+            p = self.index_plan
+            use_pallas = self._use_pallas_req
+            vi = p.value_indices.astype(np.int64)
+            num_slots = self._s_pad * p.dim_z
+            (dec_idx, occupied), (cmp_idx, cmp_valid) = \
+                gk.compression_gather_inputs(vi, num_slots)
+            dec = gk.build_best_gather_tables(dec_idx, occupied,
+                                              p.num_values)
+            # commit the first table set while the second builds on host
+            if dec is not None:
+                self._tables_hot["dec_tabs"] = gk.gather_device_tables(dec)
+            cmp_ = gk.build_best_gather_tables(cmp_idx, cmp_valid,
+                                               num_slots)
+            if cmp_ is not None:
+                self._tables_hot["cmp_tabs"] = gk.gather_device_tables(cmp_)
+            if dec is None or cmp_ is None:
+                fell_back = [n for n, t in (("decompress", dec),
+                                            ("compress", cmp_))
+                             if t is None]
+                # WARNING only when the caller explicitly asked for the
+                # kernel; auto mode logs at INFO.
+                log = logger.warning if use_pallas is True else logger.info
+                log(
+                    "spfft_tpu: value order too scattered for the Pallas "
+                    "compression kernel (%s) — using the slower XLA gather "
+                    "path there (sort triplets with utils.workloads."
+                    "sort_triplets_stick_major for the fast path)",
+                    " and ".join(fell_back))
+            if dec is None and cmp_ is None:
+                self._pallas_box = None
+                return
+            self._pallas_box = {"dec": dec, "cmp": cmp_}
+            self._pallas_active_flag = self._backend_ok
+        except BaseException as exc:  # re-raised by _finalize
+            self._build_exc = exc
+
+    def _commit_fallback(self, which: str) -> None:
+        """Commit the XLA-gather fallback table for one compression
+        direction (slot_src / value_indices — the big inverse maps that
+        the Pallas path never reads)."""
+        p = self.index_plan
+        extra = self._s_pad - p.num_sticks
+        if which == "dec" and "slot_src" not in self._tables_hot:
+            ss = p.slot_src
+            if extra:
+                ss = np.concatenate(
+                    [ss, np.full(extra * p.dim_z, p.num_values, np.int32)])
+            self._tables_hot["slot_src"] = jnp.asarray(ss)
+        if which == "cmp" and "value_indices" not in self._tables_hot:
+            self._tables_hot["value_indices"] = jnp.asarray(
+                p.value_indices)
+
+    def _finalize(self) -> None:
+        """Join the background table build (no-op afterwards) and commit
+        whatever fallback tables the outcome requires."""
+        th = self._build_thread
+        if th is None:
             return
-        self._pallas_active = backend_ok
-        for name, t in (("dec", dec), ("cmp", cmp_)):
-            if t is None:
-                continue
-            self._tables[name + "_tabs"] = gk.gather_device_tables(t)
+        th.join()
+        self._build_thread = None
+        if self._build_exc is not None:
+            raise self._build_exc
+        box = self._pallas_box
+        if box is None or box["dec"] is None:
+            self._commit_fallback("dec")
+        if box is None or box["cmp"] is None:
+            self._commit_fallback("cmp")
+
+    @property
+    def _pallas(self):
+        self._finalize()
+        return self._pallas_box
+
+    @property
+    def _pallas_active(self) -> bool:
+        self._finalize()
+        return self._pallas_active_flag
+
+    @_pallas_active.setter
+    def _pallas_active(self, value: bool) -> None:
+        # tests force the kernel path in interpret mode on CPU
+        self._finalize()
+        self._pallas_active_flag = bool(value)
+
+    @property
+    def _tables(self):
+        """The FULL committed table set (hot-path tables plus every
+        fallback/debug table) — for tests, probes and explicit
+        ``pallas=False`` comparisons. Hot execution passes
+        ``_tables_hot``, which carries only what the active path reads."""
+        self._finalize()
+        if self._tables_full is None:
+            p = self.index_plan
+            full = dict(self._tables_hot)
+            if "slot_src" not in full:
+                extra = self._s_pad - p.num_sticks
+                ss = p.slot_src
+                if extra:
+                    ss = np.concatenate(
+                        [ss, np.full(extra * p.dim_z, p.num_values,
+                                     np.int32)])
+                full["slot_src"] = jnp.asarray(ss)
+            if "value_indices" not in full:
+                full["value_indices"] = jnp.asarray(p.value_indices)
+            if "scatter_cols" not in full:
+                extra = self._s_pad - p.num_sticks
+                sc = p.scatter_cols
+                if extra:
+                    sc = np.concatenate([sc, np.zeros(extra, np.int32)])
+                full["scatter_cols"] = jnp.asarray(sc)
+            if "col_inv" not in full:
+                full["col_inv"] = jnp.asarray(p.col_inv)
+            self._tables_full = full
+        return self._tables_full
 
     def _init_split_x(self) -> None:
         """Enable the sparse-x xy-stage when the occupied x columns span
@@ -252,16 +352,16 @@ class TransformPlan:
             x_w = (p.stick_x.astype(np.int64) - x0) % xf
             cols_sub_t = (x_w * p.dim_y
                           + p.stick_y.astype(np.int64)).astype(np.int32)
-            self._tables["col_inv_sub_t"] = jnp.asarray(
+            self._tables_hot["col_inv_sub_t"] = jnp.asarray(
                 inverse_col_map(cols_sub_t, w * p.dim_y, p.num_sticks))
-            self._tables["scatter_cols_sub_t"] = jnp.asarray(
+            self._tables_hot["scatter_cols_sub_t"] = jnp.asarray(
                 np.concatenate([cols_sub_t, pads]))
         else:
             cols_sub = window_sub_cols(p.scatter_cols, xf, x0, w)
             col_inv_sub = inverse_col_map(cols_sub, p.dim_y * w,
                                           p.num_sticks)
-            self._tables["col_inv_sub"] = jnp.asarray(col_inv_sub)
-            self._tables["scatter_cols_sub"] = jnp.asarray(
+            self._tables_hot["col_inv_sub"] = jnp.asarray(col_inv_sub)
+            self._tables_hot["scatter_cols_sub"] = jnp.asarray(
                 np.concatenate([cols_sub, pads]))
 
     @property
@@ -673,8 +773,10 @@ class TransformPlan:
             if isinstance(values_batch, jax.Array) \
             and values_batch.shape[1:] == per \
             else jnp.stack([self._coerce_values(v) for v in values_batch])
+        self._finalize()
         with timed_transform("backward_batched") as box:
-            box.value = self._batched_jits()["backward"](batch, self._tables)
+            box.value = self._batched_jits()["backward"](batch,
+                                                         self._tables_hot)
         return box.value
 
     def forward_batched(self, space_batch, scaling: Scaling = Scaling.NONE):
@@ -686,8 +788,10 @@ class TransformPlan:
             if not (isinstance(space_batch, jax.Array)
                     and space_batch.ndim
                     == (4 if self._is_r2c else 5)) else space_batch
+        self._finalize()
         with timed_transform("forward_batched") as box:
-            box.value = self._batched_jits()[scaling](batch, self._tables)
+            box.value = self._batched_jits()[scaling](batch,
+                                                      self._tables_hot)
         return box.value
 
     # -- fused round trip ----------------------------------------------------
@@ -747,8 +851,9 @@ class TransformPlan:
                                   scaled=scaling is Scaling.FULL, fn=fn),
                 donate_argnums=(0,) if self.donate_inputs else ())
             self._pair_jits[key] = jitted
+        self._finalize()
         with timed_transform("apply_pointwise") as box:
-            box.value = jitted(values_il, self._tables, *fn_args)
+            box.value = jitted(values_il, self._tables_hot, *fn_args)
         return box.value
 
     def iterate_pointwise(self, values, fn, *fn_args, steps: int,
@@ -782,8 +887,9 @@ class TransformPlan:
             jitted = jax.jit(
                 run, donate_argnums=(0,) if self.donate_inputs else ())
             self._pair_jits[key] = jitted
+        self._finalize()
         with timed_transform("iterate_pointwise") as box:
-            box.value = jitted(values_il, self._tables, *fn_args)
+            box.value = jitted(values_il, self._tables_hot, *fn_args)
         return box.value
 
     # -- public execution (reference: transform.hpp:198-211) -----------------
@@ -794,8 +900,9 @@ class TransformPlan:
         dim_x) for R2C. Unnormalised inverse DFT (details.rst
         "Transform Definition")."""
         values_il = self._coerce_values(values)
+        self._finalize()
         with timed_transform("backward") as box:
-            box.value = self._backward_jit(values_il, self._tables)
+            box.value = self._backward_jit(values_il, self._tables_hot)
         return box.value
 
     def forward(self, space, scaling: Scaling = Scaling.NONE):
@@ -805,8 +912,9 @@ class TransformPlan:
         (details.rst "Normalization")."""
         scaling = Scaling(scaling)
         space = self._coerce_space(space)
+        self._finalize()
         with timed_transform("forward") as box:
-            box.value = self._forward_jit[scaling](space, self._tables)
+            box.value = self._forward_jit[scaling](space, self._tables_hot)
         return box.value
 
     # -- input coercion ------------------------------------------------------
