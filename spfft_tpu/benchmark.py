@@ -56,8 +56,11 @@ def _parse_args(argv):
                    default="c2c")
     p.add_argument("-e", "--exchange",
                    choices=["default", "buffered", "bufferedFloat",
-                            "compact", "compactFloat", "unbuffered"],
-                   default="default")
+                            "compact", "compactFloat", "unbuffered", "all"],
+                   default="default",
+                   help="'all' sweeps every exchange mechanism on one "
+                        "workload and prints a comparison table with HLO "
+                        "wire bytes (reference: benchmark.cpp:138-156)")
     p.add_argument("-p", "--proc", choices=["host", "device"],
                    default="device",
                    help="host: numpy I/O every repeat; device: arrays stay "
@@ -85,6 +88,75 @@ _EXCHANGE = {
     "bufferedFloat": "buffered_float", "compact": "compact_buffered",
     "compactFloat": "compact_buffered_float", "unbuffered": "unbuffered",
 }
+
+
+def _exchange_sweep(args, dims, ttype, triplets, rng, cdt) -> int:
+    """-e all: one workload, every exchange mechanism (reference:
+    benchmark.cpp:138-156 runs the benchmark once per exchange for
+    'all'). Prints a comparison table — pair wall-clock plus the
+    aggregate and busiest-link wire bytes of the LOWERED exchange HLO —
+    and writes the same rows into the -o JSON."""
+    import jax
+    from .parallel import make_distributed_plan, make_mesh
+    from .types import ExchangeType
+    from .utils.workloads import (even_plane_split,
+                                  round_robin_stick_partition)
+
+    nx, ny, nz = dims
+    parts = round_robin_stick_partition(triplets, dims, args.shards)
+    planes = even_plane_split(nz, args.shards)
+    values_np = [
+        (rng.uniform(-1, 1, len(p)) + 1j * rng.uniform(-1, 1, len(p)))
+        .astype(cdt) for p in parts]
+    variants = ["buffered", "bufferedFloat", "compact", "compactFloat",
+                "unbuffered"]
+    rows = []
+    for name in variants:
+        plan = make_distributed_plan(
+            ttype, nx, ny, nz, parts, planes, mesh=make_mesh(args.shards),
+            precision=args.precision,
+            exchange=ExchangeType(_EXCHANGE[name]))
+        values = plan.shard_values(values_np)
+        last = None
+        for _ in range(max(args.warmups, 1)):
+            last = plan.apply_pointwise(values)
+        jax.block_until_ready(last)
+        np.asarray(jax.tree_util.tree_leaves(last)[-1]).ravel()[:1]
+        t0 = time.perf_counter()
+        for _ in range(args.repeats):
+            out = plan.apply_pointwise(values)
+        jax.block_until_ready(out)
+        np.asarray(jax.tree_util.tree_leaves(out)[-1]).ravel()[:1]
+        pair_s = (time.perf_counter() - t0) / args.repeats
+        rows.append({
+            "exchange": name,
+            "pair_seconds": round(pair_s, 6),
+            "wire_total_bytes": int(plan.exchange_wire_bytes()),
+            "busiest_link_bytes": int(plan.exchange_busiest_link_bytes()),
+        })
+    hdr = (f"{'exchange':>14s} {'pair ms':>10s} {'wire total MB':>14s} "
+           f"{'busiest link MB':>16s}")
+    print(hdr)
+    for r in rows:
+        print(f"{r['exchange']:>14s} {r['pair_seconds'] * 1e3:10.3f} "
+              f"{r['wire_total_bytes'] / 1e6:14.3f} "
+              f"{r['busiest_link_bytes'] / 1e6:16.3f}")
+    if args.output:
+        payload = {
+            "parameters": {
+                "dim_x": nx, "dim_y": ny, "dim_z": nz,
+                "shards": args.shards, "sparsity": args.sparsity,
+                "transform_type": args.transform,
+                "precision": args.precision, "repeats": args.repeats,
+                "backend": jax.default_backend(),
+                "num_values": int(len(triplets)),
+            },
+            "exchange_sweep": rows,
+        }
+        with open(args.output, "w") as f:
+            json.dump(payload, f, indent=2)
+        print(f"wrote {args.output}")
+    return 0
 
 
 def main(argv=None) -> int:
@@ -117,10 +189,18 @@ def main(argv=None) -> int:
 
     ttype = TransformType.C2C if args.transform == "c2c" else TransformType.R2C
     hermitian = ttype == TransformType.R2C
-    exchange = ExchangeType(_EXCHANGE[args.exchange])
     triplets = cutoff_stick_triplets(nx, ny, nz, args.sparsity, hermitian)
     rng = np.random.default_rng(42)
     cdt = np.complex64 if args.precision == "single" else np.complex128
+
+    if args.exchange == "all":
+        if args.shards < 2:
+            print("error: -e all compares exchange mechanisms and needs "
+                  "--shards > 1", file=sys.stderr)
+            return 2
+        return _exchange_sweep(args, (nx, ny, nz), ttype, triplets, rng,
+                               cdt)
+    exchange = ExchangeType(_EXCHANGE[args.exchange])
 
     t0 = time.perf_counter()
     if args.shards > 1:

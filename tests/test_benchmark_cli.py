@@ -38,5 +38,30 @@ def test_cli_runs(flags, tmp_path, capsys):
     assert capsys.readouterr().out  # params + tree printed
 
 
+def test_cli_exchange_all_sweep(tmp_path, capsys):
+    """-e all compares every exchange mechanism on one workload with HLO
+    wire bytes (reference: benchmark.cpp:138-156)."""
+    out = tmp_path / "sweep.json"
+    assert main(["-d", "16", "-r", "1", "--shards", "4", "-e", "all",
+                 "-o", str(out)]) == 0
+    payload = json.loads(out.read_text())
+    rows = payload["exchange_sweep"]
+    assert [r["exchange"] for r in rows] == [
+        "buffered", "bufferedFloat", "compact", "compactFloat",
+        "unbuffered"]
+    for r in rows:
+        assert r["pair_seconds"] > 0
+        assert r["wire_total_bytes"] >= r["busiest_link_bytes"] >= 0
+    # float wire halves the bytes
+    by = {r["exchange"]: r for r in rows}
+    assert by["bufferedFloat"]["wire_total_bytes"] \
+        == by["buffered"]["wire_total_bytes"] // 2
+    assert capsys.readouterr().out
+
+
+def test_cli_exchange_all_needs_shards():
+    assert main(["-d", "8", "-e", "all"]) == 2
+
+
 def test_cli_bad_dims():
     assert main(["-d", "4", "4"]) == 2
