@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""Recorded 8/16/32-shard scaling projection (VERDICT r3 item 4).
+
+For S in {8, 16, 32} x {uniform, stick-skew, plane-skew} x {padded
+(BUFFERED), COMPACT_BUFFERED, UNBUFFERED}: build the REAL distributed
+plan on an S-device virtual CPU mesh, read aggregate + busiest-link wire
+bytes from the plan's HLO-verified model, and CROSS-CHECK them against
+the byte counts of the collectives in the actually-lowered SPMD module
+(the same extraction tests/test_compact_exchange.py pins at S=4).
+
+Time model (parameters printed with the output; all knobs adjustable):
+  T_pair(S) = pair_1chip / S            (per-shard FFT+gather work)
+            + 2 * busiest_link_bytes / BW_ICI   (two exchanges per pair)
+            + n_ops * T_LAUNCH                  (collective launches)
+
+Usage:
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=32 \
+      python scripts/scaling_model.py [--dim 128] [--pair-ms 10.2] \
+      [--bw-gbps 100] [--out FILE.json]
+"""
+import argparse
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+
+def scenarios(S):
+    """stick weights, plane weights per scenario."""
+    ramp = list(range(1, S + 1))
+    return {
+        "uniform": ([1] * S, [1] * S),
+        "stick_skew": (ramp, [1] * S),          # stick ownership ramps 1..S
+        "plane_skew": ([1] * S, ramp),          # slab heights ramp 1..S
+    }
+
+
+from spfft_tpu.utils.hlo_inspect import hlo_wire_bytes as _shared
+
+
+def hlo_wire_bytes(txt, S):
+    total, sent, recv = _shared(txt, S)
+    import numpy as _np
+    return total, int(_np.maximum(sent, recv).max())
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dim", type=int, default=128)
+    ap.add_argument("--pair-ms", type=float, default=10.2,
+                    help="measured single-chip 256^3 pair (BENCH_r04)")
+    ap.add_argument("--bw-gbps", type=float, default=100.0,
+                    help="assumed per-link ICI bandwidth (v5e-class)")
+    ap.add_argument("--launch-us", type=float, default=2.0,
+                    help="assumed per-collective launch cost")
+    ap.add_argument("--shards", type=int, nargs="+", default=[8, 16, 32])
+    ap.add_argument("--hlo-check", type=int, nargs="+", default=[8],
+                    help="shard counts whose plans are also LOWERED and "
+                         "cross-checked against the HLO byte counts")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    from spfft_tpu import ExchangeType, TransformType
+    from spfft_tpu.parallel import make_distributed_plan, make_mesh
+    from spfft_tpu.utils.platform import force_virtual_cpu_devices
+    from spfft_tpu.utils.workloads import spherical_cutoff_triplets
+    import jax
+
+    force_virtual_cpu_devices(max(args.shards))
+    n = args.dim
+    triplets = spherical_cutoff_triplets(n)
+    rows = []
+    mechs = [("padded", ExchangeType.BUFFERED),
+             ("compact", ExchangeType.COMPACT_BUFFERED),
+             ("unbuffered", ExchangeType.UNBUFFERED)]
+    for S in args.shards:
+        for scen, (sw, pw) in scenarios(S).items():
+            # weighted stick split + weighted plane split
+            sticks = {}
+            for t in triplets:
+                sticks.setdefault((t[0], t[1]), []).append(t)
+            keys = sorted(sticks)
+            cum = np.cumsum(sw, dtype=np.float64)
+            bound = cum / cum[-1] * len(keys)
+            parts = [[] for _ in range(S)]
+            r = 0
+            for i, k in enumerate(keys):
+                while i >= bound[r] and r < S - 1:
+                    r += 1
+                parts[r].extend(sticks[k])
+            parts = [np.asarray(p, np.int64).reshape(-1, 3) if p
+                     else np.empty((0, 3), np.int64) for p in parts]
+            cump = np.cumsum(pw, dtype=np.float64)
+            edges = np.round(cump / cump[-1] * n).astype(int)
+            planes = np.diff(np.concatenate([[0], edges])).tolist()
+            for mname, mech in mechs:
+                plan = make_distributed_plan(
+                    TransformType.C2C, n, n, n, parts, planes,
+                    mesh=make_mesh(S), precision="single", exchange=mech)
+                total = plan.exchange_wire_bytes()
+                link = plan.exchange_busiest_link_bytes()
+                hlo_note = ""
+                if S in args.hlo_check:
+                    vals = plan.shard_values(
+                        [np.zeros(len(p), np.complex64) for p in parts])
+                    txt = plan._backward_jit.lower(
+                        vals, *plan._device_tables).as_text()
+                    h_total, h_link = hlo_wire_bytes(txt, S)
+                    assert h_total == total, (scen, mname, h_total, total)
+                    assert h_link == link, (scen, mname, h_link, link)
+                    hlo_note = "hlo-verified"
+                sched = getattr(plan, "_compact", None)
+                n_ops = len(sched.ops) if mname == "compact" and sched \
+                    else (S - 1 if mname == "unbuffered" else 1)
+                t_model = (args.pair_ms * 1e-3 * (n / 256) ** 0 / S
+                           + 2 * link / (args.bw_gbps * 1e9)
+                           + 2 * n_ops * args.launch_us * 1e-6)
+                rows.append({
+                    "shards": S, "scenario": scen, "mechanism": mname,
+                    "wire_total_mb": round(total / 1e6, 3),
+                    "busiest_link_mb": round(link / 1e6, 3),
+                    "n_collectives": int(n_ops),
+                    "t_model_ms": round(t_model * 1e3, 3),
+                    "hlo": hlo_note,
+                })
+                print(f"S={S:2d} {scen:11s} {mname:10s} "
+                      f"total {total / 1e6:9.3f} MB  link "
+                      f"{link / 1e6:8.3f} MB  ops {n_ops:3d}  "
+                      f"t_model {t_model * 1e3:7.3f} ms  {hlo_note}",
+                      flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump({"parameters": vars(args), "rows": rows}, f,
+                      indent=2)
+        print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

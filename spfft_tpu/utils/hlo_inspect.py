@@ -1,0 +1,61 @@
+"""Collective wire-byte extraction from lowered StableHLO text.
+
+Shared by tests/test_compact_exchange.py (which pins the plan-level wire
+model to the actually-lowered collectives) and scripts/scaling_model.py
+(the recorded 8/16/32-shard projection): one parser, so the falsifiable
+scaling table and the test assertions cannot use different accounting.
+
+``collective_permute`` ships one operand-sized buffer per listed
+(src, dst) pair; ``all_to_all`` ships (S-1)/S of each shard's operand
+off-shard, uniformly.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+CP_RE = re.compile(
+    r'stablehlo\.collective_permute.*?source_target_pairs\s*=\s*dense<'
+    r'\[?(?P<pairs>.*?)\]?>\s*:\s*tensor<(?P<npairs>\d+)x2xi64>.*?'
+    r'\(tensor<(?P<shape>[^>]*(?:<[^>]*>)?)>\)')
+A2A_RE = re.compile(
+    r'stablehlo\.all_to_all.*?\(tensor<(?P<shape>[^>]*(?:<[^>]*>)?)>\)')
+
+DTYPE_BYTES = {"complex<f32>": 8, "complex<f64>": 16,
+               "f32": 4, "f64": 8, "bf16": 2, "f16": 2}
+
+
+def tensor_bytes(shape_str: str) -> int:
+    """'4x22xcomplex<f64>' -> total bytes."""
+    parts = shape_str.split("x")
+    dims, i = [], 0
+    while i < len(parts) and parts[i].isdigit():
+        dims.append(int(parts[i]))
+        i += 1
+    dtype = "x".join(parts[i:])
+    n = 1
+    for d in dims:
+        n *= d
+    return n * DTYPE_BYTES[dtype]
+
+
+def hlo_wire_bytes(txt: str, num_shards: int):
+    """(total_off_shard_bytes, per_shard_sent, per_shard_recv) summed over
+    every collective in one lowered SPMD module."""
+    sent = np.zeros(num_shards, np.int64)
+    recv = np.zeros(num_shards, np.int64)
+    for m in CP_RE.finditer(txt):
+        nbytes = tensor_bytes(m.group("shape"))
+        flat = [int(v) for v in re.findall(r"-?\d+", m.group("pairs"))]
+        for s, d in zip(flat[::2], flat[1::2]):
+            if s != d:
+                sent[s] += nbytes
+                recv[d] += nbytes
+    for m in A2A_RE.finditer(txt):
+        nbytes = tensor_bytes(m.group("shape"))
+        off = nbytes * (num_shards - 1) // num_shards
+        sent += off
+        recv += off
+    return int(sent.sum()), sent, recv
