@@ -46,6 +46,15 @@ class ExchangeType(enum.Enum):
     The ``*_FLOAT`` variants additionally reduce the on-wire precision
     around the exchange, halving ICI bytes exactly as the reference halves
     MPI bytes (docs/source/details.rst "MPI Exchange").
+
+    DEFAULT here maps to the padded BUFFERED mechanism — a documented
+    deviation from the reference's COMPACT_BUFFERED default
+    (grid_internal.cpp:176-179), justified by the recorded 8/16/32-shard
+    comparison in docs/scaling_r04.json: equal busiest-link bytes on
+    uniform/mild-skew distributions, ONE fused collective instead of a
+    multi-op schedule, and XLA overlap. Pass COMPACT_BUFFERED explicitly
+    for strongly skewed caller-chosen distributions (docs/details.md
+    "Exchange").
     """
 
     DEFAULT = "default"

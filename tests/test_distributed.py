@@ -423,3 +423,24 @@ def test_distributed_iterate_pointwise():
             seq, damp, scaling=Scaling.FULL))
     for g, s in zip(out, seq):
         np.testing.assert_allclose(g, s, atol=1e-10, rtol=0)
+
+
+def test_default_exchange_mechanism():
+    """DEFAULT maps to the padded all_to_all — a documented deviation from
+    the reference's COMPACT_BUFFERED default (grid_internal.cpp:176-179);
+    see docs/details.md 'Exchange' and docs/scaling_r04.json for the
+    justification. This pin fails if the mapping silently changes."""
+    from spfft_tpu.parallel.exchange import all_to_all_blocks
+    rng = np.random.default_rng(5)
+    dims = (8, 8, 8)
+    triplets = random_sparse_triplets(rng, dims)
+    parts = split_by_sticks(triplets, dims, [1, 1, 1, 1])
+    planes = split_planes(dims[2], [1, 1, 1, 1])
+    plan = make_distributed_plan(TransformType.C2C, *dims, parts, planes,
+                                 mesh=make_mesh(4))
+    assert plan.exchange == ExchangeType.DEFAULT
+    assert plan._compact is None
+    assert plan._exchange_fn is all_to_all_blocks
+    values = plan.shard_values([random_values(rng, len(p)) for p in parts])
+    txt = plan._backward_jit.lower(values, *plan._device_tables).as_text()
+    assert "all_to_all" in txt and "collective_permute" not in txt
