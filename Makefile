@@ -15,7 +15,24 @@ CACHE_TAG   := $(shell python3 -c "import sys; print(sys.implementation.cache_ta
 PLANNER_SO  := $(NATIVE_DIR)/_planner_$(CACHE_TAG).so
 CAPI_SO     := lib/libspfft_tpu.so
 
-.PHONY: all native capi example-c test clean
+.PHONY: all native capi example-c test ci clean
+
+# One-command CI (reference: .github/workflows/ci.yml builds + runs the
+# local test matrix): full CPU suite (8-device virtual mesh; includes the
+# capi build, C feature drive, Fortran-width execution and the in-suite
+# multihost smoke), the compiled C example, the standalone 2-process
+# multihost smoke, and the precision matrix in CPU mode. Record with
+#   make ci 2>&1 | tee docs/ci_r04.log
+ci: native capi
+	@echo "== CI 1/4: test suite (CPU, virtual 8-device mesh) =="
+	python -m pytest tests/ -q
+	@echo "== CI 2/4: compiled C example =="
+	$(MAKE) example-c
+	@echo "== CI 3/4: 2-process multihost smoke =="
+	python scripts/multihost_smoke.py
+	@echo "== CI 4/4: precision matrix (CPU mode) =="
+	JAX_PLATFORMS=cpu DIMS="32 64" python scripts/precision_matrix.py
+	@echo "CI GREEN"
 
 all: native capi
 
