@@ -13,7 +13,7 @@ from .errors import (AllocationError, DeviceAllocationError, DeviceError,
                      DistributedSupportError, DuplicateIndicesError, ErrorCode,
                      FFTError, GenericError, HostExecutionError, InternalError,
                      InvalidIndicesError, InvalidParameterError, OverflowError_,
-                     ParameterMismatchError)
+                     ParameterMismatchError, PrecisionContractError)
 from .indexing import IndexPlan, build_index_plan, check_stick_duplicates
 from .parallel import (DistributedIndexPlan, DistributedTransformPlan,
                        build_distributed_plan,
@@ -23,7 +23,7 @@ from .parallel import (DistributedIndexPlan, DistributedTransformPlan,
 from . import timing
 from .grid import Grid, Transform
 from .multi import multi_transform_backward, multi_transform_forward
-from .plan import TransformPlan, make_local_plan
+from .plan import TransformPlan, make_local_plan, predicted_rel_error
 from .types import (ExchangeType, IndexFormat, ProcessingUnit, Scaling,
                     TransformType)
 
@@ -39,7 +39,8 @@ __all__ = [
     "ExchangeType", "ProcessingUnit", "IndexFormat", "TransformType",
     "Scaling",
     "IndexPlan", "build_index_plan", "check_stick_duplicates",
-    "TransformPlan", "make_local_plan",
+    "TransformPlan", "make_local_plan", "predicted_rel_error",
+    "PrecisionContractError",
     "DistributedIndexPlan", "DistributedTransformPlan",
     "build_distributed_plan", "build_distributed_plan_multihost",
     "initialize_multihost", "make_distributed_plan", "make_mesh",

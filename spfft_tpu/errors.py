@@ -123,6 +123,16 @@ class FFTError(GenericError):
     code = ErrorCode.FFT
 
 
+class PrecisionContractError(FFTError):
+    """A plan's PREDICTED relative error exceeds the accuracy bound the
+    caller demanded (``max_rel_error=``): the configured precision cannot
+    meet the contract, so construction fails loudly instead of returning
+    silently-degraded results. Subclass of :class:`FFTError` (it is an
+    FFT-accuracy failure; the reference's closest surface is the FFTW
+    error, exceptions.hpp:160-167 — its f64-everywhere build never needs
+    the distinction, docs/precision.md explains why this one does)."""
+
+
 class InternalError(GenericError):
     """Internal consistency failure (reference: exceptions.hpp:170-177)."""
 

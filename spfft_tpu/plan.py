@@ -52,6 +52,30 @@ from .utils.dtypes import (as_interleaved, complex_dtype,
                            real_dtype)
 
 
+def predicted_rel_error(precision: str, max_dim: int) -> float:
+    """Conservative predicted relative l2 error of a backward transform vs
+    a dense f64 oracle, for uniform-magnitude (O(1) dynamic range) value
+    sets.
+
+    Calibrated against the measured on-TPU precision matrix with the
+    round-4 matmul-DFT stages (docs/precision.md;
+    scripts/precision_matrix.py): single-precision backward l2 vs the
+    dense f64 oracle measures 1.4e-7 (32^3) / 1.5e-7 (64^3) / 1.7e-7
+    (128^3) / 1.8e-7 (256^3) / 1.9e-7 (512^3) — fit err ~ 1.5e-7 *
+    (n/64)^0.13; the model uses a ~1.8x envelope so every measured point
+    (including the adversarial rows, worst 1.92e-7) sits well below it.
+    Double precision follows the same shape from the f64 epsilon. The
+    model covers the reference-contract workload class (values of
+    bounded dynamic range, test_check_values.hpp:46-50); measured 1e±6
+    dynamic range stayed at 1.9e-7 relative l2
+    (docs/precision.md 'Adversarial rows').
+    """
+    shape = (max(max_dim, 1) / 64.0) ** 0.13
+    if precision == "single":
+        return 2.8e-7 * shape
+    return 5.0e-15 * shape  # f64 eps * same shape, ~10x headroom
+
+
 class TransformPlan:
     """A compiled sparse 3D FFT on a single device.
 
@@ -62,9 +86,23 @@ class TransformPlan:
 
     def __init__(self, index_plan: IndexPlan, precision: str = "single",
                  use_pallas: Optional[bool] = None,
-                 donate_inputs: bool = False):
+                 donate_inputs: bool = False,
+                 max_rel_error: Optional[float] = None):
         from .utils.platform import enable_persistent_compilation_cache
         enable_persistent_compilation_cache()
+        if max_rel_error is not None:
+            predicted = predicted_rel_error(
+                precision, max(index_plan.dim_x, index_plan.dim_y,
+                               index_plan.dim_z))
+            if predicted > max_rel_error:
+                from .errors import PrecisionContractError
+                raise PrecisionContractError(
+                    f"precision='{precision}' predicts relative error "
+                    f"~{predicted:.1e} at dims ({index_plan.dim_x},"
+                    f"{index_plan.dim_y},{index_plan.dim_z}), above the "
+                    f"requested max_rel_error={max_rel_error:.1e} — use "
+                    f"precision='double' (CPU backend) for the reference's "
+                    f"f64 contract (docs/precision.md)")
         #: When True, the fused round-trip executables (apply_pointwise /
         #: iterate_pointwise) DONATE their values argument: the output has
         #: the same shape, so XLA aliases the input buffer into it, cutting
@@ -975,12 +1013,19 @@ class TransformPlan:
 def make_local_plan(transform_type: TransformType, dim_x: int, dim_y: int,
                     dim_z: int, triplets, precision: str = "single",
                     use_pallas: Optional[bool] = None,
-                    donate_inputs: bool = False) -> TransformPlan:
+                    donate_inputs: bool = False,
+                    max_rel_error: Optional[float] = None) -> TransformPlan:
     """Build a local plan from raw index triplets — the moral equivalent of
     ``Grid::create_transform`` without a communicator (reference:
     grid.hpp:138-141). ``donate_inputs=True`` lets XLA reuse the caller's
-    input device buffers for outputs (see TransformPlan.donate_inputs)."""
+    input device buffers for outputs (see TransformPlan.donate_inputs).
+    ``max_rel_error`` demands an accuracy contract at construction: when
+    the calibrated error model (:func:`predicted_rel_error`) says the
+    chosen precision cannot meet it, a typed
+    :class:`~spfft_tpu.errors.PrecisionContractError` is raised instead
+    of returning silently-degraded results."""
     plan = build_index_plan(TransformType(transform_type), dim_x, dim_y,
                             dim_z, np.asarray(triplets))
     return TransformPlan(plan, precision=precision, use_pallas=use_pallas,
-                         donate_inputs=donate_inputs)
+                         donate_inputs=donate_inputs,
+                         max_rel_error=max_rel_error)
