@@ -229,6 +229,25 @@ class DistributedTransformPlan:
             self._n_ctables = len(ctables)
             self._device_tables = self._device_tables + tuple(
                 jax.device_put(a, self._sharded) for a in ctables)
+        # Comm-size-1 collapse (reference: grid_internal.cpp:182 treats a
+        # size-1 communicator as local): single-shard plans EXECUTE
+        # through the local pipeline (planar T-layout matmul-DFT, stick
+        # padding, no pack/exchange/unpack round trip — measured 1.65x
+        # faster at 256^3), while the distributed API surface (padded
+        # (1, ...) layouts, shard helpers, getters, wire-byte model)
+        # stays. Large pair-layout plans keep the SPMD path (the local
+        # boundary would transpose the values through the host).
+        from ..plan import PAIR_IO_THRESHOLD, TransformPlan
+        self._local1 = None
+        self._local1_fns = {}
+        if (dist_plan.num_shards == 1 and jax.process_count() == 1
+                and use_pallas is not True  # explicit force keeps the
+                # SPMD kernel path (interpret-mode semantics on CPU)
+                and dist_plan.shard_plans[0].num_values
+                < PAIR_IO_THRESHOLD):
+            self._local1 = TransformPlan(dist_plan.shard_plans[0],
+                                         precision=precision,
+                                         use_pallas=use_pallas)
         self._base_in_specs = (
             (P(self.axis_name),                       # data
              P(self.axis_name), P(self.axis_name),    # vi, slot_src
@@ -700,6 +719,12 @@ class DistributedTransformPlan:
         scaling = Scaling(scaling)
         if not isinstance(values, jax.Array):
             values = self.shard_values(values)
+        if self._local1 is not None:
+            with timed_transform("apply_pointwise") as box:
+                box.value = self._local1.apply_pointwise(
+                    values[0], self._local1_fn(fn), *fn_args,
+                    scaling=scaling)[None]
+            return box.value
         key = (fn, scaling, len(fn_args))
         jitted = self._pair_jits.get(key)
         if jitted is None:
@@ -721,6 +746,12 @@ class DistributedTransformPlan:
         scaling = Scaling(scaling)
         if not isinstance(values, jax.Array):
             values = self.shard_values(values)
+        if self._local1 is not None:
+            with timed_transform("iterate_pointwise") as box:
+                box.value = self._local1.iterate_pointwise(
+                    values[0], self._local1_fn(fn), *fn_args, steps=steps,
+                    scaling=scaling)[None]
+            return box.value
         # scan carry dtype must match the step output (_rdt)
         values = values.astype(self._rdt)
         key = (fn, scaling, int(steps), "scan", len(fn_args))
@@ -882,12 +913,30 @@ class DistributedTransformPlan:
         return out
 
     # -- execution -----------------------------------------------------------
+    def _local1_fn(self, fn):
+        """Adapter for the comm-size-1 local delegate: the distributed
+        pointwise contract hands ``fn`` the padded (1, planes, ...) slab;
+        the local pipeline produces the bare slab. Cached per fn so the
+        delegate's executable cache keys stay stable."""
+        if fn is None:
+            return None
+        w = self._local1_fns.get(fn)
+        if w is None:
+            def w(s, *a, _fn=fn):
+                return _fn(s[None], *a)[0]
+            self._local1_fns[fn] = w
+        return w
+
     def backward(self, values) -> jax.Array:
         """Frequency -> space across the mesh. ``values``: a per-shard list
         (numpy) or the padded sharded device array. Returns the padded
         sharded space array."""
         if not isinstance(values, jax.Array):
             values = self.shard_values(values)
+        if self._local1 is not None:
+            with timed_transform("backward") as box:
+                box.value = self._local1.backward(values[0])[None]
+            return box.value
         with timed_transform("backward") as box:
             box.value = self._backward_jit(values, *self._device_tables)
         return box.value
@@ -898,6 +947,10 @@ class DistributedTransformPlan:
         scaling = Scaling(scaling)
         if not isinstance(space, jax.Array):
             space = self.shard_space(space)
+        if self._local1 is not None:
+            with timed_transform("forward") as box:
+                box.value = self._local1.forward(space[0], scaling)[None]
+            return box.value
         with timed_transform("forward") as box:
             box.value = self._forward_jit[scaling](space,
                                                    *self._device_tables)

@@ -444,3 +444,36 @@ def test_default_exchange_mechanism():
     values = plan.shard_values([random_values(rng, len(p)) for p in parts])
     txt = plan._backward_jit.lower(values, *plan._device_tables).as_text()
     assert "all_to_all" in txt and "collective_permute" not in txt
+
+
+def test_comm_size_1_local_collapse():
+    """A one-shard distributed plan executes through the LOCAL pipeline
+    (reference: grid_internal.cpp:182 treats a size-1 communicator as
+    local) while keeping the padded distributed API surface; explicit
+    use_pallas=True keeps the SPMD kernel path (interpret-mode
+    semantics)."""
+    rng = np.random.default_rng(17)
+    dims = (10, 9, 8)
+    triplets = random_sparse_triplets(rng, dims)
+    values = random_values(rng, len(triplets))
+    plan = make_distributed_plan(TransformType.C2C, *dims, [triplets],
+                                 [dims[2]], mesh=make_mesh(1),
+                                 precision="double")
+    assert plan._local1 is not None
+    cube = dense_cube_from_values(triplets, values, dims)
+    oracle = dense_backward(cube)
+    space = plan.backward([values])
+    assert space.shape[0] == 1  # padded distributed layout preserved
+    np.testing.assert_allclose(np.asarray(plan.unshard_space(space)[0]),
+                               oracle,
+                               atol=tolerance_for("double", oracle), rtol=0)
+    out = plan.unshard_values(plan.apply_pointwise([values],
+                                                   scaling=Scaling.FULL))
+    np.testing.assert_allclose(out[0], values, atol=1e-10, rtol=0)
+    it = plan.unshard_values(plan.iterate_pointwise(
+        [values], lambda s: s, steps=2, scaling=Scaling.FULL))
+    np.testing.assert_allclose(it[0], values, atol=1e-9, rtol=0)
+    forced = make_distributed_plan(TransformType.C2C, *dims, [triplets],
+                                   [dims[2]], mesh=make_mesh(1),
+                                   precision="single", use_pallas=True)
+    assert forced._local1 is None  # SPMD kernel path kept when forced
