@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Round-4 probe: can the grid stages drop the pack/unpack transposes?
+
+Candidate pipeline (timing-faithful, tables reused where layouts allow):
+  backward: dec -> z-ifft (minor) -> unpack WITHOUT .T: (Y, XF, Z)
+            -> y-DFT as axis-0 GEMM 'ky,y(xz)' -> (KY, XF, Z)
+            -> transpose to (XF, KY, Z)
+            -> x-DFT as axis-0 GEMM -> space (X, Y, Z)   [reversed]
+  forward:  x-DFT axis-0 -> (KX, Y, Z) -> transpose (Y, KX, Z)
+            -> y-DFT axis-0 -> (KY, KX, Z) -> reshape (cols, Z)
+            -> pack row gather (no .T) -> z-fft -> cmp
+vs the current T-layout pipeline. Identity-pair timing only (values are
+numerically wrong where tables assume other layouts — cost-faithful).
+"""
+import os
+import sys
+import time
+import functools
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from spfft_tpu import TransformType, make_local_plan
+from spfft_tpu.ops import dft
+from spfft_tpu.utils.benchtime import diff_estimate_seconds
+from spfft_tpu.utils.workloads import spherical_cutoff_triplets
+
+
+def main(n: int):
+    triplets = spherical_cutoff_triplets(n)
+    plan = make_local_plan(TransformType.C2C, n, n, n, triplets,
+                           precision="single")
+    plan._finalize()
+    p = plan.index_plan
+    N = p.num_values
+    tables = plan._tables  # full set (col_inv y-major + T tables)
+    rng = np.random.default_rng(0)
+    values = (rng.uniform(-1, 1, N)
+              + 1j * rng.uniform(-1, 1, N)).astype(np.complex64)
+    vil = jax.device_put(plan._coerce_values(values))
+
+    def sync(a):
+        return float(np.asarray(a.ravel()[0]))
+
+    def timed_ms(fn, *args):
+        o = fn(*args); sync(o)
+        def grp(g):
+            t0 = time.perf_counter(); o = None
+            for _ in range(g):
+                o = fn(*args)
+            sync(o)
+            return time.perf_counter() - t0
+        return diff_estimate_seconds(grp, reps=20).seconds * 1e3
+
+    # current pipeline
+    cur = jax.jit(functools.partial(plan._pair_impl, scaled=False, fn=None))
+    print(f"current planar T pair:      "
+          f"{timed_ms(cur, vil, plan._tables_hot):8.3f} ms", flush=True)
+
+    S_pad, Z, Y, XF = plan._s_pad, p.dim_z, p.dim_y, p.dim_x_freq
+    mats_b = dft.c2c_mats(n, dft.BACKWARD)
+    mats_f = dft.c2c_mats(n, dft.FORWARD)
+
+    def gemm0(mats, g):
+        """axis-0 contraction: (K, d0) x (d0, rest) as one GEMM, planar
+        Karatsuba like pdft_last."""
+        cr, ci, cs = mats
+        sh = g[0].shape
+        flat_r = g[0].reshape(sh[0], -1)
+        flat_i = g[1].reshape(sh[0], -1)
+        dot = lambda c, x: jax.lax.dot_general(
+            jnp.asarray(c), x, (((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST)
+        p1 = dot(cr, flat_r)
+        p2 = dot(ci, flat_i)
+        p3 = dot(cs, flat_r + flat_i)
+        out_shape = (cr.shape[0],) + sh[1:]
+        return ((p1 - p2).reshape(out_shape),
+                (p3 - p1 - p2).reshape(out_shape))
+
+    col_inv = np.asarray(tables["col_inv"])          # y-major (Y*XF,)
+    col_inv_dev = jnp.asarray(col_inv)
+    scat = jnp.asarray(np.asarray(tables["scatter_cols"]))  # (S_pad,)
+
+    def pair_nt(v):
+        sr, si = plan._decompress_planar(v, tables)
+        sr, si = dft.pdft_last(sr, si, dft.c2c_mats(Z, dft.BACKWARD))
+        gr = sr[col_inv_dev].reshape(Y, XF, Z)   # unpack, NO transpose
+        gi = si[col_inv_dev].reshape(Y, XF, Z)
+        gr, gi = gemm0(mats_b, (gr, gi))          # y-DFT axis-0
+        gr = jnp.swapaxes(gr, 0, 1)               # (XF, KY, Z)
+        gi = jnp.swapaxes(gi, 0, 1)
+        gr, gi = gemm0(mats_b, (gr, gi))          # x-DFT -> space (X,Y,Z)
+        # forward
+        gr, gi = gemm0(mats_f, (gr, gi))          # (KX, Y, Z)
+        gr = jnp.swapaxes(gr, 0, 1)               # (Y, KX, Z)
+        gi = jnp.swapaxes(gi, 0, 1)
+        gr, gi = gemm0(mats_f, (gr, gi))          # (KY, KX, Z)
+        fr = gr.reshape(Y * XF, Z)[scat]          # pack row gather, no .T
+        fi = gi.reshape(Y * XF, Z)[scat]
+        fr, fi = dft.pdft_last(fr, fi, dft.c2c_mats(Z, dft.FORWARD))
+        return plan._compress_planar(fr, fi, tables)
+
+    f = jax.jit(pair_nt)
+    print(f"no-pack-transpose pair:     {timed_ms(f, vil):8.3f} ms",
+          flush=True)
+
+
+if __name__ == "__main__":
+    print(f"devices: {jax.devices()}", flush=True)
+    main(int(os.environ.get("DIM", "256")))
