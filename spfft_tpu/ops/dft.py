@@ -145,52 +145,6 @@ def cdft_last(x, mats):
     return yr + 1j * yi
 
 
-def _dot_first(c, x):
-    """(out, in) @ (in, rest...) -> (out, rest...) — the axis-0 GEMM form
-    used by the transpose-free grid pipeline (plan.py): contracting the
-    LEADING data axis lets unpack/pack keep the (cols, Z) gather layout
-    with no transposes at all."""
-    sh = x.shape
-    flat = x.reshape(sh[0], -1)
-    out = jax.lax.dot_general(jnp.asarray(c), flat,
-                              (((1,), (0,)), ((), ())),
-                              precision=_HIGHEST)
-    return out.reshape((c.shape[0],) + sh[1:])
-
-
-def pdft_first(xr, xi, mats_first):
-    """Complex DFT along AXIS 0 on planar operands (Karatsuba 3-mult).
-    ``mats_first`` come from the ``*_mats_first`` builders ((out, in)
-    layout)."""
-    cr, ci, cs = mats_first
-    p1 = _dot_first(cr, xr)
-    p2 = _dot_first(ci, xi)
-    p3 = _dot_first(cs, xr + xi)
-    return p1 - p2, p3 - p1 - p2
-
-
-@functools.lru_cache(maxsize=None)
-def c2c_mats_first(n: int, sign: int, scale: float = 1.0):
-    """Axis-0 form of :func:`c2c_mats`. The full DFT matrix is SYMMETRIC
-    (C[k,m] = e^{s 2 pi i k m / n} = C[m,k]), so the same arrays serve
-    both contraction forms."""
-    return c2c_mats(n, sign, scale)
-
-
-@functools.lru_cache(maxsize=None)
-def sub_rows_mats_first(n: int, sign: int, rows: tuple, scale: float = 1.0):
-    """Axis-0 form of :func:`sub_rows_mats`: (out=n, in=w) layout."""
-    return tuple(np.ascontiguousarray(m.T)
-                 for m in sub_rows_mats(n, sign, rows, scale))
-
-
-@functools.lru_cache(maxsize=None)
-def sub_cols_mats_first(n: int, sign: int, cols: tuple, scale: float = 1.0):
-    """Axis-0 form of :func:`sub_cols_mats`: (out=w, in=n) layout."""
-    return tuple(np.ascontiguousarray(m.T)
-                 for m in sub_cols_mats(n, sign, cols, scale))
-
-
 # -- real transforms ---------------------------------------------------------
 
 def prdft_last(x, mats):
@@ -205,40 +159,6 @@ def pirdft_last(yr, yi, mats):
     (..., n): two dots; hermitian doubling folded into the matrices."""
     a, b = mats
     return _dot(yr, a) + _dot(yi, b)
-
-
-def prdft_first(x, mats_first):
-    """Real forward DFT along axis 0 -> planar half spectrum
-    (n//2+1, rest...). ``mats_first`` from :func:`r2c_mats_first`."""
-    a, b = mats_first
-    return _dot_first(a, x), _dot_first(b, x)
-
-
-def pirdft_first(yr, yi, mats_first):
-    """Planar half spectrum -> real inverse along axis 0 (n, rest...).
-    ``mats_first`` from :func:`c2r_mats_first`."""
-    a, b = mats_first
-    return _dot_first(a, yr) + _dot_first(b, yi)
-
-
-@functools.lru_cache(maxsize=None)
-def r2c_mats_first(n: int, scale: float = 1.0, cols: tuple = None):
-    """Axis-0 forms of the forward-real matrices ((xf|w, n) layout);
-    ``cols`` selects a half-spectrum output window (split-x)."""
-    mats = _rdft_mats(n, float(scale))
-    if cols is not None:
-        mats = _sub_cols(mats, np.asarray(cols))
-    return tuple(np.ascontiguousarray(m.T) for m in mats)
-
-
-@functools.lru_cache(maxsize=None)
-def c2r_mats_first(n: int, scale: float = 1.0, rows: tuple = None):
-    """Axis-0 forms of the inverse-real matrices ((n, xf|w) layout);
-    ``rows`` selects the supplied half-spectrum window (split-x)."""
-    mats = _irdft_mats(n, float(scale))
-    if rows is not None:
-        mats = _sub_rows(mats, np.asarray(rows))
-    return tuple(np.ascontiguousarray(m.T) for m in mats)
 
 
 # -- stage-level helpers (mats builders with scale folding) ------------------

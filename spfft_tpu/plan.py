@@ -154,35 +154,29 @@ class TransformPlan:
         p = index_plan
         extra = self._s_pad - p.num_sticks
         pads = np.zeros(extra, np.int32)
-        # one table set serves both pipelines: y-major columns feed the
-        # fallback's (planes, cols) stages AND the transpose-free
-        # (cols, Z) row gathers of the matmul path
-        self._tables_hot = {
-            "col_inv": jnp.asarray(p.col_inv),
-            "scatter_cols": jnp.asarray(
+        self._tables_hot = {}
+        if self._use_mdft:
+            self._tables_hot["col_inv_t"] = jnp.asarray(p.col_inv_t)
+            self._tables_hot["scatter_cols_t"] = jnp.asarray(
+                np.concatenate([p.scatter_cols_t, pads]) if extra
+                else p.scatter_cols_t)
+        else:
+            self._tables_hot["col_inv"] = jnp.asarray(p.col_inv)
+            self._tables_hot["scatter_cols"] = jnp.asarray(
                 np.concatenate([p.scatter_cols, pads]) if extra
-                else p.scatter_cols),
-        }
+                else p.scatter_cols)
         if not will_build:
             self._commit_fallback("dec")
             self._commit_fallback("cmp")
         self._init_split_x()
         self._batched = None
         self._pair_jits = {}
-        # The jitted executables CLOSE OVER the hot tables (read at trace
-        # time, after _finalize): embedded constants get compiler-chosen
-        # layouts, measured 2.5 ms faster per 256^3 pair than passing the
-        # tables as call arguments (the executables' constant pools cost
-        # ~100 MB HBM each; the persistent cache absorbs the compile).
-        self._backward_jit = jax.jit(
-            lambda v: self._backward_impl(v, self._tables_hot))
+        self._backward_jit = jax.jit(self._backward_impl)
         self._forward_jit = {
-            Scaling.NONE: jax.jit(
-                lambda sp: self._forward_impl(sp, self._tables_hot,
-                                              scaled=False)),
-            Scaling.FULL: jax.jit(
-                lambda sp: self._forward_impl(sp, self._tables_hot,
-                                              scaled=True)),
+            Scaling.NONE: jax.jit(functools.partial(self._forward_impl,
+                                                    scaled=False)),
+            Scaling.FULL: jax.jit(functools.partial(self._forward_impl,
+                                                    scaled=True)),
         }
         if will_build:
             # The compression-table build (native cover + device commit,
@@ -355,6 +349,14 @@ class TransformPlan:
                 full["slot_src"] = jnp.asarray(ss)
             if "value_indices" not in full:
                 full["value_indices"] = jnp.asarray(p.value_indices)
+            if "scatter_cols" not in full:
+                extra = self._s_pad - p.num_sticks
+                sc = p.scatter_cols
+                if extra:
+                    sc = np.concatenate([sc, np.zeros(extra, np.int32)])
+                full["scatter_cols"] = jnp.asarray(sc)
+            if "col_inv" not in full:
+                full["col_inv"] = jnp.asarray(p.col_inv)
             self._tables_full = full
         return self._tables_full
 
@@ -383,12 +385,22 @@ class TransformPlan:
             return
         self._split_x = (x0, w)
         pads = np.zeros(self._s_pad - p.num_sticks, np.int32)
-        cols_sub = window_sub_cols(p.scatter_cols, xf, x0, w)
-        col_inv_sub = inverse_col_map(cols_sub, p.dim_y * w,
-                                      p.num_sticks)
-        self._tables_hot["col_inv_sub"] = jnp.asarray(col_inv_sub)
-        self._tables_hot["scatter_cols_sub"] = jnp.asarray(
-            np.concatenate([cols_sub, pads]) if len(pads) else cols_sub)
+        if self._use_mdft:
+            # T layout: window-x-major columns x_w * dim_y + y
+            x_w = (p.stick_x.astype(np.int64) - x0) % xf
+            cols_sub_t = (x_w * p.dim_y
+                          + p.stick_y.astype(np.int64)).astype(np.int32)
+            self._tables_hot["col_inv_sub_t"] = jnp.asarray(
+                inverse_col_map(cols_sub_t, w * p.dim_y, p.num_sticks))
+            self._tables_hot["scatter_cols_sub_t"] = jnp.asarray(
+                np.concatenate([cols_sub_t, pads]))
+        else:
+            cols_sub = window_sub_cols(p.scatter_cols, xf, x0, w)
+            col_inv_sub = inverse_col_map(cols_sub, p.dim_y * w,
+                                          p.num_sticks)
+            self._tables_hot["col_inv_sub"] = jnp.asarray(col_inv_sub)
+            self._tables_hot["scatter_cols_sub"] = jnp.asarray(
+                np.concatenate([cols_sub, pads]))
 
     @property
     def pallas_active(self) -> bool:
@@ -528,27 +540,14 @@ class TransformPlan:
         return gk.interleaved_from_planar(out_re, out_im, t.num_out,
                                           pair=self._pair_io)
 
-    def _unpack_rows(self, ch, col_inv_tab):
-        """One planar channel (s_pad, Z) -> (cols, Z) row gather. Padded
-        plans index a real zero row through the sentinel; unpadded ones
-        concatenate one (the XLA-fallback shape)."""
-        if self._s_pad > self.index_plan.num_sticks:
-            return ch[col_inv_tab]
-        return stages.gather_rows_with_sentinel(ch, col_inv_tab)
-
     def _backward_rest_tp(self, sr, si, tables):
-        """Matmul-DFT tail of backward, PLANAR and TRANSPOSE-FREE:
-        z-DFT on sticks (minor axis), unpack keeps the row-gather's
-        (cols, Z) layout reshaped (Y, XFe, Z), the y-DFT contracts
-        axis 0 as one GEMM, ONE axis swap, then the x-stage contracts
-        axis 0 — the space slab comes out axis-REVERSED (X, Y, Z), and
-        only boundaries that hand it to a caller reverse it
-        (scripts/probe_r4_notranspose.py: dropping the pack/unpack
-        transposes saves 1.4 ms at 256^3). Planar channels throughout:
-        XLA stores c64 interleaved T(2,128), so complex materialisations
-        between stages are interleave copies the pair form never pays.
-        Returns (xr, xi) planar (X, Y, Z) for C2C, real (X, Y, Z) for
-        R2C."""
+        """Matmul-DFT T-layout tail of backward, fully PLANAR (separate
+        re/im f32 arrays — XLA stores c64 interleaved T(2,128), so every
+        complex materialisation between stages is an interleave copy the
+        planar form never pays): z-DFT on sticks, unpack into the
+        TRANSPOSED plane grid (planes, x, y), y-DFT on the minor axis,
+        one swap, then the x-stage. Returns (xr, xi) planar space for
+        C2C, the real space slab for R2C."""
         from .ops import dft
         p = self.index_plan
         if self._is_r2c and p.zero_stick_id is not None:
@@ -560,104 +559,91 @@ class TransformPlan:
             si = si.at[zid].set(jnp.where(nz, ri, -jnp.roll(ri[::-1], 1)))
         sr, si = dft.pdft_last(sr, si, dft.c2c_mats(p.dim_z, dft.BACKWARD))
         xf = p.dim_x_freq
+        unpack = stages.sticks_to_grid_padded \
+            if self._s_pad > p.num_sticks else stages.sticks_to_grid
         if self._split_x is not None:
             x0, w = self._split_x
-            col_tab = tables["col_inv_sub"]
+            col_tab = tables["col_inv_sub_t"]
             rows = tuple(int(r) for r in (x0 + np.arange(w)) % xf)
         else:
             x0, w = 0, xf
-            col_tab = tables["col_inv"]
+            col_tab = tables["col_inv_t"]
             rows = None
-        gr = self._unpack_rows(sr, col_tab).reshape(p.dim_y, w, p.dim_z)
-        gi = self._unpack_rows(si, col_tab).reshape(p.dim_y, w, p.dim_z)
+        gr = unpack(sr, col_tab, w, p.dim_y)
+        gi = unpack(si, col_tab, w, p.dim_y)
         if self._is_r2c and x0 == 0:
-            # complete the x=0 sub-plane along y (axis 0 here)
+            # complete the x=0 sub-plane along y (contiguous in T layout)
             cr, ci = gr[:, 0, :], gi[:, 0, :]
             nz = (cr != 0) | (ci != 0)
             gr = gr.at[:, 0, :].set(
-                jnp.where(nz, cr, jnp.roll(cr[::-1, :], 1, axis=0)))
+                jnp.where(nz, cr, jnp.roll(cr[:, ::-1], 1, axis=-1)))
             gi = gi.at[:, 0, :].set(
-                jnp.where(nz, ci, -jnp.roll(ci[::-1, :], 1, axis=0)))
-        gr, gi = dft.pdft_first(gr, gi,
-                                dft.c2c_mats_first(p.dim_y, dft.BACKWARD))
-        gr = jnp.swapaxes(gr, 0, 1)   # (XFe, Y, Z)
-        gi = jnp.swapaxes(gi, 0, 1)
+                jnp.where(nz, ci, -jnp.roll(ci[:, ::-1], 1, axis=-1)))
+        gr, gi = dft.pdft_last(gr, gi, dft.c2c_mats(p.dim_y, dft.BACKWARD))
+        gr = jnp.swapaxes(gr, -1, -2)
+        gi = jnp.swapaxes(gi, -1, -2)
         if self._is_r2c:
-            mats = dft.c2r_mats_first(p.dim_x) if rows is None \
-                else dft.c2r_mats_first(p.dim_x, rows=rows)
-            return dft.pirdft_first(gr, gi, mats)
-        mats = dft.c2c_mats_first(p.dim_x, dft.BACKWARD) if rows is None \
-            else dft.sub_rows_mats_first(p.dim_x, dft.BACKWARD, rows)
-        return dft.pdft_first(gr, gi, mats)
-
-    @staticmethod
-    def _rev(x):
-        """(X, Y, Z) <-> (Z, Y, X) axis reversal (the public space
-        layout; paid only at boundaries a caller observes)."""
-        return jnp.transpose(x, (2, 1, 0))
+            mats = dft.c2r_mats(p.dim_x) if rows is None \
+                else dft.sub_rows_c2r_mats(p.dim_x, rows)
+            return dft.pirdft_last(gr, gi, mats)
+        mats = dft.c2c_mats(p.dim_x, dft.BACKWARD) if rows is None \
+            else dft.sub_rows_mats(p.dim_x, dft.BACKWARD, rows)
+        return dft.pdft_last(gr, gi, mats)
 
     def _backward_rest_t(self, sticks, tables):
         """Complex-dtype wrapper of :meth:`_backward_rest_tp` (the batched
         path feeds complex sticks); returns the public interleaved (C2C)
-        or real (R2C) space layout, axes natural (Z, Y, X)."""
+        or real (R2C) space layout."""
         out = self._backward_rest_tp(jnp.real(sticks), jnp.imag(sticks),
                                      tables)
         if self._is_r2c:
-            return self._rev(out)
-        return jnp.stack([self._rev(out[0]), self._rev(out[1])], axis=-1)
+            return out
+        return jnp.stack([out[0], out[1]], axis=-1)
 
     def _forward_head_tp(self, space_p, tables, scale):
-        """Planar transpose-free head of forward: x-stage contracts
-        axis 0 of the REVERSED space slab, ONE axis swap, y-DFT axis 0,
-        pack as a direct row gather of the (cols, Z) layout, then the
+        """Planar T-layout head of forward: x-stage on the minor axis,
+        one swap into the transposed grid, y-DFT minor, pack, then the
         z-DFT with any FULL scaling folded into its matrix. ``space_p``
-        is (xr, xi) planar (X, Y, Z) for C2C, real (X, Y, Z) for R2C.
-        Returns (sr, si) planar sticks."""
+        is (xr, xi) planar for C2C, the real slab for R2C. Returns
+        (sr, si) planar sticks."""
         from .ops import dft
         p = self.index_plan
         xf = p.dim_x_freq
         if self._split_x is not None:
             x0, w = self._split_x
             cols = tuple(int(c) for c in (x0 + np.arange(w)) % xf)
-            cols_tab = tables["scatter_cols_sub"]
+            cols_tab = tables["scatter_cols_sub_t"]
             if self._is_r2c:
-                gr, gi = dft.prdft_first(
-                    space_p.astype(self._rdt),
-                    dft.r2c_mats_first(p.dim_x, cols=cols))
+                gr, gi = dft.prdft_last(space_p.astype(self._rdt),
+                                        dft.sub_cols_r2c_mats(p.dim_x,
+                                                              cols))
             else:
-                gr, gi = dft.pdft_first(
+                gr, gi = dft.pdft_last(
                     space_p[0].astype(self._rdt),
                     space_p[1].astype(self._rdt),
-                    dft.sub_cols_mats_first(p.dim_x, dft.FORWARD, cols))
+                    dft.sub_cols_mats(p.dim_x, dft.FORWARD, cols))
         else:
-            cols_tab = tables["scatter_cols"]
+            cols_tab = tables["scatter_cols_t"]
             if self._is_r2c:
-                gr, gi = dft.prdft_first(space_p.astype(self._rdt),
-                                         dft.r2c_mats_first(p.dim_x))
+                gr, gi = dft.prdft_last(space_p.astype(self._rdt),
+                                        dft.r2c_mats(p.dim_x))
             else:
-                gr, gi = dft.pdft_first(space_p[0].astype(self._rdt),
-                                        space_p[1].astype(self._rdt),
-                                        dft.c2c_mats_first(p.dim_x,
-                                                           dft.FORWARD))
-        gr = jnp.swapaxes(gr, 0, 1)   # (Y, XFe, Z)
-        gi = jnp.swapaxes(gi, 0, 1)
-        gr, gi = dft.pdft_first(gr, gi,
-                                dft.c2c_mats_first(p.dim_y, dft.FORWARD))
-        flat_r = gr.reshape(-1, p.dim_z)
-        flat_i = gi.reshape(-1, p.dim_z)
-        sr = flat_r[cols_tab]     # (s_pad, Z) row gather — no transpose
-        si = flat_i[cols_tab]
+                gr, gi = dft.pdft_last(space_p[0].astype(self._rdt),
+                                       space_p[1].astype(self._rdt),
+                                       dft.c2c_mats(p.dim_x, dft.FORWARD))
+        gr = jnp.swapaxes(gr, -1, -2)
+        gi = jnp.swapaxes(gi, -1, -2)
+        gr, gi = dft.pdft_last(gr, gi, dft.c2c_mats(p.dim_y, dft.FORWARD))
+        sr = stages.grid_to_sticks(gr, cols_tab)
+        si = stages.grid_to_sticks(gi, cols_tab)
         return dft.pdft_last(
             sr, si, dft.c2c_mats(p.dim_z, dft.FORWARD,
                                  scale=scale if scale else 1.0))
 
     def _forward_head_t(self, space, tables, scale):
         """Complex-dtype wrapper of :meth:`_forward_head_tp` (batched
-        path): natural interleaved/real space in, complex sticks out."""
-        if self._is_r2c:
-            sp = self._rev(space)
-        else:
-            sp = (self._rev(space[..., 0]), self._rev(space[..., 1]))
+        path): interleaved/real space in, complex sticks out."""
+        sp = space if self._is_r2c else (space[..., 0], space[..., 1])
         sr, si = self._forward_head_tp(sp, tables, scale)
         return sr + 1j * si
 
@@ -694,9 +680,8 @@ class TransformPlan:
             sr, si = self._decompress_planar(values_il, tables, pallas)
             out = self._backward_rest_tp(sr, si, tables)
             if self._is_r2c:
-                return self._rev(out)
-            return jnp.stack([self._rev(out[0]), self._rev(out[1])],
-                             axis=-1)
+                return out
+            return jnp.stack([out[0], out[1]], axis=-1)
         return self._backward_rest(
             self._decompress(values_il, tables, pallas), tables)
 
@@ -730,8 +715,7 @@ class TransformPlan:
     def _forward_impl(self, space, tables, *, scaled: bool, pallas=True):
         scale = 1.0 / self.global_size if scaled else None
         if self._use_mdft:  # planar pipeline, scale folded into z matrix
-            sp = self._rev(space) if self._is_r2c \
-                else (self._rev(space[..., 0]), self._rev(space[..., 1]))
+            sp = space if self._is_r2c else (space[..., 0], space[..., 1])
             sr, si = self._forward_head_tp(sp, tables, scale)
             return self._compress_planar(sr, si, tables, pallas)
         sticks = self._forward_head(space, tables)
@@ -808,14 +792,11 @@ class TransformPlan:
         kernel with a batched grid (same tables, one launch) when active."""
         if self._batched is None:
             self._batched = {
-                "backward": jax.jit(lambda b: self._backward_impl_batched(
-                    b, self._tables_hot)),
-                Scaling.NONE: jax.jit(
-                    lambda b: self._forward_impl_batched(
-                        b, self._tables_hot, scaled=False)),
-                Scaling.FULL: jax.jit(
-                    lambda b: self._forward_impl_batched(
-                        b, self._tables_hot, scaled=True)),
+                "backward": jax.jit(self._backward_impl_batched),
+                Scaling.NONE: jax.jit(functools.partial(
+                    self._forward_impl_batched, scaled=False)),
+                Scaling.FULL: jax.jit(functools.partial(
+                    self._forward_impl_batched, scaled=True)),
             }
         return self._batched
 
@@ -832,7 +813,8 @@ class TransformPlan:
             else jnp.stack([self._coerce_values(v) for v in values_batch])
         self._finalize()
         with timed_transform("backward_batched") as box:
-            box.value = self._batched_jits()["backward"](batch)
+            box.value = self._batched_jits()["backward"](batch,
+                                                         self._tables_hot)
         return box.value
 
     def forward_batched(self, space_batch, scaling: Scaling = Scaling.NONE):
@@ -846,7 +828,8 @@ class TransformPlan:
                     == (4 if self._is_r2c else 5)) else space_batch
         self._finalize()
         with timed_transform("forward_batched") as box:
-            box.value = self._batched_jits()[scaling](batch)
+            box.value = self._batched_jits()[scaling](batch,
+                                                      self._tables_hot)
         return box.value
 
     # -- fused round trip ----------------------------------------------------
@@ -858,15 +841,12 @@ class TransformPlan:
             sr, si = self._decompress_planar(values_il, tables)
             space = self._backward_rest_tp(sr, si, tables)
             if fn is not None:
-                # fn's contract is the NATURAL (z, y, x) slab; reverse in
-                # and out around it (the identity pair never reverses)
                 if self._is_r2c:
-                    space = self._rev(fn(self._rev(space), *fn_args))
+                    space = fn(space, *fn_args)
                 else:
-                    s = fn(jnp.stack([self._rev(space[0]),
-                                      self._rev(space[1])], axis=-1),
+                    s = fn(jnp.stack([space[0], space[1]], axis=-1),
                            *fn_args)
-                    space = (self._rev(s[..., 0]), self._rev(s[..., 1]))
+                    space = (s[..., 0], s[..., 1])
             scale = 1.0 / self.global_size if scaled else None
             out_sr, out_si = self._forward_head_tp(space, tables, scale)
             return self._compress_planar(out_sr, out_si, tables)
@@ -904,17 +884,14 @@ class TransformPlan:
         key = (fn, scaling)
         jitted = self._pair_jits.get(key)
         if jitted is None:
-            scaled = scaling is Scaling.FULL
-
-            def pair(v, *fa, _fn=fn, _scaled=scaled):
-                return self._pair_impl(v, self._tables_hot, *fa,
-                                       scaled=_scaled, fn=_fn)
             jitted = jax.jit(
-                pair, donate_argnums=(0,) if self.donate_inputs else ())
+                functools.partial(self._pair_impl,
+                                  scaled=scaling is Scaling.FULL, fn=fn),
+                donate_argnums=(0,) if self.donate_inputs else ())
             self._pair_jits[key] = jitted
         self._finalize()
         with timed_transform("apply_pointwise") as box:
-            box.value = jitted(values_il, *fn_args)
+            box.value = jitted(values_il, self._tables_hot, *fn_args)
         return box.value
 
     def iterate_pointwise(self, values, fn, *fn_args, steps: int,
@@ -937,9 +914,9 @@ class TransformPlan:
         if jitted is None:
             scaled = scaling is Scaling.FULL
 
-            def run(values_il, *fn_args):
+            def run(values_il, tables, *fn_args):
                 def step(v, _):
-                    return self._pair_impl(v, self._tables_hot, *fn_args,
+                    return self._pair_impl(v, tables, *fn_args,
                                            scaled=scaled, fn=fn), None
                 out, _ = jax.lax.scan(step, values_il, None,
                                       length=int(steps))
@@ -950,7 +927,7 @@ class TransformPlan:
             self._pair_jits[key] = jitted
         self._finalize()
         with timed_transform("iterate_pointwise") as box:
-            box.value = jitted(values_il, *fn_args)
+            box.value = jitted(values_il, self._tables_hot, *fn_args)
         return box.value
 
     # -- public execution (reference: transform.hpp:198-211) -----------------
@@ -963,7 +940,7 @@ class TransformPlan:
         values_il = self._coerce_values(values)
         self._finalize()
         with timed_transform("backward") as box:
-            box.value = self._backward_jit(values_il)
+            box.value = self._backward_jit(values_il, self._tables_hot)
         return box.value
 
     def forward(self, space, scaling: Scaling = Scaling.NONE):
@@ -975,7 +952,7 @@ class TransformPlan:
         space = self._coerce_space(space)
         self._finalize()
         with timed_transform("forward") as box:
-            box.value = self._forward_jit[scaling](space)
+            box.value = self._forward_jit[scaling](space, self._tables_hot)
         return box.value
 
     # -- input coercion ------------------------------------------------------

@@ -166,41 +166,3 @@ def test_use_matmul_dft_gating(monkeypatch):
     monkeypatch.delenv("SPFFT_TPU_FORCE_MATMUL_DFT")
     monkeypatch.setenv("SPFFT_TPU_NO_MATMUL_DFT", "1")
     assert not dft.use_matmul_dft(256, jnp.complex64)
-
-
-def test_first_axis_forms_match_last_axis():
-    """pdft_first/prdft_first/pirdft_first (the transpose-free pipeline's
-    axis-0 GEMMs) agree with the minor-axis forms."""
-    n = 24
-    rng = np.random.default_rng(9)
-    xr = rng.standard_normal((n, 5)).astype(np.float32)
-    xi = rng.standard_normal((n, 5)).astype(np.float32)
-    for sign in (dft.FORWARD, dft.BACKWARD):
-        yr, yi = dft.pdft_first(jnp.asarray(xr), jnp.asarray(xi),
-                                dft.c2c_mats_first(n, sign))
-        lr, li = dft.pdft_last(jnp.asarray(xr.T.copy()),
-                               jnp.asarray(xi.T.copy()),
-                               dft.c2c_mats(n, sign))
-        np.testing.assert_allclose(np.asarray(yr), np.asarray(lr).T,
-                                   atol=1e-4, rtol=1e-5)
-        np.testing.assert_allclose(np.asarray(yi), np.asarray(li).T,
-                                   atol=1e-4, rtol=1e-5)
-    # real forward + inverse
-    fr, fi = dft.prdft_first(jnp.asarray(xr), dft.r2c_mats_first(n))
-    gr, gi = dft.prdft_last(jnp.asarray(xr.T.copy()), dft.r2c_mats(n))
-    np.testing.assert_allclose(np.asarray(fr), np.asarray(gr).T,
-                               atol=1e-4, rtol=1e-5)
-    back = dft.pirdft_first(fr, fi, dft.c2r_mats_first(n))
-    ref = dft.pirdft_last(gr, gi, dft.c2r_mats(n))
-    np.testing.assert_allclose(np.asarray(back), np.asarray(ref).T,
-                               atol=1e-3, rtol=1e-5)
-    # windowed variants
-    rows = tuple(range(2, 9))
-    sr_, si_ = dft.pdft_first(jnp.asarray(xr[2:9]), jnp.asarray(xi[2:9]),
-                              dft.sub_rows_mats_first(n, dft.FORWARD,
-                                                      rows))
-    lr_, li_ = dft.pdft_last(jnp.asarray(xr[2:9].T.copy()),
-                             jnp.asarray(xi[2:9].T.copy()),
-                             dft.sub_rows_mats(n, dft.FORWARD, rows))
-    np.testing.assert_allclose(np.asarray(sr_), np.asarray(lr_).T,
-                               atol=1e-4, rtol=1e-5)
