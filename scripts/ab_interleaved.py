@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Interleaved multi-process A/B for pair-time experiments.
+
+The tunnel-attached device is BIMODAL per process (~1.3x between modes,
+state fixed for the process lifetime — BENCHMARKS.md 'Session
+discipline'), so a single-session A/B can report a 2 ms 'win' that is
+pure device state: two round-4 optimisations were committed on
+single-session evidence and reverted under this harness. This script is
+the required protocol for ANY tuning decision:
+
+  python scripts/ab_interleaved.py /root/repo /path/to/other [--rounds 4]
+
+Each round launches one fresh subprocess per variant (alternating), each
+measuring the 256^3 identity pair through the public API with the
+sync-cancelling difference estimator. Compares MIN and MEDIAN per
+variant and refuses a verdict when the distributions overlap.
+"""
+import argparse
+import os
+import statistics
+import subprocess
+import sys
+
+WORKER = r'''
+import os, sys, time
+sys.path.insert(0, sys.argv[1])
+import numpy as np, jax
+from spfft_tpu import TransformType, make_local_plan
+from spfft_tpu.utils.benchtime import diff_estimate_seconds
+from spfft_tpu.utils.workloads import spherical_cutoff_triplets
+n = int(os.environ.get("AB_DIM", "256"))
+triplets = spherical_cutoff_triplets(n)
+rng = np.random.default_rng(42)
+N = len(triplets)
+values = (rng.uniform(-1, 1, N)
+          + 1j * rng.uniform(-1, 1, N)).astype(np.complex64)
+plan = make_local_plan(TransformType.C2C, n, n, n, triplets,
+                       precision="single")
+vil = jax.device_put(plan._coerce_values(values))
+def sync(a):
+    return float(np.asarray(jax.numpy.real(a).ravel()[0]))
+o = plan.apply_pointwise(vil); sync(o)
+def grp(g):
+    t0 = time.perf_counter(); o = None
+    for _ in range(g):
+        o = plan.apply_pointwise(vil)
+    sync(o)
+    return time.perf_counter() - t0
+est = diff_estimate_seconds(grp, reps=20)
+print(f"ABRESULT {est.seconds * 1e3:.3f}")
+'''
+
+
+def run_one(path: str) -> float:
+    proc = subprocess.run([sys.executable, "-c", WORKER, path],
+                          capture_output=True, text=True)
+    for line in reversed(proc.stdout.splitlines()):
+        if line.startswith("ABRESULT"):
+            return float(line.split()[1])
+    sys.stderr.write(proc.stdout[-1500:] + proc.stderr[-1500:])
+    raise SystemExit(f"worker for {path} produced no result")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("a", help="first repo checkout (e.g. /root/repo)")
+    ap.add_argument("b", help="second checkout (e.g. a git worktree)")
+    ap.add_argument("--rounds", type=int, default=4)
+    args = ap.parse_args()
+    samples = {args.a: [], args.b: []}
+    for r in range(args.rounds):
+        for path in (args.a, args.b):
+            ms = run_one(path)
+            samples[path].append(ms)
+            print(f"round {r} {path}: {ms:.3f} ms", flush=True)
+    print()
+    stats = {}
+    for path, xs in samples.items():
+        stats[path] = (min(xs), statistics.median(xs))
+        print(f"{path}: min {min(xs):.3f}  median "
+              f"{statistics.median(xs):.3f}  samples "
+              f"{[round(x, 2) for x in xs]}")
+    (a_min, a_med), (b_min, b_med) = stats[args.a], stats[args.b]
+    if (a_min < b_min) == (a_med < b_med) and \
+            abs(a_med - b_med) > 0.05 * max(a_med, b_med):
+        win = args.a if a_med < b_med else args.b
+        print(f"VERDICT: {win} is faster (min and median agree, "
+              f"median gap > 5%)")
+    else:
+        print("VERDICT: inconclusive — min/median disagree or the gap is "
+              "inside the noise; add rounds before deciding")
+
+
+if __name__ == "__main__":
+    main()
