@@ -1,0 +1,181 @@
+#!/usr/bin/env python
+"""Round-5 probe: per-stage scan-timed breakdown of the ACTUAL matmul-DFT
+planar pipeline (profile_stages.py times the legacy jnp.fft stage set, so
+its numbers don't localise the mdft pair's cost).
+
+Stages mirror plan._backward_rest_tp / _forward_head_tp exactly, on planar
+carriers. Usage: DIM=256 python scripts/probe_r5_mdft_stages.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from spfft_tpu import TransformType, make_local_plan
+from spfft_tpu.ops import dft, stages
+from spfft_tpu.utils.workloads import spherical_cutoff_triplets
+
+R = int(os.environ.get("REPS", 20))
+C64 = 8
+
+
+def sync(out):
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    float(np.asarray(jax.numpy.real(leaf).ravel()[0]))
+
+
+def _perturb(t):
+    if isinstance(t, tuple):
+        return tuple(x * x.dtype.type(1.0 + 1e-7) for x in t)
+    return t * t.dtype.type(1.0 + 1e-7)
+
+
+def _consume(y):
+    leaves = jax.tree_util.tree_leaves(y)
+    return sum(jnp.mean(jnp.real(x)) + (jnp.mean(jnp.imag(x))
+               if jnp.iscomplexobj(x) else 0.0) for x in leaves)
+
+
+def _scan_seconds(body, x, reps=3):
+    def run(x0):
+        def step(c, _):
+            xp = _perturb(c)
+            return xp, _consume(body(xp))
+        _, ys = jax.lax.scan(step, x0, None, length=R)
+        return ys
+    f = jax.jit(run)
+    out = f(x)
+    sync(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = f(x)
+    sync(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    n = int(os.environ.get("DIM", "256"))
+    print(f"devices: {jax.devices()}", flush=True)
+    triplets = spherical_cutoff_triplets(n)
+    plan = make_local_plan(TransformType.C2C, n, n, n, triplets,
+                           precision="single")
+    p = plan.index_plan
+    assert plan._use_mdft and plan._pallas_active
+    tables = plan._tables
+    rng = np.random.default_rng(0)
+    N = p.num_values
+    values = (rng.uniform(-1, 1, N)
+              + 1j * rng.uniform(-1, 1, N)).astype(np.complex64)
+    vil = jax.device_put(plan._coerce_values(values))
+
+    S, Z = plan._s_pad, p.dim_z
+    xf = p.dim_x_freq
+    if plan._split_x is not None:
+        x0w, w = plan._split_x
+        col_tab = tables["col_inv_sub_t"]
+        cols_tab = tables["scatter_cols_sub_t"]
+        rows = tuple(int(r) for r in (x0w + np.arange(w)) % xf)
+        cols = rows
+    else:
+        w = xf
+        col_tab = tables["col_inv_t"]
+        cols_tab = tables["scatter_cols_t"]
+        rows = None
+    print(f"n={n} N={N} sticks={p.num_sticks} s_pad={S} split_x={plan._split_x}",
+          flush=True)
+
+    unpack = stages.sticks_to_grid_padded if S > p.num_sticks \
+        else stages.sticks_to_grid
+
+    # carriers
+    sticks_p = jax.jit(lambda v: plan._decompress_planar(v, tables))(vil)
+    grid_p = jax.jit(lambda sp: (unpack(sp[0], col_tab, w, p.dim_y),
+                                 unpack(sp[1], col_tab, w, p.dim_y)))(sticks_p)
+    swapped = jax.jit(lambda gp: (jnp.swapaxes(gp[0], -1, -2),
+                                  jnp.swapaxes(gp[1], -1, -2)))(grid_p)
+
+    cal_v = _scan_seconds(lambda x: x, vil)
+    cal_s = _scan_seconds(lambda x: x, sticks_p)
+    cal_g = _scan_seconds(lambda x: x, grid_p)
+    cal_w = _scan_seconds(lambda x: x, swapped)
+
+    G = Z * w * p.dim_y
+
+    def stage(name, body, x, cal, nbytes):
+        t = _scan_seconds(body, x)
+        dt = (t - cal) / R
+        noise = 0.15 * cal / R
+        flag = " (below noise)" if dt < noise else ""
+        gbs = nbytes / max(dt, 1e-9) / 1e9
+        print(f"{name:26s} {dt*1e3:8.3f} ms  {gbs:7.1f} GB/s{flag}",
+              flush=True)
+        return max(dt, 0.0)
+
+    tot = 0.0
+    tot += stage("decompress_planar",
+                 lambda v: plan._decompress_planar(v, tables), vil, cal_v,
+                 (N + S * Z) * C64)
+    zb = dft.c2c_mats(Z, dft.BACKWARD)
+    tot += stage("z pdft bwd",
+                 lambda sp: dft.pdft_last(sp[0], sp[1], zb),
+                 sticks_p, cal_s, 2 * S * Z * C64)
+    tot += stage("unpack (sticks->grid)",
+                 lambda sp: (unpack(sp[0], col_tab, w, p.dim_y),
+                             unpack(sp[1], col_tab, w, p.dim_y)),
+                 sticks_p, cal_s, (S * Z + G) * C64)
+    yb = dft.c2c_mats(p.dim_y, dft.BACKWARD)
+    tot += stage("y pdft bwd",
+                 lambda gp: dft.pdft_last(gp[0], gp[1], yb),
+                 grid_p, cal_g, 2 * G * C64)
+    tot += stage("swap",
+                 lambda gp: (jnp.swapaxes(gp[0], -1, -2),
+                             jnp.swapaxes(gp[1], -1, -2)),
+                 grid_p, cal_g, 2 * G * C64)
+    xmats = dft.c2c_mats(p.dim_x, dft.BACKWARD) if rows is None \
+        else dft.sub_rows_mats(p.dim_x, dft.BACKWARD, rows)
+    tot += stage("x pdft bwd",
+                 lambda gp: dft.pdft_last(gp[0], gp[1], xmats),
+                 swapped, cal_w,
+                 (G + Z * p.dim_y * p.dim_x) * C64)
+    # forward
+    space = jax.jit(lambda gp: dft.pdft_last(gp[0], gp[1], xmats))(swapped)
+    cal_sp = _scan_seconds(lambda x: x, space)
+    xf_mats = dft.c2c_mats(p.dim_x, dft.FORWARD) if rows is None \
+        else dft.sub_cols_mats(p.dim_x, dft.FORWARD, cols)
+    tot += stage("x pdft fwd",
+                 lambda sp: dft.pdft_last(sp[0], sp[1], xf_mats),
+                 space, cal_sp, (Z * p.dim_y * p.dim_x + G) * C64)
+    tot += stage("swap (fwd)",
+                 lambda gp: (jnp.swapaxes(gp[0], -1, -2),
+                             jnp.swapaxes(gp[1], -1, -2)),
+                 grid_p, cal_g, 2 * G * C64)
+    yf = dft.c2c_mats(p.dim_y, dft.FORWARD)
+    tot += stage("y pdft fwd",
+                 lambda gp: dft.pdft_last(gp[0], gp[1], yf),
+                 grid_p, cal_g, 2 * G * C64)
+    tot += stage("pack (grid->sticks)",
+                 lambda gp: (stages.grid_to_sticks(gp[0], cols_tab),
+                             stages.grid_to_sticks(gp[1], cols_tab)),
+                 grid_p, cal_g, (G + S * Z) * C64)
+    zf = dft.c2c_mats(Z, dft.FORWARD)
+    tot += stage("z pdft fwd",
+                 lambda sp: dft.pdft_last(sp[0], sp[1], zf),
+                 sticks_p, cal_s, 2 * S * Z * C64)
+    tot += stage("compress_planar",
+                 lambda sp: plan._compress_planar(sp[0], sp[1], tables),
+                 sticks_p, cal_s, (S * Z + N) * C64)
+    print(f"{'sum of stages':26s} {tot*1e3:8.2f} ms", flush=True)
+
+    pair = _scan_seconds(
+        lambda v: plan._pair_impl(v, tables, scaled=False, fn=None), vil, 3)
+    print(f"{'FULL fused pair':26s} {(pair - cal_v) / R * 1e3:8.3f} ms",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

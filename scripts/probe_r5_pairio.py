@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Round-5 probe: does forcing the planar PAIR (2, N) value boundary on a
+sub-threshold plan (256^3, 8.78M values) cut the fused identity pair?
+
+The rows (N, 2) boundary pays gather_kernel.planar_from_interleaved /
+interleaved_from_planar conversions inside every fused executable; the
+pair boundary reduces them to row slices / a (2, N) stack. Same-session
+A/B (alternating diff-estimator groups) — ratios are mode-invariant
+(BENCHMARKS.md 'Session discipline'); cross-check any win with
+scripts/ab_interleaved.py before committing a default change.
+
+Usage: DIM=256 python scripts/probe_r5_pairio.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+
+import spfft_tpu.plan as plan_mod
+from spfft_tpu import TransformType, make_local_plan
+from spfft_tpu.utils.benchtime import diff_estimate_seconds
+from spfft_tpu.utils.workloads import spherical_cutoff_triplets
+
+
+def sync(a):
+    return float(np.asarray(jax.numpy.real(a).ravel()[0]))
+
+
+def measure(plan, vil, reps=20):
+    def grp(g):
+        t0 = time.perf_counter()
+        o = None
+        for _ in range(g):
+            o = plan.apply_pointwise(vil)
+        sync(o)
+        return time.perf_counter() - t0
+    return diff_estimate_seconds(grp, reps=reps)
+
+
+def main():
+    n = int(os.environ.get("DIM", "256"))
+    print(f"devices: {jax.devices()}", flush=True)
+    triplets = spherical_cutoff_triplets(n)
+    rng = np.random.default_rng(42)
+    N = len(triplets)
+    values = (rng.uniform(-1, 1, N)
+              + 1j * rng.uniform(-1, 1, N)).astype(np.complex64)
+
+    plan_rows = make_local_plan(TransformType.C2C, n, n, n, triplets,
+                                precision="single")
+    saved = plan_mod.PAIR_IO_THRESHOLD
+    plan_mod.PAIR_IO_THRESHOLD = 1
+    try:
+        plan_pair = make_local_plan(TransformType.C2C, n, n, n, triplets,
+                                    precision="single")
+    finally:
+        plan_mod.PAIR_IO_THRESHOLD = saved
+    assert not plan_rows.pair_values_io and plan_pair.pair_values_io
+
+    vil_rows = jax.device_put(plan_rows._coerce_values(values))
+    vil_pair = jax.device_put(plan_pair._coerce_values(values))
+
+    # correctness cross-check before timing
+    out_rows = np.asarray(plan_rows.apply_pointwise(vil_rows))
+    out_pair = np.asarray(plan_pair.apply_pointwise(vil_pair)).T
+    rel = (np.linalg.norm(out_rows - out_pair)
+           / np.linalg.norm(out_rows))
+    print(f"rows-vs-pair output rel diff: {rel:.2e}", flush=True)
+
+    # warm both executables, then alternate measurement blocks
+    sync(plan_pair.apply_pointwise(vil_pair))
+    sync(plan_rows.apply_pointwise(vil_rows))
+    for it in range(3):
+        er = measure(plan_rows, vil_rows)
+        ep = measure(plan_pair, vil_pair)
+        print(f"block {it}: rows {er.seconds*1e3:.3f} ms "
+              f"(med {er.median*1e3:.3f})   pair {ep.seconds*1e3:.3f} ms "
+              f"(med {ep.median*1e3:.3f})", flush=True)
+
+
+if __name__ == "__main__":
+    main()

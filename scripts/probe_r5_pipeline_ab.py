@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Round-5 probe: fused identity pair, matmul-DFT pipeline vs the
+jnp.fft pipeline, same session, alternating diff-estimator blocks.
+
+profile_stages.py's stage-sum for the jnp.fft pipeline (7.7 ms) came in
+UNDER the mdft fused pair (11.6 ms) at 256^3 — but scanned stage bodies
+overlap differently than a fused dispatch, so this measures the real
+thing: two plans, two fused executables, one session.
+
+Usage: DIM=256 python scripts/probe_r5_pipeline_ab.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+
+from spfft_tpu import TransformType, make_local_plan
+from spfft_tpu.utils.benchtime import diff_estimate_seconds
+from spfft_tpu.utils.workloads import spherical_cutoff_triplets
+
+
+def sync(a):
+    return float(np.asarray(jax.numpy.real(a).ravel()[0]))
+
+
+def measure(plan, vil, reps=20):
+    def grp(g):
+        t0 = time.perf_counter()
+        o = None
+        for _ in range(g):
+            o = plan.apply_pointwise(vil)
+        sync(o)
+        return time.perf_counter() - t0
+    return diff_estimate_seconds(grp, reps=reps)
+
+
+def main():
+    n = int(os.environ.get("DIM", "256"))
+    print(f"devices: {jax.devices()}", flush=True)
+    triplets = spherical_cutoff_triplets(n)
+    rng = np.random.default_rng(42)
+    N = len(triplets)
+    values = (rng.uniform(-1, 1, N)
+              + 1j * rng.uniform(-1, 1, N)).astype(np.complex64)
+
+    plan_mdft = make_local_plan(TransformType.C2C, n, n, n, triplets,
+                                precision="single")
+    os.environ["SPFFT_TPU_NO_MATMUL_DFT"] = "1"
+    try:
+        plan_fft = make_local_plan(TransformType.C2C, n, n, n, triplets,
+                                   precision="single")
+    finally:
+        del os.environ["SPFFT_TPU_NO_MATMUL_DFT"]
+    assert plan_mdft._use_mdft and not plan_fft._use_mdft
+
+    vil = jax.device_put(plan_mdft._coerce_values(values))
+
+    out_a = np.asarray(plan_mdft.apply_pointwise(vil))
+    out_b = np.asarray(plan_fft.apply_pointwise(vil))
+    rel = np.linalg.norm(out_a - out_b) / np.linalg.norm(out_a)
+    print(f"mdft-vs-fft output rel diff: {rel:.2e}", flush=True)
+
+    sync(plan_fft.apply_pointwise(vil))
+    sync(plan_mdft.apply_pointwise(vil))
+    for it in range(3):
+        ea = measure(plan_mdft, vil)
+        eb = measure(plan_fft, vil)
+        print(f"block {it}: mdft {ea.seconds*1e3:.3f} ms "
+              f"(med {ea.median*1e3:.3f})   fft {eb.seconds*1e3:.3f} ms "
+              f"(med {eb.median*1e3:.3f})", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Round-5 probe: PREFIX timing of the fused mdft pair at 256^3.
+
+probe_r5_mdft_stages.py's per-carrier calibration went negative on
+grid-sized carriers (the identity scan pays layout copies the real body
+doesn't), so stage costs come instead from differences of scanned
+PREFIXES of the actual fused pipeline — every prefix runs on the same
+values carrier, so the scan/perturb/consume constant cancels in the
+difference and each stage is measured in its fused context.
+
+Usage: DIM=256 python scripts/probe_r5_prefix.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from spfft_tpu import TransformType, make_local_plan
+from spfft_tpu.ops import dft, stages
+from spfft_tpu.utils.workloads import spherical_cutoff_triplets
+
+R = int(os.environ.get("REPS", 20))
+
+
+def sync(out):
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    float(np.asarray(jax.numpy.real(leaf).ravel()[0]))
+
+
+def _consume(y):
+    leaves = jax.tree_util.tree_leaves(y)
+    return sum(jnp.mean(jnp.real(x)) for x in leaves)
+
+
+def _scan_seconds(body, x, reps=4):
+    def run(x0):
+        def step(c, _):
+            xp = c * c.dtype.type(1.0 + 1e-7)
+            return xp, _consume(body(xp))
+        _, ys = jax.lax.scan(step, x0, None, length=R)
+        return ys
+    f = jax.jit(run)
+    out = f(x)
+    sync(out)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = f(x)
+        sync(out)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    n = int(os.environ.get("DIM", "256"))
+    print(f"devices: {jax.devices()}", flush=True)
+    triplets = spherical_cutoff_triplets(n)
+    plan = make_local_plan(TransformType.C2C, n, n, n, triplets,
+                           precision="single")
+    p = plan.index_plan
+    tables = plan._tables
+    rng = np.random.default_rng(0)
+    N = p.num_values
+    values = (rng.uniform(-1, 1, N)
+              + 1j * rng.uniform(-1, 1, N)).astype(np.complex64)
+    vil = jax.device_put(plan._coerce_values(values))
+
+    S, Z = plan._s_pad, p.dim_z
+    xf = p.dim_x_freq
+    col_tab = tables["col_inv_t"]
+    cols_tab = tables["scatter_cols_t"]
+    unpack = stages.sticks_to_grid_padded if S > p.num_sticks \
+        else stages.sticks_to_grid
+    zb = dft.c2c_mats(Z, dft.BACKWARD)
+    yb = dft.c2c_mats(p.dim_y, dft.BACKWARD)
+    xb = dft.c2c_mats(p.dim_x, dft.BACKWARD)
+    xf_m = dft.c2c_mats(p.dim_x, dft.FORWARD)
+    yf = dft.c2c_mats(p.dim_y, dft.FORWARD)
+    zf = dft.c2c_mats(Z, dft.FORWARD)
+
+    def s_dec(v):
+        return plan._decompress_planar(v, tables)
+
+    def s_z(st):
+        return dft.pdft_last(st[0], st[1], zb)
+
+    def s_unpack(st):
+        return (unpack(st[0], col_tab, xf, p.dim_y),
+                unpack(st[1], col_tab, xf, p.dim_y))
+
+    def s_y(g):
+        return dft.pdft_last(g[0], g[1], yb)
+
+    def s_swap(g):
+        return (jnp.swapaxes(g[0], -1, -2), jnp.swapaxes(g[1], -1, -2))
+
+    def s_x(g):
+        return dft.pdft_last(g[0], g[1], xb)
+
+    def f_x(g):
+        return dft.pdft_last(g[0], g[1], xf_m)
+
+    def f_swap(g):
+        return (jnp.swapaxes(g[0], -1, -2), jnp.swapaxes(g[1], -1, -2))
+
+    def f_y(g):
+        return dft.pdft_last(g[0], g[1], yf)
+
+    def f_pack(g):
+        return (stages.grid_to_sticks(g[0], cols_tab),
+                stages.grid_to_sticks(g[1], cols_tab))
+
+    def f_z(st):
+        return dft.pdft_last(st[0], st[1], zf)
+
+    def f_cmp(st):
+        return plan._compress_planar(st[0], st[1], tables)
+
+    chain = [("decompress", s_dec), ("z bwd", s_z), ("unpack", s_unpack),
+             ("y bwd", s_y), ("swap", s_swap), ("x bwd", s_x),
+             ("x fwd", f_x), ("swap2", f_swap), ("y fwd", f_y),
+             ("pack", f_pack), ("z fwd", f_z), ("compress", f_cmp)]
+
+    base = _scan_seconds(lambda v: v, vil)
+    print(f"{'(identity)':18s} {base/R*1e3:8.3f} ms/step", flush=True)
+    prev = base
+    for k in range(1, len(chain) + 1):
+        def body(v, _k=k):
+            out = v
+            for _, fn in chain[:_k]:
+                out = fn(out)
+            return out
+        t = _scan_seconds(body, vil)
+        name = chain[k - 1][0]
+        print(f"+{name:17s} {t/R*1e3:8.3f} ms/step  (Δ {(t-prev)/R*1e3:+7.3f})",
+              flush=True)
+        prev = t
+
+
+if __name__ == "__main__":
+    main()
