@@ -17,17 +17,19 @@ baseline_seconds / tpu_seconds (>1 means faster than baseline).
 Prints exactly one JSON line at the end:
   {"metric": ..., "value": ..., "unit": "s", "vs_baseline": ...}
 
-Session handling: the tunnel-attached device is BIMODAL per process —
-identical code measures either ~9.5 ms or ~12.5 ms at 256^3 (ratio
-~1.3x, stable for the process lifetime; 12 interleaved A/B samples of
-one revision spanned both modes while in-process diff-estimator spread
-stayed ~1-2%). The measurement therefore runs in SPFFT_BENCH_SESSIONS
-(default 4) fresh backend sessions and reports the best — disclosed in
-the metric string together with every session's value. Any optimisation
-decision needs interleaved multi-process sampling: two same-session
-probes this round (a transpose-free pipeline variant and
-constant-embedded tables) each looked 1.5-2.5 ms faster in single-session
-A/B and turned out SLOWER under interleaved sampling.
+Session handling: round 5 resolved the round-4 "bimodal device" as
+bimodal SYNC-READBACK cost (~88 vs ~128 ms per hard sync, constant per
+group regardless of group size — scripts/probe_r5_mode.py), not bimodal
+compute. The old min-of-single-diffs statistic fabricated 8.6-9.5 ms
+readings whenever the two group sizes caught mismatched sync modes; the
+estimator now differences MEDIANS of several samples per group size
+(utils/benchtime.py), which is immune to the mismatch. The measurement
+still runs in SPFFT_BENCH_SESSIONS (default 4) fresh backend sessions
+(compile/backend variance) and reports the best session — disclosed in
+the metric string together with every session's value. Optimisation
+decisions still require interleaved multi-process A/B
+(scripts/ab_interleaved.py): two round-4 same-session "wins" reverted
+under interleaving.
 
 Env knobs: SPFFT_BENCH_DIM (default 256), SPFFT_BENCH_REPS (default 30),
 SPFFT_BENCH_SESSIONS (default 4, set 1 to disable re-rolling),
@@ -168,15 +170,13 @@ def main() -> None:
     sync(out)
 
     # Variance-robust statistic: the hard-sync readback through the axon
-    # tunnel costs 80-120 ms regardless of queue depth (measured on a
+    # tunnel costs ~85-130 ms regardless of queue depth (measured on a
     # ready array), so any "time N reps then sync" number includes
-    # sync_cost/N of pure tunnel latency — the round-1/2 benches amortised
-    # ~3-4 ms/rep of it at reps=30, and its variance is why the headline
-    # moved 10% between rounds. The difference-of-group-sizes estimator
-    # cancels the constant exactly: pair = (T(g2) - T(g1)) / (g2 - g1),
-    # both groups pipelined and each ending in one sync. Reported value =
-    # min over trials (the best sustained rate the hardware delivered);
-    # observed trial spread at 256^3 is < 1.5% vs ~25% for group means.
+    # sync_cost/N of pure tunnel latency. The difference-of-group-sizes
+    # estimator cancels the constant: pair = (medT(g2) - medT(g1)) /
+    # (g2 - g1), medians over several samples per size so the bimodal
+    # sync cost (see module docstring) cancels at the majority mode
+    # instead of fabricating fast readings at mismatched pairings.
     from spfft_tpu.utils.benchtime import diff_estimate_seconds
 
     def timed(g):

@@ -1,12 +1,14 @@
 #!/usr/bin/env python
 """Interleaved multi-process A/B for pair-time experiments.
 
-The tunnel-attached device is BIMODAL per process (~1.3x between modes,
-state fixed for the process lifetime — BENCHMARKS.md 'Session
-discipline'), so a single-session A/B can report a 2 ms 'win' that is
-pure device state: two round-4 optimisations were committed on
-single-session evidence and reverted under this harness. This script is
-the required protocol for ANY tuning decision:
+Round 5 resolved the round-4 "bimodal device" as bimodal SYNC cost
+(~88 vs ~128 ms per readback — scripts/probe_r5_mode.py), now cancelled
+inside the estimator itself (utils/benchtime.py median differencing).
+Per-session compile/backend variance remains, so a single-session A/B
+can still report a 'win' that is session state: two round-4
+optimisations were committed on single-session evidence and reverted
+under this harness. This script stays the required protocol for ANY
+tuning decision:
 
   python scripts/ab_interleaved.py /root/repo /path/to/other [--rounds 4]
 

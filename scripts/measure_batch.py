@@ -38,7 +38,7 @@ def bench(fn, reps=10):
 
     sec, _, fallback = diff_estimate_seconds(timed, reps=reps, trials=3)
     if fallback:
-        print("  (diff estimator below noise — pipelined mean reported)",
+        print("  (diff estimator below noise — pipelined median reported)",
               flush=True)
     return sec * 1e3
 

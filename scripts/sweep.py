@@ -45,7 +45,7 @@ def timeit(fn):
 
     sec, _, fallback = diff_estimate_seconds(timed, reps=REPS, trials=3)
     if fallback:
-        print("  (diff estimator below noise — pipelined mean reported)",
+        print("  (diff estimator below noise — pipelined median reported)",
               flush=True)
     return sec
 

@@ -1,23 +1,38 @@
 """The sync-cancelling wall-clock estimator shared by every benchmark.
 
-The hard-sync readback through a remote-attached device costs 80-120 ms
-regardless of queue depth (measured on the axon tunnel — bench.py), so any
-"time N pipelined calls then sync once" number includes sync_cost/N of
-pure transport latency, and its variance is what moved the round-1/2
-headline numbers 10% between sessions. The difference of two group sizes
-cancels the constant exactly:
+The hard-sync readback through a remote-attached device costs ~85-130 ms
+regardless of queue depth (measured on the axon tunnel — bench.py,
+scripts/probe_r5_mode.py), so any "time N pipelined calls then sync once"
+number includes sync_cost/N of pure transport latency. The difference of
+two group sizes cancels the constant:
 
     per_call = (T(g2) - T(g1)) / (g2 - g1)
 
-with each T(g) = g pipelined calls ending in ONE hard sync. Used by
-bench.py, scripts/sweep.py and scripts/measure_batch.py so every number
-recorded in BENCHMARKS.md comes from the same estimator.
+with each T(g) = g pipelined calls ending in ONE hard sync.
+
+ROBUSTNESS (round 5): the sync cost is itself BIMODAL (~88 vs ~128 ms,
+constant per group regardless of group size — probe_r5_mode.py measured
+13.3 ms/pair of apparent contrast at g=3 vs 4.1 ms/pair at g=10, i.e. a
+fixed ~40 ms/group term). A min-of-single-diffs statistic therefore
+fabricates fast readings whenever T(g1) catches a slow sync and T(g2) a
+fast one (−40 ms / (g2−g1) ≈ −3 ms/call at the bench sizes): this is
+exactly the round-4 "device fast mode" (8.6–9.5 ms sightings at a true
+~12.5 ms pair). The estimator now samples each group size ``trials``
+times and differences the MEDIANS, which both sit on the majority sync
+mode, so the constant cancels without mismatched pairings. ``minimum``
+(min over per-trial diffs, the old statistic) is kept for comparison
+with older recorded numbers; it is downward-biased and must not be used
+for decisions or headlines.
+
+Used by bench.py, scripts/sweep.py and scripts/measure_batch.py so every
+number recorded in BENCHMARKS.md comes from the same estimator.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import math
+import statistics
 from typing import Callable
 
 
@@ -25,17 +40,19 @@ from typing import Callable
 class DiffEstimate:
     """Result of :func:`diff_estimate_seconds`. ``label`` describes the
     methodology that ACTUALLY produced ``seconds`` (so benchmark logs
-    cannot silently diverge from the estimator). ``seconds`` is the min
-    over trials (downward-biased best case — fine for "best sustained
-    rate" headlines); ``median`` is the robust companion statistic for
-    threshold tuning, where the min's optimism would shift crossovers
-    (round-3 advisor finding)."""
+    cannot silently diverge from the estimator). ``seconds`` is the
+    sync-robust median-difference statistic; ``median`` aliases it for
+    callers that were already using the robust companion for threshold
+    tuning (round-3 advisor finding). ``minimum`` is the legacy
+    min-of-single-diffs value — downward-biased by sync-mode mismatch
+    (see module docstring), reported only for continuity."""
 
     seconds: float
     spread: float
     fallback: bool
     label: str
     median: float = math.nan
+    minimum: float = math.nan
 
     def __iter__(self):  # (seconds, spread, fallback) unpacking
         return iter((self.seconds, self.spread, self.fallback))
@@ -51,31 +68,47 @@ def diff_estimate_seconds(run_group: Callable[[int], float],
         hard sync, and returns the wall seconds for the whole group.
       reps: sizing knob — group sizes are ``g1 = max(1, reps // 6)`` and
         ``g2 = max(g1 + 1, reps - g1)``.
-      trials: difference trials; the minimum positive difference is
-        reported (the best sustained rate the hardware delivered).
+      trials: samples per group size; the estimate is
+        ``(median T(g2) - median T(g1)) / (g2 - g1)``.
 
     Returns:
       A :class:`DiffEstimate` (iterates as ``(seconds, spread,
-      fallback)``). When every difference is non-positive (the per-call
-      time is below the sync-cost noise — tiny workloads), falls back to
-      the plain pipelined mean of one g2 group, which re-includes
-      sync_cost/g2; ``fallback`` is True and ``label`` says so.
+      fallback)``). When the median difference is non-positive (the
+      per-call time is below the sync-cost noise — tiny workloads),
+      falls back to the plain pipelined mean of one g2 group, which
+      re-includes sync_cost/g2; ``fallback`` is True and ``label`` says
+      so.
     """
     g1 = max(1, reps // 6)
     g2 = max(g1 + 1, reps - g1)
-    diffs = [(run_group(g2) - run_group(g1)) / (g2 - g1)
-             for _ in range(trials)]
+    # alternate sizes so slow drift (if any) hits both groups equally
+    t1s, t2s = [], []
+    for _ in range(trials):
+        t2s.append(run_group(g2))
+        t1s.append(run_group(g1))
+    # median_high, not median: with an even sample count a plain median
+    # AVERAGES the two middle samples — a 2-2 fast/slow sync split would
+    # put one group's median between the modes while the other sits on a
+    # mode, re-introducing the mismatch bias. median_high is always a
+    # real sample and lands on the majority (slow) mode whenever at
+    # least half the samples do, so both group medians cancel exactly.
+    med = (statistics.median_high(t2s)
+           - statistics.median_high(t1s)) / (g2 - g1)
+    diffs = [(t2 - t1) / (g2 - g1) for t1, t2 in zip(t1s, t2s)]
     positive = [d for d in diffs if d > 0]
-    if positive:
-        best = min(positive)
-        spread = (max(positive) - best) / best
-        med = sorted(positive)[len(positive) // 2]
+    minimum = min(positive) if positive else math.nan
+    if med > 0:
+        spread = ((max(positive) - min(positive)) / med
+                  if len(positive) > 1 else 0.0)
         return DiffEstimate(
-            best, spread, False,
-            f"min of sync-cancelling trials ((T({g2})-T({g1}))/{g2 - g1}, "
-            f"trial spread +{spread * 100:.1f}%, median "
-            f"{med * 1e3:.3g} ms)", med)
-    t = run_group(g2) / g2
+            med, spread, False,
+            f"sync-robust median estimator ((medT({g2})-medT({g1}))/"
+            f"{g2 - g1}, {trials} samples/size, per-trial spread "
+            f"{spread * 100:.1f}%)", med, minimum)
+    # below the sync noise floor: the per-call time is smaller than the
+    # sync jitter. Reuse the samples already collected (no fresh group —
+    # it would cost another ~100 ms sync for ONE unreplicated sample).
+    t = statistics.median_high(t2s) / g2
     return DiffEstimate(t, math.nan, True,
-                        f"pipelined mean of {g2} "
-                        f"(diff estimator below noise)", t)
+                        f"pipelined median of {trials}x{g2} "
+                        f"(diff estimator below noise)", t, minimum)
