@@ -42,6 +42,11 @@ def _cdft_mid(x, mats):
     return jnp.swapaxes(y, -1, -2)
 
 
+#: xy-stage gate — the shared routing predicate lives in ops.dft so the
+#: plan pipeline and these per-stage gates cannot drift
+_mdft_axes = dft.mdft_axes
+
+
 # ---------------------------------------------------------------------------
 # Compression: sparse values <-> packed z-stick array
 # ---------------------------------------------------------------------------
@@ -245,7 +250,7 @@ def xy_backward_c2c(grid):
     """
     dim_y, dim_x = grid.shape[-2], grid.shape[-1]
     scale = grid.real.dtype.type(dim_y * dim_x)
-    if dft.use_matmul_dft(max(dim_y, dim_x), grid.dtype):
+    if _mdft_axes(grid.dtype, dim_y, dim_x):
         grid = dft.cdft_last(grid, dft.c2c_mats(dim_x, dft.BACKWARD))
         return _cdft_mid(grid, dft.c2c_mats(dim_y, dft.BACKWARD))
     return jnp.fft.ifft2(_mat(grid), axes=(-2, -1)) * scale
@@ -254,7 +259,7 @@ def xy_backward_c2c(grid):
 def xy_forward_c2c(grid):
     """Forward DFT over (y, x) per plane."""
     dim_y, dim_x = grid.shape[-2], grid.shape[-1]
-    if dft.use_matmul_dft(max(dim_y, dim_x), grid.dtype):
+    if _mdft_axes(grid.dtype, dim_y, dim_x):
         grid = dft.cdft_last(grid, dft.c2c_mats(dim_x, dft.FORWARD))
         return _cdft_mid(grid, dft.c2c_mats(dim_y, dft.FORWARD))
     return jnp.fft.fft2(_mat(grid), axes=(-2, -1))
@@ -298,7 +303,7 @@ def xy_backward_c2c_split(sub, x0: int, dim_x: int):
     DFT matrix directly — a wrapped window is just a non-contiguous row
     selection, no roll/pad stage."""
     dim_y, w = sub.shape[-2], sub.shape[-1]
-    if dft.use_matmul_dft(max(dim_y, dim_x), sub.dtype):
+    if _mdft_axes(sub.dtype, dim_y, dim_x, direct=(dim_x,)):
         sub = _cdft_mid(sub, dft.c2c_mats(dim_y, dft.BACKWARD))
         rows = tuple(int(r) for r in (x0 + np.arange(w)) % dim_x)
         return dft.cdft_last(
@@ -313,7 +318,7 @@ def xy_forward_c2c_split(space, x0: int, w: int):
     the y-DFT only on the occupied x columns ``[x0, x0+w) mod dim_x`` —
     the only columns the stick gather reads. Returns (planes, dim_y, w)."""
     dim_y, dim_x = space.shape[-2], space.shape[-1]
-    if dft.use_matmul_dft(max(dim_y, dim_x), space.dtype):
+    if _mdft_axes(space.dtype, dim_y, dim_x, direct=(dim_x,)):
         cols = tuple(int(c) for c in (x0 + np.arange(w)) % dim_x)
         grid = dft.cdft_last(
             space, dft.sub_cols_mats(dim_x, dft.FORWARD, cols))
@@ -345,7 +350,7 @@ def xy_backward_r2c_split(sub, x0: int, dim_x: int, dim_x_freq: int):
     (planes, dim_y, dim_x). Reference: the per-selected-row vertical plan,
     transform_1d_host.hpp:137-196."""
     dim_y, w = sub.shape[-2], sub.shape[-1]
-    if dft.use_matmul_dft(max(dim_y, dim_x), sub.dtype):
+    if _mdft_axes(sub.dtype, dim_y, dim_x, direct=(dim_x,)):
         sub = _cdft_mid(sub, dft.c2c_mats(dim_y, dft.BACKWARD))
         rows = tuple(range(x0, x0 + w))
         return dft.pirdft_last(jnp.real(sub), jnp.imag(sub),
@@ -361,7 +366,7 @@ def xy_forward_r2c_split(space, x0: int, w: int):
     then the y-DFT only on the occupied half-spectrum columns. ``space``
     is real (planes, dim_y, dim_x); returns (planes, dim_y, w) complex."""
     dim_y, dim_x = space.shape[-2], space.shape[-1]
-    if dft.use_matmul_dft(max(dim_y, dim_x), space.dtype):
+    if _mdft_axes(space.dtype, dim_y, dim_x, direct=(dim_x,)):
         cols = tuple(range(x0, x0 + w))
         yr, yi = dft.prdft_last(space,
                                 dft.sub_cols_r2c_mats(dim_x, cols))
@@ -381,7 +386,7 @@ def xy_backward_r2c(grid, dim_x: int):
     rank-3 irfft corruption by construction.
     """
     dim_y = grid.shape[-2]
-    if dft.use_matmul_dft(max(dim_y, dim_x), grid.dtype):
+    if _mdft_axes(grid.dtype, dim_y, dim_x, direct=(dim_x,)):
         grid = _cdft_mid(grid, dft.c2c_mats(dim_y, dft.BACKWARD))
         return dft.pirdft_last(jnp.real(grid), jnp.imag(grid),
                                dft.c2r_mats(dim_x))
@@ -397,7 +402,7 @@ def xy_forward_r2c(space):
     (planes, dim_y, dim_x//2+1) complex.
     """
     dim_y, dim_x = space.shape[-2], space.shape[-1]
-    if dft.use_matmul_dft(max(dim_y, dim_x), space.dtype):
+    if _mdft_axes(space.dtype, dim_y, dim_x, direct=(dim_x,)):
         yr, yi = dft.prdft_last(space, dft.r2c_mats(dim_x))
         return _cdft_mid(yr + 1j * yi, dft.c2c_mats(dim_y, dft.FORWARD))
     grid = jnp.fft.rfft(_mat(space), axis=-1)

@@ -52,7 +52,8 @@ from .utils.dtypes import (as_interleaved, complex_dtype,
                            real_dtype)
 
 
-def predicted_rel_error(precision: str, max_dim: int) -> float:
+def predicted_rel_error(precision: str, max_dim: int,
+                        mdft_covered: Optional[bool] = None) -> float:
     """Conservative predicted relative l2 error of a backward transform vs
     a dense f64 oracle, for uniform-magnitude (O(1) dynamic range) value
     sets.
@@ -69,10 +70,26 @@ def predicted_rel_error(precision: str, max_dim: int) -> float:
     bounded dynamic range, test_check_values.hpp:46-50); measured 1e±6
     dynamic range stayed at 1.9e-7 relative l2
     (docs/precision.md 'Adversarial rows').
+
+    Calibrated domain: the matmul-DFT forms (direct or two-stage,
+    single precision) and the CPU f64 path. Plans the matmul pipeline
+    cannot cover (a prime axis above the cap, an R2C x-axis above the
+    direct cap) execute through XLA's ``jnp.fft`` lowering, where the
+    envelope is extrapolation — an extra 4x safety factor applies so
+    the contract fails loudly rather than promising uncalibrated
+    accuracy (round-4 advisor finding). ``mdft_covered`` is the
+    STRUCTURAL routing answer (ops.dft.mdft_coverable) from the caller;
+    ``None`` infers it from ``max_dim`` alone (single-axis query).
     """
+    from .ops.dft import mdft_coverable
+    if mdft_covered is None:
+        mdft_covered = mdft_coverable((max_dim,))
     shape = (max(max_dim, 1) / 64.0) ** 0.13
     if precision == "single":
-        return 2.8e-7 * shape
+        base = 2.8e-7 * shape
+        if not mdft_covered:
+            base *= 4.0  # uncalibrated jnp.fft path
+        return base
     return 5.0e-15 * shape  # f64 eps * same shape, ~10x headroom
 
 
@@ -91,9 +108,12 @@ class TransformPlan:
         from .utils.platform import enable_persistent_compilation_cache
         enable_persistent_compilation_cache()
         if max_rel_error is not None:
+            from .ops.dft import mdft_coverable
             predicted = predicted_rel_error(
                 precision, max(index_plan.dim_x, index_plan.dim_y,
-                               index_plan.dim_z))
+                               index_plan.dim_z),
+                mdft_coverable((index_plan.dim_x, index_plan.dim_y,
+                                index_plan.dim_z), index_plan.hermitian))
             if predicted > max_rel_error:
                 from .errors import PrecisionContractError
                 raise PrecisionContractError(
@@ -127,9 +147,13 @@ class TransformPlan:
         #: transposed (planes, x, y) through the y-stage, and the round
         #: trip pays ONE transpose pair instead of XLA fft2's four
         #: internal layout copies (ops/dft.py; scripts/probe_r4_dft2.py).
-        self._use_mdft = _dft.use_matmul_dft(
-            max(index_plan.dim_x, index_plan.dim_y, index_plan.dim_z),
-            self._cdt)
+        # The shared routing predicate (ops.dft.mdft_axes): every axis
+        # direct or two-stage; the R2C x-axis needs the direct form
+        # (half-spectrum matrices don't factor through the split).
+        self._use_mdft = _dft.mdft_axes(
+            self._cdt, index_plan.dim_x, index_plan.dim_y,
+            index_plan.dim_z,
+            direct=(index_plan.dim_x,) if index_plan.hermitian else ())
         if self._pair_io:
             # Layout flip is observable by callers (forward/apply_pointwise
             # return (2, N) instead of (N, 2)); say so once at plan build.
@@ -299,19 +323,23 @@ class TransformPlan:
 
     def _finalize(self) -> None:
         """Join the background table build (no-op afterwards) and commit
-        whatever fallback tables the outcome requires."""
+        whatever fallback tables the outcome requires. A build failure is
+        STICKY: every subsequent execution call re-raises the original
+        error (a one-shot raise would leave later calls with neither
+        pallas nor fallback tables committed and fail with a confusing
+        KeyError inside the jitted pipeline — round-4 advisor finding)."""
         th = self._build_thread
-        if th is None:
-            return
-        th.join()
-        self._build_thread = None
+        if th is not None:
+            th.join()
+            self._build_thread = None
+            if self._build_exc is None:
+                box = self._pallas_box
+                if box is None or box["dec"] is None:
+                    self._commit_fallback("dec")
+                if box is None or box["cmp"] is None:
+                    self._commit_fallback("cmp")
         if self._build_exc is not None:
             raise self._build_exc
-        box = self._pallas_box
-        if box is None or box["dec"] is None:
-            self._commit_fallback("dec")
-        if box is None or box["cmp"] is None:
-            self._commit_fallback("cmp")
 
     @property
     def _pallas(self):
@@ -377,6 +405,11 @@ class TransformPlan:
         p = self.index_plan
         self._split_x = None
         if p.num_sticks == 0:
+            return
+        from .ops.dft import MATMUL_DFT_MAX
+        if self._use_mdft and p.dim_x > MATMUL_DFT_MAX:
+            # the split-x contraction needs row/column-selected DIRECT
+            # matrices; a two-stage x-axis runs dense instead
             return
         xf = p.dim_x_freq
         xs = p.scatter_cols % xf

@@ -1,0 +1,152 @@
+"""Two-stage Cooley-Tukey matmul-DFT (ops/dft.py TwoStageMats) vs numpy.
+
+Covers the round-4 verdict item "fast path above 512-point axes": axes
+above MATMUL_DFT_MAX factor as N = N1*N2 (both <= the cap) and run as
+two dots plus a twiddle, replacing the conv-lowered jnp.fft fallback.
+Reference bar: arbitrary-N FFTW plans
+(reference: src/fft/fftw_plan_1d.hpp:74-94).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import spfft_tpu.plan as plan_mod
+from spfft_tpu import TransformType, make_local_plan
+from spfft_tpu.ops import dft
+
+LONG = [768, 1024, 600, 540, 1000]
+
+
+def _rand(shape, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal(shape) + 1j
+            * rng.standard_normal(shape)).astype(np.complex64)
+
+
+@pytest.mark.parametrize("n", LONG)
+def test_factorization(n):
+    n1, n2 = dft.two_stage_factor(n)
+    assert n1 * n2 == n
+    assert n1 <= dft.MATMUL_DFT_MAX and n2 <= dft.MATMUL_DFT_MAX
+    # balanced: no better pair exists (n1 is the largest divisor <= sqrt)
+    for cand in range(n1 + 1, int(np.sqrt(n)) + 1):
+        assert n % cand != 0
+
+
+def test_factor_gates():
+    assert dft.two_stage_factor(256) is None      # direct form
+    assert dft.two_stage_factor(521) is None      # prime above the cap
+    assert dft.two_stage_factor(2 * 521) is None  # no pair <= cap
+    assert not dft.use_matmul_dft(521, jnp.complex64)
+    assert dft.matmul_dft_limit() == dft.MATMUL_DFT_MAX ** 2
+
+
+@pytest.mark.parametrize("n", LONG)
+def test_forward_c2c_long(n):
+    x = _rand((5, n))
+    got = np.asarray(dft.cdft_last(jnp.asarray(x),
+                                   dft.c2c_mats(n, dft.FORWARD)))
+    ref = np.fft.fft(x, axis=-1)
+    rel = np.linalg.norm(got - ref) / np.linalg.norm(ref)
+    assert rel < 5e-7, rel
+
+
+@pytest.mark.parametrize("n", LONG)
+def test_backward_unnormalised_long(n):
+    x = _rand((4, n), seed=1)
+    got = np.asarray(dft.cdft_last(jnp.asarray(x),
+                                   dft.c2c_mats(n, dft.BACKWARD)))
+    ref = np.fft.ifft(x, axis=-1) * n
+    rel = np.linalg.norm(got - ref) / np.linalg.norm(ref)
+    assert rel < 5e-7, rel
+
+
+def test_scale_folds_into_stage_two():
+    n = 768
+    x = _rand((3, n), seed=2)
+    got = np.asarray(dft.cdft_last(
+        jnp.asarray(x), dft.c2c_mats(n, dft.FORWARD, scale=1.0 / n)))
+    ref = np.fft.fft(x, axis=-1) / n
+    rel = np.linalg.norm(got - ref) / np.linalg.norm(ref)
+    assert rel < 5e-7, rel
+
+
+def test_planar_matches_complex_long():
+    n = 600
+    x = _rand((4, n), seed=3)
+    mats = dft.c2c_mats(n, dft.FORWARD)
+    yr, yi = dft.pdft_last(jnp.asarray(x.real.copy()),
+                           jnp.asarray(x.imag.copy()), mats)
+    ref = np.asarray(dft.cdft_last(jnp.asarray(x), mats))
+    np.testing.assert_allclose(np.asarray(yr) + 1j * np.asarray(yi), ref,
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_batched_leading_dims():
+    n = 768
+    x = _rand((2, 3, n), seed=4)
+    got = np.asarray(dft.cdft_last(jnp.asarray(x),
+                                   dft.c2c_mats(n, dft.FORWARD)))
+    ref = np.fft.fft(x, axis=-1)
+    rel = np.linalg.norm(got - ref) / np.linalg.norm(ref)
+    assert rel < 5e-7, rel
+
+
+@pytest.fixture
+def tiny_cap(monkeypatch):
+    """Shrink the direct-form cap so a small full-pipeline plan runs the
+    two-stage path (a real 768^3 dense oracle is not CPU-tractable in
+    CI); caches keyed on lengths near the old cap are cleared."""
+    monkeypatch.setenv("SPFFT_TPU_FORCE_MATMUL_DFT", "1")
+    monkeypatch.setattr(dft, "MATMUL_DFT_MAX", 8)
+    dft.two_stage_factor.cache_clear()
+    dft._two_stage_mats.cache_clear()
+    yield
+    dft.two_stage_factor.cache_clear()
+    dft._two_stage_mats.cache_clear()
+
+
+def test_full_pipeline_two_stage_c2c(tiny_cap):
+    """End-to-end C2C plan whose every axis (12 = 3*4) exceeds the
+    shrunk direct cap: backward vs the dense oracle, then the fwd(bwd)
+    round trip."""
+    n = 12
+    rng = np.random.default_rng(7)
+    tr = np.stack(np.meshgrid(np.arange(n), np.arange(n), np.arange(n),
+                              indexing="ij"), axis=-1).reshape(-1, 3)
+    keep = rng.uniform(size=len(tr)) < 0.4
+    tr = tr[keep]
+    plan = make_local_plan(TransformType.C2C, n, n, n, tr,
+                           precision="single")
+    assert plan._use_mdft and plan._split_x is None
+    vals = (rng.standard_normal(len(tr))
+            + 1j * rng.standard_normal(len(tr))).astype(np.complex64)
+    space = np.asarray(plan.backward(vals))
+    got = space[..., 0] + 1j * space[..., 1]
+    cube = np.zeros((n, n, n), np.complex64)
+    cube[tr[:, 2], tr[:, 1], tr[:, 0]] = vals
+    oracle = np.fft.ifftn(cube) * cube.size
+    rel = np.linalg.norm(got - oracle) / np.linalg.norm(oracle)
+    assert rel < 1e-5, rel
+    from spfft_tpu.types import Scaling
+    out = np.asarray(plan.forward(space, scaling=Scaling.FULL))
+    got_v = out[:, 0] + 1j * out[:, 1]
+    rel = np.linalg.norm(got_v - vals) / np.linalg.norm(vals)
+    assert rel < 1e-5, rel
+
+
+def test_r2c_long_x_stays_off_mdft(tiny_cap):
+    """An R2C plan whose x-axis exceeds the direct cap must not claim
+    the matmul pipeline (half-spectrum matrices don't factor)."""
+    n = 12
+    tr = np.array([[0, 0, 0], [1, 2, 3], [2, 1, 0]])
+    plan = make_local_plan(TransformType.R2C, n, n, n, tr,
+                           precision="single")
+    assert not plan._use_mdft
+
+
+def test_precision_model_penalises_uncalibrated_path():
+    assert plan_mod.predicted_rel_error("single", 2 ** 19) \
+        > 4 * plan_mod.predicted_rel_error("single", 512) \
+        > plan_mod.predicted_rel_error("single", 256)
