@@ -71,6 +71,22 @@ class Grid:
         self._exchange = ExchangeType(exchange)
         self._precision = precision
 
+    def copy(self) -> "Grid":
+        """Deep-copy constructor parity (reference grid.hpp:82-90 /
+        grid_internal.cpp:232-262, where the copy re-allocates fresh
+        buffers so the twin grids never share scratch space). Plans here
+        own no mutable buffers — XLA allocates per executable — so an
+        independent ``Grid`` carrying the same limits IS the deep copy;
+        transforms created from either are fully isolated."""
+        return Grid(self._max_dim_x, self._max_dim_y, self._max_dim_z,
+                    self._max_num_local_z_sticks, self._processing_unit,
+                    self._num_threads, self._mesh,
+                    self._max_local_z_length, self._exchange,
+                    self._precision)
+
+    __copy__ = copy
+    __deepcopy__ = lambda self, memo: self.copy()  # noqa: E731
+
     # -- getters (reference grid.hpp:144-203) --------------------------------
     @property
     def max_dim_x(self) -> int:

@@ -318,6 +318,13 @@ def check_size_overflow(dim_x: int, dim_y: int, dim_z: int) -> None:
         raise OverflowError_(
             f"grid size product 2*{dim_x}*{dim_y}*{dim_z} overflows the "
             f"64-bit size range")
+    # The per-plane gather tables (stick keys x*dim_y+y, col_inv over
+    # dim_x_freq*dim_y columns) are int32; a plane bigger than int32 would
+    # wrap them silently (round-4 advisor finding), so fail loudly here.
+    if int(dim_x) * int(dim_y) > 2 ** 31 - 1:
+        raise OverflowError_(
+            f"plane size {dim_x}*{dim_y} exceeds the int32 range of the "
+            f"stick-key/column gather tables")
 
 
 def build_index_plan(transform_type: TransformType,
@@ -336,6 +343,19 @@ def build_index_plan(transform_type: TransformType,
     hermitian = transform_type == TransformType.R2C
     value_indices, stick_keys, centered = convert_index_triplets(
         hermitian, dim_x, dim_y, dim_z, triplets)
+    # Stick-slot space and per-value flat indices are int32 tables
+    # (value_indices, slot_src); num_sticks is known only after the
+    # unique() above, so the int32-range check lives here rather than in
+    # check_size_overflow (round-4 advisor finding: a sparse
+    # 4096x4096x1024 plan passed the 2^62 guard and wrapped silently).
+    from .errors import OverflowError_
+    num_sticks = int(stick_keys.shape[0])
+    if num_sticks * int(dim_z) > 2 ** 31 - 1 \
+            or int(value_indices.shape[0]) > 2 ** 31 - 1:
+        raise OverflowError_(
+            f"stick-slot count {num_sticks}*{dim_z} (or value count "
+            f"{value_indices.shape[0]}) exceeds the int32 range of the "
+            f"compression gather tables")
     return IndexPlan(transform_type=transform_type, dim_x=dim_x, dim_y=dim_y,
                      dim_z=dim_z, centered=centered,
                      value_indices=value_indices, stick_keys=stick_keys)

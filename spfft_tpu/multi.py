@@ -49,6 +49,14 @@ FUSED_BATCH_MAX_GRID = 8_000_000
 #: (pack/exchange/unpack stages), so fusion stays profitable longer than
 #: locally — measured round 3 (sync-cancelled, scripts/measure_batch.py):
 #: 128^3 B=8 (16.8M total) fused wins 1.9x, 256^3 B=3 (50M) loses 0.64x.
+#: PROVENANCE: those measurements ran comm_size=1 distributed plans (the
+#: only configuration this container can time — one real chip); the
+#: multi-shard economics (collective launch amortisation vs vmapped
+#: exchange cost) are UNMEASURED. The gate's scaling behavior has a
+#: structural check instead: tests/test_multi.py asserts the fused S=8
+#: batch compiles ONE executable whose HLO stays sub-linear in B vs the
+#: unfused N-dispatch path (wall-clock on a virtual CPU mesh would be
+#: meaningless).
 FUSED_BATCH_MAX_DIST_TOTAL = 32_000_000
 
 

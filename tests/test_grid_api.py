@@ -219,3 +219,33 @@ def test_space_domain_host_snapshot_does_not_alias_numpy_store():
     a[0, 0, 0, 0] = 7.0
     assert snap[0, 0, 0, 0] == 0.0  # true snapshot, no aliasing
     assert a.flags.writeable  # the caller's array is untouched
+
+
+def test_grid_copy_is_independent():
+    """Grid deep-copy parity (reference grid_internal.cpp:232-262): the
+    copy carries the same limits, works through copy.copy/deepcopy, and
+    transforms made from original and copy are fully isolated."""
+    import copy as copy_mod
+
+    n = 6
+    trip = np.array([[x, y, z] for x in range(2) for y in range(2)
+                     for z in range(n)], np.int32)
+    grid = Grid(n, n, n, 4)
+    for dup in (grid.copy(), copy_mod.copy(grid),
+                copy_mod.deepcopy(grid)):
+        assert dup is not grid
+        assert dup.max_dim_x == grid.max_dim_x
+        assert dup.max_dim_y == grid.max_dim_y
+        assert dup.max_dim_z == grid.max_dim_z
+        assert dup.max_num_local_z_columns == grid.max_num_local_z_columns
+        assert dup.processing_unit == grid.processing_unit
+        assert dup.distributed == grid.distributed
+    dup = grid.copy()
+    ta = grid.create_transform(ProcessingUnit.DEVICE, TransformType.C2C,
+                               n, n, n, indices=trip)
+    tb = dup.create_transform(ProcessingUnit.DEVICE, TransformType.C2C,
+                              n, n, n, indices=trip)
+    vals = np.arange(len(trip)).astype(np.complex64)
+    np.testing.assert_allclose(np.asarray(ta.backward(vals)),
+                               np.asarray(tb.backward(vals)),
+                               atol=0, rtol=0)
