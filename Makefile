@@ -15,7 +15,7 @@ CACHE_TAG   := $(shell python3 -c "import sys; print(sys.implementation.cache_ta
 PLANNER_SO  := $(NATIVE_DIR)/_planner_$(CACHE_TAG).so
 CAPI_SO     := lib/libspfft_tpu.so
 
-.PHONY: all native capi example-c test ci clean
+.PHONY: all native capi example-c test ci ci-tpu clean
 
 # One-command CI (reference: .github/workflows/ci.yml builds + runs the
 # local test matrix): full CPU suite (8-device virtual mesh; includes the
@@ -33,6 +33,16 @@ ci: native capi
 	@echo "== CI 4/4: precision matrix (CPU mode) =="
 	JAX_PLATFORMS=cpu DIMS="32 64" python scripts/precision_matrix.py
 	@echo "CI GREEN"
+
+# On-TPU regression lane (tests_tpu/): oracle matrix, forced Pallas,
+# the segmented aliased-carry accumulate, split-x, pair-IO, two-stage
+# axes and repeated-backward — the silent-corruption bug classes the
+# CPU-pinned suite cannot see. Needs the real chip; record with
+#   make ci-tpu 2>&1 | tee docs/ci_tpu_r05.log
+ci-tpu:
+	@echo "== CI-TPU: on-device regression lane =="
+	python -m pytest tests_tpu/ -q -rA
+	@echo "CI-TPU GREEN"
 
 all: native capi
 

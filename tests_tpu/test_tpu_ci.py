@@ -1,0 +1,213 @@
+"""On-TPU regression net (make ci-tpu): the exact code paths the
+CPU-pinned suite cannot exercise, each against a dense numpy oracle.
+
+Coverage (round-4 verdict item 3 + advisor finding 3):
+  * oracle matrix 32^3/64^3, C2C + R2C, centered + positive indexing
+  * Pallas compression kernel forced on (real Mosaic codegen + DMA)
+  * the segmented aliased-carry accumulate path (input/output aliasing
+    semantics only real hardware honors — the interpreter keeps the
+    concat path, so this was previously validated by hand-run probes
+    only)
+  * split-x (occupied-window xy stage), pair-IO (2, N) boundary,
+    two-stage Cooley-Tukey long axis, repeated-backward stability,
+    fused iterate_pointwise
+"""
+
+import numpy as np
+import pytest
+
+import spfft_tpu.plan as plan_mod
+from spfft_tpu import Scaling, TransformType, make_local_plan
+from spfft_tpu.ops import gather_kernel as gk
+from spfft_tpu.utils.workloads import spherical_cutoff_triplets
+
+TOL = 1e-6
+
+
+def _values(n_values, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal(n_values)
+            + 1j * rng.standard_normal(n_values)).astype(np.complex64)
+
+
+def _dense_c2c_oracle(triplets, vals, dims):
+    nx, ny, nz = dims
+    st = triplets.copy()
+    for a, n in enumerate(dims):
+        st[:, a] = np.where(st[:, a] < 0, st[:, a] + n, st[:, a])
+    cube = np.zeros((nz, ny, nx), np.complex64)
+    cube[st[:, 2], st[:, 1], st[:, 0]] = vals
+    return np.fft.ifftn(cube) * cube.size
+
+
+def _rel(got, want):
+    return np.linalg.norm(got - want) / max(np.linalg.norm(want), 1e-30)
+
+
+def _check_c2c(plan, triplets, n, seed=0):
+    vals = _values(len(triplets), seed)
+    space = np.asarray(plan.backward(vals))
+    got = space[..., 0] + 1j * space[..., 1]
+    oracle = _dense_c2c_oracle(triplets, vals, (n, n, n))
+    assert _rel(got, oracle) < TOL
+    out = np.asarray(plan.forward(space, Scaling.FULL))
+    if plan.pair_values_io:
+        out = out.T
+    assert _rel(out[:, 0] + 1j * out[:, 1], vals) < TOL
+    return space
+
+
+@pytest.mark.parametrize("n", [32, 64])
+@pytest.mark.parametrize("indexing", ["centered", "positive"])
+def test_oracle_c2c(n, indexing):
+    tr = spherical_cutoff_triplets(n, radius=n // 2 - 1)
+    if indexing == "positive":
+        tr = np.where(tr < 0, tr + n, tr)
+    plan = make_local_plan(TransformType.C2C, n, n, n, tr,
+                           precision="single")
+    _check_c2c(plan, tr, n)
+
+
+@pytest.mark.parametrize("n", [32, 64])
+@pytest.mark.parametrize("indexing", ["centered", "positive"])
+def test_oracle_r2c(n, indexing):
+    rng = np.random.default_rng(1)
+    field = rng.standard_normal((n, n, n)).astype(np.float32)
+    freq = np.fft.fftn(field)
+    half = []
+    for x in range(n // 2 + 1):
+        for y in range(n):
+            for z in range(n):
+                half.append((x, y, z))
+    tr = np.asarray(half, np.int64)
+    vals = freq[tr[:, 2], tr[:, 1], tr[:, 0]].astype(np.complex64)
+    if indexing == "centered":
+        tr = tr.copy()
+        for a in (1, 2):
+            tr[:, a] = np.where(tr[:, a] > n // 2, tr[:, a] - n, tr[:, a])
+    plan = make_local_plan(TransformType.R2C, n, n, n, tr,
+                           precision="single")
+    space = np.asarray(plan.backward(vals))
+    assert _rel(space, field * field.size) < TOL
+    out = np.asarray(plan.forward(space, Scaling.FULL))
+    assert _rel(out[:, 0] + 1j * out[:, 1], vals) < TOL
+
+
+def test_pallas_kernel_forced():
+    """The Mosaic windowed-gather kernel on real hardware (auto-gate
+    would skip it below 200k values; forcing keeps this test fast)."""
+    n = 64
+    tr = spherical_cutoff_triplets(n)
+    plan = make_local_plan(TransformType.C2C, n, n, n, tr,
+                           precision="single", use_pallas=True)
+    assert plan.pallas_active
+    _check_c2c(plan, tr, n, seed=2)
+
+
+def test_segmented_aliased_carry_accumulate(monkeypatch):
+    """Segmented multi-launch gathers accumulate through pallas
+    input/output aliasing on real hardware — semantics the interpreter
+    does not honor, so only this lane can regression-test them
+    (advisor r4 finding 3). Shrinking the launch limits forces many
+    segments on a small plan; the result must still match both the
+    dense oracle and the XLA-gather path."""
+    # limit 2 at 32^3 segments BOTH table kinds (measured: decompress =
+    # wide kernel, 2 segments; compress = narrow kernel, 14 segments)
+    monkeypatch.setattr(gk, "SEG_CHUNK_LIMIT", 2)
+    monkeypatch.setattr(gk, "WIDE_SEG_CHUNK_LIMIT", 2)
+    n = 32
+    tr = spherical_cutoff_triplets(n)
+    plan = make_local_plan(TransformType.C2C, n, n, n, tr,
+                           precision="single", use_pallas=True)
+    assert plan.pallas_active
+    box = plan._pallas
+    assert all(t is not None and t.segs for t in box.values()), \
+        "launch limits did not force segmentation on both directions"
+    vals = _values(len(tr), 3)
+    space = np.asarray(plan.backward(vals))
+    got = space[..., 0] + 1j * space[..., 1]
+    oracle = _dense_c2c_oracle(tr, vals, (n, n, n))
+    assert _rel(got, oracle) < TOL
+    # forward leg drives the segmented COMPRESS carry
+    out = np.asarray(plan.forward(space, Scaling.FULL))
+    assert _rel(out[:, 0] + 1j * out[:, 1], vals) < TOL
+    # XLA-gather cross-check through the same plan tables
+    import jax
+    vil = plan._coerce_values(vals)
+    xla = np.asarray(jax.jit(
+        lambda v, t: plan._backward_impl(v, t, pallas=False))(
+            vil, plan._tables))
+    np.testing.assert_allclose(space, xla, atol=1e-5, rtol=1e-5)
+
+
+def test_split_x_window():
+    """Occupied-x-window xy stage (plan._split_x) on real hardware,
+    wrapped window included (centered x in [-3, 3])."""
+    n = 64
+    tr = spherical_cutoff_triplets(n)
+    tr = tr[np.abs(tr[:, 0]) <= 3]
+    plan = make_local_plan(TransformType.C2C, n, n, n, tr,
+                           precision="single")
+    assert plan._split_x is not None
+    _check_c2c(plan, tr, n, seed=4)
+
+
+def test_pair_io_boundary(monkeypatch):
+    """The planar (2, N) value boundary (default only >= 16M values) on
+    a small plan: layout flip must be observable and exact."""
+    monkeypatch.setattr(plan_mod, "PAIR_IO_THRESHOLD", 1)
+    n = 32
+    tr = spherical_cutoff_triplets(n)
+    plan = make_local_plan(TransformType.C2C, n, n, n, tr,
+                           precision="single")
+    assert plan.pair_values_io
+    vals = _values(len(tr), 5)
+    out = plan.forward(plan.backward(vals), Scaling.FULL)
+    assert out.shape == (2, len(tr))
+    assert _rel(np.asarray(out)[0] + 1j * np.asarray(out)[1], vals) < TOL
+
+
+def test_two_stage_long_axis():
+    """768 = 24*32 z-axis through the two-stage Cooley-Tukey matmul
+    path on real hardware."""
+    nx, ny, nz = 16, 16, 768
+    rng = np.random.default_rng(6)
+    tr = np.stack([rng.integers(0, nx, 3000), rng.integers(0, ny, 3000),
+                   rng.integers(0, nz, 3000)], axis=-1)
+    tr = np.unique(tr, axis=0)
+    plan = make_local_plan(TransformType.C2C, nx, ny, nz, tr,
+                           precision="single")
+    assert plan._use_mdft
+    vals = _values(len(tr), 6)
+    space = np.asarray(plan.backward(vals))
+    got = space[..., 0] + 1j * space[..., 1]
+    oracle = _dense_c2c_oracle(tr, vals, (nx, ny, nz))
+    assert _rel(got, oracle) < TOL
+
+
+def test_repeated_backward_is_stable():
+    """Back-to-back backward executions must agree bit-for-bit (the
+    reference's repeated-transform zeroing check, benchmark.cpp) —
+    catches stale-buffer reuse on the device."""
+    n = 32
+    tr = spherical_cutoff_triplets(n)
+    plan = make_local_plan(TransformType.C2C, n, n, n, tr,
+                           precision="single")
+    vals = _values(len(tr), 7)
+    a = np.asarray(plan.backward(vals))
+    b = np.asarray(plan.backward(vals))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_iterate_pointwise_fused_scan():
+    """lax.scan-fused round trips == sequential apply_pointwise."""
+    n = 32
+    tr = spherical_cutoff_triplets(n)
+    plan = make_local_plan(TransformType.C2C, n, n, n, tr,
+                           precision="single")
+    vals = _values(len(tr), 8)
+    it = np.asarray(plan.iterate_pointwise(vals, None, steps=2,
+                                           scaling=Scaling.FULL))
+    one = plan.apply_pointwise(vals, scaling=Scaling.FULL)
+    two = np.asarray(plan.apply_pointwise(one, scaling=Scaling.FULL))
+    np.testing.assert_allclose(it, two, atol=1e-6, rtol=1e-5)
