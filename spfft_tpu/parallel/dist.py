@@ -55,7 +55,8 @@ from ..utils.dtypes import (as_interleaved, complex_dtype,
                             complex_to_interleaved, interleaved_to_complex,
                             real_dtype)
 from .exchange import (all_to_all_blocks, build_compact_schedule,
-                       compact_exchange, pack_freq_to_blocks,
+                       build_ragged_schedule, compact_exchange,
+                       ragged_exchange, pack_freq_to_blocks,
                        pack_space_to_blocks, ring_exchange_blocks,
                        unpack_blocks_to_grid, unpack_blocks_to_sticks)
 from .mesh import SHARD_AXIS, make_mesh
@@ -187,12 +188,34 @@ class DistributedTransformPlan:
                                 else jnp.bfloat16)
         self._init_split_x()
         # UNBUFFERED selects the ppermute-ring mechanism; COMPACT_BUFFERED
-        # the exact-count schedule (no padded-block exchange at all); every
-        # other variant the single fused all_to_all (see exchange.py).
-        self._compact = (build_compact_schedule(dist_plan,
-                                                x_window=self._split_x)
-                         if self.exchange.compact else None)
-        if self._compact is not None:
+        # the exact-count exchange — ONE ragged_all_to_all per direction
+        # (exchange.RaggedSchedule, the true Alltoallv; launch count is
+        # shard-count-invariant, replacing the round-4 ppermute schedule
+        # that paid up to 416 collectives at S=32). Off-TPU the ragged
+        # collective is EMULATED (all_gather + plan-time gather — XLA:CPU
+        # has no ragged-all-to-all kernel), so the CPU suite and the
+        # virtual-device dryrun execute the same tables end-to-end.
+        # SPFFT_TPU_COMPACT_PPERMUTE=1 restores the ppermute schedule
+        # (also used at S=1, where no collective exists to batch). Every
+        # other variant runs the single fused all_to_all (exchange.py).
+        import os as _os
+        self._compact = None
+        self._ragged = None
+        if self.exchange.compact:
+            if dist_plan.num_shards > 1 and _os.environ.get(
+                    "SPFFT_TPU_COMPACT_PPERMUTE") != "1":
+                self._ragged = build_ragged_schedule(
+                    dist_plan, x_window=self._split_x)
+            else:
+                self._compact = build_compact_schedule(
+                    dist_plan, x_window=self._split_x)
+        # SPFFT_TPU_FORCE_RAGGED_OP=1 lowers the REAL ragged op off-TPU
+        # (XLA:CPU can lower it but not execute it) — used by the HLO
+        # launch-count checks in tests and scripts/scaling_model.py.
+        self._ragged_emulate = (jax.default_backend() != "tpu"
+                                and _os.environ.get(
+                                    "SPFFT_TPU_FORCE_RAGGED_OP") != "1")
+        if self._compact is not None or self._ragged is not None:
             self._exchange_fn = None
         elif self.exchange == ExchangeType.UNBUFFERED:
             self._exchange_fn = ring_exchange_blocks
@@ -226,6 +249,11 @@ class DistributedTransformPlan:
                        + [self._compact.bwd_unpack]
                        + list(self._compact.fwd_pack)
                        + [self._compact.fwd_unpack])
+            self._n_ctables = len(ctables)
+            self._device_tables = self._device_tables + tuple(
+                jax.device_put(a, self._sharded) for a in ctables)
+        elif self._ragged is not None:
+            ctables = self._ragged.device_tables()
             self._n_ctables = len(ctables)
             self._device_tables = self._device_tables + tuple(
                 jax.device_put(a, self._sharded) for a in ctables)
@@ -498,6 +526,20 @@ class DistributedTransformPlan:
         """z-sticks -> local plane grid across the mesh, via the selected
         exchange mechanism."""
         dp = self.dist_plan
+        if self._ragged is not None:
+            # sticks: (max_sticks, dim_z) or batched (B, max_sticks, dim_z)
+            batch = sticks.shape[:-2]
+            flat = sticks.reshape(batch + (-1,))
+            buf = jnp.take(flat, ctables[0][0], axis=-1, mode="fill",
+                           fill_value=0)
+            offs = tuple(t[0] for t in ctables[4:8])
+            recv = ragged_exchange(buf, offs, ctables[12][0],
+                                   self._ragged.recv_cap, self.axis_name,
+                                   self._ragged_emulate, self._wire_dtype)
+            grid_flat = jnp.take(recv, ctables[1][0], axis=-1,
+                                 mode="fill", fill_value=0)
+            return grid_flat.reshape(batch + (dp.max_planes, dp.dim_y,
+                                              self._xf_eff))
         if self._compact is not None:
             nb = len(self._compact.hop_sizes)
             flat = sticks.reshape(-1)
@@ -525,6 +567,18 @@ class DistributedTransformPlan:
     def _exchange_grid_to_sticks(self, grid, cols_flat, z_src, ctables):
         """Local plane grid -> z-sticks across the mesh (forward mirror)."""
         dp = self.dist_plan
+        if self._ragged is not None:
+            batch = grid.shape[:-3]
+            flat = grid.reshape(batch + (-1,))
+            buf = jnp.take(flat, ctables[2][0], axis=-1, mode="fill",
+                           fill_value=0)
+            offs = tuple(t[0] for t in ctables[8:12])
+            recv = ragged_exchange(buf, offs, ctables[13][0],
+                                   self._ragged.recv_cap, self.axis_name,
+                                   self._ragged_emulate, self._wire_dtype)
+            sticks_flat = jnp.take(recv, ctables[3][0], axis=-1,
+                                   mode="fill", fill_value=0)
+            return sticks_flat.reshape(batch + (dp.max_sticks, dp.dim_z))
         if self._compact is not None:
             nb = len(self._compact.hop_sizes)
             flat = grid.reshape(-1)
@@ -562,11 +616,9 @@ class DistributedTransformPlan:
             return jax.vmap(dec)(values_il)
         return dec(values_il)
 
-    def _backward_tail(self, sticks, onehot, col_inv, zmap, ctables):
-        """Per-shard pipeline after decompress: symmetry, z-IFFT, exchange,
-        plane symmetry, xy-IFFT. Input (max_sticks, dim_z); output the
-        per-shard space slab (unbatched — batched callers vmap this, the
-        collectives inside batch cleanly)."""
+    def _bwd_pre_exchange(self, sticks, onehot):
+        """Stick symmetry + z-IFFT (the per-example half before the
+        exchange; batched callers vmap this)."""
         dp = self.dist_plan
         if dp.hermitian:
             # Complete every stick, then blend by the one-hot (0,0)-stick
@@ -575,8 +627,11 @@ class DistributedTransformPlan:
             completed = jax.vmap(stages.complete_stick_hermitian)(sticks)
             oh = onehot[0][:, None].astype(self._rdt)
             sticks = sticks * (1 - oh) + completed * oh
-        sticks = stages.z_backward(sticks)
-        grid = self._exchange_freq_to_grid(sticks, zmap, col_inv, ctables)
+        return stages.z_backward(sticks)
+
+    def _bwd_post_exchange(self, grid):
+        """Plane symmetry + xy-IFFT (after the exchange)."""
+        dp = self.dist_plan
         if dp.hermitian:
             if self._split_x is not None:
                 x0, _ = self._split_x
@@ -591,6 +646,16 @@ class DistributedTransformPlan:
             return complex_to_interleaved(
                 stages.xy_backward_c2c_split(grid, x0, dp.dim_x))
         return complex_to_interleaved(stages.xy_backward_c2c(grid))
+
+    def _backward_tail(self, sticks, onehot, col_inv, zmap, ctables):
+        """Per-shard pipeline after decompress: symmetry, z-IFFT, exchange,
+        plane symmetry, xy-IFFT. Input (max_sticks, dim_z); output the
+        per-shard space slab (unbatched — batched callers vmap the
+        pre/post halves and run the exchange batch-natively, see
+        _backward_body_batched)."""
+        sticks = self._bwd_pre_exchange(sticks, onehot)
+        grid = self._exchange_freq_to_grid(sticks, zmap, col_inv, ctables)
+        return self._bwd_post_exchange(grid)
 
     def _backward_body(self, values_il, vi, slot_src, onehot, cols_flat,
                        col_inv, zmap, z_src, *xtables):
@@ -611,28 +676,39 @@ class DistributedTransformPlan:
         ptables = xtables[:self._n_ptables]
         ctables = xtables[self._n_ptables:self._n_ptables + self._n_ctables]
         sticks_b = self._decompress_shard(values_il[0], slot_src, ptables)
+        if self._ragged is not None:
+            # ragged_all_to_all has no vmap batching rule: vmap the
+            # per-example halves, run ONE collective with the batch as a
+            # trailing dimension (exchange.ragged_exchange)
+            s2 = jax.vmap(
+                lambda s: self._bwd_pre_exchange(s, onehot))(sticks_b)
+            grid_b = self._exchange_freq_to_grid(s2, zmap, col_inv,
+                                                 ctables)
+            return jax.vmap(self._bwd_post_exchange)(grid_b)[None]
         return jax.vmap(
             lambda s: self._backward_tail(s, onehot, col_inv, zmap,
                                           ctables))(sticks_b)[None]
 
-    def _forward_head(self, space, cols_flat, z_src, ctables):
-        """Per-shard pipeline before compress: xy-FFT, exchange, z-FFT.
-        Input the per-shard space slab; output (max_sticks, dim_z)."""
+    def _fwd_pre_exchange(self, space):
+        """xy-FFT (the per-example half before the forward exchange)."""
         dp = self.dist_plan
         if dp.hermitian:
             if self._split_x is not None:
                 x0, w = self._split_x
-                grid = stages.xy_forward_r2c_split(
+                return stages.xy_forward_r2c_split(
                     space.astype(self._rdt), x0, w)
-            else:
-                grid = stages.xy_forward_r2c(space.astype(self._rdt))
-        elif self._split_x is not None:
+            return stages.xy_forward_r2c(space.astype(self._rdt))
+        if self._split_x is not None:
             x0, w = self._split_x
-            grid = stages.xy_forward_c2c_split(
+            return stages.xy_forward_c2c_split(
                 interleaved_to_complex(space).astype(self._cdt), x0, w)
-        else:
-            grid = stages.xy_forward_c2c(
-                interleaved_to_complex(space).astype(self._cdt))
+        return stages.xy_forward_c2c(
+            interleaved_to_complex(space).astype(self._cdt))
+
+    def _forward_head(self, space, cols_flat, z_src, ctables):
+        """Per-shard pipeline before compress: xy-FFT, exchange, z-FFT.
+        Input the per-shard space slab; output (max_sticks, dim_z)."""
+        grid = self._fwd_pre_exchange(space)
         sticks = self._exchange_grid_to_sticks(grid, cols_flat, z_src,
                                                ctables)
         return stages.z_forward(sticks)
@@ -669,9 +745,16 @@ class DistributedTransformPlan:
                               col_inv, zmap, z_src, *xtables, scaled: bool):
         ptables = xtables[:self._n_ptables]
         ctables = xtables[self._n_ptables:self._n_ptables + self._n_ctables]
-        sticks_b = jax.vmap(
-            lambda s: self._forward_head(s, cols_flat, z_src,
-                                         ctables))(space[0])
+        if self._ragged is not None:
+            # batch rides the collective's trailing dims (see
+            # _backward_body_batched)
+            grid_b = jax.vmap(self._fwd_pre_exchange)(space[0])
+            sticks_b = stages.z_forward(self._exchange_grid_to_sticks(
+                grid_b, cols_flat, z_src, ctables))
+        else:
+            sticks_b = jax.vmap(
+                lambda s: self._forward_head(s, cols_flat, z_src,
+                                             ctables))(space[0])
         return self._compress_shard(sticks_b, vi, ptables, scaled)[None]
 
     def _pair_shmap(self, n_fn_args: int):
@@ -829,6 +912,8 @@ class DistributedTransformPlan:
         :meth:`exchange_busiest_link_bytes` for the bottleneck-link view."""
         dp = self.dist_plan
         elem = self._wire_elem_bytes()
+        if self._ragged is not None:
+            return self._ragged.wire_elements() * elem  # exact Alltoallv
         if self._compact is not None:
             return self._compact.wire_elements() * elem
         return (dp.num_shards * (dp.num_shards - 1)
@@ -842,6 +927,8 @@ class DistributedTransformPlan:
         (aggregate), not here; stick-skew savings show up in both."""
         dp = self.dist_plan
         elem = self._wire_elem_bytes()
+        if self._ragged is not None:
+            return self._ragged.busiest_link_elements() * elem
         if self._compact is not None:
             return self._compact.busiest_link_elements() * elem
         return (dp.num_shards - 1) * dp.max_sticks * dp.max_planes * elem

@@ -377,6 +377,227 @@ def build_compact_schedule(dp, x_window=None) -> CompactSchedule:
                            fwd_unpack=fwd_unpack)
 
 
+@dataclasses.dataclass(frozen=True)
+class RaggedSchedule:
+    """Plan-time tables for the ONE-COLLECTIVE exact-count exchange — the
+    true Alltoallv (reference MPI_Alltoallv,
+    transpose_mpi_compact_buffered_host.cpp:183-200), built on
+    ``jax.lax.ragged_all_to_all``: per-pair element counts ride offset
+    vectors into one fixed-capacity buffer, so the launch count is 1 per
+    direction at ANY shard count (the round-4 ppermute schedule paid up
+    to 416 collectives at S=32 — its launch-scalability gap) and the
+    wire carries EXACTLY the per-pair counts (no 1.25x bucket factor).
+
+    Backward direction: stick-owner ``j`` sends ``ns(j) * np(d)``
+    elements to plane-owner ``d``; forward reverses (counts transpose).
+    Send buffers are laid out destination-major, receive buffers
+    source-major, both with static capacity = the max total over shards
+    (the ragged op needs one static shape; the capacity slack stays in
+    HBM and off the wire — unlike the padded layout, which ships it).
+
+    XLA:CPU has no ragged-all-to-all kernel, so off-TPU execution (the
+    CPU test suite, the driver's virtual-device dryrun) EMULATES the
+    collective with one ``all_gather`` + a plan-time gather table
+    (``emu_*``) — identical numerics through the same pack/unpack
+    tables, wire economics obviously not preserved. The real op lowers
+    and is HLO-verified at S=8/16/32 (scripts/scaling_model.py); it
+    cannot *execute* in this container (one TPU chip), which is exactly
+    the class of gap the on-TPU CI lane documents.
+    """
+
+    num_shards: int
+    send_cap: int                 # static send-buffer elements per shard
+    recv_cap: int                 # static recv-buffer elements per shard
+    # per-direction offset vectors, each (S, S) int32, row = this shard:
+    bwd_offsets: tuple            # (input_offsets, send_sizes,
+                                  #  output_offsets, recv_sizes)
+    fwd_offsets: tuple
+    bwd_pack: np.ndarray          # (S, send_cap) into flat local sticks
+    bwd_unpack: np.ndarray        # (S, mp*Y*Xe) into the recv buffer
+    fwd_pack: np.ndarray          # (S, send_cap) into the flat local grid
+    fwd_unpack: np.ndarray        # (S, ms*dz) into the recv buffer
+    emu_bwd: np.ndarray           # (S, recv_cap) into allgathered sends
+    emu_fwd: np.ndarray           # (S, recv_cap)
+
+    def _counts(self):
+        """Backward per-pair element counts n[j, d] (forward is n.T)."""
+        io, ss, oo, rs = self.bwd_offsets
+        return ss
+
+    def wire_elements(self) -> int:
+        """TOTAL off-shard complex elements per exchange (exact — the
+        ragged op ships per-pair counts with no padding or buckets)."""
+        n = np.asarray(self._counts(), np.int64)
+        return int(n.sum() - np.trace(n))
+
+    def busiest_link_elements(self) -> int:
+        """Max over shards of max(sent, received) off-shard elements."""
+        n = np.asarray(self._counts(), np.int64).copy()
+        np.fill_diagonal(n, 0)
+        send = n.sum(axis=1)
+        recv = n.sum(axis=0)
+        both = np.maximum(send, recv)
+        return int(both.max()) if self.num_shards else 0
+
+    def device_tables(self) -> list:
+        """The (S, ...) tables the SPMD bodies consume, in a fixed order
+        (see dist.TransformPlan's ctables plumbing)."""
+        io_b, ss_b, oo_b, rs_b = self.bwd_offsets
+        io_f, ss_f, oo_f, rs_f = self.fwd_offsets
+        return [self.bwd_pack, self.bwd_unpack, self.fwd_pack,
+                self.fwd_unpack, io_b, ss_b, oo_b, rs_b,
+                io_f, ss_f, oo_f, rs_f, self.emu_bwd, self.emu_fwd]
+
+
+def _ragged_direction_tables(S: int, counts: np.ndarray):
+    """Offset vectors + emulation table layout for one direction.
+    ``counts[j, d]`` = elements shard j sends shard d. Returns
+    ((input_offsets, send_sizes, output_offsets, recv_sizes), send_cap,
+    recv_cap, recv_offsets)."""
+    counts = np.asarray(counts, np.int64)
+    input_offsets = np.concatenate(
+        [np.zeros((S, 1), np.int64), np.cumsum(counts, axis=1)[:, :-1]],
+        axis=1)
+    recv_counts = counts.T                      # row d: from each j
+    recv_offsets = np.concatenate(
+        [np.zeros((S, 1), np.int64), np.cumsum(recv_counts, axis=1)[:, :-1]],
+        axis=1)
+    # sender j's chunk lands at receiver d's recv_offsets[d, j]
+    output_offsets = recv_offsets.T
+    send_cap = int(counts.sum(axis=1).max()) if S else 1
+    recv_cap = int(recv_counts.sum(axis=1).max()) if S else 1
+    offs = tuple(a.astype(np.int32) for a in
+                 (input_offsets, counts, output_offsets, recv_counts))
+    return offs, max(send_cap, 1), max(recv_cap, 1), recv_offsets
+
+
+def build_ragged_schedule(dp, x_window=None) -> RaggedSchedule:
+    """Build the one-collective exact-count schedule from a
+    ``DistributedIndexPlan`` (same duck-typed contract and x-window
+    composition as :func:`build_compact_schedule`)."""
+    from ..indexing import window_sub_cols
+
+    S = dp.num_shards
+    ms, mp_ = dp.max_sticks, dp.max_planes
+    dz, Y, Xf = dp.dim_z, dp.dim_y, dp.dim_x_freq
+    Xe = Xf if x_window is None else x_window[1]
+
+    def grid_cols(cols):
+        if x_window is None:
+            return np.asarray(cols, np.int64)
+        return window_sub_cols(cols, Xf, *x_window).astype(np.int64)
+
+    ns = [p.num_sticks for p in dp.shard_plans]
+    npl = list(dp.num_planes)
+    off = list(dp.plane_offsets)
+    n_bwd = np.asarray([[ns[j] * npl[d] for d in range(S)]
+                        for j in range(S)], np.int64)
+    bwd_offs, s_cap_b, r_cap_b, roff_b = _ragged_direction_tables(S, n_bwd)
+    fwd_offs, s_cap_f, r_cap_f, roff_f = _ragged_direction_tables(S, n_bwd.T)
+    send_cap = max(s_cap_b, s_cap_f)
+    recv_cap = max(r_cap_b, r_cap_f)
+    io_b = bwd_offs[0].astype(np.int64)
+    io_f = fwd_offs[0].astype(np.int64)
+
+    bwd_pack = np.full((S, send_cap), ms * dz, np.int32)
+    emu_bwd = np.full((S, recv_cap), S * send_cap, np.int32)
+    fwd_pack = np.full((S, send_cap), mp_ * Y * Xe, np.int32)
+    emu_fwd = np.full((S, recv_cap), S * send_cap, np.int32)
+    bwd_unpack = np.full((S, mp_ * Y * Xe), recv_cap, np.int32)
+    fwd_unpack = np.full((S, ms * dz), recv_cap, np.int32)
+
+    for j in range(S):
+        for d in range(S):
+            n = ns[j] * npl[d]
+            if n:
+                # backward send j -> d: stick-major block (ns[j], npl[d])
+                i = np.arange(ns[j])[:, None]
+                z = off[d] + np.arange(npl[d])[None, :]
+                bwd_pack[j, io_b[j, d]:io_b[j, d] + n] = \
+                    (i * dz + z).reshape(-1)
+                emu_bwd[d, roff_b[d, j]:roff_b[d, j] + n] = \
+                    j * send_cap + io_b[j, d] + np.arange(n)
+            m = ns[d] * npl[j]
+            if m:
+                # forward send j -> d: d's sticks restricted to j's planes
+                cols = grid_cols(dp.shard_plans[d].scatter_cols)
+                p = np.arange(npl[j])[None, :]
+                fwd_pack[j, io_f[j, d]:io_f[j, d] + m] = \
+                    (p * (Y * Xe) + cols[:, None]).reshape(-1)
+                emu_fwd[d, roff_f[d, j]:roff_f[d, j] + m] = \
+                    j * send_cap + io_f[j, d] + np.arange(m)
+
+    for d in range(S):
+        if npl[d]:
+            for j in range(S):
+                if ns[j]:
+                    cols = grid_cols(dp.shard_plans[j].scatter_cols)
+                    i = np.arange(ns[j])[:, None]
+                    p = np.arange(npl[d])[None, :]
+                    pos = roff_b[d, j] + i * npl[d] + p
+                    flat_idx = p * (Y * Xe) + cols[:, None]
+                    bwd_unpack[d][flat_idx.reshape(-1)] = pos.reshape(-1)
+        if ns[d]:
+            for j in range(S):
+                if npl[j]:
+                    i = np.arange(ns[d])[:, None]
+                    p = np.arange(npl[j])[None, :]
+                    pos = roff_f[d, j] + i * npl[j] + p
+                    flat_idx = i * dz + (off[j] + p)
+                    fwd_unpack[d][flat_idx.reshape(-1)] = pos.reshape(-1)
+
+    return RaggedSchedule(
+        num_shards=S, send_cap=send_cap, recv_cap=recv_cap,
+        bwd_offsets=bwd_offs, fwd_offsets=fwd_offs, bwd_pack=bwd_pack,
+        bwd_unpack=bwd_unpack, fwd_pack=fwd_pack, fwd_unpack=fwd_unpack,
+        emu_bwd=emu_bwd, emu_fwd=emu_fwd)
+
+
+def ragged_exchange(buf, offsets, emu_table, recv_cap: int,
+                    axis_name: str, emulate: bool,
+                    wire_real_dtype: Optional[jnp.dtype] = None):
+    """Run one direction of the exact-count exchange.
+
+    Args:
+      buf: (send_cap,) complex — or (B, send_cap) batched — the packed
+        send buffer (destination-major layout of the schedule).
+      offsets: per-shard (input_offsets, send_sizes, output_offsets,
+        recv_sizes), each (S,) int32 (this shard's row).
+      emu_table: (recv_cap,) int32 into the allgathered flat sends —
+        the CPU-emulation gather (sentinel = S * send_cap).
+      emulate: True off-TPU (no XLA:CPU ragged-all-to-all kernel).
+    Returns:
+      (recv_cap,) complex — or (B, recv_cap).
+
+    The collective runs on interleaved reals with the batch as a
+    TRAILING dimension: ``ragged_all_to_all`` sizes address dim 0 and
+    the op has no vmap batching rule, so the batched fused path moves
+    B inside instead of vmapping (dist._backward_body_batched).
+    """
+    batched = buf.ndim == 2
+    rdt = buf.real.dtype
+    il = jnp.stack([jnp.real(buf), jnp.imag(buf)], axis=-1)
+    if wire_real_dtype is not None:
+        il = il.astype(wire_real_dtype)
+    if emulate:
+        gathered = jax.lax.all_gather(il, axis_name)  # (S, [B,] cap, 2)
+        flat = jnp.moveaxis(gathered, 1, 0).reshape(
+            (il.shape[0],) + (-1, 2)) if batched \
+            else gathered.reshape(-1, 2)
+        recv = jnp.take(flat, emu_table, axis=-2, mode="fill",
+                        fill_value=0)
+    else:
+        io, ss, oo, rs = offsets
+        op = jnp.moveaxis(il, 0, -2) if batched else il  # (cap, [B,] 2)
+        out = jnp.zeros((recv_cap,) + op.shape[1:], op.dtype)
+        recv = jax.lax.ragged_all_to_all(op, out, io, ss, oo, rs,
+                                         axis_name=axis_name)
+        if batched:
+            recv = jnp.moveaxis(recv, -2, 0)  # (B, recv_cap, 2)
+    recv = recv.astype(rdt)
+    return recv[..., 0] + 1j * recv[..., 1]
+
+
 def compact_exchange(bufs, ops, num_shards: int, axis_name: str,
                      reverse: bool,
                      wire_real_dtype: Optional[jnp.dtype] = None):

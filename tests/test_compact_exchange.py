@@ -97,10 +97,14 @@ def test_compact_fused_pair_and_scan():
         np.testing.assert_allclose(g, v, atol=1e-9, rtol=0)
 
 
-def test_compact_hlo_mechanically_distinct():
-    """The compact plan lowers to collective-permute hops with NO
-    all-to-all; the padded plan lowers to all-to-all (VERDICT: assert a
-    mechanically distinct lowering, not an alias)."""
+def test_compact_hlo_mechanically_distinct(monkeypatch):
+    """The ppermute-schedule variant of the compact plan lowers to
+    collective-permute hops with NO all-to-all; the padded plan lowers
+    to all-to-all (VERDICT: assert a mechanically distinct lowering, not
+    an alias). The DEFAULT compact mechanism is now the one-collective
+    ragged exchange (test_ragged_exchange.py); the schedule stays
+    available via SPFFT_TPU_COMPACT_PPERMUTE."""
+    monkeypatch.setenv("SPFFT_TPU_COMPACT_PPERMUTE", "1")
     rng = np.random.default_rng(3)
     dims = (8, 8, 8)
     triplets = random_sparse_triplets(rng, dims)
@@ -188,10 +192,11 @@ def test_plane_skew_saves_wire():
         np.testing.assert_allclose(g, v, atol=1e-10, rtol=0)
 
 
-def test_size_class_bucketing_round_trip():
-    """More than 4 distinct pair sizes per hop forces factor-2 bucketing;
-    the schedule must stay correct (8 shards, all-different plane counts
-    and stick counts)."""
+def test_size_class_bucketing_round_trip(monkeypatch):
+    """More than 4 distinct pair sizes per hop forces bucketing in the
+    ppermute schedule; the schedule must stay correct (8 shards,
+    all-different plane counts and stick counts)."""
+    monkeypatch.setenv("SPFFT_TPU_COMPACT_PPERMUTE", "1")
     rng = np.random.default_rng(30)
     dims = (14, 14, 36)
     triplets = random_sparse_triplets(rng, dims)
@@ -278,13 +283,17 @@ HLO_MECHANISMS = (ExchangeType.BUFFERED, ExchangeType.BUFFERED_FLOAT,
 
 
 @pytest.mark.parametrize("scenario", sorted(HLO_SCENARIOS))
-def test_wire_byte_model_matches_lowered_hlo(scenario):
+def test_wire_byte_model_matches_lowered_hlo(scenario, monkeypatch):
     """exchange_wire_bytes() / exchange_busiest_link_bytes() must equal the
     byte counts of the collectives ACTUALLY lowered into the SPMD module,
     for every mechanism and wire precision (VERDICT r2: the model drove
     the BENCHMARKS claims but was never checked against the compiled
     program; reference counts/displs:
-    transpose_mpi_compact_buffered_host.cpp:83-105)."""
+    transpose_mpi_compact_buffered_host.cpp:83-105). COMPACT here pins
+    the ppermute schedule — the default ragged collective's wire traffic
+    is data-dependent (not derivable from static HLO shapes); its model
+    is validated at the table level in test_ragged_exchange.py."""
+    monkeypatch.setenv("SPFFT_TPU_COMPACT_PPERMUTE", "1")
     rng = np.random.default_rng(23)
     dims = (12, 12, 12)
     triplets = random_sparse_triplets(rng, dims)
