@@ -1,0 +1,221 @@
+"""On-device double precision: double-single channels + exact-sliced dots.
+
+The chip has no f64 ALU, so ``precision="double"`` historically ran on
+the CPU backend. This module provides the ON-DEVICE double path
+(round-4 verdict item 5): every value is a DOUBLE-SINGLE pair (hi, lo)
+of f32 — ~48 significant bits — and every DFT contraction runs as an
+Ozaki-style EXACT-SLICED matmul:
+
+  * operands are sliced into beta-bit limbs on a power-of-two grid,
+    with beta chosen so each partial dot is EXACT in the f32 MXU
+    accumulator ((beta+1) + (beta+1) + log2(n) <= 24 bits);
+  * partial dots are combined hi-to-lo with Knuth TwoSum chains, every
+    rounding error captured into the lo channel.
+
+Measured on the chip (scripts/probe_r5_ds.py, 4096x256 @ 256x256):
+plain f32 HIGHEST dot 7.1e-8 relative; the verdict's compensated 3-dot
+sketch 6.5e-8 (the f32 accumulator rounds regardless — recorded
+negative result); exact-sliced 36-dot 5.5e-13. Two hazards both
+materialised and are guarded here: the algebraic simplifier folds
+``(a + C) - C`` and TwoSum identities unless the intermediate is
+``optimization_barrier``-ed (the documented dot-merge-simplifier
+class), and slices one bit over the exactness budget silently plateau
+the error at ~2^-25 (measured with beta=8 at n=256).
+
+Reference bar: FFTW double plans / cuFFT Z2Z as the default precision
+(reference: src/fft/fftw_plan_1d.hpp:74-94,
+src/gpu_util/gpu_fft_api.hpp:90-148).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_HIGHEST = jax.lax.Precision.HIGHEST
+
+#: Round-to-integer constant for the f32 round trick: (t + C) - C rounds
+#: t to the nearest integer (round-to-nearest-even) for |t| < 2^22.
+_C_ROUND = np.float32(1.5 * 2 ** 23)
+
+#: Slice-ladder depth for double-single values (~beta*VALUE_SLICES
+#: significant bits below each array's max exponent) and for the f64
+#: matrices. Partial dots beyond ORDER_MAX are dropped — the floor is
+#: ~2^(-beta*(ORDER_MAX+1)) ≈ 2e-13 per stage at beta=6, measured
+#: 2-4e-14 through the whole backward at 64^3/128^3 on-chip with the
+#: deeper (8, 9, 8) ladder; (7, 7, 6) keeps a >100x margin to the
+#: 2e-11 contract envelope at 28 instead of 45 partial dots per real
+#: contraction. Slices past ORDER_MAX can never pair and are not built.
+VALUE_SLICES = 7
+MAT_SLICES = 7
+ORDER_MAX = 6
+
+
+def slice_beta(n: int) -> int:
+    """Largest slice width keeping partial dots exact in the f32
+    accumulator: (beta+1)+(beta+1)+ceil(log2 n) <= 24."""
+    logn = max(int(np.ceil(np.log2(max(n, 2)))), 1)
+    return max((22 - logn) // 2, 2)
+
+
+def _two_sum(a, b):
+    """Knuth TwoSum: exact a + b = t + e for any f32 pair. The sum is
+    barriered so the algebraic simplifier cannot rewrite (a+b)-b -> a
+    and erase the error term (measured to plateau the whole scheme at
+    ~2.5e-8 when it fires)."""
+    t = jax.lax.optimization_barrier(a + b)
+    bv = t - a
+    av = t - bv
+    return t, (a - av) + (b - bv)
+
+
+def ds_add(ah, al, bh, bl):
+    """Double-single addition with renormalisation."""
+    sh, se = _two_sum(ah, bh)
+    lo = se + (al + bl)
+    return _two_sum(sh, lo)
+
+
+def ds_neg(h, l):
+    return -h, -l
+
+
+def _round_to_grid(x, inv_sc, sc):
+    """Round x to the nearest multiple of the power-of-two sc — exactly
+    representable when x/sc fits ~22 bits. The add is barriered: the
+    simplifier would fold (t + C) - C to t."""
+    t = x * inv_sc
+    r = jax.lax.optimization_barrier(t + _C_ROUND) - _C_ROUND
+    return r * sc
+
+
+def ds_slices(hi, lo, beta: int, s: int = VALUE_SLICES):
+    """Slice a double-single array into ``s`` beta-bit limbs on
+    PER-ROW power-of-two ladders (anchored at each contraction row's
+    max exponent). Each limb is exactly representable and partial-dot
+    exactness only needs slice widths, not a shared anchor, so per-row
+    anchors are free — and essential: a forward xy-DFT concentrates the
+    grid's energy into few modes, and a GLOBAL anchor drops every
+    element more than ~beta*s bits below the array max off the ladder
+    (measured: the composed forward pipeline plateaued at 2.5e-8 with a
+    global anchor while every isolated stage sat at 1e-14). Residual
+    exposure is the WITHIN-row dynamic range only
+    (docs/precision.md)."""
+    mx = jnp.max(jnp.abs(hi), axis=-1, keepdims=True)
+    # power-of-two anchor in [2*mx, 4*mx) by EXPONENT BIT extraction —
+    # exp2/log2 are approximate vector transcendentals on the TPU VPU,
+    # and an anchor that is not exactly a power of two makes every
+    # slice inexact (measured: a data-dependent 7e-9 floor, invariant
+    # under ladder depth, on cancellation-heavy forward grids)
+    bits = jax.lax.bitcast_convert_type(
+        jnp.maximum(mx, np.float32(1e-30)).astype(jnp.float32), jnp.int32)
+    expo = jnp.clip((bits >> 23) & 0xFF, 1, 250)
+    e0 = jax.lax.bitcast_convert_type((expo + 2) << 23, jnp.float32)
+    e0 = jax.lax.optimization_barrier(e0)
+    inv0 = 1.0 / e0  # exact: e0 is a power of two
+    out = []
+    rh, rl = hi, lo
+    for i in range(s):
+        sc = e0 * np.float32(2.0 ** (-beta * (i + 1)))
+        inv = inv0 * np.float32(2.0 ** (beta * (i + 1)))
+        q = _round_to_grid(rh, inv, sc)
+        rh = rh - q          # exact: q carries rh's top bits
+        rh, rl = _two_sum(rh, rl)
+        out.append(q)
+    return out
+
+
+def mat_slices_host(m64: np.ndarray, beta: int,
+                    s: int = MAT_SLICES) -> tuple:
+    """Slice an f64 matrix into beta-bit f32 limbs at plan time (host
+    f64 arithmetic — exact)."""
+    out = []
+    r = np.asarray(m64, np.float64).copy()
+    mx = float(np.max(np.abs(r)))
+    e0 = 2.0 ** (np.floor(np.log2(mx)) + 1) if mx > 0 else 1.0
+    for i in range(s):
+        sc = e0 * 2.0 ** (-beta * (i + 1))
+        q = np.round(r / sc) * sc
+        out.append(np.ascontiguousarray(q.astype(np.float32)))
+        r -= q
+    return tuple(out)
+
+
+def _dot(a, c):
+    return jax.lax.dot_general(a, jnp.asarray(c),
+                               (((a.ndim - 1,), (0,)), ((), ())),
+                               precision=_HIGHEST)
+
+
+def ozaki_dot_last(vslices, mslices, order_max: int = ORDER_MAX):
+    """(..., K) x (K, M) contraction over exact slice pairs: partial
+    dots of combined order i+j <= order_max, combined descending with
+    TwoSum so every bit lands in (hi, lo)."""
+    sh = sl = None
+    for o in range(order_max + 1):
+        for i in range(min(o + 1, len(vslices))):
+            j = o - i
+            if j >= len(mslices):
+                continue
+            p = _dot(vslices[i], mslices[j])
+            if sh is None:
+                sh, sl = p, jnp.zeros_like(p)
+            else:
+                sh, e = _two_sum(sh, p)
+                sl = sl + e
+    return sh, sl
+
+
+@dataclasses.dataclass(frozen=True)
+class DSMats:
+    """Plan-time sliced complex DFT matrix (f64 source)."""
+
+    n: int
+    beta: int
+    cr: tuple  # f32 slices of the real part
+    ci: tuple  # f32 slices of the imaginary part
+
+
+@functools.lru_cache(maxsize=32)
+def ds_c2c_mats(n: int, sign: int, scale: float = 1.0) -> DSMats:
+    """Sliced matrices for a complex length-``n`` DFT in f64, ``scale``
+    folded in before slicing (sign convention as ops.dft.c2c_mats:
+    BACKWARD = unnormalised inverse)."""
+    from .dft import BACKWARD
+    s = +1 if sign == BACKWARD else -1
+    k = np.arange(n)
+    ang = s * 2 * np.pi * np.outer(k, k) / n
+    beta = slice_beta(n)
+    return DSMats(n, beta,
+                  mat_slices_host(np.cos(ang) * scale, beta),
+                  mat_slices_host(np.sin(ang) * scale, beta))
+
+
+def ds_cdft_last(rh, rl, ih, il, m: DSMats):
+    """Complex DFT along the minor axis on double-single planar
+    channels: four exact-sliced real contractions plus double-single
+    complex combines. Returns (yrh, yrl, yih, yil)."""
+    vsr = ds_slices(rh, rl, m.beta)
+    vsi = ds_slices(ih, il, m.beta)
+    p_rr = ozaki_dot_last(vsr, m.cr)
+    p_ii = ozaki_dot_last(vsi, m.ci)
+    p_ri = ozaki_dot_last(vsr, m.ci)
+    p_ir = ozaki_dot_last(vsi, m.cr)
+    yrh, yrl = ds_add(*p_rr, *ds_neg(*p_ii))
+    yih, yil = ds_add(*p_ri, *p_ir)
+    return yrh, yrl, yih, yil
+
+
+def split_host_f64(x64: np.ndarray):
+    """Host f64 -> (hi, lo) f32 pair (exact: lo = x - f32(x))."""
+    hi = x64.astype(np.float32)
+    lo = (x64 - hi.astype(np.float64)).astype(np.float32)
+    return hi, lo
+
+
+def combine_host_f64(hi, lo) -> np.ndarray:
+    return np.asarray(hi, np.float64) + np.asarray(lo, np.float64)

@@ -181,6 +181,13 @@ class DistributedTransformPlan:
         self._rdt = real_dtype(precision)
         self._cdt = complex_dtype(precision)
         # Reduced wire precision (reference *_FLOAT exchanges, types.h:43-57):
+        if precision == "double" and jax.default_backend() == "tpu":
+            logger.warning(
+                "spfft_tpu: distributed precision='double' on a TPU "
+                "backend runs at FLOAT32 device precision (jax x64 is "
+                "unavailable on TPU; the on-device double-single mode "
+                "covers local C2C plans only) — use the CPU backend for "
+                "true f64 (docs/precision.md)")
         # one real dtype down from the transform precision.
         self._wire_dtype = None
         if self.exchange.float_wire:
@@ -273,9 +280,13 @@ class DistributedTransformPlan:
                 # SPMD kernel path (interpret-mode semantics on CPU)
                 and dist_plan.shard_plans[0].num_values
                 < PAIR_IO_THRESHOLD):
+            # device_double=False: the delegate must keep the distributed
+            # API contract (sharded f32 jax.Array outputs, pointwise fns)
+            # — the on-device double mode changes both (review r5)
             self._local1 = TransformPlan(dist_plan.shard_plans[0],
                                          precision=precision,
-                                         use_pallas=use_pallas)
+                                         use_pallas=use_pallas,
+                                         device_double=False)
         self._base_in_specs = (
             (P(self.axis_name),                       # data
              P(self.axis_name), P(self.axis_name),    # vi, slot_src

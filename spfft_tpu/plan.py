@@ -53,7 +53,8 @@ from .utils.dtypes import (as_interleaved, complex_dtype,
 
 
 def predicted_rel_error(precision: str, max_dim: int,
-                        mdft_covered: Optional[bool] = None) -> float:
+                        mdft_covered: Optional[bool] = None,
+                        device_double: bool = False) -> float:
     """Conservative predicted relative l2 error of a backward transform vs
     a dense f64 oracle, for uniform-magnitude (O(1) dynamic range) value
     sets.
@@ -90,6 +91,11 @@ def predicted_rel_error(precision: str, max_dim: int,
         if not mdft_covered:
             base *= 4.0  # uncalibrated jnp.fft path
         return base
+    if device_double:
+        # on-device double-single (ops/dsdft.py): exact-sliced dots with
+        # an ORDER_MAX drop floor ~2^-54 and double-single carries;
+        # measured pipeline ~1e-12-class (docs/precision.md round-5 rows)
+        return 2.0e-11 * shape
     return 5.0e-15 * shape  # f64 eps * same shape, ~10x headroom
 
 
@@ -104,25 +110,10 @@ class TransformPlan:
     def __init__(self, index_plan: IndexPlan, precision: str = "single",
                  use_pallas: Optional[bool] = None,
                  donate_inputs: bool = False,
-                 max_rel_error: Optional[float] = None):
+                 max_rel_error: Optional[float] = None,
+                 device_double: Optional[bool] = None):
         from .utils.platform import enable_persistent_compilation_cache
         enable_persistent_compilation_cache()
-        if max_rel_error is not None:
-            from .ops.dft import mdft_coverable
-            predicted = predicted_rel_error(
-                precision, max(index_plan.dim_x, index_plan.dim_y,
-                               index_plan.dim_z),
-                mdft_coverable((index_plan.dim_x, index_plan.dim_y,
-                                index_plan.dim_z), index_plan.hermitian))
-            if predicted > max_rel_error:
-                from .errors import PrecisionContractError
-                raise PrecisionContractError(
-                    f"precision='{precision}' predicts relative error "
-                    f"~{predicted:.1e} at dims ({index_plan.dim_x},"
-                    f"{index_plan.dim_y},{index_plan.dim_z}), above the "
-                    f"requested max_rel_error={max_rel_error:.1e} — use "
-                    f"precision='double' (CPU backend) for the reference's "
-                    f"f64 contract (docs/precision.md)")
         #: When True, the fused round-trip executables (apply_pointwise /
         #: iterate_pointwise) DONATE their values argument: the output has
         #: the same shape, so XLA aliases the input buffer into it, cutting
@@ -142,6 +133,61 @@ class TransformPlan:
         self._cdt = complex_dtype(precision)
         self._pair_io = index_plan.num_values >= PAIR_IO_THRESHOLD
         from .ops import dft as _dft
+        # On-device double: double-single (hi, lo) f32 channels through
+        # exact-sliced Ozaki dots (ops/dsdft.py) — ~1e-12 relative on the
+        # chip, where f64 arrays cannot even exist. C2C, direct-form
+        # axes. SPFFT_TPU_DEVICE_DOUBLE=0 restores the old behavior
+        # (CPU-backend f64; on a TPU session that silently truncated to
+        # f32 — the bug this mode replaces); =force enables off-TPU for
+        # tests.
+        import os as _os
+        _ds_env = _os.environ.get("SPFFT_TPU_DEVICE_DOUBLE", "")
+        self._ds = (precision == "double" and _ds_env != "0"
+                    and device_double is not False
+                    and not index_plan.hermitian
+                    and max(index_plan.dim_x, index_plan.dim_y,
+                            index_plan.dim_z) <= _dft.MATMUL_DFT_MAX
+                    and (_ds_env == "force"
+                         or jax.default_backend() == "tpu"))
+        if precision == "double" and not self._ds \
+                and device_double is not False \
+                and jax.default_backend() == "tpu":
+            # device_double=False callers (the distributed comm-size-1
+            # delegate) warn at their own layer with their own wording
+            why = ("SPFFT_TPU_DEVICE_DOUBLE=0 disabled it"
+                   if _ds_env == "0" else
+                   f"R2C, or an axis above {_dft.MATMUL_DFT_MAX}, "
+                   f"is outside the mode")
+            logger.warning(
+                "spfft_tpu: precision='double' on a TPU backend without "
+                "the on-device double mode (%s) runs at FLOAT32 device "
+                "precision — use the CPU backend (JAX_PLATFORMS=cpu, "
+                "jax x64) for true f64 (docs/precision.md)", why)
+        # the double-single pipeline has its own (N, 4) host-f64
+        # boundary; the planar pair layout never applies to it
+        if self._ds:
+            self._pair_io = False
+        if max_rel_error is not None:
+            from .ops.dft import mdft_coverable
+            predicted = predicted_rel_error(
+                precision, max(index_plan.dim_x, index_plan.dim_y,
+                               index_plan.dim_z),
+                mdft_coverable((index_plan.dim_x, index_plan.dim_y,
+                                index_plan.dim_z), index_plan.hermitian),
+                device_double=self._ds)
+            if predicted > max_rel_error:
+                from .errors import PrecisionContractError
+                hint = ("the CPU backend (JAX_PLATFORMS=cpu, jax x64) "
+                        "reaches f64 epsilon"
+                        if precision == "double" else
+                        "precision='double' (on-device double-single for "
+                        "C2C, CPU backend otherwise)")
+                raise PrecisionContractError(
+                    f"precision='{precision}' predicts relative error "
+                    f"~{predicted:.1e} at dims ({index_plan.dim_x},"
+                    f"{index_plan.dim_y},{index_plan.dim_z}), above the "
+                    f"requested max_rel_error={max_rel_error:.1e} — "
+                    f"{hint} (docs/precision.md)")
         #: Matmul-DFT (T-layout) pipeline: every DFT contracts the minor
         #: axis against plan-time matrices, the plane grid stays
         #: transposed (planes, x, y) through the y-stage, and the round
@@ -179,12 +225,14 @@ class TransformPlan:
         extra = self._s_pad - p.num_sticks
         pads = np.zeros(extra, np.int32)
         self._tables_hot = {}
-        if self._use_mdft:
+        if self._use_mdft or self._ds:
             self._tables_hot["col_inv_t"] = jnp.asarray(p.col_inv_t)
             self._tables_hot["scatter_cols_t"] = jnp.asarray(
                 np.concatenate([p.scatter_cols_t, pads]) if extra
                 else p.scatter_cols_t)
-        else:
+        if not self._use_mdft and not self._ds:
+            # (_ds reads only the T tables + the compression fallbacks;
+            # an unused pytree leaf would ship every call — see above)
             self._tables_hot["col_inv"] = jnp.asarray(p.col_inv)
             self._tables_hot["scatter_cols"] = jnp.asarray(
                 np.concatenate([p.scatter_cols, pads]) if extra
@@ -193,6 +241,18 @@ class TransformPlan:
             self._commit_fallback("dec")
             self._commit_fallback("cmp")
         self._init_split_x()
+        if self._ds:
+            from .ops import dsdft as _dsdft
+            gs = 1.0 / float(self.global_size)
+            self._ds_mats = {
+                "z_b": _dsdft.ds_c2c_mats(p.dim_z, _dft.BACKWARD),
+                "y_b": _dsdft.ds_c2c_mats(p.dim_y, _dft.BACKWARD),
+                "x_b": _dsdft.ds_c2c_mats(p.dim_x, _dft.BACKWARD),
+                "x_f": _dsdft.ds_c2c_mats(p.dim_x, _dft.FORWARD),
+                "y_f": _dsdft.ds_c2c_mats(p.dim_y, _dft.FORWARD),
+                "z_f": _dsdft.ds_c2c_mats(p.dim_z, _dft.FORWARD),
+                "z_fs": _dsdft.ds_c2c_mats(p.dim_z, _dft.FORWARD, gs),
+            }
         self._batched = None
         self._pair_jits = {}
         self._backward_jit = jax.jit(self._backward_impl)
@@ -407,6 +467,8 @@ class TransformPlan:
         if p.num_sticks == 0:
             return
         from .ops.dft import MATMUL_DFT_MAX
+        if self._ds:
+            return  # the double-single pipeline runs the dense path
         if self._use_mdft and p.dim_x > MATMUL_DFT_MAX:
             # the split-x contraction needs row/column-selected DIRECT
             # matrices; a two-stage x-axis runs dense instead
@@ -708,7 +770,57 @@ class TransformPlan:
             return stages.xy_backward_r2c(grid, p.dim_x)
         return complex_to_interleaved(stages.xy_backward_c2c(grid))
 
+    # -- on-device double (double-single channels, ops/dsdft.py) ------------
+    def _ds_backward_impl(self, values_il, tables):
+        """Backward on (N, 4) double-single channels [rh, rl, ih, il]:
+        gathers are dtype-agnostic row moves, every DFT stage is the
+        exact-sliced complex contraction, T-layout with one swap per
+        direction (same dataflow as the mdft pipeline). Returns the
+        (dim_z, dim_y, dim_x, 4) channel slab."""
+        from .ops import dsdft
+        p = self.index_plan
+        flat = stages.gather_rows_with_sentinel(values_il,
+                                                tables["slot_src"])
+        ch = tuple(flat[..., k].reshape(flat.shape[:-2]
+                                        + (p.num_sticks, p.dim_z))
+                   for k in range(4))
+        ch = dsdft.ds_cdft_last(*ch, self._ds_mats["z_b"])
+        ch = tuple(stages.sticks_to_grid(c, tables["col_inv_t"],
+                                         p.dim_x_freq, p.dim_y)
+                   for c in ch)
+        ch = dsdft.ds_cdft_last(*ch, self._ds_mats["y_b"])
+        ch = tuple(jnp.swapaxes(c, -1, -2) for c in ch)
+        ch = dsdft.ds_cdft_last(*ch, self._ds_mats["x_b"])
+        return jnp.stack(ch, axis=-1)
+
+    def _ds_forward_impl(self, space4, tables, scaled: bool):
+        """Forward mirror: (dim_z, dim_y, dim_x, 4) -> (N, 4), FULL
+        scaling folded into the f64 z matrix before slicing."""
+        from .ops import dsdft
+        ch = tuple(space4[..., k] for k in range(4))
+        ch = dsdft.ds_cdft_last(*ch, self._ds_mats["x_f"])
+        ch = tuple(jnp.swapaxes(c, -1, -2) for c in ch)
+        ch = dsdft.ds_cdft_last(*ch, self._ds_mats["y_f"])
+        ch = tuple(stages.grid_to_sticks(c, tables["scatter_cols_t"])
+                   for c in ch)
+        ch = dsdft.ds_cdft_last(*ch,
+                                self._ds_mats["z_fs" if scaled else "z_f"])
+        flat = jnp.stack([c.reshape(-1) for c in ch], axis=-1)
+        return flat[tables["value_indices"]]
+
+    def _ds_space_to_host(self, out) -> np.ndarray:
+        """(…, 4) channel slab -> host f64 interleaved (…, 2)."""
+        from .ops import dsdft
+        a = np.asarray(out)
+        return np.stack([dsdft.combine_host_f64(a[..., 0], a[..., 1]),
+                         dsdft.combine_host_f64(a[..., 2], a[..., 3])],
+                        axis=-1)
+
+    _ds_values_to_host = _ds_space_to_host  # same channel layout
+
     def _backward_impl(self, values_il, tables, *, pallas=True):
+        if self._ds:
+            return self._ds_backward_impl(values_il, tables)
         if self._use_mdft:
             sr, si = self._decompress_planar(values_il, tables, pallas)
             out = self._backward_rest_tp(sr, si, tables)
@@ -746,6 +858,8 @@ class TransformPlan:
         return stages.z_forward(sticks)
 
     def _forward_impl(self, space, tables, *, scaled: bool, pallas=True):
+        if self._ds:
+            return self._ds_forward_impl(space, tables, scaled)
         scale = 1.0 / self.global_size if scaled else None
         if self._use_mdft:  # planar pipeline, scale folded into z matrix
             sp = space if self._is_r2c else (space[..., 0], space[..., 1])
@@ -798,11 +912,17 @@ class TransformPlan:
         return values
 
     def _backward_impl_batched(self, values_b, tables):
+        if self._ds:
+            return jax.vmap(
+                lambda v: self._ds_backward_impl(v, tables))(values_b)
         sticks_b = self._decompress_batched(values_b, tables)
         return jax.vmap(self._backward_rest,
                         in_axes=(0, None))(sticks_b, tables)
 
     def _forward_impl_batched(self, space_b, tables, *, scaled: bool):
+        if self._ds:
+            return jax.vmap(lambda sp: self._ds_forward_impl(
+                sp, tables, scaled))(space_b)
         scale = 1.0 / self.global_size if scaled else None
         if self._use_mdft:
             sticks_b = jax.vmap(
@@ -838,7 +958,8 @@ class TransformPlan:
         complex or (B, num_values, 2) interleaved ((B, 2, num_values) for
         pair_values_io plans). Returns the (B, ...) stacked space-domain
         result in one fused execution."""
-        per = ((2, self.index_plan.num_values) if self._pair_io
+        per = ((self.index_plan.num_values, 4) if self._ds
+               else (2, self.index_plan.num_values) if self._pair_io
                else (self.index_plan.num_values, 2))
         batch = values_batch \
             if isinstance(values_batch, jax.Array) \
@@ -848,6 +969,8 @@ class TransformPlan:
         with timed_transform("backward_batched") as box:
             box.value = self._batched_jits()["backward"](batch,
                                                          self._tables_hot)
+            if self._ds:
+                box.value = self._ds_space_to_host(box.value)
         return box.value
 
     def forward_batched(self, space_batch, scaling: Scaling = Scaling.NONE):
@@ -863,10 +986,17 @@ class TransformPlan:
         with timed_transform("forward_batched") as box:
             box.value = self._batched_jits()[scaling](batch,
                                                       self._tables_hot)
+            if self._ds:
+                box.value = self._ds_values_to_host(box.value)
         return box.value
 
     # -- fused round trip ----------------------------------------------------
     def _pair_impl(self, values_il, tables, *fn_args, scaled, fn):
+        if self._ds:
+            # fn is rejected up front (apply_pointwise): a pointwise fn
+            # would run at f32 and silently break the double contract
+            space4 = self._ds_backward_impl(values_il, tables)
+            return self._ds_forward_impl(space4, tables, scaled)
         if self._use_mdft:
             # fully planar round trip; the space domain is materialised
             # in the public interleaved layout ONLY when a pointwise fn
@@ -913,6 +1043,13 @@ class TransformPlan:
         Returns the (num_values, 2) interleaved frequency values —
         (2, num_values) for pair_values_io plans."""
         scaling = Scaling(scaling)
+        if self._ds and fn is not None:
+            raise InvalidParameterError(
+                "on-device double plans fuse only the identity round "
+                "trip (fn=None): a pointwise fn would execute at f32 "
+                "and silently break the double contract — compose "
+                "backward / fn on the host f64 slab / forward instead "
+                "(docs/precision.md)")
         values_il = self._coerce_values(values)
         key = (fn, scaling)
         jitted = self._pair_jits.get(key)
@@ -925,6 +1062,8 @@ class TransformPlan:
         self._finalize()
         with timed_transform("apply_pointwise") as box:
             box.value = jitted(values_il, self._tables_hot, *fn_args)
+            if self._ds:
+                box.value = self._ds_values_to_host(box.value)
         return box.value
 
     def iterate_pointwise(self, values, fn, *fn_args, steps: int,
@@ -939,6 +1078,12 @@ class TransformPlan:
         grid size every step). Returns the final (num_values, 2) values.
         Cached per ``(fn, scaling, steps)``; pass a stable callable."""
         scaling = Scaling(scaling)
+        if self._ds:
+            raise InvalidParameterError(
+                "on-device double plans do not fuse iterate_pointwise "
+                "(the pointwise fn would execute at f32) — loop "
+                "apply_pointwise / backward+forward instead "
+                "(docs/precision.md)")
         # the scan carry dtype must match the step output (_rdt); coerce
         # up-front rather than per step
         values_il = self._coerce_values(values).astype(self._rdt)
@@ -974,6 +1119,8 @@ class TransformPlan:
         self._finalize()
         with timed_transform("backward") as box:
             box.value = self._backward_jit(values_il, self._tables_hot)
+            if self._ds:
+                box.value = self._ds_space_to_host(box.value)
         return box.value
 
     def forward(self, space, scaling: Scaling = Scaling.NONE):
@@ -986,11 +1133,36 @@ class TransformPlan:
         self._finalize()
         with timed_transform("forward") as box:
             box.value = self._forward_jit[scaling](space, self._tables_hot)
+            if self._ds:
+                box.value = self._ds_values_to_host(box.value)
         return box.value
 
     # -- input coercion ------------------------------------------------------
     def _coerce_values(self, values):
         N = self.index_plan.num_values
+        if self._ds:
+            from .ops.dsdft import split_host_f64
+            if isinstance(values, jax.Array) and values.ndim == 2 \
+                    and values.shape == (N, 4):
+                return values
+            arr = np.asarray(values)
+            if arr.shape == (N, 4) and not np.iscomplexobj(arr):
+                return jnp.asarray(
+                    np.ascontiguousarray(arr.astype(np.float32)))
+            if np.iscomplexobj(arr) and arr.shape == (N,):
+                re = arr.real.astype(np.float64)
+                im = arr.imag.astype(np.float64)
+            elif arr.shape == (N, 2):
+                re = arr[:, 0].astype(np.float64)
+                im = arr[:, 1].astype(np.float64)
+            else:
+                raise InvalidParameterError(
+                    f"expected {N} frequency values, got shape "
+                    f"{arr.shape}")
+            rh, rl = split_host_f64(re)
+            ih, il = split_host_f64(im)
+            return jnp.asarray(np.ascontiguousarray(
+                np.stack([rh, rl, ih, il], axis=-1)))
         if self._pair_io:
             # planar pair (2, N) device boundary (see pair_values_io)
             if isinstance(values, jax.Array):
@@ -1025,6 +1197,28 @@ class TransformPlan:
     def _coerce_space(self, space):
         p = self.index_plan
         shape3 = (self.local_z_length, p.dim_y, p.dim_x)
+        if self._ds:
+            from .ops.dsdft import split_host_f64
+            if isinstance(space, jax.Array) and space.shape == shape3 + (4,):
+                return space
+            arr = np.asarray(space)
+            if arr.shape == shape3 + (4,) and not np.iscomplexobj(arr):
+                return jnp.asarray(
+                    np.ascontiguousarray(arr.astype(np.float32)))
+            if np.iscomplexobj(arr) and arr.shape == shape3:
+                re = arr.real.astype(np.float64)
+                im = arr.imag.astype(np.float64)
+            elif arr.shape == shape3 + (2,):
+                re = arr[..., 0].astype(np.float64)
+                im = arr[..., 1].astype(np.float64)
+            else:
+                raise InvalidParameterError(
+                    f"expected space-domain slab {shape3} complex, "
+                    f"got {arr.shape}")
+            rh, rl = split_host_f64(re)
+            ih, il = split_host_f64(im)
+            return jnp.asarray(np.ascontiguousarray(
+                np.stack([rh, rl, ih, il], axis=-1)))
         if self._is_r2c:
             arr = space if isinstance(space, jax.Array) \
                 else np.asarray(space, self._rdt)

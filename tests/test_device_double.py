@@ -1,0 +1,200 @@
+"""On-device double precision (ops/dsdft.py + the plan's _ds mode).
+
+The CPU suite forces the mode with SPFFT_TPU_DEVICE_DOUBLE=force — the
+double-single arithmetic is pure f32 and bit-identical across backends;
+tests_tpu/ re-runs the oracle check on the real chip. Reference bar:
+f64 as the default precision with the 1e-6 oracle tolerance
+(reference: tests/test_util/test_check_values.hpp:46-50) — this mode
+measures ~1e-13-class, four orders below the 2e-11 contract envelope.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from spfft_tpu import Scaling, TransformType, make_local_plan
+from spfft_tpu.errors import InvalidParameterError
+from spfft_tpu.ops import dft, dsdft
+from spfft_tpu.plan import predicted_rel_error
+
+
+@pytest.fixture
+def force_ds(monkeypatch):
+    monkeypatch.setenv("SPFFT_TPU_DEVICE_DOUBLE", "force")
+
+
+def _sparse(n, rng, frac=0.4):
+    tr = np.stack(np.meshgrid(*[np.arange(n)] * 3, indexing="ij"),
+                  -1).reshape(-1, 3)
+    return tr[rng.uniform(size=len(tr)) < frac]
+
+
+def test_ds_cdft_matches_f64_oracle():
+    rng = np.random.default_rng(0)
+    for n in (13, 100, 256):
+        x = (rng.standard_normal((23, n))
+             + 1j * rng.standard_normal((23, n)))
+        m = dsdft.ds_c2c_mats(n, dft.FORWARD, 1.0 / n)
+        rh, rl = dsdft.split_host_f64(x.real)
+        ih, il = dsdft.split_host_f64(x.imag)
+        yrh, yrl, yih, yil = dsdft.ds_cdft_last(
+            *map(jnp.asarray, (rh, rl, ih, il)), m)
+        got = (dsdft.combine_host_f64(yrh, yrl)
+               + 1j * dsdft.combine_host_f64(yih, yil))
+        ref = np.fft.fft(x, axis=-1) / n
+        rel = np.linalg.norm(got - ref) / np.linalg.norm(ref)
+        assert rel < 5e-13, (n, rel)
+
+
+def test_two_sum_exact_under_jit():
+    """The Knuth TwoSum must survive jit + the algebraic simplifier
+    (the unbarriered form measured a 2.5e-8 plateau)."""
+    import jax
+    a = jnp.asarray([1.0, 1e-8, -1.0], jnp.float32)
+    b = jnp.asarray([1e-8, 1.0, 1.0000001], jnp.float32)
+    t, e = jax.jit(dsdft._two_sum)(a, b)
+    exact = (np.asarray(a, np.float64) + np.asarray(b, np.float64))
+    got = np.asarray(t, np.float64) + np.asarray(e, np.float64)
+    np.testing.assert_array_equal(got, exact)
+
+
+def test_full_plan_round_trip(force_ds):
+    rng = np.random.default_rng(1)
+    n = 12
+    tr = _sparse(n, rng)
+    plan = make_local_plan(TransformType.C2C, n, n, n, tr,
+                           precision="double")
+    assert plan._ds
+    vals = rng.standard_normal(len(tr)) + 1j * rng.standard_normal(len(tr))
+    space = plan.backward(vals)
+    assert space.dtype == np.float64
+    got = space[..., 0] + 1j * space[..., 1]
+    cube = np.zeros((n, n, n), np.complex128)
+    cube[tr[:, 2], tr[:, 1], tr[:, 0]] = vals
+    oracle = np.fft.ifftn(cube) * cube.size
+    assert np.linalg.norm(got - oracle) / np.linalg.norm(oracle) < 1e-13
+    out = plan.forward(space, Scaling.FULL)
+    gv = out[:, 0] + 1j * out[:, 1]
+    assert np.linalg.norm(gv - vals) / np.linalg.norm(vals) < 1e-13
+    fused = plan.apply_pointwise(vals, scaling=Scaling.FULL)
+    # fused skips the host combine/re-split between halves, so its ds
+    # channels are non-canonical: same f64 values to the slice-ladder
+    # floor (~2^-42), not bit-identical
+    np.testing.assert_allclose(fused, out, atol=1e-12, rtol=0)
+
+
+def test_centered_indexing_and_batched(force_ds):
+    rng = np.random.default_rng(2)
+    n = 10
+    tr = _sparse(n, rng)
+    trc = tr.copy()
+    trc[trc > n // 2] -= n
+    plan = make_local_plan(TransformType.C2C, n, n, n, trc,
+                           precision="double")
+    assert plan._ds
+    vals = [rng.standard_normal(len(tr)) + 1j * rng.standard_normal(len(tr))
+            for _ in range(2)]
+    stacked = plan.backward_batched(vals)
+    assert stacked.dtype == np.float64
+    for i, v in enumerate(vals):
+        single = plan.backward(v)
+        np.testing.assert_allclose(stacked[i], single, atol=1e-15, rtol=0)
+
+
+
+def test_ds_beats_single_by_orders_of_magnitude(force_ds):
+    """The point of the mode: same plan single vs double, > 1e4x."""
+    rng = np.random.default_rng(3)
+    n = 16
+    tr = _sparse(n, rng)
+    vals = rng.standard_normal(len(tr)) + 1j * rng.standard_normal(len(tr))
+    cube = np.zeros((n, n, n), np.complex128)
+    cube[tr[:, 2], tr[:, 1], tr[:, 0]] = vals
+    oracle = np.fft.ifftn(cube) * cube.size
+
+    def rel(precision):
+        plan = make_local_plan(TransformType.C2C, n, n, n, tr,
+                               precision=precision)
+        v = vals if precision == "double" else vals.astype(np.complex64)
+        s = np.asarray(plan.backward(v))
+        got = s[..., 0] + 1j * s[..., 1]
+        return np.linalg.norm(got - oracle) / np.linalg.norm(oracle)
+
+    assert rel("double") < 1e-13
+    assert rel("double") < rel("single") / 1e4
+
+
+def test_pointwise_fn_rejected_with_guidance(force_ds):
+    rng = np.random.default_rng(4)
+    n = 8
+    tr = _sparse(n, rng)
+    plan = make_local_plan(TransformType.C2C, n, n, n, tr,
+                           precision="double")
+    assert plan._ds
+    vals = rng.standard_normal(len(tr)) + 1j * rng.standard_normal(len(tr))
+    with pytest.raises(InvalidParameterError, match="f32"):
+        plan.apply_pointwise(vals, lambda s: s)
+    with pytest.raises(InvalidParameterError, match="f32"):
+        plan.iterate_pointwise(vals, lambda s: s, steps=2)
+
+
+def test_gating(force_ds, monkeypatch):
+    rng = np.random.default_rng(5)
+    n = 8
+    tr = _sparse(n, rng)
+    # R2C keeps the CPU-backend contract (half-spectrum DS not built)
+    trh = tr[tr[:, 0] <= n // 2]
+    plan = make_local_plan(TransformType.R2C, n, n, n, trh,
+                           precision="double")
+    assert not plan._ds
+    # kill switch
+    monkeypatch.setenv("SPFFT_TPU_DEVICE_DOUBLE", "0")
+    plan = make_local_plan(TransformType.C2C, n, n, n, tr,
+                           precision="double")
+    assert not plan._ds
+
+
+def test_precision_model_covers_ds():
+    # the device-double envelope sits between single and CPU f64 and
+    # accepts the 1e-10 class the verdict asked for
+    assert predicted_rel_error("double", 256, device_double=True) < 1e-10
+    assert predicted_rel_error("double", 256, device_double=True) \
+        > predicted_rel_error("double", 256)
+    assert predicted_rel_error("double", 256, device_double=True) \
+        < predicted_rel_error("single", 256)
+
+
+def test_ds_disables_pair_io(force_ds, monkeypatch):
+    """The double-single (N, 4) host-f64 boundary replaces the planar
+    pair layout — pair_values_io must report False however large the
+    plan (review r5)."""
+    import spfft_tpu.plan as plan_mod
+    monkeypatch.setattr(plan_mod, "PAIR_IO_THRESHOLD", 1)
+    rng = np.random.default_rng(6)
+    n = 8
+    tr = _sparse(n, rng)
+    plan = make_local_plan(TransformType.C2C, n, n, n, tr,
+                           precision="double")
+    assert plan._ds and not plan.pair_values_io
+    vals = rng.standard_normal(len(tr)) + 1j * rng.standard_normal(len(tr))
+    out = plan.forward(plan.backward(vals), Scaling.FULL)
+    assert out.shape == (len(tr), 2) and out.dtype == np.float64
+
+
+def test_dist_comm1_delegate_keeps_contract(force_ds):
+    """The distributed comm-size-1 local delegate must NOT engage the
+    on-device double mode: the distributed API promises sharded device
+    arrays and pointwise fns (review r5)."""
+    from spfft_tpu.parallel import make_distributed_plan, make_mesh
+    rng = np.random.default_rng(7)
+    n = 8
+    tr = _sparse(n, rng)
+    plan = make_distributed_plan(TransformType.C2C, n, n, n, [tr], [n],
+                                 mesh=make_mesh(1), precision="double")
+    if plan._local1 is not None:
+        assert not plan._local1._ds
+    vals = [rng.standard_normal(len(tr))
+            + 1j * rng.standard_normal(len(tr))]
+    out = plan.apply_pointwise(vals, lambda s: s)  # fn must still work
+    assert out is not None
