@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Round-5 single-chip envelope: the fused identity pair at each grid
+size, measured with the sync-robust median estimator (the ≥320³ rows of
+the round-4 table used probe-amortised timing — VERDICT r4 weak #5).
+
+Usage: DIMS="320 384 512 768" python scripts/envelope_r05.py
+Large grids build multi-minute plans; each dim runs in-process
+sequentially with progress marks so a stall is attributable.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+
+from spfft_tpu import TransformType, make_local_plan
+from spfft_tpu.utils.benchtime import diff_estimate_seconds
+from spfft_tpu.utils.workloads import spherical_cutoff_triplets
+
+
+def sync_one(out):
+    first = out[(0,) * (out.ndim - 1)][:1]
+    return float(np.asarray(first).ravel()[0])
+
+
+def main():
+    dims = [int(d) for d in os.environ.get("DIMS", "320 384 512").split()]
+    reps = int(os.environ.get("REPS", "12"))
+    print(f"devices: {jax.devices()}", flush=True)
+    for n in dims:
+        t0 = time.perf_counter()
+        triplets = spherical_cutoff_triplets(n)
+        rng = np.random.default_rng(42)
+        values = (rng.uniform(-1, 1, len(triplets))
+                  + 1j * rng.uniform(-1, 1, len(triplets))
+                  ).astype(np.complex64)
+        print(f"[{n}] triplets {len(triplets)} "
+              f"({time.perf_counter()-t0:.0f}s)", flush=True)
+        t0 = time.perf_counter()
+        try:
+            plan = make_local_plan(TransformType.C2C, n, n, n, triplets,
+                                   precision="single")
+            vil = jax.device_put(plan._coerce_values(values))
+            out = plan.apply_pointwise(vil)
+            sync_one(out)
+        except Exception as exc:
+            print(f"[{n}] FAILED: {type(exc).__name__}: "
+                  f"{str(exc)[:300]}", flush=True)
+            continue
+        print(f"[{n}] plan+compile {time.perf_counter()-t0:.0f}s "
+              f"(pallas={plan._pallas_active} pair_io={plan.pair_values_io}"
+              f" mdft={plan._use_mdft})", flush=True)
+
+        def grp(g):
+            t0 = time.perf_counter()
+            o = None
+            for _ in range(g):
+                o = plan.apply_pointwise(vil)
+            sync_one(o)
+            return time.perf_counter() - t0
+
+        est = diff_estimate_seconds(grp, reps=reps)
+        gbs = ((2 * plan.index_plan.num_values
+                + 8 * plan.index_plan.num_sticks * n + 6 * n ** 3) * 8
+               / est.seconds / 1e9)
+        print(f"[{n}] pair {est.seconds*1e3:.2f} ms  ({est.label})  "
+              f"effective {gbs:.0f} GB/s", flush=True)
+
+
+if __name__ == "__main__":
+    main()
