@@ -22,7 +22,7 @@ CAPI_SO     := lib/libspfft_tpu.so
 # capi build, C feature drive, Fortran-width execution and the in-suite
 # multihost smoke), the compiled C example, the standalone 2-process
 # multihost smoke, and the precision matrix in CPU mode. Record with
-#   make ci 2>&1 | tee docs/ci_r04.log
+#   make ci 2>&1 | tee docs/ci_r05.log
 ci: native capi
 	@echo "== CI 1/4: test suite (CPU, virtual 8-device mesh) =="
 	python -m pytest tests/ -q
