@@ -113,7 +113,12 @@ def ds_slices(hi, lo, beta: int, s: int = VALUE_SLICES):
     # under ladder depth, on cancellation-heavy forward grids)
     bits = jax.lax.bitcast_convert_type(
         jnp.maximum(mx, np.float32(1e-30)).astype(jnp.float32), jnp.int32)
-    expo = jnp.clip((bits >> 23) & 0xFF, 1, 250)
+    # lower exponent clamp at 64 (e0 >= 2^-61): an all-zero row (the
+    # r2c sin matrix guarantees one) would otherwise anchor at ~2^-98,
+    # whose deepest inverse scale 2^(98+beta*s) OVERFLOWS f32 and turns
+    # the row into 0*inf = NaN. Rows truly below 2^-61 still slice on
+    # the clamped ladder down to ~2^-103.
+    expo = jnp.clip((bits >> 23) & 0xFF, 64, 250)
     e0 = jax.lax.bitcast_convert_type((expo + 2) << 23, jnp.float32)
     e0 = jax.lax.optimization_barrier(e0)
     inv0 = 1.0 / e0  # exact: e0 is a power of two
@@ -193,6 +198,55 @@ def ds_c2c_mats(n: int, sign: int, scale: float = 1.0) -> DSMats:
     return DSMats(n, beta,
                   mat_slices_host(np.cos(ang) * scale, beta),
                   mat_slices_host(np.sin(ang) * scale, beta))
+
+
+@functools.lru_cache(maxsize=32)
+def ds_r2c_mats(n: int, scale: float = 1.0) -> DSMats:
+    """Sliced forward real-to-halfspectrum matrices in f64 (the DS twin
+    of ops.dft._rdft_mats): Yr = X @ cr, Yi = X @ ci with the reference
+    rfft layout (dim_x_freq = n//2+1)."""
+    xf = n // 2 + 1
+    ang = 2 * np.pi * np.outer(np.arange(n), np.arange(xf)) / n
+    beta = slice_beta(n)
+    return DSMats(n, beta, mat_slices_host(np.cos(ang) * scale, beta),
+                  mat_slices_host(-np.sin(ang) * scale, beta))
+
+
+@functools.lru_cache(maxsize=32)
+def ds_c2r_mats(n: int, scale: float = 1.0) -> DSMats:
+    """Sliced halfspectrum-to-real matrices in f64 (DS twin of
+    ops.dft._irdft_mats): x = Yr @ cr + Yi @ ci, hermitian doubling
+    folded into the matrices (w = 1 on self-conjugate bins, 2
+    otherwise) — no complex op and no XLA C2R involved."""
+    xf = n // 2 + 1
+    k = np.arange(xf)
+    w = np.full(xf, 2.0)
+    w[0] = 1.0
+    if n % 2 == 0:
+        w[-1] = 1.0
+    ang = 2 * np.pi * np.outer(k, np.arange(n)) / n
+    beta = slice_beta(n)
+    return DSMats(n, beta,
+                  mat_slices_host(w[:, None] * np.cos(ang) * scale, beta),
+                  mat_slices_host(w[:, None] * -np.sin(ang) * scale, beta))
+
+
+def ds_rdft_last(xh, xl, m: DSMats):
+    """Real forward DFT along the minor axis on a double-single channel
+    -> planar half-spectrum ds channels (two exact-sliced contractions —
+    half the dots of the complex form)."""
+    vs = ds_slices(xh, xl, m.beta)
+    yr = ozaki_dot_last(vs, m.cr)
+    yi = ozaki_dot_last(vs, m.ci)
+    return (*yr, *yi)
+
+
+def ds_irdft_last(rh, rl, ih, il, m: DSMats):
+    """Planar half-spectrum ds channels -> real inverse along the minor
+    axis: x = Yr @ cr + Yi @ ci with a double-single combine."""
+    vr = ds_slices(rh, rl, m.beta)
+    vi = ds_slices(ih, il, m.beta)
+    return ds_add(*ozaki_dot_last(vr, m.cr), *ozaki_dot_last(vi, m.ci))
 
 
 def ds_cdft_last(rh, rl, ih, il, m: DSMats):

@@ -135,8 +135,9 @@ class TransformPlan:
         from .ops import dft as _dft
         # On-device double: double-single (hi, lo) f32 channels through
         # exact-sliced Ozaki dots (ops/dsdft.py) — ~1e-12 relative on the
-        # chip, where f64 arrays cannot even exist. C2C, direct-form
-        # axes. SPFFT_TPU_DEVICE_DOUBLE=0 restores the old behavior
+        # chip, where f64 arrays cannot even exist. C2C and R2C,
+        # direct-form axes. SPFFT_TPU_DEVICE_DOUBLE=0 restores the old
+        # behavior
         # (CPU-backend f64; on a TPU session that silently truncated to
         # f32 — the bug this mode replaces); =force enables off-TPU for
         # tests.
@@ -144,7 +145,6 @@ class TransformPlan:
         _ds_env = _os.environ.get("SPFFT_TPU_DEVICE_DOUBLE", "")
         self._ds = (precision == "double" and _ds_env != "0"
                     and device_double is not False
-                    and not index_plan.hermitian
                     and max(index_plan.dim_x, index_plan.dim_y,
                             index_plan.dim_z) <= _dft.MATMUL_DFT_MAX
                     and (_ds_env == "force"
@@ -156,8 +156,8 @@ class TransformPlan:
             # delegate) warn at their own layer with their own wording
             why = ("SPFFT_TPU_DEVICE_DOUBLE=0 disabled it"
                    if _ds_env == "0" else
-                   f"R2C, or an axis above {_dft.MATMUL_DFT_MAX}, "
-                   f"is outside the mode")
+                   f"an axis above {_dft.MATMUL_DFT_MAX} is outside "
+                   f"the mode")
             logger.warning(
                 "spfft_tpu: precision='double' on a TPU backend without "
                 "the on-device double mode (%s) runs at FLOAT32 device "
@@ -180,8 +180,8 @@ class TransformPlan:
                 hint = ("the CPU backend (JAX_PLATFORMS=cpu, jax x64) "
                         "reaches f64 epsilon"
                         if precision == "double" else
-                        "precision='double' (on-device double-single for "
-                        "C2C, CPU backend otherwise)")
+                        "precision='double' (on-device double-single "
+                        "for axes <= 512, CPU backend otherwise)")
                 raise PrecisionContractError(
                     f"precision='{precision}' predicts relative error "
                     f"~{predicted:.1e} at dims ({index_plan.dim_x},"
@@ -244,11 +244,16 @@ class TransformPlan:
         if self._ds:
             from .ops import dsdft as _dsdft
             gs = 1.0 / float(self.global_size)
+            herm = index_plan.hermitian
             self._ds_mats = {
                 "z_b": _dsdft.ds_c2c_mats(p.dim_z, _dft.BACKWARD),
                 "y_b": _dsdft.ds_c2c_mats(p.dim_y, _dft.BACKWARD),
-                "x_b": _dsdft.ds_c2c_mats(p.dim_x, _dft.BACKWARD),
-                "x_f": _dsdft.ds_c2c_mats(p.dim_x, _dft.FORWARD),
+                # hermitian x-stages are the REAL half-spectrum forms
+                # (hermitian doubling folded into the c2r matrices)
+                "x_b": (_dsdft.ds_c2r_mats(p.dim_x) if herm
+                        else _dsdft.ds_c2c_mats(p.dim_x, _dft.BACKWARD)),
+                "x_f": (_dsdft.ds_r2c_mats(p.dim_x) if herm
+                        else _dsdft.ds_c2c_mats(p.dim_x, _dft.FORWARD)),
                 "y_f": _dsdft.ds_c2c_mats(p.dim_y, _dft.FORWARD),
                 "z_f": _dsdft.ds_c2c_mats(p.dim_z, _dft.FORWARD),
                 "z_fs": _dsdft.ds_c2c_mats(p.dim_z, _dft.FORWARD, gs),
@@ -771,6 +776,26 @@ class TransformPlan:
         return complex_to_interleaved(stages.xy_backward_c2c(grid))
 
     # -- on-device double (double-single channels, ops/dsdft.py) ------------
+    @staticmethod
+    def _ds_complete(ch, idx):
+        """Hermitian completion of ``ch[..., idx, :]`` along the minor
+        axis on double-single channels [rh, rl, ih, il]: where an
+        element was not supplied (all four channels zero), fill the
+        conj reflection — sign-flipped on the imaginary channels; hi
+        and lo transform identically (the DS twin of
+        stages.complete_stick_hermitian / the x=0 plane completion)."""
+        rows = tuple(c[..., idx, :] for c in ch)
+        nz = (rows[0] != 0) | (rows[1] != 0) \
+            | (rows[2] != 0) | (rows[3] != 0)
+
+        def refl(v):
+            return jnp.roll(v[..., ::-1], 1, axis=-1)
+
+        return tuple(
+            c.at[..., idx, :].set(jnp.where(
+                nz, r, refl(r) if k < 2 else -refl(r)))
+            for k, (c, r) in enumerate(zip(ch, rows)))
+
     def _ds_backward_impl(self, values_il, tables):
         """Backward on (N, 4) double-single channels [rh, rl, ih, il]:
         gathers are dtype-agnostic row moves, every DFT stage is the
@@ -784,12 +809,22 @@ class TransformPlan:
         ch = tuple(flat[..., k].reshape(flat.shape[:-2]
                                         + (p.num_sticks, p.dim_z))
                    for k in range(4))
+        if self._is_r2c and p.zero_stick_id is not None:
+            # complete the (0,0) stick (conj reflection = sign flip on
+            # the imaginary channels) — hi and lo transform identically
+            ch = self._ds_complete(ch, p.zero_stick_id)
         ch = dsdft.ds_cdft_last(*ch, self._ds_mats["z_b"])
         ch = tuple(stages.sticks_to_grid(c, tables["col_inv_t"],
                                          p.dim_x_freq, p.dim_y)
                    for c in ch)
+        if self._is_r2c:
+            # complete the x=0 sub-plane along y (minor axis in T layout)
+            ch = self._ds_complete(ch, 0)
         ch = dsdft.ds_cdft_last(*ch, self._ds_mats["y_b"])
         ch = tuple(jnp.swapaxes(c, -1, -2) for c in ch)
+        if self._is_r2c:
+            oh, ol = dsdft.ds_irdft_last(*ch, self._ds_mats["x_b"])
+            return jnp.stack([oh, ol], axis=-1)
         ch = dsdft.ds_cdft_last(*ch, self._ds_mats["x_b"])
         return jnp.stack(ch, axis=-1)
 
@@ -797,8 +832,12 @@ class TransformPlan:
         """Forward mirror: (dim_z, dim_y, dim_x, 4) -> (N, 4), FULL
         scaling folded into the f64 z matrix before slicing."""
         from .ops import dsdft
-        ch = tuple(space4[..., k] for k in range(4))
-        ch = dsdft.ds_cdft_last(*ch, self._ds_mats["x_f"])
+        if self._is_r2c:
+            ch = dsdft.ds_rdft_last(space4[..., 0], space4[..., 1],
+                                    self._ds_mats["x_f"])
+        else:
+            ch = dsdft.ds_cdft_last(*(space4[..., k] for k in range(4)),
+                                    self._ds_mats["x_f"])
         ch = tuple(jnp.swapaxes(c, -1, -2) for c in ch)
         ch = dsdft.ds_cdft_last(*ch, self._ds_mats["y_f"])
         ch = tuple(stages.grid_to_sticks(c, tables["scatter_cols_t"])
@@ -809,9 +848,12 @@ class TransformPlan:
         return flat[tables["value_indices"]]
 
     def _ds_space_to_host(self, out) -> np.ndarray:
-        """(…, 4) channel slab -> host f64 interleaved (…, 2)."""
+        """Channel slab -> host f64: (…, 4) -> interleaved (…, 2), or
+        the R2C real slab (…, 2) [hi, lo] -> real (…,)."""
         from .ops import dsdft
         a = np.asarray(out)
+        if a.shape[-1] == 2:  # real (hi, lo)
+            return dsdft.combine_host_f64(a[..., 0], a[..., 1])
         return np.stack([dsdft.combine_host_f64(a[..., 0], a[..., 1]),
                          dsdft.combine_host_f64(a[..., 2], a[..., 3])],
                         axis=-1)
@@ -978,10 +1020,21 @@ class TransformPlan:
         execution. Returns (B, num_values, 2) interleaved values —
         (B, 2, num_values) for pair_values_io plans."""
         scaling = Scaling(scaling)
-        batch = jnp.stack([self._coerce_space(s) for s in space_batch]) \
-            if not (isinstance(space_batch, jax.Array)
-                    and space_batch.ndim
-                    == (4 if self._is_r2c else 5)) else space_batch
+        if self._ds:
+            # coerced DS slabs always carry a trailing channel axis:
+            # (B, z, y, x, 2) hi/lo for R2C, (B, z, y, x, 4) for C2C —
+            # a raw real R2C batch is also ndim 4, so the channel count
+            # must be checked, not just the rank
+            nch = 2 if self._is_r2c else 4
+            coerced = (isinstance(space_batch, jax.Array)
+                       and space_batch.ndim == 5
+                       and space_batch.shape[-1] == nch)
+        else:
+            coerced = (isinstance(space_batch, jax.Array)
+                       and space_batch.ndim
+                       == (4 if self._is_r2c else 5))
+        batch = space_batch if coerced else jnp.stack(
+            [self._coerce_space(s) for s in space_batch])
         self._finalize()
         with timed_transform("forward_batched") as box:
             box.value = self._batched_jits()[scaling](batch,
@@ -1199,12 +1252,22 @@ class TransformPlan:
         shape3 = (self.local_z_length, p.dim_y, p.dim_x)
         if self._ds:
             from .ops.dsdft import split_host_f64
-            if isinstance(space, jax.Array) and space.shape == shape3 + (4,):
+            nch = 2 if self._is_r2c else 4
+            if isinstance(space, jax.Array) \
+                    and space.shape == shape3 + (nch,):
                 return space
             arr = np.asarray(space)
-            if arr.shape == shape3 + (4,) and not np.iscomplexobj(arr):
+            if arr.shape == shape3 + (nch,) and not np.iscomplexobj(arr):
                 return jnp.asarray(
                     np.ascontiguousarray(arr.astype(np.float32)))
+            if self._is_r2c:
+                if arr.shape != shape3 or np.iscomplexobj(arr):
+                    raise InvalidParameterError(
+                        f"expected real space-domain slab {shape3}, "
+                        f"got {arr.shape}")
+                rh, rl = split_host_f64(arr.astype(np.float64))
+                return jnp.asarray(np.ascontiguousarray(
+                    np.stack([rh, rl], axis=-1)))
             if np.iscomplexobj(arr) and arr.shape == shape3:
                 re = arr.real.astype(np.float64)
                 im = arr.imag.astype(np.float64)

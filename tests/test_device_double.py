@@ -143,16 +143,63 @@ def test_gating(force_ds, monkeypatch):
     rng = np.random.default_rng(5)
     n = 8
     tr = _sparse(n, rng)
-    # R2C keeps the CPU-backend contract (half-spectrum DS not built)
-    trh = tr[tr[:, 0] <= n // 2]
-    plan = make_local_plan(TransformType.R2C, n, n, n, trh,
-                           precision="double")
-    assert not plan._ds
     # kill switch
     monkeypatch.setenv("SPFFT_TPU_DEVICE_DOUBLE", "0")
     plan = make_local_plan(TransformType.C2C, n, n, n, tr,
                            precision="double")
     assert not plan._ds
+
+
+def test_r2c_full_half_spectrum(force_ds):
+    """R2C on-device double: full half-spectrum set vs an f64 field
+    oracle, both directions."""
+    rng = np.random.default_rng(8)
+    n = 10
+    field = rng.standard_normal((n, n, n))
+    freq = np.fft.fftn(field)
+    tr = np.asarray([(x, y, z) for x in range(n // 2 + 1)
+                     for y in range(n) for z in range(n)], np.int64)
+    vals = freq[tr[:, 2], tr[:, 1], tr[:, 0]]
+    plan = make_local_plan(TransformType.R2C, n, n, n, tr,
+                           precision="double")
+    assert plan._ds
+    space = plan.backward(vals)
+    assert space.dtype == np.float64 and space.shape == (n, n, n)
+    rel = (np.linalg.norm(space - field * field.size)
+           / np.linalg.norm(field * field.size))
+    assert rel < 1e-13, rel
+    out = plan.forward(space, Scaling.FULL)
+    gv = out[:, 0] + 1j * out[:, 1]
+    # self-conjugate bins round-trip to Re(v) (docs/precision.md) — the
+    # oracle set is hermitian-consistent, so exact recovery holds
+    rel = np.linalg.norm(gv - vals) / np.linalg.norm(vals)
+    assert rel < 1e-13, rel
+
+
+def test_r2c_zero_stick_completion(force_ds):
+    """R2C DS with only the non-negative-z half of the (0,0) stick
+    supplied: the completion must reconstruct the mirrored half (the
+    reference StickSymmetry semantics)."""
+    rng = np.random.default_rng(9)
+    n = 8
+    field = rng.standard_normal((n, n, n))
+    freq = np.fft.fftn(field)
+    tr = []
+    for x in range(n // 2 + 1):
+        for y in range(n):
+            for z in range(n):
+                if x == 0 and y == 0 and z > n // 2:
+                    continue  # drop the mirrored half of the (0,0) stick
+                tr.append((x, y, z))
+    tr = np.asarray(tr, np.int64)
+    vals = freq[tr[:, 2], tr[:, 1], tr[:, 0]]
+    plan = make_local_plan(TransformType.R2C, n, n, n, tr,
+                           precision="double")
+    assert plan._ds and plan.index_plan.zero_stick_id is not None
+    space = plan.backward(vals)
+    rel = (np.linalg.norm(space - field * field.size)
+           / np.linalg.norm(field * field.size))
+    assert rel < 1e-13, rel
 
 
 def test_precision_model_covers_ds():
