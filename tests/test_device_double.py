@@ -245,3 +245,30 @@ def test_dist_comm1_delegate_keeps_contract(force_ds):
             + 1j * rng.standard_normal(len(tr))]
     out = plan.apply_pointwise(vals, lambda s: s)  # fn must still work
     assert out is not None
+
+
+def test_ds_dynamic_range(force_ds):
+    """Adversarial 1e±6 value magnitudes (the reference-contract
+    adversarial case, docs/precision.md): the PER-ROW slice ladders
+    must keep relative l2 inside the 2e-11 contract envelope even when
+    spectra concentrate (the global-anchor design measured 2.5e-8 on
+    exactly this failure shape)."""
+    rng = np.random.default_rng(10)
+    n = 12
+    tr = _sparse(n, rng)
+    mags = 10.0 ** rng.uniform(-6, 6, len(tr))
+    vals = mags * np.exp(2j * np.pi * rng.uniform(size=len(tr)))
+    plan = make_local_plan(TransformType.C2C, n, n, n, tr,
+                           precision="double")
+    assert plan._ds
+    space = plan.backward(vals)
+    got = space[..., 0] + 1j * space[..., 1]
+    cube = np.zeros((n, n, n), np.complex128)
+    cube[tr[:, 2], tr[:, 1], tr[:, 0]] = vals
+    oracle = np.fft.ifftn(cube) * cube.size
+    rel = np.linalg.norm(got - oracle) / np.linalg.norm(oracle)
+    assert rel < 2e-11, rel
+    out = plan.forward(space, Scaling.FULL)
+    gv = out[:, 0] + 1j * out[:, 1]
+    rel = np.linalg.norm(gv - vals) / np.linalg.norm(vals)
+    assert rel < 2e-11, rel
