@@ -113,12 +113,17 @@ def ds_slices(hi, lo, beta: int, s: int = VALUE_SLICES):
     # under ladder depth, on cancellation-heavy forward grids)
     bits = jax.lax.bitcast_convert_type(
         jnp.maximum(mx, np.float32(1e-30)).astype(jnp.float32), jnp.int32)
-    # lower exponent clamp at 64 (e0 >= 2^-61): an all-zero row (the
-    # r2c sin matrix guarantees one) would otherwise anchor at ~2^-98,
-    # whose deepest inverse scale 2^(98+beta*s) OVERFLOWS f32 and turns
-    # the row into 0*inf = NaN. Rows truly below 2^-61 still slice on
-    # the clamped ladder down to ~2^-103.
-    expo = jnp.clip((bits >> 23) & 0xFF, 64, 250)
+    # Lower exponent clamp: an all-zero row (the r2c sin matrix
+    # guarantees one; so do pad rows) would anchor at ~2^-98, whose
+    # deepest inverse scale 2^(98+beta*s) OVERFLOWS f32 and turns the
+    # row into 0*inf = NaN. The bound must track the LADDER DEPTH:
+    # log2(inv_deepest) = 125 - expo + beta*s <= 126, i.e.
+    # expo >= beta*s - 1 — a fixed 64 was sized for beta=6 and
+    # overflowed again at the beta=10 short axes the randomized sweep
+    # found (NaN only on all-zero rows). Real rows anchored above the
+    # clamp are unaffected; tinier ones still slice ~70 bits down.
+    expo_min = max(64, beta * s - 1)
+    expo = jnp.clip((bits >> 23) & 0xFF, expo_min, 250)
     e0 = jax.lax.bitcast_convert_type((expo + 2) << 23, jnp.float32)
     e0 = jax.lax.optimization_barrier(e0)
     inv0 = 1.0 / e0  # exact: e0 is a power of two

@@ -67,3 +67,44 @@ def test_distributed_round_trip_property(seed):
         plan.apply_pointwise(values, scaling=Scaling.FULL))
     for g, v in zip(got, values):
         np.testing.assert_allclose(g, v, atol=1e-10, rtol=0)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_local_round_trip_property_device_double(seed, monkeypatch):
+    """The on-device double pipeline over the same randomized space
+    (degenerate dims of 1, primes, sparse/dense, C2C and R2C, centered
+    and positive): forward(backward(v), FULL) == v within the 2e-11
+    contract envelope."""
+    monkeypatch.setenv("SPFFT_TPU_DEVICE_DOUBLE", "force")
+    rng = np.random.default_rng(3000 + seed)
+    dims = tuple(int(d) for d in rng.integers(1, 20, 3))
+    r2c = bool(rng.integers(0, 2)) and dims[0] > 1
+    if r2c:
+        triplets = hermitian_triplets(rng, dims)
+        ttype = TransformType.R2C
+    else:
+        triplets = random_sparse_triplets(rng, dims)
+        if rng.integers(0, 2):
+            triplets = center_triplets(triplets, dims)
+        ttype = TransformType.C2C
+    if len(triplets) == 0:
+        pytest.skip("degenerate empty set")
+    plan = make_local_plan(ttype, *dims, triplets, precision="double")
+    assert plan._ds
+    vals = random_values(rng, len(triplets)).astype(np.complex128)
+    space = plan.backward(vals)
+    out = plan.forward(space, Scaling.FULL)
+    got = as_complex_np(out)
+    assert np.linalg.norm(got) > 0  # a zeroed forward must not pass
+    if r2c:
+        # self-conjugate bins recover Re(v) (docs/precision.md); compare
+        # through a second round trip, which must be a fixed point
+        space2 = plan.backward(got)
+        out2 = plan.forward(space2, Scaling.FULL)
+        got2 = as_complex_np(out2)
+        ref = got
+    else:
+        got2, ref = got, vals
+    rel = (np.linalg.norm(got2 - ref)
+           / max(np.linalg.norm(ref), 1e-30))
+    assert rel < 2e-11, (dims, ttype, rel)
