@@ -181,13 +181,15 @@ class DistributedTransformPlan:
         self._rdt = real_dtype(precision)
         self._cdt = complex_dtype(precision)
         # Reduced wire precision (reference *_FLOAT exchanges, types.h:43-57):
-        if precision == "double" and jax.default_backend() == "tpu":
+        if precision == "double" and (jax.default_backend() == "tpu"
+                                      or not jax.config.jax_enable_x64):
             logger.warning(
-                "spfft_tpu: distributed precision='double' on a TPU "
-                "backend runs at FLOAT32 device precision (jax x64 is "
-                "unavailable on TPU; the on-device double-single mode "
-                "covers local C2C plans only) — use the CPU backend for "
-                "true f64 (docs/precision.md)")
+                "spfft_tpu: distributed precision='double' without jax "
+                "x64 runs at FLOAT32 device precision (x64 is "
+                "unavailable on TPU, and off by default on CPU; the "
+                "on-device double-single mode covers local plans only) "
+                "— use the CPU backend with JAX_ENABLE_X64=1 for true "
+                "f64 (docs/precision.md)")
         # one real dtype down from the transform precision.
         self._wire_dtype = None
         if self.exchange.float_wire:

@@ -151,18 +151,25 @@ class TransformPlan:
                          or jax.default_backend() == "tpu"))
         if precision == "double" and not self._ds \
                 and device_double is not False \
-                and jax.default_backend() == "tpu":
+                and (jax.default_backend() == "tpu"
+                     or not jax.config.jax_enable_x64):
             # device_double=False callers (the distributed comm-size-1
-            # delegate) warn at their own layer with their own wording
-            why = ("SPFFT_TPU_DEVICE_DOUBLE=0 disabled it"
-                   if _ds_env == "0" else
-                   f"an axis above {_dft.MATMUL_DFT_MAX} is outside "
-                   f"the mode")
+            # delegate) warn at their own layer with their own wording.
+            # The CPU-without-x64 case is the same silent trap: JAX
+            # truncates every f64 array to f32 with only a UserWarning.
+            if jax.default_backend() != "tpu":
+                why = "jax x64 is not enabled on this CPU backend"
+            elif _ds_env == "0":
+                why = "SPFFT_TPU_DEVICE_DOUBLE=0 disabled it"
+            else:
+                why = (f"an axis above {_dft.MATMUL_DFT_MAX} is outside "
+                       f"the mode")
             logger.warning(
-                "spfft_tpu: precision='double' on a TPU backend without "
-                "the on-device double mode (%s) runs at FLOAT32 device "
-                "precision — use the CPU backend (JAX_PLATFORMS=cpu, "
-                "jax x64) for true f64 (docs/precision.md)", why)
+                "spfft_tpu: precision='double' without the on-device "
+                "double mode (%s) runs at FLOAT32 device precision — "
+                "use the CPU backend with jax x64 enabled "
+                "(JAX_ENABLE_X64=1) for true f64 (docs/precision.md)",
+                why)
         # the double-single pipeline has its own (N, 4) host-f64
         # boundary; the planar pair layout never applies to it
         if self._ds:
