@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""Round-5 probe: fused 2D (y-DFT + transpose + x-DFT) Pallas kernel.
+
+The pipeline's xy tail is pdft_last(y) -> swapaxes (a materialized
+grid-sized transpose pass) -> pdft_last(x). A per-plane-batch kernel
+does dot / in-VMEM transpose / dot with one HBM read and one write.
+A/B against the XLA three-pass form with the shared estimator.
+
+Usage: python scripts/probe_r5_fused2d.py
+"""
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from spfft_tpu.ops import dft
+from spfft_tpu.utils.benchtime import diff_estimate_seconds
+
+_HI = jax.lax.Precision.HIGHEST
+_DN = (((1,), (0,)), ((), ()))
+
+
+def _dotk(a, c):
+    return jax.lax.dot_general(a, c, _DN, precision=_HI,
+                               preferred_element_type=jnp.float32)
+
+
+def _karatsuba(ar, ai, cr, ci, cs):
+    p1 = _dotk(ar, cr)
+    p2 = _dotk(ai, ci)
+    p3 = _dotk(ar + ai, cs)
+    return p1 - p2, p3 - p1 - p2
+
+
+def make_fused2d(ny_mats, nx_mats, tp=4):
+    ycr, yci, ycs = (np.asarray(m) for m in ny_mats)
+    xcr, xci, xcs = (np.asarray(m) for m in nx_mats)
+    ny, nyo = ycr.shape
+    nx, nxo = xcr.shape
+
+    def kernel(xr_ref, xi_ref, ycr_ref, yci_ref, ycs_ref,
+               xcr_ref, xci_ref, xcs_ref, or_ref, oi_ref):
+        tp_, nx_, ny_ = xr_ref.shape
+        a = xr_ref[...].reshape(tp_ * nx_, ny_)
+        b = xi_ref[...].reshape(tp_ * nx_, ny_)
+        gr, gi = _karatsuba(a, b, ycr_ref[...], yci_ref[...],
+                            ycs_ref[...])                 # (tp*nx, nyo)
+        gr = gr.reshape(tp_, nx_, nyo)
+        gi = gi.reshape(tp_, nx_, nyo)
+        gr = jnp.swapaxes(gr, -1, -2).reshape(tp_ * nyo, nx_)
+        gi = jnp.swapaxes(gi, -1, -2).reshape(tp_ * nyo, nx_)
+        hr, hi = _karatsuba(gr, gi, xcr_ref[...], xci_ref[...],
+                            xcs_ref[...])                 # (tp*nyo, nxo)
+        or_ref[...] = hr.reshape(tp_, nyo, nxo)
+        oi_ref[...] = hi.reshape(tp_, nyo, nxo)
+
+    mats = tuple(jnp.asarray(m) for m in (ycr, yci, ycs, xcr, xci, xcs))
+
+    def apply(xr, xi):
+        p = xr.shape[0]
+        grid = (pl.cdiv(p, tp),)
+        mspecs = [pl.BlockSpec((ycr.shape[0], nyo), lambda i: (0, 0))] * 3 \
+            + [pl.BlockSpec((nx, nxo), lambda i: (0, 0))] * 3
+        return pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[pl.BlockSpec((tp, nx, ny), lambda i: (i, 0, 0))] * 2
+            + mspecs,
+            out_specs=[pl.BlockSpec((tp, nyo, nxo), lambda i: (i, 0, 0))] * 2,
+            out_shape=[jax.ShapeDtypeStruct((p, nyo, nxo), jnp.float32)] * 2,
+        )(xr, xi, *mats)
+    return apply
+
+
+def xla_ref(xr, xi, ny_mats, nx_mats):
+    gr, gi = dft.pdft_last(xr, xi, ny_mats)
+    gr = jnp.swapaxes(gr, -1, -2)
+    gi = jnp.swapaxes(gi, -1, -2)
+    return dft.pdft_last(gr, gi, nx_mats)
+
+
+def sync(pair):
+    return float(np.asarray(jnp.real(pair[0]).ravel()[0]))
+
+
+def bench(g, xr, xi, chain=3, reps=16):
+    def body(a, b):
+        o = g(a, b)
+        for _ in range(chain - 1):
+            o = g(o[0], o[1])
+        return o
+    f = jax.jit(body)
+    sync(f(xr, xi))
+
+    def grp(k):
+        t0 = time.perf_counter()
+        o = (xr, xi)
+        for _ in range(k):
+            o = f(xr, xi)
+        sync(o)
+        return time.perf_counter() - t0
+    return diff_estimate_seconds(grp, reps=reps).seconds / chain
+
+
+def main():
+    n = int(os.environ.get("N", 256))
+    p = int(os.environ.get("P", 256))
+    rng = np.random.default_rng(5)
+    xr64 = rng.standard_normal((p, n, n))
+    xi64 = rng.standard_normal((p, n, n))
+    ny_mats = dft.c2c_mats(n, dft.BACKWARD)
+    nx_mats = dft.c2c_mats(n, dft.BACKWARD)
+    xr = jnp.asarray(xr64, jnp.float32)
+    xi = jnp.asarray(xi64, jnp.float32)
+
+    ref = np.asarray(
+        jax.jit(lambda a, b: xla_ref(a, b, ny_mats, nx_mats))(xr, xi)[0],
+        np.float64)
+
+    for tp in (2, 4, 8):
+        fused = make_fused2d(ny_mats, nx_mats, tp=tp)
+        try:
+            got = np.asarray(jax.jit(fused)(xr, xi)[0], np.float64)
+            err = np.linalg.norm(got - ref) / np.linalg.norm(ref)
+            t = bench(fused, xr, xi)
+            gb = (4 * p * n * n * 4) / 1e9
+            print(f"fused2d tp={tp}: {t*1e3:7.3f} ms  vs-xla rel {err:.3e}  "
+                  f"eff {(gb/t):6.1f} GB/s", flush=True)
+        except Exception as e:  # noqa: BLE001
+            print(f"fused2d tp={tp} FAILED: {type(e).__name__}: "
+                  f"{str(e).splitlines()[0][:140]}", flush=True)
+
+    t = bench(lambda a, b: xla_ref(a, b, ny_mats, nx_mats), xr, xi)
+    print(f"xla 3-pass    : {t*1e3:7.3f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()

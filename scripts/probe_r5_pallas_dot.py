@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""Round-5 probe: does Mosaic honor f32 matmul precision inside Pallas?
+
+The fused-stage kernel plan (pdft_last in one Pallas pass) only works if
+a dot inside the kernel can match XLA's HIGHEST-precision (multi-pass
+bf16) f32 matmul accuracy. Measures rel error of a 256-point DFT row
+pass vs numpy f64 for: XLA dot at HIGHEST/HIGH/DEFAULT, and Pallas dots
+with precision=HIGHEST / preferred_element_type=f32.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import functools
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+N = 256
+rng = np.random.default_rng(3)
+a64 = rng.standard_normal((512, N))
+c64 = np.cos(2 * np.pi * np.outer(np.arange(N), np.arange(N)) / N)
+ref = a64 @ c64
+a = jnp.asarray(a64, jnp.float32)
+c = jnp.asarray(c64, jnp.float32)
+
+
+def rel(x):
+    x = np.asarray(x, np.float64)
+    return np.linalg.norm(x - ref) / np.linalg.norm(ref)
+
+
+for name, prec in [("HIGHEST", jax.lax.Precision.HIGHEST),
+                   ("HIGH", jax.lax.Precision.HIGH),
+                   ("DEFAULT", jax.lax.Precision.DEFAULT)]:
+    y = jax.jit(lambda a, c, p=prec: jax.lax.dot_general(
+        a, c, (((1,), (0,)), ((), ())), precision=p))(a, c)
+    print(f"XLA    {name:8s} rel {rel(y):.3e}", flush=True)
+
+
+def kernel(prec, a_ref, c_ref, o_ref):
+    o_ref[...] = jax.lax.dot_general(
+        a_ref[...], c_ref[...], (((1,), (0,)), ((), ())),
+        precision=prec, preferred_element_type=jnp.float32)
+
+
+for name, prec in [("HIGHEST", jax.lax.Precision.HIGHEST),
+                   ("HIGH", jax.lax.Precision.HIGH),
+                   ("DEFAULT", jax.lax.Precision.DEFAULT),
+                   ("None", None)]:
+    try:
+        f = pl.pallas_call(
+            functools.partial(kernel, prec),
+            out_shape=jax.ShapeDtypeStruct((512, N), jnp.float32))
+        y = jax.jit(f)(a, c)
+        print(f"PALLAS {name:8s} rel {rel(y):.3e}", flush=True)
+    except Exception as e:  # noqa: BLE001
+        print(f"PALLAS {name:8s} FAILED: {type(e).__name__}: "
+              f"{str(e).splitlines()[0][:120]}", flush=True)

@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Round-5 probe: is the 256^3 fused pair MXU-bound?
+
+A/B the fused identity pair (apply_pointwise) with the matmul-DFT dots
+at HIGHEST (6-pass bf16 on f32 operands) vs HIGH (3-pass) vs DEFAULT
+(1-pass): if the pair is MXU-bound the HIGH variant should recover a
+large chunk of the dot time; if movement-bound it barely moves.
+Accuracy is the pair round-trip error ||pair(v)/size - v|| / ||v||
+(backward+forward with no scaling multiplies by the global size), which
+bounds the per-direction error without any dense-oracle host copy.
+
+Shipping setting is HIGHEST (probe_r4_dft.py measured lower settings
+missing the 1e-6 contract per pass); this re-checks the tradeoff at the
+whole-pair level under the round-5 sync-robust estimator.
+
+Usage: DIM=256 python scripts/probe_r5_precision_ab.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+
+from spfft_tpu import TransformType, make_local_plan
+from spfft_tpu.ops import dft
+from spfft_tpu.utils.benchtime import diff_estimate_seconds
+from spfft_tpu.utils.workloads import spherical_cutoff_triplets
+
+DIM = int(os.environ.get("DIM", 256))
+REPS = int(os.environ.get("REPS", 16))
+
+
+def sync(a):
+    return float(np.asarray(jax.numpy.real(a).ravel()[0]))
+
+
+def measure(plan, vil):
+    def grp(g):
+        t0 = time.perf_counter()
+        o = None
+        for _ in range(g):
+            o = plan.apply_pointwise(vil)
+        sync(o)
+        return time.perf_counter() - t0
+    return diff_estimate_seconds(grp, reps=REPS)
+
+
+def main():
+    tri = spherical_cutoff_triplets(DIM)
+    rng = np.random.default_rng(7)
+    n = len(tri)
+    vals = (rng.uniform(-1, 1, n) + 1j * rng.uniform(-1, 1, n)).astype(np.complex64)
+    size = float(DIM) ** 3
+
+    for name, prec in [("HIGHEST", jax.lax.Precision.HIGHEST),
+                       ("HIGH", jax.lax.Precision.HIGH),
+                       ("DEFAULT", jax.lax.Precision.DEFAULT)]:
+        dft._HIGHEST = prec
+        dft._dft_mats.cache_clear()
+        plan = make_local_plan(TransformType.C2C, DIM, DIM, DIM, tri)
+        vil = jax.device_put(plan._coerce_values(vals))
+        out = np.asarray(plan.apply_pointwise(vil))
+        got = out[..., 0] + 1j * out[..., 1] if out.ndim == 2 else out
+        err = np.linalg.norm(got / size - vals) / np.linalg.norm(vals)
+        est = measure(plan, vil)
+        print(f"{name:8s} pair {est.seconds*1e3:7.2f} ms (med {est.median*1e3:7.2f})"
+              f"  roundtrip rel l2 {err:.3e}", flush=True)
+        del plan, vil
+    dft._HIGHEST = jax.lax.Precision.HIGHEST
+
+
+if __name__ == "__main__":
+    main()

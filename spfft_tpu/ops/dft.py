@@ -201,8 +201,11 @@ def _pdft_two_stage(xr, xi, m: "TwoStageMats"):
 
 def cdft_last(x, mats):
     """Complex-dtype wrapper of :func:`pdft_last` (drop-in inside jit:
-    XLA splits/joins the complex pair for free)."""
-    yr, yi = pdft_last(jnp.real(x), jnp.imag(x), mats)
+    XLA splits/joins the complex pair for free). Routed through the
+    fused-kernel dispatch so the distributed stage wrappers
+    (ops.stages.z_backward etc., executing inside shard_map on a real
+    TPU mesh) get the fused stage too."""
+    yr, yi = pdft_last_opt(jnp.real(x), jnp.imag(x), mats)
     return yr + 1j * yi
 
 
@@ -220,6 +223,75 @@ def pirdft_last(yr, yi, mats):
     (..., n): two dots; hermitian doubling folded into the matrices."""
     a, b = mats
     return _dot(yr, a) + _dot(yi, b)
+
+
+# -- fused-kernel dispatch ---------------------------------------------------
+#
+# The plan pipelines call these instead of the raw stage functions: on a
+# TPU backend with f32 operands and plain (non-Cooley-Tukey) matrices the
+# stage executes as a fused Pallas kernel (ops.dft_kernel — one HBM read,
+# one write, Karatsuba combine in VMEM); everything else takes the XLA
+# form above, same math and layouts. SPFFT_TPU_FUSED_STAGE=0 forces the
+# XLA form everywhere (the probes' A/B knob).
+
+def _fused_ok(xr, *mats_list) -> bool:
+    from . import dft_kernel as dk
+    return (dk.enabled() and xr.dtype == jnp.float32
+            and dk.eligible_mats(*mats_list))
+
+
+def pdft_last_opt(xr, xi, mats):
+    """:func:`pdft_last` through the fused stage kernel when eligible."""
+    if not isinstance(mats, TwoStageMats) and _fused_ok(xr, mats):
+        from . import dft_kernel as dk
+        return dk.pdft_last(xr, xi, mats)
+    return pdft_last(xr, xi, mats)
+
+
+def _swap_pair(gr, gi):
+    return jnp.swapaxes(gr, -1, -2), jnp.swapaxes(gi, -1, -2)
+
+
+def pdft2_minor(xr, xi, mats1, mats2):
+    """[minor DFT (mats1), transpose, minor DFT (mats2)] on planar
+    complex ``(P, A, B)`` operands -> ``(P, B', A')``: one fused kernel
+    when eligible, else the three-pass XLA form with per-stage fusion."""
+    if (xr.ndim == 3 and not isinstance(mats1, TwoStageMats)
+            and not isinstance(mats2, TwoStageMats)
+            and _fused_ok(xr, mats1, mats2)):
+        from . import dft_kernel as dk
+        if dk.fits2("cc", xr.shape[1], xr.shape[2],
+                    mats1[0].shape[1], mats2[0].shape[1]):
+            return dk.pdft2(xr, xi, mats1, mats2)
+    gr, gi = pdft_last_opt(xr, xi, mats1)
+    gr, gi = _swap_pair(gr, gi)
+    return pdft_last_opt(gr, gi, mats2)
+
+
+def prdft2_minor(x, mats1, mats2):
+    """R2C head twin of :func:`pdft2_minor`: real in, rdft stage 1."""
+    if (x.ndim == 3 and not isinstance(mats2, TwoStageMats)
+            and _fused_ok(x, mats1, mats2)):
+        from . import dft_kernel as dk
+        if dk.fits2("rc", x.shape[1], x.shape[2],
+                    mats1[0].shape[1], mats2[0].shape[1]):
+            return dk.prdft2(x, mats1, mats2)
+    gr, gi = prdft_last(x, mats1)
+    gr, gi = _swap_pair(gr, gi)
+    return pdft_last_opt(gr, gi, mats2)
+
+
+def pdft2_minor_cr(xr, xi, mats1, mats2):
+    """C2R tail twin of :func:`pdft2_minor`: irdft stage 2, real out."""
+    if (xr.ndim == 3 and not isinstance(mats1, TwoStageMats)
+            and _fused_ok(xr, mats1, mats2)):
+        from . import dft_kernel as dk
+        if dk.fits2("cr", xr.shape[1], xr.shape[2],
+                    mats1[0].shape[1], mats2[0].shape[1]):
+            return dk.pdft2_cr(xr, xi, mats1, mats2)
+    gr, gi = pdft_last_opt(xr, xi, mats1)
+    gr, gi = _swap_pair(gr, gi)
+    return pirdft_last(gr, gi, mats2)
 
 
 # -- stage-level helpers (mats builders with scale folding) ------------------

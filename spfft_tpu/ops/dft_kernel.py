@@ -1,0 +1,322 @@
+"""Fused Pallas matmul-DFT stage kernels (TPU hot path).
+
+The XLA form of a planar DFT stage (:func:`spfft_tpu.ops.dft.pdft_last`)
+is three ``dot_general`` ops plus an elementwise Karatsuba combine. XLA
+cannot carry one fused elementwise chain across three matmuls, so at
+grid scale every stage materialises p1/p2/p3 and the (xr+xi) operand sum
+as HBM intermediates around the dots. These kernels do the dots and the
+combine per row tile entirely in VMEM — one HBM read of the operands,
+one write of the results:
+
+* :func:`pdft_last` — one stage, minor-axis contraction. Measured
+  0.796 ms vs 1.087 ms for the XLA form at the 256^3 stage shape
+  (M=65536, N=256), identical accuracy (rel 8.2e-8 vs numpy f64 —
+  scripts/probe_r5_fused_stage.py).
+* :func:`pdft2` (+ ``prdft2``/``pdft2_cr`` R2C twins) — TWO stages with
+  the inter-stage transpose done in VMEM: stage-1 dot over the minor
+  axis, swap of the two minor axes, stage-2 dot over the new minor
+  axis. This removes the materialised grid-sized ``swapaxes`` pass
+  between the xy stages. Measured 1.62 ms vs 2.07 ms for the XLA
+  three-pass form at 256^3 (scripts/probe_r5_fused2d.py); the fused
+  form is MXU-bound (~1.57 ms of 6-pass f32 matmul at this shape), so
+  it sits at the precision ladder's floor.
+
+Precision: Mosaic honours ``Precision.HIGHEST`` for f32 dots (measured
+rel 8.1e-8 on a 256-point pass, identical to XLA HIGHEST —
+scripts/probe_r5_pallas_dot.py), which is what keeps the library's
+1e-6 contract available; ``Precision.HIGH`` is *rejected* by Mosaic and
+DEFAULT fails the contract, so the kernels are HIGHEST-only.
+
+Eligibility (:func:`eligible_mats`): TPU backend, f32 operands, plain
+matrix tuples (the two-stage Cooley-Tukey path keeps its XLA form), and
+axis lengths that fit the VMEM tiling budget. Everything else falls
+back to the XLA path — same math, same layouts. Disable with
+``SPFFT_TPU_FUSED_STAGE=0`` (the A/B knob used by the probes).
+
+Reference parity: these kernels fuse what the reference runs as separate
+batched FFTW/cuFFT executes plus explicit pack/unpack transposes
+(reference: src/fft/transform_1d_host.hpp:76-118, the local transpose in
+src/transpose/transpose_host.hpp:94-154); on TPU the transpose lives in
+VMEM inside the same kernel instead of being a strided plan.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+_HI = jax.lax.Precision.HIGHEST
+_DN = (((1,), (0,)), ((), ()))
+
+#: Longest axis the fused kernels accept. Matches dft.MATMUL_DFT_MAX —
+#: above it the pipeline uses the two-stage Cooley-Tukey XLA form anyway.
+MAX_DIM = 512
+
+#: Per-kernel VMEM budget (bytes) the tile chooser aims under. v5e has
+#: ~16 MB/core; staying near half leaves room for Mosaic's own
+#: double-buffering of the streamed operand tiles.
+_VMEM_BUDGET = 9 * 1024 * 1024
+
+
+def enabled() -> bool:
+    """Fused stages are on by default on TPU; ``SPFFT_TPU_FUSED_STAGE=0``
+    disables (read per trace so tests can flip it)."""
+    return os.environ.get("SPFFT_TPU_FUSED_STAGE", "1").strip() != "0" \
+        and jax.default_backend() == "tpu"
+
+
+def _plain_mats(mats) -> bool:
+    """True for a tuple of plain 2-D arrays (rejects TwoStageMats and
+    anything else the XLA path special-cases)."""
+    return (isinstance(mats, tuple) and len(mats) in (2, 3)
+            and all(isinstance(m, (np.ndarray, jnp.ndarray)) and m.ndim == 2
+                    for m in mats))
+
+
+def eligible_mats(*mats_list) -> bool:
+    """All matrix tuples are plain and within the kernel's axis cap."""
+    for mats in mats_list:
+        if not _plain_mats(mats):
+            return False
+        if any(d > MAX_DIM for m in mats for d in m.shape):
+            return False
+    return True
+
+
+def _f32(*arrs) -> bool:
+    return all(a.dtype == jnp.float32 for a in arrs)
+
+
+# -- single fused stage ------------------------------------------------------
+
+def _stage_kernel(xr_ref, xi_ref, cr_ref, ci_ref, cs_ref, yr_ref, yi_ref):
+    a = xr_ref[...]
+    b = xi_ref[...]
+    p1 = jax.lax.dot_general(a, cr_ref[...], _DN, precision=_HI,
+                             preferred_element_type=jnp.float32)
+    p2 = jax.lax.dot_general(b, ci_ref[...], _DN, precision=_HI,
+                             preferred_element_type=jnp.float32)
+    p3 = jax.lax.dot_general(a + b, cs_ref[...], _DN, precision=_HI,
+                             preferred_element_type=jnp.float32)
+    yr_ref[...] = p1 - p2
+    yi_ref[...] = p3 - p1 - p2
+
+
+def _stage_tm(k: int, mo: int) -> int:
+    """Row-tile size: large tiles amortise the resident matrices; shrink
+    until 2 in + 2 out tiles + 3 matrices fit the VMEM budget."""
+    for tm in (1024, 512, 256, 128):
+        if (2 * tm * k + 2 * tm * mo + 3 * k * mo) * 4 <= _VMEM_BUDGET:
+            return tm
+    return 128
+
+
+def pdft_last(xr, xi, mats, interpret: bool = False):
+    """Fused planar complex DFT along the minor axis — drop-in for the
+    eligible subset of :func:`spfft_tpu.ops.dft.pdft_last`."""
+    cr, ci, cs = (jnp.asarray(m) for m in mats)
+    k, mo = cr.shape
+    lead = xr.shape[:-1]
+    m = int(np.prod(lead)) if lead else 1
+    tm = _stage_tm(k, mo)
+    yr, yi = pl.pallas_call(
+        _stage_kernel,
+        grid=(pl.cdiv(m, tm),),
+        in_specs=[
+            pl.BlockSpec((tm, k), lambda i: (i, 0)),
+            pl.BlockSpec((tm, k), lambda i: (i, 0)),
+            pl.BlockSpec((k, mo), lambda i: (0, 0)),
+            pl.BlockSpec((k, mo), lambda i: (0, 0)),
+            pl.BlockSpec((k, mo), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((tm, mo), lambda i: (i, 0)),
+            pl.BlockSpec((tm, mo), lambda i: (i, 0)),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((m, mo), jnp.float32)] * 2,
+        interpret=interpret,
+    )(xr.reshape(m, k), xi.reshape(m, k), cr, ci, cs)
+    return yr.reshape(lead + (mo,)), yi.reshape(lead + (mo,))
+
+
+# -- fused two-stage (stage1 · in-VMEM transpose · stage2) -------------------
+
+def _kara(ar, ai, cr, ci, cs):
+    p1 = jax.lax.dot_general(ar, cr, _DN, precision=_HI,
+                             preferred_element_type=jnp.float32)
+    p2 = jax.lax.dot_general(ai, ci, _DN, precision=_HI,
+                             preferred_element_type=jnp.float32)
+    p3 = jax.lax.dot_general(ar + ai, cs, _DN, precision=_HI,
+                             preferred_element_type=jnp.float32)
+    return p1 - p2, p3 - p1 - p2
+
+
+def _swap2(g, tp, b_out, a_in):
+    """(tp*a_in, b_out) -> (tp*b_out, a_in) via the 3-D minor swap."""
+    return jnp.swapaxes(g.reshape(tp, a_in, b_out), -1, -2) \
+        .reshape(tp * b_out, a_in)
+
+
+def _kernel2_cc(xr_ref, xi_ref, c1r_ref, c1i_ref, c1s_ref,
+                c2r_ref, c2i_ref, c2s_ref, or_ref, oi_ref):
+    tp, a_in, b_in = xr_ref.shape
+    b_out = c1r_ref.shape[1]
+    gr, gi = _kara(xr_ref[...].reshape(tp * a_in, b_in),
+                   xi_ref[...].reshape(tp * a_in, b_in),
+                   c1r_ref[...], c1i_ref[...], c1s_ref[...])
+    gr = _swap2(gr, tp, b_out, a_in)
+    gi = _swap2(gi, tp, b_out, a_in)
+    hr, hi = _kara(gr, gi, c2r_ref[...], c2i_ref[...], c2s_ref[...])
+    a_out = hr.shape[1]
+    or_ref[...] = hr.reshape(tp, b_out, a_out)
+    oi_ref[...] = hi.reshape(tp, b_out, a_out)
+
+
+def _kernel2_rc(x_ref, c1a_ref, c1b_ref, c2r_ref, c2i_ref, c2s_ref,
+                or_ref, oi_ref):
+    tp, a_in, b_in = x_ref.shape
+    b_out = c1a_ref.shape[1]
+    x = x_ref[...].reshape(tp * a_in, b_in)
+    gr = jax.lax.dot_general(x, c1a_ref[...], _DN, precision=_HI,
+                             preferred_element_type=jnp.float32)
+    gi = jax.lax.dot_general(x, c1b_ref[...], _DN, precision=_HI,
+                             preferred_element_type=jnp.float32)
+    gr = _swap2(gr, tp, b_out, a_in)
+    gi = _swap2(gi, tp, b_out, a_in)
+    hr, hi = _kara(gr, gi, c2r_ref[...], c2i_ref[...], c2s_ref[...])
+    a_out = hr.shape[1]
+    or_ref[...] = hr.reshape(tp, b_out, a_out)
+    oi_ref[...] = hi.reshape(tp, b_out, a_out)
+
+
+def _kernel2_cr(xr_ref, xi_ref, c1r_ref, c1i_ref, c1s_ref,
+                c2a_ref, c2b_ref, o_ref):
+    tp, a_in, b_in = xr_ref.shape
+    b_out = c1r_ref.shape[1]
+    gr, gi = _kara(xr_ref[...].reshape(tp * a_in, b_in),
+                   xi_ref[...].reshape(tp * a_in, b_in),
+                   c1r_ref[...], c1i_ref[...], c1s_ref[...])
+    gr = _swap2(gr, tp, b_out, a_in)
+    gi = _swap2(gi, tp, b_out, a_in)
+    h = jax.lax.dot_general(gr, c2a_ref[...], _DN, precision=_HI,
+                            preferred_element_type=jnp.float32) \
+        + jax.lax.dot_general(gi, c2b_ref[...], _DN, precision=_HI,
+                              preferred_element_type=jnp.float32)
+    o_ref[...] = h.reshape(tp, b_out, h.shape[1])
+
+
+#: Tighter budget for the two-stage kernels: their in-VMEM transpose and
+#: two live dot accumulators cost Mosaic more than the footprint formula
+#: sees (a tp=4 256-class kernel, ~7.8 MB by the formula, fails to
+#: compile on v5e — probe_r5_fused2d.py), so aim well under half VMEM.
+_VMEM_BUDGET2 = 5 * 1024 * 1024
+
+
+def plane_tp(a_in, b_in, b_out, a_out, n_chan_in, n_chan_out,
+             mats_elems):
+    """Planes per grid step for the two-stage kernels, sized to VMEM
+    (input + intermediate + output tiles per plane plus the resident
+    matrices). ``None`` when even one plane per step does not fit —
+    callers must fall back to the single-stage form."""
+    per_plane = (n_chan_in * a_in * b_in + 2 * a_in * b_out
+                 + n_chan_out * b_out * a_out) * 4
+    mats = mats_elems * 4
+    for tp in (4, 2, 1):
+        if tp * per_plane + mats <= _VMEM_BUDGET2:
+            return tp
+    return None
+
+
+#: (input channels, output channels, stage-1 matrices, stage-2 matrices)
+#: per two-stage kernel mode — the single source for the VMEM sizing
+#: used by both the eligibility gate and the kernels themselves.
+_MODE_CHANNELS = {"cc": (2, 2, 3, 3), "rc": (1, 2, 2, 3),
+                  "cr": (2, 1, 3, 2)}
+
+
+def _tp2(mode: str, a_in: int, b_in: int, b_out: int, a_out: int):
+    ci, co, m1, m2 = _MODE_CHANNELS[mode]
+    return plane_tp(a_in, b_in, b_out, a_out, ci, co,
+                    m1 * b_in * b_out + m2 * a_in * a_out)
+
+
+def fits2(mode: str, a_in: int, b_in: int, b_out: int, a_out: int) -> bool:
+    """Whether the two-stage kernel of ``mode`` ('cc'/'rc'/'cr') fits
+    the VMEM budget at these axis lengths."""
+    return _tp2(mode, a_in, b_in, b_out, a_out) is not None
+
+
+def _pallas2(kernel, ins, in_specs, out_shapes, out_specs, grid,
+             interpret):
+    return pl.pallas_call(
+        kernel, grid=grid, in_specs=in_specs, out_specs=out_specs,
+        out_shape=out_shapes, interpret=interpret)(*ins)
+
+
+def pdft2(xr, xi, mats1, mats2, interpret: bool = False):
+    """Fused [stage-1 minor dot, transpose, stage-2 minor dot] on planar
+    complex operands: ``(P, A, B) -> (P, B', A')`` — replaces
+    ``pdft_last(mats1) ; swapaxes(-1, -2) ; pdft_last(mats2)``."""
+    c1 = tuple(jnp.asarray(m) for m in mats1)
+    c2 = tuple(jnp.asarray(m) for m in mats2)
+    p, a_in, b_in = xr.shape
+    b_out = c1[0].shape[1]
+    a_out = c2[0].shape[1]
+    tp = _tp2("cc", a_in, b_in, b_out, a_out)
+    assert tp is not None, "caller must gate on fits2"
+    mspecs = [pl.BlockSpec((b_in, b_out), lambda i: (0, 0))] * 3 \
+        + [pl.BlockSpec((a_in, a_out), lambda i: (0, 0))] * 3
+    yr, yi = _pallas2(
+        _kernel2_cc, (xr, xi) + c1 + c2,
+        [pl.BlockSpec((tp, a_in, b_in), lambda i: (i, 0, 0))] * 2 + mspecs,
+        [jax.ShapeDtypeStruct((p, b_out, a_out), jnp.float32)] * 2,
+        [pl.BlockSpec((tp, b_out, a_out), lambda i: (i, 0, 0))] * 2,
+        (pl.cdiv(p, tp),), interpret)
+    return yr, yi
+
+
+def prdft2(x, mats1, mats2, interpret: bool = False):
+    """R2C head twin of :func:`pdft2`: real input, stage 1 is the
+    half-spectrum real DFT (two dots), stage 2 complex."""
+    c1 = tuple(jnp.asarray(m) for m in mats1)
+    c2 = tuple(jnp.asarray(m) for m in mats2)
+    p, a_in, b_in = x.shape
+    b_out = c1[0].shape[1]
+    a_out = c2[0].shape[1]
+    tp = _tp2("rc", a_in, b_in, b_out, a_out)
+    assert tp is not None, "caller must gate on fits2"
+    mspecs = [pl.BlockSpec((b_in, b_out), lambda i: (0, 0))] * 2 \
+        + [pl.BlockSpec((a_in, a_out), lambda i: (0, 0))] * 3
+    yr, yi = _pallas2(
+        _kernel2_rc, (x,) + c1 + c2,
+        [pl.BlockSpec((tp, a_in, b_in), lambda i: (i, 0, 0))] + mspecs,
+        [jax.ShapeDtypeStruct((p, b_out, a_out), jnp.float32)] * 2,
+        [pl.BlockSpec((tp, b_out, a_out), lambda i: (i, 0, 0))] * 2,
+        (pl.cdiv(p, tp),), interpret)
+    return yr, yi
+
+
+def pdft2_cr(xr, xi, mats1, mats2, interpret: bool = False):
+    """C2R tail twin of :func:`pdft2`: stage 1 complex, stage 2 the real
+    inverse DFT (two dots into one real output)."""
+    c1 = tuple(jnp.asarray(m) for m in mats1)
+    c2 = tuple(jnp.asarray(m) for m in mats2)
+    p, a_in, b_in = xr.shape
+    b_out = c1[0].shape[1]
+    a_out = c2[0].shape[1]
+    tp = _tp2("cr", a_in, b_in, b_out, a_out)
+    assert tp is not None, "caller must gate on fits2"
+    mspecs = [pl.BlockSpec((b_in, b_out), lambda i: (0, 0))] * 3 \
+        + [pl.BlockSpec((a_in, a_out), lambda i: (0, 0))] * 2
+    out = _pallas2(
+        _kernel2_cr, (xr, xi) + c1 + c2,
+        [pl.BlockSpec((tp, a_in, b_in), lambda i: (i, 0, 0))] * 2 + mspecs,
+        [jax.ShapeDtypeStruct((p, b_out, a_out), jnp.float32)],
+        [pl.BlockSpec((tp, b_out, a_out), lambda i: (i, 0, 0))],
+        (pl.cdiv(p, tp),), interpret)
+    return out[0]

@@ -664,7 +664,8 @@ class TransformPlan:
             nz = (rr != 0) | (ri != 0)
             sr = sr.at[zid].set(jnp.where(nz, rr, jnp.roll(rr[::-1], 1)))
             si = si.at[zid].set(jnp.where(nz, ri, -jnp.roll(ri[::-1], 1)))
-        sr, si = dft.pdft_last(sr, si, dft.c2c_mats(p.dim_z, dft.BACKWARD))
+        sr, si = dft.pdft_last_opt(sr, si,
+                                   dft.c2c_mats(p.dim_z, dft.BACKWARD))
         xf = p.dim_x_freq
         unpack = stages.sticks_to_grid_padded \
             if self._s_pad > p.num_sticks else stages.sticks_to_grid
@@ -686,16 +687,14 @@ class TransformPlan:
                 jnp.where(nz, cr, jnp.roll(cr[:, ::-1], 1, axis=-1)))
             gi = gi.at[:, 0, :].set(
                 jnp.where(nz, ci, -jnp.roll(ci[:, ::-1], 1, axis=-1)))
-        gr, gi = dft.pdft_last(gr, gi, dft.c2c_mats(p.dim_y, dft.BACKWARD))
-        gr = jnp.swapaxes(gr, -1, -2)
-        gi = jnp.swapaxes(gi, -1, -2)
+        y_mats = dft.c2c_mats(p.dim_y, dft.BACKWARD)
         if self._is_r2c:
             mats = dft.c2r_mats(p.dim_x) if rows is None \
                 else dft.sub_rows_c2r_mats(p.dim_x, rows)
-            return dft.pirdft_last(gr, gi, mats)
+            return dft.pdft2_minor_cr(gr, gi, y_mats, mats)
         mats = dft.c2c_mats(p.dim_x, dft.BACKWARD) if rows is None \
             else dft.sub_rows_mats(p.dim_x, dft.BACKWARD, rows)
-        return dft.pdft_last(gr, gi, mats)
+        return dft.pdft2_minor(gr, gi, y_mats, mats)
 
     def _backward_rest_t(self, sticks, tables):
         """Complex-dtype wrapper of :meth:`_backward_rest_tp` (the batched
@@ -716,34 +715,33 @@ class TransformPlan:
         from .ops import dft
         p = self.index_plan
         xf = p.dim_x_freq
+        y_mats = dft.c2c_mats(p.dim_y, dft.FORWARD)
         if self._split_x is not None:
             x0, w = self._split_x
             cols = tuple(int(c) for c in (x0 + np.arange(w)) % xf)
             cols_tab = tables["scatter_cols_sub_t"]
             if self._is_r2c:
-                gr, gi = dft.prdft_last(space_p.astype(self._rdt),
-                                        dft.sub_cols_r2c_mats(p.dim_x,
-                                                              cols))
+                gr, gi = dft.prdft2_minor(
+                    space_p.astype(self._rdt),
+                    dft.sub_cols_r2c_mats(p.dim_x, cols), y_mats)
             else:
-                gr, gi = dft.pdft_last(
+                gr, gi = dft.pdft2_minor(
                     space_p[0].astype(self._rdt),
                     space_p[1].astype(self._rdt),
-                    dft.sub_cols_mats(p.dim_x, dft.FORWARD, cols))
+                    dft.sub_cols_mats(p.dim_x, dft.FORWARD, cols), y_mats)
         else:
             cols_tab = tables["scatter_cols_t"]
             if self._is_r2c:
-                gr, gi = dft.prdft_last(space_p.astype(self._rdt),
-                                        dft.r2c_mats(p.dim_x))
+                gr, gi = dft.prdft2_minor(space_p.astype(self._rdt),
+                                          dft.r2c_mats(p.dim_x), y_mats)
             else:
-                gr, gi = dft.pdft_last(space_p[0].astype(self._rdt),
-                                       space_p[1].astype(self._rdt),
-                                       dft.c2c_mats(p.dim_x, dft.FORWARD))
-        gr = jnp.swapaxes(gr, -1, -2)
-        gi = jnp.swapaxes(gi, -1, -2)
-        gr, gi = dft.pdft_last(gr, gi, dft.c2c_mats(p.dim_y, dft.FORWARD))
+                gr, gi = dft.pdft2_minor(space_p[0].astype(self._rdt),
+                                         space_p[1].astype(self._rdt),
+                                         dft.c2c_mats(p.dim_x, dft.FORWARD),
+                                         y_mats)
         sr = stages.grid_to_sticks(gr, cols_tab)
         si = stages.grid_to_sticks(gi, cols_tab)
-        return dft.pdft_last(
+        return dft.pdft_last_opt(
             sr, si, dft.c2c_mats(p.dim_z, dft.FORWARD,
                                  scale=scale if scale else 1.0))
 

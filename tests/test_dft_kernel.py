@@ -1,0 +1,125 @@
+"""Fused Pallas DFT stage kernels — interpret-mode correctness on CPU.
+
+The kernels only RUN on TPU (ops.dft.pdft_last_opt and friends gate on
+the backend); interpret mode executes the same kernel program with
+plain JAX ops, so these tests pin the tiling/transpose/index logic —
+odd plane counts, non-tile-aligned row counts, rectangular matrices —
+against the XLA stage forms. Device-level equivalence (real Mosaic
+codegen, HIGHEST-precision dots) is tests_tpu/test_tpu_ci.py::
+test_fused_stage_matches_xla. Mirrors the reference's transpose-layer
+unit tests (reference: tests/mpi_tests/test_transpose.cpp:122-183) one
+level down, at the kernel boundary.
+"""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from spfft_tpu.ops import dft
+from spfft_tpu.ops import dft_kernel as dk
+
+RTOL = 2e-6
+
+
+def _rand(shape, seed):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.standard_normal(shape), jnp.float32)
+
+
+def _close(a, b):
+    a = np.asarray(a, np.float64)
+    b = np.asarray(b, np.float64)
+    assert np.linalg.norm(a - b) <= RTOL * max(np.linalg.norm(b), 1e-30)
+
+
+@pytest.mark.parametrize("m,n", [(96, 16), (130, 13), (1, 12)])
+def test_stage_kernel_matches_xla_form(m, n):
+    xr, xi = _rand((m, n), 1), _rand((m, n), 2)
+    mats = dft.c2c_mats(n, dft.BACKWARD)
+    want = dft.pdft_last(xr, xi, mats)
+    got = dk.pdft_last(xr, xi, mats, interpret=True)
+    _close(got[0], want[0])
+    _close(got[1], want[1])
+
+
+def test_stage_kernel_rectangular_mats():
+    # sub-rows selection: input length 5 != output length 12
+    n, rows = 12, (0, 2, 3, 7, 11)
+    xr, xi = _rand((33, 5), 3), _rand((33, 5), 4)
+    mats = dft.sub_rows_mats(n, dft.BACKWARD, rows)
+    want = dft.pdft_last(xr, xi, mats)
+    got = dk.pdft_last(xr, xi, mats, interpret=True)
+    _close(got[0], want[0])
+    _close(got[1], want[1])
+
+
+@pytest.mark.parametrize("p,a,b", [(5, 12, 16), (1, 7, 9), (8, 16, 16)])
+def test_pdft2_matches_three_pass(p, a, b):
+    xr, xi = _rand((p, a, b), 5), _rand((p, a, b), 6)
+    m1 = dft.c2c_mats(b, dft.BACKWARD)
+    m2 = dft.c2c_mats(a, dft.FORWARD)
+    wr, wi = dft.pdft_last(xr, xi, m1)
+    wr, wi = jnp.swapaxes(wr, -1, -2), jnp.swapaxes(wi, -1, -2)
+    want = dft.pdft_last(wr, wi, m2)
+    got = dk.pdft2(xr, xi, m1, m2, interpret=True)
+    _close(got[0], want[0])
+    _close(got[1], want[1])
+
+
+def test_prdft2_matches_three_pass():
+    p, a, b = 5, 10, 12
+    x = _rand((p, a, b), 7)
+    m1 = dft.r2c_mats(b)
+    m2 = dft.c2c_mats(a, dft.FORWARD)
+    wr, wi = dft.prdft_last(x, m1)
+    wr, wi = jnp.swapaxes(wr, -1, -2), jnp.swapaxes(wi, -1, -2)
+    want = dft.pdft_last(wr, wi, m2)
+    got = dk.prdft2(x, m1, m2, interpret=True)
+    _close(got[0], want[0])
+    _close(got[1], want[1])
+
+
+def test_pdft2_cr_matches_three_pass():
+    p, a, b = 3, 12, 14
+    xf = a // 2 + 1
+    xr, xi = _rand((p, xf, b), 8), _rand((p, xf, b), 9)
+    m1 = dft.c2c_mats(b, dft.BACKWARD)
+    m2 = dft.c2r_mats(a)
+    wr, wi = dft.pdft_last(xr, xi, m1)
+    wr, wi = jnp.swapaxes(wr, -1, -2), jnp.swapaxes(wi, -1, -2)
+    want = dft.pirdft_last(wr, wi, m2)
+    got = dk.pdft2_cr(xr, xi, m1, m2, interpret=True)
+    _close(got, want)
+
+
+def test_dispatchers_fall_back_off_tpu():
+    """On the CPU backend the dispatchers must produce the XLA result
+    bit-for-bit (no kernel involved)."""
+    xr, xi = _rand((4, 6, 8), 10), _rand((4, 6, 8), 11)
+    m1 = dft.c2c_mats(8, dft.BACKWARD)
+    m2 = dft.c2c_mats(6, dft.BACKWARD)
+    wr, wi = dft.pdft_last(xr, xi, m1)
+    wr, wi = jnp.swapaxes(wr, -1, -2), jnp.swapaxes(wi, -1, -2)
+    want = dft.pdft_last(wr, wi, m2)
+    got = dft.pdft2_minor(xr, xi, m1, m2)
+    assert np.array_equal(np.asarray(got[0]), np.asarray(want[0]))
+    assert np.array_equal(np.asarray(got[1]), np.asarray(want[1]))
+
+
+def test_two_stage_mats_take_xla_form():
+    """TwoStageMats (axes > MATMUL_DFT_MAX) must route through the XLA
+    Cooley-Tukey path, not the kernel, regardless of backend."""
+    n = 768
+    mats = dft.c2c_mats(n, dft.BACKWARD)
+    assert isinstance(mats, dft.TwoStageMats)
+    xr, xi = _rand((3, n), 12), _rand((3, n), 13)
+    want = dft.pdft_last(xr, xi, mats)
+    got = dft.pdft_last_opt(xr, xi, mats)
+    assert np.array_equal(np.asarray(got[0]), np.asarray(want[0]))
+
+
+def test_vmem_gate():
+    assert dk.fits2("cc", 256, 256, 256, 256)
+    assert not dk.fits2("cc", 512, 512, 512, 512)
+    assert dk.plane_tp(256, 256, 256, 256, 2, 2,
+                       6 * 256 * 256) in (1, 2, 4)

@@ -267,3 +267,44 @@ def test_on_device_double_r2c():
     gv = out[:, 0] + 1j * out[:, 1]
     rel = np.linalg.norm(gv - vals) / np.linalg.norm(vals)
     assert rel < 2e-12, rel
+
+
+def test_fused_stage_matches_xla(monkeypatch):
+    """Fused Pallas DFT-stage kernels (real Mosaic codegen, in-VMEM
+    transpose, HIGHEST-precision dots) vs the SPFFT_TPU_FUSED_STAGE=0
+    XLA pipeline: same plan, same values. The two paths differ only in
+    rounding order, so agreement is ~1e-7-class."""
+    n = 64
+    tr = spherical_cutoff_triplets(n)
+    vals = _values(len(tr), 6)
+    # dft_kernel.enabled() reads the env at TRACE time and plans trace
+    # lazily at first execution — so each plan must EXECUTE while its
+    # intended setting is live, or both trace the same path.
+    import jax
+
+    def hlo(plan):
+        # lowered under the CURRENT env — the engagement proof below
+        vil = plan._coerce_values(vals)
+        return jax.jit(plan._backward_impl).lower(
+            vil, plan._tables_hot).as_text()
+
+    plan_f = make_local_plan(TransformType.C2C, n, n, n, tr,
+                             precision="single")
+    a = np.asarray(plan_f.backward(vals))
+    fa = np.asarray(plan_f.forward(a, Scaling.FULL))
+    hlo_f = hlo(plan_f)
+    monkeypatch.setenv("SPFFT_TPU_FUSED_STAGE", "0")
+    plan_x = make_local_plan(TransformType.C2C, n, n, n, tr,
+                             precision="single")
+    b = np.asarray(plan_x.backward(vals))
+    fb = np.asarray(plan_x.forward(b, Scaling.FULL))
+    hlo_x = hlo(plan_x)
+    monkeypatch.delenv("SPFFT_TPU_FUSED_STAGE")
+    # prove the A/B engaged: the fused plan lowers to Pallas custom
+    # calls, the env=0 plan to plain dots (at 64^3 the two paths agree
+    # BIT-FOR-BIT — same 6-pass dot algorithm either way — so result
+    # inequality cannot serve as the engagement check)
+    assert "tpu_custom_call" in hlo_f
+    assert "tpu_custom_call" not in hlo_x
+    assert _rel(a, b) < 5e-6
+    assert _rel(fa, fb) < 5e-6
