@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Round-5 probe: fused sub-pipeline costs after the DFT-stage kernels.
+
+Times each growing sub-pipeline of the 256^3 backward and forward as
+its own jitted executable with the shared estimator (no scan carrier —
+the prefix probe's identity-scan baseline measured 5.6 ms/step of pure
+carrier cost and +-1 ms rescheduling noise). Differences between rows
+are the marginal fused cost of each stage in a dispatch context close
+to the real pair.
+
+Usage: DIM=256 python scripts/probe_r5_stagecost.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from spfft_tpu import TransformType, make_local_plan
+from spfft_tpu.ops import dft, stages
+from spfft_tpu.utils.benchtime import diff_estimate_seconds
+from spfft_tpu.utils.workloads import spherical_cutoff_triplets
+
+DIM = int(os.environ.get("DIM", 256))
+REPS = int(os.environ.get("REPS", 16))
+
+
+def sync(out):
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    return float(np.asarray(jnp.real(leaf).ravel()[0]))
+
+
+def measure(f, *args):
+    g = jax.jit(f)
+    sync(g(*args))
+
+    def grp(k):
+        t0 = time.perf_counter()
+        o = None
+        for _ in range(k):
+            o = g(*args)
+        sync(o)
+        return time.perf_counter() - t0
+    return diff_estimate_seconds(grp, reps=REPS).seconds
+
+
+def main():
+    tri = spherical_cutoff_triplets(DIM)
+    rng = np.random.default_rng(7)
+    n = len(tri)
+    vals = (rng.uniform(-1, 1, n) + 1j * rng.uniform(-1, 1, n)).astype(
+        np.complex64)
+    plan = make_local_plan(TransformType.C2C, DIM, DIM, DIM, tri)
+    tabs = plan._tables_hot
+    vil = jax.device_put(plan._coerce_values(vals))
+    p = plan.index_plan
+
+    # -- backward sub-pipelines ---------------------------------------
+    def bw_dec(v):
+        return plan._decompress_planar(v, tabs)
+
+    def bw_z(v):
+        sr, si = plan._decompress_planar(v, tabs)
+        return dft.pdft_last_opt(sr, si, dft.c2c_mats(p.dim_z, dft.BACKWARD))
+
+    def bw_full(v):
+        return plan._backward_impl(v, tabs)
+
+    # -- forward sub-pipelines (on the backward output space) ---------
+    space = jax.device_put(jax.jit(bw_full)(vil))
+
+    def fw_head(s):
+        sp = (s[..., 0], s[..., 1])
+        return plan._forward_head_tp(sp, tabs, None)
+
+    def fw_full(s):
+        return plan._forward_impl(s, tabs, scaled=False)
+
+    def pair(v):
+        return plan._forward_impl(plan._backward_impl(v, tabs), tabs,
+                                  scaled=False)
+
+    rows = [
+        ("bw decompress          ", bw_dec, vil),
+        ("bw decompress+z        ", bw_z, vil),
+        ("bw full                ", bw_full, vil),
+        ("fw head (xy+pack+z)    ", fw_head, space),
+        ("fw full (head+compress)", fw_full, space),
+        ("pair (fused)           ", pair, vil),
+    ]
+    res = {}
+    for name, f, arg in rows:
+        t = measure(f, arg)
+        res[name] = t
+        print(f"{name}: {t*1e3:7.3f} ms", flush=True)
+
+    print(f"\nmarginals: z-bwd {1e3*(res['bw decompress+z        ']-res['bw decompress          ']):+.3f}"
+          f"  unpack+xy {1e3*(res['bw full                ']-res['bw decompress+z        ']):+.3f}"
+          f"  compress {1e3*(res['fw full (head+compress)']-res['fw head (xy+pack+z)    ']):+.3f}"
+          f"  bw+fw-pair {1e3*(res['bw full                ']+res['fw full (head+compress)']-res['pair (fused)           ']):+.3f}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

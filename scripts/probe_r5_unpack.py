@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Round-5 probe: decompose the backward unpack (sticks_to_grid) and
+forward compress costs at 256^3 shapes.
+
+stagecost measured unpack+xy at ~5.1 ms marginal while the fused xy
+kernel alone is 1.62 — sticks_to_grid_padded is `sticks[col_inv].T`,
+i.e. a row gather PLUS a full grid transpose per channel. This probe
+times the pieces standalone: gather only, transpose only, gather+T,
+and the compress sub-pieces (planar pad/reshape, gather kernel,
+interleave).
+
+Usage: python scripts/probe_r5_unpack.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from spfft_tpu import TransformType, make_local_plan
+from spfft_tpu.utils.benchtime import diff_estimate_seconds
+from spfft_tpu.utils.workloads import spherical_cutoff_triplets
+
+DIM = int(os.environ.get("DIM", 256))
+
+
+def sync(out):
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    return float(np.asarray(jnp.real(leaf).ravel()[0]))
+
+
+def measure(f, *args, reps=16):
+    g = jax.jit(f)
+    sync(g(*args))
+
+    def grp(k):
+        t0 = time.perf_counter()
+        o = None
+        for _ in range(k):
+            o = g(*args)
+        sync(o)
+        return time.perf_counter() - t0
+    return diff_estimate_seconds(grp, reps=reps).seconds
+
+
+def main():
+    tri = spherical_cutoff_triplets(DIM)
+    plan = make_local_plan(TransformType.C2C, DIM, DIM, DIM, tri)
+    p = plan.index_plan
+    tabs = plan._tables_hot
+    col = tabs["col_inv_t"]
+    rng = np.random.default_rng(3)
+    s_pad = plan._s_pad
+    sr = jax.device_put(jnp.asarray(
+        rng.standard_normal((s_pad, p.dim_z)), jnp.float32))
+    si = jax.device_put(jnp.asarray(
+        rng.standard_normal((s_pad, p.dim_z)), jnp.float32))
+    xf = p.dim_x_freq
+
+    t = measure(lambda a, b: (a[col], b[col]), sr, si)
+    print(f"row gather only (both ch)   : {t*1e3:7.3f} ms", flush=True)
+
+    ga = jax.device_put(jnp.asarray(
+        rng.standard_normal((xf * p.dim_y, p.dim_z)), jnp.float32))
+    gb = jax.device_put(jnp.asarray(
+        rng.standard_normal((xf * p.dim_y, p.dim_z)), jnp.float32))
+    t = measure(lambda a, b: (a.T.reshape(p.dim_z, xf, p.dim_y),
+                              b.T.reshape(p.dim_z, xf, p.dim_y)), ga, gb)
+    print(f"grid transpose only (both)  : {t*1e3:7.3f} ms", flush=True)
+
+    t = measure(lambda a, b: (a[col].T.reshape(p.dim_z, xf, p.dim_y),
+                              b[col].T.reshape(p.dim_z, xf, p.dim_y)),
+                sr, si)
+    print(f"gather + T (current unpack) : {t*1e3:7.3f} ms", flush=True)
+
+    # forward pack mirror: minor-axis gather + T
+    fr = jax.device_put(jnp.asarray(
+        rng.standard_normal((p.dim_z, xf * p.dim_y)), jnp.float32))
+    cols = tabs["scatter_cols_t"]
+    t = measure(lambda a: a[:, cols].T, fr)
+    print(f"pack: minor gather + T (1ch): {t*1e3:7.3f} ms", flush=True)
+
+    # compress pieces
+    vil = jax.device_put(plan._coerce_values(
+        (rng.standard_normal(p.num_values)
+         + 1j * rng.standard_normal(p.num_values)).astype(np.complex64)))
+    t = measure(lambda v: plan._decompress_planar(v, tabs), vil)
+    print(f"decompress (planar)         : {t*1e3:7.3f} ms", flush=True)
+    t = measure(lambda a, b: plan._compress_planar(a, b, tabs), sr, si)
+    print(f"compress (full)             : {t*1e3:7.3f} ms", flush=True)
+    from spfft_tpu.ops import gather_kernel as gk
+    tt = plan._pallas["cmp"]
+    pad = tt.src_rows * 128 - sr.size
+    re = jnp.pad(sr.reshape(-1), (0, pad)).reshape(tt.src_rows, 128)
+    im = jnp.pad(si.reshape(-1), (0, pad)).reshape(tt.src_rows, 128)
+    re, im = jax.device_put(re), jax.device_put(im)
+    t = measure(lambda a, b: gk.run_gather(a, b, tabs["cmp_tabs"], tt),
+                re, im)
+    print(f"compress bare kernel        : {t*1e3:7.3f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Round-5 probe: unpack (sticks -> grid placement) through the
+existing Pallas windowed element-gather + the cheap transpose.
+
+probe_r5_unpack measured the XLA row gather at 3.56 ms (both channels)
+with the transpose at 0.41 — the gather dominates. The unpack map in
+FLAT element space (out q = r*Z + z <- src col_inv[r]*Z + z) has
+256-element consecutive runs, exactly the window locality the
+compression gather kernel is built for. This builds tables for that
+map at 256^3 and times [kernel gather + reshape + T] vs the current
+`sticks[col_inv].T`.
+
+Usage: python scripts/probe_r5_unpack_kernel.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from spfft_tpu import TransformType, make_local_plan
+from spfft_tpu.ops import gather_kernel as gk
+from spfft_tpu.utils.benchtime import diff_estimate_seconds
+from spfft_tpu.utils.workloads import spherical_cutoff_triplets
+
+DIM = int(os.environ.get("DIM", 256))
+
+
+def sync(out):
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    return float(np.asarray(jnp.real(leaf).ravel()[0]))
+
+
+def measure(f, *args, reps=16):
+    g = jax.jit(f)
+    sync(g(*args))
+
+    def grp(k):
+        t0 = time.perf_counter()
+        o = None
+        for _ in range(k):
+            o = g(*args)
+        sync(o)
+        return time.perf_counter() - t0
+    return diff_estimate_seconds(grp, reps=reps).seconds
+
+
+def main():
+    tri = spherical_cutoff_triplets(DIM)
+    plan = make_local_plan(TransformType.C2C, DIM, DIM, DIM, tri)
+    p = plan.index_plan
+    tabs = plan._tables_hot
+    col = np.asarray(tabs["col_inv_t"])
+    s_pad = plan._s_pad
+    Z = p.dim_z
+    R = col.shape[0]
+
+    t0 = time.time()
+    valid = col < p.num_sticks  # sentinel == num_sticks -> zero output
+    # forward-fill sentinel rows so windows stay local (the idiom of
+    # compression_gather_inputs' decompress side)
+    filled = np.maximum.accumulate(
+        np.where(valid, col.astype(np.int64), 0))
+    # element map: out q = r*Z + z <- src col[r]*Z + z
+    idx = (filled[:, None] * Z
+           + np.arange(Z, dtype=np.int64)[None, :]).reshape(-1)
+    vmask = np.repeat(valid, Z)
+    t = gk.build_best_gather_tables(idx, vmask, s_pad * Z)
+    print(f"table build: {time.time()-t0:.2f} s -> "
+          f"{type(t).__name__ if t is not None else None}", flush=True)
+    if t is None:
+        return
+    dev = gk.gather_device_tables(t)
+
+    rng = np.random.default_rng(3)
+    sr = jax.device_put(jnp.asarray(
+        rng.standard_normal((s_pad, Z)), jnp.float32))
+    si = jax.device_put(jnp.asarray(
+        rng.standard_normal((s_pad, Z)), jnp.float32))
+    xf = p.dim_x_freq
+
+    def kernel_unpack(a, b):
+        src_re = a.reshape(-1, 128)
+        src_im = b.reshape(-1, 128)
+        o_re, o_im = gk.run_gather(src_re, src_im, dev, t)
+        gr = o_re.reshape(-1)[:R * Z].reshape(R, Z)
+        gi = o_im.reshape(-1)[:R * Z].reshape(R, Z)
+        return (gr.T.reshape(Z, xf, p.dim_y),
+                gi.T.reshape(Z, xf, p.dim_y))
+
+    def xla_unpack(a, b):
+        cj = jnp.asarray(col)
+        return (a[cj].T.reshape(Z, xf, p.dim_y),
+                b[cj].T.reshape(Z, xf, p.dim_y))
+
+    ka = jax.jit(kernel_unpack)(sr, si)
+    xa = jax.jit(xla_unpack)(sr, si)
+    d = np.linalg.norm(np.asarray(ka[0]) - np.asarray(xa[0]))
+    print(f"kernel-vs-xla diff: {d:.3e}", flush=True)
+
+    tk = measure(kernel_unpack, sr, si)
+    tx = measure(xla_unpack, sr, si)
+    print(f"kernel unpack: {tk*1e3:7.3f} ms   xla unpack: {tx*1e3:7.3f} ms",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

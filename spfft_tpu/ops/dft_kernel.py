@@ -27,11 +27,12 @@ scripts/probe_r5_pallas_dot.py), which is what keeps the library's
 1e-6 contract available; ``Precision.HIGH`` is *rejected* by Mosaic and
 DEFAULT fails the contract, so the kernels are HIGHEST-only.
 
-Eligibility (:func:`eligible_mats`): TPU backend, f32 operands, plain
-matrix tuples (the two-stage Cooley-Tukey path keeps its XLA form), and
-axis lengths that fit the VMEM tiling budget. Everything else falls
-back to the XLA path — same math, same layouts. Disable with
-``SPFFT_TPU_FUSED_STAGE=0`` (the A/B knob used by the probes).
+Eligibility (:func:`eligible_mats` + :func:`fits2`): TPU backend, f32
+operands, plain matrix tuples (the two-stage Cooley-Tukey path keeps
+its XLA form), and axis lengths that fit the VMEM tiling budget.
+Everything else falls back to the XLA path — same math, same layouts.
+Disable with ``SPFFT_TPU_FUSED_STAGE=0`` (the A/B knob used by the
+probes).
 
 Reference parity: these kernels fuse what the reference runs as separate
 batched FFTW/cuFFT executes plus explicit pack/unpack transposes
@@ -50,16 +51,18 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
+from .dft import MATMUL_DFT_MAX
+
 _HI = jax.lax.Precision.HIGHEST
 _DN = (((1,), (0,)), ((), ()))
 
-#: Longest axis the fused kernels accept. Matches dft.MATMUL_DFT_MAX —
-#: above it the pipeline uses the two-stage Cooley-Tukey XLA form anyway.
-MAX_DIM = 512
+#: Longest axis the fused kernels accept — the matmul-DFT cap itself
+#: (above it the pipeline uses the two-stage Cooley-Tukey XLA form).
+MAX_DIM = MATMUL_DFT_MAX
 
-#: Per-kernel VMEM budget (bytes) the tile chooser aims under. v5e has
-#: ~16 MB/core; staying near half leaves room for Mosaic's own
-#: double-buffering of the streamed operand tiles.
+#: Per-kernel VMEM budget (bytes) the single-stage tile chooser aims
+#: under. v5e has ~16 MB/core; staying near half leaves room for
+#: Mosaic's own double-buffering of the streamed operand tiles.
 _VMEM_BUDGET = 9 * 1024 * 1024
 
 
@@ -88,23 +91,26 @@ def eligible_mats(*mats_list) -> bool:
     return True
 
 
-def _f32(*arrs) -> bool:
-    return all(a.dtype == jnp.float32 for a in arrs)
+def _dot(a, c):
+    return jax.lax.dot_general(a, c, _DN, precision=_HI,
+                               preferred_element_type=jnp.float32)
+
+
+def _kara(ar, ai, cr, ci, cs):
+    """Karatsuba 3-mult complex DFT on 2-D planar operands."""
+    p1 = _dot(ar, cr)
+    p2 = _dot(ai, ci)
+    p3 = _dot(ar + ai, cs)
+    return p1 - p2, p3 - p1 - p2
 
 
 # -- single fused stage ------------------------------------------------------
 
 def _stage_kernel(xr_ref, xi_ref, cr_ref, ci_ref, cs_ref, yr_ref, yi_ref):
-    a = xr_ref[...]
-    b = xi_ref[...]
-    p1 = jax.lax.dot_general(a, cr_ref[...], _DN, precision=_HI,
-                             preferred_element_type=jnp.float32)
-    p2 = jax.lax.dot_general(b, ci_ref[...], _DN, precision=_HI,
-                             preferred_element_type=jnp.float32)
-    p3 = jax.lax.dot_general(a + b, cs_ref[...], _DN, precision=_HI,
-                             preferred_element_type=jnp.float32)
-    yr_ref[...] = p1 - p2
-    yi_ref[...] = p3 - p1 - p2
+    yr, yi = _kara(xr_ref[...], xi_ref[...],
+                   cr_ref[...], ci_ref[...], cs_ref[...])
+    yr_ref[...] = yr
+    yi_ref[...] = yi
 
 
 def _stage_tm(k: int, mo: int) -> int:
@@ -146,69 +152,14 @@ def pdft_last(xr, xi, mats, interpret: bool = False):
 
 # -- fused two-stage (stage1 · in-VMEM transpose · stage2) -------------------
 
-def _kara(ar, ai, cr, ci, cs):
-    p1 = jax.lax.dot_general(ar, cr, _DN, precision=_HI,
-                             preferred_element_type=jnp.float32)
-    p2 = jax.lax.dot_general(ai, ci, _DN, precision=_HI,
-                             preferred_element_type=jnp.float32)
-    p3 = jax.lax.dot_general(ar + ai, cs, _DN, precision=_HI,
-                             preferred_element_type=jnp.float32)
-    return p1 - p2, p3 - p1 - p2
-
-
-def _swap2(g, tp, b_out, a_in):
-    """(tp*a_in, b_out) -> (tp*b_out, a_in) via the 3-D minor swap."""
-    return jnp.swapaxes(g.reshape(tp, a_in, b_out), -1, -2) \
-        .reshape(tp * b_out, a_in)
-
-
-def _kernel2_cc(xr_ref, xi_ref, c1r_ref, c1i_ref, c1s_ref,
-                c2r_ref, c2i_ref, c2s_ref, or_ref, oi_ref):
-    tp, a_in, b_in = xr_ref.shape
-    b_out = c1r_ref.shape[1]
-    gr, gi = _kara(xr_ref[...].reshape(tp * a_in, b_in),
-                   xi_ref[...].reshape(tp * a_in, b_in),
-                   c1r_ref[...], c1i_ref[...], c1s_ref[...])
-    gr = _swap2(gr, tp, b_out, a_in)
-    gi = _swap2(gi, tp, b_out, a_in)
-    hr, hi = _kara(gr, gi, c2r_ref[...], c2i_ref[...], c2s_ref[...])
-    a_out = hr.shape[1]
-    or_ref[...] = hr.reshape(tp, b_out, a_out)
-    oi_ref[...] = hi.reshape(tp, b_out, a_out)
-
-
-def _kernel2_rc(x_ref, c1a_ref, c1b_ref, c2r_ref, c2i_ref, c2s_ref,
-                or_ref, oi_ref):
-    tp, a_in, b_in = x_ref.shape
-    b_out = c1a_ref.shape[1]
-    x = x_ref[...].reshape(tp * a_in, b_in)
-    gr = jax.lax.dot_general(x, c1a_ref[...], _DN, precision=_HI,
-                             preferred_element_type=jnp.float32)
-    gi = jax.lax.dot_general(x, c1b_ref[...], _DN, precision=_HI,
-                             preferred_element_type=jnp.float32)
-    gr = _swap2(gr, tp, b_out, a_in)
-    gi = _swap2(gi, tp, b_out, a_in)
-    hr, hi = _kara(gr, gi, c2r_ref[...], c2i_ref[...], c2s_ref[...])
-    a_out = hr.shape[1]
-    or_ref[...] = hr.reshape(tp, b_out, a_out)
-    oi_ref[...] = hi.reshape(tp, b_out, a_out)
-
-
-def _kernel2_cr(xr_ref, xi_ref, c1r_ref, c1i_ref, c1s_ref,
-                c2a_ref, c2b_ref, o_ref):
-    tp, a_in, b_in = xr_ref.shape
-    b_out = c1r_ref.shape[1]
-    gr, gi = _kara(xr_ref[...].reshape(tp * a_in, b_in),
-                   xi_ref[...].reshape(tp * a_in, b_in),
-                   c1r_ref[...], c1i_ref[...], c1s_ref[...])
-    gr = _swap2(gr, tp, b_out, a_in)
-    gi = _swap2(gi, tp, b_out, a_in)
-    h = jax.lax.dot_general(gr, c2a_ref[...], _DN, precision=_HI,
-                            preferred_element_type=jnp.float32) \
-        + jax.lax.dot_general(gi, c2b_ref[...], _DN, precision=_HI,
-                              preferred_element_type=jnp.float32)
-    o_ref[...] = h.reshape(tp, b_out, h.shape[1])
-
+#: (input channels, output channels, stage-1 matrices, stage-2 matrices)
+#: per two-stage kernel mode — the single source for operand counts and
+#: VMEM sizing, shared by the eligibility gate, the driver and the
+#: kernel body. 'cc' = complex->complex both stages; 'rc' = real-input
+#: rdft stage 1 (R2C forward head); 'cr' = real-output irdft stage 2
+#: (R2C backward tail).
+_MODE_CHANNELS = {"cc": (2, 2, 3, 3), "rc": (1, 2, 2, 3),
+                  "cr": (2, 1, 3, 2)}
 
 #: Tighter budget for the two-stage kernels: their in-VMEM transpose and
 #: two live dot accumulators cost Mosaic more than the footprint formula
@@ -232,13 +183,6 @@ def plane_tp(a_in, b_in, b_out, a_out, n_chan_in, n_chan_out,
     return None
 
 
-#: (input channels, output channels, stage-1 matrices, stage-2 matrices)
-#: per two-stage kernel mode — the single source for the VMEM sizing
-#: used by both the eligibility gate and the kernels themselves.
-_MODE_CHANNELS = {"cc": (2, 2, 3, 3), "rc": (1, 2, 2, 3),
-                  "cr": (2, 1, 3, 2)}
-
-
 def _tp2(mode: str, a_in: int, b_in: int, b_out: int, a_out: int):
     ci, co, m1, m2 = _MODE_CHANNELS[mode]
     return plane_tp(a_in, b_in, b_out, a_out, ci, co,
@@ -251,72 +195,75 @@ def fits2(mode: str, a_in: int, b_in: int, b_out: int, a_out: int) -> bool:
     return _tp2(mode, a_in, b_in, b_out, a_out) is not None
 
 
-def _pallas2(kernel, ins, in_specs, out_shapes, out_specs, grid,
-             interpret):
+def _kernel2(mode, *refs):
+    """Shared two-stage kernel body: stage-1 dot over the minor axis,
+    in-VMEM swap of the two minor axes, stage-2 dot over the new minor
+    axis. Operand refs are laid out [inputs, stage-1 mats, stage-2 mats,
+    outputs] per ``_MODE_CHANNELS[mode]``."""
+    n_in, n_out, m1, m2 = _MODE_CHANNELS[mode]
+    ins = refs[:n_in]
+    c1 = [r[...] for r in refs[n_in:n_in + m1]]
+    c2 = [r[...] for r in refs[n_in + m1:n_in + m1 + m2]]
+    outs = refs[n_in + m1 + m2:]
+    tp, a_in, b_in = ins[0].shape
+    b_out = c1[0].shape[1]
+    flat = [r[...].reshape(tp * a_in, b_in) for r in ins]
+    if mode == "rc":
+        gr, gi = _dot(flat[0], c1[0]), _dot(flat[0], c1[1])
+    else:
+        gr, gi = _kara(flat[0], flat[1], *c1)
+    gr = jnp.swapaxes(gr.reshape(tp, a_in, b_out), -1, -2) \
+        .reshape(tp * b_out, a_in)
+    gi = jnp.swapaxes(gi.reshape(tp, a_in, b_out), -1, -2) \
+        .reshape(tp * b_out, a_in)
+    if mode == "cr":
+        h = _dot(gr, c2[0]) + _dot(gi, c2[1])
+        outs[0][...] = h.reshape(tp, b_out, h.shape[1])
+    else:
+        hr, hi = _kara(gr, gi, *c2)
+        outs[0][...] = hr.reshape(tp, b_out, hr.shape[1])
+        outs[1][...] = hi.reshape(tp, b_out, hi.shape[1])
+
+
+def _run2(mode, ins, mats1, mats2, interpret):
+    c1 = tuple(jnp.asarray(m) for m in mats1)
+    c2 = tuple(jnp.asarray(m) for m in mats2)
+    n_in, n_out, m1, m2 = _MODE_CHANNELS[mode]
+    p, a_in, b_in = ins[0].shape
+    b_out = c1[0].shape[1]
+    a_out = c2[0].shape[1]
+    tp = _tp2(mode, a_in, b_in, b_out, a_out)
+    assert tp is not None, "caller must gate on fits2"
     return pl.pallas_call(
-        kernel, grid=grid, in_specs=in_specs, out_specs=out_specs,
-        out_shape=out_shapes, interpret=interpret)(*ins)
+        functools.partial(_kernel2, mode),
+        grid=(pl.cdiv(p, tp),),
+        in_specs=[pl.BlockSpec((tp, a_in, b_in), lambda i: (i, 0, 0))] * n_in
+        + [pl.BlockSpec((b_in, b_out), lambda i: (0, 0))] * m1
+        + [pl.BlockSpec((a_in, a_out), lambda i: (0, 0))] * m2,
+        out_specs=[pl.BlockSpec((tp, b_out, a_out),
+                                lambda i: (i, 0, 0))] * n_out,
+        out_shape=[jax.ShapeDtypeStruct((p, b_out, a_out),
+                                        jnp.float32)] * n_out,
+        interpret=interpret,
+    )(*ins, *c1, *c2)
 
 
 def pdft2(xr, xi, mats1, mats2, interpret: bool = False):
     """Fused [stage-1 minor dot, transpose, stage-2 minor dot] on planar
     complex operands: ``(P, A, B) -> (P, B', A')`` — replaces
     ``pdft_last(mats1) ; swapaxes(-1, -2) ; pdft_last(mats2)``."""
-    c1 = tuple(jnp.asarray(m) for m in mats1)
-    c2 = tuple(jnp.asarray(m) for m in mats2)
-    p, a_in, b_in = xr.shape
-    b_out = c1[0].shape[1]
-    a_out = c2[0].shape[1]
-    tp = _tp2("cc", a_in, b_in, b_out, a_out)
-    assert tp is not None, "caller must gate on fits2"
-    mspecs = [pl.BlockSpec((b_in, b_out), lambda i: (0, 0))] * 3 \
-        + [pl.BlockSpec((a_in, a_out), lambda i: (0, 0))] * 3
-    yr, yi = _pallas2(
-        _kernel2_cc, (xr, xi) + c1 + c2,
-        [pl.BlockSpec((tp, a_in, b_in), lambda i: (i, 0, 0))] * 2 + mspecs,
-        [jax.ShapeDtypeStruct((p, b_out, a_out), jnp.float32)] * 2,
-        [pl.BlockSpec((tp, b_out, a_out), lambda i: (i, 0, 0))] * 2,
-        (pl.cdiv(p, tp),), interpret)
+    yr, yi = _run2("cc", (xr, xi), mats1, mats2, interpret)
     return yr, yi
 
 
 def prdft2(x, mats1, mats2, interpret: bool = False):
     """R2C head twin of :func:`pdft2`: real input, stage 1 is the
     half-spectrum real DFT (two dots), stage 2 complex."""
-    c1 = tuple(jnp.asarray(m) for m in mats1)
-    c2 = tuple(jnp.asarray(m) for m in mats2)
-    p, a_in, b_in = x.shape
-    b_out = c1[0].shape[1]
-    a_out = c2[0].shape[1]
-    tp = _tp2("rc", a_in, b_in, b_out, a_out)
-    assert tp is not None, "caller must gate on fits2"
-    mspecs = [pl.BlockSpec((b_in, b_out), lambda i: (0, 0))] * 2 \
-        + [pl.BlockSpec((a_in, a_out), lambda i: (0, 0))] * 3
-    yr, yi = _pallas2(
-        _kernel2_rc, (x,) + c1 + c2,
-        [pl.BlockSpec((tp, a_in, b_in), lambda i: (i, 0, 0))] + mspecs,
-        [jax.ShapeDtypeStruct((p, b_out, a_out), jnp.float32)] * 2,
-        [pl.BlockSpec((tp, b_out, a_out), lambda i: (i, 0, 0))] * 2,
-        (pl.cdiv(p, tp),), interpret)
+    yr, yi = _run2("rc", (x,), mats1, mats2, interpret)
     return yr, yi
 
 
 def pdft2_cr(xr, xi, mats1, mats2, interpret: bool = False):
     """C2R tail twin of :func:`pdft2`: stage 1 complex, stage 2 the real
     inverse DFT (two dots into one real output)."""
-    c1 = tuple(jnp.asarray(m) for m in mats1)
-    c2 = tuple(jnp.asarray(m) for m in mats2)
-    p, a_in, b_in = xr.shape
-    b_out = c1[0].shape[1]
-    a_out = c2[0].shape[1]
-    tp = _tp2("cr", a_in, b_in, b_out, a_out)
-    assert tp is not None, "caller must gate on fits2"
-    mspecs = [pl.BlockSpec((b_in, b_out), lambda i: (0, 0))] * 3 \
-        + [pl.BlockSpec((a_in, a_out), lambda i: (0, 0))] * 2
-    out = _pallas2(
-        _kernel2_cr, (xr, xi) + c1 + c2,
-        [pl.BlockSpec((tp, a_in, b_in), lambda i: (i, 0, 0))] * 2 + mspecs,
-        [jax.ShapeDtypeStruct((p, b_out, a_out), jnp.float32)],
-        [pl.BlockSpec((tp, b_out, a_out), lambda i: (i, 0, 0))],
-        (pl.cdiv(p, tp),), interpret)
-    return out[0]
+    return _run2("cr", (xr, xi), mats1, mats2, interpret)[0]
