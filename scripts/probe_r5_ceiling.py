@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Round-5 probe: what would PERFECT compression fusion buy?
+
+Times pair variants with stages replaced by shape-correct no-ops
+(results are wrong; traffic is the point):
+
+  A. real pair                      (reference point)
+  B. pair, decompress -> broadcast  (values ignored; sticks faked from
+                                     a cheap slice-free reshape)
+  C. pair, compress -> slice        (values faked by slicing sticks)
+  D. both replaced                  (the DFT+transpose core alone)
+
+A-D bounds the total compression cost including boundaries; comparing
+with the standalone stage numbers separates scheduling overlap from
+real stage time. Decides whether a merged gather+DFT kernel is worth
+building.
+
+Usage: DIM=256 python scripts/probe_r5_ceiling.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from spfft_tpu import TransformType, make_local_plan
+from spfft_tpu.utils.benchtime import diff_estimate_seconds
+from spfft_tpu.utils.workloads import spherical_cutoff_triplets
+
+DIM = int(os.environ.get("DIM", 256))
+
+
+def sync(out):
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    return float(np.asarray(jnp.real(leaf).ravel()[0]))
+
+
+def measure(f, *args, reps=16):
+    g = jax.jit(f)
+    sync(g(*args))
+
+    def grp(k):
+        t0 = time.perf_counter()
+        o = None
+        for _ in range(k):
+            o = g(*args)
+        sync(o)
+        return time.perf_counter() - t0
+    return diff_estimate_seconds(grp, reps=reps).seconds
+
+
+def main():
+    tri = spherical_cutoff_triplets(DIM)
+    plan = make_local_plan(TransformType.C2C, DIM, DIM, DIM, tri)
+    p = plan.index_plan
+    tabs = plan._tables_hot
+    n = p.num_values
+    rng = np.random.default_rng(7)
+    vals = (rng.uniform(-1, 1, n) + 1j * rng.uniform(-1, 1, n)).astype(
+        np.complex64)
+    vil = jax.device_put(plan._coerce_values(vals))
+    s_pad, Z = plan._s_pad, p.dim_z
+    nslots = s_pad * Z
+
+    def fake_dec(v):
+        # values (n, 2) -> (s_pad, Z) x2 without a gather: tile the
+        # first rows cyclically via cheap reshape of a padded slice
+        flat = v.reshape(-1)
+        rep = nslots * 2 // flat.size + 1
+        big = jnp.concatenate([flat] * rep)[:nslots * 2].reshape(-1, 2)
+        return big[:, 0].reshape(s_pad, Z), big[:, 1].reshape(s_pad, Z)
+
+    def fake_cmp(sr, si):
+        flat = jnp.stack([sr.reshape(-1), si.reshape(-1)], axis=-1)
+        return flat[:n]
+
+    def pair_real(v):
+        return plan._forward_impl(plan._backward_impl(v, tabs), tabs,
+                                  scaled=False)
+
+    def bw_core(sr, si):
+        out = plan._backward_rest_tp(sr, si, tabs)
+        return jnp.stack([out[0], out[1]], axis=-1)
+
+    def pair_nodec(v):
+        sr, si = fake_dec(v)
+        space = bw_core(sr, si)
+        return plan._forward_impl(space, tabs, scaled=False)
+
+    def pair_nocmp(v):
+        space = bw_core(*plan._decompress_planar(v, tabs))
+        sp = (space[..., 0], space[..., 1])
+        sr, si = plan._forward_head_tp(sp, tabs, None)
+        return fake_cmp(sr, si)
+
+    def pair_neither(v):
+        sr, si = fake_dec(v)
+        space = bw_core(sr, si)
+        sp = (space[..., 0], space[..., 1])
+        sr2, si2 = plan._forward_head_tp(sp, tabs, None)
+        return fake_cmp(sr2, si2)
+
+    for name, f in [("A real pair     ", pair_real),
+                    ("B no decompress ", pair_nodec),
+                    ("C no compress   ", pair_nocmp),
+                    ("D neither       ", pair_neither)]:
+        t = measure(f, vil)
+        print(f"{name}: {t*1e3:7.3f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Round-5 probe: forced (kp, K) sweep of the 256^3 compress gather.
+
+The auto-chosen wide tables for the compress direction DMA K=192-row
+windows (kp=12), reading ~385 MB for a 105 MB source (3.7x overfetch).
+Sweeps forced sub-window/DMA-window heights and times the bare kernel;
+if a tighter config wins, the builder's cost model gets re-calibrated.
+
+Usage: python scripts/probe_r5_cmp_sweep.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from spfft_tpu import TransformType, make_local_plan
+from spfft_tpu.ops import gather_kernel as gk
+from spfft_tpu.utils.benchtime import diff_estimate_seconds
+from spfft_tpu.utils.workloads import spherical_cutoff_triplets
+
+DIM = int(os.environ.get("DIM", 256))
+
+
+def sync(out):
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    return float(np.asarray(jnp.real(leaf).ravel()[0]))
+
+
+def measure(f, *args, reps=14):
+    g = jax.jit(f)
+    sync(g(*args))
+
+    def grp(k):
+        t0 = time.perf_counter()
+        o = None
+        for _ in range(k):
+            o = g(*args)
+        sync(o)
+        return time.perf_counter() - t0
+    return diff_estimate_seconds(grp, reps=reps).seconds
+
+
+def main():
+    tri = spherical_cutoff_triplets(DIM)
+    plan = make_local_plan(TransformType.C2C, DIM, DIM, DIM, tri)
+    plan._finalize()
+    p = plan.index_plan
+    vi = p.value_indices.astype(np.int64)
+    num_slots = plan._s_pad * p.dim_z
+    (_, _), (cmp_idx, cmp_valid) = gk.compression_gather_inputs(
+        vi, num_slots)
+
+    rng = np.random.default_rng(3)
+    src_rows_flat = -(-num_slots // 128)
+    re = jax.device_put(jnp.asarray(
+        rng.standard_normal((src_rows_flat, 128)), jnp.float32))
+    im = jax.device_put(jnp.asarray(
+        rng.standard_normal((src_rows_flat, 128)), jnp.float32))
+
+    ref = None
+    configs = [(0, 0)] + [(kp, 0) for kp in (8, 10, 12, 16, 20, 24)] \
+        + [(12, 96), (12, 128), (12, 160), (16, 128), (10, 128), (8, 96),
+           (8, 64), (10, 96)]
+    seen = set()
+    for kp, K in configs:
+        if (kp, K) in seen:
+            continue
+        seen.add((kp, K))
+        try:
+            t = gk.build_wide_gather_tables(cmp_idx, cmp_valid, num_slots,
+                                            kp_rows=kp, k_rows=K)
+        except Exception as e:  # noqa: BLE001
+            print(f"kp={kp:3d} K={K:3d}: build failed {e}", flush=True)
+            continue
+        if t is None:
+            print(f"kp={kp:3d} K={K:3d}: builder refused", flush=True)
+            continue
+        dev = gk.gather_device_tables(t)
+        out = jax.jit(lambda a, b: gk.run_gather(a, b, dev, t))(re, im)
+        got = np.asarray(out[0].reshape(-1)[:t.num_out])
+        if ref is None:
+            ref = got
+        else:
+            assert np.array_equal(got, ref), "config changed results!"
+        sec = measure(lambda a, b: gk.run_gather(a, b, dev, t), re, im)
+        traffic = (t.row0.shape[0] * t.span_rows * 128 * 4 * 2
+                   + t.num_out * 4 * 2) / 1e9
+        print(f"kp={kp:3d} K={K:3d}: chunks={t.row0.shape[0]:5d} "
+              f"span={t.span_rows:3d} segs={len(t.segs) if t.segs else 1} "
+              f"-> {sec*1e3:7.3f} ms ({traffic/sec:5.0f} GB/s modeled)",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

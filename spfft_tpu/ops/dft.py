@@ -240,6 +240,17 @@ def _fused_ok(xr, *mats_list) -> bool:
             and dk.eligible_mats(*mats_list))
 
 
+def _fits2_ok(mode, xr, mats1, mats2) -> bool:
+    """Shared two-stage dispatch gate: 3-D f32 operand, eligible plain
+    matrices (eligible_mats rejects TwoStageMats and over-cap axes),
+    kernel enabled, and the mode's VMEM fit."""
+    if xr.ndim != 3 or not _fused_ok(xr, mats1, mats2):
+        return False
+    from . import dft_kernel as dk
+    return dk.fits2(mode, xr.shape[1], xr.shape[2],
+                    mats1[0].shape[1], mats2[0].shape[1])
+
+
 def pdft_last_opt(xr, xi, mats):
     """:func:`pdft_last` through the fused stage kernel when eligible."""
     if not isinstance(mats, TwoStageMats) and _fused_ok(xr, mats):
@@ -256,13 +267,9 @@ def pdft2_minor(xr, xi, mats1, mats2):
     """[minor DFT (mats1), transpose, minor DFT (mats2)] on planar
     complex ``(P, A, B)`` operands -> ``(P, B', A')``: one fused kernel
     when eligible, else the three-pass XLA form with per-stage fusion."""
-    if (xr.ndim == 3 and not isinstance(mats1, TwoStageMats)
-            and not isinstance(mats2, TwoStageMats)
-            and _fused_ok(xr, mats1, mats2)):
+    if _fits2_ok("cc", xr, mats1, mats2):
         from . import dft_kernel as dk
-        if dk.fits2("cc", xr.shape[1], xr.shape[2],
-                    mats1[0].shape[1], mats2[0].shape[1]):
-            return dk.pdft2(xr, xi, mats1, mats2)
+        return dk.pdft2(xr, xi, mats1, mats2)
     gr, gi = pdft_last_opt(xr, xi, mats1)
     gr, gi = _swap_pair(gr, gi)
     return pdft_last_opt(gr, gi, mats2)
@@ -270,25 +277,35 @@ def pdft2_minor(xr, xi, mats1, mats2):
 
 def prdft2_minor(x, mats1, mats2):
     """R2C head twin of :func:`pdft2_minor`: real in, rdft stage 1."""
-    if (x.ndim == 3 and not isinstance(mats2, TwoStageMats)
-            and _fused_ok(x, mats1, mats2)):
+    if _fits2_ok("rc", x, mats1, mats2):
         from . import dft_kernel as dk
-        if dk.fits2("rc", x.shape[1], x.shape[2],
-                    mats1[0].shape[1], mats2[0].shape[1]):
-            return dk.prdft2(x, mats1, mats2)
+        return dk.prdft2(x, mats1, mats2)
     gr, gi = prdft_last(x, mats1)
     gr, gi = _swap_pair(gr, gi)
     return pdft_last_opt(gr, gi, mats2)
 
 
+def cdft2_xy(x, mats_minor, mats_mid):
+    """[minor-axis DFT (mats_minor), mid-axis DFT (mats_mid)] on a
+    complex ``(..., mid, minor)`` operand -> ``(..., k_mid, k_minor)``
+    — the distributed xy-stage shape (ops.stages.xy_*_c2c). One fused
+    kernel with both transposes in VMEM when eligible; otherwise the
+    XLA pair of stages around materialised swaps."""
+    xr, xi = jnp.real(x), jnp.imag(x)
+    if _fits2_ok("cc", xr, mats_minor, mats_mid):
+        from . import dft_kernel as dk
+        yr, yi = dk.pdft2_swapped(xr, xi, mats_minor, mats_mid)
+        return yr + 1j * yi
+    y = cdft_last(x, mats_minor)
+    y = cdft_last(jnp.swapaxes(y, -1, -2), mats_mid)
+    return jnp.swapaxes(y, -1, -2)
+
+
 def pdft2_minor_cr(xr, xi, mats1, mats2):
     """C2R tail twin of :func:`pdft2_minor`: irdft stage 2, real out."""
-    if (xr.ndim == 3 and not isinstance(mats1, TwoStageMats)
-            and _fused_ok(xr, mats1, mats2)):
+    if _fits2_ok("cr", xr, mats1, mats2):
         from . import dft_kernel as dk
-        if dk.fits2("cr", xr.shape[1], xr.shape[2],
-                    mats1[0].shape[1], mats2[0].shape[1]):
-            return dk.pdft2_cr(xr, xi, mats1, mats2)
+        return dk.pdft2_cr(xr, xi, mats1, mats2)
     gr, gi = pdft_last_opt(xr, xi, mats1)
     gr, gi = _swap_pair(gr, gi)
     return pirdft_last(gr, gi, mats2)

@@ -56,14 +56,23 @@ from .dft import MATMUL_DFT_MAX
 _HI = jax.lax.Precision.HIGHEST
 _DN = (((1,), (0,)), ((), ()))
 
-#: Longest axis the fused kernels accept — the matmul-DFT cap itself
-#: (above it the pipeline uses the two-stage Cooley-Tukey XLA form).
-MAX_DIM = MATMUL_DFT_MAX
+#: Longest axis the fused kernels accept. EMPIRICAL, not the matmul-DFT
+#: cap: above 320 the two-stage xy kernel no longer fits VMEM, and the
+#: single-stage kernel alone measures a net LOSS against the XLA stages
+#: (same-session interleaved A/B: 384^3 pair 56.5 vs 54.2 ms, 512^3
+#: 161.2 vs 148.2 — the shrunken row tiles forced by the compile
+#: ceiling spend more on matrix streaming than the combine fusion
+#: saves), while <= 320 wins (256^3 12.3 -> 10.5, 320^3 36.6 -> 33.1).
+MAX_DIM = min(320, MATMUL_DFT_MAX)
 
 #: Per-kernel VMEM budget (bytes) the single-stage tile chooser aims
-#: under. v5e has ~16 MB/core; staying near half leaves room for
-#: Mosaic's own double-buffering of the streamed operand tiles.
-_VMEM_BUDGET = 9 * 1024 * 1024
+#: under. The EMPIRICAL compile ceiling on v5e is ~5.5 MB by the
+#: footprint formula (tm sweep at 384/512: 5.2 MB compiles, 7.3 MB
+#: crashes the compile helper — Mosaic's double-buffering of streamed
+#: tiles and dot accumulators roughly doubles the formula), so both
+#: budgets sit just under it. 256-class stages keep tm=1024 (5.0 MB);
+#: 384 -> tm=512, 512 -> tm=256.
+_VMEM_BUDGET = int(5.5 * 1024 * 1024)
 
 
 def enabled() -> bool:
@@ -195,11 +204,14 @@ def fits2(mode: str, a_in: int, b_in: int, b_out: int, a_out: int) -> bool:
     return _tp2(mode, a_in, b_in, b_out, a_out) is not None
 
 
-def _kernel2(mode, *refs):
+def _kernel2(mode, swap_out, *refs):
     """Shared two-stage kernel body: stage-1 dot over the minor axis,
     in-VMEM swap of the two minor axes, stage-2 dot over the new minor
     axis. Operand refs are laid out [inputs, stage-1 mats, stage-2 mats,
-    outputs] per ``_MODE_CHANNELS[mode]``."""
+    outputs] per ``_MODE_CHANNELS[mode]``. ``swap_out`` stores the
+    result transposed back to ``(tp, a_out, b_out)`` — the layout the
+    distributed xy wrappers end in — with one more in-VMEM swap instead
+    of a materialised HBM pass."""
     n_in, n_out, m1, m2 = _MODE_CHANNELS[mode]
     ins = refs[:n_in]
     c1 = [r[...] for r in refs[n_in:n_in + m1]]
@@ -216,16 +228,20 @@ def _kernel2(mode, *refs):
         .reshape(tp * b_out, a_in)
     gi = jnp.swapaxes(gi.reshape(tp, a_in, b_out), -1, -2) \
         .reshape(tp * b_out, a_in)
+
+    def store(ref, h):
+        h = h.reshape(tp, b_out, h.shape[1])
+        ref[...] = jnp.swapaxes(h, -1, -2) if swap_out else h
+
     if mode == "cr":
-        h = _dot(gr, c2[0]) + _dot(gi, c2[1])
-        outs[0][...] = h.reshape(tp, b_out, h.shape[1])
+        store(outs[0], _dot(gr, c2[0]) + _dot(gi, c2[1]))
     else:
         hr, hi = _kara(gr, gi, *c2)
-        outs[0][...] = hr.reshape(tp, b_out, hr.shape[1])
-        outs[1][...] = hi.reshape(tp, b_out, hi.shape[1])
+        store(outs[0], hr)
+        store(outs[1], hi)
 
 
-def _run2(mode, ins, mats1, mats2, interpret):
+def _run2(mode, ins, mats1, mats2, interpret, swap_out=False):
     c1 = tuple(jnp.asarray(m) for m in mats1)
     c2 = tuple(jnp.asarray(m) for m in mats2)
     n_in, n_out, m1, m2 = _MODE_CHANNELS[mode]
@@ -234,15 +250,16 @@ def _run2(mode, ins, mats1, mats2, interpret):
     a_out = c2[0].shape[1]
     tp = _tp2(mode, a_in, b_in, b_out, a_out)
     assert tp is not None, "caller must gate on fits2"
+    oshape = (a_out, b_out) if swap_out else (b_out, a_out)
     return pl.pallas_call(
-        functools.partial(_kernel2, mode),
+        functools.partial(_kernel2, mode, swap_out),
         grid=(pl.cdiv(p, tp),),
         in_specs=[pl.BlockSpec((tp, a_in, b_in), lambda i: (i, 0, 0))] * n_in
         + [pl.BlockSpec((b_in, b_out), lambda i: (0, 0))] * m1
         + [pl.BlockSpec((a_in, a_out), lambda i: (0, 0))] * m2,
-        out_specs=[pl.BlockSpec((tp, b_out, a_out),
+        out_specs=[pl.BlockSpec((tp,) + oshape,
                                 lambda i: (i, 0, 0))] * n_out,
-        out_shape=[jax.ShapeDtypeStruct((p, b_out, a_out),
+        out_shape=[jax.ShapeDtypeStruct((p,) + oshape,
                                         jnp.float32)] * n_out,
         interpret=interpret,
     )(*ins, *c1, *c2)
@@ -267,3 +284,12 @@ def pdft2_cr(xr, xi, mats1, mats2, interpret: bool = False):
     """C2R tail twin of :func:`pdft2`: stage 1 complex, stage 2 the real
     inverse DFT (two dots into one real output)."""
     return _run2("cr", (xr, xi), mats1, mats2, interpret)[0]
+
+
+def pdft2_swapped(xr, xi, mats1, mats2, interpret: bool = False):
+    """:func:`pdft2` with the result stored back in ``(P, A', B')``
+    order (one more in-VMEM swap) — the layout the distributed xy stage
+    wrappers produce, replacing their two materialised ``swapaxes``
+    passes (ops.stages._cdft_mid)."""
+    yr, yi = _run2("cc", (xr, xi), mats1, mats2, interpret, swap_out=True)
+    return yr, yi

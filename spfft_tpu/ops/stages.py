@@ -251,8 +251,8 @@ def xy_backward_c2c(grid):
     dim_y, dim_x = grid.shape[-2], grid.shape[-1]
     scale = grid.real.dtype.type(dim_y * dim_x)
     if _mdft_axes(grid.dtype, dim_y, dim_x):
-        grid = dft.cdft_last(grid, dft.c2c_mats(dim_x, dft.BACKWARD))
-        return _cdft_mid(grid, dft.c2c_mats(dim_y, dft.BACKWARD))
+        return dft.cdft2_xy(grid, dft.c2c_mats(dim_x, dft.BACKWARD),
+                            dft.c2c_mats(dim_y, dft.BACKWARD))
     return jnp.fft.ifft2(_mat(grid), axes=(-2, -1)) * scale
 
 
@@ -260,8 +260,8 @@ def xy_forward_c2c(grid):
     """Forward DFT over (y, x) per plane."""
     dim_y, dim_x = grid.shape[-2], grid.shape[-1]
     if _mdft_axes(grid.dtype, dim_y, dim_x):
-        grid = dft.cdft_last(grid, dft.c2c_mats(dim_x, dft.FORWARD))
-        return _cdft_mid(grid, dft.c2c_mats(dim_y, dft.FORWARD))
+        return dft.cdft2_xy(grid, dft.c2c_mats(dim_x, dft.FORWARD),
+                            dft.c2c_mats(dim_y, dft.FORWARD))
     return jnp.fft.fft2(_mat(grid), axes=(-2, -1))
 
 

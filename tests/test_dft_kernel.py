@@ -123,3 +123,33 @@ def test_vmem_gate():
     assert not dk.fits2("cc", 512, 512, 512, 512)
     assert dk.plane_tp(256, 256, 256, 256, 2, 2,
                        6 * 256 * 256) in (1, 2, 4)
+
+
+def test_pdft2_swapped_matches_three_pass():
+    p, a, b = 5, 12, 16
+    xr, xi = _rand((p, a, b), 20), _rand((p, a, b), 21)
+    m1 = dft.c2c_mats(b, dft.BACKWARD)
+    m2 = dft.c2c_mats(a, dft.BACKWARD)
+    wr, wi = dft.pdft_last(xr, xi, m1)
+    wr, wi = jnp.swapaxes(wr, -1, -2), jnp.swapaxes(wi, -1, -2)
+    wr, wi = dft.pdft_last(wr, wi, m2)
+    wr, wi = jnp.swapaxes(wr, -1, -2), jnp.swapaxes(wi, -1, -2)
+    got = dk.pdft2_swapped(xr, xi, m1, m2, interpret=True)
+    _close(got[0], wr)
+    _close(got[1], wi)
+
+
+def test_cdft2_xy_fallback_off_tpu():
+    """On CPU the complex dispatcher must reproduce the two-stage XLA
+    form bit-for-bit (it IS that form when the kernel is ineligible)."""
+    p, a, b = 4, 10, 12
+    rng = np.random.default_rng(22)
+    x = jnp.asarray(rng.standard_normal((p, a, b))
+                    + 1j * rng.standard_normal((p, a, b)), jnp.complex64)
+    m1 = dft.c2c_mats(b, dft.FORWARD)
+    m2 = dft.c2c_mats(a, dft.FORWARD)
+    want = dft.cdft_last(x, m1)
+    want = dft.cdft_last(jnp.swapaxes(want, -1, -2), m2)
+    want = jnp.swapaxes(want, -1, -2)
+    got = dft.cdft2_xy(x, m1, m2)
+    assert np.array_equal(np.asarray(got), np.asarray(want))

@@ -330,3 +330,51 @@ def test_batched_vmap_over_fused_kernels():
     for k, v in enumerate(vals):
         got = fwd_b[k]
         assert _rel(got[:, 0] + 1j * got[:, 1], v) < TOL
+
+
+def test_stage_kernel_compile_envelope():
+    """The kernel tile chooser must pick configs that actually COMPILE
+    on this chip: the formula-vs-Mosaic gap crashed 320^3/384^3 plans
+    when the budget allowed 7-8 MB tiles (envelope regression, fixed by
+    the 5.5 MB empirical ceiling). Compiles one stage at each larger
+    axis class and the complex xy dispatcher on device."""
+    import jax
+    import jax.numpy as jnp
+    from spfft_tpu.ops import dft, dft_kernel as dk
+
+    rng = np.random.default_rng(30)
+    for n in (384, 512):
+        mats = dft.c2c_mats(n, dft.BACKWARD)
+        xr = jnp.asarray(rng.standard_normal((1536, n)), jnp.float32)
+        xi = jnp.asarray(rng.standard_normal((1536, n)), jnp.float32)
+        yr, yi = jax.jit(
+            lambda a, b, m=mats: dk.pdft_last(a, b, m))(xr, xi)
+        got = np.asarray(yr, np.float64) + 1j * np.asarray(yi, np.float64)
+        want = np.fft.ifft(np.asarray(xr, np.float64)
+                           + 1j * np.asarray(xi, np.float64), axis=-1) * n
+        assert _rel(got, want) < 1e-5
+
+    # complex xy dispatcher (the distributed wrappers' fused path) at
+    # n=64 (fast correctness) and at n=320 — the LARGEST eligible axis
+    # class, where the swap_out variant's extra transposed buffers sit
+    # closest to the Mosaic compile ceiling the VMEM formula does not
+    # model. Complex cannot cross the host<->device boundary on this
+    # backend, so the complex value is formed and split inside the jit.
+    for n, p in ((64, 8), (320, 4)):
+        xr = jnp.asarray(rng.standard_normal((p, n, n)), jnp.float32)
+        xi = jnp.asarray(rng.standard_normal((p, n, n)), jnp.float32)
+        m1 = dft.c2c_mats(n, dft.BACKWARD)
+        m2 = dft.c2c_mats(n, dft.BACKWARD)
+
+        def run(a, b, m1=m1, m2=m2):
+            y = dft.cdft2_xy(a + 1j * b, m1, m2)
+            return jnp.real(y), jnp.imag(y)
+
+        gr, gi = jax.jit(run)(xr, xi)
+        got = np.asarray(gr, np.float64) + 1j * np.asarray(gi, np.float64)
+        want = np.fft.ifft2(np.asarray(xr, np.float64)
+                            + 1j * np.asarray(xi, np.float64),
+                            axes=(-2, -1)) * (n * n)
+        assert _rel(got, want) < 1e-5
+        hlo = jax.jit(run).lower(xr, xi).as_text()
+        assert "tpu_custom_call" in hlo
