@@ -14,10 +14,17 @@ missing the 1e-6 contract per pass); this re-checks the tradeoff at the
 whole-pair level under the round-5 sync-robust estimator.
 
 Usage: DIM=256 python scripts/probe_r5_precision_ab.py
+
+NOTE (post fused kernels): the sweep monkeypatches dft._HIGHEST, which
+only reaches the XLA stage forms — the Pallas kernels hardcode HIGHEST.
+The probe therefore forces SPFFT_TPU_FUSED_STAGE=0 so the A/B varies
+what it claims to (its recorded numbers predate the kernels).
 """
 import os
 import sys
 import time
+
+os.environ.setdefault("SPFFT_TPU_FUSED_STAGE", "0")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

@@ -252,8 +252,12 @@ def _fits2_ok(mode, xr, mats1, mats2) -> bool:
 
 
 def pdft_last_opt(xr, xi, mats):
-    """:func:`pdft_last` through the fused stage kernel when eligible."""
-    if not isinstance(mats, TwoStageMats) and _fused_ok(xr, mats):
+    """:func:`pdft_last` through the fused stage kernel when eligible.
+    Complex 3-matrix tuples only — a 2-matrix rdft tuple would pass the
+    shared eligibility check (it is valid for the two-stage kernels) but
+    crash the single-stage kernel's unpack."""
+    if (not isinstance(mats, TwoStageMats) and len(mats) == 3
+            and _fused_ok(xr, mats)):
         from . import dft_kernel as dk
         return dk.pdft_last(xr, xi, mats)
     return pdft_last(xr, xi, mats)
