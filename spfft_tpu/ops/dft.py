@@ -31,8 +31,10 @@ one planar twiddle multiply (fused elementwise), stage-2 dot over N2,
 and a final minor-axes swap — keeping 768/1024-class axes off the
 conv-lowered ``jnp.fft`` TPU path entirely (round-4 verdict item; the
 reference gets arbitrary N from FFTW plans, fftw_plan_1d.hpp:74-94).
-Axes above the cap with no such factorization (primes > 512) still fall
-back to ``jnp.fft`` in ops.stages.
+Axes above the cap with no such factorization run the DIRECT form up
+to ``MATMUL_DFT_DIRECT_FALLBACK_MAX`` (primes have no cheaper matmul
+route); only lengths beyond that fall back to ``jnp.fft`` in
+ops.stages.
 
 Reference parity: these replace the reference's FFTW/cuFFT plan objects
 (reference: src/fft/fftw_plan_1d.hpp:74-94, src/fft/transform_1d_gpu.hpp)
@@ -48,10 +50,38 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-#: Longest axis the direct matmul-DFT handles; beyond this ops.stages
-#: falls back to jnp.fft (the O(N^2) flops would dominate, and no
-#: workload in the reference's envelope exceeds it).
+#: Longest axis the direct matmul-DFT PREFERS; composite lengths above
+#: it run the two-stage Cooley-Tukey split (fewer MXU flops).
 MATMUL_DFT_MAX = 512
+
+#: Unfactorable lengths (primes, and composites whose smallest balanced
+#: split exceeds the cap) still run the DIRECT matmul form up to this
+#: length: for a prime there is no cheaper matmul route — Bluestein at
+#: the padded power-of-two costs MORE flops than N^2 here (N=1021
+#: direct = 1.04M MACs/row vs three length-4096 passes ~ 1.57M) — and
+#: the jnp.fft fallback is the conv-lowered O(N^2) TPU path with the
+#: compile-explosion hazard the matmul layer exists to avoid
+#: (scripts/probe_fftcompile.py). Beyond this, jnp.fft remains (the
+#: reference gets any N from FFTW, fftw_plan_1d.hpp:74-94).
+MATMUL_DFT_DIRECT_FALLBACK_MAX = 1024
+
+
+def _mdft_covered_len(n: int) -> bool:
+    """A length the matmul layer can execute: direct (incl. the direct
+    fallback for unfactorable lengths) or two-stage."""
+    return (n <= MATMUL_DFT_DIRECT_FALLBACK_MAX
+            or two_stage_factor(n) is not None)
+
+
+def _direct_form_len(n: int) -> bool:
+    """Lengths whose matrix builders yield PLAIN matrix tuples — the
+    split-window row/column selections and the hermitian x-stage need
+    them (TwoStageMats does not row/column-select). Composite lengths
+    above the cap return TwoStageMats from c2c_mats and so do NOT
+    qualify; unfactorable ones up to the direct fallback cap do."""
+    return n <= MATMUL_DFT_MAX or (
+        two_stage_factor(n) is None
+        and n <= MATMUL_DFT_DIRECT_FALLBACK_MAX)
 
 _HIGHEST = jax.lax.Precision.HIGHEST
 
@@ -379,12 +409,17 @@ def c2c_mats(n: int, sign: int, scale: float = 1.0):
     # BACKWARD is the unnormalised inverse: e^{+...} with no 1/n — the
     # caller's extra scale folds directly either way
     if n > MATMUL_DFT_MAX:
-        if two_stage_factor(n) is None:
-            raise ValueError(
-                f"axis length {n} exceeds MATMUL_DFT_MAX={MATMUL_DFT_MAX} "
-                f"and has no two-factor split with both factors <= the "
-                f"cap — gate with use_matmul_dft()")
-        return _two_stage_mats(n, s, float(scale))
+        if two_stage_factor(n) is not None:
+            return _two_stage_mats(n, s, float(scale))
+        if n <= MATMUL_DFT_DIRECT_FALLBACK_MAX:
+            # unfactorable (prime-class) length: direct form (see
+            # MATMUL_DFT_DIRECT_FALLBACK_MAX for the flop rationale)
+            return _dft_mats(n, s, float(scale))
+        raise ValueError(
+            f"axis length {n} exceeds MATMUL_DFT_MAX={MATMUL_DFT_MAX} "
+            f"with no two-factor split and exceeds the direct fallback "
+            f"cap {MATMUL_DFT_DIRECT_FALLBACK_MAX} — gate with "
+            f"use_matmul_dft()")
     return _dft_mats(n, s, float(scale))
 
 
@@ -434,7 +469,7 @@ def mdft_axes(dtype, *dims, direct=()) -> bool:
     r2c half-spectrum matrices do not factor through the two-stage
     decomposition)."""
     return (all(use_matmul_dft(d, dtype) for d in dims)
-            and all(d <= MATMUL_DFT_MAX for d in direct))
+            and all(_direct_form_len(d) for d in direct))
 
 
 def mdft_coverable(dims, hermitian: bool = False) -> bool:
@@ -443,9 +478,8 @@ def mdft_coverable(dims, hermitian: bool = False) -> bool:
     two-stage; hermitian x-axis = ``dims[0]`` direct-only)? Used by the
     precision model, which must not depend on the importing process's
     backend."""
-    ok = all(d <= MATMUL_DFT_MAX or two_stage_factor(d) is not None
-             for d in dims)
-    return ok and (not hermitian or dims[0] <= MATMUL_DFT_MAX)
+    ok = all(_mdft_covered_len(d) for d in dims)
+    return ok and (not hermitian or _direct_form_len(dims[0]))
 
 
 def use_matmul_dft(n: int, dtype) -> bool:
@@ -457,7 +491,7 @@ def use_matmul_dft(n: int, dtype) -> bool:
     import os
     single = jnp.dtype(dtype) in (jnp.dtype(jnp.float32),
                                   jnp.dtype(jnp.complex64))
-    covered = n <= MATMUL_DFT_MAX or two_stage_factor(n) is not None
+    covered = _mdft_covered_len(n)
     if os.environ.get("SPFFT_TPU_FORCE_MATMUL_DFT") == "1":
         return single and covered  # force past the backend gate
     if os.environ.get("SPFFT_TPU_NO_MATMUL_DFT") == "1":

@@ -72,13 +72,16 @@ def predicted_rel_error(precision: str, max_dim: int,
     dynamic range stayed at 1.9e-7 relative l2
     (docs/precision.md 'Adversarial rows').
 
-    Calibrated domain: the matmul-DFT forms (direct or two-stage,
-    single precision) and the CPU f64 path. Plans the matmul pipeline
-    cannot cover (a prime axis above the cap, an R2C x-axis above the
-    direct cap) execute through XLA's ``jnp.fft`` lowering, where the
-    envelope is extrapolation — an extra 4x safety factor applies so
-    the contract fails loudly rather than promising uncalibrated
-    accuracy (round-4 advisor finding). ``mdft_covered`` is the
+    Calibrated domain: the matmul-DFT forms (direct — incl. the
+    prime-fallback lengths, measured 1.44e-7 at a 521 axis and 1.42e-7
+    at 1021 on-chip — or two-stage, single precision) and the CPU f64
+    path. Plans the matmul pipeline cannot cover (an unfactorable axis
+    above the direct-fallback cap; an R2C x-axis that is neither
+    direct-cap nor prime-fallback, e.g. composite 768) execute through
+    XLA's ``jnp.fft`` lowering, where the envelope is extrapolation —
+    an extra 4x safety factor applies so the contract fails loudly
+    rather than promising uncalibrated accuracy (round-4 advisor
+    finding). ``mdft_covered`` is the
     STRUCTURAL routing answer (ops.dft.mdft_coverable) from the caller;
     ``None`` infers it from ``max_dim`` alone (single-axis query).
     """
@@ -478,12 +481,14 @@ class TransformPlan:
         self._split_x = None
         if p.num_sticks == 0:
             return
-        from .ops.dft import MATMUL_DFT_MAX
         if self._ds:
             return  # the double-single pipeline runs the dense path
-        if self._use_mdft and p.dim_x > MATMUL_DFT_MAX:
+        from .ops.dft import _direct_form_len
+        if self._use_mdft and not _direct_form_len(p.dim_x):
             # the split-x contraction needs row/column-selected DIRECT
-            # matrices; a two-stage x-axis runs dense instead
+            # matrices; a two-stage (composite > cap) x-axis runs dense
+            # instead — prime-fallback lengths keep the split (they ARE
+            # direct)
             return
         xf = p.dim_x_freq
         xs = p.scatter_cols % xf

@@ -162,10 +162,12 @@ def test_plan_roundtrip_through_matmul_path(monkeypatch, ttype, dims):
 def test_use_matmul_dft_gating(monkeypatch):
     monkeypatch.setenv("SPFFT_TPU_FORCE_MATMUL_DFT", "1")
     assert dft.use_matmul_dft(256, jnp.complex64)
-    # above the direct cap: composite lengths ride the two-stage split,
-    # primes (no factorization with both factors <= the cap) fall back
+    # above the direct cap: composite lengths ride the two-stage split;
+    # unfactorable (prime-class) lengths run the direct fallback up to
+    # MATMUL_DFT_DIRECT_FALLBACK_MAX; beyond it, jnp.fft
     assert dft.use_matmul_dft(768, jnp.complex64)
-    assert not dft.use_matmul_dft(521, jnp.complex64)
+    assert dft.use_matmul_dft(521, jnp.complex64)
+    assert not dft.use_matmul_dft(2 * 521, jnp.complex64)  # 1042 > 1024
     monkeypatch.delenv("SPFFT_TPU_FORCE_MATMUL_DFT")
     monkeypatch.setenv("SPFFT_TPU_NO_MATMUL_DFT", "1")
     assert not dft.use_matmul_dft(256, jnp.complex64)

@@ -34,11 +34,17 @@ def test_factorization(n):
         assert n % cand != 0
 
 
-def test_factor_gates():
+def test_factor_gates(monkeypatch):
     assert dft.two_stage_factor(256) is None      # direct form
     assert dft.two_stage_factor(521) is None      # prime above the cap
     assert dft.two_stage_factor(2 * 521) is None  # no pair <= cap
-    assert not dft.use_matmul_dft(521, jnp.complex64)
+    # primes above the cap run the DIRECT fallback (round 5) up to
+    # MATMUL_DFT_DIRECT_FALLBACK_MAX; 1042 = 2*521 exceeds it
+    monkeypatch.setenv("SPFFT_TPU_FORCE_MATMUL_DFT", "1")
+    assert dft.use_matmul_dft(521, jnp.complex64)
+    assert not dft.use_matmul_dft(2 * 521, jnp.complex64)
+    monkeypatch.delenv("SPFFT_TPU_FORCE_MATMUL_DFT")
+    assert not dft.use_matmul_dft(521, jnp.complex64)  # CPU backend gate
     assert dft.matmul_dft_limit() == dft.MATMUL_DFT_MAX ** 2
 
 

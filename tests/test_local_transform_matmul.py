@@ -17,3 +17,91 @@ def _force_matmul_dft(monkeypatch):
 
 
 from tests.test_local_transform import *  # noqa: F401,F403,E402
+
+
+# ---------------------------------------------------------------------------
+# Round-5: unfactorable axes above MATMUL_DFT_MAX run the DIRECT matmul
+# form up to MATMUL_DFT_DIRECT_FALLBACK_MAX (primes have no two-stage
+# split and the jnp.fft fallback is the conv-lowered O(N^2) TPU path;
+# reference covers any N via FFTW, fftw_plan_1d.hpp:74-94)
+# ---------------------------------------------------------------------------
+
+import numpy as np  # noqa: E402
+
+from spfft_tpu import Scaling, TransformType, make_local_plan  # noqa: E402
+from spfft_tpu.ops import dft as _dft  # noqa: E402
+
+
+def test_prime_axis_direct_fallback_c2c():
+    assert _dft.use_matmul_dft(521, np.complex64)
+    mats = _dft.c2c_mats(521, _dft.BACKWARD)
+    assert not isinstance(mats, _dft.TwoStageMats)
+    nx, ny, nz = 6, 5, 521
+    rng = np.random.default_rng(3)
+    tr = np.unique(np.stack([rng.integers(0, nx, 900),
+                             rng.integers(0, ny, 900),
+                             rng.integers(0, nz, 900)], -1), axis=0)
+    plan = make_local_plan(TransformType.C2C, nx, ny, nz, tr,
+                           precision="single")
+    assert plan._use_mdft
+    vals = (rng.standard_normal(len(tr))
+            + 1j * rng.standard_normal(len(tr))).astype(np.complex64)
+    space = np.asarray(plan.backward(vals))
+    cube = np.zeros((nz, ny, nx), np.complex64)
+    cube[tr[:, 2], tr[:, 1], tr[:, 0]] = vals
+    want = np.fft.ifftn(cube) * cube.size
+    got = space[..., 0] + 1j * space[..., 1]
+    rel = np.linalg.norm(got - want) / np.linalg.norm(want)
+    assert rel < 1e-6, rel
+    out = np.asarray(plan.forward(space, Scaling.FULL))
+    rt = np.linalg.norm(out[:, 0] + 1j * out[:, 1] - vals) \
+        / np.linalg.norm(vals)
+    assert rt < 1e-6, rt
+
+
+def test_r2c_prime_x_direct_fallback():
+    """Hermitian x-axis above the cap (613 prime): the half-spectrum
+    matrices are direct at any length, so the plan is mdft-covered."""
+    nx, ny, nz = 613, 4, 4
+    rng = np.random.default_rng(5)
+    field = rng.standard_normal((nz, ny, nx)).astype(np.float32)
+    freq = np.fft.fftn(field)
+    tr = np.asarray([(x, y, z) for x in range(nx // 2 + 1)
+                     for y in range(ny) for z in range(nz)], np.int64)
+    vals = freq[tr[:, 2], tr[:, 1], tr[:, 0]].astype(np.complex64)
+    plan = make_local_plan(TransformType.R2C, nx, ny, nz, tr,
+                           precision="single")
+    assert plan._use_mdft
+    space = np.asarray(plan.backward(vals))
+    rel = np.linalg.norm(space - field * field.size) \
+        / np.linalg.norm(field * field.size)
+    assert rel < 1e-6, rel
+
+
+def test_split_x_with_prime_fallback_axis():
+    """Prime x-axis above the cap (521) with a narrow occupied window:
+    the split-x optimization stays ENABLED (direct row/column-selected
+    matrices exist for prime-fallback lengths; only two-stage composite
+    axes run dense)."""
+    nx, ny, nz = 521, 6, 6
+    rng = np.random.default_rng(7)
+    xs = [0, 1, 2, 520]  # wrapped narrow window
+    tr = np.asarray([(x, y, z) for x in xs for y in range(ny)
+                     for z in range(nz) if rng.random() < 0.8], np.int64)
+    plan = make_local_plan(TransformType.C2C, nx, ny, nz, tr,
+                           precision="single")
+    assert plan._use_mdft
+    assert plan._split_x is not None
+    vals = (rng.standard_normal(len(tr))
+            + 1j * rng.standard_normal(len(tr))).astype(np.complex64)
+    space = np.asarray(plan.backward(vals))
+    cube = np.zeros((nz, ny, nx), np.complex64)
+    cube[tr[:, 2], tr[:, 1], tr[:, 0]] = vals
+    want = np.fft.ifftn(cube) * cube.size
+    got = space[..., 0] + 1j * space[..., 1]
+    rel = np.linalg.norm(got - want) / np.linalg.norm(want)
+    assert rel < 1e-6, rel
+    out = np.asarray(plan.forward(space, Scaling.FULL))
+    rt = np.linalg.norm(out[:, 0] + 1j * out[:, 1] - vals) \
+        / np.linalg.norm(vals)
+    assert rt < 1e-6, rt

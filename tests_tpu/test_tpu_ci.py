@@ -378,3 +378,61 @@ def test_stage_kernel_compile_envelope():
         assert _rel(got, want) < 1e-5
         hlo = jax.jit(run).lower(xr, xi).as_text()
         assert "tpu_custom_call" in hlo
+
+
+def test_multi_transform_on_device():
+    """multi_transform on the chip, both execution regimes: three
+    clones of one plan (fused vmapped batch over the Pallas kernels —
+    the path the CPU suite runs on XLA stages only) and two DISTINCT
+    plans (per-transform async dispatch); each result must match the
+    plan's own single execution."""
+    from spfft_tpu import Transform
+    from spfft_tpu.multi import (multi_transform_backward,
+                                 multi_transform_forward)
+    n = 48
+    tr = spherical_cutoff_triplets(n)
+    plan = make_local_plan(TransformType.C2C, n, n, n, tr,
+                           precision="single")
+    base = Transform(plan)
+    clones = [base.clone() for _ in range(3)]
+    vals = [_values(len(tr), s) for s in (21, 22, 23)]
+    outs = multi_transform_backward(clones, vals)
+    for o, v in zip(outs, vals):
+        assert _rel(np.asarray(o), np.asarray(plan.backward(v))) < 1e-7
+    fouts = multi_transform_forward(clones, [np.asarray(o) for o in outs])
+    for f, o in zip(fouts, outs):
+        want = np.asarray(plan.forward(np.asarray(o)))
+        assert _rel(np.asarray(f), want) < 1e-7
+
+    m = 40
+    tr2 = spherical_cutoff_triplets(m)
+    plan_b = make_local_plan(TransformType.C2C, m, m, m, tr2,
+                             precision="single")
+    pair = [Transform(plan), Transform(plan_b)]
+    vals2 = [vals[0], _values(len(tr2), 24)]
+    outs2 = multi_transform_backward(pair, vals2)
+    assert _rel(np.asarray(outs2[0]),
+                np.asarray(plan.backward(vals2[0]))) < 1e-7
+    assert _rel(np.asarray(outs2[1]),
+                np.asarray(plan_b.backward(vals2[1]))) < 1e-7
+
+
+def test_prime_axis_direct_on_device():
+    """617-point (prime > MATMUL_DFT_MAX) z-axis through the direct
+    matmul fallback on real hardware — the round-5 coverage extension
+    that keeps prime axes off the conv-lowered jnp.fft TPU path."""
+    nx, ny, nz = 8, 8, 617
+    rng = np.random.default_rng(31)
+    tr = np.unique(np.stack([rng.integers(0, nx, 2000),
+                             rng.integers(0, ny, 2000),
+                             rng.integers(0, nz, 2000)], -1), axis=0)
+    plan = make_local_plan(TransformType.C2C, nx, ny, nz, tr,
+                           precision="single")
+    assert plan._use_mdft
+    vals = _values(len(tr), 32)
+    space = np.asarray(plan.backward(vals))
+    got = space[..., 0] + 1j * space[..., 1]
+    oracle = _dense_c2c_oracle(tr, vals, (nx, ny, nz))
+    assert _rel(got, oracle) < TOL
+    out = np.asarray(plan.forward(space, Scaling.FULL))
+    assert _rel(out[:, 0] + 1j * out[:, 1], vals) < TOL
