@@ -56,13 +56,16 @@ MATMUL_DFT_MAX = 512
 
 #: Unfactorable lengths (primes, and composites whose smallest balanced
 #: split exceeds the cap) still run the DIRECT matmul form up to this
-#: length: for a prime there is no cheaper matmul route — Bluestein at
-#: the padded power-of-two costs MORE flops than N^2 here (N=1021
-#: direct = 1.04M MACs/row vs three length-4096 passes ~ 1.57M) — and
-#: the jnp.fft fallback is the conv-lowered O(N^2) TPU path with the
-#: compile-explosion hazard the matmul layer exists to avoid
-#: (scripts/probe_fftcompile.py). Beyond this, jnp.fft remains (the
-#: reference gets any N from FFTW, fftw_plan_1d.hpp:74-94).
+#: length. Bluestein at the padded length (2048 for N=1021) can cost
+#: FEWER MACs (~0.59M/row via two-stage 2048 passes vs 1.04M direct)
+#: but spends THREE grid-scale passes plus chirp elementwise traffic
+#: where the direct form spends one — the same movement-vs-flops trade
+#: the measured radix-split experiment lost (module docstring;
+#: probe_r4_dft2.py) — and the jnp.fft fallback is the conv-lowered
+#: O(N^2) TPU path with the compile-explosion hazard the matmul layer
+#: exists to avoid (scripts/probe_fftcompile.py). Beyond this cap the
+#: N^2 flops genuinely dominate and jnp.fft remains (the reference
+#: gets any N from FFTW, fftw_plan_1d.hpp:74-94).
 MATMUL_DFT_DIRECT_FALLBACK_MAX = 1024
 
 
@@ -459,17 +462,22 @@ def sub_cols_r2c_mats(n: int, cols: tuple, scale: float = 1.0):
     return _sub_cols(r2c_mats(n, scale), np.asarray(cols))
 
 
-def mdft_axes(dtype, *dims, direct=()) -> bool:
+def mdft_axes(dtype, *dims, direct=(), direct_any=()) -> bool:
     """THE shared matmul-DFT routing predicate (one home so the plan
     pipeline, the stage-level xy gates and the precision model cannot
     drift): every axis in ``dims`` must be coverable (direct or
-    two-stage — per axis, not just the max: one prime axis above the
-    cap must fail the whole gate), and axes in ``direct`` additionally
-    need the direct form (split-window row/column selections and the
-    r2c half-spectrum matrices do not factor through the two-stage
-    decomposition)."""
+    two-stage — per axis, not just the max: one unfactorable axis above
+    the fallback cap must fail the whole gate). Axes in ``direct``
+    additionally need PLAIN c2c matrices (split-window row/column
+    selections of ``c2c_mats``; composite lengths above the cap return
+    TwoStageMats and do not qualify). Axes in ``direct_any`` need only
+    a real-transform builder (``r2c_mats``/``c2r_mats`` are plain
+    direct matrices at ANY length up to the fallback cap — composite
+    768-class R2C x-axes included)."""
     return (all(use_matmul_dft(d, dtype) for d in dims)
-            and all(_direct_form_len(d) for d in direct))
+            and all(_direct_form_len(d) for d in direct)
+            and all(d <= MATMUL_DFT_DIRECT_FALLBACK_MAX
+                    for d in direct_any))
 
 
 def mdft_coverable(dims, hermitian: bool = False) -> bool:
@@ -479,7 +487,8 @@ def mdft_coverable(dims, hermitian: bool = False) -> bool:
     precision model, which must not depend on the importing process's
     backend."""
     ok = all(_mdft_covered_len(d) for d in dims)
-    return ok and (not hermitian or _direct_form_len(dims[0]))
+    return ok and (not hermitian
+                   or dims[0] <= MATMUL_DFT_DIRECT_FALLBACK_MAX)
 
 
 def use_matmul_dft(n: int, dtype) -> bool:

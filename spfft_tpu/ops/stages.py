@@ -350,7 +350,7 @@ def xy_backward_r2c_split(sub, x0: int, dim_x: int, dim_x_freq: int):
     (planes, dim_y, dim_x). Reference: the per-selected-row vertical plan,
     transform_1d_host.hpp:137-196."""
     dim_y, w = sub.shape[-2], sub.shape[-1]
-    if _mdft_axes(sub.dtype, dim_y, dim_x, direct=(dim_x,)):
+    if _mdft_axes(sub.dtype, dim_y, dim_x, direct_any=(dim_x,)):
         sub = _cdft_mid(sub, dft.c2c_mats(dim_y, dft.BACKWARD))
         rows = tuple(range(x0, x0 + w))
         return dft.pirdft_last(jnp.real(sub), jnp.imag(sub),
@@ -366,7 +366,7 @@ def xy_forward_r2c_split(space, x0: int, w: int):
     then the y-DFT only on the occupied half-spectrum columns. ``space``
     is real (planes, dim_y, dim_x); returns (planes, dim_y, w) complex."""
     dim_y, dim_x = space.shape[-2], space.shape[-1]
-    if _mdft_axes(space.dtype, dim_y, dim_x, direct=(dim_x,)):
+    if _mdft_axes(space.dtype, dim_y, dim_x, direct_any=(dim_x,)):
         cols = tuple(range(x0, x0 + w))
         yr, yi = dft.prdft_last(space,
                                 dft.sub_cols_r2c_mats(dim_x, cols))
@@ -386,7 +386,7 @@ def xy_backward_r2c(grid, dim_x: int):
     rank-3 irfft corruption by construction.
     """
     dim_y = grid.shape[-2]
-    if _mdft_axes(grid.dtype, dim_y, dim_x, direct=(dim_x,)):
+    if _mdft_axes(grid.dtype, dim_y, dim_x, direct_any=(dim_x,)):
         grid = _cdft_mid(grid, dft.c2c_mats(dim_y, dft.BACKWARD))
         return dft.pirdft_last(jnp.real(grid), jnp.imag(grid),
                                dft.c2r_mats(dim_x))
@@ -402,7 +402,7 @@ def xy_forward_r2c(space):
     (planes, dim_y, dim_x//2+1) complex.
     """
     dim_y, dim_x = space.shape[-2], space.shape[-1]
-    if _mdft_axes(space.dtype, dim_y, dim_x, direct=(dim_x,)):
+    if _mdft_axes(space.dtype, dim_y, dim_x, direct_any=(dim_x,)):
         yr, yi = dft.prdft_last(space, dft.r2c_mats(dim_x))
         return _cdft_mid(yr + 1j * yi, dft.c2c_mats(dim_y, dft.FORWARD))
     grid = jnp.fft.rfft(_mat(space), axis=-1)

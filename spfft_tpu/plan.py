@@ -76,9 +76,9 @@ def predicted_rel_error(precision: str, max_dim: int,
     prime-fallback lengths, measured 1.44e-7 at a 521 axis and 1.42e-7
     at 1021 on-chip — or two-stage, single precision) and the CPU f64
     path. Plans the matmul pipeline cannot cover (an unfactorable axis
-    above the direct-fallback cap; an R2C x-axis that is neither
-    direct-cap nor prime-fallback, e.g. composite 768) execute through
-    XLA's ``jnp.fft`` lowering, where the envelope is extrapolation —
+    above the direct-fallback cap; an R2C x-axis above it) execute
+    through XLA's ``jnp.fft`` lowering, where the envelope is
+    extrapolation —
     an extra 4x safety factor applies so the contract fails loudly
     rather than promising uncalibrated accuracy (round-4 advisor
     finding). ``mdft_covered`` is the
@@ -209,7 +209,7 @@ class TransformPlan:
         self._use_mdft = _dft.mdft_axes(
             self._cdt, index_plan.dim_x, index_plan.dim_y,
             index_plan.dim_z,
-            direct=(index_plan.dim_x,) if index_plan.hermitian else ())
+            direct_any=(index_plan.dim_x,) if index_plan.hermitian else ())
         if self._pair_io:
             # Layout flip is observable by callers (forward/apply_pointwise
             # return (2, N) instead of (N, 2)); say so once at plan build.
@@ -483,12 +483,16 @@ class TransformPlan:
             return
         if self._ds:
             return  # the double-single pipeline runs the dense path
-        from .ops.dft import _direct_form_len
-        if self._use_mdft and not _direct_form_len(p.dim_x):
-            # the split-x contraction needs row/column-selected DIRECT
-            # matrices; a two-stage (composite > cap) x-axis runs dense
-            # instead — prime-fallback lengths keep the split (they ARE
-            # direct)
+        from .ops.dft import (MATMUL_DFT_DIRECT_FALLBACK_MAX,
+                              _direct_form_len)
+        x_direct = (p.dim_x <= MATMUL_DFT_DIRECT_FALLBACK_MAX
+                    if self._is_r2c else _direct_form_len(p.dim_x))
+        if self._use_mdft and not x_direct:
+            # the split-x contraction needs PLAIN row/column-selected
+            # matrices: the C2C builders return TwoStageMats for
+            # composite axes above the cap (those run dense), while the
+            # r2c/c2r builders are direct at any length up to the
+            # fallback cap — prime-fallback and R2C axes keep the split
             return
         xf = p.dim_x_freq
         xs = p.scatter_cols % xf

@@ -142,14 +142,20 @@ def test_full_pipeline_two_stage_c2c(tiny_cap):
     assert rel < 1e-5, rel
 
 
-def test_r2c_long_x_stays_off_mdft(tiny_cap):
-    """An R2C plan whose x-axis exceeds the direct cap must not claim
-    the matmul pipeline (half-spectrum matrices don't factor)."""
+def test_r2c_long_x_mdft_coverage(tiny_cap, monkeypatch):
+    """An R2C x-axis above the c2c cap still claims the matmul pipeline
+    (the half-spectrum builders are plain direct matrices at any length
+    up to the fallback cap — round 5); above the FALLBACK cap it must
+    not."""
     n = 12
     tr = np.array([[0, 0, 0], [1, 2, 3], [2, 1, 0]])
     plan = make_local_plan(TransformType.R2C, n, n, n, tr,
                            precision="single")
-    assert not plan._use_mdft
+    assert plan._use_mdft  # 12 > tiny cap 8, but <= the fallback cap
+    monkeypatch.setattr(dft, "MATMUL_DFT_DIRECT_FALLBACK_MAX", 8)
+    plan2 = make_local_plan(TransformType.R2C, n, n, n, tr,
+                            precision="single")
+    assert not plan2._use_mdft
 
 
 def test_precision_model_penalises_uncalibrated_path():

@@ -105,3 +105,28 @@ def test_split_x_with_prime_fallback_axis():
     rt = np.linalg.norm(out[:, 0] + 1j * out[:, 1] - vals) \
         / np.linalg.norm(vals)
     assert rt < 1e-6, rt
+
+
+def test_r2c_composite_x_above_cap_direct_any():
+    """Composite R2C x-axis above the cap (768 = 2^8*3): the
+    half-spectrum builders are plain direct matrices at any length, so
+    the plan is mdft-covered even though c2c_mats(768) would be
+    TwoStageMats (round-5 review follow-up)."""
+    nx, ny, nz = 768, 4, 4
+    rng = np.random.default_rng(8)
+    field = rng.standard_normal((nz, ny, nx)).astype(np.float32)
+    freq = np.fft.fftn(field)
+    tr = np.asarray([(x, y, z) for x in range(nx // 2 + 1)
+                     for y in range(ny) for z in range(nz)], np.int64)
+    vals = freq[tr[:, 2], tr[:, 1], tr[:, 0]].astype(np.complex64)
+    plan = make_local_plan(TransformType.R2C, nx, ny, nz, tr,
+                           precision="single")
+    assert plan._use_mdft
+    space = np.asarray(plan.backward(vals))
+    rel = np.linalg.norm(space - field * field.size) \
+        / np.linalg.norm(field * field.size)
+    assert rel < 1e-6, rel
+    out = np.asarray(plan.forward(space, Scaling.FULL))
+    rt = np.linalg.norm(out[:, 0] + 1j * out[:, 1] - vals) \
+        / np.linalg.norm(vals)
+    assert rt < 1e-6, rt
