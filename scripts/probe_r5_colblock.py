@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Round-5 probe: column-blocked single-stage kernel for 384/512 axes.
+
+At 384/512 the compile ceiling forces small row tiles (tm=512/256) and
+the fused stage loses to XLA (matrix streaming dominates). A 2D grid
+(row tiles x output-column blocks) shrinks the resident matrix slice so
+tm can stay large; the input block is constant over the column steps
+(Mosaic keeps it resident). Measures compile + time vs the XLA form and
+the current 1D kernel.
+
+Usage: python scripts/probe_r5_colblock.py
+"""
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from spfft_tpu.ops import dft, dft_kernel as dk
+from spfft_tpu.utils.benchtime import diff_estimate_seconds
+
+_HI = jax.lax.Precision.HIGHEST
+_DN = (((1,), (0,)), ((), ()))
+
+
+def colblock_pdft(xr, xi, mats, tm, mb):
+    cr, ci, cs = (jnp.asarray(m) for m in mats)
+    k, mo = cr.shape
+    m = xr.shape[0]
+    return pl.pallas_call(
+        dk._stage_kernel,
+        grid=(pl.cdiv(m, tm), pl.cdiv(mo, mb)),
+        in_specs=[
+            pl.BlockSpec((tm, k), lambda i, j: (i, 0)),
+            pl.BlockSpec((tm, k), lambda i, j: (i, 0)),
+            pl.BlockSpec((k, mb), lambda i, j: (0, j)),
+            pl.BlockSpec((k, mb), lambda i, j: (0, j)),
+            pl.BlockSpec((k, mb), lambda i, j: (0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((tm, mb), lambda i, j: (i, j)),
+            pl.BlockSpec((tm, mb), lambda i, j: (i, j)),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((m, mo), jnp.float32)] * 2,
+    )(xr, xi, cr, ci, cs)
+
+
+def sync(o):
+    return float(np.asarray(jnp.real(o[0]).ravel()[0]))
+
+
+def measure(g, xr, xi, chain=3, reps=14):
+    def body(a, b):
+        o = g(a, b)
+        for _ in range(chain - 1):
+            o = g(o[0], o[1])
+        return o
+    f = jax.jit(body)
+    sync(f(xr, xi))
+
+    def grp(kk):
+        t0 = time.perf_counter()
+        o = None
+        for _ in range(kk):
+            o = f(xr, xi)
+        sync(o)
+        return time.perf_counter() - t0
+    return diff_estimate_seconds(grp, reps=reps).seconds / chain
+
+
+def main():
+    rng = np.random.default_rng(5)
+    for n, m in ((384, 147456), (512, 262144)):
+        mats = dft.c2c_mats(n, dft.BACKWARD)
+        xr = jnp.asarray(rng.standard_normal((m, n)), jnp.float32)
+        xi = jnp.asarray(rng.standard_normal((m, n)), jnp.float32)
+        t = measure(lambda a, b, mm=mats: dft.pdft_last(a, b, mm), xr, xi)
+        print(f"n={n} XLA stage         : {t*1e3:7.3f} ms", flush=True)
+        t = measure(lambda a, b, mm=mats: dk.pdft_last(a, b, mm), xr, xi)
+        print(f"n={n} kernel tm={dk._stage_tm(n, n):4d}    : {t*1e3:7.3f} ms",
+              flush=True)
+        for tm, mb in ((1024, 128), (1024, 256), (2048, 128)):
+            try:
+                t = measure(lambda a, b, mm=mats, t_=tm, b_=mb:
+                            colblock_pdft(a, b, mm, t_, b_), xr, xi)
+                print(f"n={n} colblock tm={tm} mb={mb}: {t*1e3:7.3f} ms",
+                      flush=True)
+            except Exception as e:  # noqa: BLE001
+                print(f"n={n} colblock tm={tm} mb={mb}: FAIL "
+                      f"{str(e).splitlines()[0][:60]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -267,10 +267,10 @@ def pirdft_last(yr, yi, mats):
 # form above, same math and layouts. SPFFT_TPU_FUSED_STAGE=0 forces the
 # XLA form everywhere (the probes' A/B knob).
 
-def _fused_ok(xr, *mats_list) -> bool:
+def _fused_ok(xr, *mats_list, cap=None) -> bool:
     from . import dft_kernel as dk
     return (dk.enabled() and xr.dtype == jnp.float32
-            and dk.eligible_mats(*mats_list))
+            and dk.eligible_mats(*mats_list, cap=cap))
 
 
 def _fits2_ok(mode, xr, mats1, mats2) -> bool:
@@ -288,9 +288,18 @@ def pdft_last_opt(xr, xi, mats):
     """:func:`pdft_last` through the fused stage kernel when eligible.
     Complex 3-matrix tuples only — a 2-matrix rdft tuple would pass the
     shared eligibility check (it is valid for the two-stage kernels) but
-    crash the single-stage kernel's unpack."""
+    crash the single-stage kernel's unpack.
+
+    2-D operands (the z-stages, and the vmapped batched z-stages) take
+    the kernel up to the full matmul cap: standalone the kernel beats
+    the XLA stage at 384/512 too (4.09 vs 4.82 / 12.63 vs 13.58 ms —
+    probe_r5_colblock.py); the >320 pair-level LOSS that set
+    dft_kernel.MAX_DIM comes from the materialised swapaxes between
+    kernel xy stages (XLA dots absorb those transposes via layout
+    freedom, Pallas boundaries cannot), which a z-stage does not have."""
     if (not isinstance(mats, TwoStageMats) and len(mats) == 3
-            and _fused_ok(xr, mats)):
+            and _fused_ok(xr, mats, cap=(MATMUL_DFT_MAX if xr.ndim == 2
+                                         else None))):
         from . import dft_kernel as dk
         return dk.pdft_last(xr, xi, mats)
     return pdft_last(xr, xi, mats)

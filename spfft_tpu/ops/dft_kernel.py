@@ -90,12 +90,15 @@ def _plain_mats(mats) -> bool:
                     for m in mats))
 
 
-def eligible_mats(*mats_list) -> bool:
-    """All matrix tuples are plain and within the kernel's axis cap."""
+def eligible_mats(*mats_list, cap=None) -> bool:
+    """All matrix tuples are plain and within the axis cap (default
+    ``MAX_DIM``; the z-stage dispatch passes the full matmul cap — see
+    dft.pdft_last_opt)."""
+    limit = MAX_DIM if cap is None else cap
     for mats in mats_list:
         if not _plain_mats(mats):
             return False
-        if any(d > MAX_DIM for m in mats for d in m.shape):
+        if any(d > limit for m in mats for d in m.shape):
             return False
     return True
 
