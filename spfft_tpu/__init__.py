@@ -8,12 +8,14 @@ execution, and distributed slab<->pencil decomposition over a TPU device mesh
 via ``shard_map`` + ``lax.all_to_all``.
 """
 
-from .errors import (AllocationError, DeviceAllocationError, DeviceError,
+from .errors import (AllocationError, DeadlineExpiredError,
+                     DeviceAllocationError, DeviceError,
                      DeviceFFTError, DeviceSupportError, DistributedError,
                      DistributedSupportError, DuplicateIndicesError, ErrorCode,
                      FFTError, GenericError, HostExecutionError, InternalError,
                      InvalidIndicesError, InvalidParameterError, OverflowError_,
-                     ParameterMismatchError, PrecisionContractError)
+                     ParameterMismatchError, PrecisionContractError,
+                     QueueFullError, ServeError)
 from .indexing import IndexPlan, build_index_plan, check_stick_duplicates
 from .parallel import (DistributedIndexPlan, DistributedTransformPlan,
                        build_distributed_plan,
@@ -36,6 +38,7 @@ __all__ = [
     "DistributedError", "ParameterMismatchError", "HostExecutionError",
     "FFTError", "InternalError", "DeviceError", "DeviceSupportError",
     "DeviceAllocationError", "DeviceFFTError",
+    "ServeError", "QueueFullError", "DeadlineExpiredError",
     "ExchangeType", "ProcessingUnit", "IndexFormat", "TransformType",
     "Scaling",
     "IndexPlan", "build_index_plan", "check_stick_duplicates",

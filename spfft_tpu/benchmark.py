@@ -70,6 +70,11 @@ def _parse_args(argv):
     p.add_argument("--fused-pair", action="store_true",
                    help="time backward+forward as ONE fused executable "
                         "(apply_pointwise identity; requires -m 1)")
+    p.add_argument("--serve", action="store_true",
+                   help="route the -m transforms through the serving "
+                        "layer (spfft_tpu.serve: registry + batching "
+                        "executor) instead of multi_transform_*; local "
+                        "plans only (requires --shards 1)")
     p.add_argument("--shards", type=int, default=1,
                    help="distribute over an N-device mesh (default local)")
     p.add_argument("--cpu", action="store_true",
@@ -80,6 +85,8 @@ def _parse_args(argv):
     args = p.parse_args(argv)
     if args.fused_pair and args.num_transforms != 1:
         p.error("--fused-pair requires -m 1")
+    if args.serve and (args.shards > 1 or args.fused_pair):
+        p.error("--serve requires --shards 1 and no --fused-pair")
     return args
 
 
@@ -230,7 +237,28 @@ def main(argv=None) -> int:
     transforms = [Transform(plan) for _ in range(args.num_transforms)]
     m = args.num_transforms
 
-    if args.fused_pair:
+    serve_executor = None
+    if args.serve:
+        # the serving layer over the SAME plan: the registry is seeded
+        # with the already-built plan and each repeat submits one
+        # backward + one forward request per transform — the executor's
+        # same-signature bucketing turns each phase into fused batches
+        from .serve import PlanRegistry, PlanSignature, ServeExecutor
+        registry = PlanRegistry()
+        serve_sig = PlanSignature.of_plan(plan)
+        registry.put(serve_sig, plan)
+        serve_executor = ServeExecutor(registry)
+        serve_executor.prewarm(serve_sig)
+
+        def run_pair(vals):
+            spaces = [f.result() for f in
+                      [serve_executor.submit(serve_sig, vals)
+                       for _ in range(m)]]
+            outs = [f.result() for f in
+                    [serve_executor.submit(serve_sig, s, "forward")
+                     for s in spaces]]
+            return outs
+    elif args.fused_pair:
         def run_pair(vals):
             # one executable for backward+forward (apply_pointwise with
             # the identity operator) — the layout bench.py measures
@@ -292,6 +320,10 @@ def main(argv=None) -> int:
         "plan_seconds": round(plan_s, 4),
         "pair_seconds": round(pair_s, 6),
     }
+    if serve_executor is not None:
+        serve_executor.close()
+        params["serve"] = serve_executor.metrics.snapshot(
+            serve_executor.registry)
     print(json.dumps(params, indent=2))
     result.print()
     if args.output:

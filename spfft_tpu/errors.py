@@ -116,6 +116,24 @@ class HostExecutionError(GenericError):
     code = ErrorCode.HOST_EXECUTION
 
 
+class ServeError(HostExecutionError):
+    """Base class of serving-layer failures (spfft_tpu.serve). The
+    serving layer is host-side orchestration over compiled plans, so
+    these report through the host-execution branch; no reference
+    counterpart exists (SpFFT has no request-driven executor)."""
+
+
+class QueueFullError(ServeError):
+    """The serving executor's bounded request queue is full —
+    backpressure is reject-with-error, never silent blocking, so
+    overloaded callers fail fast instead of stacking unbounded latency."""
+
+
+class DeadlineExpiredError(ServeError):
+    """A request's deadline elapsed before the executor dispatched it;
+    the work was never executed."""
+
+
 class FFTError(GenericError):
     """Failure inside the FFT backend (reference: exceptions.hpp:160-167,
     FFTWError; here: XLA Fft HLO)."""

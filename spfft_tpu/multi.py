@@ -60,33 +60,40 @@ FUSED_BATCH_MAX_GRID = 8_000_000
 FUSED_BATCH_MAX_DIST_TOTAL = 32_000_000
 
 
-def _shared_plan(transforms: Sequence[Transform]):
-    """If every transform wraps the *same* plan object (clones share their
-    plan) AND the batch is in the regime where fusion wins, return it —
-    the batch then runs as ONE fused executable (local: vmapped +
-    batched-grid kernel; distributed: one SPMD program with a per-shard
-    batch axis) instead of N dispatches. Returns None otherwise
-    (per-transform async dispatch, which XLA pipelines per device queue).
-
-    The local gate is on TOTAL batch work B * grid elements (round-3
+def fusion_eligible(plan, batch_size: int) -> bool:
+    """THE shared fusion gate: is a batch of ``batch_size`` transforms
+    over ``plan`` in the regime where the fused executable wins? Local
+    plans gate on TOTAL batch work B * grid elements (round-3
     sync-cancelled measurements: 128^3 B=3 = 6.3M fused wins 3.8x,
     128^3 B=8 = 16.8M loses 0.47x, 256^3 B=3 = 50M loses 0.60x — the
-    round-2 per-transform-size gate missed the B dependence)."""
+    round-2 per-transform-size gate missed the B dependence);
+    distributed plans on per-shard slab work (see
+    FUSED_BATCH_MAX_DIST_TOTAL). Shared by :func:`_shared_plan` and the
+    serving executor (spfft_tpu.serve.executor), so the batching policy
+    cannot drift between the two entry points."""
+    if batch_size < 2:
+        return False
+    if isinstance(plan, TransformPlan):
+        return batch_size * plan.global_size <= FUSED_BATCH_MAX_GRID
+    dp = plan.dist_plan
+    slab = dp.dim_x * dp.dim_y * dp.max_planes  # per-shard slab
+    return batch_size * slab <= FUSED_BATCH_MAX_DIST_TOTAL
+
+
+def _shared_plan(transforms: Sequence[Transform]):
+    """If every transform wraps the *same* plan object (clones share their
+    plan) AND the batch is in the regime where fusion wins
+    (:func:`fusion_eligible`), return it — the batch then runs as ONE
+    fused executable (local: vmapped + batched-grid kernel; distributed:
+    one SPMD program with a per-shard batch axis) instead of N
+    dispatches. Returns None otherwise (per-transform async dispatch,
+    which XLA pipelines per device queue)."""
     if len(transforms) < 2:
         return None
     plan = transforms[0].plan
     if any(t.plan is not plan for t in transforms[1:]):
         return None
-    B = len(transforms)
-    if isinstance(plan, TransformPlan):
-        if B * plan.global_size > FUSED_BATCH_MAX_GRID:
-            return None
-        return plan
-    dp = plan.dist_plan
-    slab = dp.dim_x * dp.dim_y * dp.max_planes  # per-shard slab
-    if B * slab > FUSED_BATCH_MAX_DIST_TOTAL:
-        return None
-    return plan
+    return plan if fusion_eligible(plan, len(transforms)) else None
 
 
 def multi_transform_backward(transforms: Sequence[Transform],

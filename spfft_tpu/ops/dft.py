@@ -43,8 +43,10 @@ Reference parity: these replace the reference's FFTW/cuFFT plan objects
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import functools
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -98,8 +100,61 @@ FORWARD = -1    # plain DFT
 # O(n^2) f32 matrices for the process lifetime in plan-churning servers.
 # 32 entries cover every axis of a handful of live plans; evicted
 # matrices rebuild in milliseconds at the next plan construction.
-@functools.lru_cache(maxsize=32)
-def _dft_mats(n: int, sign: int, scale: float):
+#
+# _dft_mats additionally caps resident BYTES (round-5 advisor finding):
+# an entry-count bound alone lets 32 prime-fallback triples at n>512
+# (a 1021 axis costs ~12.5 MB per triple) pin ~400 MB for the process
+# lifetime of a long-lived server; the byte-aware LRU below evicts
+# oldest-first once the total exceeds the budget, so worst-case
+# residency stays bounded regardless of axis mix.
+DFT_MATS_CACHE_BYTES = 96 * 1024 * 1024
+
+
+class _ByteLRU:
+    """A thread-safe LRU keyed like ``functools.lru_cache`` but bounded
+    by BOTH entry count and the summed ``nbytes`` of the cached arrays
+    (serve-registry plans build concurrently from worker threads).
+    Provides ``cache_clear()`` for drop-in compatibility."""
+
+    def __init__(self, builder, max_entries: int, max_bytes: int):
+        self._builder = builder
+        self._max_entries = max_entries
+        self._max_bytes = max_bytes
+        self._store = collections.OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+
+    def __call__(self, *key):
+        with self._lock:
+            hit = self._store.get(key)
+            if hit is not None:
+                self._store.move_to_end(key)
+                return hit
+        val = self._builder(*key)  # build outside the lock (ms-scale)
+        nbytes = sum(int(m.nbytes) for m in val)
+        with self._lock:
+            if key not in self._store:
+                self._store[key] = val
+                self._bytes += nbytes
+            while len(self._store) > 1 \
+                    and (self._bytes > self._max_bytes
+                         or len(self._store) > self._max_entries):
+                _, old = self._store.popitem(last=False)
+                self._bytes -= sum(int(m.nbytes) for m in old)
+        return val
+
+    def cache_clear(self) -> None:
+        with self._lock:
+            self._store.clear()
+            self._bytes = 0
+
+    @property
+    def cache_bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+
+def _build_dft_mats(n: int, sign: int, scale: float):
     """(Cr, Ci, Cs) f32 numpy constants for the length-``n`` DFT with
     ``scale`` folded in; Cs = Cr + Ci pre-summed for the Karatsuba form."""
     k = np.arange(n)
@@ -107,6 +162,10 @@ def _dft_mats(n: int, sign: int, scale: float):
     cr = np.ascontiguousarray(m.real.astype(np.float32))
     ci = np.ascontiguousarray(m.imag.astype(np.float32))
     return cr, ci, np.ascontiguousarray(cr + ci)
+
+
+_dft_mats = _ByteLRU(_build_dft_mats, max_entries=32,
+                     max_bytes=DFT_MATS_CACHE_BYTES)
 
 
 @functools.lru_cache(maxsize=32)
@@ -294,14 +353,15 @@ def pdft_last_opt(xr, xi, mats):
     the kernel up to the full matmul cap: standalone the kernel beats
     the XLA stage at 384/512 too (4.09 vs 4.82 / 12.63 vs 13.58 ms —
     probe_r5_colblock.py); the >320 pair-level LOSS that set
-    dft_kernel.MAX_DIM comes from the materialised swapaxes between
+    dft_kernel.max_dim() comes from the materialised swapaxes between
     kernel xy stages (XLA dots absorb those transposes via layout
     freedom, Pallas boundaries cannot), which a z-stage does not have."""
     if (not isinstance(mats, TwoStageMats) and len(mats) == 3
             and _fused_ok(xr, mats, cap=(MATMUL_DFT_MAX if xr.ndim == 2
                                          else None))):
         from . import dft_kernel as dk
-        return dk.pdft_last(xr, xi, mats)
+        if dk.fits1(*np.shape(mats[0])):
+            return dk.pdft_last(xr, xi, mats)
     return pdft_last(xr, xi, mats)
 
 

@@ -51,19 +51,27 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
-from .dft import MATMUL_DFT_MAX
-
 _HI = jax.lax.Precision.HIGHEST
 _DN = (((1,), (0,)), ((), ()))
 
-#: Longest axis the fused kernels accept. EMPIRICAL, not the matmul-DFT
+#: Empirical ceiling of the fused kernels, independent of the matmul-DFT
 #: cap: above 320 the two-stage xy kernel no longer fits VMEM, and the
 #: single-stage kernel alone measures a net LOSS against the XLA stages
 #: (same-session interleaved A/B: 384^3 pair 56.5 vs 54.2 ms, 512^3
 #: 161.2 vs 148.2 — the shrunken row tiles forced by the compile
 #: ceiling spend more on matrix streaming than the combine fusion
 #: saves), while <= 320 wins (256^3 12.3 -> 10.5, 320^3 36.6 -> 33.1).
-MAX_DIM = min(320, MATMUL_DFT_MAX)
+_EMPIRICAL_MAX = 320
+
+
+def max_dim() -> int:
+    """Longest axis the fused kernels accept: the empirical VMEM/perf
+    ceiling clamped to the CURRENT matmul-DFT cap. Reads
+    ``dft.MATMUL_DFT_MAX`` per call (module-attribute access, never
+    bound at import) so monkeypatched/retuned caps propagate to kernel
+    eligibility immediately (round-5 advisor finding)."""
+    from . import dft
+    return min(_EMPIRICAL_MAX, dft.MATMUL_DFT_MAX)
 
 #: Per-kernel VMEM budget (bytes) the single-stage tile chooser aims
 #: under. The EMPIRICAL compile ceiling on v5e is ~5.5 MB by the
@@ -92,9 +100,9 @@ def _plain_mats(mats) -> bool:
 
 def eligible_mats(*mats_list, cap=None) -> bool:
     """All matrix tuples are plain and within the axis cap (default
-    ``MAX_DIM``; the z-stage dispatch passes the full matmul cap — see
-    dft.pdft_last_opt)."""
-    limit = MAX_DIM if cap is None else cap
+    :func:`max_dim`; the z-stage dispatch passes the full matmul cap —
+    see dft.pdft_last_opt)."""
+    limit = max_dim() if cap is None else cap
     for mats in mats_list:
         if not _plain_mats(mats):
             return False
@@ -125,13 +133,24 @@ def _stage_kernel(xr_ref, xi_ref, cr_ref, ci_ref, cs_ref, yr_ref, yi_ref):
     yi_ref[...] = yi
 
 
-def _stage_tm(k: int, mo: int) -> int:
+def _stage_tm(k: int, mo: int):
     """Row-tile size: large tiles amortise the resident matrices; shrink
-    until 2 in + 2 out tiles + 3 matrices fit the VMEM budget."""
+    until 2 in + 2 out tiles + 3 matrices fit the VMEM budget. Returns
+    ``None`` when even tm=128 exceeds the budget (the matrices alone
+    overflow it at retuned caps) — dispatchers must treat that as
+    ineligible and keep the XLA form, mirroring the fits2/plane_tp
+    pattern, instead of risking a Mosaic compile crash (round-5 advisor
+    finding)."""
     for tm in (1024, 512, 256, 128):
         if (2 * tm * k + 2 * tm * mo + 3 * k * mo) * 4 <= _VMEM_BUDGET:
             return tm
-    return 128
+    return None
+
+
+def fits1(k: int, mo: int) -> bool:
+    """Whether the single-stage kernel fits the VMEM budget at this
+    matrix shape — the fits2 twin for :func:`pdft_last` dispatch."""
+    return _stage_tm(k, mo) is not None
 
 
 def pdft_last(xr, xi, mats, interpret: bool = False):
@@ -142,6 +161,7 @@ def pdft_last(xr, xi, mats, interpret: bool = False):
     lead = xr.shape[:-1]
     m = int(np.prod(lead)) if lead else 1
     tm = _stage_tm(k, mo)
+    assert tm is not None, "caller must gate on fits1"
     yr, yi = pl.pallas_call(
         _stage_kernel,
         grid=(pl.cdiv(m, tm),),

@@ -269,6 +269,7 @@ class TransformPlan:
                 "z_fs": _dsdft.ds_c2c_mats(p.dim_z, _dft.FORWARD, gs),
             }
         self._batched = None
+        self._device_tables = {}
         self._pair_jits = {}
         self._backward_jit = jax.jit(self._backward_impl)
         self._forward_jit = {
@@ -517,6 +518,40 @@ class TransformPlan:
             self._tables_hot["col_inv_sub"] = jnp.asarray(col_inv_sub)
             self._tables_hot["scatter_cols_sub"] = jnp.asarray(
                 np.concatenate([cols_sub, pads]))
+
+    def _tables_on(self, device):
+        """The hot table set replicated onto ``device`` (cached per
+        device; ``None`` = the default placement). Serving executors
+        schedule independent requests across a device pool — jit
+        dispatches on argument placement, so pinning an execution to a
+        device means its tables must live there too. Call after
+        ``_finalize`` (the hot dict can still gain fallback entries
+        before the background build resolves)."""
+        if device is None:
+            return self._tables_hot
+        cached = self._device_tables.get(device)
+        if cached is None:
+            cached = jax.device_put(self._tables_hot, device)
+            self._device_tables[device] = cached
+        return cached
+
+    def estimated_device_bytes(self) -> int:
+        """Approximate resident bytes this plan pins for its lifetime:
+        the committed device tables (hot dict, whatever paths have
+        committed so far) plus the host-side index arrays and the
+        double-single matrix set when present. Used by the serving
+        plan registry (spfft_tpu.serve.registry) for its byte-aware
+        LRU budget; an ESTIMATE — XLA executable buffers are excluded
+        (they are owned by the compilation cache, not the plan) and a
+        still-running background table build is counted at its current
+        state rather than joined."""
+        pieces = [self._tables_hot]
+        if getattr(self, "_ds_mats", None):
+            pieces.append(tuple(self._ds_mats.values()))
+        p = self.index_plan
+        pieces.append((p.value_indices, p.stick_keys))
+        leaves = jax.tree_util.tree_leaves(pieces)
+        return sum(int(getattr(leaf, "nbytes", 0)) for leaf in leaves)
 
     @property
     def pallas_active(self) -> bool:
@@ -1009,30 +1044,48 @@ class TransformPlan:
             }
         return self._batched
 
-    def backward_batched(self, values_batch):
+    def _stack_coerced(self, items, coerce):
+        """Stack per-request arrays into the batch boundary layout. When
+        every element coerces to a HOST array (the serving executor's
+        common case: numpy request payloads), stack on host and pay ONE
+        device transfer — ``jnp.stack`` over B separately-committed
+        device arrays costs a device concat kernel plus B puts, which
+        measurably erases the batching win for ms-scale transforms
+        (spfft_tpu.serve; measured on the CPU backend)."""
+        coerced = [coerce(v) for v in items]
+        if all(isinstance(c, np.ndarray) for c in coerced):
+            return jnp.asarray(np.stack(coerced))
+        return jnp.stack(coerced)
+
+    def backward_batched(self, values_batch, device=None):
         """Backward-execute a batch: ``values_batch`` is (B, num_values)
         complex or (B, num_values, 2) interleaved ((B, 2, num_values) for
         pair_values_io plans). Returns the (B, ...) stacked space-domain
-        result in one fused execution."""
+        result in one fused execution. ``device`` pins the batch to one
+        device of a pool (see :meth:`backward`)."""
         per = ((self.index_plan.num_values, 4) if self._ds
                else (2, self.index_plan.num_values) if self._pair_io
                else (self.index_plan.num_values, 2))
         batch = values_batch \
             if isinstance(values_batch, jax.Array) \
             and values_batch.shape[1:] == per \
-            else jnp.stack([self._coerce_values(v) for v in values_batch])
+            else self._stack_coerced(values_batch, self._coerce_values)
         self._finalize()
         with timed_transform("backward_batched") as box:
-            box.value = self._batched_jits()["backward"](batch,
-                                                         self._tables_hot)
+            if device is not None:
+                batch = jax.device_put(batch, device)
+            box.value = self._batched_jits()["backward"](
+                batch, self._tables_on(device))
             if self._ds:
                 box.value = self._ds_space_to_host(box.value)
         return box.value
 
-    def forward_batched(self, space_batch, scaling: Scaling = Scaling.NONE):
+    def forward_batched(self, space_batch, scaling: Scaling = Scaling.NONE,
+                        device=None):
         """Forward-execute a batch of space-domain slabs in one fused
         execution. Returns (B, num_values, 2) interleaved values —
-        (B, 2, num_values) for pair_values_io plans."""
+        (B, 2, num_values) for pair_values_io plans. ``device`` as in
+        :meth:`backward`."""
         scaling = Scaling(scaling)
         if self._ds:
             # coerced DS slabs always carry a trailing channel axis:
@@ -1047,12 +1100,14 @@ class TransformPlan:
             coerced = (isinstance(space_batch, jax.Array)
                        and space_batch.ndim
                        == (4 if self._is_r2c else 5))
-        batch = space_batch if coerced else jnp.stack(
-            [self._coerce_space(s) for s in space_batch])
+        batch = space_batch if coerced else \
+            self._stack_coerced(space_batch, self._coerce_space)
         self._finalize()
         with timed_transform("forward_batched") as box:
-            box.value = self._batched_jits()[scaling](batch,
-                                                      self._tables_hot)
+            if device is not None:
+                batch = jax.device_put(batch, device)
+            box.value = self._batched_jits()[scaling](
+                batch, self._tables_on(device))
             if self._ds:
                 box.value = self._ds_values_to_host(box.value)
         return box.value
@@ -1176,30 +1231,42 @@ class TransformPlan:
         return box.value
 
     # -- public execution (reference: transform.hpp:198-211) -----------------
-    def backward(self, values):
+    def backward(self, values, device=None):
         """Frequency -> space. ``values`` is (num_values,) complex (or
         interleaved (num_values, 2) real). Returns the space-domain slab:
         (dim_z, dim_y, dim_x, 2) interleaved for C2C, real (dim_z, dim_y,
         dim_x) for R2C. Unnormalised inverse DFT (details.rst
-        "Transform Definition")."""
+        "Transform Definition").
+
+        ``device`` pins the execution (input + replicated tables) to one
+        device of a pool — the serving executor's cross-device
+        round-robin; ``None`` keeps the default placement."""
         values_il = self._coerce_values(values)
         self._finalize()
         with timed_transform("backward") as box:
-            box.value = self._backward_jit(values_il, self._tables_hot)
+            if device is not None:
+                values_il = jax.device_put(values_il, device)
+            box.value = self._backward_jit(values_il,
+                                           self._tables_on(device))
             if self._ds:
                 box.value = self._ds_space_to_host(box.value)
         return box.value
 
-    def forward(self, space, scaling: Scaling = Scaling.NONE):
+    def forward(self, space, scaling: Scaling = Scaling.NONE,
+                device=None):
         """Space -> frequency. Returns (num_values, 2) interleaved sparse
         values — (2, num_values) for pair_values_io plans;
         ``scaling=Scaling.FULL`` multiplies by 1/(Nx·Ny·Nz)
-        (details.rst "Normalization")."""
+        (details.rst "Normalization"). ``device`` as in
+        :meth:`backward`."""
         scaling = Scaling(scaling)
         space = self._coerce_space(space)
         self._finalize()
         with timed_transform("forward") as box:
-            box.value = self._forward_jit[scaling](space, self._tables_hot)
+            if device is not None:
+                space = jax.device_put(space, device)
+            box.value = self._forward_jit[scaling](space,
+                                                   self._tables_on(device))
             if self._ds:
                 box.value = self._ds_values_to_host(box.value)
         return box.value

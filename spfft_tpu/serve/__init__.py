@@ -1,0 +1,40 @@
+"""spfft_tpu.serve — transform-as-a-service on top of compiled plans.
+
+The serving layer the ROADMAP's "heavy traffic" north star needs, built
+from three cooperating pieces:
+
+* :mod:`~spfft_tpu.serve.registry` — ``PlanRegistry``, a byte-aware
+  bounded LRU of ``TransformPlan``s keyed by a canonical
+  ``PlanSignature`` (dims, sparse-index digest, transform type,
+  precision, scaling, device count), with explicit ``warmup`` and
+  hit/miss/eviction counters. Layered over the persistent XLA
+  compilation cache, so a warm process skips both plan construction
+  (~0.35 s at 256^3) and the compile.
+* :mod:`~spfft_tpu.serve.executor` — ``ServeExecutor``, a concurrent
+  batching executor: ``submit(signature, values)`` returns a future; a
+  dispatcher thread buckets same-signature requests inside a small time
+  window and runs full buckets through the fused multi-transform path,
+  with a bounded queue (``QueueFullError`` backpressure), per-request
+  deadlines (``DeadlineExpiredError``) and graceful serial degradation.
+  Correctness contract: any interleaving of concurrent requests is
+  bit-identical to running each request alone.
+* :mod:`~spfft_tpu.serve.metrics` — ``ServeMetrics``: per-request
+  latency percentiles, queue depth, batch-size histogram and registry
+  counters, integrated with :mod:`spfft_tpu.timing`'s exports.
+
+``python -m spfft_tpu.serve.bench`` replays a mixed-signature request
+trace and reports p50/p95/p99 latency and throughput against a
+serial-loop baseline.
+"""
+
+from ..errors import DeadlineExpiredError, QueueFullError, ServeError
+from .executor import ServeExecutor
+from .metrics import ServeMetrics, percentile
+from .registry import (PlanRegistry, PlanSignature, index_digest,
+                       signature_for)
+
+__all__ = [
+    "PlanRegistry", "PlanSignature", "index_digest", "signature_for",
+    "ServeExecutor", "ServeMetrics", "percentile",
+    "ServeError", "QueueFullError", "DeadlineExpiredError",
+]

@@ -1,0 +1,158 @@
+"""Serving metrics: request latency, queue depth, batch sizes, registry
+counters — one thread-safe sink shared by the executor and the bench CLI.
+
+Integration with ``spfft_tpu.timing``: every completed request's latency
+is also recorded into the global scope timer (``Timer.record``, the
+cross-thread-safe path) under the ``serve.request`` label when timing is
+enabled, so serving latencies appear in the same print/JSON exports the
+reference-style benchmark already produces (rt_graph semantics,
+src/timing/rt_graph.hpp). ``to_json`` embeds the full timing tree next
+to the serving counters for one-file provenance.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, List, Optional
+
+from .. import timing
+
+
+def percentile(samples: List[float], p: float) -> float:
+    """Nearest-rank percentile (p in [0, 100]); 0.0 on no samples. The
+    serving latency distribution is heavy-tailed (batching windows +
+    compile hits), so nearest-rank — always a real sample — beats
+    interpolation for honesty at the p99 tail."""
+    if not samples:
+        return 0.0
+    s = sorted(samples)
+    k = max(0, min(len(s) - 1, int(round(p / 100.0 * len(s) + 0.5)) - 1))
+    return s[k]
+
+
+class ServeMetrics:
+    """Counters + distributions for one executor's lifetime.
+
+    All mutation goes through the internal lock: the executor's
+    dispatcher thread records completions while N submitter threads
+    record enqueues/rejects concurrently.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        """Zero every counter/distribution (the bench CLI separates its
+        warm phase from the measured replay this way). Quiesce the
+        executor first — concurrent recording during a reset is not an
+        error, but its samples land on whichever side of the reset the
+        lock decides."""
+        with self._lock:
+            self._latencies: List[float] = []
+            self._batch_hist: Dict[int, int] = {}
+            self._fused_batches = 0
+            self._serial_batches = 0
+            self._completed = 0
+            self._failed = 0
+            self._rejected_queue_full = 0
+            self._expired_deadline = 0
+            self._queue_depth = 0
+            self._max_queue_depth = 0
+
+    # -- recording (executor-facing) ---------------------------------------
+    def record_enqueue(self, depth: int) -> None:
+        with self._lock:
+            self._queue_depth = depth
+            if depth > self._max_queue_depth:
+                self._max_queue_depth = depth
+
+    def record_dequeue(self, depth: int) -> None:
+        with self._lock:
+            self._queue_depth = depth
+
+    def record_reject_queue_full(self) -> None:
+        with self._lock:
+            self._rejected_queue_full += 1
+
+    def record_deadline_expired(self) -> None:
+        with self._lock:
+            self._expired_deadline += 1
+
+    def record_batch(self, size: int, fused: bool) -> None:
+        with self._lock:
+            self._batch_hist[size] = self._batch_hist.get(size, 0) + 1
+            if fused:
+                self._fused_batches += 1
+            else:
+                self._serial_batches += 1
+
+    def record_request_done(self, latency_s: float,
+                            failed: bool = False) -> None:
+        with self._lock:
+            if failed:
+                self._failed += 1
+            else:
+                self._completed += 1
+                self._latencies.append(latency_s)
+        if not failed and timing.enabled():
+            timing.GlobalTimer.record("serve.request", latency_s)
+
+    # -- reading -----------------------------------------------------------
+    @property
+    def fused_batches(self) -> int:
+        with self._lock:
+            return self._fused_batches
+
+    @property
+    def max_fused_batch_size(self) -> int:
+        """Largest batch executed through the fused path so far (0 when
+        none) — the fuzz tests' 'at least one fused batch >= 2'
+        observable."""
+        with self._lock:
+            if not self._fused_batches:
+                return 0
+            return max((s for s, c in self._batch_hist.items()
+                        if s >= 2 and c > 0), default=0)
+
+    def latency_percentiles(self) -> Dict[str, float]:
+        with self._lock:
+            samples = list(self._latencies)
+        return {"p50": percentile(samples, 50.0),
+                "p95": percentile(samples, 95.0),
+                "p99": percentile(samples, 99.0)}
+
+    def snapshot(self, registry=None) -> Dict:
+        """One JSON-ready dict of everything: counters, latency
+        percentiles, the batch-size histogram, platform provenance and
+        (when given) the registry's counter snapshot."""
+        from ..utils.platform import platform_summary
+        with self._lock:
+            snap = {
+                "completed": self._completed,
+                "failed": self._failed,
+                "rejected_queue_full": self._rejected_queue_full,
+                "expired_deadline": self._expired_deadline,
+                "queue_depth": self._queue_depth,
+                "max_queue_depth": self._max_queue_depth,
+                "fused_batches": self._fused_batches,
+                "serial_batches": self._serial_batches,
+                "batch_size_histogram": {str(k): v for k, v in
+                                         sorted(self._batch_hist.items())},
+                "latency_count": len(self._latencies),
+            }
+        snap["latency_seconds"] = self.latency_percentiles()
+        snap["platform"] = platform_summary()
+        if registry is not None:
+            snap["registry"] = registry.stats()
+        return snap
+
+    def to_json(self, registry=None) -> str:
+        """The snapshot plus the global timing tree (when any scopes
+        were recorded) as one JSON document."""
+        payload = self.snapshot(registry)
+        timings = json.loads(timing.GlobalTimer.process().json())
+        if timings.get("timings"):
+            payload["timings"] = timings["timings"]
+        return json.dumps(payload)

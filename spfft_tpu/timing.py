@@ -28,6 +28,7 @@ import contextlib
 import json as _json
 import os
 import statistics
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
@@ -111,10 +112,25 @@ class Timer:
     def __init__(self):
         self._root = _Node("<root>")
         self._stack: List[_Node] = [self._root]
+        self._record_lock = threading.Lock()
 
     def reset(self) -> None:
         self._root = _Node("<root>")
         self._stack = [self._root]
+
+    def record(self, label: str, seconds: float) -> None:
+        """Append one pre-measured duration under a ROOT-LEVEL scope
+        named ``label``. The serving layer measures request latencies on
+        its dispatcher thread (a ``scoped`` context there would race the
+        per-thread-unaware scope stack); this path takes a lock and
+        never touches the stack, so cross-thread recording is safe and
+        the samples appear in the same print/JSON exports as scoped
+        timings."""
+        with self._record_lock:
+            node = self._root.children.get(label)
+            if node is None:
+                node = self._root.children[label] = _Node(label)
+            node.times.append(seconds)
 
     @contextlib.contextmanager
     def scoped(self, label: str, block: Any = None):

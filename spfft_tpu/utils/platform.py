@@ -71,14 +71,37 @@ def enable_persistent_compilation_cache() -> None:
                     "(%s)", e)
 
 
+def platform_summary() -> dict:
+    """Backend provenance for serving metrics and benchmark JSON: which
+    backend the process resolved, how many devices it sees and their
+    kind. Initialises the backend on first call (same cost the first
+    transform would pay anyway); serving exports embed this so recorded
+    throughput numbers carry the platform they were measured on."""
+    devices = jax.devices()
+    return {
+        "backend": jax.default_backend(),
+        "device_count": len(devices),
+        "device_kind": devices[0].device_kind if devices else "none",
+    }
+
+
 def force_virtual_cpu_devices(n: int) -> None:
     """Force an ``n``-device virtual CPU platform through the live config.
 
     Env vars alone (JAX_PLATFORMS=cpu + --xla_force_host_platform_device_count)
     are NOT sufficient in this container: the pre-registered TPU plugin ignores
-    them, so the platform is always forced through the live config. A no-op if
-    the backend is already initialised (config updates then raise and are
-    swallowed — callers check ``len(jax.devices())`` afterwards)."""
+    them, so the platform is always forced through the live config. The
+    device COUNT still comes from XLA_FLAGS (this jax version has no
+    ``jax_num_cpu_devices`` config), which XLA reads at backend
+    initialisation — so it is appended here too, effective whenever the
+    backend is not yet up. A no-op if the backend is already initialised
+    (config updates then raise and are swallowed — callers check
+    ``len(jax.devices())`` afterwards)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={max(n, 1)}"
+        ).strip()
     try:
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_num_cpu_devices", max(n, 1))

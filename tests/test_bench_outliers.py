@@ -1,0 +1,41 @@
+"""Outlier-session hygiene in the north-star bench (bench.py).
+
+The r05 best-of-4 line disclosed a 274.74 ms session next to 10.6-11 ms
+ones; the best-of statistic was immune but the mixed list distorted
+trajectory comparisons. The split helper must flag exactly such hiccups
+and never flag healthy spread."""
+
+import importlib.util
+import os
+
+spec = importlib.util.spec_from_file_location(
+    "bench_root", os.path.join(os.path.dirname(__file__), os.pardir,
+                               "bench.py"))
+bench = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(bench)
+
+
+def test_r05_hiccup_is_flagged():
+    values = [0.01076, 0.01068, 0.27474, 0.01067]
+    kept, outliers = bench.split_outlier_sessions(values)
+    assert outliers == [0.27474]
+    assert sorted(kept) == sorted([0.01076, 0.01068, 0.01067])
+
+
+def test_healthy_spread_not_flagged():
+    values = [0.0119, 0.0123, 0.0129, 0.0131]
+    kept, outliers = bench.split_outlier_sessions(values)
+    assert outliers == [] and len(kept) == 4
+
+
+def test_small_sample_never_flagged():
+    assert bench.split_outlier_sessions([0.01, 0.5]) \
+        == ([0.01, 0.5], [])
+
+
+def test_min_session_survives():
+    """The best-of value can never be dropped: outliers are high-side
+    only (cut is above the median)."""
+    values = [0.009, 0.011, 0.012, 0.3]
+    kept, _ = bench.split_outlier_sessions(values)
+    assert min(values) in kept

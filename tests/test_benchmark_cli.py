@@ -28,6 +28,7 @@ def test_cutoff_stick_workload_hermitian():
     ["-d", "8", "10", "12", "-r", "1", "-t", "r2c", "-s", "0.5"],
     ["-d", "16", "-r", "1", "--shards", "4", "-e", "compactFloat"],
     ["-d", "16", "-r", "1", "--shards", "2", "-t", "r2c", "-p", "host"],
+    ["-d", "12", "-r", "2", "-m", "3", "--serve"],
 ])
 def test_cli_runs(flags, tmp_path, capsys):
     out = tmp_path / "bench.json"
@@ -57,6 +58,25 @@ def test_cli_exchange_all_sweep(tmp_path, capsys):
     assert by["bufferedFloat"]["wire_total_bytes"] \
         == by["buffered"]["wire_total_bytes"] // 2
     assert capsys.readouterr().out
+
+
+def test_cli_serve_reports_metrics(tmp_path):
+    """--serve routes the -m transforms through the serving layer and
+    embeds its metrics (fused batches must appear: m same-signature
+    submissions per phase bucket together)."""
+    out = tmp_path / "serve_bench.json"
+    assert main(["-d", "12", "-r", "3", "-m", "4", "--serve",
+                 "-o", str(out)]) == 0
+    payload = json.loads(out.read_text())
+    serve = payload["parameters"]["serve"]
+    assert serve["completed"] == 2 * 4 * (3 + 1)  # warmups + repeats
+    assert serve["fused_batches"] >= 1
+    assert serve["registry"]["plans"] == 1
+
+
+def test_cli_serve_rejects_shards():
+    with pytest.raises(SystemExit):
+        main(["-d", "12", "--serve", "--shards", "2"])
 
 
 def test_cli_exchange_all_needs_shards():

@@ -153,3 +153,71 @@ def test_cdft2_xy_fallback_off_tpu():
     want = jnp.swapaxes(want, -1, -2)
     got = dft.cdft2_xy(x, m1, m2)
     assert np.array_equal(np.asarray(got), np.asarray(want))
+
+
+# -- round-6 satellite coverage: dynamic caps + VMEM ineligibility ----------
+
+def test_max_dim_tracks_retuned_cap(monkeypatch):
+    """dft.MATMUL_DFT_MAX is read per call (module-attribute access),
+    so a monkeypatched/retuned cap propagates to kernel eligibility
+    instead of staying frozen at import-time (r05 advisor finding)."""
+    assert dk.max_dim() == min(dk._EMPIRICAL_MAX, dft.MATMUL_DFT_MAX)
+    monkeypatch.setattr(dft, "MATMUL_DFT_MAX", 8)
+    assert dk.max_dim() == 8
+    mats16 = dft._build_dft_mats(16, -1, 1.0)
+    assert not dk.eligible_mats(mats16)  # 16 > the retuned cap
+    monkeypatch.setattr(dft, "MATMUL_DFT_MAX", 4096)
+    assert dk.max_dim() == dk._EMPIRICAL_MAX
+
+
+def test_stage_tm_none_when_matrices_overflow_budget(monkeypatch):
+    """_stage_tm returns None (not a bogus minimum tile) when even
+    tm=128 exceeds the VMEM budget, and fits1 reports ineligible —
+    the fits2/plane_tp pattern, preventing a Mosaic compile crash at
+    retuned caps (r05 advisor finding)."""
+    assert dk._stage_tm(256, 256) is not None
+    assert dk.fits1(256, 256)
+    assert dk._stage_tm(2048, 2048) is None
+    assert not dk.fits1(2048, 2048)
+    monkeypatch.setattr(dk, "_VMEM_BUDGET", 1024)
+    assert dk._stage_tm(64, 64) is None
+    assert not dk.fits1(64, 64)
+
+
+def test_pdft_last_opt_falls_back_when_unfit(monkeypatch):
+    """The dispatcher takes the XLA form (same math) instead of the
+    kernel when fits1 says the shape cannot tile."""
+    monkeypatch.setattr(dk, "_VMEM_BUDGET", 1024)
+    monkeypatch.setenv("SPFFT_TPU_FUSED_STAGE", "1")
+    xr, xi = _rand((6, 16), 30), _rand((6, 16), 31)
+    mats = dft.c2c_mats(16, dft.FORWARD)
+    got = dft.pdft_last_opt(xr, xi, mats)
+    want = dft.pdft_last(xr, xi, mats)
+    assert np.array_equal(np.asarray(got[0]), np.asarray(want[0]))
+    assert np.array_equal(np.asarray(got[1]), np.asarray(want[1]))
+
+
+def test_dft_mats_byte_lru_bounded():
+    """_dft_mats evicts oldest-first past its byte budget (prime
+    fallback triples at n > 512 must not pin ~400 MB in long-lived
+    servers — r05 advisor finding), keeps hit identity, and supports
+    cache_clear (probe scripts rely on it)."""
+    lru = dft._ByteLRU(dft._build_dft_mats, max_entries=32,
+                       max_bytes=2 * (3 * 64 * 64 * 4))  # two n=64 triples
+    a = lru(64, -1, 1.0)
+    assert lru(64, -1, 1.0) is a  # hit returns the same object
+    lru(64, +1, 1.0)
+    assert lru.cache_bytes == 2 * (3 * 64 * 64 * 4)
+    lru(64, -1, 0.5)  # third entry: evicts the oldest
+    assert lru.cache_bytes == 2 * (3 * 64 * 64 * 4)
+    assert lru(64, -1, 1.0) is not a  # rebuilt after eviction
+    lru.cache_clear()
+    assert lru.cache_bytes == 0
+
+
+def test_dft_mats_entry_cap_still_applies():
+    lru = dft._ByteLRU(dft._build_dft_mats, max_entries=2,
+                       max_bytes=1 << 40)
+    for scale in (1.0, 0.5, 0.25):
+        lru(8, -1, scale)
+    assert len(lru._store) == 2

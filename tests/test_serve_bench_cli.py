@@ -1,0 +1,58 @@
+"""Serving benchmark CLI smoke tests (the harness is part of the
+deliverable, like spfft_tpu.benchmark — SURVEY.md §6)."""
+
+import json
+
+from spfft_tpu.serve.bench import main
+
+
+def _last_json(capsys):
+    out = capsys.readouterr().out
+    line = next(ln for ln in reversed(out.splitlines())
+                if ln.startswith("{"))
+    return json.loads(line), out
+
+
+def test_serve_bench_runs_and_meets_bars(tmp_path, capsys):
+    """The acceptance run: CPU, mixed signatures — throughput at least
+    the serial-loop baseline, registry hit-rate >= 90% after warmup,
+    and the JSON payload carries the serving metrics."""
+    out_file = tmp_path / "serve.json"
+    rc = main(["--dim", "16", "--requests", "64", "--signatures", "3",
+               "--threads", "8", "-o", str(out_file)])
+    assert rc == 0
+    payload, text = _last_json(capsys)
+    assert payload["unit"] == "req/s"
+    assert payload["throughput_rps"] > 0
+    assert payload["throughput_rps"] >= payload["serial_throughput_rps"]
+    assert payload["registry_hit_rate"] >= 0.9
+    snap = payload["serve_metrics"]
+    assert snap["completed"] == 64
+    assert snap["failed"] == 0
+    assert snap["registry"]["builds"] == 3
+    assert "p50" in snap["latency_seconds"]
+    assert json.loads(out_file.read_text()) == payload
+    assert "serial loop" in text and "executor" in text
+
+
+def test_serve_bench_same_signature_beats_serial(capsys):
+    """The same-signature trace of the acceptance criterion."""
+    rc = main(["--dim", "16", "--requests", "64", "--signatures", "1",
+               "--threads", "4"])
+    assert rc == 0
+    payload, _ = _last_json(capsys)
+    assert payload["speedup_vs_serial"] >= 1.0
+    assert payload["serve_metrics"]["fused_batches"] >= 1
+
+
+def test_serve_bench_no_batching(capsys):
+    rc = main(["--dim", "12", "--requests", "16", "--signatures", "1",
+               "--threads", "2", "--no-batching"])
+    assert rc == 0
+    payload, _ = _last_json(capsys)
+    assert payload["serve_metrics"]["fused_batches"] == 0
+    assert payload["serve_metrics"]["completed"] == 16
+
+
+def test_serve_bench_bad_args():
+    assert main(["--requests", "0"]) == 2

@@ -1,0 +1,290 @@
+"""Serving executor: the bit-identical concurrency contract plus every
+flow-control path (deadline, queue-full, degradation, shutdown).
+
+The load-bearing test is the concurrency fuzz: 8 submitter threads x
+mixed signatures against per-request serial oracles with EXACT equality
+— any relaxation here would let the fused batched path drift from the
+serial path silently. The fused path must also demonstrably engage
+(at least one fused batch >= 2 in metrics).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from spfft_tpu import Scaling, TransformType
+from spfft_tpu.errors import (DeadlineExpiredError, InvalidParameterError,
+                              QueueFullError, ServeError)
+from spfft_tpu.serve import (PlanRegistry, ServeExecutor, ServeMetrics,
+                             percentile)
+
+from test_util import hermitian_triplets, random_sparse_triplets
+
+DIMS = (12, 13, 11)
+
+
+def _registry_with(seeds, precision="double", ttype=TransformType.C2C):
+    reg = PlanRegistry()
+    sigs = []
+    for s in seeds:
+        rng = np.random.default_rng(s)
+        t = (hermitian_triplets(rng, DIMS)
+             if ttype == TransformType.R2C
+             else random_sparse_triplets(rng, DIMS))
+        sig, _ = reg.get_or_build(ttype, *DIMS, t, precision=precision)
+        sigs.append(sig)
+    return reg, sigs
+
+
+def _values_for(reg, sig, rng):
+    n = reg.get(sig).index_plan.num_values
+    return (rng.uniform(-1, 1, n) + 1j * rng.uniform(-1, 1, n))
+
+
+def test_single_request_matches_plan_backward():
+    reg, (sig,) = _registry_with([1])
+    rng = np.random.default_rng(0)
+    v = _values_for(reg, sig, rng)
+    with ServeExecutor(reg) as ex:
+        got = np.asarray(ex.submit(sig, v).result())
+    expect = np.asarray(reg.get(sig).backward(v))
+    assert np.array_equal(got, expect)
+
+
+def test_forward_request_with_scaling():
+    reg, (sig,) = _registry_with([1])
+    rng = np.random.default_rng(0)
+    plan = reg.get(sig)
+    space = np.asarray(plan.backward(_values_for(reg, sig, rng)))
+    with ServeExecutor(reg) as ex:
+        got = np.asarray(ex.submit_forward(sig, space,
+                                           Scaling.FULL).result())
+    expect = np.asarray(plan.forward(space, Scaling.FULL))
+    assert np.array_equal(got, expect)
+
+
+def test_fused_batch_bitexact_and_observed():
+    """A staged full bucket executes fused (metrics prove it) and every
+    result equals the serial per-request execution bit-for-bit."""
+    reg, (sig,) = _registry_with([1])
+    rng = np.random.default_rng(7)
+    vals = [_values_for(reg, sig, rng) for _ in range(8)]
+    plan = reg.get(sig)
+    oracles = [np.asarray(plan.backward(v)) for v in vals]
+    ex = ServeExecutor(reg, autostart=False, batch_window=0.0)
+    futures = [ex.submit(sig, v) for v in vals]
+    ex.start()
+    results = [np.asarray(f.result()) for f in futures]
+    ex.close()
+    for got, expect in zip(results, oracles):
+        assert np.array_equal(got, expect)
+    assert ex.metrics.fused_batches >= 1
+    assert ex.metrics.max_fused_batch_size >= 2
+
+
+def test_concurrency_fuzz_mixed_signatures():
+    """8 submitter threads x 96 mixed-signature requests == the serial
+    oracle, exactly; >= 1 fused batch of >= 2 observed (acceptance
+    criterion). Requests are staged before the dispatcher starts so
+    full same-signature buckets are guaranteed to form, then submitted
+    concurrently while the dispatcher drains — both the staged and the
+    racing arrivals must hold the contract."""
+    reg, sigs = _registry_with([1, 2, 3])
+    rng = np.random.default_rng(42)
+    requests = []  # (sig, kind, scaling, payload, oracle)
+    for i in range(96):
+        sig = sigs[int(rng.integers(len(sigs)))]
+        plan = reg.get(sig)
+        v = _values_for(reg, sig, rng)
+        if rng.random() < 0.5:
+            requests.append((sig, "backward", Scaling.NONE, v,
+                             np.asarray(plan.backward(v))))
+        else:
+            space = np.asarray(plan.backward(v))
+            scl = Scaling.FULL if rng.random() < 0.5 else Scaling.NONE
+            requests.append((sig, "forward", scl, space,
+                             np.asarray(plan.forward(space, scl))))
+
+    ex = ServeExecutor(reg, autostart=False, batch_window=0.001)
+    futures = [None] * len(requests)
+    errors = []
+    # stage the first third (guarantees formed buckets); the 8 threads
+    # then race >= 64 submissions against the draining dispatcher
+    for i in range(32):
+        sig, kind, scl, payload, _ = requests[i]
+        futures[i] = ex.submit(sig, payload, kind, scaling=scl)
+
+    def submitter(indices):
+        for i in indices:
+            sig, kind, scl, payload, _ = requests[i]
+            try:
+                futures[i] = ex.submit(sig, payload, kind, scaling=scl)
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+
+    threads = [threading.Thread(target=submitter,
+                                args=(range(32 + k, 96, 8),))
+               for k in range(8)]
+    ex.start()
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errors
+    for i, (sig, kind, scl, payload, oracle) in enumerate(requests):
+        got = np.asarray(futures[i].result(timeout=60))
+        assert np.array_equal(got, oracle), \
+            f"request {i} ({kind}) diverged from its serial oracle"
+    ex.close()
+    assert ex.metrics.fused_batches >= 1
+    assert ex.metrics.max_fused_batch_size >= 2
+    snap = ex.metrics.snapshot(reg)
+    assert snap["completed"] == 96
+    assert snap["failed"] == 0
+
+
+def test_batching_disabled_degrades_serial():
+    reg, (sig,) = _registry_with([1])
+    rng = np.random.default_rng(3)
+    vals = [_values_for(reg, sig, rng) for _ in range(8)]
+    plan = reg.get(sig)
+    oracles = [np.asarray(plan.backward(v)) for v in vals]
+    ex = ServeExecutor(reg, batching=False, autostart=False)
+    futures = [ex.submit(sig, v) for v in vals]
+    ex.start()
+    for f, expect in zip(futures, oracles):
+        assert np.array_equal(np.asarray(f.result()), expect)
+    ex.close()
+    assert ex.metrics.fused_batches == 0
+
+
+def test_device_pool_results_bitexact():
+    """Round-robin across the virtual CPU pool returns the same bits as
+    default-device execution (same executable, different placement)."""
+    reg, (sig,) = _registry_with([1])
+    rng = np.random.default_rng(9)
+    vals = [_values_for(reg, sig, rng) for _ in range(6)]
+    plan = reg.get(sig)
+    oracles = [np.asarray(plan.backward(v)) for v in vals]
+    ex = ServeExecutor(reg, devices="all", batching=False,
+                       autostart=False)
+    assert len(ex._devices) == len(jax.devices())
+    futures = [ex.submit(sig, v) for v in vals]
+    ex.start()
+    for f, expect in zip(futures, oracles):
+        assert np.array_equal(np.asarray(f.result()), expect)
+    ex.close()
+
+
+def test_deadline_expired():
+    reg, (sig,) = _registry_with([1])
+    rng = np.random.default_rng(4)
+    v = _values_for(reg, sig, rng)
+    ex = ServeExecutor(reg, autostart=False)
+    fut = ex.submit(sig, v, timeout=0.005)
+    time.sleep(0.05)  # expires while the dispatcher is not running
+    ex.start()
+    with pytest.raises(DeadlineExpiredError):
+        fut.result(timeout=30)
+    ex.close()
+    assert ex.metrics.snapshot()["expired_deadline"] == 1
+
+
+def test_queue_full_backpressure():
+    reg, (sig,) = _registry_with([1])
+    rng = np.random.default_rng(5)
+    v = _values_for(reg, sig, rng)
+    ex = ServeExecutor(reg, max_queue=4, autostart=False)
+    futures = [ex.submit(sig, v) for _ in range(4)]
+    with pytest.raises(QueueFullError):
+        ex.submit(sig, v)
+    assert ex.metrics.snapshot()["rejected_queue_full"] == 1
+    ex.start()
+    for f in futures:
+        f.result(timeout=30)
+    ex.close()
+
+
+def test_unknown_signature_rejected_at_submit():
+    reg, sigs = _registry_with([1])
+    other_reg, (foreign,) = _registry_with([2])
+    with ServeExecutor(reg) as ex:
+        with pytest.raises(InvalidParameterError):
+            ex.submit(foreign, np.zeros(4))
+
+
+def test_submit_after_close_raises_and_drain_completes():
+    reg, (sig,) = _registry_with([1])
+    rng = np.random.default_rng(6)
+    v = _values_for(reg, sig, rng)
+    ex = ServeExecutor(reg)
+    fut = ex.submit(sig, v)
+    ex.close()
+    assert fut.done()
+    with pytest.raises(ServeError):
+        ex.submit(sig, v)
+
+
+def test_close_without_drain_fails_pending():
+    reg, (sig,) = _registry_with([1])
+    rng = np.random.default_rng(6)
+    ex = ServeExecutor(reg, autostart=False)
+    fut = ex.submit(sig, _values_for(reg, sig, rng))
+    ex.close(drain=False)
+    with pytest.raises(ServeError):
+        fut.result(timeout=5)
+
+
+def test_bad_request_fails_future_not_executor():
+    """A malformed payload fails ITS future; the executor keeps
+    serving."""
+    reg, (sig,) = _registry_with([1])
+    rng = np.random.default_rng(8)
+    good = _values_for(reg, sig, rng)
+    with ServeExecutor(reg) as ex:
+        bad = ex.submit(sig, np.zeros(3))  # wrong length
+        with pytest.raises(Exception):
+            bad.result(timeout=30)
+        ok = ex.submit(sig, good)
+        expect = np.asarray(reg.get(sig).backward(good))
+        assert np.array_equal(np.asarray(ok.result(timeout=30)), expect)
+
+
+def test_metrics_latency_and_timing_integration():
+    from spfft_tpu import timing
+    reg, (sig,) = _registry_with([1])
+    rng = np.random.default_rng(2)
+    timing.GlobalTimer.reset()
+    timing.enable()
+    try:
+        with ServeExecutor(reg) as ex:
+            for _ in range(4):
+                ex.submit(sig, _values_for(reg, sig, rng)).result()
+    finally:
+        timing.disable()
+    rows = timing.GlobalTimer.process()._rows()
+    serve_rows = [r for r in rows if r["label"] == "serve.request"]
+    assert serve_rows and serve_rows[0]["count"] == 4
+    lat = ServeMetrics().latency_percentiles()
+    assert lat == {"p50": 0.0, "p95": 0.0, "p99": 0.0}
+
+
+def test_percentile_nearest_rank():
+    samples = [1.0, 2.0, 3.0, 4.0]
+    assert percentile(samples, 50.0) == 2.0
+    assert percentile(samples, 99.0) == 4.0
+    assert percentile([], 50.0) == 0.0
+
+
+def test_padded_ladder():
+    reg, _ = _registry_with([1])
+    ex = ServeExecutor(reg, max_batch=8, autostart=False)
+    assert [ex._padded_size(b) for b in (1, 2, 3, 5, 8)] == [2, 2, 4, 8, 8]
+    ex.close()
+    ex6 = ServeExecutor(reg, max_batch=6, autostart=False)
+    assert ex6._padded_size(5) == 6
+    ex6.close()

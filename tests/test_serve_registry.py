@@ -1,0 +1,153 @@
+"""Plan registry: canonical signatures, byte-aware LRU, warmup.
+
+The registry's contract (spfft_tpu/serve/registry.py): equal signatures
+MUST be answerable by one plan (the executor's batching invariant), the
+resident byte total stays under the configured budget, and every
+lookup/build is counted.
+"""
+
+import numpy as np
+import pytest
+
+from spfft_tpu import Scaling, TransformType
+from spfft_tpu.errors import InvalidParameterError
+from spfft_tpu.serve import (PlanRegistry, PlanSignature, index_digest,
+                             signature_for)
+
+from test_util import hermitian_triplets, random_sparse_triplets
+
+DIMS = (12, 13, 11)
+
+
+def _triplets(seed=3):
+    return random_sparse_triplets(np.random.default_rng(seed), DIMS)
+
+
+def test_signature_canonical_across_representations():
+    """Centered and wrapped index representations of the SAME sparse set
+    digest identically (both canonicalise through the index plan's
+    storage tables)."""
+    t = _triplets()
+    centered = t.astype(np.int64).copy()
+    for axis, n in enumerate(DIMS):
+        col = centered[:, axis]
+        centered[:, axis] = np.where(col > n // 2, col - n, col)
+    a = signature_for(TransformType.C2C, *DIMS, t)
+    b = signature_for(TransformType.C2C, *DIMS, centered.astype(np.int32))
+    assert a == b
+    assert hash(a) == hash(b)
+
+
+def test_signature_order_sensitive():
+    """Caller order is part of the identity: the value array is
+    positional, so a permuted triplet set is a DIFFERENT plan."""
+    t = _triplets()
+    perm = t[::-1].copy()
+    assert signature_for(TransformType.C2C, *DIMS, t) \
+        != signature_for(TransformType.C2C, *DIMS, perm)
+
+
+def test_signature_fields_distinguish():
+    t = _triplets()
+    base = signature_for(TransformType.C2C, *DIMS, t)
+    assert base != signature_for(TransformType.C2C, *DIMS, t,
+                                 precision="double")
+    assert base != signature_for(TransformType.C2C, *DIMS, t,
+                                 scaling=Scaling.FULL)
+    assert base != signature_for(TransformType.C2C, *DIMS, t,
+                                 device_count=4)
+
+
+def test_get_or_build_counts_and_reuses():
+    reg = PlanRegistry()
+    t = _triplets()
+    sig1, plan1 = reg.get_or_build(TransformType.C2C, *DIMS, t,
+                                   precision="double")
+    sig2, plan2 = reg.get_or_build(TransformType.C2C, *DIMS, t,
+                                   precision="double")
+    assert sig1 == sig2
+    assert plan1 is plan2
+    stats = reg.stats()
+    assert stats["builds"] == 1
+    assert stats["hits"] == 1
+    assert stats["misses"] == 1
+    assert stats["bytes_in_use"] > 0
+    assert reg.hit_rate == 0.5
+
+
+def test_signature_of_plan_matches_get_or_build():
+    reg = PlanRegistry()
+    t = _triplets()
+    sig, plan = reg.get_or_build(TransformType.C2C, *DIMS, t,
+                                 precision="double")
+    assert PlanSignature.of_plan(plan) == sig
+    assert sig.index_digest == index_digest(plan.index_plan)
+
+
+def test_byte_aware_eviction():
+    """A byte budget below two plans' footprint keeps at most one
+    resident (the newest), counting evictions."""
+    reg = PlanRegistry(max_bytes=1)  # everything over-budget
+    tA = _triplets(1)
+    tB = _triplets(2)
+    sigA, planA = reg.get_or_build(TransformType.C2C, *DIMS, tA,
+                                   precision="double")
+    assert len(reg) == 1  # the inserted entry itself survives
+    sigB, _ = reg.get_or_build(TransformType.C2C, *DIMS, tB,
+                               precision="double")
+    assert len(reg) == 1
+    assert reg.stats()["evictions"] == 1
+    assert reg.get(sigA) is None  # evicted oldest-first
+    assert reg.get(sigB) is not None
+
+
+def test_max_plans_eviction_lru_order():
+    reg = PlanRegistry(max_plans=2)
+    sigs = []
+    for seed in (1, 2, 3):
+        sig, _ = reg.get_or_build(TransformType.C2C, *DIMS,
+                                  _triplets(seed), precision="double")
+        sigs.append(sig)
+    assert len(reg) == 2
+    assert reg.get(sigs[0]) is None
+    assert reg.get(sigs[1]) is not None
+    assert reg.get(sigs[2]) is not None
+    # refreshing sigs[1] makes sigs[2] the eviction candidate
+    reg.get(sigs[1])
+    sig4, _ = reg.get_or_build(TransformType.C2C, *DIMS, _triplets(4),
+                               precision="double")
+    assert reg.get(sigs[1]) is not None
+    assert reg.get(sigs[2]) is None
+
+
+def test_warmup_builds_and_hits():
+    reg = PlanRegistry()
+    specs = [dict(transform_type=TransformType.C2C, dim_x=DIMS[0],
+                  dim_y=DIMS[1], dim_z=DIMS[2], triplets=_triplets(s),
+                  precision="double") for s in (1, 2)]
+    sigs = reg.warmup(specs, compile=True)
+    assert len(sigs) == 2 and sigs[0] != sigs[1]
+    assert reg.stats()["builds"] == 2
+    # post-warmup traffic hits
+    for _ in range(20):
+        for sig in sigs:
+            assert reg.get(sig) is not None
+    assert reg.hit_rate >= 0.9  # the acceptance bar
+
+
+def test_warmup_r2c_single():
+    """R2C + single precision warmup executes its zero-valued compile
+    pass without shape errors."""
+    reg = PlanRegistry()
+    t = hermitian_triplets(np.random.default_rng(5), DIMS)
+    sigs = reg.warmup([dict(transform_type=TransformType.R2C,
+                            dim_x=DIMS[0], dim_y=DIMS[1], dim_z=DIMS[2],
+                            triplets=t, precision="single")],
+                      compile=True)
+    plan = reg.get(sigs[0])
+    assert plan is not None and plan.index_plan.hermitian
+
+
+def test_registry_rejects_bad_bounds():
+    with pytest.raises(InvalidParameterError):
+        PlanRegistry(max_plans=0)
