@@ -248,7 +248,11 @@ def main(argv=None) -> int:
         serve_sig = PlanSignature.of_plan(plan)
         registry.put(serve_sig, plan)
         serve_executor = ServeExecutor(registry)
-        serve_executor.prewarm(serve_sig)
+        # every repeat submits exactly m same-signature requests, so the
+        # adaptive observer pins the exact shape m after a few repeats —
+        # prewarm it alongside the pow2 ladder to keep that compile out
+        # of the measured loop
+        serve_executor.prewarm(serve_sig, batch_sizes=(m,))
 
         def run_pair(vals):
             spaces = [f.result() for f in

@@ -60,6 +60,21 @@ FUSED_BATCH_MAX_GRID = 8_000_000
 FUSED_BATCH_MAX_DIST_TOTAL = 32_000_000
 
 
+def planned_batch_size(batch_size: int, cap: int) -> int:
+    """The planned-batch pow2 ladder (the cuFFT idiom): the smallest
+    power of two >= ``batch_size``, capped at ``cap``. Dispatching every
+    bucket at a ladder size bounds the set of compiled batch shapes per
+    plan to O(log cap) while wasting at most 2x compute on pad rows.
+    Lives here, next to :func:`fusion_eligible`, because it is batching
+    POLICY shared by the serving executor's fallback path and its
+    prewarm — the adaptive pinning path (spfft_tpu.serve.executor)
+    bypasses the ladder once a signature's batch size stabilises."""
+    p = 2
+    while p < batch_size and p < cap:
+        p *= 2
+    return min(p, cap)
+
+
 def fusion_eligible(plan, batch_size: int) -> bool:
     """THE shared fusion gate: is a batch of ``batch_size`` transforms
     over ``plan`` in the regime where the fused executable wins? Local

@@ -12,29 +12,38 @@ from three cooperating pieces:
   (~0.35 s at 256^3) and the compile.
 * :mod:`~spfft_tpu.serve.executor` — ``ServeExecutor``, a concurrent
   batching executor: ``submit(signature, values)`` returns a future; a
-  dispatcher thread buckets same-signature requests inside a small time
-  window and runs full buckets through the fused multi-transform path,
-  with a bounded queue (``QueueFullError`` backpressure), per-request
-  deadlines (``DeadlineExpiredError``) and graceful serial degradation.
-  Correctness contract: any interleaving of concurrent requests is
-  bit-identical to running each request alone.
-* :mod:`~spfft_tpu.serve.metrics` — ``ServeMetrics``: per-request
-  latency percentiles, queue depth, batch-size histogram and registry
-  counters, integrated with :mod:`spfft_tpu.timing`'s exports.
+  dispatcher thread buckets same-signature requests from per-signature
+  pending shards and runs full buckets through the fused
+  multi-transform path. ``priority="high"`` requests take a lane served
+  before any normal work (EDF within each lane; a forming normal bucket
+  closes its window early for urgent arrivals), and an adaptive
+  batch-shape observer PINS exact batch shapes once a signature's
+  traffic stabilises — stable traces dispatch with zero ladder pad
+  rows. Bounded queue (``QueueFullError`` backpressure), per-request
+  deadlines (``DeadlineExpiredError``), graceful serial degradation,
+  reusable host staging buffers and double-buffered dispatch
+  pipelining. Correctness contract: any interleaving of concurrent
+  requests is bit-identical to running each request alone.
+* :mod:`~spfft_tpu.serve.metrics` — ``ServeMetrics``: bounded
+  per-priority-class latency reservoirs (p50/p95/p99), queue depth,
+  split fused/serial batch histograms, pad-row and pinned-batch
+  counters, orchestration overhead, and registry counters, integrated
+  with :mod:`spfft_tpu.timing`'s exports.
 
 ``python -m spfft_tpu.serve.bench`` replays a mixed-signature request
-trace and reports p50/p95/p99 latency and throughput against a
-serial-loop baseline.
+trace and reports p50/p95/p99 latency (per priority class with
+``--high-fraction``) and throughput against a serial-loop baseline;
+``--smoke`` is the deterministic tier-1 pinning check.
 """
 
 from ..errors import DeadlineExpiredError, QueueFullError, ServeError
 from .executor import ServeExecutor
-from .metrics import ServeMetrics, percentile
+from .metrics import PRIORITY_CLASSES, ServeMetrics, percentile
 from .registry import (PlanRegistry, PlanSignature, index_digest,
                        signature_for)
 
 __all__ = [
     "PlanRegistry", "PlanSignature", "index_digest", "signature_for",
-    "ServeExecutor", "ServeMetrics", "percentile",
+    "ServeExecutor", "ServeMetrics", "percentile", "PRIORITY_CLASSES",
     "ServeError", "QueueFullError", "DeadlineExpiredError",
 ]

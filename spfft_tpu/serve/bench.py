@@ -11,6 +11,18 @@ tight loop is the dispatch optimum, so the serving win there is plan
 amortisation; fused batching and the device pool are TPU-regime levers
 (see multi.FUSED_BATCH_MAX_GRID provenance).
 
+Two extra modes exercise the adaptive dispatch path:
+
+* ``--smoke`` — a fast, fully DETERMINISTIC trace (no threads, no
+  batching windows: fixed-size waves drained synchronously) that
+  asserts the adaptive pinning path activates and drives ladder pad
+  rows to zero once pinned, with every result checked bit-exact against
+  the serial oracle. Wired into tier-1 CI
+  (tests/test_serve_bench_cli.py) — exit code 1 on any violated check.
+* ``--high-fraction F`` — marks a deterministic F of the trace
+  high-priority; the summary and JSON then carry per-class p50/p99 so
+  the priority lane's latency separation under flood is measurable.
+
 The workload reuses the benchmark CLI's dense-within-cutoff stick
 generator (``spfft_tpu.benchmark.cutoff_stick_triplets``, reference:
 tests/programs/benchmark.cpp:176-205) at several sparsities, so the
@@ -48,12 +60,22 @@ def _parse_args(argv):
                         "(default 3); 1 = same-signature trace")
     p.add_argument("--threads", type=int, default=4,
                    help="submitter threads replaying the trace")
-    p.add_argument("--window", type=float, default=0.002,
-                   help="batching window seconds (default 0.002)")
-    p.add_argument("--max-batch", type=int, default=8)
+    p.add_argument("--window", type=float, default=None,
+                   help="batching window seconds (default: the "
+                        "executor's DEFAULT_BATCH_WINDOW)")
+    p.add_argument("--max-batch", type=int, default=None,
+                   help="bucket cap (default: the executor's "
+                        "DEFAULT_MAX_BATCH)")
     p.add_argument("--max-queue", type=int, default=1024)
     p.add_argument("--no-batching", action="store_true",
                    help="degrade to serial dispatch (A/B the batcher)")
+    p.add_argument("--pin-after", type=int, default=None,
+                   help="consecutive same-size buckets before the exact "
+                        "shape pins (default: DEFAULT_PIN_AFTER; 0 "
+                        "disables pinning)")
+    p.add_argument("--high-fraction", type=float, default=0.0,
+                   help="fraction of trace requests submitted "
+                        "priority='high' (default 0: all normal)")
     p.add_argument("--devices", type=int, default=0,
                    help="size of the executor's device pool (0 = all "
                         "visible devices; on a fresh CPU process this "
@@ -64,6 +86,11 @@ def _parse_args(argv):
     p.add_argument("--cpu", action="store_true",
                    help="force the virtual CPU platform (like the test "
                         "conftest)")
+    p.add_argument("--smoke", action="store_true",
+                   help="fast deterministic pinning check (tier-1 CI): "
+                        "fixed-size waves drained synchronously; "
+                        "asserts pinned-path activation, zero pad rows "
+                        "once pinned, and bit-exact results")
     p.add_argument("-o", "--output", default=None, metavar="FILE.json")
     return p.parse_args(argv)
 
@@ -73,11 +100,95 @@ def _block(result) -> None:
     np.asarray(result).ravel()[:1]
 
 
+def _run_smoke(args) -> int:
+    """Deterministic pinning smoke: one signature, ``WAVES`` waves of
+    ``WAVE`` (deliberately NOT a power of two) requests, each wave
+    staged then drained synchronously — bucket sizes are exact by
+    construction, so the adaptive observer's behaviour is reproducible:
+    the first ``pin_after`` waves pad ``WAVE`` up the pow2 ladder, every
+    later wave dispatches at the pinned exact shape with zero pad rows.
+    Every result is checked bit-exact against the serial oracle."""
+    from ..benchmark import cutoff_stick_triplets
+    from ..types import TransformType
+    from .executor import DEFAULT_PIN_AFTER, ServeExecutor
+    from .registry import PlanRegistry
+
+    n, WAVE, WAVES = 12, 5, 6
+    pin_after = (args.pin_after if args.pin_after is not None
+                 else DEFAULT_PIN_AFTER)
+    triplets = cutoff_stick_triplets(n, n, n, 0.9, hermitian=False)
+    registry = PlanRegistry()
+    sig, plan = registry.get_or_build(
+        TransformType.C2C, n, n, n, triplets, precision=args.precision)
+    nv = plan.index_plan.num_values
+    rng = np.random.default_rng(args.seed)
+    ex = ServeExecutor(registry, autostart=False, batch_window=0.0,
+                       pin_after=pin_after)
+    failures = []
+    pad_rows_per_wave = []
+    for w in range(WAVES):
+        if args.precision == "single":
+            vals = [rng.standard_normal((nv, 2)).astype(np.float32)
+                    for _ in range(WAVE)]
+        else:
+            vals = [rng.standard_normal(nv)
+                    + 1j * rng.standard_normal(nv) for _ in range(WAVE)]
+        before = ex.metrics.padded_rows
+        futures = [ex.submit(sig, v) for v in vals]
+        ex._drain_once()
+        pad_rows_per_wave.append(ex.metrics.padded_rows - before)
+        for i, (v, f) in enumerate(zip(vals, futures)):
+            if not np.array_equal(np.asarray(f.result()),
+                                  np.asarray(plan.backward(v))):
+                failures.append(f"wave {w} request {i} diverged from "
+                                f"the serial oracle")
+    snap = ex.metrics.snapshot(registry)
+    ex.close()
+    pinned = snap["pinned_batches"]
+    if pin_after > 0:
+        if pinned < 1:
+            failures.append("pinned path never activated")
+        if pad_rows_per_wave[-1] != 0:
+            failures.append(
+                f"stable-size trace still pads after pinning: "
+                f"last wave added {pad_rows_per_wave[-1]} pad rows")
+    ok = not failures
+    print(f"smoke: {WAVES} waves x {WAVE} requests, dim={n}^3, "
+          f"pin_after={pin_after}")
+    print(f"pad rows per wave: {pad_rows_per_wave} "
+          f"(pinned_batches={pinned})")
+    for msg in failures:
+        print(f"FAIL: {msg}", file=sys.stderr)
+    result = {
+        "metric": f"serve.bench --smoke {n}^3 waves={WAVES}x{WAVE} "
+                  f"(pinned_batches={pinned}, "
+                  f"padded_rows={snap['padded_rows']})",
+        "value": 1 if ok else 0,
+        "unit": "ok",
+        "smoke": True,
+        "ok": ok,
+        "pinned_batches": pinned,
+        "padded_rows_total": snap["padded_rows"],
+        "padded_rows_per_wave": pad_rows_per_wave,
+        "failures": failures,
+    }
+    print(json.dumps(result))
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(result, f, indent=2)
+        print(f"wrote {args.output}")
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     args = _parse_args(argv if argv is not None else sys.argv[1:])
     if args.requests < 1 or args.signatures < 1 or args.threads < 1:
         print("error: --requests, --signatures and --threads must be "
               ">= 1", file=sys.stderr)
+        return 2
+    if not 0.0 <= args.high_fraction <= 1.0:
+        print("error: --high-fraction must be in [0, 1]",
+              file=sys.stderr)
         return 2
     if args.cpu or args.devices > 1:
         # a no-op once the backend is up (the test conftest's virtual
@@ -86,6 +197,9 @@ def main(argv=None) -> int:
         from ..utils.platform import force_virtual_cpu_devices
         force_virtual_cpu_devices(max(args.devices, 1))
 
+    if args.smoke:
+        return _run_smoke(args)
+
     import threading
 
     import jax
@@ -93,9 +207,17 @@ def main(argv=None) -> int:
     from ..benchmark import cutoff_stick_triplets
     from ..types import TransformType
     from ..utils.platform import platform_summary
-    from .executor import ServeExecutor
+    from .executor import (DEFAULT_BATCH_WINDOW, DEFAULT_MAX_BATCH,
+                           DEFAULT_PIN_AFTER, ServeExecutor)
     from .metrics import ServeMetrics
     from .registry import PlanRegistry
+
+    window = (args.window if args.window is not None
+              else DEFAULT_BATCH_WINDOW)
+    max_batch = (args.max_batch if args.max_batch is not None
+                 else DEFAULT_MAX_BATCH)
+    pin_after = (args.pin_after if args.pin_after is not None
+                 else DEFAULT_PIN_AFTER)
 
     n = args.dim
     rng = np.random.default_rng(args.seed)
@@ -117,7 +239,8 @@ def main(argv=None) -> int:
     sigs = registry.warmup(specs, compile=True)
     warmup_s = time.perf_counter() - t0
 
-    # the request trace: per-request signature choice + value array
+    # the request trace: per-request signature choice + value array +
+    # priority class (deterministic from the seed)
     plans = [registry.get(sig) for sig in sigs]
     trace = []
     for _ in range(args.requests):
@@ -127,7 +250,9 @@ def main(argv=None) -> int:
             if args.precision == "single" \
             else (rng.standard_normal(nv)
                   + 1j * rng.standard_normal(nv))
-        trace.append((which, vals))
+        priority = ("high" if rng.random() < args.high_fraction
+                    else "normal")
+        trace.append((which, vals, priority))
 
     # -- serial-loop baseline: a caller WITHOUT the serving layer. It
     # hand-builds its own plan per signature at first use (the 0.35 s
@@ -142,7 +267,7 @@ def main(argv=None) -> int:
     from ..plan import make_local_plan
     own_plans = {}
     t0 = time.perf_counter()
-    for which, vals in trace:
+    for which, vals, _ in trace:
         p = own_plans.get(which)
         if p is None:
             spec = specs[which]
@@ -155,7 +280,7 @@ def main(argv=None) -> int:
     serial_s = time.perf_counter() - t0
 
     t0 = time.perf_counter()
-    for which, vals in trace:
+    for which, vals, _ in trace:
         _block(own_plans[which].backward(vals))
     warm_loop_s = time.perf_counter() - t0
 
@@ -165,11 +290,12 @@ def main(argv=None) -> int:
     pool = jax.devices()
     if args.devices > 0:
         pool = pool[:args.devices]
-    executor = ServeExecutor(registry, batch_window=args.window,
-                             max_batch=args.max_batch,
+    executor = ServeExecutor(registry, batch_window=window,
+                             max_batch=max_batch,
                              max_queue=args.max_queue,
                              batching=not args.no_batching,
                              devices=pool if len(pool) > 1 else None,
+                             pin_after=pin_after,
                              metrics=metrics)
 
     # Warm every (signature, device, batch-shape) executable the replay
@@ -183,7 +309,7 @@ def main(argv=None) -> int:
         vals = np.zeros((nv, 2), np.float32) \
             if args.precision == "single" else np.zeros(nv, np.complex128)
         for f in [executor.submit(sig, vals)
-                  for _ in range(args.max_batch)]:
+                  for _ in range(max_batch)]:
             f.result()
     metrics.reset()
     lock = threading.Lock()
@@ -196,8 +322,9 @@ def main(argv=None) -> int:
                 if i >= len(trace):
                     return
                 cursor[0] += 1
-            which, vals = trace[i]
-            futures[i] = executor.submit(sigs[which], vals)
+            which, vals, priority = trace[i]
+            futures[i] = executor.submit(sigs[which], vals,
+                                         priority=priority)
 
     t0 = time.perf_counter()
     threads = [threading.Thread(target=submitter)
@@ -213,6 +340,8 @@ def main(argv=None) -> int:
 
     snap = metrics.snapshot(registry)
     lat = snap["latency_seconds"]
+    by_class = snap["latency_seconds_by_class"]
+    overhead = snap["overhead_seconds"]
     throughput = len(trace) / served_s
     serial_throughput = len(trace) / serial_s
     warm_loop_throughput = len(trace) / warm_loop_s
@@ -222,7 +351,8 @@ def main(argv=None) -> int:
           f"threads={args.threads} dim={n}^3 "
           f"precision={args.precision} "
           f"batching={'off' if args.no_batching else 'on'} "
-          f"device_pool={len(pool)}")
+          f"window={window * 1e3:.1f}ms max_batch={max_batch} "
+          f"pin_after={pin_after} device_pool={len(pool)}")
     print(f"warmup: {warmup_s:.2f}s for {len(sigs)} plans "
           f"(registry builds={reg['builds']}, "
           f"bytes={reg['bytes_in_use'] / 1e6:.1f} MB)")
@@ -235,9 +365,23 @@ def main(argv=None) -> int:
           f"{throughput / warm_loop_throughput:.2f}x vs warm loop)")
     print(f"latency p50/p95/p99: {lat['p50'] * 1e3:.2f} / "
           f"{lat['p95'] * 1e3:.2f} / {lat['p99'] * 1e3:.2f} ms")
+    if args.high_fraction > 0:
+        hi, no = by_class["high"], by_class["normal"]
+        print(f"  high  lane p50/p99: {hi['p50'] * 1e3:.2f} / "
+              f"{hi['p99'] * 1e3:.2f} ms "
+              f"({snap['completed_by_class']['high']} requests)")
+        print(f"  normal lane p50/p99: {no['p50'] * 1e3:.2f} / "
+              f"{no['p99'] * 1e3:.2f} ms "
+              f"({snap['completed_by_class']['normal']} requests)")
     print(f"batches: fused={snap['fused_batches']} "
           f"serial={snap['serial_batches']} "
+          f"pinned={snap['pinned_batches']} "
+          f"padded_rows={snap['padded_rows']} "
           f"histogram={snap['batch_size_histogram']}")
+    print(f"orchestration: {overhead['per_bucket'] * 1e3:.3f} ms/bucket "
+          f"{overhead['per_request'] * 1e3:.3f} ms/request "
+          f"(stage {overhead['stage_total'] * 1e3:.1f} ms + dispatch "
+          f"{overhead['dispatch_total'] * 1e3:.1f} ms total)")
     print(f"registry hit-rate: {reg['hit_rate'] * 100:.1f}% "
           f"(hits={reg['hits']} misses={reg['misses']} "
           f"evictions={reg['evictions']})")
@@ -249,6 +393,8 @@ def main(argv=None) -> int:
                   f"p95={lat['p95'] * 1e3:.2f}ms "
                   f"p99={lat['p99'] * 1e3:.2f}ms, "
                   f"fused_batches={snap['fused_batches']}, "
+                  f"pinned_batches={snap['pinned_batches']}, "
+                  f"padded_rows={snap['padded_rows']}, "
                   f"registry_hit_rate={reg['hit_rate']:.3f})",
         "value": round(throughput, 3),
         "unit": "req/s",
@@ -259,6 +405,7 @@ def main(argv=None) -> int:
         "speedup_vs_warm_loop": round(
             throughput / warm_loop_throughput, 3),
         "registry_hit_rate": round(reg["hit_rate"], 4),
+        "high_fraction": args.high_fraction,
         "serve_metrics": snap,
         "platform": platform_summary(),
     }

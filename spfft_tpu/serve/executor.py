@@ -6,21 +6,53 @@ multi-transform scheduler — hand-interleaved phases of N transforms
 here as ``spfft_tpu.multi``. This module turns that primitive into a
 request-driven serving layer: callers ``submit(signature, values)`` from
 any number of threads and get ``concurrent.futures.Future``s back; a
-single dispatcher thread buckets same-signature requests that arrive
-within a small time window and executes full buckets through the plan's
-fused batched executables (the ``multi.py`` fused path — one vmapped
-dispatch for B requests), stragglers through the ordinary serial path.
+single dispatcher thread buckets same-signature requests and executes
+full buckets through the plan's fused batched executables (the
+``multi.py`` fused path — one vmapped dispatch for B requests),
+stragglers through the ordinary serial path.
+
+The dispatch path is built for hardware-speed serving:
+
+* **Per-signature pending shards** — requests land in a shard keyed by
+  ``(signature, kind, scaling)``; bucket formation pops one shard's
+  lanes instead of re-scanning one global queue per take (the PR-1
+  structure, O(queue) per bucket).
+* **Priority lanes + EDF** — ``submit(..., priority="high")`` enters a
+  shard's high lane, served before ANY normal-lane work; within each
+  lane requests order earliest-deadline-first (deadline-less requests
+  keep FIFO order behind every deadlined one). A forming normal bucket
+  closes its batching window early when a high-priority request arrives
+  for another signature or a queued deadline is about to expire.
+* **Adaptive batch-shape pinning** — a per-shard observer watches
+  fused bucket sizes; once the same size repeats ``pin_after``
+  consecutive times, that EXACT shape is pinned (per-signature LRU,
+  ``max_pinned_shapes`` entries) and buckets of that size dispatch with
+  ZERO pad rows. Shape churn never pins and falls back to the pow2
+  ladder (``multi.planned_batch_size``), keeping compile count bounded
+  by O(log max_batch) + max_pinned_shapes per signature.
+* **Reusable staging buffers + double-buffered pipelining** — fused
+  buckets stack into preallocated per-(shard, shape) host buffers
+  (checked out from a free-list, returned when the bucket resolves, so
+  a buffer is never rewritten while its transfer may still alias it),
+  and the in-flight window is one deeper than the device pool so the
+  host stacks bucket N+1 while the devices execute bucket N. Future
+  resolution and metric recording happen outside the queue lock.
 
 Correctness contract: any interleaving of concurrent requests produces
-results BIT-IDENTICAL to running each request alone on its plan. Two
+results BIT-IDENTICAL to running each request alone on its plan. Three
 structural facts make this hold: (1) requests only share a bucket when
 their signatures are equal, and equal signatures resolve to the same
 plan object (registry invariant); (2) the fused batched pipeline is the
-vmapped form of the serial pipeline over identical static tables —
-verified bit-exact against the serial path by the tier-1 concurrency
-fuzz (tests/test_serve_executor.py). The batching policy (when fusion
-wins) is ``multi.fusion_eligible`` — the SAME gate ``multi_transform_*``
-uses, so the serving layer degrades to serial dispatch exactly where the
+vmapped form of the serial pipeline over identical static tables — vmap
+rows are independent, so pad rows (repeats of row 0) and the CHOICE of
+batch shape (pinned exact vs ladder) cannot perturb the live rows;
+(3) staged host buffers carry exactly the per-row coerced layout
+(``plan.batch_row_template``) at the plan's own dtype. Verified
+bit-exact against the serial path by the tier-1 concurrency fuzz
+(tests/test_serve_executor.py), which mixes priorities and pinned
+shapes. The batching policy (when fusion wins) is
+``multi.fusion_eligible`` — the SAME gate ``multi_transform_*`` uses,
+so the serving layer degrades to serial dispatch exactly where the
 library itself would.
 
 Flow control is explicit and bounded: a fixed-capacity queue whose
@@ -34,22 +66,34 @@ gracefully to serial per-request dispatch.
 from __future__ import annotations
 
 import collections
+import heapq
+import itertools
+import math
 import threading
 import time
 from concurrent.futures import Future
-from typing import Optional
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
 
 from ..errors import (DeadlineExpiredError, InvalidParameterError,
                       QueueFullError, ServeError)
-from ..multi import fusion_eligible
+from ..multi import fusion_eligible, planned_batch_size
+from ..plan import TransformPlan
 from ..types import Scaling
 from .metrics import ServeMetrics
 from .registry import PlanRegistry, PlanSignature
 
 #: Default same-signature batching window (seconds): long enough to
 #: collect a burst dispatched by concurrent submitters, short enough to
-#: be invisible next to a single transform execution (ms-class).
-DEFAULT_BATCH_WINDOW = 0.002
+#: be invisible next to a single transform execution (ms-class). Retuned
+#: round 7 against measured arrival/orchestration latency: 8 submitter
+#: threads spread a bucket-of-8 worth of arrivals over ~0.1 ms, so 1 ms
+#: still absorbs a burst while halving the worst-case latency a trickle
+#: request pays waiting for company that never arrives; throughput at
+#: 1 ms vs the old 2 ms is noise-equivalent under backlog, where the
+#: window never applies (BENCHMARKS.md round-7).
+DEFAULT_BATCH_WINDOW = 0.001
 
 #: Default bucket cap — the fused-batch regime gate
 #: (multi.FUSED_BATCH_MAX_GRID) bounds total work; this bounds latency
@@ -58,24 +102,73 @@ DEFAULT_MAX_BATCH = 8
 
 DEFAULT_MAX_QUEUE = 256
 
+#: Consecutive same-size fused buckets before that exact shape is
+#: pinned. 3 rides out one-off stragglers without delaying a genuinely
+#: stable trace; 0 disables pinning.
+DEFAULT_PIN_AFTER = 3
+
+#: Pinned exact shapes kept per signature (LRU). Each pin compiles one
+#: extra executable per (kind, device), so the total compile bound stays
+#: O(log max_batch) ladder + this.
+DEFAULT_MAX_PINNED = 4
+
+_PRIORITIES = ("normal", "high")
+
 
 class _Request:
     __slots__ = ("key", "plan", "kind", "values", "scaling", "deadline",
-                 "future", "enqueued_at")
+                 "priority", "seq", "future", "enqueued_at")
 
-    def __init__(self, key, plan, kind, values, scaling, deadline):
+    def __init__(self, key, plan, kind, values, scaling, deadline,
+                 priority, seq):
         self.key = key
         self.plan = plan
         self.kind = kind
         self.values = values
         self.scaling = scaling
         self.deadline = deadline
+        self.priority = priority
+        self.seq = seq
         self.future: Future = Future()
         self.enqueued_at = time.monotonic()
 
 
+class _Shard:
+    """Pending work + batch-shape observer for one (signature, kind,
+    scaling) key. Lanes are heaps of ``(deadline-or-inf, seq, request)``
+    — EDF within the lane, FIFO among deadline-less requests. The shard
+    survives idle periods so its observer state (and the signature's
+    pinned shapes) persist across traffic gaps."""
+
+    __slots__ = ("key", "plan", "high", "normal", "last_size", "streak",
+                 "row_template", "template_ready")
+
+    def __init__(self, key, plan):
+        self.key = key
+        self.plan = plan
+        self.high: List[Tuple[float, int, _Request]] = []
+        self.normal: List[Tuple[float, int, _Request]] = []
+        self.last_size = 0
+        self.streak = 0
+        self.row_template = None
+        self.template_ready = False
+
+    def pending(self) -> bool:
+        return bool(self.high or self.normal)
+
+    def head_rank(self):
+        """Scheduling rank of this shard's most urgent request:
+        ``(lane, deadline-or-inf, seq)`` — high lane beats normal,
+        then EDF, then arrival order. None when empty."""
+        if self.high:
+            return (0, self.high[0][0], self.high[0][1])
+        if self.normal:
+            return (1, self.normal[0][0], self.normal[0][1])
+        return None
+
+
 class ServeExecutor:
-    """One dispatcher thread over a bounded request queue.
+    """One dispatcher thread over bounded per-signature request shards.
 
     ``registry`` resolves signatures to plans (requests for unknown
     signatures are rejected at submit time — a server warms its shapes
@@ -94,10 +187,18 @@ class ServeExecutor:
                  batching: bool = True,
                  devices=None,
                  metrics: Optional[ServeMetrics] = None,
+                 pin_after: int = DEFAULT_PIN_AFTER,
+                 max_pinned_shapes: int = DEFAULT_MAX_PINNED,
+                 pipeline_depth: Optional[int] = None,
                  autostart: bool = True):
         if max_batch < 1 or max_queue < 1:
             raise InvalidParameterError(
                 "max_batch and max_queue must be >= 1")
+        if pipeline_depth is not None and pipeline_depth < 1:
+            raise InvalidParameterError("pipeline_depth must be >= 1")
+        if pin_after < 0 or max_pinned_shapes < 1:
+            raise InvalidParameterError(
+                "pin_after must be >= 0 and max_pinned_shapes >= 1")
         self.registry = registry
         self.metrics = metrics if metrics is not None else ServeMetrics()
         # The device pool: ``None`` keeps every execution on the default
@@ -115,7 +216,24 @@ class ServeExecutor:
         self._max_batch = int(max_batch)
         self._max_queue = int(max_queue)
         self._batching = bool(batching)
-        self._queue: "collections.deque[_Request]" = collections.deque()
+        self._pin_after = int(pin_after)
+        self._max_pinned = int(max_pinned_shapes)
+        self._pipeline_depth = pipeline_depth
+        self._shards: Dict[tuple, _Shard] = {}
+        self._pending = 0
+        self._high_pending = 0
+        # GIL-atomic arrival counter: requests are stamped BEFORE the
+        # queue lock so Future/request construction never extends the
+        # lock hold; heap ties only need uniqueness + rough arrival
+        # order, not lock-exact monotonicity
+        self._seq = itertools.count(1)
+        # per-signature pinned exact batch shapes (LRU); dispatcher
+        # thread only, no lock needed
+        self._pins: Dict[PlanSignature,
+                         "collections.OrderedDict[int, None]"] = {}
+        # staging buffer free-lists, keyed (shard key, batch shape);
+        # dispatcher thread only
+        self._staging: Dict[tuple, List[np.ndarray]] = {}
         self._cv = threading.Condition()
         self._closed = False
         self._thread: Optional[threading.Thread] = None
@@ -138,17 +256,23 @@ class ServeExecutor:
         """Stop accepting work and shut the dispatcher down. With
         ``drain`` (default) queued requests execute first; otherwise
         they fail with ``ServeError``."""
+        dropped: List[_Request] = []
         with self._cv:
             if self._closed:
                 return
             self._closed = True
             if not drain:
-                while self._queue:
-                    req = self._queue.popleft()
-                    req.future.set_exception(
-                        ServeError("executor closed before dispatch"))
+                for shard in self._shards.values():
+                    for lane in (shard.high, shard.normal):
+                        dropped.extend(req for _, _, req in lane)
+                        lane.clear()
+                self._pending = 0
+                self._high_pending = 0
             self._cv.notify_all()
             thread = self._thread
+        for req in dropped:  # resolve futures outside the lock
+            req.future.set_exception(
+                ServeError("executor closed before dispatch"))
         if thread is None:
             # never started: drain synchronously so no future is left
             # forever-pending
@@ -166,19 +290,27 @@ class ServeExecutor:
     def submit(self, signature: PlanSignature, values,
                kind: str = "backward",
                scaling: Scaling = Scaling.NONE,
-               timeout: Optional[float] = None) -> Future:
+               timeout: Optional[float] = None,
+               priority: str = "normal") -> Future:
         """Queue one transform request; returns its Future.
 
         ``kind`` is ``"backward"`` (values -> space) or ``"forward"``
         (space -> values, with ``scaling``). ``timeout`` (seconds) sets
         a deadline: requests still queued when it elapses fail with
-        ``DeadlineExpiredError`` instead of executing. Raises
-        ``QueueFullError`` immediately when the bounded queue is at
-        capacity and ``InvalidParameterError`` for signatures the
-        registry does not hold."""
+        ``DeadlineExpiredError`` instead of executing, and queued
+        requests are served earliest-deadline-first within their lane.
+        ``priority`` is ``"normal"`` or ``"high"`` — high-lane requests
+        are served before any normal-lane work and preempt a forming
+        normal bucket's batching window. Raises ``QueueFullError``
+        immediately when the bounded queue is at capacity and
+        ``InvalidParameterError`` for signatures the registry does not
+        hold."""
         if kind not in ("backward", "forward"):
             raise InvalidParameterError(
                 f"kind must be 'backward' or 'forward', got {kind!r}")
+        if priority not in _PRIORITIES:
+            raise InvalidParameterError(
+                f"priority must be 'normal' or 'high', got {priority!r}")
         scaling = Scaling(scaling)
         plan = self.registry.get(signature)
         if plan is None:
@@ -186,88 +318,142 @@ class ServeExecutor:
                 f"signature not in registry (warm up first): {signature}")
         deadline = (time.monotonic() + timeout
                     if timeout is not None else None)
-        req = _Request((signature, kind, scaling), plan, kind, values,
-                       scaling, deadline)
+        key = (signature, kind, scaling)
+        req = _Request(key, plan, kind, values, scaling, deadline,
+                       priority, next(self._seq))
+        entry = (deadline if deadline is not None else math.inf,
+                 req.seq, req)
         with self._cv:
             if self._closed:
                 raise ServeError("executor is closed")
-            if len(self._queue) >= self._max_queue:
-                self.metrics.record_reject_queue_full()
-                raise QueueFullError(
-                    f"serving queue full ({self._max_queue} requests) — "
-                    f"backpressure: retry later or raise max_queue")
-            self._queue.append(req)
-            self.metrics.record_enqueue(len(self._queue))
-            self._cv.notify_all()
+            if self._pending >= self._max_queue:
+                full = True
+            else:
+                full = False
+                shard = self._shards.get(key)
+                if shard is None:
+                    shard = self._shards[key] = _Shard(key, plan)
+                lane = shard.high if priority == "high" else shard.normal
+                heapq.heappush(lane, entry)
+                self._pending += 1
+                if priority == "high":
+                    self._high_pending += 1
+                depth = self._pending
+                self._cv.notify_all()
+        # metric recording outside the queue lock
+        if full:
+            self.metrics.record_reject_queue_full()
+            raise QueueFullError(
+                f"serving queue full ({self._max_queue} requests) — "
+                f"backpressure: retry later or raise max_queue")
+        self.metrics.record_enqueue(depth)
         return req.future
 
     def submit_backward(self, signature, values,
-                        timeout: Optional[float] = None) -> Future:
-        return self.submit(signature, values, "backward", timeout=timeout)
+                        timeout: Optional[float] = None,
+                        priority: str = "normal") -> Future:
+        return self.submit(signature, values, "backward", timeout=timeout,
+                           priority=priority)
 
     def submit_forward(self, signature, space,
                        scaling: Scaling = Scaling.NONE,
-                       timeout: Optional[float] = None) -> Future:
+                       timeout: Optional[float] = None,
+                       priority: str = "normal") -> Future:
         return self.submit(signature, space, "forward", scaling=scaling,
-                           timeout=timeout)
+                           timeout=timeout, priority=priority)
+
+    # -- scheduling (caller holds the lock) --------------------------------
+    def _select_shard(self) -> Optional[_Shard]:
+        """The shard whose head request is most urgent: high lane before
+        normal, then earliest deadline, then arrival order. O(#active
+        signatures), not O(queued requests)."""
+        best = best_rank = None
+        for shard in self._shards.values():
+            rank = shard.head_rank()
+            if rank is not None and (best_rank is None
+                                     or rank < best_rank):
+                best, best_rank = shard, rank
+        return best
+
+    def _pop_into(self, shard: _Shard, bucket: List[_Request],
+                  limit: int) -> None:
+        """Move up to ``limit - len(bucket)`` requests from the shard's
+        lanes into ``bucket`` — high lane drained first, EDF order
+        within each lane."""
+        for lane in (shard.high, shard.normal):
+            while lane and len(bucket) < limit:
+                _, _, req = heapq.heappop(lane)
+                bucket.append(req)
+                self._pending -= 1
+                if req.priority == "high":
+                    self._high_pending -= 1
+
+    def _earliest_deadline(self) -> float:
+        """The soonest deadline among ALL queued requests (inf when
+        none) — lane heads are heap minima, so this is O(#shards)."""
+        d = math.inf
+        for shard in self._shards.values():
+            for lane in (shard.high, shard.normal):
+                if lane and lane[0][0] < d:
+                    d = lane[0][0]
+        return d
 
     # -- dispatch ----------------------------------------------------------
-    def _take_bucket(self):
-        """Pop the oldest request plus every same-key request currently
-        queued (caller holds the lock), up to ``max_batch``."""
-        head = self._queue.popleft()
-        bucket = [head]
-        if self._max_batch > 1:
-            keep = collections.deque()
-            while self._queue and len(bucket) < self._max_batch:
-                req = self._queue.popleft()
-                (bucket if req.key == head.key else keep).append(req)
-            keep.extend(self._queue)
-            self._queue = keep
-        self.metrics.record_dequeue(len(self._queue))
-        return bucket
-
-    def _fill_bucket(self, bucket) -> None:
+    def _fill_bucket(self, shard: _Shard, bucket: List[_Request]) -> None:
         """Wait out the batching window, absorbing same-key arrivals
-        into ``bucket`` until it is full or the window closes."""
-        key = bucket[0].key
+        into ``bucket`` until it is full or the window closes. The
+        window closes EARLY when a high-priority request lands for
+        another signature or a queued deadline is about to expire —
+        bucket formation never holds urgent work hostage."""
         until = time.monotonic() + self._batch_window
         while len(bucket) < self._max_batch:
-            remaining = until - time.monotonic()
-            if remaining <= 0:
-                return
             with self._cv:
-                matched = False
-                keep = collections.deque()
-                while self._queue and len(bucket) < self._max_batch:
-                    req = self._queue.popleft()
-                    if req.key == key:
-                        bucket.append(req)
-                        matched = True
-                    else:
-                        keep.append(req)
-                keep.extend(self._queue)
-                self._queue = keep
-                self.metrics.record_dequeue(len(self._queue))
+                self._pop_into(shard, bucket, self._max_batch)
                 if len(bucket) >= self._max_batch or self._closed:
                     return
-                if not matched:
-                    self._cv.wait(remaining)
+                if self._high_pending:
+                    return  # high work for another key: close early
+                now = time.monotonic()
+                wait = until - now
+                d = self._earliest_deadline()
+                if d - now < wait:
+                    wait = d - now  # EDF: serve it before it expires
+                if wait <= 0:
+                    return
+                self._cv.wait(wait)
+
+    def _pipeline_slots(self) -> int:
+        """In-flight bucket window for the dispatch loop. On an
+        ACCELERATOR backend it is one slot deeper than the device pool:
+        pool-size buckets overlap across devices, and the extra slot
+        double-buffers the host side — the dispatcher stacks and
+        dispatches bucket N+1 while the device still executes bucket N.
+        On the CPU backend the extra slot is a measured LOSS (two
+        buckets then compute concurrently in XLA:CPU's shared intra-op
+        thread pool and thrash it — the round-6 finding that serialised
+        the pool in the first place; re-measured this round at -15% on
+        the same-signature trace), so CPU keeps the strict
+        dispatch-then-resolve window of pool size. ``pipeline_depth``
+        overrides the choice."""
+        if self._pipeline_depth is not None:
+            return self._pipeline_depth
+        import jax
+        extra = 0 if jax.default_backend() == "cpu" else 1
+        return len(self._devices) + extra
 
     def _dispatch_loop(self) -> None:
-        # Bounded in-flight pipelining: up to pool-size buckets stay
-        # dispatched-but-unresolved, so a device pool genuinely overlaps
-        # bucket executions (a block per bucket would serialise the pool
-        # down to one device's throughput). Futures resolve in _finish,
-        # after materialisation — depth 1 (no pool) degrades to the
-        # strict dispatch-then-block loop.
+        # Bounded in-flight pipelining (see _pipeline_slots): futures
+        # resolve in _finish, after materialisation.
         inflight: "collections.deque" = collections.deque()
-        depth = len(self._devices)
+        depth = self._pipeline_slots()
         while True:
-            bucket = None
+            shard = bucket = None
             with self._cv:
-                if self._queue:
-                    bucket = self._take_bucket()
+                if self._pending:
+                    shard = self._select_shard()
+                    bucket = []
+                    self._pop_into(shard, bucket, self._max_batch)
+                    depth_now = self._pending
                 elif inflight:
                     pass  # fall through: flush one in-flight bucket
                 elif self._closed:
@@ -278,32 +464,36 @@ class ServeExecutor:
             if bucket is None:
                 self._finish(*inflight.popleft())
                 continue
-            # Wait out the batching window only on a TRICKLE (queue
-            # empty after the take): under backlog the queued requests
-            # are already late and a window wait just adds latency
-            # without improving fill — the take itself scavenges every
-            # same-key request the backlog holds.
-            with self._cv:
-                trickle = not self._queue
-            if len(bucket) < self._max_batch and trickle \
+            self.metrics.record_dequeue(depth_now)
+            # Wait out the batching window only on a TRICKLE (nothing
+            # else queued after the take): under backlog the queued
+            # requests are already late and a window wait just adds
+            # latency without improving fill — the take itself drains
+            # every same-key request the shard holds.
+            if len(bucket) < self._max_batch and depth_now == 0 \
                     and self._batching and self._batch_window > 0 \
                     and not self._closed:
-                self._fill_bucket(bucket)
-            work = self._execute(bucket)
+                self._fill_bucket(shard, bucket)
+            work = self._execute(shard, bucket)
             if work is not None:
                 inflight.append(work)
             while len(inflight) >= depth:
                 self._finish(*inflight.popleft())
 
     def _drain_once(self) -> None:
-        """Synchronous drain for the never-started case (close() on an
-        ``autostart=False`` executor that queued work)."""
+        """Synchronous drain (close() on a never-started executor, and
+        the bench CLI's deterministic ``--smoke`` waves): buckets form
+        from whatever is queued, no windows, no pipelining."""
         while True:
             with self._cv:
-                if not self._queue:
+                if not self._pending:
                     return
-                bucket = self._take_bucket()
-            work = self._execute(bucket)
+                shard = self._select_shard()
+                bucket: List[_Request] = []
+                self._pop_into(shard, bucket, self._max_batch)
+                depth_now = self._pending
+            self.metrics.record_dequeue(depth_now)
+            work = self._execute(shard, bucket)
             if work is not None:
                 self._finish(*work)
 
@@ -314,26 +504,30 @@ class ServeExecutor:
         return d
 
     def prewarm(self, signature: PlanSignature,
-                scaling: Scaling = Scaling.NONE) -> None:
+                scaling: Scaling = Scaling.NONE,
+                batch_sizes=()) -> None:
         """Compile/warm every executable this executor can dispatch for
         ``signature``: the serial backward/forward pair plus each fused
-        batch shape of the planned-batch ladder, on EVERY pool device
-        (jit caches one executable per device). Call once per signature
-        before traffic — on TPU this is where the persistent compilation
-        cache pays out; without it the first bucket per (shape, device,
-        ladder size) eats a compile inside a request's latency."""
+        batch shape of the planned-batch ladder — plus any
+        ``batch_sizes`` a caller expects to PIN (exact shapes the
+        adaptive observer would otherwise compile on first pinned
+        dispatch) — on EVERY pool device (jit caches one executable per
+        device). Call once per signature before traffic — on TPU this is
+        where the persistent compilation cache pays out; without it the
+        first bucket per (shape, device, ladder size) eats a compile
+        inside a request's latency."""
         plan = self.registry.get(signature)
         if plan is None:
             raise InvalidParameterError(
                 f"signature not in registry: {signature}")
         import jax
-        import numpy as np
         nv = plan.index_plan.num_values
         zeros = (np.zeros((nv, 2), np.float32)
                  if plan.precision == "single"
                  else np.zeros(nv, np.complex128))
         ladder = sorted({self._padded_size(b)
-                         for b in range(2, self._max_batch + 1)})
+                         for b in range(2, self._max_batch + 1)}
+                        | {int(b) for b in batch_sizes if int(b) >= 2})
         for device in self._devices:
             space = plan.backward(zeros, device=device)
             out = [plan.forward(space, scaling, device=device)]
@@ -348,29 +542,110 @@ class ServeExecutor:
             jax.block_until_ready(out)
 
     def _padded_size(self, b: int) -> int:
-        """The batch ladder: the smallest power of two >= ``b``, capped
-        at ``max_batch``. Bounds the set of compiled batch shapes per
-        plan while wasting at most 2x compute on pad rows."""
-        p = 2
-        while p < b and p < self._max_batch:
-            p *= 2
-        return min(p, self._max_batch)
+        """The fallback batch ladder (``multi.planned_batch_size``):
+        smallest power of two >= ``b``, capped at ``max_batch``."""
+        return planned_batch_size(b, self._max_batch)
 
-    def _execute(self, bucket):
+    def _dispatch_shape(self, shard: _Shard, b: int) -> Tuple[int, bool]:
+        """The batch shape a fused bucket of ``b`` live rows dispatches
+        at, and whether that shape is exact (pinned or ladder-exact).
+
+        The observer pins ``b`` once it repeats ``pin_after`` times
+        consecutively; pinned shapes live in a per-signature LRU capped
+        at ``max_pinned_shapes``. Churny traffic (no streak) falls back
+        to the pow2 ladder, so the compiled-shape count stays bounded
+        either way. Dispatcher thread only — no lock."""
+        ladder = self._padded_size(b)
+        if ladder == b:
+            # ladder already exact: zero pad rows for free, no pin
+            # needed (and none counted — pinned_batches reads the
+            # adaptive path only)
+            return b, False
+        if self._pin_after <= 0:
+            return ladder, False
+        if b == shard.last_size:
+            shard.streak += 1
+        else:
+            shard.last_size = b
+            shard.streak = 1
+        pins = self._pins.get(shard.key[0])
+        if pins is not None and b in pins:
+            pins.move_to_end(b)
+            return b, True
+        if shard.streak >= self._pin_after:
+            if pins is None:
+                pins = self._pins[shard.key[0]] = collections.OrderedDict()
+            pins[b] = None
+            while len(pins) > self._max_pinned:
+                pins.popitem(last=False)
+            return b, True
+        return ladder, False
+
+    # -- staging -----------------------------------------------------------
+    def _row_template(self, shard: _Shard):
+        if not shard.template_ready:
+            shard.row_template = shard.plan.batch_row_template(
+                "values" if shard.key[1] == "backward" else "space")
+            shard.template_ready = True
+        return shard.row_template
+
+    def _stage(self, shard: _Shard, live: List[_Request], shape: int):
+        """Stack ``live`` payloads (plus pad rows up to ``shape``) into
+        a reusable preallocated host buffer when every payload coerces
+        to a host row of the plan's template — one allocation per
+        (shard, shape) steady-state, one device transfer per bucket.
+        Returns ``(batch_arg, buffer)``; ``buffer`` is None on the
+        fallback list path (device-array payloads, double-single plans),
+        where the plan's own ``_stack_coerced`` handles staging.
+
+        Buffers come from a free-list and are returned in
+        :meth:`_finish` AFTER the bucket materialises — ``jnp.asarray``
+        may alias host memory on the CPU backend, so a buffer is never
+        rewritten while its bucket may still read it."""
+        template = self._row_template(shard)
+        if template is not None:
+            plan, kind = shard.plan, shard.key[1]
+            coerce = (plan._coerce_values if kind == "backward"
+                      else plan._coerce_space)
+            rows = [coerce(req.values) for req in live]
+            row_shape, dtype = template
+            if all(isinstance(r, np.ndarray) and r.shape == row_shape
+                   and r.dtype == dtype for r in rows):
+                pool_key = (shard.key, shape)
+                free = self._staging.get(pool_key)
+                buf = free.pop() if free else np.empty(
+                    (shape,) + row_shape, dtype)
+                for i, r in enumerate(rows):
+                    buf[i] = r
+                for j in range(len(rows), shape):
+                    buf[j] = buf[0]  # pad rows repeat row 0
+                return buf, buf
+        values = [req.values for req in live]
+        values += [values[0]] * (shape - len(values))
+        return values, None
+
+    def _release(self, shard_key, shape: int,
+                 buf: Optional[np.ndarray]) -> None:
+        if buf is not None:
+            self._staging.setdefault((shard_key, shape), []).append(buf)
+
+    def _execute(self, shard: _Shard, bucket: List[_Request]):
         """Deadline-check and DISPATCH one bucket. Returns ``(live,
-        results)`` with results possibly still executing (the dispatch
-        loop pipelines them), or ``None`` when nothing survived the
-        deadline check or the dispatch itself failed."""
+        results, shard_key, shape, buf)`` with results possibly still
+        executing (the dispatch loop pipelines them), or ``None`` when
+        nothing survived the deadline check or the dispatch itself
+        failed."""
         now = time.monotonic()
-        live = []
+        live: List[_Request] = []
+        expired: List[_Request] = []
         for req in bucket:
-            if req.deadline is not None and now > req.deadline:
-                self.metrics.record_deadline_expired()
-                req.future.set_exception(DeadlineExpiredError(
-                    f"deadline expired after "
-                    f"{now - req.enqueued_at:.3f}s in queue"))
-            else:
-                live.append(req)
+            (expired if req.deadline is not None and now > req.deadline
+             else live).append(req)
+        for req in expired:
+            self.metrics.record_deadline_expired()
+            req.future.set_exception(DeadlineExpiredError(
+                f"deadline expired after "
+                f"{now - req.enqueued_at:.3f}s in queue"))
         if not live:
             return None
         plan = live[0].plan
@@ -378,36 +653,43 @@ class ServeExecutor:
         scaling = live[0].scaling
         # device pools apply to LOCAL plans only — a distributed plan
         # already spans its mesh and pins its own placement
-        from ..plan import TransformPlan
         pooled = (self._devices != [None]
                   and isinstance(plan, TransformPlan))
-        padded = self._padded_size(len(live))
-        fused = (self._batching and len(live) >= 2
-                 and fusion_eligible(plan, padded))
-        self.metrics.record_batch(len(live), fused)
+        b = len(live)
+        shape, exact = b, False
+        fused = False
+        if self._batching and b >= 2:
+            shape, exact = self._dispatch_shape(shard, b)
+            fused = fusion_eligible(plan, shape)
+        buf = None
+        t0 = time.perf_counter()
         try:
             if fused:
-                # Planned-batch execution (the cuFFT idiom): pad the
-                # bucket up to the next ladder size so only
+                # Planned-batch execution (the cuFFT idiom): dispatch at
+                # the exact pinned shape when the observer has locked
+                # on, else pad up to the next pow2 ladder size so only
                 # O(log max_batch) batched executables ever compile per
-                # plan, instead of one retrace per distinct bucket size.
-                # vmap rows are independent, so pad rows (repeats of row
-                # 0) cannot perturb the live rows and results stay
-                # bit-identical to serial execution. The whole bucket
-                # lands on ONE pool device; successive buckets rotate.
-                values = [r.values for r in live]
-                values += [values[0]] * (padded - len(values))
+                # plan. vmap rows are independent, so pad rows (repeats
+                # of row 0) cannot perturb the live rows and results
+                # stay bit-identical to serial execution. The whole
+                # bucket lands on ONE pool device; successive buckets
+                # rotate.
+                batch_arg, buf = self._stage(shard, live, shape)
                 device = self._next_device() if pooled else None
+                t1 = time.perf_counter()
                 if kind == "backward":
-                    stacked = plan.backward_batched(values, device=device)
+                    stacked = plan.backward_batched(batch_arg,
+                                                    device=device)
                 else:
-                    stacked = plan.forward_batched(values, scaling,
+                    stacked = plan.forward_batched(batch_arg, scaling,
                                                    device=device)
-                results = [stacked[i] for i in range(len(live))]
+                results = [stacked[i] for i in range(b)]
             else:
                 # serial path: dispatch every request before blocking on
                 # any result (the multi.py async-overlap idiom), fanned
                 # round-robin across the device pool
+                t1 = t0
+                shape, exact = b, False
                 results = []
                 for req in live:
                     device = (self._next_device()
@@ -419,30 +701,51 @@ class ServeExecutor:
                         results.append(plan.forward(req.values, scaling,
                                                     device=device))
         except Exception as exc:
+            self._release(shard.key, shape, buf)
             done = time.monotonic()
             for req in live:
                 self.metrics.record_request_done(done - req.enqueued_at,
-                                                 failed=True)
+                                                 failed=True,
+                                                 priority=req.priority)
                 req.future.set_exception(exc)
             return None
-        return live, results
+        t2 = time.perf_counter()
+        self.metrics.record_batch(b, fused,
+                                  padded_rows=shape - b if fused else 0,
+                                  pinned=fused and exact,
+                                  stage_s=t1 - t0, dispatch_s=t2 - t1)
+        return live, results, shard.key, shape, buf
 
-    def _finish(self, live, results) -> None:
+    def _finish(self, live, results, shard_key=None, shape=0,
+                buf=None) -> None:
         """Materialise a dispatched bucket and resolve its futures:
         latency samples measure completion (not dispatch), and async XLA
-        failures surface here as exceptions instead of poisoned
-        arrays."""
+        failures surface here as exceptions instead of poisoned arrays.
+        The staging buffer returns to its free-list only now — after
+        materialisation — so reuse can never race the device transfer."""
         try:
             import jax
             jax.block_until_ready(results)
         except Exception as exc:
+            self._release(shard_key, shape, buf)
             done = time.monotonic()
             for req in live:
                 self.metrics.record_request_done(done - req.enqueued_at,
-                                                 failed=True)
+                                                 failed=True,
+                                                 priority=req.priority)
                 req.future.set_exception(exc)
             return
+        self._release(shard_key, shape, buf)
         done = time.monotonic()
         for req, res in zip(live, results):
-            self.metrics.record_request_done(done - req.enqueued_at)
+            self.metrics.record_request_done(done - req.enqueued_at,
+                                             priority=req.priority)
             req.future.set_result(res)
+
+    # -- introspection -----------------------------------------------------
+    def pinned_shapes(self, signature: PlanSignature) -> Tuple[int, ...]:
+        """The exact batch shapes currently pinned for ``signature``
+        (LRU order, oldest first). Diagnostic only — reads dispatcher-
+        owned state, so values are advisory under live traffic."""
+        pins = self._pins.get(signature)
+        return tuple(pins) if pins else ()

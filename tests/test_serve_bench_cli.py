@@ -56,3 +56,34 @@ def test_serve_bench_no_batching(capsys):
 
 def test_serve_bench_bad_args():
     assert main(["--requests", "0"]) == 2
+    assert main(["--high-fraction", "1.5"]) == 2
+
+
+def test_serve_bench_smoke_pins_and_drops_pad_rows(capsys):
+    """The tier-1 smoke: deterministic stable-size waves activate the
+    pinned exact-shape path and drive ladder pad rows to zero (the
+    perf_opt acceptance observable), bit-exact throughout."""
+    rc = main(["--smoke"])
+    assert rc == 0
+    payload, text = _last_json(capsys)
+    assert payload["smoke"] and payload["ok"]
+    assert payload["pinned_batches"] >= 1
+    assert payload["padded_rows_per_wave"][-1] == 0
+    assert payload["failures"] == []
+    assert "pad rows per wave" in text
+
+
+def test_serve_bench_priority_classes(capsys):
+    """--high-fraction floods a deterministic subset through the high
+    lane; per-class latency percentiles land in the payload."""
+    rc = main(["--dim", "12", "--requests", "32", "--signatures", "1",
+               "--threads", "4", "--high-fraction", "0.3"])
+    assert rc == 0
+    payload, text = _last_json(capsys)
+    snap = payload["serve_metrics"]
+    by_class = snap["latency_seconds_by_class"]
+    assert set(by_class) == {"high", "normal"}
+    counts = snap["completed_by_class"]
+    assert counts["high"] + counts["normal"] == 32
+    assert counts["high"] > 0
+    assert "high  lane p50/p99" in text

@@ -1,10 +1,14 @@
 """Serving executor: the bit-identical concurrency contract plus every
-flow-control path (deadline, queue-full, degradation, shutdown).
+flow-control path (deadline, queue-full, degradation, shutdown) and the
+adaptive dispatch machinery (priority lanes, EDF, batch-shape pinning,
+staged host buffers).
 
 The load-bearing test is the concurrency fuzz: 8 submitter threads x
-mixed signatures against per-request serial oracles with EXACT equality
-— any relaxation here would let the fused batched path drift from the
-serial path silently. The fused path must also demonstrably engage
+mixed signatures x mixed PRIORITIES with aggressive pinning
+(pin_after=1) against per-request serial oracles with EXACT equality —
+any relaxation here would let the fused batched path (padded ladder OR
+pinned exact shapes, staged through reusable host buffers) drift from
+the serial path silently. The fused path must also demonstrably engage
 (at least one fused batch >= 2 in metrics).
 """
 
@@ -87,42 +91,49 @@ def test_fused_batch_bitexact_and_observed():
 
 
 def test_concurrency_fuzz_mixed_signatures():
-    """8 submitter threads x 96 mixed-signature requests == the serial
-    oracle, exactly; >= 1 fused batch of >= 2 observed (acceptance
-    criterion). Requests are staged before the dispatcher starts so
-    full same-signature buckets are guaranteed to form, then submitted
+    """8 submitter threads x 96 mixed-signature, mixed-PRIORITY requests
+    == the serial oracle, exactly; >= 1 fused batch of >= 2 observed
+    (acceptance criterion). ``pin_after=1`` makes the observer pin
+    aggressively, so racy bucket sizes exercise the pinned exact-shape
+    dispatch path alongside the pow2 ladder — neither may perturb
+    results. Requests are staged before the dispatcher starts so full
+    same-signature buckets are guaranteed to form, then submitted
     concurrently while the dispatcher drains — both the staged and the
     racing arrivals must hold the contract."""
     reg, sigs = _registry_with([1, 2, 3])
     rng = np.random.default_rng(42)
-    requests = []  # (sig, kind, scaling, payload, oracle)
+    requests = []  # (sig, kind, scaling, priority, payload, oracle)
     for i in range(96):
         sig = sigs[int(rng.integers(len(sigs)))]
         plan = reg.get(sig)
         v = _values_for(reg, sig, rng)
+        prio = "high" if rng.random() < 0.3 else "normal"
         if rng.random() < 0.5:
-            requests.append((sig, "backward", Scaling.NONE, v,
+            requests.append((sig, "backward", Scaling.NONE, prio, v,
                              np.asarray(plan.backward(v))))
         else:
             space = np.asarray(plan.backward(v))
             scl = Scaling.FULL if rng.random() < 0.5 else Scaling.NONE
-            requests.append((sig, "forward", scl, space,
+            requests.append((sig, "forward", scl, prio, space,
                              np.asarray(plan.forward(space, scl))))
 
-    ex = ServeExecutor(reg, autostart=False, batch_window=0.001)
+    ex = ServeExecutor(reg, autostart=False, batch_window=0.001,
+                       pin_after=1)
     futures = [None] * len(requests)
     errors = []
     # stage the first third (guarantees formed buckets); the 8 threads
     # then race >= 64 submissions against the draining dispatcher
     for i in range(32):
-        sig, kind, scl, payload, _ = requests[i]
-        futures[i] = ex.submit(sig, payload, kind, scaling=scl)
+        sig, kind, scl, prio, payload, _ = requests[i]
+        futures[i] = ex.submit(sig, payload, kind, scaling=scl,
+                               priority=prio)
 
     def submitter(indices):
         for i in indices:
-            sig, kind, scl, payload, _ = requests[i]
+            sig, kind, scl, prio, payload, _ = requests[i]
             try:
-                futures[i] = ex.submit(sig, payload, kind, scaling=scl)
+                futures[i] = ex.submit(sig, payload, kind, scaling=scl,
+                                       priority=prio)
             except Exception as exc:  # pragma: no cover
                 errors.append(exc)
 
@@ -135,16 +146,19 @@ def test_concurrency_fuzz_mixed_signatures():
     for th in threads:
         th.join()
     assert not errors
-    for i, (sig, kind, scl, payload, oracle) in enumerate(requests):
+    for i, (sig, kind, scl, prio, payload, oracle) in enumerate(requests):
         got = np.asarray(futures[i].result(timeout=60))
         assert np.array_equal(got, oracle), \
-            f"request {i} ({kind}) diverged from its serial oracle"
+            f"request {i} ({kind}, {prio}) diverged from its serial oracle"
     ex.close()
     assert ex.metrics.fused_batches >= 1
     assert ex.metrics.max_fused_batch_size >= 2
     snap = ex.metrics.snapshot(reg)
     assert snap["completed"] == 96
     assert snap["failed"] == 0
+    assert (snap["completed_by_class"]["high"]
+            + snap["completed_by_class"]["normal"]) == 96
+    assert snap["completed_by_class"]["high"] > 0
 
 
 def test_batching_disabled_degrades_serial():
@@ -288,3 +302,171 @@ def test_padded_ladder():
     ex6 = ServeExecutor(reg, max_batch=6, autostart=False)
     assert ex6._padded_size(5) == 6
     ex6.close()
+
+
+# -- priority lanes ---------------------------------------------------------
+def test_priority_high_served_before_staged_normals():
+    """A high-priority request staged AFTER a full normal bucket for a
+    different signature resolves first — the high lane preempts shard
+    selection."""
+    reg, (sig_a, sig_b) = _registry_with([1, 2])
+    rng = np.random.default_rng(11)
+    order = []
+    ex = ServeExecutor(reg, autostart=False, batch_window=0.0)
+    futs_a = [ex.submit(sig_a, _values_for(reg, sig_a, rng))
+              for _ in range(4)]
+    fut_b = ex.submit(sig_b, _values_for(reg, sig_b, rng),
+                      priority="high")
+    for i, f in enumerate(futs_a):
+        f.add_done_callback(lambda _f, i=i: order.append(("A", i)))
+    fut_b.add_done_callback(lambda _f: order.append(("B",)))
+    ex.start()
+    for f in futs_a + [fut_b]:
+        f.result(timeout=30)
+    ex.close()
+    assert order[0] == ("B",)
+
+
+def test_deadline_edf_selection_order():
+    """Within a lane, a deadlined request staged AFTER a deadline-less
+    one is served first (EDF; deadline-less requests keep FIFO order
+    behind every deadlined one)."""
+    reg, (sig_a, sig_b) = _registry_with([1, 2])
+    rng = np.random.default_rng(12)
+    order = []
+    ex = ServeExecutor(reg, autostart=False, batch_window=0.0)
+    fut_a = ex.submit(sig_a, _values_for(reg, sig_a, rng))
+    fut_b = ex.submit(sig_b, _values_for(reg, sig_b, rng), timeout=30)
+    fut_a.add_done_callback(lambda _f: order.append("A"))
+    fut_b.add_done_callback(lambda _f: order.append("B"))
+    ex.start()
+    fut_a.result(timeout=30)
+    fut_b.result(timeout=30)
+    ex.close()
+    assert order[0] == "B"
+
+
+def test_bad_priority_rejected():
+    reg, (sig,) = _registry_with([1])
+    with ServeExecutor(reg, autostart=False) as ex:
+        with pytest.raises(InvalidParameterError):
+            ex.submit(sig, np.zeros(4), priority="urgent")
+
+
+def test_per_class_latency_recorded():
+    reg, (sig,) = _registry_with([1])
+    rng = np.random.default_rng(13)
+    with ServeExecutor(reg) as ex:
+        ex.submit(sig, _values_for(reg, sig, rng)).result(timeout=30)
+        ex.submit(sig, _values_for(reg, sig, rng),
+                  priority="high").result(timeout=30)
+    snap = ex.metrics.snapshot()
+    assert snap["completed_by_class"] == {"high": 1, "normal": 1}
+    assert snap["latency_seconds_by_class"]["high"]["p50"] > 0
+    assert snap["latency_seconds_by_class"]["normal"]["p50"] > 0
+
+
+# -- adaptive batch-shape pinning -------------------------------------------
+def _run_waves(ex, reg, sig, sizes, rng):
+    """Stage exact-size waves and drain synchronously (deterministic
+    bucket sizes), checking every result bit-exact against the serial
+    oracle."""
+    plan = reg.get(sig)
+    for size in sizes:
+        vals = [_values_for(reg, sig, rng) for _ in range(size)]
+        oracles = [np.asarray(plan.backward(v)) for v in vals]
+        futures = [ex.submit(sig, v) for v in vals]
+        ex._drain_once()
+        for f, expect in zip(futures, oracles):
+            assert np.array_equal(np.asarray(f.result(timeout=30)),
+                                  expect)
+
+
+def test_pinning_stable_size_drops_pad_rows():
+    """Five waves of 5 (not a power of two): the first pin_after=3
+    waves pad 5 -> 8, then the exact shape pins and pad rows stop. All
+    results stay bit-identical to the serial oracle (checked inside
+    _run_waves)."""
+    reg, (sig,) = _registry_with([1])
+    rng = np.random.default_rng(21)
+    ex = ServeExecutor(reg, autostart=False, batch_window=0.0,
+                       pin_after=3)
+    _run_waves(ex, reg, sig, [5, 5, 5, 5, 5], rng)
+    ex.close()
+    assert ex.metrics.padded_rows == 2 * 3  # waves 1-2 padded 5 -> 8
+    assert ex.metrics.pinned_batches == 3   # waves 3-5 exact
+    assert ex.pinned_shapes(sig) == (5,)
+    # staging buffers were checked out and returned, not leaked: the
+    # free-lists hold at most one buffer per (key, shape)
+    assert all(len(v) <= 1 for v in ex._staging.values())
+
+
+def test_pinning_churn_never_pins():
+    """Alternating bucket sizes never build a streak, so the observer
+    never pins and every bucket rides the pow2 ladder."""
+    reg, (sig,) = _registry_with([1])
+    rng = np.random.default_rng(22)
+    ex = ServeExecutor(reg, autostart=False, batch_window=0.0,
+                       pin_after=3)
+    _run_waves(ex, reg, sig, [3, 5, 3, 5, 3, 5], rng)
+    ex.close()
+    assert ex.metrics.pinned_batches == 0
+    assert ex.pinned_shapes(sig) == ()
+    # 3 pads to 4 (1 row), 5 pads to 8 (3 rows)
+    assert ex.metrics.padded_rows == 3 * 1 + 3 * 3
+
+
+def test_pinning_disabled_keeps_ladder():
+    reg, (sig,) = _registry_with([1])
+    rng = np.random.default_rng(23)
+    ex = ServeExecutor(reg, autostart=False, batch_window=0.0,
+                       pin_after=0)
+    _run_waves(ex, reg, sig, [5, 5, 5], rng)
+    ex.close()
+    assert ex.metrics.pinned_batches == 0
+    assert ex.metrics.padded_rows == 3 * 3
+
+
+def test_pinned_shape_lru_bounded():
+    """More stable shapes than max_pinned_shapes: the LRU evicts the
+    oldest pin; results stay exact throughout."""
+    reg, (sig,) = _registry_with([1])
+    rng = np.random.default_rng(24)
+    ex = ServeExecutor(reg, autostart=False, batch_window=0.0,
+                       pin_after=1, max_pinned_shapes=2)
+    _run_waves(ex, reg, sig, [3, 5, 6, 7], rng)
+    ex.close()
+    pins = ex.pinned_shapes(sig)
+    assert len(pins) == 2
+    assert pins == (6, 7)  # 3 and 5 evicted oldest-first
+
+
+def test_fused_serial_histograms_split():
+    """A serial bucket of size >= 2 (batching off) lands in the serial
+    histogram and cannot inflate max_fused_batch_size."""
+    reg, (sig,) = _registry_with([1])
+    rng = np.random.default_rng(25)
+    ex = ServeExecutor(reg, autostart=False, batching=False)
+    futures = [ex.submit(sig, _values_for(reg, sig, rng))
+               for _ in range(4)]
+    ex._drain_once()
+    for f in futures:
+        f.result(timeout=30)
+    ex.close()
+    assert ex.metrics.max_fused_batch_size == 0
+    snap = ex.metrics.snapshot()
+    assert snap["serial_batch_histogram"] == {"4": 1}
+    assert snap["fused_batch_histogram"] == {}
+    assert snap["batch_size_histogram"] == {"4": 1}
+
+
+def test_latency_reservoir_bounded():
+    from spfft_tpu.serve.metrics import ServeMetrics
+    m = ServeMetrics(latency_window=8)
+    for i in range(100):
+        m.record_request_done(float(i + 1))
+    snap = m.snapshot()
+    assert snap["completed"] == 100           # lifetime counter exact
+    assert snap["latency_count"] == 8         # reservoir bounded
+    # percentiles read the recent window only (samples 93..100)
+    assert m.latency_percentiles()["p50"] >= 93.0

@@ -6,6 +6,8 @@ resident byte total stays under the configured budget, and every
 lookup/build is counted.
 """
 
+import threading
+
 import numpy as np
 import pytest
 
@@ -151,3 +153,107 @@ def test_warmup_r2c_single():
 def test_registry_rejects_bad_bounds():
     with pytest.raises(InvalidParameterError):
         PlanRegistry(max_plans=0)
+
+
+# -- get_or_build hot path (zero-rebuild resolution) ------------------------
+def test_fast_path_skips_index_plan_build(monkeypatch):
+    """A repeated raw request shape resolves through the bytes -> sig
+    memo without touching build_index_plan (the cost the fast path
+    exists to skip)."""
+    import spfft_tpu.serve.registry as regmod
+    calls = {"n": 0}
+    real = regmod.build_index_plan
+
+    def counting(*a, **k):
+        calls["n"] += 1
+        return real(*a, **k)
+
+    monkeypatch.setattr(regmod, "build_index_plan", counting)
+    reg = PlanRegistry()
+    t = _triplets()
+    sig1, plan1 = reg.get_or_build(TransformType.C2C, *DIMS, t,
+                                   precision="double")
+    assert calls["n"] == 1
+    sig2, plan2 = reg.get_or_build(TransformType.C2C, *DIMS, t,
+                                   precision="double")
+    assert calls["n"] == 1  # memo hit: no index-plan rebuild
+    assert sig1 == sig2 and plan1 is plan2
+    assert reg.stats()["fast_hits"] == 1
+
+
+def test_memo_two_spellings_resolve_one_plan():
+    """Centered and wrapped spellings of one sparse set occupy two memo
+    slots but resolve to the SAME canonical signature and plan — one
+    build total."""
+    reg = PlanRegistry()
+    t = _triplets()
+    centered = t.astype(np.int64).copy()
+    for axis, n in enumerate(DIMS):
+        col = centered[:, axis]
+        centered[:, axis] = np.where(col > n // 2, col - n, col)
+    sig1, plan1 = reg.get_or_build(TransformType.C2C, *DIMS, t,
+                                   precision="double")
+    sig2, plan2 = reg.get_or_build(TransformType.C2C, *DIMS,
+                                   centered.astype(np.int32),
+                                   precision="double")
+    assert sig1 == sig2 and plan1 is plan2
+    assert reg.stats()["builds"] == 1
+    assert reg.stats()["sig_memo_entries"] == 2
+
+
+def test_singleflight_concurrent_misses_build_once():
+    """N threads racing the same cold shape: exactly one TransformPlan
+    construction (the dogpile guard), every caller gets the same
+    object."""
+    reg = PlanRegistry()
+    t = _triplets()
+    n_threads = 8
+    results = [None] * n_threads
+    barrier = threading.Barrier(n_threads)
+
+    def worker(i):
+        barrier.wait()
+        results[i] = reg.get_or_build(TransformType.C2C, *DIMS, t,
+                                      precision="double")
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    sig0, plan0 = results[0]
+    assert all(sig == sig0 and plan is plan0 for sig, plan in results)
+    stats = reg.stats()
+    assert stats["builds"] == 1
+    assert stats["misses"] == 1
+    assert stats["hits"] == n_threads - 1
+
+
+def test_singleflight_builder_failure_releases_followers():
+    """A failing build doesn't wedge the per-shape lock: followers
+    retry and one of them becomes the builder."""
+    import spfft_tpu.serve.registry as regmod
+    reg = PlanRegistry()
+    t = _triplets()
+    real = regmod.build_index_plan
+    state = {"fail_next": True}
+
+    def flaky(*a, **k):
+        if state["fail_next"]:
+            state["fail_next"] = False
+            raise RuntimeError("injected build failure")
+        return real(*a, **k)
+
+    orig = regmod.build_index_plan
+    regmod.build_index_plan = flaky
+    try:
+        with pytest.raises(RuntimeError):
+            reg.get_or_build(TransformType.C2C, *DIMS, t,
+                             precision="double")
+        sig, plan = reg.get_or_build(TransformType.C2C, *DIMS, t,
+                                     precision="double")
+    finally:
+        regmod.build_index_plan = orig
+    assert plan is not None
+    assert reg.stats()["builds"] == 1

@@ -456,3 +456,20 @@ def test_distributed_delegate_on_device():
     assert _rel(got, oracle) < TOL
     out = np.asarray(plan.forward([space], Scaling.FULL)[0])
     assert _rel(out[:, 0] + 1j * out[:, 1], vals) < TOL
+
+
+def test_serve_smoke_on_tpu():
+    """The serving layer's deterministic pinning smoke ON THE CHIP: the
+    adaptive exact-shape path (pinned batched executables, staged host
+    buffers, zero pad rows) exercises real Mosaic/XLA:TPU executables
+    here — the CPU tier-1 smoke covers the same logic but not the
+    hardware dispatch. Also records a small on-chip serve trace so the
+    TPU-regime serving numbers the ROADMAP calls for land in the CI log
+    (window/max-batch retuning reads them from there)."""
+    from spfft_tpu.serve.bench import main as serve_bench_main
+
+    assert serve_bench_main(["--smoke"]) == 0
+    # one small measured trace (printed JSON line lands in the CI log)
+    assert serve_bench_main(["--dim", "24", "--requests", "64",
+                             "--signatures", "2", "--threads", "4",
+                             "--high-fraction", "0.25"]) == 0
