@@ -37,7 +37,9 @@ ci: native capi
 # On-TPU regression lane (tests_tpu/): oracle matrix, forced Pallas,
 # the segmented aliased-carry accumulate, split-x, pair-IO, two-stage
 # axes and repeated-backward — the silent-corruption bug classes the
-# CPU-pinned suite cannot see. Needs the real chip; record with
+# CPU-pinned suite cannot see — plus the serving smokes (pinning +
+# fault-injection: bucket isolation, device quarantine over the real
+# chip pool, crash-proof dispatch). Needs the real chip; record with
 #   make ci-tpu 2>&1 | tee docs/ci_tpu_r05.log
 ci-tpu:
 	@echo "== CI-TPU: on-device regression lane =="

@@ -134,6 +134,35 @@ class DeadlineExpiredError(ServeError):
     the work was never executed."""
 
 
+class RetryExhaustedError(ServeError):
+    """A request failed, was classified transient, and failed again on
+    its one bounded retry. ``cause`` (also chained as ``__cause__``)
+    carries the exception the final attempt raised — the serving layer
+    never swallows the underlying failure, it wraps it so callers can
+    tell "retried and still broken" from a first-shot permanent error."""
+
+    def __init__(self, message: str, cause: BaseException = None):
+        super().__init__(message)
+        self.cause = cause
+        if cause is not None:
+            self.__cause__ = cause
+
+
+class NoHealthyDeviceError(ServeError):
+    """Every device in the executor's pool is quarantined and none is
+    due for probation — there is nowhere to run the request. Mirrors the
+    reference's no-device condition (SPFFT_NO_DEVICE_ERROR) at the
+    serving layer."""
+
+    code = ErrorCode.DEVICE_NO_DEVICE
+
+
+class ExecutorCrashedError(ServeError):
+    """The dispatch loop crashed unexpectedly and its supervisor
+    exhausted the bounded restart budget; every queued and in-flight
+    future was failed with this error instead of hanging forever."""
+
+
 class FFTError(GenericError):
     """Failure inside the FFT backend (reference: exceptions.hpp:160-167,
     FFTWError; here: XLA Fft HLO)."""

@@ -30,14 +30,30 @@ from three cooperating pieces:
   counters, orchestration overhead, and registry counters, integrated
   with :mod:`spfft_tpu.timing`'s exports.
 
+* :mod:`~spfft_tpu.serve.faults` — ``FaultPlan``, the deterministic
+  fault-injection seam behind the executor's failure handling:
+  bucket-failure isolation (one poisoned request fails alone; healthy
+  co-batched requests stay bit-exact), bounded retries with
+  transient/permanent classification (``RetryExhaustedError``), device
+  quarantine with probation/readmission (``NoHealthyDeviceError`` on an
+  empty pool) and a crash-proof supervised dispatch loop
+  (``ExecutorCrashedError``; health states via
+  ``ServeMetrics.health()``). See docs/serving.md "Failure semantics".
+
 ``python -m spfft_tpu.serve.bench`` replays a mixed-signature request
 trace and reports p50/p95/p99 latency (per priority class with
 ``--high-fraction``) and throughput against a serial-loop baseline;
-``--smoke`` is the deterministic tier-1 pinning check.
+``--smoke`` is the deterministic tier-1 pinning check,
+``--fault-smoke`` the deterministic failure-semantics check, and
+``--fault-rate``/``--fault-script`` inject faults into a measured
+replay.
 """
 
-from ..errors import DeadlineExpiredError, QueueFullError, ServeError
+from ..errors import (DeadlineExpiredError, ExecutorCrashedError,
+                      NoHealthyDeviceError, QueueFullError,
+                      RetryExhaustedError, ServeError)
 from .executor import ServeExecutor
+from .faults import FaultPlan, InjectedFault, is_transient
 from .metrics import PRIORITY_CLASSES, ServeMetrics, percentile
 from .registry import (PlanRegistry, PlanSignature, index_digest,
                        signature_for)
@@ -45,5 +61,8 @@ from .registry import (PlanRegistry, PlanSignature, index_digest,
 __all__ = [
     "PlanRegistry", "PlanSignature", "index_digest", "signature_for",
     "ServeExecutor", "ServeMetrics", "percentile", "PRIORITY_CLASSES",
+    "FaultPlan", "InjectedFault", "is_transient",
     "ServeError", "QueueFullError", "DeadlineExpiredError",
+    "RetryExhaustedError", "NoHealthyDeviceError",
+    "ExecutorCrashedError",
 ]

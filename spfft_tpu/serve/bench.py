@@ -22,6 +22,20 @@ Two extra modes exercise the adaptive dispatch path:
 * ``--high-fraction F`` — marks a deterministic F of the trace
   high-priority; the summary and JSON then carry per-class p50/p99 so
   the priority lane's latency separation under flood is measurable.
+* ``--fault-rate R`` / ``--fault-script S`` — arm a deterministic
+  ``faults.FaultPlan`` for the MEASURED replay (the warm phase runs
+  clean), so graceful degradation under injected stage/dispatch/
+  materialise/device faults is a recorded number (retries, bucket
+  fallbacks, quarantine lifecycle, per-class p99 shift), not just an
+  assertion.
+* ``--fault-smoke`` — a fast, fully deterministic failure-semantics
+  check (tier-1 CI, and the ``make ci-tpu`` lane next to the pinning
+  smoke): a poisoned request in a fused bucket fails ALONE (co-batched
+  requests bit-exact), a transiently-failing bucket recovers everyone,
+  an always-failing device is quarantined while the pool keeps serving
+  (then re-admitted via probation), and a scripted dispatch-loop crash
+  resolves EVERY pending future with a typed error — zero hangs. Exit
+  code 1 on any violation.
 
 The workload reuses the benchmark CLI's dense-within-cutoff stick
 generator (``spfft_tpu.benchmark.cutoff_stick_triplets``, reference:
@@ -91,6 +105,23 @@ def _parse_args(argv):
                         "fixed-size waves drained synchronously; "
                         "asserts pinned-path activation, zero pad rows "
                         "once pinned, and bit-exact results")
+    p.add_argument("--fault-smoke", action="store_true",
+                   help="fast deterministic failure-semantics check "
+                        "(tier-1 CI + make ci-tpu): bucket isolation, "
+                        "retry, quarantine/probation, crash-proof "
+                        "dispatch — exit 1 on any violation")
+    p.add_argument("--fault-rate", type=float, default=0.0,
+                   help="per-check probability of an injected transient "
+                        "fault during the measured replay (seeded by "
+                        "--seed; default 0 = no injection)")
+    p.add_argument("--fault-script", default=None,
+                   help="comma-separated scripted faults for the "
+                        "measured replay, e.g. "
+                        "'dispatch@3,device1@*:permanent' "
+                        "(see spfft_tpu.serve.faults)")
+    p.add_argument("--fault-scope", default=None,
+                   help="restrict --fault-rate faults to one site "
+                        "(stage|dispatch|materialise) or 'device:N'")
     p.add_argument("-o", "--output", default=None, metavar="FILE.json")
     return p.parse_args(argv)
 
@@ -180,6 +211,215 @@ def _run_smoke(args) -> int:
     return 0 if ok else 1
 
 
+def _run_fault_smoke(args) -> int:
+    """Deterministic failure-semantics smoke: every acceptance behavior
+    of the fault-tolerance layer driven by scripted ``FaultPlan``s over
+    synchronously drained waves (phases 1-4) and a live supervised
+    dispatcher (phases 5-6) — no probabilistic faults, no timing races
+    beyond one quarantine-backoff sleep. Exit code 1 on any violation:
+
+    1. a fused bucket with one POISONED request fails only that request
+       (co-batched requests bit-exact vs the serial oracle);
+    2. a transiently-failing fused bucket recovers EVERY request via
+       per-request serial retry;
+    3. a device scripted to always fail is quarantined after
+       ``quarantine_after`` consecutive failures and the pool keeps
+       serving (every request still succeeds);
+    4. a quarantined device whose fault cleared is re-admitted through
+       a probation canary and the executor returns to healthy;
+    5. a scripted dispatch-loop crash past the restart budget resolves
+       every pending future with ``ExecutorCrashedError`` — zero hangs;
+    6. the same crash WITHIN the restart budget restarts the loop and
+       serves everything (degraded, not failed).
+    """
+    import jax
+
+    from ..benchmark import cutoff_stick_triplets
+    from ..errors import ExecutorCrashedError, ServeError
+    from ..types import TransformType
+    from .executor import ServeExecutor
+    from .faults import FaultPlan
+    from .registry import PlanRegistry
+
+    n = 12
+    triplets = cutoff_stick_triplets(n, n, n, 0.9, hermitian=False)
+    registry = PlanRegistry()
+    sig, plan = registry.get_or_build(
+        TransformType.C2C, n, n, n, triplets, precision=args.precision)
+    nv = plan.index_plan.num_values
+    rng = np.random.default_rng(args.seed)
+    failures = []
+    phases = {}
+
+    def vals():
+        if args.precision == "single":
+            return rng.standard_normal((nv, 2)).astype(np.float32)
+        return rng.standard_normal(nv) + 1j * rng.standard_normal(nv)
+
+    def check(cond, msg):
+        if not cond:
+            failures.append(msg)
+
+    # -- phase 1: poisoned request fails ALONE ------------------------
+    ex = ServeExecutor(registry, autostart=False, batch_window=0.0)
+    good = [vals() for _ in range(3)]
+    oracles = [np.asarray(plan.backward(v)) for v in good]
+    futs = [ex.submit(sig, v) for v in good[:2]]
+    poisoned = ex.submit(sig, np.zeros(3))  # wrong length: poisoned
+    futs.append(ex.submit(sig, good[2]))
+    ex._drain_once()
+    for f, expect in zip(futs, oracles):
+        check(np.array_equal(np.asarray(f.result(timeout=30)), expect),
+              "phase1: healthy co-batched request diverged from oracle")
+    try:
+        poisoned.result(timeout=30)
+        check(False, "phase1: poisoned request did not fail")
+    except Exception:
+        pass
+    check(ex.metrics.health()["bucket_fallbacks"] >= 1,
+          "phase1: fused bucket never fell back to serial recovery")
+    ex.close()
+    phases["1_poisoned_isolated"] = ex.metrics.health()
+
+    # -- phase 2: transient bucket fault recovers everyone ------------
+    ex = ServeExecutor(registry, autostart=False, batch_window=0.0,
+                       fault_plan=FaultPlan(script="dispatch@1"))
+    good = [vals() for _ in range(4)]
+    oracles = [np.asarray(plan.backward(v)) for v in good]
+    futs = [ex.submit(sig, v) for v in good]
+    ex._drain_once()
+    for f, expect in zip(futs, oracles):
+        check(np.array_equal(np.asarray(f.result(timeout=30)), expect),
+              "phase2: request not recovered bit-exact after transient "
+              "bucket fault")
+    h = ex.metrics.health()
+    check(h["retries"] == 4 and h["retries_exhausted"] == 0,
+          f"phase2: expected 4 clean retries, got {h}")
+    ex.close()
+    phases["2_transient_recovered"] = h
+
+    # -- phases 3-4: quarantine + probation (need a 2+ device pool) ---
+    pool = jax.devices()
+    if len(pool) >= 2:
+        ex = ServeExecutor(registry, autostart=False, devices=pool[:2],
+                           quarantine_after=2, quarantine_backoff=30.0,
+                           fault_plan=FaultPlan(script="device0@*"))
+        for i in range(8):
+            v = vals()
+            expect = np.asarray(plan.backward(v))
+            f = ex.submit(sig, v)
+            ex._drain_once()
+            check(np.array_equal(np.asarray(f.result(timeout=30)),
+                                 expect),
+                  f"phase3: request {i} failed under a sick device")
+        h = ex.health()
+        check(h["quarantines"] == 1,
+              f"phase3: sick device not quarantined exactly once: {h}")
+        check(h["devices"][0]["state"] == "quarantined",
+              "phase3: device 0 not in quarantined state")
+        check(h["state"] == "degraded",
+              f"phase3: health should be degraded, got {h['state']}")
+        ex.close()
+        phases["3_quarantine"] = h
+
+        ex = ServeExecutor(registry, autostart=False, devices=pool[:2],
+                           quarantine_after=1, quarantine_backoff=0.05,
+                           fault_plan=FaultPlan(script="device0@1"))
+        v = vals()
+        expect = np.asarray(plan.backward(v))
+        f = ex.submit(sig, v)
+        ex._drain_once()
+        check(np.array_equal(np.asarray(f.result(timeout=30)), expect),
+              "phase4: request not recovered around one-shot device "
+              "fault")
+        time.sleep(0.06)  # past the quarantine backoff: probation due
+        v = vals()
+        expect = np.asarray(plan.backward(v))
+        f = ex.submit(sig, v)
+        ex._drain_once()
+        check(np.array_equal(np.asarray(f.result(timeout=30)), expect),
+              "phase4: probation canary request failed")
+        h = ex.health()
+        check(h["probations"] == 1 and h["readmissions"] == 1,
+              f"phase4: probation/readmission not observed: {h}")
+        check(h["devices"][0]["state"] == "healthy"
+              and h["state"] == "healthy",
+              f"phase4: device not re-admitted to healthy: {h}")
+        ex.close()
+        phases["4_readmission"] = h
+    else:
+        phases["3_quarantine"] = phases["4_readmission"] = \
+            f"skipped: single-device process ({len(pool)} visible)"
+
+    # -- phase 5: loop crash past the budget fails every future -------
+    ex = ServeExecutor(registry, autostart=False,
+                       max_dispatch_restarts=0,
+                       fault_plan=FaultPlan(script="loop@1:permanent"))
+    futs = [ex.submit(sig, vals()) for _ in range(5)]
+    ex.start()
+    for i, f in enumerate(futs):
+        try:
+            f.result(timeout=30)
+            check(False, f"phase5: future {i} resolved with a result "
+                         f"after a dispatch-loop crash")
+        except ExecutorCrashedError:
+            pass
+        except Exception as exc:
+            check(False, f"phase5: future {i} failed with {type(exc)}, "
+                         f"not ExecutorCrashedError")
+    h = ex.metrics.health()
+    check(h["state"] == "failed" and h["dispatcher_crashes"] == 1,
+          f"phase5: supervisor state wrong after give-up: {h}")
+    try:
+        ex.submit(sig, vals())
+        check(False, "phase5: submit accepted work on a failed executor")
+    except ServeError:
+        pass
+    ex.close()
+    phases["5_crash_fails_futures"] = h
+
+    # -- phase 6: loop crash within the budget restarts and serves ----
+    ex = ServeExecutor(registry, autostart=False,
+                       max_dispatch_restarts=2,
+                       fault_plan=FaultPlan(script="loop@1"))
+    good = [vals() for _ in range(5)]
+    oracles = [np.asarray(plan.backward(v)) for v in good]
+    futs = [ex.submit(sig, v) for v in good]
+    ex.start()
+    for f, expect in zip(futs, oracles):
+        check(np.array_equal(np.asarray(f.result(timeout=30)), expect),
+              "phase6: request lost across a supervised restart")
+    h = ex.metrics.health()
+    check(h["dispatcher_restarts"] == 1 and h["state"] == "degraded",
+          f"phase6: restart not recorded as degraded: {h}")
+    ex.close()
+    phases["6_crash_restart_recovers"] = h
+
+    ok = not failures
+    print(f"fault smoke: dim={n}^3 precision={args.precision} "
+          f"devices={len(pool)}")
+    for name, h in phases.items():
+        print(f"  {name}: {h}")
+    for msg in failures:
+        print(f"FAIL: {msg}", file=sys.stderr)
+    result = {
+        "metric": f"serve.bench --fault-smoke {n}^3 (6 phases: "
+                  f"isolation/retry/quarantine/probation/crash/restart)",
+        "value": 1 if ok else 0,
+        "unit": "ok",
+        "fault_smoke": True,
+        "ok": ok,
+        "failures": failures,
+        "phases": {k: v for k, v in phases.items()},
+    }
+    print(json.dumps(result, default=str))
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(result, f, indent=2, default=str)
+        print(f"wrote {args.output}")
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     args = _parse_args(argv if argv is not None else sys.argv[1:])
     if args.requests < 1 or args.signatures < 1 or args.threads < 1:
@@ -190,6 +430,9 @@ def main(argv=None) -> int:
         print("error: --high-fraction must be in [0, 1]",
               file=sys.stderr)
         return 2
+    if not 0.0 <= args.fault_rate <= 1.0:
+        print("error: --fault-rate must be in [0, 1]", file=sys.stderr)
+        return 2
     if args.cpu or args.devices > 1:
         # a no-op once the backend is up (the test conftest's virtual
         # 8-device platform stays as-is); on a fresh CPU process it
@@ -199,6 +442,8 @@ def main(argv=None) -> int:
 
     if args.smoke:
         return _run_smoke(args)
+    if args.fault_smoke:
+        return _run_fault_smoke(args)
 
     import threading
 
@@ -312,6 +557,16 @@ def main(argv=None) -> int:
                   for _ in range(max_batch)]:
             f.result()
     metrics.reset()
+    # Fault injection arms AFTER the warm phase: the measured replay
+    # degrades, the baseline and warmup stay clean — that's the A/B the
+    # acceptance criterion wants (graceful degradation vs collapse).
+    fault_plan = None
+    if args.fault_rate > 0.0 or args.fault_script:
+        from .faults import FaultPlan
+        fault_plan = FaultPlan(rate=args.fault_rate, seed=args.seed,
+                               scope=args.fault_scope,
+                               script=args.fault_script)
+        executor.inject_faults(fault_plan)
     lock = threading.Lock()
     cursor = [0]
 
@@ -333,8 +588,12 @@ def main(argv=None) -> int:
         t.start()
     for t in threads:
         t.join()
+    failed_requests = 0
     for f in futures:
-        _block(f.result())
+        try:
+            _block(f.result(timeout=120))
+        except Exception:
+            failed_requests += 1
     served_s = time.perf_counter() - t0
     executor.close()
 
@@ -385,6 +644,23 @@ def main(argv=None) -> int:
     print(f"registry hit-rate: {reg['hit_rate'] * 100:.1f}% "
           f"(hits={reg['hits']} misses={reg['misses']} "
           f"evictions={reg['evictions']})")
+    health = snap["health"]
+    if fault_plan is not None:
+        fstats = fault_plan.stats()
+        print(f"faults: injected transient={fstats['fired_transient']} "
+              f"permanent={fstats['fired_permanent']} "
+              f"by_site={fstats['fired_by_site']}")
+        print(f"  recovery: retries={health['retries']} "
+              f"exhausted={health['retries_exhausted']} "
+              f"bucket_fallbacks={health['bucket_fallbacks']} "
+              f"failed_requests={failed_requests}")
+        print(f"  pool: quarantines={health['quarantines']} "
+              f"probations={health['probations']} "
+              f"readmissions={health['readmissions']} "
+              f"no_healthy_device={health['no_healthy_device']}")
+    print(f"health: {health['state']} "
+          f"(crashes={health['dispatcher_crashes']} "
+          f"restarts={health['dispatcher_restarts']})")
 
     result = {
         "metric": f"serve.bench {n}^3 x{len(sigs)} signatures, "
@@ -406,6 +682,11 @@ def main(argv=None) -> int:
             throughput / warm_loop_throughput, 3),
         "registry_hit_rate": round(reg["hit_rate"], 4),
         "high_fraction": args.high_fraction,
+        "fault_rate": args.fault_rate,
+        "fault_script": args.fault_script,
+        "failed_requests": failed_requests,
+        "faults": (fault_plan.stats() if fault_plan is not None
+                   else None),
         "serve_metrics": snap,
         "platform": platform_summary(),
     }

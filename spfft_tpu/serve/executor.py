@@ -27,16 +27,45 @@ The dispatch path is built for hardware-speed serving:
   fused bucket sizes; once the same size repeats ``pin_after``
   consecutive times, that EXACT shape is pinned (per-signature LRU,
   ``max_pinned_shapes`` entries) and buckets of that size dispatch with
-  ZERO pad rows. Shape churn never pins and falls back to the pow2
-  ladder (``multi.planned_batch_size``), keeping compile count bounded
-  by O(log max_batch) + max_pinned_shapes per signature.
+  ZERO pad rows. One bucket before the pin lands, the exact-shape
+  executable compiles on a background thread (prewarm-on-pin), so the
+  first pinned dispatch hits a warm jit cache. Shape churn never pins
+  and falls back to the pow2 ladder (``multi.planned_batch_size``).
 * **Reusable staging buffers + double-buffered pipelining** — fused
-  buckets stack into preallocated per-(shard, shape) host buffers
-  (checked out from a free-list, returned when the bucket resolves, so
-  a buffer is never rewritten while its transfer may still alias it),
-  and the in-flight window is one deeper than the device pool so the
-  host stacks bucket N+1 while the devices execute bucket N. Future
-  resolution and metric recording happen outside the queue lock.
+  buckets stack into preallocated per-(shard, shape) host buffers, and
+  the in-flight window is one deeper than the device pool so the host
+  stacks bucket N+1 while the devices execute bucket N.
+
+Failure is a first-class surface (the reference's 16-type exception
+hierarchy + cross-rank mismatch checks, exceptions.hpp /
+grid_internal.cpp:148-167, carried to the serving layer):
+
+* **Bucket-failure isolation** — a fused bucket that raises (dispatch
+  or materialisation) falls back to per-request serial re-execution, so
+  one poisoned request fails alone and its healthy co-batched neighbors
+  still return bit-exact results. Each request gets ONE bounded retry:
+  transient failures (``faults.is_transient``) that persist surface as
+  ``RetryExhaustedError`` carrying the cause; permanent failures
+  surface immediately as themselves.
+* **Device quarantine** — per-device consecutive-failure accounting on
+  the round-robin pool; a device crossing ``quarantine_after`` failures
+  is quarantined with exponential-backoff probation (one canary request
+  re-admits it on success, doubles the backoff on failure). An empty
+  pool fails requests with ``NoHealthyDeviceError`` instead of
+  dispatching into a known-sick device.
+* **Crash-proof dispatch** — the dispatcher thread runs under a
+  supervisor: an exception escaping the per-bucket handling fails that
+  bucket's futures, flushes in-flight work, and restarts the loop up to
+  ``max_dispatch_restarts`` times; past the budget every queued future
+  fails with ``ExecutorCrashedError``. A crash can degrade the service
+  but can never silently strand a caller on a forever-pending future.
+  Executor health (healthy/degraded/draining/failed) is exposed via
+  ``ServeMetrics.health()`` / :meth:`ServeExecutor.health`.
+* **Deterministic fault injection** — every path above is driven
+  through ``faults.FaultPlan`` checkpoints (stage / dispatch /
+  materialise / loop, per pool device), so the whole failure surface is
+  tier-1-testable on CPU and measurable via ``serve.bench
+  --fault-rate``.
 
 Correctness contract: any interleaving of concurrent requests produces
 results BIT-IDENTICAL to running each request alone on its plan. Three
@@ -47,20 +76,19 @@ vmapped form of the serial pipeline over identical static tables — vmap
 rows are independent, so pad rows (repeats of row 0) and the CHOICE of
 batch shape (pinned exact vs ladder) cannot perturb the live rows;
 (3) staged host buffers carry exactly the per-row coerced layout
-(``plan.batch_row_template``) at the plan's own dtype. Verified
-bit-exact against the serial path by the tier-1 concurrency fuzz
-(tests/test_serve_executor.py), which mixes priorities and pinned
-shapes. The batching policy (when fusion wins) is
-``multi.fusion_eligible`` — the SAME gate ``multi_transform_*`` uses,
-so the serving layer degrades to serial dispatch exactly where the
-library itself would.
+(``plan.batch_row_template``) at the plan's own dtype. The failure
+paths preserve the contract: recovery re-executions run the SAME serial
+pipeline the oracle does, so a retried request's result is bit-identical
+to its serial execution. Verified by the tier-1 concurrency fuzzes
+(tests/test_serve_executor.py, tests/test_serve_faults.py).
 
 Flow control is explicit and bounded: a fixed-capacity queue whose
-overflow REJECTS with ``QueueFullError`` (backpressure the caller can
-see, never silent unbounded buffering), per-request deadlines that
-expire queued work with ``DeadlineExpiredError`` before it wastes device
-time, and ``batching=False`` (or a fusion-ineligible regime) degrading
-gracefully to serial per-request dispatch.
+overflow REJECTS with ``QueueFullError`` (after reaping already-expired
+deadlined requests, so a queue full of dead work never rejects live
+work), per-request deadlines that expire queued work with
+``DeadlineExpiredError`` before it wastes device time, and
+``batching=False`` (or a fusion-ineligible regime) degrading gracefully
+to serial per-request dispatch.
 """
 
 from __future__ import annotations
@@ -76,11 +104,13 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..errors import (DeadlineExpiredError, InvalidParameterError,
-                      QueueFullError, ServeError)
+from ..errors import (DeadlineExpiredError, ExecutorCrashedError,
+                      InvalidParameterError, NoHealthyDeviceError,
+                      QueueFullError, RetryExhaustedError, ServeError)
 from ..multi import fusion_eligible, planned_batch_size
 from ..plan import TransformPlan
 from ..types import Scaling
+from .faults import FaultPlan, is_transient
 from .metrics import ServeMetrics
 from .registry import PlanRegistry, PlanSignature
 
@@ -111,6 +141,25 @@ DEFAULT_PIN_AFTER = 3
 #: extra executable per (kind, device), so the total compile bound stays
 #: O(log max_batch) ladder + this.
 DEFAULT_MAX_PINNED = 4
+
+#: Consecutive failures on one pool device before it is quarantined.
+#: 3 rides out a transient blip without condemning the device; 0
+#: disables quarantine entirely. Consecutive means successes reset the
+#: count — a sick device fails everything routed to it, a healthy
+#: device interleaves successes.
+DEFAULT_QUARANTINE_AFTER = 3
+
+#: Initial quarantine backoff (seconds). Each failed probation canary
+#: doubles it (capped), each successful canary re-admits the device and
+#: resets it.
+DEFAULT_QUARANTINE_BACKOFF = 0.25
+
+#: Ceiling on the exponential probation backoff.
+QUARANTINE_BACKOFF_CAP = 60.0
+
+#: Dispatch-loop restarts the supervisor attempts before declaring the
+#: executor failed and rejecting everything queued.
+DEFAULT_MAX_RESTARTS = 3
 
 _PRIORITIES = ("normal", "high")
 
@@ -167,6 +216,23 @@ class _Shard:
         return None
 
 
+class _DeviceSlot:
+    """Health accounting for one pool device: consecutive-failure count,
+    quarantine state and the exponential probation backoff. Mutated only
+    under the executor's pool lock."""
+
+    __slots__ = ("device", "index", "failures", "state", "until",
+                 "backoff")
+
+    def __init__(self, device, index, backoff):
+        self.device = device
+        self.index = index
+        self.failures = 0
+        self.state = "healthy"   # healthy | quarantined | probation
+        self.until = 0.0         # when a quarantined slot is probe-able
+        self.backoff = backoff
+
+
 class ServeExecutor:
     """One dispatcher thread over bounded per-signature request shards.
 
@@ -178,6 +244,12 @@ class ServeExecutor:
     ``autostart=False`` defers the dispatcher thread until
     :meth:`start` — used by tests (and pre-warm scripts) to stage a
     queue deterministically before any dispatch happens.
+
+    Failure knobs: ``quarantine_after`` / ``quarantine_backoff`` control
+    the device-pool quarantine, ``max_dispatch_restarts`` bounds the
+    crash supervisor, ``fault_plan`` arms deterministic fault injection
+    (see :mod:`~spfft_tpu.serve.faults`), ``prewarm_on_pin`` toggles the
+    background exact-shape compile one bucket before a pin lands.
     """
 
     def __init__(self, registry: PlanRegistry,
@@ -190,6 +262,11 @@ class ServeExecutor:
                  pin_after: int = DEFAULT_PIN_AFTER,
                  max_pinned_shapes: int = DEFAULT_MAX_PINNED,
                  pipeline_depth: Optional[int] = None,
+                 fault_plan: Optional[FaultPlan] = None,
+                 quarantine_after: int = DEFAULT_QUARANTINE_AFTER,
+                 quarantine_backoff: float = DEFAULT_QUARANTINE_BACKOFF,
+                 max_dispatch_restarts: int = DEFAULT_MAX_RESTARTS,
+                 prewarm_on_pin: bool = True,
                  autostart: bool = True):
         if max_batch < 1 or max_queue < 1:
             raise InvalidParameterError(
@@ -199,6 +276,11 @@ class ServeExecutor:
         if pin_after < 0 or max_pinned_shapes < 1:
             raise InvalidParameterError(
                 "pin_after must be >= 0 and max_pinned_shapes >= 1")
+        if quarantine_after < 0 or quarantine_backoff <= 0.0 \
+                or max_dispatch_restarts < 0:
+            raise InvalidParameterError(
+                "quarantine_after and max_dispatch_restarts must be "
+                ">= 0, quarantine_backoff > 0")
         self.registry = registry
         self.metrics = metrics if metrics is not None else ServeMetrics()
         # The device pool: ``None`` keeps every execution on the default
@@ -219,6 +301,14 @@ class ServeExecutor:
         self._pin_after = int(pin_after)
         self._max_pinned = int(max_pinned_shapes)
         self._pipeline_depth = pipeline_depth
+        self._faults = fault_plan
+        self._q_after = int(quarantine_after)
+        self._q_backoff = float(quarantine_backoff)
+        self._max_restarts = int(max_dispatch_restarts)
+        self._prewarm_on_pin = bool(prewarm_on_pin)
+        self._pool_lock = threading.Lock()
+        self._slots = [_DeviceSlot(d, i, self._q_backoff)
+                       for i, d in enumerate(self._devices)]
         self._shards: Dict[tuple, _Shard] = {}
         self._pending = 0
         self._high_pending = 0
@@ -234,6 +324,15 @@ class ServeExecutor:
         # staging buffer free-lists, keyed (shard key, batch shape);
         # dispatcher thread only
         self._staging: Dict[tuple, List[np.ndarray]] = {}
+        # prewarm-on-pin background compiles, keyed (shard key, shape)
+        self._prewarm_threads: Dict[tuple, threading.Thread] = {}
+        # supervisor state: buckets the dispatcher holds outside the
+        # shards (forming + in-flight) so a crash can fail their
+        # futures instead of stranding them in dead local variables
+        self._inflight: "collections.deque" = collections.deque()
+        self._forming: Optional[List[_Request]] = None
+        self._restarts = 0
+        self._failed = False
         self._cv = threading.Condition()
         self._closed = False
         self._thread: Optional[threading.Thread] = None
@@ -242,26 +341,29 @@ class ServeExecutor:
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
-        """Start the dispatcher thread (idempotent)."""
+        """Start the supervised dispatcher thread (idempotent)."""
         with self._cv:
             if self._closed:
                 raise ServeError("executor is closed")
             if self._thread is None:
                 self._thread = threading.Thread(
-                    target=self._dispatch_loop,
+                    target=self._run_dispatcher,
                     name="spfft-serve-dispatcher", daemon=True)
                 self._thread.start()
+        self._push_health()
 
     def close(self, drain: bool = True) -> None:
         """Stop accepting work and shut the dispatcher down. With
         ``drain`` (default) queued requests execute first; otherwise
-        they fail with ``ServeError``."""
+        they fail with ``ServeError``. Either way, EVERY still-pending
+        future is resolved before close returns — no caller is ever
+        left blocked on a future that cannot complete."""
         dropped: List[_Request] = []
         with self._cv:
             if self._closed:
                 return
             self._closed = True
-            if not drain:
+            if not drain or self._failed:
                 for shard in self._shards.values():
                     for lane in (shard.high, shard.normal):
                         dropped.extend(req for _, _, req in lane)
@@ -270,21 +372,92 @@ class ServeExecutor:
                 self._high_pending = 0
             self._cv.notify_all()
             thread = self._thread
-        for req in dropped:  # resolve futures outside the lock
-            req.future.set_exception(
-                ServeError("executor closed before dispatch"))
+        self._push_health()
+        self._fail_requests(dropped,
+                            ServeError("executor closed before dispatch"))
         if thread is None:
             # never started: drain synchronously so no future is left
             # forever-pending
             self._drain_once()
         else:
             thread.join()
+        # defensive final sweep — anything a crashed/raced dispatcher
+        # left behind resolves with a typed error rather than hanging
+        self._fail_all_pending(ServeError("executor closed"))
 
     def __enter__(self) -> "ServeExecutor":
         return self
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+    # -- fault/health plumbing ---------------------------------------------
+    def inject_faults(self, fault_plan: Optional[FaultPlan]) -> None:
+        """Arm (replace, or clear with None) the fault-injection plan.
+        The deterministic test/bench seam — production servers leave it
+        unset and every check is a no-op attribute read."""
+        self._faults = fault_plan
+
+    def _check_fault(self, site: str, device: Optional[int] = None):
+        plan = self._faults
+        if plan is not None:
+            plan.check(site, device)
+
+    def _push_health(self) -> None:
+        """Recompute the lifecycle state and push it into the metrics
+        sink: failed > draining > degraded (restarted dispatcher or any
+        non-healthy pool device) > healthy."""
+        with self._cv:
+            failed, closed = self._failed, self._closed
+            restarts = self._restarts
+        if failed:
+            state = "failed"
+        elif closed:
+            state = "draining"
+        else:
+            with self._pool_lock:
+                sick = any(s.state != "healthy" for s in self._slots)
+            state = "degraded" if (restarts or sick) else "healthy"
+        self.metrics.record_health(state)
+
+    def health(self) -> Dict:
+        """The :meth:`ServeMetrics.health` snapshot plus live per-device
+        pool state (index, health state, consecutive failures, current
+        probation backoff)."""
+        snap = self.metrics.health()
+        with self._pool_lock:
+            snap["devices"] = [
+                {"index": s.index, "state": s.state,
+                 "consecutive_failures": s.failures,
+                 "backoff_s": s.backoff} for s in self._slots]
+        return snap
+
+    def _fail_requests(self, reqs, exc: BaseException) -> None:
+        """Resolve ``reqs``' futures with ``exc`` (skipping any already
+        resolved) and record the failures. Never called under the queue
+        lock."""
+        done = time.monotonic()
+        for req in reqs:
+            if req.future.done():
+                continue
+            self.metrics.record_request_done(done - req.enqueued_at,
+                                             failed=True,
+                                             priority=req.priority)
+            req.future.set_exception(exc)
+
+    def _fail_all_pending(self, exc: BaseException) -> None:
+        """Pop EVERYTHING still queued and fail it with ``exc`` — the
+        supervisor's give-up path and close()'s final sweep."""
+        with self._cv:
+            dropped: List[_Request] = []
+            for shard in self._shards.values():
+                for lane in (shard.high, shard.normal):
+                    dropped.extend(req for _, _, req in lane)
+                    lane.clear()
+            self._pending = 0
+            self._high_pending = 0
+            self._cv.notify_all()
+        self._fail_requests(dropped, exc)
 
     # -- submission --------------------------------------------------------
     def submit(self, signature: PlanSignature, values,
@@ -302,9 +475,11 @@ class ServeExecutor:
         ``priority`` is ``"normal"`` or ``"high"`` — high-lane requests
         are served before any normal-lane work and preempt a forming
         normal bucket's batching window. Raises ``QueueFullError``
-        immediately when the bounded queue is at capacity and
-        ``InvalidParameterError`` for signatures the registry does not
-        hold."""
+        when the bounded queue is at capacity with LIVE requests
+        (already-expired deadlined requests are reaped first and fail
+        with ``DeadlineExpiredError``, so dead work never causes
+        backpressure) and ``InvalidParameterError`` for signatures the
+        registry does not hold."""
         if kind not in ("backward", "forward"):
             raise InvalidParameterError(
                 f"kind must be 'backward' or 'forward', got {kind!r}")
@@ -323,9 +498,16 @@ class ServeExecutor:
                        priority, next(self._seq))
         entry = (deadline if deadline is not None else math.inf,
                  req.seq, req)
+        purged: List[_Request] = []
         with self._cv:
             if self._closed:
                 raise ServeError("executor is closed")
+            if self._failed:
+                raise ServeError(
+                    "executor dispatch loop has failed (crashed past "
+                    "its restart budget)")
+            if self._pending >= self._max_queue:
+                purged = self._purge_expired_locked(time.monotonic())
             if self._pending >= self._max_queue:
                 full = True
             else:
@@ -340,7 +522,13 @@ class ServeExecutor:
                     self._high_pending += 1
                 depth = self._pending
                 self._cv.notify_all()
-        # metric recording outside the queue lock
+        # future resolution + metric recording outside the queue lock
+        for dead in purged:
+            self.metrics.record_deadline_expired(purged=True)
+            if not dead.future.done():
+                dead.future.set_exception(DeadlineExpiredError(
+                    "deadline expired in queue (reaped by the "
+                    "backpressure sweep before dispatch)"))
         if full:
             self.metrics.record_reject_queue_full()
             raise QueueFullError(
@@ -363,6 +551,28 @@ class ServeExecutor:
                            timeout=timeout, priority=priority)
 
     # -- scheduling (caller holds the lock) --------------------------------
+    def _purge_expired_locked(self, now: float) -> List[_Request]:
+        """Reap queued requests whose deadline has already passed
+        (caller holds the lock; futures resolve OUTSIDE it). Runs only
+        on the backpressure path, so ``QueueFullError`` is never raised
+        while the queue is stuffed with dead requests that dispatch
+        would discard anyway. O(queue), but the full-queue path is
+        already the slow path."""
+        reaped: List[_Request] = []
+        for shard in self._shards.values():
+            for lane in (shard.high, shard.normal):
+                expired = [e for e in lane if e[0] <= now]
+                if not expired:
+                    continue
+                reaped.extend(e[2] for e in expired)
+                lane[:] = [e for e in lane if e[0] > now]
+                heapq.heapify(lane)
+        if reaped:
+            self._pending -= len(reaped)
+            self._high_pending -= sum(1 for r in reaped
+                                      if r.priority == "high")
+        return reaped
+
     def _select_shard(self) -> Optional[_Shard]:
         """The shard whose head request is most urgent: high lane before
         normal, then earliest deadline, then arrival order. O(#active
@@ -441,12 +651,54 @@ class ServeExecutor:
         extra = 0 if jax.default_backend() == "cpu" else 1
         return len(self._devices) + extra
 
+    def _run_dispatcher(self) -> None:
+        """Crash-proof supervisor around :meth:`_dispatch_loop`. An
+        exception escaping the loop's per-bucket error handling fails
+        the crashing bucket's futures with ``ExecutorCrashedError``,
+        flushes in-flight buckets (resolving them normally when their
+        results are intact), and restarts the loop — up to
+        ``max_dispatch_restarts`` times. Past the budget it fails
+        everything queued and marks the executor failed: a dispatch
+        crash may degrade the service, it can NEVER silently strand a
+        caller on an unresolved future."""
+        while True:
+            try:
+                self._dispatch_loop()
+                return  # clean shutdown via close()
+            except Exception as exc:
+                self.metrics.record_dispatcher_crash()
+                crash = ExecutorCrashedError(
+                    f"dispatch loop crashed: {exc!r}")
+                forming, self._forming = self._forming, None
+                self._fail_requests(forming or [], crash)
+                while self._inflight:
+                    work = self._inflight.popleft()
+                    try:
+                        self._finish(*work)
+                    except Exception:
+                        self._fail_requests(work[0], crash)
+                with self._cv:
+                    self._restarts += 1
+                    give_up = self._restarts > self._max_restarts
+                    if give_up:
+                        self._failed = True
+                if not give_up:
+                    self.metrics.record_dispatcher_restart()
+                    self._push_health()
+                    continue
+                self._fail_all_pending(crash)
+                self._push_health()
+                return
+
     def _dispatch_loop(self) -> None:
         # Bounded in-flight pipelining (see _pipeline_slots): futures
-        # resolve in _finish, after materialisation.
-        inflight: "collections.deque" = collections.deque()
+        # resolve in _finish, after materialisation. In-flight work and
+        # the forming bucket live on the executor (not loop locals) so
+        # the supervisor can resolve their futures after a crash.
+        inflight = self._inflight
         depth = self._pipeline_slots()
         while True:
+            self._check_fault("loop")
             shard = bucket = None
             with self._cv:
                 if self._pending:
@@ -462,8 +714,12 @@ class ServeExecutor:
                     self._cv.wait()
                     continue
             if bucket is None:
-                self._finish(*inflight.popleft())
+                # peek-then-pop: a crash inside _finish leaves the
+                # bucket reachable for the supervisor's flush
+                self._finish(*inflight[0])
+                inflight.popleft()
                 continue
+            self._forming = bucket
             self.metrics.record_dequeue(depth_now)
             # Wait out the batching window only on a TRICKLE (nothing
             # else queued after the take): under backlog the queued
@@ -477,8 +733,10 @@ class ServeExecutor:
             work = self._execute(shard, bucket)
             if work is not None:
                 inflight.append(work)
+            self._forming = None
             while len(inflight) >= depth:
-                self._finish(*inflight.popleft())
+                self._finish(*inflight[0])
+                inflight.popleft()
 
     def _drain_once(self) -> None:
         """Synchronous drain (close() on a never-started executor, and
@@ -497,12 +755,76 @@ class ServeExecutor:
             if work is not None:
                 self._finish(*work)
 
-    # -- execution ---------------------------------------------------------
-    def _next_device(self):
-        d = self._devices[self._rotor % len(self._devices)]
-        self._rotor += 1
-        return d
+    # -- device pool health ------------------------------------------------
+    def _acquire_slot(self) -> _DeviceSlot:
+        """Next servable pool slot, round-robin, skipping quarantined
+        devices. A quarantined device whose backoff has elapsed is
+        flipped to probation and RETURNED — the caller's request is the
+        canary that decides readmission. Raises
+        ``NoHealthyDeviceError`` when every slot is quarantined and
+        none is due."""
+        probed = None
+        with self._pool_lock:
+            now = time.monotonic()
+            n = len(self._slots)
+            for _ in range(n):
+                slot = self._slots[self._rotor % n]
+                self._rotor += 1
+                if slot.state == "healthy":
+                    return slot
+                if slot.state == "quarantined" and now >= slot.until:
+                    slot.state = "probation"
+                    probed = slot
+                    break
+                # quarantined-and-not-due, or probation with a canary
+                # already outstanding: skip
+        if probed is not None:
+            self.metrics.record_probation()
+            return probed
+        raise NoHealthyDeviceError(
+            f"all {len(self._slots)} pool devices are quarantined and "
+            f"none is due for probation")
 
+    def _device_ok(self, slot: Optional[_DeviceSlot]) -> None:
+        """A request completed on ``slot``: reset its failure streak; a
+        probation canary's success re-admits the device."""
+        if slot is None:
+            return
+        readmitted = False
+        with self._pool_lock:
+            slot.failures = 0
+            if slot.state == "probation":
+                slot.state = "healthy"
+                slot.backoff = self._q_backoff
+                readmitted = True
+        if readmitted:
+            self.metrics.record_readmission()
+            self._push_health()
+
+    def _device_fail(self, slot: Optional[_DeviceSlot]) -> None:
+        """A request failed on ``slot``: bump its consecutive-failure
+        count; crossing ``quarantine_after`` (or failing its probation
+        canary) quarantines it with exponential backoff."""
+        if slot is None or self._q_after <= 0:
+            return
+        quarantined = False
+        with self._pool_lock:
+            slot.failures += 1
+            if slot.state == "probation":
+                slot.backoff = min(slot.backoff * 2.0,
+                                   QUARANTINE_BACKOFF_CAP)
+                quarantined = True
+            elif slot.failures >= self._q_after:
+                quarantined = True
+            if quarantined:
+                slot.state = "quarantined"
+                slot.until = time.monotonic() + slot.backoff
+                slot.failures = 0
+        if quarantined:
+            self.metrics.record_quarantine()
+            self._push_health()
+
+    # -- execution ---------------------------------------------------------
     def prewarm(self, signature: PlanSignature,
                 scaling: Scaling = Scaling.NONE,
                 batch_sizes=()) -> None:
@@ -546,15 +868,57 @@ class ServeExecutor:
         smallest power of two >= ``b``, capped at ``max_batch``."""
         return planned_batch_size(b, self._max_batch)
 
+    def _prewarm_pin_async(self, shard: _Shard, b: int) -> None:
+        """ROADMAP prewarm-on-pin: the observer's streak is ONE bucket
+        short of pinning exact shape ``b`` — compile that batched
+        executable on a background thread now, so the first pinned
+        dispatch hits a warm jit cache (jit caches are shared across
+        threads) instead of eating the compile blip inside a request.
+        Best-effort: a failed prewarm just means the compile happens at
+        dispatch, exactly as before."""
+        key = (shard.key, b)
+        if key in self._prewarm_threads \
+                or not fusion_eligible(shard.plan, b):
+            return
+        template = self._row_template(shard)
+        if template is None:
+            return  # device-staged plans: no host zero-batch to trace
+        plan, kind, scaling = shard.plan, shard.key[1], shard.key[2]
+        row_shape, dtype = template
+        devices = list(self._devices)
+        metrics = self.metrics
+
+        def compile_shape():
+            try:
+                import jax
+                zeros = np.zeros((b,) + row_shape, dtype)
+                for device in devices:
+                    if kind == "backward":
+                        out = plan.backward_batched(zeros, device=device)
+                    else:
+                        out = plan.forward_batched(zeros, scaling,
+                                                   device=device)
+                    jax.block_until_ready(out)
+                metrics.record_pin_prewarm()
+            except Exception:
+                pass
+
+        thread = threading.Thread(target=compile_shape, daemon=True,
+                                  name="spfft-serve-pin-prewarm")
+        self._prewarm_threads[key] = thread
+        thread.start()
+
     def _dispatch_shape(self, shard: _Shard, b: int) -> Tuple[int, bool]:
         """The batch shape a fused bucket of ``b`` live rows dispatches
         at, and whether that shape is exact (pinned or ladder-exact).
 
         The observer pins ``b`` once it repeats ``pin_after`` times
         consecutively; pinned shapes live in a per-signature LRU capped
-        at ``max_pinned_shapes``. Churny traffic (no streak) falls back
-        to the pow2 ladder, so the compiled-shape count stays bounded
-        either way. Dispatcher thread only — no lock."""
+        at ``max_pinned_shapes``. One repeat BEFORE the pin lands the
+        exact-shape compile starts on a background thread
+        (prewarm-on-pin). Churny traffic (no streak) falls back to the
+        pow2 ladder, so the compiled-shape count stays bounded either
+        way. Dispatcher thread only — no lock."""
         ladder = self._padded_size(b)
         if ladder == b:
             # ladder already exact: zero pad rows for free, no pin
@@ -572,6 +936,9 @@ class ServeExecutor:
         if pins is not None and b in pins:
             pins.move_to_end(b)
             return b, True
+        if self._prewarm_on_pin and self._pin_after >= 2 \
+                and shard.streak == self._pin_after - 1:
+            self._prewarm_pin_async(shard, b)
         if shard.streak >= self._pin_after:
             if pins is None:
                 pins = self._pins[shard.key[0]] = collections.OrderedDict()
@@ -629,12 +996,96 @@ class ServeExecutor:
         if buf is not None:
             self._staging.setdefault((shard_key, shape), []).append(buf)
 
+    def _run_one(self, req: _Request, pooled: bool):
+        """One SYNCHRONOUS serial execution of a single request —
+        dispatch plus materialisation — used by recovery and retry.
+        Updates the device health accounting; raises on failure
+        (``NoHealthyDeviceError`` propagates before any device is
+        charged)."""
+        import jax
+        slot = self._acquire_slot() if pooled else None
+        device = slot.device if slot is not None else None
+        try:
+            self._check_fault("dispatch",
+                              slot.index if slot is not None else None)
+            if req.kind == "backward":
+                res = req.plan.backward(req.values, device=device)
+            else:
+                res = req.plan.forward(req.values, req.scaling,
+                                       device=device)
+            jax.block_until_ready(res)
+        except Exception:
+            self._device_fail(slot)
+            raise
+        self._device_ok(slot)
+        return res
+
+    def _resolve_one(self, req: _Request, res) -> None:
+        if req.future.done():
+            return
+        done = time.monotonic()
+        self.metrics.record_request_done(done - req.enqueued_at,
+                                         priority=req.priority)
+        req.future.set_result(res)
+
+    def _recover_serial(self, live: List[_Request], cause: BaseException,
+                        pooled: bool) -> None:
+        """Bucket-failure isolation: the fused bucket raised ``cause``,
+        so re-execute every live request SERIALLY — only genuinely
+        poisoned requests fail; healthy co-batched requests still return
+        their (bit-exact) results. The serial re-execution is each
+        request's one bounded retry: a transient failure there becomes
+        ``RetryExhaustedError`` (carrying the cause), a permanent one
+        surfaces as itself."""
+        for req in live:
+            self.metrics.record_retry()
+            try:
+                res = self._run_one(req, pooled)
+            except NoHealthyDeviceError as exc:
+                self.metrics.record_no_healthy_device()
+                self._fail_requests([req], exc)
+                continue
+            except Exception as exc:
+                if is_transient(exc):
+                    self.metrics.record_retry_exhausted()
+                    self._fail_requests([req], RetryExhaustedError(
+                        f"request failed its fused-bucket fallback "
+                        f"retry (bucket error: {cause!r})", cause=exc))
+                else:
+                    self._fail_requests([req], exc)
+                continue
+            self._resolve_one(req, res)
+
+    def _retry_request(self, req: _Request, first_exc: BaseException,
+                       pooled: bool) -> None:
+        """A serial execution of ``req`` failed with ``first_exc``:
+        permanent failures surface immediately; transient ones get the
+        one bounded retry, failing with ``RetryExhaustedError`` when the
+        retry fails too."""
+        if not is_transient(first_exc):
+            self._fail_requests([req], first_exc)
+            return
+        self.metrics.record_retry()
+        try:
+            res = self._run_one(req, pooled)
+        except NoHealthyDeviceError as exc:
+            self.metrics.record_no_healthy_device()
+            self._fail_requests([req], exc)
+            return
+        except Exception as exc:
+            self.metrics.record_retry_exhausted()
+            self._fail_requests([req], RetryExhaustedError(
+                f"transient failure persisted through its retry "
+                f"(first error: {first_exc!r})", cause=exc))
+            return
+        self._resolve_one(req, res)
+
     def _execute(self, shard: _Shard, bucket: List[_Request]):
         """Deadline-check and DISPATCH one bucket. Returns ``(live,
-        results, shard_key, shape, buf)`` with results possibly still
-        executing (the dispatch loop pipelines them), or ``None`` when
-        nothing survived the deadline check or the dispatch itself
-        failed."""
+        results, shard_key, shape, buf, slots, fused)`` with results
+        possibly still executing (the dispatch loop pipelines them), or
+        ``None`` when nothing survived the deadline check or every
+        request resolved on a failure path."""
         now = time.monotonic()
         live: List[_Request] = []
         expired: List[_Request] = []
@@ -662,9 +1113,10 @@ class ServeExecutor:
             shape, exact = self._dispatch_shape(shard, b)
             fused = fusion_eligible(plan, shape)
         buf = None
+        slot: Optional[_DeviceSlot] = None
         t0 = time.perf_counter()
-        try:
-            if fused:
+        if fused:
+            try:
                 # Planned-batch execution (the cuFFT idiom): dispatch at
                 # the exact pinned shape when the observer has locked
                 # on, else pad up to the next pow2 ladder size so only
@@ -674,8 +1126,12 @@ class ServeExecutor:
                 # stay bit-identical to serial execution. The whole
                 # bucket lands on ONE pool device; successive buckets
                 # rotate.
+                self._check_fault("stage")
                 batch_arg, buf = self._stage(shard, live, shape)
-                device = self._next_device() if pooled else None
+                slot = self._acquire_slot() if pooled else None
+                device = slot.device if slot is not None else None
+                self._check_fault(
+                    "dispatch", slot.index if slot is not None else None)
                 t1 = time.perf_counter()
                 if kind == "backward":
                     stacked = plan.backward_batched(batch_arg,
@@ -684,60 +1140,101 @@ class ServeExecutor:
                     stacked = plan.forward_batched(batch_arg, scaling,
                                                    device=device)
                 results = [stacked[i] for i in range(b)]
-            else:
-                # serial path: dispatch every request before blocking on
-                # any result (the multi.py async-overlap idiom), fanned
-                # round-robin across the device pool
-                t1 = t0
-                shape, exact = b, False
-                results = []
-                for req in live:
-                    device = (self._next_device()
-                              if pooled else None)
-                    if kind == "backward":
-                        results.append(plan.backward(req.values,
-                                                     device=device))
-                    else:
-                        results.append(plan.forward(req.values, scaling,
-                                                    device=device))
-        except Exception as exc:
-            self._release(shard.key, shape, buf)
-            done = time.monotonic()
-            for req in live:
-                self.metrics.record_request_done(done - req.enqueued_at,
-                                                 failed=True,
-                                                 priority=req.priority)
-                req.future.set_exception(exc)
-            return None
+            except NoHealthyDeviceError as exc:
+                self._release(shard.key, shape, buf)
+                self.metrics.record_no_healthy_device()
+                self._fail_requests(live, exc)
+                return None
+            except Exception as exc:
+                # bucket-failure isolation: never fail the whole bucket
+                # for one poisoned request — fall back to per-request
+                # serial re-execution
+                self._release(shard.key, shape, buf)
+                self._device_fail(slot)
+                self.metrics.record_bucket_fallback()
+                self._recover_serial(live, exc, pooled)
+                return None
+            t2 = time.perf_counter()
+            self.metrics.record_batch(b, True, padded_rows=shape - b,
+                                      pinned=exact,
+                                      stage_s=t1 - t0, dispatch_s=t2 - t1)
+            return live, results, shard.key, shape, buf, [slot], True
+        # serial path: dispatch every request before blocking on any
+        # result (the multi.py async-overlap idiom), fanned round-robin
+        # across the device pool; failures are isolated per request
+        shape, exact = b, False
+        keep: List[_Request] = []
+        results = []
+        slots: List[Optional[_DeviceSlot]] = []
+        for req in live:
+            slot = None
+            try:
+                slot = self._acquire_slot() if pooled else None
+                device = slot.device if slot is not None else None
+                self._check_fault(
+                    "dispatch", slot.index if slot is not None else None)
+                if kind == "backward":
+                    res = plan.backward(req.values, device=device)
+                else:
+                    res = plan.forward(req.values, scaling, device=device)
+            except NoHealthyDeviceError as exc:
+                self.metrics.record_no_healthy_device()
+                self._fail_requests([req], exc)
+                continue
+            except Exception as exc:
+                self._device_fail(slot)
+                self._retry_request(req, exc, pooled)
+                continue
+            keep.append(req)
+            results.append(res)
+            slots.append(slot)
         t2 = time.perf_counter()
-        self.metrics.record_batch(b, fused,
-                                  padded_rows=shape - b if fused else 0,
-                                  pinned=fused and exact,
-                                  stage_s=t1 - t0, dispatch_s=t2 - t1)
-        return live, results, shard.key, shape, buf
+        self.metrics.record_batch(b, False, dispatch_s=t2 - t0)
+        if not keep:
+            return None
+        return keep, results, shard.key, shape, buf, slots, False
 
     def _finish(self, live, results, shard_key=None, shape=0,
-                buf=None) -> None:
+                buf=None, slots=None, fused=False) -> None:
         """Materialise a dispatched bucket and resolve its futures:
         latency samples measure completion (not dispatch), and async XLA
         failures surface here as exceptions instead of poisoned arrays.
-        The staging buffer returns to its free-list only now — after
-        materialisation — so reuse can never race the device transfer."""
+        A fused bucket that fails to materialise takes the same
+        per-request serial recovery as a failed dispatch; a serial
+        bucket isolates the failure by materialising per request. The
+        staging buffer returns to its free-list only now — after
+        materialisation — so reuse can never race the device
+        transfer."""
+        import jax
         try:
-            import jax
+            self._check_fault("materialise")
             jax.block_until_ready(results)
         except Exception as exc:
             self._release(shard_key, shape, buf)
-            done = time.monotonic()
-            for req in live:
-                self.metrics.record_request_done(done - req.enqueued_at,
-                                                 failed=True,
-                                                 priority=req.priority)
-                req.future.set_exception(exc)
+            pooled = bool(slots) and slots[0] is not None
+            if fused:
+                self._device_fail(slots[0] if slots else None)
+                self.metrics.record_bucket_fallback()
+                self._recover_serial(live, exc, pooled)
+                return
+            for i, req in enumerate(live):
+                slot = slots[i] if slots else None
+                try:
+                    jax.block_until_ready(results[i])
+                except Exception as exc_i:
+                    self._device_fail(slot)
+                    self._retry_request(req, exc_i, slot is not None)
+                    continue
+                self._device_ok(slot)
+                self._resolve_one(req, results[i])
             return
         self._release(shard_key, shape, buf)
+        for slot in (slots or ()):
+            self._device_ok(slot)
         done = time.monotonic()
         for req, res in zip(live, results):
+            if req.future.done():
+                continue
             self.metrics.record_request_done(done - req.enqueued_at,
                                              priority=req.priority)
             req.future.set_result(res)

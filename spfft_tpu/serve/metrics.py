@@ -95,8 +95,21 @@ class ServeMetrics:
             self._failed = 0
             self._rejected_queue_full = 0
             self._expired_deadline = 0
+            self._purged_expired = 0
             self._queue_depth = 0
             self._max_queue_depth = 0
+            # failure-handling counters (fault tolerance layer)
+            self._retries = 0
+            self._retries_exhausted = 0
+            self._bucket_fallbacks = 0
+            self._quarantines = 0
+            self._probations = 0
+            self._readmissions = 0
+            self._no_healthy_device = 0
+            self._dispatcher_crashes = 0
+            self._dispatcher_restarts = 0
+            self._pin_prewarms = 0
+            self._health_state = "healthy"
 
     # -- recording (executor-facing) ---------------------------------------
     def record_enqueue(self, depth: int) -> None:
@@ -113,9 +126,70 @@ class ServeMetrics:
         with self._lock:
             self._rejected_queue_full += 1
 
-    def record_deadline_expired(self) -> None:
+    def record_deadline_expired(self, purged: bool = False) -> None:
+        """One request whose deadline elapsed before dispatch;
+        ``purged=True`` when ``submit``'s backpressure sweep reclaimed
+        it from a full queue (counted in both tallies)."""
         with self._lock:
             self._expired_deadline += 1
+            if purged:
+                self._purged_expired += 1
+
+    # -- failure handling (executor-facing) --------------------------------
+    def record_retry(self) -> None:
+        """One recovery/retry execution of a single request."""
+        with self._lock:
+            self._retries += 1
+
+    def record_retry_exhausted(self) -> None:
+        """A request failed again on its one bounded retry."""
+        with self._lock:
+            self._retries_exhausted += 1
+
+    def record_bucket_fallback(self) -> None:
+        """A fused bucket raised and fell back to per-request serial
+        re-execution (bucket-failure isolation)."""
+        with self._lock:
+            self._bucket_fallbacks += 1
+
+    def record_quarantine(self) -> None:
+        with self._lock:
+            self._quarantines += 1
+
+    def record_probation(self) -> None:
+        """A quarantined device's backoff elapsed; a canary request is
+        being routed to it."""
+        with self._lock:
+            self._probations += 1
+
+    def record_readmission(self) -> None:
+        """A probation canary succeeded; the device rejoined the pool."""
+        with self._lock:
+            self._readmissions += 1
+
+    def record_no_healthy_device(self) -> None:
+        with self._lock:
+            self._no_healthy_device += 1
+
+    def record_dispatcher_crash(self) -> None:
+        with self._lock:
+            self._dispatcher_crashes += 1
+
+    def record_dispatcher_restart(self) -> None:
+        with self._lock:
+            self._dispatcher_restarts += 1
+
+    def record_pin_prewarm(self) -> None:
+        """A background exact-shape compile kicked off at streak
+        pin_after - 1 (prewarm-on-pin)."""
+        with self._lock:
+            self._pin_prewarms += 1
+
+    def record_health(self, state: str) -> None:
+        """The executor pushes its lifecycle state here on transitions:
+        ``healthy`` / ``degraded`` / ``draining`` / ``failed``."""
+        with self._lock:
+            self._health_state = state
 
     def record_batch(self, size: int, fused: bool,
                      padded_rows: int = 0, pinned: bool = False,
@@ -181,6 +255,31 @@ class ServeMetrics:
         with self._lock:
             return max(self._fused_hist, default=0)
 
+    def health(self) -> Dict:
+        """One JSON-ready snapshot of the executor's failure-handling
+        state: lifecycle state plus every fault-tolerance counter —
+        retries, bucket fallbacks, quarantine lifecycle, dispatcher
+        crash/restart tallies. This is the operator's first look when a
+        service degrades: a climbing ``retries`` with zero
+        ``retries_exhausted`` is riding out transients; climbing
+        ``quarantines`` names a sick device; ``state == "failed"`` means
+        the supervisor gave up and every pending future was failed."""
+        with self._lock:
+            return {
+                "state": self._health_state,
+                "retries": self._retries,
+                "retries_exhausted": self._retries_exhausted,
+                "bucket_fallbacks": self._bucket_fallbacks,
+                "quarantines": self._quarantines,
+                "probations": self._probations,
+                "readmissions": self._readmissions,
+                "no_healthy_device": self._no_healthy_device,
+                "dispatcher_crashes": self._dispatcher_crashes,
+                "dispatcher_restarts": self._dispatcher_restarts,
+                "pin_prewarms": self._pin_prewarms,
+                "purged_expired": self._purged_expired,
+            }
+
     def latency_percentiles(
             self, priority: Optional[str] = None) -> Dict[str, float]:
         """p50/p95/p99 over the bounded reservoir — one class when
@@ -238,6 +337,7 @@ class ServeMetrics:
                                     if self._completed else 0.0),
                 },
             }
+        snap["health"] = self.health()
         snap["latency_seconds"] = self.latency_percentiles()
         snap["latency_seconds_by_class"] = {
             cls: self.latency_percentiles(cls) for cls in PRIORITY_CLASSES}

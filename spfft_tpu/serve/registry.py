@@ -147,6 +147,19 @@ def _memo_key(transform_type: TransformType, dim_x: int, dim_y: int,
 SIG_MEMO_MAX_BYTES = 64 * 1024 ** 2
 
 
+class _BuildFlight:
+    """One in-flight singleflight build: waiters block on ``done`` and
+    read ``exc`` — a failed build releases every waiter at once with
+    the builder's exception (never a wedge of serial re-builds), a
+    successful one sends them back through the memo fast path."""
+
+    __slots__ = ("done", "exc")
+
+    def __init__(self):
+        self.done = threading.Event()
+        self.exc: BaseException = None
+
+
 class PlanRegistry:
     """Thread-safe byte-aware bounded LRU of ``TransformPlan``s with
     hit/miss/eviction counters and explicit warmup/prefetch.
@@ -184,7 +197,8 @@ class PlanRegistry:
             collections.OrderedDict()
         self._sig_memo_cap = max(64, 4 * self._max_plans)
         self._sig_memo_bytes = 0
-        self._build_locks: Dict[tuple, threading.Lock] = {}
+        self._build_flights: Dict[tuple, "_BuildFlight"] = {}
+        self._build_failures = 0
 
     # -- lookup ------------------------------------------------------------
     def get(self, signature: PlanSignature) -> Optional[TransformPlan]:
@@ -295,18 +309,26 @@ class PlanRegistry:
                 fast = self._fast_lookup_locked(memo_key, arr)
                 if fast is not None:
                     return fast
-                lock = self._build_locks.get(memo_key)
-                owner = lock is None
+                flight = self._build_flights.get(memo_key)
+                owner = flight is None
                 if owner:
-                    lock = self._build_locks[memo_key] = threading.Lock()
-                    lock.acquire()
+                    flight = self._build_flights[memo_key] = \
+                        _BuildFlight()
             if owner:
                 break
-            # follower: block until the builder finishes, then re-check
-            # the memo — if the builder failed, loop and become the
-            # builder
-            lock.acquire()
-            lock.release()
+            # Follower: wait for the in-flight build, sharing its
+            # OUTCOME either way. A failed build propagates the
+            # builder's exception to every waiter IMMEDIATELY — the old
+            # per-lock scheme promoted each waiter to builder in turn,
+            # so N waiters behind one broken shape serialised N
+            # expensive failing builds before the last caller saw the
+            # error (a wedge under a thundering herd). A success loops
+            # back to the fast path (counted as a hit); only a caller
+            # arriving AFTER the failed flight retires retries the
+            # build fresh.
+            flight.done.wait()
+            if flight.exc is not None:
+                raise flight.exc
         try:
             ip = build_index_plan(TransformType(transform_type), dim_x,
                                   dim_y, dim_z, arr)
@@ -322,10 +344,15 @@ class PlanRegistry:
                 self.put(sig, plan)
             self._memoize(memo_key, arr, sig)
             return sig, plan
+        except BaseException as exc:
+            flight.exc = exc
+            with self._lock:
+                self._build_failures += 1
+            raise
         finally:
             with self._lock:
-                self._build_locks.pop(memo_key, None)
-            lock.release()
+                self._build_flights.pop(memo_key, None)
+            flight.done.set()
 
     # -- warmup ------------------------------------------------------------
     def warmup(self, specs: Iterable[dict],
@@ -382,6 +409,7 @@ class PlanRegistry:
                 "fast_hits": self._fast_hits,
                 "evictions": self._evictions,
                 "builds": self._builds,
+                "build_failures": self._build_failures,
                 "sig_memo_entries": sum(len(c) for c in
                                         self._sig_memo.values()),
                 "sig_memo_bytes": self._sig_memo_bytes,

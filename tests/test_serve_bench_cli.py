@@ -73,6 +73,43 @@ def test_serve_bench_smoke_pins_and_drops_pad_rows(capsys):
     assert "pad rows per wave" in text
 
 
+def test_serve_bench_fault_smoke(capsys):
+    """The tier-1 failure-semantics smoke: scripted faults prove bucket
+    isolation, bounded retry, quarantine/probation/readmission and the
+    crash-proof dispatch supervisor — exit 1 on any violation."""
+    rc = main(["--fault-smoke"])
+    assert rc == 0
+    payload, text = _last_json(capsys)
+    assert payload["fault_smoke"] and payload["ok"]
+    assert payload["failures"] == []
+    assert "5_crash_fails_futures" in payload["phases"]
+    assert "fault smoke" in text
+
+
+def test_serve_bench_fault_rate_degrades_gracefully(capsys):
+    """A 5% injected transient fault rate: the replay completes,
+    recovery counters land in the JSON, and the service degrades
+    (retries/fallbacks) rather than collapses (the overwhelming
+    majority of requests still succeed)."""
+    rc = main(["--dim", "12", "--requests", "32", "--signatures", "1",
+               "--threads", "4", "--fault-rate", "0.05"])
+    assert rc == 0
+    payload, text = _last_json(capsys)
+    assert payload["fault_rate"] == 0.05
+    assert payload["faults"] is not None
+    snap = payload["serve_metrics"]
+    health = snap["health"]
+    assert snap["completed"] + payload["failed_requests"] == 32
+    assert payload["failed_requests"] <= health["retries_exhausted"] \
+        + health["no_healthy_device"]
+    assert snap["completed"] >= 24  # degradation, not collapse
+    assert "recovery:" in text and "health:" in text
+
+
+def test_serve_bench_bad_fault_args():
+    assert main(["--fault-rate", "1.5"]) == 2
+
+
 def test_serve_bench_priority_classes(capsys):
     """--high-fraction floods a deterministic subset through the high
     lane; per-class latency percentiles land in the payload."""

@@ -1,0 +1,543 @@
+"""Fault-tolerant serving: deterministic fault injection driving every
+failure path of the executor (spfft_tpu/serve/faults.py + executor.py).
+
+The load-bearing acceptance behaviors, each proven with scripted
+(deterministic, CPU-runnable) faults:
+
+* bucket-failure isolation — a fused bucket with one poisoned request
+  fails ONLY that request; healthy co-batched requests return results
+  bit-exact vs the serial oracle;
+* bounded retry — transient failures get exactly one retry
+  (``RetryExhaustedError`` carrying the cause when it fails too),
+  permanent failures surface immediately as themselves;
+* device quarantine — a device scripted to always fail is quarantined
+  after ``quarantine_after`` consecutive failures and the pool keeps
+  serving; probation canaries re-admit recovered devices; an empty pool
+  fails requests with ``NoHealthyDeviceError`` instead of hanging;
+* crash-proof dispatch — a scripted dispatch-loop crash resolves EVERY
+  pending future with a typed error (restart within budget serves
+  everything, past budget fails everything) — zero hangs;
+* the fault fuzz — 8 submitter threads x mixed signatures x mixed
+  priorities x poisoned payloads x scripted transient faults: healthy
+  requests stay bit-exact, exactly the poisoned requests fail, and no
+  future is ever left unresolved.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from spfft_tpu import TransformType
+from spfft_tpu.errors import (DeadlineExpiredError, ExecutorCrashedError,
+                              InvalidParameterError, NoHealthyDeviceError,
+                              QueueFullError, RetryExhaustedError,
+                              ServeError)
+from spfft_tpu.serve import (FaultPlan, InjectedFault, PlanRegistry,
+                             ServeExecutor, is_transient)
+
+from test_util import random_sparse_triplets
+
+DIMS = (12, 13, 11)
+
+
+def _registry_with(seeds):
+    reg = PlanRegistry()
+    sigs = []
+    for s in seeds:
+        rng = np.random.default_rng(s)
+        t = random_sparse_triplets(rng, DIMS)
+        sig, _ = reg.get_or_build(TransformType.C2C, *DIMS, t,
+                                  precision="double")
+        sigs.append(sig)
+    return reg, sigs
+
+
+def _values_for(reg, sig, rng):
+    n = reg.get(sig).index_plan.num_values
+    return (rng.uniform(-1, 1, n) + 1j * rng.uniform(-1, 1, n))
+
+
+# -- FaultPlan unit behavior ------------------------------------------------
+def test_fault_plan_scripted_fires_on_nth_call():
+    fp = FaultPlan(script="dispatch@2,materialise@1:permanent")
+    fp.check("dispatch")  # call 1: clean
+    with pytest.raises(InjectedFault) as exc:
+        fp.check("dispatch")  # call 2: scripted
+    assert exc.value.transient
+    with pytest.raises(InjectedFault) as exc:
+        fp.check("materialise")
+    assert not exc.value.transient
+    fp.check("dispatch")  # call 3: clean again (one-shot entry)
+    stats = fp.stats()
+    assert stats["fired_transient"] == 1
+    assert stats["fired_permanent"] == 1
+    assert stats["checks"]["dispatch"] == 3
+
+
+def test_fault_plan_device_scoped_and_always():
+    fp = FaultPlan(script="device1@*")
+    fp.check("dispatch", device=0)  # other device: clean
+    with pytest.raises(InjectedFault):
+        fp.check("dispatch", device=1)
+    with pytest.raises(InjectedFault):
+        fp.check("dispatch", device=1)  # @* fires every time
+    assert fp.stats()["fired_transient"] == 2
+
+
+def test_fault_plan_rate_deterministic_by_seed():
+    def fires(seed):
+        fp = FaultPlan(rate=0.3, seed=seed)
+        out = []
+        for _ in range(64):
+            try:
+                fp.check("dispatch")
+                out.append(False)
+            except InjectedFault:
+                out.append(True)
+        return out
+
+    a, b = fires(7), fires(7)
+    assert a == b and any(a) and not all(a)
+    assert fires(8) != a  # different seed, different sequence
+
+
+def test_fault_plan_rejects_bad_specs():
+    with pytest.raises(InvalidParameterError):
+        FaultPlan(script="bogus@1")
+    with pytest.raises(InvalidParameterError):
+        FaultPlan(script="dispatch@0")
+    with pytest.raises(InvalidParameterError):
+        FaultPlan(script="dispatch@1:sometimes")
+    with pytest.raises(InvalidParameterError):
+        FaultPlan(rate=1.5)
+    with pytest.raises(InvalidParameterError):
+        FaultPlan(rate=0.1, scope="gpu")
+
+
+def test_is_transient_classification():
+    assert is_transient(InjectedFault("x", transient=True))
+    assert not is_transient(InjectedFault("x", transient=False))
+    assert is_transient(TimeoutError("slow"))
+    assert is_transient(RuntimeError("RESOURCE_EXHAUSTED: oom"))
+    assert is_transient(RuntimeError("UNAVAILABLE: device lost"))
+    assert not is_transient(ValueError("bad shape"))
+    assert not is_transient(RuntimeError("INVALID_ARGUMENT: rank"))
+
+
+# -- bucket-failure isolation -----------------------------------------------
+def test_poisoned_request_fails_alone_in_fused_bucket():
+    """The acceptance behavior: one poisoned request in a fused bucket
+    fails ONLY that request; co-batched healthy requests come back
+    bit-exact vs the serial oracle."""
+    reg, (sig,) = _registry_with([1])
+    rng = np.random.default_rng(0)
+    plan = reg.get(sig)
+    good = [_values_for(reg, sig, rng) for _ in range(4)]
+    oracles = [np.asarray(plan.backward(v)) for v in good]
+    ex = ServeExecutor(reg, autostart=False, batch_window=0.0)
+    futs = [ex.submit(sig, v) for v in good[:2]]
+    poisoned = ex.submit(sig, np.zeros(3))  # wrong length
+    futs += [ex.submit(sig, v) for v in good[2:]]
+    ex._drain_once()
+    for f, expect in zip(futs, oracles):
+        assert np.array_equal(np.asarray(f.result(timeout=30)), expect)
+    with pytest.raises(Exception) as exc:
+        poisoned.result(timeout=30)
+    assert not isinstance(exc.value, RetryExhaustedError)  # permanent
+    h = ex.metrics.health()
+    assert h["bucket_fallbacks"] == 1
+    snap = ex.metrics.snapshot()
+    assert snap["completed"] == 4 and snap["failed"] == 1
+    ex.close()
+
+
+def test_transient_fused_fault_recovers_every_request():
+    reg, (sig,) = _registry_with([1])
+    rng = np.random.default_rng(1)
+    plan = reg.get(sig)
+    vals = [_values_for(reg, sig, rng) for _ in range(4)]
+    oracles = [np.asarray(plan.backward(v)) for v in vals]
+    ex = ServeExecutor(reg, autostart=False, batch_window=0.0,
+                       fault_plan=FaultPlan(script="dispatch@1"))
+    futs = [ex.submit(sig, v) for v in vals]
+    ex._drain_once()
+    for f, expect in zip(futs, oracles):
+        assert np.array_equal(np.asarray(f.result(timeout=30)), expect)
+    h = ex.metrics.health()
+    assert h["bucket_fallbacks"] == 1
+    assert h["retries"] == 4 and h["retries_exhausted"] == 0
+    ex.close()
+
+
+def test_materialise_fault_recovers_fused_bucket():
+    reg, (sig,) = _registry_with([1])
+    rng = np.random.default_rng(2)
+    plan = reg.get(sig)
+    vals = [_values_for(reg, sig, rng) for _ in range(4)]
+    oracles = [np.asarray(plan.backward(v)) for v in vals]
+    ex = ServeExecutor(reg, autostart=False, batch_window=0.0,
+                       fault_plan=FaultPlan(script="materialise@1"))
+    futs = [ex.submit(sig, v) for v in vals]
+    ex._drain_once()
+    for f, expect in zip(futs, oracles):
+        assert np.array_equal(np.asarray(f.result(timeout=30)), expect)
+    assert ex.metrics.health()["bucket_fallbacks"] == 1
+    ex.close()
+
+
+def test_permanent_fault_in_recovery_fails_with_original_error():
+    """Recovery executions classify too: a PERMANENT fault during one
+    request's serial re-execution fails that request with the error
+    itself (not RetryExhaustedError), the rest still succeed."""
+    reg, (sig,) = _registry_with([1])
+    rng = np.random.default_rng(3)
+    plan = reg.get(sig)
+    vals = [_values_for(reg, sig, rng) for _ in range(4)]
+    oracles = [np.asarray(plan.backward(v)) for v in vals]
+    # dispatch #1 = the fused bucket; #2..#5 = the four recovery
+    # re-executions, of which #3 (second request) fails permanently
+    ex = ServeExecutor(
+        reg, autostart=False, batch_window=0.0,
+        fault_plan=FaultPlan(
+            script="dispatch@1:permanent,dispatch@3:permanent"))
+    futs = [ex.submit(sig, v) for v in vals]
+    ex._drain_once()
+    for i, (f, expect) in enumerate(zip(futs, oracles)):
+        if i == 1:
+            with pytest.raises(InjectedFault) as exc:
+                f.result(timeout=30)
+            assert not exc.value.transient
+        else:
+            assert np.array_equal(np.asarray(f.result(timeout=30)),
+                                  expect)
+    ex.close()
+
+
+def test_retry_exhausted_carries_cause():
+    """Serial path, transient fault on the attempt AND on its one
+    bounded retry: the future fails with RetryExhaustedError whose
+    cause is the final underlying exception."""
+    reg, (sig,) = _registry_with([1])
+    rng = np.random.default_rng(4)
+    ex = ServeExecutor(reg, autostart=False, batching=False,
+                       fault_plan=FaultPlan(
+                           script="dispatch@1,dispatch@2"))
+    fut = ex.submit(sig, _values_for(reg, sig, rng))
+    ex._drain_once()
+    with pytest.raises(RetryExhaustedError) as exc:
+        fut.result(timeout=30)
+    assert isinstance(exc.value.cause, InjectedFault)
+    assert exc.value.__cause__ is exc.value.cause
+    h = ex.metrics.health()
+    assert h["retries"] == 1 and h["retries_exhausted"] == 1
+    ex.close()
+
+
+# -- device quarantine ------------------------------------------------------
+def test_sick_device_quarantined_pool_keeps_serving():
+    """A device scripted to always fail is quarantined after
+    quarantine_after consecutive failures; every request still succeeds
+    on the remaining pool (the acceptance behavior)."""
+    pool = jax.devices()[:2]
+    reg, (sig,) = _registry_with([1])
+    rng = np.random.default_rng(5)
+    plan = reg.get(sig)
+    ex = ServeExecutor(reg, autostart=False, devices=pool,
+                       quarantine_after=2, quarantine_backoff=30.0,
+                       fault_plan=FaultPlan(script="device0@*"))
+    for i in range(8):
+        v = _values_for(reg, sig, rng)
+        expect = np.asarray(plan.backward(v))
+        f = ex.submit(sig, v)
+        ex._drain_once()
+        assert np.array_equal(np.asarray(f.result(timeout=30)), expect)
+    h = ex.health()
+    assert h["quarantines"] == 1
+    assert h["devices"][0]["state"] == "quarantined"
+    assert h["devices"][1]["state"] == "healthy"
+    assert h["state"] == "degraded"
+    ex.close()
+
+
+def test_probation_canary_readmits_recovered_device():
+    pool = jax.devices()[:2]
+    reg, (sig,) = _registry_with([1])
+    rng = np.random.default_rng(6)
+    plan = reg.get(sig)
+    ex = ServeExecutor(reg, autostart=False, devices=pool,
+                       quarantine_after=1, quarantine_backoff=0.05,
+                       fault_plan=FaultPlan(script="device0@1"))
+    v = _values_for(reg, sig, rng)
+    f = ex.submit(sig, v)
+    ex._drain_once()
+    assert np.array_equal(np.asarray(f.result(timeout=30)),
+                          np.asarray(plan.backward(v)))
+    assert ex.health()["devices"][0]["state"] == "quarantined"
+    time.sleep(0.08)  # backoff elapses: next acquire probes device 0
+    v = _values_for(reg, sig, rng)
+    f = ex.submit(sig, v)
+    ex._drain_once()
+    assert np.array_equal(np.asarray(f.result(timeout=30)),
+                          np.asarray(plan.backward(v)))
+    h = ex.health()
+    assert h["probations"] == 1 and h["readmissions"] == 1
+    assert h["devices"][0]["state"] == "healthy"
+    assert h["state"] == "healthy"
+    ex.close()
+
+
+def test_empty_pool_raises_no_healthy_device():
+    """With every pool device quarantined and none due for probation,
+    requests fail with NoHealthyDeviceError instead of dispatching into
+    a known-sick device (or hanging)."""
+    pool = jax.devices()[:1]
+    reg, (sig,) = _registry_with([1])
+    rng = np.random.default_rng(7)
+    ex = ServeExecutor(reg, autostart=False, devices=pool,
+                       quarantine_after=1, quarantine_backoff=30.0,
+                       fault_plan=FaultPlan(script="device0@*"))
+    # first request: fails on device 0 (quarantining it), then its
+    # bounded retry finds no healthy device
+    f1 = ex.submit(sig, _values_for(reg, sig, rng))
+    ex._drain_once()
+    with pytest.raises(NoHealthyDeviceError):
+        f1.result(timeout=30)
+    # later requests fail fast the same way
+    f2 = ex.submit(sig, _values_for(reg, sig, rng))
+    ex._drain_once()
+    with pytest.raises(NoHealthyDeviceError):
+        f2.result(timeout=30)
+    h = ex.health()
+    assert h["no_healthy_device"] >= 2
+    assert h["state"] == "degraded"
+    ex.close()
+
+
+# -- crash-proof dispatch ---------------------------------------------------
+def test_loop_crash_past_budget_fails_every_future_typed():
+    """The acceptance behavior: a scripted dispatch-loop crash resolves
+    every pending future with a typed error within the drain timeout —
+    zero hangs — and the executor rejects new work."""
+    reg, (sig,) = _registry_with([1])
+    rng = np.random.default_rng(8)
+    ex = ServeExecutor(reg, autostart=False, max_dispatch_restarts=0,
+                       fault_plan=FaultPlan(script="loop@1:permanent"))
+    futs = [ex.submit(sig, _values_for(reg, sig, rng))
+            for _ in range(6)]
+    ex.start()
+    for f in futs:
+        with pytest.raises(ExecutorCrashedError):
+            f.result(timeout=30)
+    h = ex.metrics.health()
+    assert h["state"] == "failed"
+    assert h["dispatcher_crashes"] == 1
+    assert h["dispatcher_restarts"] == 0
+    with pytest.raises(ServeError):
+        ex.submit(sig, _values_for(reg, sig, rng))
+    ex.close()  # returns promptly; nothing left pending
+    assert all(f.done() for f in futs)
+
+
+def test_loop_crash_within_budget_restarts_and_serves():
+    reg, (sig,) = _registry_with([1])
+    rng = np.random.default_rng(9)
+    plan = reg.get(sig)
+    vals = [_values_for(reg, sig, rng) for _ in range(6)]
+    oracles = [np.asarray(plan.backward(v)) for v in vals]
+    ex = ServeExecutor(reg, autostart=False, max_dispatch_restarts=2,
+                       fault_plan=FaultPlan(script="loop@1"))
+    futs = [ex.submit(sig, v) for v in vals]
+    ex.start()
+    for f, expect in zip(futs, oracles):
+        assert np.array_equal(np.asarray(f.result(timeout=30)), expect)
+    h = ex.metrics.health()
+    assert h["dispatcher_crashes"] == 1
+    assert h["dispatcher_restarts"] == 1
+    assert h["state"] == "degraded"
+    ex.close()
+
+
+# -- satellite regressions --------------------------------------------------
+def test_queue_full_purges_already_expired_requests():
+    """submit's backpressure check reaps already-expired deadlined
+    requests instead of rejecting live work behind a queue full of dead
+    requests (the round-7 expiry check only ran at dispatch)."""
+    reg, (sig,) = _registry_with([1])
+    rng = np.random.default_rng(10)
+    ex = ServeExecutor(reg, max_queue=4, autostart=False)
+    dead = [ex.submit(sig, _values_for(reg, sig, rng), timeout=0.005)
+            for _ in range(4)]
+    time.sleep(0.05)  # every queued request's deadline has now passed
+    live = ex.submit(sig, _values_for(reg, sig, rng))  # no QueueFullError
+    for f in dead:
+        with pytest.raises(DeadlineExpiredError):
+            f.result(timeout=5)
+    snap = ex.metrics.snapshot()
+    assert snap["expired_deadline"] == 4
+    assert snap["health"]["purged_expired"] == 4
+    assert snap["rejected_queue_full"] == 0
+    ex.start()
+    live.result(timeout=30)
+    ex.close()
+
+
+def test_queue_full_still_rejects_live_requests():
+    reg, (sig,) = _registry_with([1])
+    rng = np.random.default_rng(11)
+    ex = ServeExecutor(reg, max_queue=4, autostart=False)
+    futs = [ex.submit(sig, _values_for(reg, sig, rng), timeout=60)
+            for _ in range(4)]
+    with pytest.raises(QueueFullError):
+        ex.submit(sig, _values_for(reg, sig, rng))
+    ex.start()
+    for f in futs:
+        f.result(timeout=30)
+    ex.close()
+
+
+def test_close_no_drain_resolves_every_pending_future():
+    """close(drain=False) resolves EVERY still-pending future with a
+    typed ServeError — callers are never left blocked on futures that
+    cannot complete."""
+    reg, (sig,) = _registry_with([1])
+    rng = np.random.default_rng(12)
+    ex = ServeExecutor(reg, autostart=False)
+    futs = [ex.submit(sig, _values_for(reg, sig, rng),
+                      priority=("high" if i % 3 == 0 else "normal"),
+                      timeout=(30 if i % 2 == 0 else None))
+            for i in range(7)]
+    ex.close(drain=False)
+    assert all(f.done() for f in futs)
+    for f in futs:
+        with pytest.raises(ServeError):
+            f.result(timeout=0)
+
+
+def test_prewarm_on_pin_compiles_in_background():
+    """ROADMAP prewarm-on-pin: when a shard's streak hits pin_after - 1
+    the exact-shape batched compile starts on a background thread, so
+    the first PINNED dispatch finds a warm jit cache. Results stay
+    bit-exact throughout (checked per wave)."""
+    reg, (sig,) = _registry_with([1])
+    rng = np.random.default_rng(13)
+    plan = reg.get(sig)
+    ex = ServeExecutor(reg, autostart=False, batch_window=0.0,
+                       pin_after=3)
+
+    def wave(size):
+        vals = [_values_for(reg, sig, rng) for _ in range(size)]
+        oracles = [np.asarray(plan.backward(v)) for v in vals]
+        futs = [ex.submit(sig, v) for v in vals]
+        ex._drain_once()
+        for f, expect in zip(futs, oracles):
+            assert np.array_equal(np.asarray(f.result(timeout=30)),
+                                  expect)
+
+    wave(5)
+    assert not ex._prewarm_threads  # streak 1: too early
+    wave(5)  # streak 2 == pin_after - 1: prewarm kicks off
+    assert len(ex._prewarm_threads) == 1
+    for th in ex._prewarm_threads.values():
+        th.join(timeout=60)
+    assert ex.metrics.health()["pin_prewarms"] == 1
+    wave(5)  # streak 3: pinned, zero pad rows
+    assert ex.metrics.pinned_batches == 1
+    assert ex.pinned_shapes(sig) == (5,)
+    ex.close()
+
+
+def test_prewarm_on_pin_disabled():
+    reg, (sig,) = _registry_with([1])
+    rng = np.random.default_rng(14)
+    ex = ServeExecutor(reg, autostart=False, batch_window=0.0,
+                       pin_after=3, prewarm_on_pin=False)
+    for _ in range(3):
+        futs = [ex.submit(sig, _values_for(reg, sig, rng))
+                for _ in range(5)]
+        ex._drain_once()
+        for f in futs:
+            f.result(timeout=30)
+    assert not ex._prewarm_threads
+    assert ex.metrics.health()["pin_prewarms"] == 0
+    assert ex.metrics.pinned_batches == 1  # pinning itself unaffected
+    ex.close()
+
+
+# -- the fault fuzz ---------------------------------------------------------
+def test_fault_fuzz_poisoned_and_transient_under_concurrency():
+    """8 submitter threads x 96 mixed-signature, mixed-priority requests
+    with (a) POISONED payloads scattered through the trace and
+    (b) scripted transient stage/materialise faults hitting whole fused
+    buckets. Asserts the acceptance trio: healthy requests bit-exact vs
+    the serial oracle, exactly the poisoned requests fail, and no
+    future is ever left unresolved.
+
+    The script deliberately avoids ``dispatch`` entries: recovery
+    re-executions consume dispatch checks, so a dispatch entry could
+    land on a healthy request's one retry and legitimately exhaust it —
+    stage/materialise checks only ever hit whole buckets, whose
+    recovery then runs clean."""
+    reg, sigs = _registry_with([1, 2, 3])
+    rng = np.random.default_rng(42)
+    requests = []  # (sig, priority, payload, oracle-or-None)
+    for i in range(96):
+        sig = sigs[int(rng.integers(len(sigs)))]
+        plan = reg.get(sig)
+        prio = "high" if rng.random() < 0.3 else "normal"
+        if i % 12 == 5:  # 8 poisoned requests, deterministic positions
+            requests.append((sig, prio, np.zeros(3), None))
+        else:
+            v = _values_for(reg, sig, rng)
+            requests.append((sig, prio, v, np.asarray(plan.backward(v))))
+
+    ex = ServeExecutor(
+        reg, autostart=False, batch_window=0.001, pin_after=1,
+        fault_plan=FaultPlan(
+            script="stage@2,materialise@3,stage@5,materialise@7"))
+    futures = [None] * len(requests)
+    errors = []
+    for i in range(32):  # staged: guarantees fused buckets form
+        sig, prio, payload, _ = requests[i]
+        futures[i] = ex.submit(sig, payload, priority=prio)
+
+    def submitter(indices):
+        for i in indices:
+            sig, prio, payload, _ = requests[i]
+            try:
+                futures[i] = ex.submit(sig, payload, priority=prio)
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+
+    threads = [threading.Thread(target=submitter,
+                                args=(range(32 + k, 96, 8),))
+               for k in range(8)]
+    ex.start()
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errors
+    n_poisoned = 0
+    for i, (sig, prio, payload, oracle) in enumerate(requests):
+        if oracle is None:
+            n_poisoned += 1
+            with pytest.raises(Exception) as exc:
+                futures[i].result(timeout=60)
+            assert not isinstance(exc.value, RetryExhaustedError), \
+                f"poisoned request {i} failed as transient-exhausted, " \
+                f"not with its own (permanent) error"
+        else:
+            got = np.asarray(futures[i].result(timeout=60))
+            assert np.array_equal(got, oracle), \
+                f"healthy request {i} ({prio}) diverged from its oracle"
+    assert all(f.done() for f in futures)  # (c): zero unresolved
+    ex.close()
+    snap = ex.metrics.snapshot()
+    assert snap["completed"] == 96 - n_poisoned
+    assert snap["failed"] == n_poisoned
+    assert snap["health"]["state"] in ("healthy", "degraded", "draining")
+    assert snap["health"]["dispatcher_crashes"] == 0

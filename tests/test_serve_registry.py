@@ -7,6 +7,7 @@ lookup/build is counted.
 """
 
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -257,3 +258,69 @@ def test_singleflight_builder_failure_releases_followers():
         regmod.build_index_plan = orig
     assert plan is not None
     assert reg.stats()["builds"] == 1
+    assert reg.stats()["build_failures"] == 1
+
+
+def test_singleflight_failure_released_to_all_waiters_at_once(
+        monkeypatch):
+    """Waiters parked behind one failing build all get the builder's
+    exception from the SHARED flight — exactly one build attempt, no
+    serial re-building wedge. Sequenced deterministically: the builder
+    blocks inside the (patched) build until both waiters are observed
+    entering the flight's wait."""
+    import spfft_tpu.serve.registry as regmod
+    reg = PlanRegistry()
+    t = _triplets()
+    attempts = {"n": 0}
+    waiters_parked = threading.Semaphore(0)
+    release = threading.Event()
+
+    class SpyFlight(regmod._BuildFlight):
+        """Flight whose waiters announce themselves before blocking."""
+
+        class _SpyEvent(threading.Event):
+            def wait(self, *a, **k):
+                waiters_parked.release()
+                return super().wait(*a, **k)
+
+        def __init__(self):
+            super().__init__()
+            self.done = self._SpyEvent()
+
+    def slow_flaky(*a, **k):
+        attempts["n"] += 1
+        release.wait(timeout=30)  # held until waiters are parked
+        raise RuntimeError("injected build failure")
+
+    real = regmod.build_index_plan
+    monkeypatch.setattr(regmod, "_BuildFlight", SpyFlight)
+    monkeypatch.setattr(regmod, "build_index_plan", slow_flaky)
+    results = [None, None, None]
+
+    def worker(i):
+        try:
+            results[i] = reg.get_or_build(TransformType.C2C, *DIMS, t,
+                                          precision="double")
+        except RuntimeError as exc:
+            results[i] = exc
+
+    builder = threading.Thread(target=worker, args=(0,))
+    builder.start()
+    while attempts["n"] == 0:  # builder is inside the flight
+        time.sleep(0.001)
+    waiters = [threading.Thread(target=worker, args=(i,))
+               for i in (1, 2)]
+    for th in waiters:
+        th.start()
+    for _ in (1, 2):  # both waiters joined the flight's wait
+        assert waiters_parked.acquire(timeout=30)
+    release.set()
+    for th in [builder] + waiters:
+        th.join(timeout=30)
+    assert attempts["n"] == 1  # one failing build, not one per waiter
+    assert all(isinstance(r, RuntimeError) for r in results)
+    assert reg.stats()["build_failures"] == 1
+    monkeypatch.setattr(regmod, "build_index_plan", real)
+    sig, plan = reg.get_or_build(TransformType.C2C, *DIMS, t,
+                                 precision="double")
+    assert plan is not None and reg.stats()["builds"] == 1

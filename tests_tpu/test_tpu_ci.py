@@ -473,3 +473,20 @@ def test_serve_smoke_on_tpu():
     assert serve_bench_main(["--dim", "24", "--requests", "64",
                              "--signatures", "2", "--threads", "4",
                              "--high-fraction", "0.25"]) == 0
+
+
+def test_serve_fault_smoke_on_tpu():
+    """The failure-semantics smoke ON THE CHIP: scripted faults drive
+    bucket isolation, bounded retry, device quarantine/probation over
+    the REAL device pool and the crash-proof dispatch supervisor
+    against real Mosaic/XLA:TPU executables (the CPU tier-1 smoke
+    covers the same logic but not hardware dispatch or a multi-chip
+    pool). A short injected-fault trace is also measured so degraded
+    TPU-regime serving numbers land in the CI log next to the clean
+    trace from test_serve_smoke_on_tpu."""
+    from spfft_tpu.serve.bench import main as serve_bench_main
+
+    assert serve_bench_main(["--fault-smoke"]) == 0
+    assert serve_bench_main(["--dim", "24", "--requests", "64",
+                             "--signatures", "2", "--threads", "4",
+                             "--fault-rate", "0.05"]) == 0
