@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Same-session interleaved A/B for the overlap pipeline: monolithic
+(K=1) vs chunked (K in {2, 4}) distributed exchange — the multichip
+bench lane the ISSUE-4 acceptance criteria record in BENCHMARKS.md
+"Round-9".
+
+Protocol: ONE backend session builds every (exchange, K) plan on the
+same mesh and the measurement rounds INTERLEAVE across plans (A/B/A/B),
+so session-state drift (compile caches, allocator warmup) hits every
+variant equally — the ab_interleaved.py lesson applied within a
+session. Per variant the script reports the median-of-rounds pair time
+plus the structural HLO evidence: collective launch count (K per
+direction when chunked) and the async start/done split of the COMPILED
+module (non-zero only on backends whose scheduler overlaps collectives
+— XLA:TPU; zero on XLA:CPU, where the numbers below are mechanism
+overhead only, not overlap wins).
+
+  python scripts/bench_overlap_ab.py [--shards 8] [--dim 48] \
+      [--reps 10] [--rounds 5] [--cpu] [-o overlap_ab.json]
+
+On a CPU container pass ``--cpu`` to force a virtual --shards-device
+platform (same as the test conftest); on a TPU pod slice run it bare.
+"""
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--shards", type=int, default=8)
+    ap.add_argument("--dim", type=int, default=48)
+    ap.add_argument("--reps", type=int, default=10,
+                    help="pairs per measurement group")
+    ap.add_argument("--rounds", type=int, default=5,
+                    help="interleaved rounds per variant")
+    ap.add_argument("--chunks", type=int, nargs="+", default=[1, 2, 4])
+    ap.add_argument("--cpu", action="store_true",
+                    help="force a virtual CPU platform with --shards "
+                         "devices")
+    ap.add_argument("-o", "--output", default=None, metavar="FILE.json")
+    args = ap.parse_args(argv)
+
+    if args.cpu:
+        from spfft_tpu.utils.platform import force_virtual_cpu_devices
+        force_virtual_cpu_devices(args.shards)
+
+    import numpy as np
+    import jax
+
+    from spfft_tpu import ExchangeType, TransformType
+    from spfft_tpu.parallel import make_distributed_plan, make_mesh
+    from spfft_tpu.utils.hlo_inspect import (collective_async_split,
+                                             count_collectives)
+    from spfft_tpu.utils.workloads import (even_plane_split,
+                                           round_robin_stick_partition,
+                                           spherical_cutoff_triplets)
+
+    n, S = args.dim, args.shards
+    tr = spherical_cutoff_triplets(n)
+    parts = round_robin_stick_partition(tr, (n, n, n), S)
+    planes = even_plane_split(n, S)
+    mesh = make_mesh(S)
+    rng = np.random.default_rng(42)
+    vals_np = [(rng.uniform(-1, 1, len(p))
+                + 1j * rng.uniform(-1, 1, len(p))).astype(np.complex64)
+               for p in parts]
+
+    variants = []  # (label, plan, device values, hlo evidence)
+    for exch, ename in ((ExchangeType.DEFAULT, "buffered"),
+                        (ExchangeType.COMPACT_BUFFERED, "ragged")):
+        for k in args.chunks:
+            plan = make_distributed_plan(
+                TransformType.C2C, n, n, n, parts, planes, mesh=mesh,
+                exchange=exch, overlap_chunks=k)
+            v = plan.shard_values(vals_np)
+            lowered = plan._backward_jit.lower(v, *plan._device_tables)
+            launches = sum(count_collectives(lowered.as_text()).values())
+            split = collective_async_split(lowered.compile().as_text())
+            variants.append({
+                "label": f"{ename}-k{plan.overlap_chunks}",
+                "exchange": ename, "k": plan.overlap_chunks,
+                "plan": plan, "values": v,
+                "collectives_bwd": launches,
+                "async_starts": split["starts"],
+                "wire_total_bytes": int(plan.exchange_wire_bytes()),
+                "times": []})
+
+    def sync(a):
+        jax.block_until_ready(a)
+        np.asarray(jax.tree_util.tree_leaves(a)[-1]).ravel()[:1]
+
+    for var in variants:  # warm every executable before any timing
+        sync(var["plan"].apply_pointwise(var["values"]))
+    for _ in range(args.rounds):
+        for var in variants:  # interleaved: one group per variant
+            t0 = time.perf_counter()
+            out = None
+            for _ in range(args.reps):
+                out = var["plan"].apply_pointwise(var["values"])
+            sync(out)
+            var["times"].append((time.perf_counter() - t0) / args.reps)
+
+    backend = jax.default_backend()
+    rows = []
+    base_ms = {}
+    for var in variants:
+        ms = sorted(t * 1e3 for t in var["times"])
+        med = statistics.median(ms)
+        if var["k"] == 1:
+            base_ms[var["exchange"]] = med
+        rows.append({k: var[k] for k in
+                     ("label", "exchange", "k", "collectives_bwd",
+                      "async_starts", "wire_total_bytes")}
+                    | {"pair_ms_median": round(med, 3),
+                       "pair_ms_min": round(ms[0], 3),
+                       "vs_k1": round(base_ms[var["exchange"]] / med, 3)})
+    payload = {
+        "backend": backend, "shards": S, "dim": n,
+        "num_values": int(len(tr)), "reps": args.reps,
+        "rounds": args.rounds,
+        "overlap_meaningful": backend == "tpu",
+        "note": ("async_starts == 0 on this backend: the scheduler "
+                 "runs collectives synchronously, so K>1 measures "
+                 "chunking overhead, not overlap wins — run on TPU "
+                 "for the real A/B" if backend != "tpu" else
+                 "async start/done split active"),
+        "rows": rows,
+    }
+    print(json.dumps(payload, indent=2))
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(payload, f, indent=2)
+        print(f"wrote {args.output}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -77,6 +77,13 @@ def _parse_args(argv):
                         "plans only (requires --shards 1)")
     p.add_argument("--shards", type=int, default=1,
                    help="distribute over an N-device mesh (default local)")
+    p.add_argument("--overlap-chunks", type=int, default=None,
+                   metavar="K",
+                   help="split the distributed exchange into K "
+                        "destination-balanced chunks so the z/xy FFT "
+                        "stages pipeline with the collectives "
+                        "(parallel/overlap.py; default 1 = monolithic, "
+                        "or SPFFT_TPU_OVERLAP_CHUNKS)")
     p.add_argument("--cpu", action="store_true",
                    help="force a virtual CPU platform with --shards devices "
                         "(multi-chip simulation, like the test conftest)")
@@ -122,7 +129,8 @@ def _exchange_sweep(args, dims, ttype, triplets, rng, cdt) -> int:
         plan = make_distributed_plan(
             ttype, nx, ny, nz, parts, planes, mesh=make_mesh(args.shards),
             precision=args.precision,
-            exchange=ExchangeType(_EXCHANGE[name]))
+            exchange=ExchangeType(_EXCHANGE[name]),
+            overlap_chunks=args.overlap_chunks)
         values = plan.shard_values(values_np)
         last = None
         for _ in range(max(args.warmups, 1)):
@@ -137,6 +145,7 @@ def _exchange_sweep(args, dims, ttype, triplets, rng, cdt) -> int:
         pair_s = (time.perf_counter() - t0) / args.repeats
         rows.append({
             "exchange": name,
+            "overlap_chunks": plan.overlap_chunks,
             "pair_seconds": round(pair_s, 6),
             "wire_total_bytes": int(plan.exchange_wire_bytes()),
             "busiest_link_bytes": int(plan.exchange_busiest_link_bytes()),
@@ -220,7 +229,8 @@ def main(argv=None) -> int:
         plan = make_distributed_plan(ttype, nx, ny, nz, parts, planes,
                                      mesh=make_mesh(args.shards),
                                      precision=args.precision,
-                                     exchange=exchange)
+                                     exchange=exchange,
+                                     overlap_chunks=args.overlap_chunks)
         values_np = [
             (rng.uniform(-1, 1, len(p)) + 1j * rng.uniform(-1, 1, len(p)))
             .astype(cdt) for p in parts]
@@ -315,6 +325,7 @@ def main(argv=None) -> int:
         "devices": len(jax.devices()), "backend": jax.default_backend(),
         "dim_x": nx, "dim_y": ny, "dim_z": nz,
         "exchange": args.exchange, "repeats": args.repeats,
+        "overlap_chunks": int(getattr(plan, "overlap_chunks", 1)),
         "transform_type": args.transform, "num_transforms": m,
         "fused_pair": bool(args.fused_pair),
         "sparsity": args.sparsity, "precision": args.precision,

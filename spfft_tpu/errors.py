@@ -157,6 +157,20 @@ class NoHealthyDeviceError(ServeError):
     code = ErrorCode.DEVICE_NO_DEVICE
 
 
+class DistributedPlanUnsupportedError(ServeError):
+    """A ``DistributedTransformPlan`` was submitted to the serving
+    executor. The executor's device pool, batching shards and staging
+    buffers are built around LOCAL plans (one device per request); a
+    distributed plan spans its own mesh and pins its own placement, so
+    routing it through the pool is undefined — it is rejected at submit
+    time instead of failing deep inside dispatch. Multi-host serve
+    (ROADMAP) is the path that will carry distributed-plan requests.
+    Reports through the distributed-support branch (reference
+    SPFFT_MPI_SUPPORT_ERROR, exceptions.hpp:110-121)."""
+
+    code = ErrorCode.DISTRIBUTED_SUPPORT
+
+
 class ExecutorCrashedError(ServeError):
     """The dispatch loop crashed unexpectedly and its supervisor
     exhausted the bounded restart budget; every queued and in-flight

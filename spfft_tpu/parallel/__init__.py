@@ -3,6 +3,8 @@
 from .mesh import make_mesh  # noqa: F401
 from .dist import (DistributedIndexPlan, DistributedTransformPlan,
                    build_distributed_plan, make_distributed_plan)  # noqa: F401
+from .overlap import (OverlapSchedule, build_overlap_schedule,  # noqa: F401
+                      chunk_bounds)
 from .multihost import (build_distributed_plan_multihost,  # noqa: F401
                         initialize as initialize_multihost,
                         plan_fingerprint, validate_consistent)

@@ -59,7 +59,14 @@ from .exchange import (all_to_all_blocks, build_compact_schedule,
                        ragged_exchange, pack_freq_to_blocks,
                        pack_space_to_blocks, ring_exchange_blocks,
                        unpack_blocks_to_grid, unpack_blocks_to_sticks)
-from .mesh import SHARD_AXIS, make_mesh
+from .mesh import SHARD_AXIS, make_mesh, shard_map
+from .overlap import build_overlap_schedule
+
+#: Environment default for the plan's ``overlap_chunks`` knob: split the
+#: distributed exchange into K destination-balanced chunks so the z/xy
+#: FFT stages software-pipeline with the collectives (parallel/overlap.py).
+#: K=1 (the default) is today's monolithic single-collective path.
+OVERLAP_CHUNKS_ENV = "SPFFT_TPU_OVERLAP_CHUNKS"
 
 logger = logging.getLogger("spfft_tpu")
 
@@ -165,7 +172,8 @@ class DistributedTransformPlan:
     def __init__(self, dist_plan: DistributedIndexPlan,
                  mesh: Optional[Mesh] = None, precision: str = "single",
                  exchange: ExchangeType = ExchangeType.DEFAULT,
-                 use_pallas: Optional[bool] = None):
+                 use_pallas: Optional[bool] = None,
+                 overlap_chunks: Optional[int] = None):
         from ..utils.platform import enable_persistent_compilation_cache
         enable_persistent_compilation_cache()
         self.dist_plan = dist_plan
@@ -210,21 +218,61 @@ class DistributedTransformPlan:
         import os as _os
         self._compact = None
         self._ragged = None
+        # Compute/communication overlap (parallel/overlap.py): split the
+        # exchange into K destination-balanced chunks so chunk i's z/xy
+        # FFT stage runs while chunk i-1's collective is in flight
+        # (issue early, unpack late). K=1 keeps the monolithic path —
+        # bit-identical to a plan built without the knob. The knob
+        # composes with EVERY exchange mechanism: ragged/compact get
+        # chunked exact-count sub-schedules, the padded block layouts
+        # (buffered/ring, float-wire included) chunk by static row
+        # slices with no extra tables.
+        if overlap_chunks is None:
+            overlap_chunks = int(
+                _os.environ.get(OVERLAP_CHUNKS_ENV, "1") or "1")
+        if int(overlap_chunks) < 1:
+            raise InvalidParameterError(
+                f"overlap_chunks must be >= 1, got {overlap_chunks}")
+        k_eff = min(int(overlap_chunks), dist_plan.max_sticks,
+                    dist_plan.max_planes)
+        if dist_plan.num_shards == 1:
+            k_eff = 1  # comm-size-1: no collective to overlap
+        if k_eff != int(overlap_chunks):
+            logger.info(
+                "spfft_tpu: overlap_chunks clamped %s -> %d (bounded by "
+                "max_sticks/max_planes; 1 on a single shard)",
+                overlap_chunks, k_eff)
+        self.overlap_chunks = k_eff
+        self._overlap = None
+        use_ppermute_compact = _os.environ.get(
+            "SPFFT_TPU_COMPACT_PPERMUTE") == "1"
         if self.exchange.compact:
-            if dist_plan.num_shards > 1 and _os.environ.get(
-                    "SPFFT_TPU_COMPACT_PPERMUTE") != "1":
-                self._ragged = build_ragged_schedule(
-                    dist_plan, x_window=self._split_x)
+            if dist_plan.num_shards > 1 and not use_ppermute_compact:
+                if k_eff > 1:
+                    self._overlap = build_overlap_schedule(
+                        dist_plan, k_eff, "ragged",
+                        x_window=self._split_x)
+                else:
+                    self._ragged = build_ragged_schedule(
+                        dist_plan, x_window=self._split_x)
+            elif k_eff > 1 and dist_plan.num_shards > 1:
+                self._overlap = build_overlap_schedule(
+                    dist_plan, k_eff, "compact", x_window=self._split_x)
             else:
                 self._compact = build_compact_schedule(
                     dist_plan, x_window=self._split_x)
+        elif k_eff > 1:
+            self._overlap = build_overlap_schedule(dist_plan, k_eff,
+                                                   "block")
         # SPFFT_TPU_FORCE_RAGGED_OP=1 lowers the REAL ragged op off-TPU
         # (XLA:CPU can lower it but not execute it) — used by the HLO
         # launch-count checks in tests and scripts/scaling_model.py.
         self._ragged_emulate = (jax.default_backend() != "tpu"
                                 and _os.environ.get(
                                     "SPFFT_TPU_FORCE_RAGGED_OP") != "1")
-        if self._compact is not None or self._ragged is not None:
+        if (self._compact is not None or self._ragged is not None
+                or (self._overlap is not None
+                    and self._overlap.kind != "block")):
             self._exchange_fn = None
         elif self.exchange == ExchangeType.UNBUFFERED:
             self._exchange_fn = ring_exchange_blocks
@@ -251,9 +299,19 @@ class DistributedTransformPlan:
         self._n_ptables = (len(self._pallas_dist["stacked"])
                            if self._pallas_dist is not None else 0)
         # Exact-count exchange tables (all sharded): per-hop pack tables +
-        # the unpack table, both directions.
+        # the unpack table, both directions. Overlap schedules ship one
+        # table set PER CHUNK plus the two late global unpack tables
+        # (overlap.OverlapSchedule.device_tables); block-kind overlap
+        # needs no tables at all (static slice bounds only).
         self._n_ctables = 0
-        if self._compact is not None:
+        self._ov_slices = None
+        if self._overlap is not None and self._overlap.kind != "block":
+            ctables = self._overlap.device_tables()
+            self._ov_slices = self._overlap.chunk_table_slices()
+            self._n_ctables = len(ctables)
+            self._device_tables = self._device_tables + tuple(
+                jax.device_put(a, self._sharded) for a in ctables)
+        elif self._compact is not None:
             ctables = (list(self._compact.bwd_pack)
                        + [self._compact.bwd_unpack]
                        + list(self._compact.fwd_pack)
@@ -300,7 +358,7 @@ class DistributedTransformPlan:
         # XLA-path plans keep the check (specs pin every sharding anyway)
         self._check_vma = self._pallas_dist is None
         shmap = functools.partial(
-            jax.shard_map, mesh=self.mesh, in_specs=self._base_in_specs,
+            shard_map, mesh=self.mesh, in_specs=self._base_in_specs,
             out_specs=P(self.axis_name), check_vma=self._check_vma)
         self._pair_jits = {}
         self._batched = None
@@ -611,6 +669,121 @@ class DistributedTransformPlan:
                                        self._wire_dtype)
         return unpack_blocks_to_sticks(blocks, z_src)
 
+    # -- chunk-pipelined exchange (compute/communication overlap) -----------
+    def _overlap_bwd_to_grid(self, sticks_raw, onehot_row, col_inv, zmap,
+                             ctables):
+        """Backward overlap pipeline: per chunk, run stick symmetry +
+        z-IFFT on the chunk's stick rows and ISSUE its collective
+        immediately; unpack once, after every chunk's exchange has been
+        issued (issue early, unpack late). The loop builds K independent
+        compute->collective chains — the dependence structure XLA's
+        latency-hiding scheduler needs to split each collective into an
+        async start/done pair and run chunk i's z-stage during chunk
+        i-1's wire time. Batch-aware for the ragged kind only (batch
+        dims lead, collectives carry them trailing); block/compact
+        batched callers vmap the whole per-example tail instead."""
+        ov = self._overlap
+        dp = self.dist_plan
+        batch = sticks_raw.shape[:-2]
+        recvs = []
+        for c, ch in enumerate(ov.chunks):
+            s_c = sticks_raw[..., ch.stick_lo:ch.stick_hi, :]
+            oh_c = onehot_row[ch.stick_lo:ch.stick_hi]
+            if batch:
+                s_c = jax.vmap(
+                    lambda s, oh=oh_c: self._bwd_pre_exchange(s, oh))(s_c)
+            else:
+                s_c = self._bwd_pre_exchange(s_c, oh_c)
+            if ov.kind == "block":
+                blocks = pack_freq_to_blocks(s_c, zmap)
+                if dp.num_shards > 1:
+                    blocks = self._exchange_fn(blocks, self.axis_name,
+                                               self._wire_dtype)
+                recvs.append(blocks)
+                continue
+            flat = s_c.reshape(batch + (-1,))
+            sl = self._ov_slices[c]
+            if ov.kind == "ragged":
+                buf = jnp.take(flat, ctables[sl["bwd_pack"]][0], axis=-1,
+                               mode="fill", fill_value=0)
+                offs = tuple(t[0] for t in
+                             ctables[sl["offs_b"][0]:sl["offs_b"][1]])
+                recvs.append(ragged_exchange(
+                    buf, offs, ctables[sl["emu_bwd"]][0], ch.recv_cap,
+                    self.axis_name, self._ragged_emulate,
+                    self._wire_dtype))
+            else:  # compact ppermute chunk (unbatched by contract)
+                lo, hi = sl["bwd_ops"]
+                bufs = [jnp.take(flat, ctables[i][0], mode="fill",
+                                 fill_value=0) for i in range(lo, hi)]
+                recvs.append(compact_exchange(
+                    bufs, ch.bwd_ops, dp.num_shards, self.axis_name,
+                    reverse=False, wire_real_dtype=self._wire_dtype))
+        if ov.kind == "block":
+            # received chunk blocks are contiguous stick-row slices of
+            # the monolithic (S, max_sticks, max_planes) block
+            blocks = jnp.concatenate(recvs, axis=1)
+            return unpack_blocks_to_grid(blocks, col_inv, dp.dim_y,
+                                         self._xf_eff)
+        recv = jnp.concatenate(recvs, axis=-1)
+        grid_flat = jnp.take(recv, ctables[-2][0], axis=-1, mode="fill",
+                             fill_value=0)
+        return grid_flat.reshape(batch + (dp.max_planes, dp.dim_y,
+                                          self._xf_eff))
+
+    def _overlap_fwd_to_sticks(self, space, cols_flat, z_src, ctables):
+        """Forward overlap pipeline (the backward's mirror): per chunk,
+        xy-FFT the chunk's plane rows and issue its collective; one late
+        unpack reassembles the full-z local sticks. Batch-aware for the
+        ragged kind only, like :meth:`_overlap_bwd_to_grid`."""
+        ov = self._overlap
+        dp = self.dist_plan
+        nd_slab = 3 if dp.hermitian else 4   # (planes, Y, X[, 2])
+        batch = space.shape[:-nd_slab]
+        axis = space.ndim - nd_slab
+        recvs = []
+        for c, ch in enumerate(ov.chunks):
+            s_c = jax.lax.slice_in_dim(space, ch.plane_lo, ch.plane_hi,
+                                       axis=axis)
+            g_c = (jax.vmap(self._fwd_pre_exchange)(s_c) if batch
+                   else self._fwd_pre_exchange(s_c))
+            if ov.kind == "block":
+                blocks = pack_space_to_blocks(g_c, cols_flat,
+                                              dp.num_shards,
+                                              dp.max_sticks)
+                if dp.num_shards > 1:
+                    blocks = self._exchange_fn(blocks, self.axis_name,
+                                               self._wire_dtype)
+                recvs.append(blocks)
+                continue
+            flat = g_c.reshape(batch + (-1,))
+            sl = self._ov_slices[c]
+            if ov.kind == "ragged":
+                buf = jnp.take(flat, ctables[sl["fwd_pack"]][0], axis=-1,
+                               mode="fill", fill_value=0)
+                offs = tuple(t[0] for t in
+                             ctables[sl["offs_f"][0]:sl["offs_f"][1]])
+                recvs.append(ragged_exchange(
+                    buf, offs, ctables[sl["emu_fwd"]][0], ch.recv_cap,
+                    self.axis_name, self._ragged_emulate,
+                    self._wire_dtype))
+            else:
+                lo, hi = sl["fwd_ops"]
+                bufs = [jnp.take(flat, ctables[i][0], mode="fill",
+                                 fill_value=0) for i in range(lo, hi)]
+                recvs.append(compact_exchange(
+                    bufs, ch.fwd_ops, dp.num_shards, self.axis_name,
+                    reverse=False, wire_real_dtype=self._wire_dtype))
+        if ov.kind == "block":
+            # chunk blocks are contiguous plane slices of the monolithic
+            # (S, max_sticks, max_planes) block
+            blocks = jnp.concatenate(recvs, axis=2)
+            return unpack_blocks_to_sticks(blocks, z_src)
+        recv = jnp.concatenate(recvs, axis=-1)
+        sticks_flat = jnp.take(recv, ctables[-1][0], axis=-1,
+                               mode="fill", fill_value=0)
+        return sticks_flat.reshape(batch + (dp.max_sticks, dp.dim_z))
+
     def _decompress_shard(self, values_il, slot_src, ptables):
         """Per-shard decompress: (mv, 2) -> (max_sticks, dim_z) sticks —
         or batched (B, mv, 2) -> (B, max_sticks, dim_z) through the same
@@ -629,16 +802,19 @@ class DistributedTransformPlan:
             return jax.vmap(dec)(values_il)
         return dec(values_il)
 
-    def _bwd_pre_exchange(self, sticks, onehot):
+    def _bwd_pre_exchange(self, sticks, onehot_row):
         """Stick symmetry + z-IFFT (the per-example half before the
-        exchange; batched callers vmap this)."""
+        exchange; batched callers vmap this). ``onehot_row`` is the
+        per-shard (max_sticks,) mask row — the overlap pipeline passes
+        chunk SLICES of both arguments (the stages are per-stick
+        independent, so a row slice is exact)."""
         dp = self.dist_plan
         if dp.hermitian:
             # Complete every stick, then blend by the one-hot (0,0)-stick
             # mask — SPMD-safe stand-in for the reference's "owner rank
             # applies StickSymmetry" branch (execution_host.cpp:306-308).
             completed = jax.vmap(stages.complete_stick_hermitian)(sticks)
-            oh = onehot[0][:, None].astype(self._rdt)
+            oh = onehot_row[:, None].astype(self._rdt)
             sticks = sticks * (1 - oh) + completed * oh
         return stages.z_backward(sticks)
 
@@ -665,9 +841,15 @@ class DistributedTransformPlan:
         plane symmetry, xy-IFFT. Input (max_sticks, dim_z); output the
         per-shard space slab (unbatched — batched callers vmap the
         pre/post halves and run the exchange batch-natively, see
-        _backward_body_batched)."""
-        sticks = self._bwd_pre_exchange(sticks, onehot)
-        grid = self._exchange_freq_to_grid(sticks, zmap, col_inv, ctables)
+        _backward_body_batched). With ``overlap_chunks > 1`` the z-stage
+        and exchange run CHUNK-PIPELINED instead (parallel/overlap.py)."""
+        if self._overlap is not None:
+            grid = self._overlap_bwd_to_grid(sticks, onehot[0], col_inv,
+                                             zmap, ctables)
+        else:
+            sticks = self._bwd_pre_exchange(sticks, onehot[0])
+            grid = self._exchange_freq_to_grid(sticks, zmap, col_inv,
+                                               ctables)
         return self._bwd_post_exchange(grid)
 
     def _backward_body(self, values_il, vi, slot_src, onehot, cols_flat,
@@ -689,15 +871,25 @@ class DistributedTransformPlan:
         ptables = xtables[:self._n_ptables]
         ctables = xtables[self._n_ptables:self._n_ptables + self._n_ctables]
         sticks_b = self._decompress_shard(values_il[0], slot_src, ptables)
+        if self._overlap is not None and self._overlap.kind == "ragged":
+            # chunk loop identical to the unbatched path; each chunk's
+            # collective carries the batch as trailing dims
+            # (_overlap_bwd_to_grid is batch-aware for the ragged kind)
+            grid_b = self._overlap_bwd_to_grid(sticks_b, onehot[0],
+                                               col_inv, zmap, ctables)
+            return jax.vmap(self._bwd_post_exchange)(grid_b)[None]
         if self._ragged is not None:
             # ragged_all_to_all has no vmap batching rule: vmap the
             # per-example halves, run ONE collective with the batch as a
             # trailing dimension (exchange.ragged_exchange)
             s2 = jax.vmap(
-                lambda s: self._bwd_pre_exchange(s, onehot))(sticks_b)
+                lambda s: self._bwd_pre_exchange(s, onehot[0]))(sticks_b)
             grid_b = self._exchange_freq_to_grid(s2, zmap, col_inv,
                                                  ctables)
             return jax.vmap(self._bwd_post_exchange)(grid_b)[None]
+        # block/compact overlap flows through the vmapped per-example
+        # tail (a2a/ppermute have batching rules), like the monolithic
+        # non-ragged mechanisms
         return jax.vmap(
             lambda s: self._backward_tail(s, onehot, col_inv, zmap,
                                           ctables))(sticks_b)[None]
@@ -720,10 +912,16 @@ class DistributedTransformPlan:
 
     def _forward_head(self, space, cols_flat, z_src, ctables):
         """Per-shard pipeline before compress: xy-FFT, exchange, z-FFT.
-        Input the per-shard space slab; output (max_sticks, dim_z)."""
-        grid = self._fwd_pre_exchange(space)
-        sticks = self._exchange_grid_to_sticks(grid, cols_flat, z_src,
-                                               ctables)
+        Input the per-shard space slab; output (max_sticks, dim_z).
+        With ``overlap_chunks > 1`` the xy-stage and exchange run
+        chunk-pipelined (the forward mirror of the backward overlap)."""
+        if self._overlap is not None:
+            sticks = self._overlap_fwd_to_sticks(space, cols_flat, z_src,
+                                                 ctables)
+        else:
+            grid = self._fwd_pre_exchange(space)
+            sticks = self._exchange_grid_to_sticks(grid, cols_flat, z_src,
+                                                   ctables)
         return stages.z_forward(sticks)
 
     def _compress_shard(self, sticks, vi, ptables, scaled: bool):
@@ -758,7 +956,12 @@ class DistributedTransformPlan:
                               col_inv, zmap, z_src, *xtables, scaled: bool):
         ptables = xtables[:self._n_ptables]
         ctables = xtables[self._n_ptables:self._n_ptables + self._n_ctables]
-        if self._ragged is not None:
+        if self._overlap is not None and self._overlap.kind == "ragged":
+            # chunked forward with the batch on the collectives'
+            # trailing dims (_overlap_fwd_to_sticks is batch-aware)
+            sticks_b = stages.z_forward(self._overlap_fwd_to_sticks(
+                space[0], cols_flat, z_src, ctables))
+        elif self._ragged is not None:
             # batch rides the collective's trailing dims (see
             # _backward_body_batched)
             grid_b = jax.vmap(self._fwd_pre_exchange)(space[0])
@@ -774,7 +977,7 @@ class DistributedTransformPlan:
         """shard_map wrapper for the fused-pair entry points: base specs
         plus one sharded spec per fn_arg."""
         return functools.partial(
-            jax.shard_map, mesh=self.mesh,
+            shard_map, mesh=self.mesh,
             in_specs=self._base_in_specs
             + (P(self.axis_name),) * n_fn_args,
             out_specs=P(self.axis_name), check_vma=self._check_vma)
@@ -925,6 +1128,10 @@ class DistributedTransformPlan:
         :meth:`exchange_busiest_link_bytes` for the bottleneck-link view."""
         dp = self.dist_plan
         elem = self._wire_elem_bytes()
+        if self._overlap is not None and self._overlap.kind != "block":
+            # chunking conserves wire elements exactly (overlap.py);
+            # block-kind overlap ships the padded rows and falls through
+            return self._overlap.wire_elements() * elem
         if self._ragged is not None:
             return self._ragged.wire_elements() * elem  # exact Alltoallv
         if self._compact is not None:
@@ -940,11 +1147,23 @@ class DistributedTransformPlan:
         (aggregate), not here; stick-skew savings show up in both."""
         dp = self.dist_plan
         elem = self._wire_elem_bytes()
+        if self._overlap is not None and self._overlap.kind != "block":
+            return self._overlap.busiest_link_elements() * elem
         if self._ragged is not None:
             return self._ragged.busiest_link_elements() * elem
         if self._compact is not None:
             return self._compact.busiest_link_elements() * elem
         return (dp.num_shards - 1) * dp.max_sticks * dp.max_planes * elem
+
+    def estimated_device_bytes(self) -> int:
+        """Approximate resident bytes this plan pins for its lifetime:
+        the committed device tables (sharded across the mesh, counted
+        whole). Same contract as the local plan's method — the serving
+        plan registry's byte-aware LRU reads it on ``put`` (even though
+        distributed plans are rejected at ``submit``; see
+        errors.DistributedPlanUnsupportedError)."""
+        leaves = jax.tree_util.tree_leaves(self._device_tables)
+        return sum(int(getattr(leaf, "nbytes", 0)) for leaf in leaves)
 
     # -- data movement helpers ----------------------------------------------
     def shard_values(self, values_per_shard: Sequence) -> jax.Array:
@@ -1066,7 +1285,7 @@ class DistributedTransformPlan:
         overlap, multi_transform_internal.hpp:47-94)."""
         if self._batched is None:
             shmap = functools.partial(
-                jax.shard_map, mesh=self.mesh, in_specs=self._base_in_specs,
+                shard_map, mesh=self.mesh, in_specs=self._base_in_specs,
                 out_specs=P(self.axis_name), check_vma=self._check_vma)
             self._batched = {
                 "backward": jax.jit(shmap(self._backward_body_batched)),
@@ -1127,6 +1346,7 @@ def make_distributed_plan(transform_type: TransformType,
                           precision: str = "single",
                           exchange: ExchangeType = ExchangeType.DEFAULT,
                           use_pallas: Optional[bool] = None,
+                          overlap_chunks: Optional[int] = None,
                           ) -> DistributedTransformPlan:
     """Plan a distributed transform in one call (the distributed analogue of
     ``Grid::create_transform``, reference grid.hpp:138-141). Under
@@ -1139,4 +1359,5 @@ def make_distributed_plan(transform_type: TransformType,
         from .multihost import validate_consistent
         validate_consistent(dist)
     return DistributedTransformPlan(dist, mesh=mesh, precision=precision,
-                                    exchange=exchange, use_pallas=use_pallas)
+                                    exchange=exchange, use_pallas=use_pallas,
+                                    overlap_chunks=overlap_chunks)

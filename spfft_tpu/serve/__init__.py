@@ -49,9 +49,9 @@ trace and reports p50/p95/p99 latency (per priority class with
 replay.
 """
 
-from ..errors import (DeadlineExpiredError, ExecutorCrashedError,
-                      NoHealthyDeviceError, QueueFullError,
-                      RetryExhaustedError, ServeError)
+from ..errors import (DeadlineExpiredError, DistributedPlanUnsupportedError,
+                      ExecutorCrashedError, NoHealthyDeviceError,
+                      QueueFullError, RetryExhaustedError, ServeError)
 from .executor import ServeExecutor
 from .faults import FaultPlan, InjectedFault, is_transient
 from .metrics import PRIORITY_CLASSES, ServeMetrics, percentile
@@ -64,5 +64,5 @@ __all__ = [
     "FaultPlan", "InjectedFault", "is_transient",
     "ServeError", "QueueFullError", "DeadlineExpiredError",
     "RetryExhaustedError", "NoHealthyDeviceError",
-    "ExecutorCrashedError",
+    "ExecutorCrashedError", "DistributedPlanUnsupportedError",
 ]

@@ -43,10 +43,12 @@ grid_internal.cpp:148-167, carried to the serving layer):
 * **Bucket-failure isolation** — a fused bucket that raises (dispatch
   or materialisation) falls back to per-request serial re-execution, so
   one poisoned request fails alone and its healthy co-batched neighbors
-  still return bit-exact results. Each request gets ONE bounded retry:
-  transient failures (``faults.is_transient``) that persist surface as
-  ``RetryExhaustedError`` carrying the cause; permanent failures
-  surface immediately as themselves.
+  still return bit-exact results. Each request draws on a bounded
+  PER-PRIORITY retry budget (``retry_budget``; default high=2,
+  normal=1, so SLO-critical work rides out one more transient):
+  transient failures (``faults.is_transient``) that persist through the
+  budget surface as ``RetryExhaustedError`` carrying the cause;
+  permanent failures surface immediately as themselves.
 * **Device quarantine** — per-device consecutive-failure accounting on
   the round-robin pool; a device crossing ``quarantine_after`` failures
   is quarantined with exponential-backoff probation (one canary request
@@ -104,9 +106,10 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..errors import (DeadlineExpiredError, ExecutorCrashedError,
-                      InvalidParameterError, NoHealthyDeviceError,
-                      QueueFullError, RetryExhaustedError, ServeError)
+from ..errors import (DeadlineExpiredError, DistributedPlanUnsupportedError,
+                      ExecutorCrashedError, InvalidParameterError,
+                      NoHealthyDeviceError, QueueFullError,
+                      RetryExhaustedError, ServeError)
 from ..multi import fusion_eligible, planned_batch_size
 from ..plan import TransformPlan
 from ..types import Scaling
@@ -162,6 +165,16 @@ QUARANTINE_BACKOFF_CAP = 60.0
 DEFAULT_MAX_RESTARTS = 3
 
 _PRIORITIES = ("normal", "high")
+
+#: Per-priority bounded-retry budget for transient failures (ROADMAP
+#: fault-tolerance follow-on: the retry budget was a flat 1). High-lane
+#: requests are the ones callers marked latency/SLO-critical, so they
+#: get one more shot at riding out a transient than normal work; a
+#: normal request still gets the single bounded retry of round 8.
+#: Override per executor with ``retry_budget={"normal": n, "high": m}``
+#: (missing classes fall back to these defaults; 0 disables retries for
+#: a class — first failure surfaces immediately).
+DEFAULT_RETRY_BUDGET = {"normal": 1, "high": 2}
 
 
 class _Request:
@@ -247,9 +260,12 @@ class ServeExecutor:
 
     Failure knobs: ``quarantine_after`` / ``quarantine_backoff`` control
     the device-pool quarantine, ``max_dispatch_restarts`` bounds the
-    crash supervisor, ``fault_plan`` arms deterministic fault injection
-    (see :mod:`~spfft_tpu.serve.faults`), ``prewarm_on_pin`` toggles the
-    background exact-shape compile one bucket before a pin lands.
+    crash supervisor, ``retry_budget`` sets the per-priority transient
+    retry budget (``{"normal": 1, "high": 2}`` by default — the high
+    lane gets one more attempt), ``fault_plan`` arms deterministic
+    fault injection (see :mod:`~spfft_tpu.serve.faults`),
+    ``prewarm_on_pin`` toggles the background exact-shape compile one
+    bucket before a pin lands.
     """
 
     def __init__(self, registry: PlanRegistry,
@@ -266,6 +282,7 @@ class ServeExecutor:
                  quarantine_after: int = DEFAULT_QUARANTINE_AFTER,
                  quarantine_backoff: float = DEFAULT_QUARANTINE_BACKOFF,
                  max_dispatch_restarts: int = DEFAULT_MAX_RESTARTS,
+                 retry_budget: Optional[Dict[str, int]] = None,
                  prewarm_on_pin: bool = True,
                  autostart: bool = True):
         if max_batch < 1 or max_queue < 1:
@@ -281,6 +298,18 @@ class ServeExecutor:
             raise InvalidParameterError(
                 "quarantine_after and max_dispatch_restarts must be "
                 ">= 0, quarantine_backoff > 0")
+        budget = dict(DEFAULT_RETRY_BUDGET)
+        if retry_budget:
+            unknown = set(retry_budget) - set(_PRIORITIES)
+            if unknown:
+                raise InvalidParameterError(
+                    f"retry_budget classes must be in {_PRIORITIES}, "
+                    f"got {sorted(unknown)}")
+            if any(int(v) < 0 for v in retry_budget.values()):
+                raise InvalidParameterError(
+                    "retry_budget values must be >= 0")
+            budget.update({k: int(v) for k, v in retry_budget.items()})
+        self._retry_budget = budget
         self.registry = registry
         self.metrics = metrics if metrics is not None else ServeMetrics()
         # The device pool: ``None`` keeps every execution on the default
@@ -491,6 +520,18 @@ class ServeExecutor:
         if plan is None:
             raise InvalidParameterError(
                 f"signature not in registry (warm up first): {signature}")
+        if not isinstance(plan, TransformPlan):
+            # Reject at the door, typed — the pool/batching/staging
+            # machinery is built around LOCAL plans (one device per
+            # request); a distributed plan spans its own mesh and pins
+            # its own placement, so routing it through the device pool
+            # was an undefined path that failed deep inside dispatch
+            # (ROADMAP "multi-host serve" owns the real support).
+            raise DistributedPlanUnsupportedError(
+                f"ServeExecutor serves local TransformPlans only; "
+                f"signature {signature} resolves to a "
+                f"{type(plan).__name__}. Run distributed plans directly "
+                f"(plan.backward/forward) until multi-host serve lands.")
         deadline = (time.monotonic() + timeout
                     if timeout is not None else None)
         key = (signature, kind, scaling)
@@ -1033,52 +1074,68 @@ class ServeExecutor:
         """Bucket-failure isolation: the fused bucket raised ``cause``,
         so re-execute every live request SERIALLY — only genuinely
         poisoned requests fail; healthy co-batched requests still return
-        their (bit-exact) results. The serial re-execution is each
-        request's one bounded retry: a transient failure there becomes
+        their (bit-exact) results. The serial re-executions draw on each
+        request's PER-PRIORITY retry budget (``retry_budget``; high-lane
+        requests get more attempts than normal ones): a transient
+        failure that persists through the budget becomes
         ``RetryExhaustedError`` (carrying the cause), a permanent one
         surfaces as itself."""
         for req in live:
-            self.metrics.record_retry()
-            try:
-                res = self._run_one(req, pooled)
-            except NoHealthyDeviceError as exc:
-                self.metrics.record_no_healthy_device()
-                self._fail_requests([req], exc)
-                continue
-            except Exception as exc:
-                if is_transient(exc):
-                    self.metrics.record_retry_exhausted()
-                    self._fail_requests([req], RetryExhaustedError(
-                        f"request failed its fused-bucket fallback "
-                        f"retry (bucket error: {cause!r})", cause=exc))
-                else:
+            budget = max(1, self._retry_budget[req.priority])
+            for attempt in range(budget):
+                self.metrics.record_retry(req.priority)
+                try:
+                    res = self._run_one(req, pooled)
+                except NoHealthyDeviceError as exc:
+                    self.metrics.record_no_healthy_device()
                     self._fail_requests([req], exc)
-                continue
-            self._resolve_one(req, res)
+                    break
+                except Exception as exc:
+                    if attempt + 1 < budget and is_transient(exc):
+                        continue
+                    if is_transient(exc):
+                        self.metrics.record_retry_exhausted(req.priority)
+                        self._fail_requests([req], RetryExhaustedError(
+                            f"request failed its fused-bucket fallback "
+                            f"({attempt + 1}/{budget} "
+                            f"{req.priority}-class attempts; bucket "
+                            f"error: {cause!r})", cause=exc))
+                    else:
+                        self._fail_requests([req], exc)
+                    break
+                else:
+                    self._resolve_one(req, res)
+                    break
 
     def _retry_request(self, req: _Request, first_exc: BaseException,
                        pooled: bool) -> None:
         """A serial execution of ``req`` failed with ``first_exc``:
         permanent failures surface immediately; transient ones get the
-        one bounded retry, failing with ``RetryExhaustedError`` when the
-        retry fails too."""
-        if not is_transient(first_exc):
+        request's PER-PRIORITY bounded retry budget, failing with
+        ``RetryExhaustedError`` once it is spent."""
+        budget = self._retry_budget[req.priority]
+        if not is_transient(first_exc) or budget < 1:
             self._fail_requests([req], first_exc)
             return
-        self.metrics.record_retry()
-        try:
-            res = self._run_one(req, pooled)
-        except NoHealthyDeviceError as exc:
-            self.metrics.record_no_healthy_device()
-            self._fail_requests([req], exc)
+        for attempt in range(budget):
+            self.metrics.record_retry(req.priority)
+            try:
+                res = self._run_one(req, pooled)
+            except NoHealthyDeviceError as exc:
+                self.metrics.record_no_healthy_device()
+                self._fail_requests([req], exc)
+                return
+            except Exception as exc:
+                if attempt + 1 < budget and is_transient(exc):
+                    continue
+                self.metrics.record_retry_exhausted(req.priority)
+                self._fail_requests([req], RetryExhaustedError(
+                    f"transient failure persisted through "
+                    f"{attempt + 1}/{budget} {req.priority}-class "
+                    f"retries (first error: {first_exc!r})", cause=exc))
+                return
+            self._resolve_one(req, res)
             return
-        except Exception as exc:
-            self.metrics.record_retry_exhausted()
-            self._fail_requests([req], RetryExhaustedError(
-                f"transient failure persisted through its retry "
-                f"(first error: {first_exc!r})", cause=exc))
-            return
-        self._resolve_one(req, res)
 
     def _execute(self, shard: _Shard, bucket: List[_Request]):
         """Deadline-check and DISPATCH one bucket. Returns ``(live,

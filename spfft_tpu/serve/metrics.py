@@ -101,6 +101,10 @@ class ServeMetrics:
             # failure-handling counters (fault tolerance layer)
             self._retries = 0
             self._retries_exhausted = 0
+            self._retries_by: Dict[str, int] = {
+                cls: 0 for cls in PRIORITY_CLASSES}
+            self._retries_exhausted_by: Dict[str, int] = {
+                cls: 0 for cls in PRIORITY_CLASSES}
             self._bucket_fallbacks = 0
             self._quarantines = 0
             self._probations = 0
@@ -136,15 +140,20 @@ class ServeMetrics:
                 self._purged_expired += 1
 
     # -- failure handling (executor-facing) --------------------------------
-    def record_retry(self) -> None:
-        """One recovery/retry execution of a single request."""
+    def record_retry(self, priority: str = "normal") -> None:
+        """One recovery/retry execution of a single request, charged to
+        its priority class (the executor's retry budget is
+        per-priority)."""
         with self._lock:
             self._retries += 1
+            self._retries_by[priority] += 1
 
-    def record_retry_exhausted(self) -> None:
-        """A request failed again on its one bounded retry."""
+    def record_retry_exhausted(self, priority: str = "normal") -> None:
+        """A request's transient failure persisted through its whole
+        per-priority retry budget."""
         with self._lock:
             self._retries_exhausted += 1
+            self._retries_exhausted_by[priority] += 1
 
     def record_bucket_fallback(self) -> None:
         """A fused bucket raised and fell back to per-request serial
@@ -269,6 +278,9 @@ class ServeMetrics:
                 "state": self._health_state,
                 "retries": self._retries,
                 "retries_exhausted": self._retries_exhausted,
+                "retries_by_class": dict(self._retries_by),
+                "retries_exhausted_by_class": dict(
+                    self._retries_exhausted_by),
                 "bucket_fallbacks": self._bucket_fallbacks,
                 "quarantines": self._quarantines,
                 "probations": self._probations,

@@ -41,6 +41,56 @@ def tensor_bytes(shape_str: str) -> int:
     return n * DTYPE_BYTES[dtype]
 
 
+#: StableHLO collective op names this library's exchanges can lower to.
+COLLECTIVE_OPS = ("all_to_all", "collective_permute", "all_gather",
+                  "ragged_all_to_all")
+
+
+def count_collectives(txt: str) -> dict:
+    """Per-op collective counts in a LOWERED StableHLO module — the
+    launch-structure check for the overlap pipeline: ``overlap_chunks=K``
+    must lower K collectives per direction (one per chunk) where the
+    monolithic path lowers one. Counts every spelling this library's
+    exchange mechanisms produce (``COLLECTIVE_OPS``)."""
+    return {op: len(re.findall(rf"stablehlo\.{op}\b", txt))
+            for op in COLLECTIVE_OPS}
+
+
+def total_collectives(txt: str) -> int:
+    """Sum of :func:`count_collectives` — the module's collective launch
+    count."""
+    return sum(count_collectives(txt).values())
+
+
+def collective_async_split(txt: str) -> dict:
+    """Count asynchronous collective start/done pairs in an OPTIMIZED
+    HLO module (``lowered.compile().as_text()``) — the structural
+    evidence that the backend scheduler actually split a collective so
+    compute can run between its start and its done (XLA's latency-hiding
+    scheduler emits ``<op>-start``/``<op>-done`` — or wraps the op in
+    ``async-start``/``async-done`` — only when the dependence graph
+    leaves something to overlap; the overlap pipeline's chunk loop
+    exists to create exactly that slack). Returns
+    ``{"starts": n, "dones": n, "by_op": {...}}``; all zero on backends
+    that schedule collectives synchronously (XLA:CPU today), which is
+    why the TPU CI lane owns the hard assertion."""
+    by_op = {}
+    for op in ("all-to-all", "collective-permute", "all-gather",
+               "ragged-all-to-all"):
+        n = len(re.findall(rf"{op}-start", txt))
+        if n:
+            by_op[op] = n
+    async_n = len(re.findall(r"async-start", txt))
+    if async_n:
+        by_op["async"] = async_n
+    starts = sum(by_op.values())
+    dones = (sum(len(re.findall(rf"{op}-done", txt))
+                 for op in ("all-to-all", "collective-permute",
+                            "all-gather", "ragged-all-to-all"))
+             + len(re.findall(r"async-done", txt)))
+    return {"starts": starts, "dones": dones, "by_op": by_op}
+
+
 def hlo_wire_bytes(txt: str, num_shards: int):
     """(total_off_shard_bytes, per_shard_sent, per_shard_recv) summed over
     every collective in one lowered SPMD module."""

@@ -1,0 +1,367 @@
+"""Compute/communication overlap: chunked, pipelined distributed exchange
+(spfft_tpu/parallel/overlap.py + the pipelined bodies in dist.py).
+
+Two layers of guarantees:
+
+1. SCHEDULE INVARIANTS (plan-time, pure numpy — property-tested over
+   skewed random distributions): chunked sub-schedules conserve
+   ``wire_elements()`` exactly, no chunk's busiest link exceeds the
+   monolithic schedule's, and the union of the chunks' (src, dst,
+   element) sets reproduces the monolithic payload exactly, both
+   directions, both chunked kinds (ragged + compact-ppermute).
+
+2. EXECUTION BIT-EXACTNESS (8-shard virtual CPU mesh): for every
+   exchange mechanism (padded all_to_all, ppermute ring, ragged
+   exact-count, ppermute compact, float-wire variants, R2C, batched,
+   fused pair), ``overlap_chunks=K`` output is BIT-IDENTICAL to the
+   monolithic plan — the overlap pipeline is pure data-movement
+   restructuring, every element takes the same arithmetic path.
+
+Plus the launch-structure checks: K chunks lower K collectives per
+direction where the monolithic path lowers one (the shape XLA's
+latency-hiding scheduler needs to overlap them — the start/done split
+itself is asserted on the TPU lane, tests_tpu/test_tpu_ci.py), and
+``overlap_chunks=1`` lowers IDENTICAL StableHLO to a plan built without
+the knob.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from spfft_tpu import ExchangeType, Scaling, TransformType
+from spfft_tpu.errors import InvalidParameterError
+from spfft_tpu.parallel import make_distributed_plan, make_mesh
+from spfft_tpu.parallel.dist import build_distributed_plan
+from spfft_tpu.parallel.exchange import (build_compact_schedule,
+                                         build_ragged_schedule)
+from spfft_tpu.parallel.overlap import (build_overlap_schedule,
+                                        chunk_bounds)
+from spfft_tpu.utils.hlo_inspect import count_collectives
+
+from test_util import hermitian_triplets, random_sparse_triplets
+from test_distributed import split_by_sticks, split_planes
+
+DIMS = (11, 12, 13)
+
+SKEWS = {
+    "uniform": ([1, 1, 1, 1], [1, 1, 1, 1]),
+    "stick_skew": ([5, 1, 2, 1], [1, 1, 1, 1]),
+    "plane_skew": ([1, 1, 1, 1], [1, 4, 1, 2]),
+    "empty_shards": ([1, 0, 2, 0], [0, 2, 0, 1]),
+}
+
+
+def _dist_plan(skew, seed=31):
+    rng = np.random.default_rng(seed)
+    triplets = random_sparse_triplets(rng, DIMS)
+    parts = split_by_sticks(triplets, DIMS, SKEWS[skew][0])
+    planes = split_planes(DIMS[2], SKEWS[skew][1])
+    return build_distributed_plan(TransformType.C2C, *DIMS, parts, planes)
+
+
+# -- the chunk partitioner ---------------------------------------------------
+def test_chunk_bounds_partition_and_balance():
+    counts = [20, 5, 10, 5]
+    for k in (1, 2, 3, 4, 7):
+        b = chunk_bounds(counts, 25, k)
+        assert len(b) == k
+        assert b[0][0] == 0 and b[-1][1] == 25
+        for (lo, hi), (lo2, _) in zip(b, b[1:]):
+            assert hi == lo2 and lo < hi  # contiguous, non-empty
+    # balanced: with 40 true rows over 4 chunks no chunk carries more
+    # than ~double the ideal share of true rows
+    b = chunk_bounds(counts, 25, 4)
+    shares = [sum(max(0, min(c, hi) - min(c, lo)) for c in counts)
+              for lo, hi in b]
+    assert sum(shares) == sum(counts)
+    assert max(shares) <= 2 * (sum(counts) / 4)
+
+
+def test_chunk_bounds_rejects_bad_k():
+    with pytest.raises(InvalidParameterError):
+        chunk_bounds([3], 4, 0)
+    with pytest.raises(InvalidParameterError):
+        chunk_bounds([3], 4, 5)
+
+
+# -- schedule invariants -----------------------------------------------------
+@pytest.mark.parametrize("skew", sorted(SKEWS))
+@pytest.mark.parametrize("kind", ["ragged", "compact"])
+@pytest.mark.parametrize("k", [2, 3, 4])
+def test_chunked_schedule_invariants(skew, kind, k):
+    dp = _dist_plan(skew)
+    mono = build_ragged_schedule(dp)       # exact accounting
+    monoc = build_compact_schedule(dp)     # bucket-charged accounting
+    ov = build_overlap_schedule(dp, k, kind)
+    # conservation: chunk exact wire sums to the monolithic exact total
+    assert ov.wire_elements() == mono.wire_elements()
+    assert sum(ov.chunk_wire_elements(c, forward=True)
+               for c in range(k)) == mono.wire_elements()
+    # the whole-exchange bottleneck link is unchanged, and no chunk
+    # exceeds it (monolithic bucket-charged accounting upper-bounds the
+    # exact one, so the compact comparison holds a fortiori)
+    assert ov.busiest_link_elements() == mono.busiest_link_elements()
+    for c in range(k):
+        for fwd in (False, True):
+            assert (ov.chunk_busiest_link_elements(c, forward=fwd)
+                    <= mono.busiest_link_elements())
+            assert (ov.chunk_busiest_link_elements(c, forward=fwd)
+                    <= monoc.busiest_link_elements())
+
+
+@pytest.mark.parametrize("skew", sorted(SKEWS))
+@pytest.mark.parametrize("kind", ["ragged", "compact"])
+@pytest.mark.parametrize("k", [2, 3])
+def test_chunk_union_reproduces_every_element(skew, kind, k):
+    """The chunks' (src, dst, element) sets — read from the actual pack
+    tables — must partition the monolithic schedule's payload exactly,
+    in both directions."""
+    dp = _dist_plan(skew)
+    S = dp.num_shards
+    ns = [p.num_sticks for p in dp.shard_plans]
+    npl = list(dp.num_planes)
+    off = list(dp.plane_offsets)
+    dz, Y, Xe = dp.dim_z, dp.dim_y, dp.dim_x_freq
+    exp_bwd, exp_fwd = {}, {}
+    for j in range(S):
+        for d in range(S):
+            if ns[j] * npl[d]:
+                i = np.arange(ns[j])[:, None]
+                z = off[d] + np.arange(npl[d])[None, :]
+                exp_bwd[(j, d)] = np.sort((i * dz + z).reshape(-1))
+            if ns[d] * npl[j]:
+                cols = np.asarray(dp.shard_plans[d].scatter_cols)
+                p = np.arange(npl[j])[None, :]  # local slab rows
+                exp_fwd[(j, d)] = np.sort(
+                    (p * (Y * Xe) + cols[:, None]).reshape(-1))
+    ov = build_overlap_schedule(dp, k, kind)
+    for exp, getter in ((exp_bwd, ov.bwd_pair_elements),
+                        (exp_fwd, ov.fwd_pair_elements)):
+        got = {}
+        for c in range(k):
+            for pr, e in getter(c).items():
+                got.setdefault(pr, []).append(e)
+        got = {pr: np.sort(np.concatenate(v)) for pr, v in got.items()
+               if sum(len(x) for x in v)}
+        assert set(got) == set(exp)
+        for pr in exp:  # exact partition: no loss, no duplication
+            np.testing.assert_array_equal(got[pr], exp[pr])
+
+
+# -- execution bit-exactness (8-shard virtual mesh) --------------------------
+N8 = 16
+
+
+def _eight_shard_case(ttype=TransformType.C2C, seed=0):
+    from spfft_tpu.utils.workloads import (even_plane_split,
+                                           round_robin_stick_partition)
+    rng = np.random.default_rng(seed)
+    if ttype == TransformType.R2C:
+        tr = hermitian_triplets(rng, (N8, N8, N8))
+    else:
+        tr = random_sparse_triplets(rng, (N8, N8, N8))
+    parts = round_robin_stick_partition(np.asarray(tr), (N8, N8, N8), 8)
+    planes = even_plane_split(N8, 8)
+    vals = [(rng.uniform(-1, 1, len(p))
+             + 1j * rng.uniform(-1, 1, len(p))).astype(np.complex64)
+            for p in parts]
+    return parts, planes, vals
+
+
+def _pair_arrays(plan, vals):
+    space = plan.backward(vals)
+    out = plan.forward(space, Scaling.FULL)
+    return np.asarray(space), np.asarray(out)
+
+
+@pytest.mark.parametrize("exchange", [
+    ExchangeType.DEFAULT, ExchangeType.UNBUFFERED,
+    ExchangeType.COMPACT_BUFFERED, ExchangeType.BUFFERED_FLOAT,
+    ExchangeType.COMPACT_BUFFERED_FLOAT])
+@pytest.mark.parametrize("k", [2, 4])
+def test_overlap_bit_exact_vs_monolithic(exchange, k):
+    parts, planes, vals = _eight_shard_case()
+    mesh = make_mesh(8)
+    p0 = make_distributed_plan(TransformType.C2C, N8, N8, N8, parts,
+                               planes, mesh=mesh, exchange=exchange,
+                               overlap_chunks=1)
+    pk = make_distributed_plan(TransformType.C2C, N8, N8, N8, parts,
+                               planes, mesh=mesh, exchange=exchange,
+                               overlap_chunks=k)
+    assert pk._overlap is not None and pk.overlap_chunks > 1
+    s0, f0 = _pair_arrays(p0, vals)
+    sk, fk = _pair_arrays(pk, vals)
+    np.testing.assert_array_equal(s0, sk)
+    np.testing.assert_array_equal(f0, fk)
+
+
+@pytest.mark.parametrize("k", [2, 4])
+def test_overlap_bit_exact_r2c(k):
+    parts, planes, vals = _eight_shard_case(TransformType.R2C)
+    mesh = make_mesh(8)
+    p0 = make_distributed_plan(TransformType.R2C, N8, N8, N8, parts,
+                               planes, mesh=mesh, overlap_chunks=1)
+    pk = make_distributed_plan(TransformType.R2C, N8, N8, N8, parts,
+                               planes, mesh=mesh, overlap_chunks=k)
+    s0, f0 = _pair_arrays(p0, vals)
+    sk, fk = _pair_arrays(pk, vals)
+    np.testing.assert_array_equal(s0, sk)
+    np.testing.assert_array_equal(f0, fk)
+
+
+def test_overlap_bit_exact_ppermute_compact(monkeypatch):
+    """The SPFFT_TPU_COMPACT_PPERMUTE=1 mechanism takes the chunked
+    compact-op path (kind == 'compact')."""
+    monkeypatch.setenv("SPFFT_TPU_COMPACT_PPERMUTE", "1")
+    parts, planes, vals = _eight_shard_case()
+    mesh = make_mesh(8)
+    p0 = make_distributed_plan(TransformType.C2C, N8, N8, N8, parts,
+                               planes, mesh=mesh,
+                               exchange=ExchangeType.COMPACT_BUFFERED,
+                               overlap_chunks=1)
+    pk = make_distributed_plan(TransformType.C2C, N8, N8, N8, parts,
+                               planes, mesh=mesh,
+                               exchange=ExchangeType.COMPACT_BUFFERED,
+                               overlap_chunks=2)
+    assert pk._overlap is not None and pk._overlap.kind == "compact"
+    s0, f0 = _pair_arrays(p0, vals)
+    sk, fk = _pair_arrays(pk, vals)
+    np.testing.assert_array_equal(s0, sk)
+    np.testing.assert_array_equal(f0, fk)
+
+
+@pytest.mark.parametrize("exchange", [ExchangeType.DEFAULT,
+                                      ExchangeType.COMPACT_BUFFERED])
+def test_overlap_bit_exact_batched_and_fused_pair(exchange):
+    parts, planes, vals = _eight_shard_case()
+    mesh = make_mesh(8)
+    rng = np.random.default_rng(7)
+    vb = [[(rng.uniform(-1, 1, len(p))
+            + 1j * rng.uniform(-1, 1, len(p))).astype(np.complex64)
+           for p in parts] for _ in range(3)]
+    p0 = make_distributed_plan(TransformType.C2C, N8, N8, N8, parts,
+                               planes, mesh=mesh, exchange=exchange,
+                               overlap_chunks=1)
+    pk = make_distributed_plan(TransformType.C2C, N8, N8, N8, parts,
+                               planes, mesh=mesh, exchange=exchange,
+                               overlap_chunks=2)
+    b0 = p0.backward_batched(vb)
+    bk = pk.backward_batched(vb)
+    np.testing.assert_array_equal(np.asarray(b0), np.asarray(bk))
+    np.testing.assert_array_equal(
+        np.asarray(p0.forward_batched(b0, Scaling.FULL)),
+        np.asarray(pk.forward_batched(bk, Scaling.FULL)))
+    np.testing.assert_array_equal(
+        np.asarray(p0.apply_pointwise(vals, scaling=Scaling.FULL)),
+        np.asarray(pk.apply_pointwise(vals, scaling=Scaling.FULL)))
+
+
+def test_overlap_bit_exact_split_x_window():
+    """Overlap composes with the split-x occupied-window optimisation:
+    sticks clustered in a narrow x band trigger the window, and the
+    chunked tables must index the window layout."""
+    rng = np.random.default_rng(5)
+    n = 16
+    tr = random_sparse_triplets(rng, (4, n, n))  # narrow x extent
+    tr = np.asarray(tr)
+    from spfft_tpu.utils.workloads import (even_plane_split,
+                                           round_robin_stick_partition)
+    parts = round_robin_stick_partition(tr, (n, n, n), 8)
+    planes = even_plane_split(n, 8)
+    vals = [(rng.uniform(-1, 1, len(p))
+             + 1j * rng.uniform(-1, 1, len(p))).astype(np.complex64)
+            for p in parts]
+    mesh = make_mesh(8)
+    for exchange in (ExchangeType.DEFAULT, ExchangeType.COMPACT_BUFFERED):
+        p0 = make_distributed_plan(TransformType.C2C, n, n, n, parts,
+                                   planes, mesh=mesh, exchange=exchange,
+                                   overlap_chunks=1)
+        assert p0._split_x is not None  # the window actually engaged
+        pk = make_distributed_plan(TransformType.C2C, n, n, n, parts,
+                                   planes, mesh=mesh, exchange=exchange,
+                                   overlap_chunks=2)
+        s0, f0 = _pair_arrays(p0, vals)
+        sk, fk = _pair_arrays(pk, vals)
+        np.testing.assert_array_equal(s0, sk)
+        np.testing.assert_array_equal(f0, fk)
+
+
+# -- launch structure / knob plumbing ----------------------------------------
+def test_overlap_lowers_k_collectives_per_direction():
+    """K chunks must lower K independent collectives (the structure the
+    latency-hiding scheduler splits into start/done pairs on TPU); the
+    monolithic plan lowers one."""
+    parts, planes, vals = _eight_shard_case()
+    mesh = make_mesh(8)
+    for k in (1, 2):
+        plan = make_distributed_plan(TransformType.C2C, N8, N8, N8,
+                                     parts, planes, mesh=mesh,
+                                     overlap_chunks=k)
+        v = plan.shard_values(vals)
+        txt = plan._backward_jit.lower(v, *plan._device_tables).as_text()
+        assert count_collectives(txt)["all_to_all"] == k
+        # ragged mechanism: the CPU emulation gathers once per chunk
+        plan_r = make_distributed_plan(
+            TransformType.C2C, N8, N8, N8, parts, planes, mesh=mesh,
+            exchange=ExchangeType.COMPACT_BUFFERED, overlap_chunks=k)
+        v = plan_r.shard_values(vals)
+        txt = plan_r._backward_jit.lower(
+            v, *plan_r._device_tables).as_text()
+        assert count_collectives(txt)["all_gather"] == k
+
+
+def test_overlap_chunks_one_is_identical_hlo():
+    """overlap_chunks=1 must produce the IDENTICAL lowered module to a
+    plan built without the knob (same code path, not merely the same
+    numerics)."""
+    parts, planes, vals = _eight_shard_case()
+    mesh = make_mesh(8)
+    p_default = make_distributed_plan(TransformType.C2C, N8, N8, N8,
+                                      parts, planes, mesh=mesh)
+    p_one = make_distributed_plan(TransformType.C2C, N8, N8, N8,
+                                  parts, planes, mesh=mesh,
+                                  overlap_chunks=1)
+    v = p_default.shard_values(vals)
+    t0 = p_default._backward_jit.lower(
+        v, *p_default._device_tables).as_text()
+    t1 = p_one._backward_jit.lower(v, *p_one._device_tables).as_text()
+    assert t0 == t1
+
+
+def test_overlap_knob_env_and_clamp(monkeypatch):
+    parts, planes, _ = _eight_shard_case()
+    mesh = make_mesh(8)
+    monkeypatch.setenv("SPFFT_TPU_OVERLAP_CHUNKS", "2")
+    plan = make_distributed_plan(TransformType.C2C, N8, N8, N8, parts,
+                                 planes, mesh=mesh)
+    assert plan.overlap_chunks == 2 and plan._overlap is not None
+    monkeypatch.delenv("SPFFT_TPU_OVERLAP_CHUNKS")
+    # clamped by max_planes (16 planes / 8 shards = 2 per shard)
+    plan = make_distributed_plan(TransformType.C2C, N8, N8, N8, parts,
+                                 planes, mesh=mesh, overlap_chunks=64)
+    assert plan.overlap_chunks == min(
+        plan.dist_plan.max_sticks, plan.dist_plan.max_planes)
+    with pytest.raises(InvalidParameterError):
+        make_distributed_plan(TransformType.C2C, N8, N8, N8, parts,
+                              planes, mesh=mesh, overlap_chunks=0)
+
+
+def test_overlap_wire_bytes_match_monolithic():
+    """The wire-byte model is unchanged by chunking: exact mechanisms
+    report the monolithic exact totals, padded mechanisms the padded
+    ones."""
+    parts, planes, _ = _eight_shard_case()
+    mesh = make_mesh(8)
+    for exchange in (ExchangeType.DEFAULT, ExchangeType.COMPACT_BUFFERED,
+                     ExchangeType.COMPACT_BUFFERED_FLOAT):
+        p0 = make_distributed_plan(TransformType.C2C, N8, N8, N8, parts,
+                                   planes, mesh=mesh, exchange=exchange,
+                                   overlap_chunks=1)
+        pk = make_distributed_plan(TransformType.C2C, N8, N8, N8, parts,
+                                   planes, mesh=mesh, exchange=exchange,
+                                   overlap_chunks=2)
+        assert pk.exchange_wire_bytes() == p0.exchange_wire_bytes()
+        assert (pk.exchange_busiest_link_bytes()
+                == p0.exchange_busiest_link_bytes())

@@ -470,3 +470,36 @@ def test_latency_reservoir_bounded():
     assert snap["latency_count"] == 8         # reservoir bounded
     # percentiles read the recent window only (samples 93..100)
     assert m.latency_percentiles()["p50"] >= 93.0
+
+
+def test_submit_distributed_plan_rejected_typed():
+    """A DistributedTransformPlan in the registry is rejected AT SUBMIT
+    with the typed DistributedPlanUnsupportedError (previously an
+    undefined path failing deep in dispatch — ROADMAP: 'local plans
+    only take the device-pool path')."""
+    from spfft_tpu.errors import (DistributedPlanUnsupportedError,
+                                  ErrorCode)
+    from spfft_tpu.parallel import make_distributed_plan, make_mesh
+    from spfft_tpu.serve import signature_for
+    from spfft_tpu.utils.workloads import (even_plane_split,
+                                           round_robin_stick_partition)
+
+    rng = np.random.default_rng(0)
+    t = random_sparse_triplets(rng, DIMS)
+    S = 2
+    parts = round_robin_stick_partition(t, DIMS, S)
+    planes = even_plane_split(DIMS[2], S)
+    dplan = make_distributed_plan(TransformType.C2C, *DIMS, parts, planes,
+                                  mesh=make_mesh(S), precision="double")
+    sig = signature_for(TransformType.C2C, *DIMS, t, precision="double",
+                        device_count=S)
+    reg = PlanRegistry()
+    reg.put(sig, dplan)
+    with ServeExecutor(reg, autostart=False) as ex:
+        with pytest.raises(DistributedPlanUnsupportedError) as exc:
+            ex.submit(sig, [np.zeros(p.num_values, np.complex128)
+                            for p in dplan.dist_plan.shard_plans])
+        assert exc.value.error_code() == ErrorCode.DISTRIBUTED_SUPPORT
+        assert isinstance(exc.value, ServeError)
+        # nothing was enqueued: the executor is still clean
+        assert ex.metrics.snapshot()["completed"] == 0

@@ -541,3 +541,119 @@ def test_fault_fuzz_poisoned_and_transient_under_concurrency():
     assert snap["failed"] == n_poisoned
     assert snap["health"]["state"] in ("healthy", "degraded", "draining")
     assert snap["health"]["dispatcher_crashes"] == 0
+
+
+# -- per-priority retry budget ----------------------------------------------
+def test_retry_budget_high_survives_double_transient():
+    """The high lane's default budget (2) rides out a transient that
+    fires on the attempt AND the first retry — where a normal request
+    (budget 1, test_retry_exhausted_carries_cause) is exhausted."""
+    reg, (sig,) = _registry_with([1])
+    rng = np.random.default_rng(20)
+    plan = reg.get(sig)
+    v = _values_for(reg, sig, rng)
+    ex = ServeExecutor(reg, autostart=False, batching=False,
+                       fault_plan=FaultPlan(
+                           script="dispatch@1,dispatch@2"))
+    fut = ex.submit(sig, v, priority="high")
+    ex._drain_once()
+    assert np.array_equal(np.asarray(fut.result(timeout=30)),
+                          np.asarray(plan.backward(v)))
+    h = ex.metrics.health()
+    assert h["retries_by_class"]["high"] == 2
+    assert h["retries_exhausted_by_class"]["high"] == 0
+    assert h["retries_exhausted"] == 0
+    ex.close()
+
+
+def test_retry_budget_high_exhausts_past_budget():
+    """Three consecutive transients beat even the high budget: the
+    request fails typed with the per-class exhaustion counted."""
+    reg, (sig,) = _registry_with([1])
+    rng = np.random.default_rng(21)
+    ex = ServeExecutor(reg, autostart=False, batching=False,
+                       fault_plan=FaultPlan(
+                           script="dispatch@1,dispatch@2,dispatch@3"))
+    fut = ex.submit(sig, _values_for(reg, sig, rng), priority="high")
+    ex._drain_once()
+    with pytest.raises(RetryExhaustedError):
+        fut.result(timeout=30)
+    h = ex.metrics.health()
+    assert h["retries_by_class"]["high"] == 2
+    assert h["retries_exhausted_by_class"]["high"] == 1
+    ex.close()
+
+
+def test_retry_budget_default_high_exceeds_normal():
+    """The ISSUE contract: high gets at least one more retry than
+    normal by default."""
+    from spfft_tpu.serve.executor import DEFAULT_RETRY_BUDGET
+    assert DEFAULT_RETRY_BUDGET["high"] >= DEFAULT_RETRY_BUDGET["normal"] + 1
+    reg, (sig,) = _registry_with([1])
+    ex = ServeExecutor(reg, autostart=False)
+    assert ex._retry_budget["high"] >= ex._retry_budget["normal"] + 1
+    ex.close()
+
+
+def test_retry_budget_knob_validation_and_zero():
+    reg, (sig,) = _registry_with([1])
+    with pytest.raises(InvalidParameterError):
+        ServeExecutor(reg, autostart=False, retry_budget={"urgent": 1})
+    with pytest.raises(InvalidParameterError):
+        ServeExecutor(reg, autostart=False, retry_budget={"high": -1})
+    # budget 0: a transient first failure surfaces immediately as
+    # itself — no retry, no RetryExhaustedError wrapper
+    rng = np.random.default_rng(22)
+    ex = ServeExecutor(reg, autostart=False, batching=False,
+                       retry_budget={"normal": 0},
+                       fault_plan=FaultPlan(script="dispatch@1"))
+    fut = ex.submit(sig, _values_for(reg, sig, rng))
+    ex._drain_once()
+    with pytest.raises(InjectedFault) as exc:
+        fut.result(timeout=30)
+    assert exc.value.transient
+    h = ex.metrics.health()
+    assert h["retries"] == 0
+    # the high lane still has its default budget
+    assert ex._retry_budget["high"] == 2
+    ex.close()
+
+
+def test_recover_serial_draws_on_priority_budget():
+    """Bucket fallback recovery consumes the per-priority budget too: a
+    transient fault landing on a HIGH request's recovery execution is
+    retried within the bucket fallback (a normal request with the same
+    script is exhausted, since its single budgeted attempt IS the
+    recovery execution)."""
+    reg, (sig,) = _registry_with([1])
+    plan = reg.get(sig)
+
+    def run(priority):
+        rng = np.random.default_rng(23)
+        vals = [_values_for(reg, sig, rng) for _ in range(4)]
+        oracles = [np.asarray(plan.backward(v)) for v in vals]
+        # stage@1 fails the fused bucket; dispatch@1 then lands on the
+        # FIRST recovery execution
+        ex = ServeExecutor(reg, autostart=False, batch_window=0.0,
+                           fault_plan=FaultPlan(
+                               script="stage@1,dispatch@1"))
+        futs = [ex.submit(sig, v, priority=priority) for v in vals]
+        ex._drain_once()
+        return ex, futs, oracles
+
+    ex, futs, oracles = run("high")
+    for f, expect in zip(futs, oracles):
+        assert np.array_equal(np.asarray(f.result(timeout=30)), expect)
+    h = ex.metrics.health()
+    assert h["bucket_fallbacks"] == 1
+    assert h["retries_exhausted"] == 0
+    assert h["retries_by_class"]["high"] == 5  # 4 recoveries + 1 extra
+    ex.close()
+
+    ex, futs, oracles = run("normal")
+    with pytest.raises(RetryExhaustedError):
+        futs[0].result(timeout=30)
+    for f, expect in zip(futs[1:], oracles[1:]):
+        assert np.array_equal(np.asarray(f.result(timeout=30)), expect)
+    assert ex.metrics.health()["retries_exhausted_by_class"]["normal"] == 1
+    ex.close()
