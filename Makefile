@@ -15,7 +15,7 @@ CACHE_TAG   := $(shell python3 -c "import sys; print(sys.implementation.cache_ta
 PLANNER_SO  := $(NATIVE_DIR)/_planner_$(CACHE_TAG).so
 CAPI_SO     := lib/libspfft_tpu.so
 
-.PHONY: all native capi example-c test ci ci-tpu clean
+.PHONY: all native capi example-c test ci ci-tpu trace-smoke clean
 
 # One-command CI (reference: .github/workflows/ci.yml builds + runs the
 # local test matrix): full CPU suite (8-device virtual mesh; includes the
@@ -45,6 +45,22 @@ ci-tpu:
 	@echo "== CI-TPU: on-device regression lane =="
 	python -m pytest tests_tpu/ -q -rA
 	@echo "CI-TPU GREEN"
+
+# Observability smoke (docs/observability.md): the deterministic serving
+# smoke with request tracing on, exporting + validating both artifact
+# formats — the Chrome trace JSON (all eight request stages + compile +
+# exchange events, zero unclosed spans; open build/trace_smoke.json in
+# https://ui.perfetto.dev) and the Prometheus text exposition
+# (round-tripped through the validating parser). The same checks run in
+# tier-1 (tests/test_serve_bench_cli.py::test_serve_bench_smoke_trace_artifacts).
+trace-smoke:
+	@echo "== trace-smoke: traced serve.bench --smoke + artifact validation =="
+	@mkdir -p build
+	python -m spfft_tpu.serve.bench --smoke --cpu --devices 2 \
+	  --trace-out build/trace_smoke.json --prom-out build/trace_smoke.prom
+	python -m spfft_tpu.obs validate build/trace_smoke.json --require-request-stages
+	python -m spfft_tpu.obs prom build/trace_smoke.prom
+	@echo "TRACE-SMOKE GREEN"
 
 all: native capi
 

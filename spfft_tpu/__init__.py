@@ -22,7 +22,7 @@ from .parallel import (DistributedIndexPlan, DistributedTransformPlan,
                        build_distributed_plan_multihost,
                        initialize_multihost, make_distributed_plan,
                        make_mesh, plan_fingerprint, validate_consistent)
-from . import timing
+from . import obs, timing
 from .grid import Grid, Transform
 from .multi import multi_transform_backward, multi_transform_forward
 from .plan import TransformPlan, make_local_plan, predicted_rel_error
@@ -50,4 +50,5 @@ __all__ = [
     "plan_fingerprint", "validate_consistent",
     "Grid", "Transform",
     "multi_transform_backward", "multi_transform_forward",
+    "timing", "obs",
 ]

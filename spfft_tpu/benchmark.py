@@ -89,6 +89,12 @@ def _parse_args(argv):
                         "(multi-chip simulation, like the test conftest)")
     p.add_argument("--precision", choices=["single", "double"],
                    default="single")
+    p.add_argument("--profile-dir", default=None, metavar="DIR",
+                   help="capture a jax.profiler trace of the measured "
+                        "window into DIR — the pipeline's "
+                        "jax.named_scope phase names (decompress/z/"
+                        "exchange/xy) become visible in the device "
+                        "profile (open with TensorBoard/XProf)")
     args = p.parse_args(argv)
     if args.fused_pair and args.num_transforms != 1:
         p.error("--fused-pair requires -m 1")
@@ -307,6 +313,14 @@ def main(argv=None) -> int:
     if args.warmups:
         sync(last)
 
+    profiling = False
+    if args.profile_dir:
+        try:
+            jax.profiler.start_trace(args.profile_dir)
+            profiling = True
+        except Exception as exc:
+            print(f"warning: jax.profiler capture unavailable: {exc}",
+                  file=sys.stderr)
     timing.enable()
     timing.GlobalTimer.reset()
     t0 = time.perf_counter()
@@ -317,6 +331,14 @@ def main(argv=None) -> int:
     sync(outs)
     total = time.perf_counter() - t0
     timing.disable()
+    if profiling:
+        try:
+            jax.profiler.stop_trace()
+            print(f"wrote jax.profiler trace to {args.profile_dir}",
+                  file=sys.stderr)
+        except Exception as exc:
+            print(f"warning: jax.profiler stop failed: {exc}",
+                  file=sys.stderr)
 
     pair_s = total / args.repeats
     result = timing.GlobalTimer.process()

@@ -1,0 +1,188 @@
+"""spfft_tpu.obs — unified observability: tracing, counters, exporters.
+
+The reproduction previously had three disjoint telemetry islands (the
+``timing.py`` scope timer, ``serve.metrics`` counters, hand-rolled
+bench JSON). This package unifies them behind one process-global
+tracer + counter registry and two exporters, so a request can be
+followed end-to-end (submit → queue-wait → bucket-formation → stage →
+dispatch → device-execute → materialise → resolve) and a fleet scraper
+or a human in Perfetto can consume the numbers without reading our
+code.
+
+* :mod:`~spfft_tpu.obs.trace` — :class:`Tracer` / :class:`Span` /
+  :class:`RequestTrace`; off by default (``SPFFT_TPU_TRACE=1`` or
+  :func:`enable`), sampled via ``SPFFT_TPU_TRACE_SAMPLE``, bounded
+  ring buffer, zero-unclosed-spans lifecycle contract.
+* :mod:`~spfft_tpu.obs.counters` — labelled counter/gauge registry
+  (always on; a dict update per record).
+* :mod:`~spfft_tpu.obs.exporters` — :func:`export_trace` (Chrome
+  trace-event JSON for Perfetto / chrome://tracing) and
+  :func:`prometheus_text` (text exposition over ServeMetrics,
+  PlanRegistry, timing.GlobalTimer and the obs counters), plus the
+  validating :func:`parse_prometheus_text`.
+* ``python -m spfft_tpu.obs`` — CLI: ``demo`` records a small traced
+  serving run and writes both artifacts; ``validate`` structurally
+  checks a trace JSON; ``prom`` prints/validates exposition text.
+
+The recorder helpers below are the integration seams the rest of the
+codebase calls (plan builds, registry builds, prewarms, distributed
+exchange accounting, HLO collective counts). Counter recording is
+always on; span recording only when tracing is enabled.
+
+See docs/observability.md for the span taxonomy, exporter formats,
+sampling knob and measured overhead.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from .counters import GLOBAL_COUNTERS, Counters
+from .exporters import (export_trace, parse_prometheus_text,
+                        prometheus_text, trace_events)
+from .trace import (GLOBAL_TRACER, RequestTrace, Span, Tracer, active,
+                    disable, enable)
+
+__all__ = [
+    "Tracer", "Span", "RequestTrace", "GLOBAL_TRACER",
+    "Counters", "GLOBAL_COUNTERS",
+    "active", "enable", "disable",
+    "export_trace", "trace_events", "prometheus_text",
+    "parse_prometheus_text",
+    "record_compile", "record_plan_build", "record_exchange_plan",
+    "record_hlo_counts",
+]
+
+
+def record_compile(what: str, seconds: float, t0: Optional[float] = None,
+                   **info) -> None:
+    """One compile-ish event (registry build, prewarm, pin prewarm,
+    batch-ladder compile): counters always, a ``compile`` track span
+    when tracing is on. ``t0`` is the ``time.perf_counter()`` start
+    when the caller measured a real interval; omitted, the span is
+    recorded at now-minus-``seconds``."""
+    GLOBAL_COUNTERS.inc("spfft_compile_events_total", 1,
+                        help="Compile-path events by kind.", kind=what)
+    GLOBAL_COUNTERS.inc("spfft_compile_seconds_total", seconds,
+                        help="Compile-path seconds by kind.", kind=what)
+    if active():
+        t1 = (t0 + seconds) if t0 is not None else time.perf_counter()
+        args = {k: v for k, v in info.items()
+                if isinstance(v, (str, int, float, bool))}
+        GLOBAL_TRACER.complete(f"compile.{what}", t1 - seconds, t1,
+                               cat="compile", track="compile",
+                               args=args or None)
+
+
+def record_plan_build(plan, seconds: float,
+                      t0: Optional[float] = None) -> None:
+    """Called by ``TransformPlan.__init__`` (kind=local) and the
+    distributed plan (kind=distributed) with the measured construction
+    time."""
+    kind = ("distributed" if hasattr(plan, "dist_plan") else "local")
+    GLOBAL_COUNTERS.inc("spfft_plan_builds_total", 1,
+                        help="Transform plans constructed.", kind=kind)
+    GLOBAL_COUNTERS.inc("spfft_plan_build_seconds_total", seconds,
+                        help="Seconds spent constructing plans.",
+                        kind=kind)
+    if active():
+        t1 = (t0 + seconds) if t0 is not None else time.perf_counter()
+        try:
+            args = {"kind": kind, "precision": plan.precision,
+                    "dims": f"{plan.dim_x}x{plan.dim_y}x{plan.dim_z}"}
+        except Exception:
+            args = {"kind": kind}
+        GLOBAL_TRACER.complete("compile.plan_build", t1 - seconds, t1,
+                               cat="compile", track="compile", args=args)
+
+
+def record_exchange_plan(plan, seconds: float,
+                         t0: Optional[float] = None) -> None:
+    """Surface a ``DistributedTransformPlan``'s exact exchange
+    accounting — total/busiest-link wire bytes and, when the overlap
+    pipeline is active, the per-chunk split from ``OverlapSchedule`` —
+    as counters plus (when tracing) an ``exchange`` track span and a
+    per-chunk counter series. Called at plan construction; distributed
+    rounds stop hand-rolling these numbers into bench JSON."""
+    labels = {"exchange": plan.exchange.value,
+              "shards": str(plan.dist_plan.num_shards),
+              "chunks": str(plan.overlap_chunks)}
+    wire = int(plan.exchange_wire_bytes())
+    busiest = int(plan.exchange_busiest_link_bytes())
+    GLOBAL_COUNTERS.inc("spfft_exchange_plans_total", 1,
+                        help="Distributed plans constructed.", **labels)
+    GLOBAL_COUNTERS.set("spfft_exchange_wire_bytes", wire,
+                        help="Exact off-shard bytes per exchange of the "
+                             "most recent plan.", **labels)
+    GLOBAL_COUNTERS.set("spfft_exchange_busiest_link_bytes", busiest,
+                        help="Bottleneck-link bytes per exchange of the "
+                             "most recent plan.", **labels)
+    if not active():
+        return
+    ov = getattr(plan, "_overlap", None)
+    per_chunk = []
+    if ov is not None:
+        elem = plan._wire_elem_bytes()
+        for c in range(ov.num_chunks):
+            per_chunk.append({
+                "bwd_bytes": ov.chunk_wire_elements(c) * elem,
+                "fwd_bytes": ov.chunk_wire_elements(c, forward=True)
+                * elem,
+                "busiest_link_bytes":
+                    ov.chunk_busiest_link_elements(c) * elem,
+            })
+            GLOBAL_TRACER.counter(
+                "exchange.chunk_wire_bytes",
+                {"bwd": per_chunk[-1]["bwd_bytes"],
+                 "fwd": per_chunk[-1]["fwd_bytes"]},
+                cat="exchange", track="exchange")
+    t1 = (t0 + seconds) if t0 is not None else time.perf_counter()
+    args = dict(labels)
+    args.update({"wire_bytes": wire, "busiest_link_bytes": busiest})
+    if per_chunk:
+        args["per_chunk"] = per_chunk
+    GLOBAL_TRACER.complete("exchange.plan_build", t1 - seconds, t1,
+                           cat="exchange", track="exchange", args=args)
+
+
+def record_hlo_counts(label: str, lowered_text: Optional[str] = None,
+                      compiled_text: Optional[str] = None) -> dict:
+    """Surface ``utils.hlo_inspect`` collective counts (lowered
+    StableHLO) and async start/done split evidence (compiled HLO) as
+    metrics + an instant event. Returns the recorded dict."""
+    from ..utils.hlo_inspect import collective_async_split, \
+        count_collectives
+    out: dict = {"label": label}
+    if lowered_text is not None:
+        counts = count_collectives(lowered_text)
+        out["collectives"] = counts
+        for op, n in counts.items():
+            if n:
+                GLOBAL_COUNTERS.set(
+                    "spfft_hlo_collectives", n,
+                    help="Collective launches in the most recently "
+                         "inspected lowered module.",
+                    label=label, op=op)
+    if compiled_text is not None:
+        split = collective_async_split(compiled_text)
+        out["async_split"] = split
+        GLOBAL_COUNTERS.set("spfft_hlo_async_starts", split["starts"],
+                            help="Async collective starts in the most "
+                                 "recently inspected compiled module.",
+                            label=label)
+        GLOBAL_COUNTERS.set("spfft_hlo_async_dones", split["dones"],
+                            help="Async collective dones in the most "
+                                 "recently inspected compiled module.",
+                            label=label)
+    if active():
+        args = {"label": label}
+        if "collectives" in out:
+            args.update({f"collectives_{k}": v
+                         for k, v in out["collectives"].items() if v})
+        if "async_split" in out:
+            args["async_starts"] = out["async_split"]["starts"]
+            args["async_dones"] = out["async_split"]["dones"]
+        GLOBAL_TRACER.instant("exchange.hlo_counts", cat="exchange",
+                              track="exchange", args=args)
+    return out

@@ -1,0 +1,94 @@
+"""Labelled counter/gauge registry for the Prometheus export.
+
+The compile and exchange observability the ISSUE names (plan-build and
+jit-compile durations, per-plan wire/busiest-link bytes, HLO collective
+counts) are process-wide facts, not per-executor ones — they need a
+sink that exists before any server object does and that costs ~a dict
+update when tracing is off. This is that sink: metric names follow the
+Prometheus data model (``spfft_*``, ``_total`` suffix on counters), the
+exporter (:func:`spfft_tpu.obs.exporters.prometheus_text`) renders it
+verbatim, and everything else in the process (plan.py, registry,
+executor, dist.py) records into the one :data:`GLOBAL_COUNTERS`.
+
+Counters only go up (``inc``); gauges hold the last written value
+(``set``). Labels are passed as kwargs and become Prometheus labels.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from typing import Dict, Optional, Tuple
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+
+class Counters:
+    """Thread-safe registry of named counter/gauge families."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # name -> {"type": "counter"|"gauge", "help": str,
+        #          "samples": {(("k","v"), ...): float}}
+        self._metrics: Dict[str, dict] = {}
+
+    def _family(self, name: str, mtype: str, help_: Optional[str]):
+        if not _NAME_RE.match(name):
+            raise ValueError(f"bad metric name {name!r}")
+        fam = self._metrics.get(name)
+        if fam is None:
+            fam = self._metrics[name] = {
+                "type": mtype, "help": help_ or name, "samples": {}}
+        elif fam["type"] != mtype:
+            raise ValueError(
+                f"metric {name!r} already registered as {fam['type']}")
+        return fam
+
+    @staticmethod
+    def _key(labels: dict) -> Tuple:
+        for k in labels:
+            if not _LABEL_RE.match(k):
+                raise ValueError(f"bad label name {k!r}")
+        return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+    def inc(self, name: str, value: float = 1.0,
+            help: Optional[str] = None, **labels) -> None:
+        """Add ``value`` (>= 0) to counter ``name``."""
+        key = self._key(labels)
+        with self._lock:
+            fam = self._family(name, "counter", help)
+            fam["samples"][key] = fam["samples"].get(key, 0.0) \
+                + float(value)
+
+    def set(self, name: str, value: float,
+            help: Optional[str] = None, **labels) -> None:
+        """Set gauge ``name`` to ``value`` (last write wins)."""
+        key = self._key(labels)
+        with self._lock:
+            fam = self._family(name, "gauge", help)
+            fam["samples"][key] = float(value)
+
+    def get(self, name: str, **labels) -> float:
+        key = self._key(labels)
+        with self._lock:
+            fam = self._metrics.get(name)
+            if fam is None:
+                return 0.0
+            return fam["samples"].get(key, 0.0)
+
+    def snapshot(self) -> Dict[str, dict]:
+        """Deep-enough copy for the exporter: {name: {type, help,
+        samples: {labels_tuple: value}}}."""
+        with self._lock:
+            return {name: {"type": fam["type"], "help": fam["help"],
+                           "samples": dict(fam["samples"])}
+                    for name, fam in self._metrics.items()}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+
+
+#: Process-global registry (the default sink for every recorder).
+GLOBAL_COUNTERS = Counters()

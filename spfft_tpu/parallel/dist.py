@@ -38,6 +38,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import logging
+import time
 from typing import Optional, Sequence
 
 import jax
@@ -176,6 +177,7 @@ class DistributedTransformPlan:
                  overlap_chunks: Optional[int] = None):
         from ..utils.platform import enable_persistent_compilation_cache
         enable_persistent_compilation_cache()
+        _t0_build = time.perf_counter()
         self.dist_plan = dist_plan
         self.precision = precision
         self.exchange = ExchangeType(exchange)
@@ -368,6 +370,14 @@ class DistributedTransformPlan:
                                                scaled=(s == Scaling.FULL))))
             for s in (Scaling.NONE, Scaling.FULL)
         }
+        # exchange observability (spfft_tpu.obs): plan-build span plus
+        # the exact wire/busiest-link byte accounting — per chunk when
+        # the overlap pipeline is active — surfaced as metrics so
+        # distributed rounds stop hand-rolling them into bench JSON
+        from .. import obs as _obs
+        _dt = time.perf_counter() - _t0_build
+        _obs.record_plan_build(self, _dt, _t0_build)
+        _obs.record_exchange_plan(self, _dt, _t0_build)
 
     # -- static tables -------------------------------------------------------
     def _init_split_x(self) -> None:

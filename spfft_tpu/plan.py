@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import functools
 import logging
+import time as _time
 from typing import Optional
 
 import jax
@@ -117,6 +118,7 @@ class TransformPlan:
                  device_double: Optional[bool] = None):
         from .utils.platform import enable_persistent_compilation_cache
         enable_persistent_compilation_cache()
+        _t0_build = _time.perf_counter()
         #: When True, the fused round-trip executables (apply_pointwise /
         #: iterate_pointwise) DONATE their values argument: the output has
         #: the same shape, so XLA aliases the input buffer into it, cutting
@@ -290,6 +292,13 @@ class TransformPlan:
             self._build_thread = threading.Thread(
                 target=self._build_compression_tables, daemon=True)
             self._build_thread.start()
+        # plan-build observability (spfft_tpu.obs): counters always, a
+        # compile-track span when tracing is on. The background
+        # compression-table build is NOT included — it overlaps the
+        # caller's next work by design and reports via its own scope.
+        from . import obs as _obs
+        _obs.record_plan_build(self, _time.perf_counter() - _t0_build,
+                               _t0_build)
 
     def _decide_pallas(self, use_pallas: Optional[bool]) -> bool:
         """Decide (cheaply, at construction) whether the Pallas
@@ -344,6 +353,7 @@ class TransformPlan:
         details.rst 'Data Distribution') is optimal, and a too-scattered
         order falls back to the XLA gather with a logged notice."""
         from .ops import gather_kernel as gk
+        _t0_tables = _time.perf_counter()
         try:
             p = self.index_plan
             use_pallas = self._use_pallas_req
@@ -380,6 +390,13 @@ class TransformPlan:
             self._pallas_active_flag = self._backend_ok
         except BaseException as exc:  # re-raised by _finalize
             self._build_exc = exc
+        finally:
+            from . import obs as _obs
+            _obs.record_compile(
+                "compression_tables",
+                _time.perf_counter() - _t0_tables, _t0_tables,
+                num_values=int(self.index_plan.num_values),
+                failed=self._build_exc is not None)
 
     def _commit_fallback(self, which: str) -> None:
         """Commit the XLA-gather fallback table for one compression

@@ -40,6 +40,14 @@ from three cooperating pieces:
   (``ExecutorCrashedError``; health states via
   ``ServeMetrics.health()``). See docs/serving.md "Failure semantics".
 
+End-to-end request observability lives in :mod:`spfft_tpu.obs`: when
+tracing is enabled (``SPFFT_TPU_TRACE=1`` / ``obs.enable()``), every
+sampled ``submit`` records spans for the full pipeline (submit →
+queue-wait → bucket-formation → stage → dispatch → device-execute →
+materialise → resolve) with retry/fallback/quarantine annotations,
+exportable as Chrome trace JSON (Perfetto) and Prometheus text —
+see docs/observability.md.
+
 ``python -m spfft_tpu.serve.bench`` replays a mixed-signature request
 trace and reports p50/p95/p99 latency (per priority class with
 ``--high-fraction``) and throughput against a serial-loop baseline;

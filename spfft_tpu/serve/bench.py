@@ -37,6 +37,16 @@ Two extra modes exercise the adaptive dispatch path:
   resolves EVERY pending future with a typed error — zero hangs. Exit
   code 1 on any violation.
 
+Observability (round 10): ``--trace-out FILE`` enables
+``spfft_tpu.obs`` request tracing for the measured replay (or the
+smoke waves) and exports the Chrome trace-event JSON — in the smoke
+modes the trace is also VALIDATED (all eight request stages plus
+compile and exchange events present, zero unclosed spans) and any
+violation exits 1; ``--prom-out FILE`` writes the Prometheus text
+exposition (round-tripped through the validating parser first);
+``--profile-dir DIR`` captures a ``jax.profiler`` session around the
+measured window. See docs/observability.md.
+
 The workload reuses the benchmark CLI's dense-within-cutoff stick
 generator (``spfft_tpu.benchmark.cutoff_stick_triplets``, reference:
 tests/programs/benchmark.cpp:176-205) at several sparsities, so the
@@ -122,8 +132,70 @@ def _parse_args(argv):
     p.add_argument("--fault-scope", default=None,
                    help="restrict --fault-rate faults to one site "
                         "(stage|dispatch|materialise) or 'device:N'")
+    p.add_argument("--trace-out", default=None, metavar="TRACE.json",
+                   help="enable spfft_tpu.obs request tracing and write "
+                        "the Chrome trace-event JSON here (open in "
+                        "Perfetto / chrome://tracing); in the smoke "
+                        "modes the trace is also validated (eight "
+                        "request stages + compile/exchange events, "
+                        "zero unclosed spans) — violations exit 1")
+    p.add_argument("--prom-out", default=None, metavar="FILE.prom",
+                   help="write obs.prometheus_text() (serving metrics + "
+                        "registry + timing + obs counters) here; the "
+                        "text is round-tripped through the exposition "
+                        "parser first")
+    p.add_argument("--profile-dir", default=None, metavar="DIR",
+                   help="capture a jax.profiler trace of the measured "
+                        "replay into DIR (the jax.named_scope phase "
+                        "names become visible in the device profile)")
     p.add_argument("-o", "--output", default=None, metavar="FILE.json")
     return p.parse_args(argv)
+
+
+def _finish_obs(args, failures, metrics=None, registry=None,
+                require_stages=False):
+    """Shared --trace-out/--prom-out epilogue: export the trace (and
+    structurally validate it in the smoke modes), check for unclosed
+    spans, and write/validate the Prometheus text. Appends failure
+    strings to ``failures``; returns an obs-summary dict for the JSON
+    payload (None when obs was not requested)."""
+    if not (args.trace_out or args.prom_out):
+        return None
+    from .. import obs
+    summary = {}
+    open_spans = obs.GLOBAL_TRACER.open_count()
+    if open_spans:
+        failures.append(
+            f"{open_spans} unclosed spans after quiescence: "
+            f"{obs.GLOBAL_TRACER.open_names()[:10]}")
+    summary["open_spans"] = open_spans
+    if args.trace_out:
+        payload = obs.export_trace(args.trace_out)
+        summary["trace_out"] = args.trace_out
+        summary["trace_events"] = len(payload["traceEvents"])
+        if require_stages:
+            from ..obs.__main__ import (REQUEST_STAGES,
+                                        validate_trace_payload)
+            require = REQUEST_STAGES + ("compile.registry_build",)
+            import jax
+            if len(jax.devices()) >= 2:
+                require = require + ("exchange.plan_build",)
+            failures.extend(validate_trace_payload(
+                payload, require_names=require))
+        print(f"wrote {args.trace_out} "
+              f"({summary['trace_events']} events)")
+    if args.prom_out:
+        text = obs.prometheus_text(metrics=metrics, registry=registry)
+        try:
+            series = obs.parse_prometheus_text(text)
+            summary["prom_series"] = len(series)
+        except ValueError as exc:
+            failures.append(f"prometheus text failed to parse: {exc}")
+        with open(args.prom_out, "w") as f:
+            f.write(text)
+        summary["prom_out"] = args.prom_out
+        print(f"wrote {args.prom_out}")
+    return summary
 
 
 def _block(result) -> None:
@@ -143,6 +215,11 @@ def _run_smoke(args) -> int:
     from ..types import TransformType
     from .executor import DEFAULT_PIN_AFTER, ServeExecutor
     from .registry import PlanRegistry
+
+    if args.trace_out or args.prom_out:
+        from .. import obs
+        obs.enable()
+        obs.GLOBAL_TRACER.reset()
 
     n, WAVE, WAVES = 12, 5, 6
     pin_after = (args.pin_after if args.pin_after is not None
@@ -183,6 +260,31 @@ def _run_smoke(args) -> int:
             failures.append(
                 f"stable-size trace still pads after pinning: "
                 f"last wave added {pad_rows_per_wave[-1]} pad rows")
+    if args.trace_out or args.prom_out:
+        # exchange observability rides the smoke when a >= 2 device
+        # mesh exists: a tiny chunked distributed plan records its
+        # exact per-chunk wire accounting + HLO collective counts
+        import jax
+        if len(jax.devices()) >= 2:
+            from .. import obs
+            from ..parallel import make_distributed_plan, make_mesh
+            from ..utils.workloads import (even_plane_split,
+                                           round_robin_stick_partition)
+            parts = round_robin_stick_partition(triplets, (n, n, n), 2)
+            planes = even_plane_split(n, 2)
+            dplan = make_distributed_plan(
+                TransformType.C2C, n, n, n, parts, planes,
+                mesh=make_mesh(2), precision=args.precision,
+                overlap_chunks=2)
+            dv = dplan.shard_values(
+                [np.zeros(len(p),
+                          np.complex64 if args.precision == "single"
+                          else np.complex128) for p in parts])
+            lowered = dplan._backward_jit.lower(dv,
+                                                *dplan._device_tables)
+            obs.record_hlo_counts("serve-smoke", lowered.as_text())
+    obs_summary = _finish_obs(args, failures, metrics=ex.metrics,
+                              registry=registry, require_stages=True)
     ok = not failures
     print(f"smoke: {WAVES} waves x {WAVE} requests, dim={n}^3, "
           f"pin_after={pin_after}")
@@ -202,6 +304,7 @@ def _run_smoke(args) -> int:
         "padded_rows_total": snap["padded_rows"],
         "padded_rows_per_wave": pad_rows_per_wave,
         "failures": failures,
+        "obs": obs_summary,
     }
     print(json.dumps(result))
     if args.output:
@@ -240,6 +343,11 @@ def _run_fault_smoke(args) -> int:
     from .executor import ServeExecutor
     from .faults import FaultPlan
     from .registry import PlanRegistry
+
+    if args.trace_out or args.prom_out:
+        from .. import obs
+        obs.enable()
+        obs.GLOBAL_TRACER.reset()
 
     n = 12
     triplets = cutoff_stick_triplets(n, n, n, 0.9, hermitian=False)
@@ -395,6 +503,12 @@ def _run_fault_smoke(args) -> int:
     ex.close()
     phases["6_crash_restart_recovers"] = h
 
+    # the acceptance observable: EVERY span opened across all six
+    # failure phases (poisoned buckets, injected faults, quarantines,
+    # supervised crashes) closed — with error status on the failure
+    # paths — before the executors quiesced
+    obs_summary = _finish_obs(args, failures, metrics=ex.metrics,
+                              registry=registry)
     ok = not failures
     print(f"fault smoke: dim={n}^3 precision={args.precision} "
           f"devices={len(pool)}")
@@ -411,6 +525,7 @@ def _run_fault_smoke(args) -> int:
         "ok": ok,
         "failures": failures,
         "phases": {k: v for k, v in phases.items()},
+        "obs": obs_summary,
     }
     print(json.dumps(result, default=str))
     if args.output:
@@ -557,6 +672,23 @@ def main(argv=None) -> int:
                   for _ in range(max_batch)]:
             f.result()
     metrics.reset()
+    if args.trace_out or args.prom_out:
+        # trace the MEASURED replay only (the warm phase's spans would
+        # drown it); enabling after warmup also keeps the baseline and
+        # warm loop untraced, so the A/B stays clean
+        from .. import obs
+        obs.enable()
+        obs.GLOBAL_TRACER.reset()
+    profiling = False
+    if args.profile_dir:
+        # jax.named_scope phase names (z/exchange/xy) become visible in
+        # the captured device profile (open with TensorBoard/XProf)
+        try:
+            jax.profiler.start_trace(args.profile_dir)
+            profiling = True
+        except Exception as exc:
+            print(f"warning: jax.profiler capture unavailable: {exc}",
+                  file=sys.stderr)
     # Fault injection arms AFTER the warm phase: the measured replay
     # degrades, the baseline and warmup stay clean — that's the A/B the
     # acceptance criterion wants (graceful degradation vs collapse).
@@ -596,8 +728,23 @@ def main(argv=None) -> int:
             failed_requests += 1
     served_s = time.perf_counter() - t0
     executor.close()
+    if profiling:
+        try:
+            jax.profiler.stop_trace()
+            print(f"wrote jax.profiler trace to {args.profile_dir}")
+        except Exception as exc:
+            print(f"warning: jax.profiler stop failed: {exc}",
+                  file=sys.stderr)
 
-    snap = metrics.snapshot(registry)
+    obs_failures = []
+    obs_summary = _finish_obs(args, obs_failures, metrics=metrics,
+                              registry=registry)
+    for msg in obs_failures:
+        print(f"warning: obs: {msg}", file=sys.stderr)
+
+    # the ONE consistent snapshot (ServeMetrics.to_json) — also what
+    # obs.prometheus_text renders; bench no longer hand-builds its own
+    snap = json.loads(metrics.to_json(registry))
     lat = snap["latency_seconds"]
     by_class = snap["latency_seconds_by_class"]
     overhead = snap["overhead_seconds"]
@@ -687,6 +834,8 @@ def main(argv=None) -> int:
         "failed_requests": failed_requests,
         "faults": (fault_plan.stats() if fault_plan is not None
                    else None),
+        "obs": obs_summary,
+        "obs_failures": obs_failures,
         "serve_metrics": snap,
         "platform": platform_summary(),
     }

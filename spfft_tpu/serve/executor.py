@@ -84,6 +84,17 @@ pipeline the oracle does, so a retried request's result is bit-identical
 to its serial execution. Verified by the tier-1 concurrency fuzzes
 (tests/test_serve_executor.py, tests/test_serve_faults.py).
 
+Observability (``spfft_tpu.obs``, round 10): when tracing is enabled,
+every sampled request carries a ``RequestTrace`` — spans for all eight
+pipeline stages (submit / queue-wait / bucket-formation / stage /
+dispatch / device-execute / materialise / resolve) on per-lane and
+per-device tracks, retry/fallback/quarantine annotations, and a
+zero-unclosed-spans guarantee: every resolution path (success, typed
+failure, crash sweep, deadline expiry, close) settles the request's
+whole trace, error-typed on failure. The disabled path is one
+module-global boolean read per checkpoint (measured ≤ noise,
+BENCHMARKS.md "Round-10").
+
 Flow control is explicit and bounded: a fixed-capacity queue whose
 overflow REJECTS with ``QueueFullError`` (after reaping already-expired
 deadlined requests, so a queue full of dead work never rejects live
@@ -106,6 +117,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from .. import obs as _obs
 from ..errors import (DeadlineExpiredError, DistributedPlanUnsupportedError,
                       ExecutorCrashedError, InvalidParameterError,
                       NoHealthyDeviceError, QueueFullError,
@@ -179,7 +191,7 @@ DEFAULT_RETRY_BUDGET = {"normal": 1, "high": 2}
 
 class _Request:
     __slots__ = ("key", "plan", "kind", "values", "scaling", "deadline",
-                 "priority", "seq", "future", "enqueued_at")
+                 "priority", "seq", "future", "enqueued_at", "trace")
 
     def __init__(self, key, plan, kind, values, scaling, deadline,
                  priority, seq):
@@ -193,6 +205,53 @@ class _Request:
         self.seq = seq
         self.future: Future = Future()
         self.enqueued_at = time.monotonic()
+        #: obs.RequestTrace when tracing is on AND this request was
+        #: sampled; None otherwise (the disabled-path cost is this
+        #: attribute staying None).
+        self.trace = None
+
+
+def _dev_track(slot) -> str:
+    """Trace track name for a pool slot (one track per pool device)."""
+    return f"device:{slot.index}" if slot is not None else "device:0"
+
+
+class _BucketTrace:
+    """Span bookkeeping for one dispatched bucket. Bucket-level stages
+    (formation/stage/dispatch/device-execute/materialise) are recorded
+    ONCE per bucket — parented under the first traced member's request
+    root, carrying every member's trace id in ``member_trace_ids`` — so
+    an 8-row fused bucket costs 5 spans, not 40. ``end_all`` closes
+    whatever is still open with an error status; every failure path in
+    the executor calls it BEFORE resolving member futures, so bucket
+    spans always nest inside their parent request span."""
+
+    __slots__ = ("tracer", "trace_id", "parent", "ids", "open")
+
+    def __init__(self, tracer, traced):
+        first = traced[0].trace
+        self.tracer = tracer
+        self.trace_id = first.trace_id
+        self.parent = first.root
+        self.ids = [r.trace.trace_id for r in traced]
+        self.open = {}
+
+    def begin(self, name, track=None, args=None):
+        a = {"member_trace_ids": list(self.ids)}
+        if args:
+            a.update(args)
+        self.open[name] = self.tracer.begin(
+            name, trace_id=self.trace_id, parent=self.parent,
+            track=track, args=a)
+
+    def end(self, name, status="ok", error=None):
+        sp = self.open.pop(name, None)
+        if sp is not None:
+            self.tracer.finish(sp, status=status, error=error)
+
+    def end_all(self, status="ok", error=None):
+        for name in list(self.open):
+            self.end(name, status, error)
 
 
 class _Shard:
@@ -473,6 +532,10 @@ class ServeExecutor:
                                              failed=True,
                                              priority=req.priority)
             req.future.set_exception(exc)
+            if req.trace is not None:
+                # failure paths settle the WHOLE trace: any open stage
+                # span and the request root close with error status
+                req.trace.close("error", type(exc).__name__)
 
     def _fail_all_pending(self, exc: BaseException) -> None:
         """Pop EVERYTHING still queued and fail it with ``exc`` — the
@@ -537,32 +600,52 @@ class ServeExecutor:
         key = (signature, kind, scaling)
         req = _Request(key, plan, kind, values, scaling, deadline,
                        priority, next(self._seq))
+        # request tracing: off -> one boolean read; on -> the sampled
+        # fraction of requests get a RequestTrace whose queue_wait span
+        # MUST begin before the request becomes visible to the
+        # dispatcher (which finishes it when the request is popped)
+        rt = None
+        if _obs.active() and _obs.GLOBAL_TRACER.sample():
+            rt = _obs.RequestTrace(
+                _obs.GLOBAL_TRACER, priority,
+                args={"kind": kind, "scaling": scaling.value})
+            rt.begin("serve.submit")
+            req.trace = rt
         entry = (deadline if deadline is not None else math.inf,
                  req.seq, req)
         purged: List[_Request] = []
-        with self._cv:
-            if self._closed:
-                raise ServeError("executor is closed")
-            if self._failed:
-                raise ServeError(
-                    "executor dispatch loop has failed (crashed past "
-                    "its restart budget)")
-            if self._pending >= self._max_queue:
-                purged = self._purge_expired_locked(time.monotonic())
-            if self._pending >= self._max_queue:
-                full = True
-            else:
-                full = False
-                shard = self._shards.get(key)
-                if shard is None:
-                    shard = self._shards[key] = _Shard(key, plan)
-                lane = shard.high if priority == "high" else shard.normal
-                heapq.heappush(lane, entry)
-                self._pending += 1
-                if priority == "high":
-                    self._high_pending += 1
-                depth = self._pending
-                self._cv.notify_all()
+        if rt is not None:
+            rt.finish("serve.submit")
+            rt.begin("serve.queue_wait")
+        try:
+            with self._cv:
+                if self._closed:
+                    raise ServeError("executor is closed")
+                if self._failed:
+                    raise ServeError(
+                        "executor dispatch loop has failed (crashed past "
+                        "its restart budget)")
+                if self._pending >= self._max_queue:
+                    purged = self._purge_expired_locked(time.monotonic())
+                if self._pending >= self._max_queue:
+                    full = True
+                else:
+                    full = False
+                    shard = self._shards.get(key)
+                    if shard is None:
+                        shard = self._shards[key] = _Shard(key, plan)
+                    lane = shard.high if priority == "high" \
+                        else shard.normal
+                    heapq.heappush(lane, entry)
+                    self._pending += 1
+                    if priority == "high":
+                        self._high_pending += 1
+                    depth = self._pending
+                    self._cv.notify_all()
+        except ServeError as exc:
+            if rt is not None:
+                rt.close("error", type(exc).__name__)
+            raise
         # future resolution + metric recording outside the queue lock
         for dead in purged:
             self.metrics.record_deadline_expired(purged=True)
@@ -570,8 +653,12 @@ class ServeExecutor:
                 dead.future.set_exception(DeadlineExpiredError(
                     "deadline expired in queue (reaped by the "
                     "backpressure sweep before dispatch)"))
+            if dead.trace is not None:
+                dead.trace.close("error", "DeadlineExpiredError")
         if full:
             self.metrics.record_reject_queue_full()
+            if rt is not None:
+                rt.close("error", "QueueFullError")
             raise QueueFullError(
                 f"serving queue full ({self._max_queue} requests) — "
                 f"backpressure: retry later or raise max_queue")
@@ -638,6 +725,8 @@ class ServeExecutor:
                 self._pending -= 1
                 if req.priority == "high":
                     self._high_pending -= 1
+                if req.trace is not None:
+                    req.trace.finish("serve.queue_wait")
 
     def _earliest_deadline(self) -> float:
         """The soonest deadline among ALL queued requests (inf when
@@ -708,6 +797,10 @@ class ServeExecutor:
                 return  # clean shutdown via close()
             except Exception as exc:
                 self.metrics.record_dispatcher_crash()
+                if _obs.active():
+                    _obs.GLOBAL_TRACER.instant(
+                        "serve.dispatcher_crash",
+                        args={"error": repr(exc)[:200]})
                 crash = ExecutorCrashedError(
                     f"dispatch loop crashed: {exc!r}")
                 forming, self._forming = self._forming, None
@@ -725,6 +818,9 @@ class ServeExecutor:
                         self._failed = True
                 if not give_up:
                     self.metrics.record_dispatcher_restart()
+                    if _obs.active():
+                        _obs.GLOBAL_TRACER.instant(
+                            "serve.dispatcher_restart")
                     self._push_health()
                     continue
                 self._fail_all_pending(crash)
@@ -762,6 +858,9 @@ class ServeExecutor:
                 continue
             self._forming = bucket
             self.metrics.record_dequeue(depth_now)
+            bt = self._bucket_trace(bucket)
+            if bt is not None:
+                bt.begin("serve.bucket_formation")
             # Wait out the batching window only on a TRICKLE (nothing
             # else queued after the take): under backlog the queued
             # requests are already late and a window wait just adds
@@ -771,7 +870,12 @@ class ServeExecutor:
                     and self._batching and self._batch_window > 0 \
                     and not self._closed:
                 self._fill_bucket(shard, bucket)
-            work = self._execute(shard, bucket)
+            try:
+                work = self._execute(shard, bucket, bt)
+            except BaseException:
+                if bt is not None:
+                    bt.end_all("error", "ExecutorCrashedError")
+                raise
             if work is not None:
                 inflight.append(work)
             self._forming = None
@@ -792,7 +896,10 @@ class ServeExecutor:
                 self._pop_into(shard, bucket, self._max_batch)
                 depth_now = self._pending
             self.metrics.record_dequeue(depth_now)
-            work = self._execute(shard, bucket)
+            bt = self._bucket_trace(bucket)
+            if bt is not None:
+                bt.begin("serve.bucket_formation")
+            work = self._execute(shard, bucket, bt)
             if work is not None:
                 self._finish(*work)
 
@@ -821,6 +928,10 @@ class ServeExecutor:
                 # already outstanding: skip
         if probed is not None:
             self.metrics.record_probation()
+            if _obs.active():
+                _obs.GLOBAL_TRACER.instant(
+                    "serve.probation", track=_dev_track(probed),
+                    args={"backoff_s": probed.backoff})
             return probed
         raise NoHealthyDeviceError(
             f"all {len(self._slots)} pool devices are quarantined and "
@@ -840,6 +951,9 @@ class ServeExecutor:
                 readmitted = True
         if readmitted:
             self.metrics.record_readmission()
+            if _obs.active():
+                _obs.GLOBAL_TRACER.instant("serve.readmission",
+                                           track=_dev_track(slot))
             self._push_health()
 
     def _device_fail(self, slot: Optional[_DeviceSlot]) -> None:
@@ -863,6 +977,10 @@ class ServeExecutor:
                 slot.failures = 0
         if quarantined:
             self.metrics.record_quarantine()
+            if _obs.active():
+                _obs.GLOBAL_TRACER.instant(
+                    "serve.quarantine", track=_dev_track(slot),
+                    args={"backoff_s": slot.backoff})
             self._push_health()
 
     # -- execution ---------------------------------------------------------
@@ -884,6 +1002,7 @@ class ServeExecutor:
             raise InvalidParameterError(
                 f"signature not in registry: {signature}")
         import jax
+        t_warm = time.perf_counter()
         nv = plan.index_plan.num_values
         zeros = (np.zeros((nv, 2), np.float32)
                  if plan.precision == "single"
@@ -903,6 +1022,13 @@ class ServeExecutor:
                     out.append(plan.forward_batched(
                         [space] * size, scaling, device=device))
             jax.block_until_ready(out)
+        # compile observability: the batch-ladder compiles happen here
+        # on a warm server (first-dispatch compiles happen inside the
+        # serve.dispatch span otherwise)
+        _obs.record_compile("prewarm", time.perf_counter() - t_warm,
+                            t_warm, ladder=len(ladder),
+                            devices=len(self._devices),
+                            num_values=nv)
 
     def _padded_size(self, b: int) -> int:
         """The fallback batch ladder (``multi.planned_batch_size``):
@@ -932,6 +1058,7 @@ class ServeExecutor:
         def compile_shape():
             try:
                 import jax
+                t_pin = time.perf_counter()
                 zeros = np.zeros((b,) + row_shape, dtype)
                 for device in devices:
                     if kind == "backward":
@@ -941,6 +1068,9 @@ class ServeExecutor:
                                                    device=device)
                     jax.block_until_ready(out)
                 metrics.record_pin_prewarm()
+                _obs.record_compile("pin_prewarm",
+                                    time.perf_counter() - t_pin, t_pin,
+                                    batch=b, kind=kind)
             except Exception:
                 pass
 
@@ -1067,7 +1197,23 @@ class ServeExecutor:
         done = time.monotonic()
         self.metrics.record_request_done(done - req.enqueued_at,
                                          priority=req.priority)
+        rt = req.trace
+        if rt is not None:
+            rt.begin("serve.resolve")
         req.future.set_result(res)
+        if rt is not None:
+            rt.finish("serve.resolve")
+            rt.close()
+
+    def _annotate_fallback(self, live, cause: BaseException) -> None:
+        """Bucket-fallback annotation on every traced member (the ISSUE
+        contract: retry/fallback/quarantine events attach to spans)."""
+        if not _obs.active():
+            return
+        for req in live:
+            if req.trace is not None:
+                req.trace.annotate("serve.bucket_fallback",
+                                   error=repr(cause)[:200])
 
     def _recover_serial(self, live: List[_Request], cause: BaseException,
                         pooled: bool) -> None:
@@ -1084,6 +1230,10 @@ class ServeExecutor:
             budget = max(1, self._retry_budget[req.priority])
             for attempt in range(budget):
                 self.metrics.record_retry(req.priority)
+                if req.trace is not None:
+                    req.trace.annotate("serve.retry",
+                                       attempt=attempt + 1,
+                                       budget=budget)
                 try:
                     res = self._run_one(req, pooled)
                 except NoHealthyDeviceError as exc:
@@ -1119,6 +1269,9 @@ class ServeExecutor:
             return
         for attempt in range(budget):
             self.metrics.record_retry(req.priority)
+            if req.trace is not None:
+                req.trace.annotate("serve.retry", attempt=attempt + 1,
+                                   budget=budget)
             try:
                 res = self._run_one(req, pooled)
             except NoHealthyDeviceError as exc:
@@ -1137,12 +1290,26 @@ class ServeExecutor:
             self._resolve_one(req, res)
             return
 
-    def _execute(self, shard: _Shard, bucket: List[_Request]):
+    def _bucket_trace(self, bucket) -> Optional[_BucketTrace]:
+        """A :class:`_BucketTrace` when tracing is on and any member
+        request was sampled; None otherwise (one boolean read on the
+        disabled path)."""
+        if not _obs.active():
+            return None
+        traced = [r for r in bucket if r.trace is not None]
+        if not traced:
+            return None
+        return _BucketTrace(_obs.GLOBAL_TRACER, traced)
+
+    def _execute(self, shard: _Shard, bucket: List[_Request],
+                 bt: Optional[_BucketTrace] = None):
         """Deadline-check and DISPATCH one bucket. Returns ``(live,
-        results, shard_key, shape, buf, slots, fused)`` with results
-        possibly still executing (the dispatch loop pipelines them), or
-        ``None`` when nothing survived the deadline check or every
-        request resolved on a failure path."""
+        results, shard_key, shape, buf, slots, fused, bt)`` with
+        results possibly still executing (the dispatch loop pipelines
+        them), or ``None`` when nothing survived the deadline check or
+        every request resolved on a failure path. ``bt`` carries the
+        bucket-level trace spans; its ``serve.device_execute`` span
+        stays open across the return and closes in :meth:`_finish`."""
         now = time.monotonic()
         live: List[_Request] = []
         expired: List[_Request] = []
@@ -1154,8 +1321,14 @@ class ServeExecutor:
             req.future.set_exception(DeadlineExpiredError(
                 f"deadline expired after "
                 f"{now - req.enqueued_at:.3f}s in queue"))
+            if req.trace is not None:
+                req.trace.close("error", "DeadlineExpiredError")
         if not live:
+            if bt is not None:
+                bt.end_all()
             return None
+        if bt is not None:
+            bt.end("serve.bucket_formation")
         plan = live[0].plan
         kind = live[0].kind
         scaling = live[0].scaling
@@ -1173,6 +1346,8 @@ class ServeExecutor:
         slot: Optional[_DeviceSlot] = None
         t0 = time.perf_counter()
         if fused:
+            if bt is not None:
+                bt.begin("serve.stage", args={"batch": b, "shape": shape})
             try:
                 # Planned-batch execution (the cuFFT idiom): dispatch at
                 # the exact pinned shape when the observer has locked
@@ -1187,6 +1362,9 @@ class ServeExecutor:
                 batch_arg, buf = self._stage(shard, live, shape)
                 slot = self._acquire_slot() if pooled else None
                 device = slot.device if slot is not None else None
+                if bt is not None:
+                    bt.end("serve.stage")
+                    bt.begin("serve.dispatch", track=_dev_track(slot))
                 self._check_fault(
                     "dispatch", slot.index if slot is not None else None)
                 t1 = time.perf_counter()
@@ -1198,6 +1376,8 @@ class ServeExecutor:
                                                    device=device)
                 results = [stacked[i] for i in range(b)]
             except NoHealthyDeviceError as exc:
+                if bt is not None:
+                    bt.end_all("error", type(exc).__name__)
                 self._release(shard.key, shape, buf)
                 self.metrics.record_no_healthy_device()
                 self._fail_requests(live, exc)
@@ -1206,16 +1386,22 @@ class ServeExecutor:
                 # bucket-failure isolation: never fail the whole bucket
                 # for one poisoned request — fall back to per-request
                 # serial re-execution
+                if bt is not None:
+                    bt.end_all("error", type(exc).__name__)
                 self._release(shard.key, shape, buf)
                 self._device_fail(slot)
                 self.metrics.record_bucket_fallback()
+                self._annotate_fallback(live, exc)
                 self._recover_serial(live, exc, pooled)
                 return None
             t2 = time.perf_counter()
             self.metrics.record_batch(b, True, padded_rows=shape - b,
                                       pinned=exact,
                                       stage_s=t1 - t0, dispatch_s=t2 - t1)
-            return live, results, shard.key, shape, buf, [slot], True
+            if bt is not None:
+                bt.end("serve.dispatch")
+                bt.begin("serve.device_execute", track=_dev_track(slot))
+            return live, results, shard.key, shape, buf, [slot], True, bt
         # serial path: dispatch every request before blocking on any
         # result (the multi.py async-overlap idiom), fanned round-robin
         # across the device pool; failures are isolated per request
@@ -1223,6 +1409,8 @@ class ServeExecutor:
         keep: List[_Request] = []
         results = []
         slots: List[Optional[_DeviceSlot]] = []
+        if bt is not None:
+            bt.begin("serve.dispatch", args={"batch": b, "serial": True})
         for req in live:
             slot = None
             try:
@@ -1247,12 +1435,19 @@ class ServeExecutor:
             slots.append(slot)
         t2 = time.perf_counter()
         self.metrics.record_batch(b, False, dispatch_s=t2 - t0)
+        if bt is not None:
+            bt.end("serve.dispatch")
         if not keep:
+            if bt is not None:
+                bt.end_all()
             return None
-        return keep, results, shard.key, shape, buf, slots, False
+        if bt is not None:
+            bt.begin("serve.device_execute",
+                     track=_dev_track(slots[0] if slots else None))
+        return keep, results, shard.key, shape, buf, slots, False, bt
 
     def _finish(self, live, results, shard_key=None, shape=0,
-                buf=None, slots=None, fused=False) -> None:
+                buf=None, slots=None, fused=False, bt=None) -> None:
         """Materialise a dispatched bucket and resolve its futures:
         latency samples measure completion (not dispatch), and async XLA
         failures surface here as exceptions instead of poisoned arrays.
@@ -1261,17 +1456,26 @@ class ServeExecutor:
         bucket isolates the failure by materialising per request. The
         staging buffer returns to its free-list only now — after
         materialisation — so reuse can never race the device
-        transfer."""
+        transfer. ``bt``'s spans (the open ``serve.device_execute``
+        plus the ``serve.materialise`` opened here) close before any
+        member future resolves, so bucket spans always nest inside
+        their request root."""
         import jax
+        if bt is not None:
+            bt.begin("serve.materialise",
+                     track=_dev_track(slots[0] if slots else None))
         try:
             self._check_fault("materialise")
             jax.block_until_ready(results)
         except Exception as exc:
+            if bt is not None:
+                bt.end_all("error", type(exc).__name__)
             self._release(shard_key, shape, buf)
             pooled = bool(slots) and slots[0] is not None
             if fused:
                 self._device_fail(slots[0] if slots else None)
                 self.metrics.record_bucket_fallback()
+                self._annotate_fallback(live, exc)
                 self._recover_serial(live, exc, pooled)
                 return
             for i, req in enumerate(live):
@@ -1285,6 +1489,9 @@ class ServeExecutor:
                 self._device_ok(slot)
                 self._resolve_one(req, results[i])
             return
+        if bt is not None:
+            bt.end("serve.materialise")
+            bt.end("serve.device_execute")
         self._release(shard_key, shape, buf)
         for slot in (slots or ()):
             self._device_ok(slot)
@@ -1294,7 +1501,13 @@ class ServeExecutor:
                 continue
             self.metrics.record_request_done(done - req.enqueued_at,
                                              priority=req.priority)
+            rt = req.trace
+            if rt is not None:
+                rt.begin("serve.resolve")
             req.future.set_result(res)
+            if rt is not None:
+                rt.finish("serve.resolve")
+                rt.close()
 
     # -- introspection -----------------------------------------------------
     def pinned_shapes(self, signature: PlanSignature) -> Tuple[int, ...]:

@@ -264,6 +264,27 @@ class ServeMetrics:
         with self._lock:
             return max(self._fused_hist, default=0)
 
+    def _health_locked(self) -> Dict:
+        """Caller holds the lock — shared by :meth:`health` and the
+        single-lock :meth:`snapshot`."""
+        return {
+            "state": self._health_state,
+            "retries": self._retries,
+            "retries_exhausted": self._retries_exhausted,
+            "retries_by_class": dict(self._retries_by),
+            "retries_exhausted_by_class": dict(
+                self._retries_exhausted_by),
+            "bucket_fallbacks": self._bucket_fallbacks,
+            "quarantines": self._quarantines,
+            "probations": self._probations,
+            "readmissions": self._readmissions,
+            "no_healthy_device": self._no_healthy_device,
+            "dispatcher_crashes": self._dispatcher_crashes,
+            "dispatcher_restarts": self._dispatcher_restarts,
+            "pin_prewarms": self._pin_prewarms,
+            "purged_expired": self._purged_expired,
+        }
+
     def health(self) -> Dict:
         """One JSON-ready snapshot of the executor's failure-handling
         state: lifecycle state plus every fault-tolerance counter —
@@ -274,23 +295,7 @@ class ServeMetrics:
         ``quarantines`` names a sick device; ``state == "failed"`` means
         the supervisor gave up and every pending future was failed."""
         with self._lock:
-            return {
-                "state": self._health_state,
-                "retries": self._retries,
-                "retries_exhausted": self._retries_exhausted,
-                "retries_by_class": dict(self._retries_by),
-                "retries_exhausted_by_class": dict(
-                    self._retries_exhausted_by),
-                "bucket_fallbacks": self._bucket_fallbacks,
-                "quarantines": self._quarantines,
-                "probations": self._probations,
-                "readmissions": self._readmissions,
-                "no_healthy_device": self._no_healthy_device,
-                "dispatcher_crashes": self._dispatcher_crashes,
-                "dispatcher_restarts": self._dispatcher_restarts,
-                "pin_prewarms": self._pin_prewarms,
-                "purged_expired": self._purged_expired,
-            }
+            return self._health_locked()
 
     def latency_percentiles(
             self, priority: Optional[str] = None) -> Dict[str, float]:
@@ -309,8 +314,16 @@ class ServeMetrics:
         """One JSON-ready dict of everything: counters, latency
         percentiles (merged and per priority class), both batch-size
         histograms, pad-row/pinning counters, orchestration overhead,
-        platform provenance and (when given) the registry's counter
-        snapshot."""
+        health, platform provenance and (when given) the registry's
+        counter snapshot.
+
+        CONSISTENCY contract (the obs-round satellite): every counter,
+        the health block and the latency reservoirs are read under ONE
+        lock acquisition, so an exporter scraping mid-traffic sees a
+        mutually consistent point-in-time view (e.g. ``completed``
+        equals the sum of ``completed_by_class``; a retry counted in
+        ``health`` has its failure counted too). Platform and registry
+        sections read other locks and may trail by a beat."""
         from ..utils.platform import platform_summary
         with self._lock:
             merged: Dict[int, int] = {}
@@ -318,6 +331,7 @@ class ServeMetrics:
                 for k, v in hist.items():
                     merged[k] = merged.get(k, 0) + v
             buckets = self._fused_batches + self._serial_batches
+            lat = {cls: list(d) for cls, d in self._latencies.items()}
             snap = {
                 "completed": self._completed,
                 "completed_by_class": dict(self._completed_by),
@@ -336,8 +350,7 @@ class ServeMetrics:
                     str(k): v for k, v in sorted(self._fused_hist.items())},
                 "serial_batch_histogram": {
                     str(k): v for k, v in sorted(self._serial_hist.items())},
-                "latency_count": sum(len(d)
-                                     for d in self._latencies.values()),
+                "latency_count": sum(len(d) for d in lat.values()),
                 "latency_window": self._window,
                 "overhead_seconds": {
                     "stage_total": self._stage_s,
@@ -348,21 +361,32 @@ class ServeMetrics:
                                     / self._completed
                                     if self._completed else 0.0),
                 },
+                "health": self._health_locked(),
             }
-        snap["health"] = self.health()
-        snap["latency_seconds"] = self.latency_percentiles()
+        merged_lat = [s for d in lat.values() for s in d]
+        snap["latency_seconds"] = {
+            "p50": percentile(merged_lat, 50.0),
+            "p95": percentile(merged_lat, 95.0),
+            "p99": percentile(merged_lat, 99.0)}
         snap["latency_seconds_by_class"] = {
-            cls: self.latency_percentiles(cls) for cls in PRIORITY_CLASSES}
+            cls: {"p50": percentile(lat[cls], 50.0),
+                  "p95": percentile(lat[cls], 95.0),
+                  "p99": percentile(lat[cls], 99.0)}
+            for cls in PRIORITY_CLASSES}
         snap["platform"] = platform_summary()
         if registry is not None:
             snap["registry"] = registry.stats()
         return snap
 
-    def to_json(self, registry=None) -> str:
-        """The snapshot plus the global timing tree (when any scopes
-        were recorded) as one JSON document."""
+    def to_json(self, registry=None, indent=None) -> str:
+        """THE machine-readable serving summary — the one consistent
+        snapshot plus the global timing tree (when any scopes were
+        recorded) as one JSON document. ``serve.bench`` embeds
+        ``json.loads(metrics.to_json(registry))`` instead of
+        hand-building its own dict, and ``obs.prometheus_text`` renders
+        the same snapshot — one source of truth for exporters."""
         payload = self.snapshot(registry)
         timings = json.loads(timing.GlobalTimer.process().json())
         if timings.get("timings"):
             payload["timings"] = timings["timings"]
-        return json.dumps(payload)
+        return json.dumps(payload, indent=indent)

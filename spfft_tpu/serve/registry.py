@@ -45,10 +45,12 @@ import collections
 import dataclasses
 import hashlib
 import threading
+import time
 from typing import Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
 
+from .. import obs as _obs
 from ..errors import InvalidParameterError
 from ..indexing import IndexPlan, build_index_plan
 from ..plan import TransformPlan
@@ -330,6 +332,7 @@ class PlanRegistry:
             if flight.exc is not None:
                 raise flight.exc
         try:
+            t_build = time.perf_counter()
             ip = build_index_plan(TransformType(transform_type), dim_x,
                                   dim_y, dim_z, arr)
             sig = PlanSignature(TransformType(transform_type).value,
@@ -342,6 +345,12 @@ class PlanRegistry:
                 with self._lock:
                     self._builds += 1
                 self.put(sig, plan)
+                # compile observability: per-signature registry build
+                # (index tables + plan construction) as span/counter
+                _obs.record_compile(
+                    "registry_build", time.perf_counter() - t_build,
+                    t_build, dims=f"{dim_x}x{dim_y}x{dim_z}",
+                    precision=precision, digest=sig.index_digest[:12])
             self._memoize(memo_key, arr, sig)
             return sig, plan
         except BaseException as exc:

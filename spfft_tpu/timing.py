@@ -107,25 +107,43 @@ class TimingResult:
 
 
 class Timer:
-    """Nested scope timer (reference: rt_graph.hpp:106-155)."""
+    """Nested scope timer (reference: rt_graph.hpp:106-155).
+
+    THREAD-SAFE since the obs round: the scope stack is THREAD-LOCAL
+    (each thread nests its own scopes from the shared root — the
+    serving executor's dispatcher, prewarm and submitter threads can
+    all enter ``timed_transform`` scopes concurrently without
+    corrupting each other's call paths), while the tree itself (child
+    creation, sample appends, ``record``) mutates under one lock. A
+    ``reset`` mid-scope on another thread orphans that thread's
+    in-flight scope (its sample lands in the discarded tree) — callers
+    quiesce before resetting, same contract as ``ServeMetrics.reset``.
+    """
 
     def __init__(self):
-        self._root = _Node("<root>")
-        self._stack: List[_Node] = [self._root]
         self._record_lock = threading.Lock()
+        self._root = _Node("<root>")
+        self._tls = threading.local()
 
     def reset(self) -> None:
-        self._root = _Node("<root>")
-        self._stack = [self._root]
+        with self._record_lock:
+            self._root = _Node("<root>")
+            self._tls = threading.local()
+
+    def _stack(self) -> List[_Node]:
+        """This thread's scope stack, rooted at the CURRENT root (a
+        stale stack from before a reset is discarded)."""
+        tls = self._tls
+        stack = getattr(tls, "stack", None)
+        if stack is None or stack[0] is not self._root:
+            stack = tls.stack = [self._root]
+        return stack
 
     def record(self, label: str, seconds: float) -> None:
         """Append one pre-measured duration under a ROOT-LEVEL scope
-        named ``label``. The serving layer measures request latencies on
-        its dispatcher thread (a ``scoped`` context there would race the
-        per-thread-unaware scope stack); this path takes a lock and
-        never touches the stack, so cross-thread recording is safe and
-        the samples appear in the same print/JSON exports as scoped
-        timings."""
+        named ``label``. Cross-thread safe (the serving layer records
+        request latencies from its dispatcher thread); never touches
+        any scope stack."""
         with self._record_lock:
             node = self._root.children.get(label)
             if node is None:
@@ -135,20 +153,26 @@ class Timer:
     @contextlib.contextmanager
     def scoped(self, label: str, block: Any = None):
         """Time a scope; if ``block`` is given, ``block_until_ready`` it
-        before closing the measurement (for async device work)."""
-        parent = self._stack[-1]
-        node = parent.children.get(label)
-        if node is None:
-            node = parent.children[label] = _Node(label)
-        self._stack.append(node)
+        before closing the measurement (for async device work).
+        Nesting is per-thread (thread-local stack); tree mutation is
+        locked."""
+        stack = self._stack()
+        parent = stack[-1]
+        with self._record_lock:
+            node = parent.children.get(label)
+            if node is None:
+                node = parent.children[label] = _Node(label)
+        stack.append(node)
         t0 = time.perf_counter()
         try:
             yield
         finally:
             if block is not None:
                 jax.block_until_ready(block)
-            node.times.append(time.perf_counter() - t0)
-            self._stack.pop()
+            dt = time.perf_counter() - t0
+            with self._record_lock:
+                node.times.append(dt)
+            stack.pop()
 
     def process(self) -> TimingResult:
         return TimingResult(self._root)

@@ -1,0 +1,295 @@
+"""Unit tests for spfft_tpu.obs: tracer lifecycle, sampling, bounded
+buffer, counters, and both exporters (Chrome trace JSON structure,
+Prometheus text round-tripped through the validating parser)."""
+
+import json
+
+import pytest
+
+from spfft_tpu import obs
+from spfft_tpu.obs import counters as obs_counters
+from spfft_tpu.obs import trace as obs_trace
+from spfft_tpu.obs.__main__ import REQUEST_STAGES, validate_trace_payload
+
+
+@pytest.fixture(autouse=True)
+def _obs_clean():
+    yield
+    obs.disable()
+    obs.GLOBAL_TRACER.reset()
+    obs.GLOBAL_TRACER.set_sample_rate(1.0)
+
+
+# -- tracer -----------------------------------------------------------------
+
+def test_span_begin_finish_lifecycle():
+    t = obs_trace.Tracer()
+    sp = t.begin("work", track="lane:normal")
+    assert t.open_count() == 1
+    t.finish(sp)
+    assert t.open_count() == 0
+    assert sp.t1 is not None and sp.t1 >= sp.t0
+    assert sp.status == "ok"
+    events = t.events()
+    assert len(events) == 1 and events[0] is sp
+
+
+def test_finish_is_idempotent():
+    t = obs_trace.Tracer()
+    sp = t.begin("work")
+    t.finish(sp, status="error", error="Boom")
+    t.finish(sp)  # second close: no-op, status keeps the first outcome
+    assert sp.status == "error" and sp.error == "Boom"
+    assert len(t.events()) == 1
+    assert t.stats()["closed"] == 1
+
+
+def test_span_context_manager_captures_error():
+    t = obs_trace.Tracer()
+    with pytest.raises(ValueError):
+        with t.span("broken"):
+            raise ValueError("no")
+    (sp,) = t.events()
+    assert sp.status == "error" and sp.error == "ValueError"
+    assert t.open_count() == 0
+
+
+def test_complete_records_measured_interval():
+    t = obs_trace.Tracer()
+    sp = t.complete("compile.plan_build", 1.0, 3.5, cat="compile",
+                    track="compile")
+    assert sp.duration == 2.5
+    assert t.open_count() == 0
+    assert t.stats()["started"] == t.stats()["closed"] == 1
+
+
+def test_deterministic_sampling_rate():
+    t = obs_trace.Tracer()
+    t.set_sample_rate(0.25)
+    hits = sum(t.sample() for _ in range(100))
+    assert hits == 25
+    t.set_sample_rate(0.0)
+    assert not any(t.sample() for _ in range(10))
+    t.set_sample_rate(1.0)
+    assert all(t.sample() for _ in range(10))
+
+
+def test_bounded_buffer_drops_oldest():
+    t = obs_trace.Tracer(max_events=4)
+    for i in range(6):
+        t.instant(f"e{i}")
+    assert len(t.events()) == 4
+    assert t.stats()["dropped"] == 2
+    assert t.events()[0]["name"] == "e2"  # oldest dropped first
+
+
+def test_request_trace_close_settles_everything():
+    t = obs_trace.Tracer()
+    rt = obs_trace.RequestTrace(t, "high")
+    rt.begin("serve.submit")
+    rt.begin("serve.queue_wait")
+    rt.finish("serve.submit")
+    assert t.open_count() == 2  # root + queue_wait
+    rt.close("error", "DeadlineExpiredError")
+    assert t.open_count() == 0
+    by_name = {s.name: s for s in t.events()}
+    assert by_name["serve.submit"].status == "ok"
+    assert by_name["serve.queue_wait"].status == "error"
+    assert by_name["serve.request"].error == "DeadlineExpiredError"
+    # trace ids are unique and shared within the request
+    assert {s.trace_id for s in t.events()} == {rt.trace_id}
+    rt.close()  # idempotent
+
+
+def test_trace_ids_unique():
+    t = obs_trace.Tracer()
+    ids = {obs_trace.RequestTrace(t, "normal").trace_id
+           for _ in range(32)}
+    assert len(ids) == 32
+
+
+# -- counters ---------------------------------------------------------------
+
+def test_counters_inc_set_get():
+    c = obs_counters.Counters()
+    c.inc("spfft_x_total", 2, kind="a")
+    c.inc("spfft_x_total", 3, kind="a")
+    c.inc("spfft_x_total", 1, kind="b")
+    c.set("spfft_g", 7.5)
+    assert c.get("spfft_x_total", kind="a") == 5
+    assert c.get("spfft_x_total", kind="b") == 1
+    assert c.get("spfft_g") == 7.5
+    assert c.get("spfft_missing") == 0.0
+    snap = c.snapshot()
+    assert snap["spfft_x_total"]["type"] == "counter"
+    assert snap["spfft_g"]["type"] == "gauge"
+
+
+def test_counters_reject_bad_names_and_type_conflicts():
+    c = obs_counters.Counters()
+    with pytest.raises(ValueError):
+        c.inc("bad name")
+    with pytest.raises(ValueError):
+        c.inc("spfft_ok", **{"bad-label": 1})
+    c.inc("spfft_dual")
+    with pytest.raises(ValueError):
+        c.set("spfft_dual", 1.0)
+
+
+# -- prometheus exporter + parser -------------------------------------------
+
+def test_prometheus_text_round_trips_counters():
+    c = obs_counters.Counters()
+    c.inc("spfft_demo_total", 4, help="Demo counter.", kind="x")
+    c.set("spfft_demo_gauge", 1.5, help='Tricky "help" \\ text.')
+    text = obs.prometheus_text(counters=c, timer=_EmptyTimer(),
+                               tracer=obs_trace.Tracer())
+    series = obs.parse_prometheus_text(text)
+    assert series[("spfft_demo_total", (("kind", "x"),))] == 4
+    assert series[("spfft_demo_gauge", ())] == 1.5
+    # tracer lifecycle gauges always present
+    assert ("spfft_trace_spans_open", ()) in series
+
+
+def test_prometheus_text_covers_serve_metrics_and_timing():
+    from spfft_tpu import timing
+    from spfft_tpu.serve.metrics import ServeMetrics
+
+    m = ServeMetrics()
+    m.record_enqueue(3)
+    m.record_batch(4, True, padded_rows=2, pinned=True,
+                   stage_s=0.01, dispatch_s=0.02)
+    for _ in range(5):
+        m.record_request_done(0.005, priority="normal")
+    m.record_request_done(0.009, failed=True)
+    m.record_retry("high")
+    timer = timing.Timer()
+    with timer.scoped("backward"):
+        with timer.scoped("fft"):
+            pass
+    text = obs.prometheus_text(metrics=m, timer=timer,
+                               counters=obs_counters.Counters(),
+                               tracer=obs_trace.Tracer())
+    series = obs.parse_prometheus_text(text)
+    assert series[("spfft_serve_completed_total", ())] == 5
+    assert series[("spfft_serve_failed_total", ())] == 1
+    assert series[("spfft_serve_padded_rows_total", ())] == 2
+    assert series[("spfft_serve_batch_size_total",
+                   (("path", "fused"), ("size", "4")))] == 1
+    assert series[("spfft_serve_retries_total", ())] == 1
+    assert series[("spfft_serve_retries_by_class_total",
+                   (("class", "high"),))] == 1
+    assert series[("spfft_serve_health", (("state", "healthy"),))] == 1
+    assert series[("spfft_serve_latency_seconds",
+                   (("quantile", "p50"),))] > 0
+    assert series[("spfft_timing_calls_total",
+                   (("scope", "backward/fft"),))] == 1
+
+
+def test_prometheus_parser_rejects_bad_text():
+    with pytest.raises(ValueError):
+        obs.parse_prometheus_text("no_type_declared 1\n")
+    with pytest.raises(ValueError):
+        obs.parse_prometheus_text(
+            "# TYPE spfft_a counter\nspfft_a{unclosed 1\n")
+    with pytest.raises(ValueError):
+        obs.parse_prometheus_text(
+            "# TYPE spfft_a counter\nspfft_a 1\nspfft_a 2\n")
+    with pytest.raises(ValueError):
+        obs.parse_prometheus_text(
+            "# TYPE spfft_a bogus\nspfft_a 1\n")
+
+
+class _EmptyTimer:
+    def process(self):
+        class _R:
+            @staticmethod
+            def json():
+                return '{"timings": []}'
+        return _R()
+
+
+# -- chrome trace exporter + validation -------------------------------------
+
+def test_export_trace_structure(tmp_path):
+    t = obs_trace.Tracer()
+    rt = obs_trace.RequestTrace(t, "normal")
+    rt.begin("serve.submit")
+    rt.finish("serve.submit")
+    rt.close()
+    t.instant("serve.retry", track="lane:normal", args={"attempt": 1})
+    t.counter("exchange.chunk_wire_bytes", {"bwd": 100, "fwd": 50},
+              track="exchange")
+    path = tmp_path / "t.json"
+    payload = obs.export_trace(str(path), tracer=t)
+    on_disk = json.loads(path.read_text())
+    assert on_disk == payload
+    phases = {e["ph"] for e in payload["traceEvents"]}
+    assert phases == {"M", "X", "i", "C"}
+    xs = [e for e in payload["traceEvents"] if e["ph"] == "X"]
+    root = next(e for e in xs if e["name"] == "serve.request")
+    child = next(e for e in xs if e["name"] == "serve.submit")
+    assert child["args"]["parent_span_id"] == root["args"]["span_id"]
+    assert child["args"]["trace_id"] == root["args"]["trace_id"]
+    # track metadata names the lane
+    threads = {e["args"]["name"] for e in payload["traceEvents"]
+               if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert "lane:normal" in threads and "exchange" in threads
+    assert validate_trace_payload(payload) == []
+
+
+def test_validate_trace_payload_catches_problems():
+    assert validate_trace_payload({}) == ["traceEvents missing or empty"]
+    bad = {"traceEvents": [{"ph": "X", "name": "a", "ts": 0,
+                            "dur": -1, "pid": 1, "tid": 1}]}
+    assert any("bad dur" in f for f in validate_trace_payload(bad))
+    ok = {"traceEvents": [{"ph": "X", "name": "a", "ts": 0, "dur": 1,
+                           "pid": 1, "tid": 1}]}
+    assert any("missing from trace" in f for f in
+               validate_trace_payload(ok, require_names=["b"]))
+    leaky = {"traceEvents": ok["traceEvents"],
+             "otherData": {"tracer": {"open": 2}}}
+    assert any("unclosed" in f for f in validate_trace_payload(leaky))
+
+
+def test_request_stages_constant_covers_the_pipeline():
+    assert len(REQUEST_STAGES) == 8
+    assert REQUEST_STAGES[0] == "serve.submit"
+    assert REQUEST_STAGES[-1] == "serve.resolve"
+
+
+# -- recorder helpers -------------------------------------------------------
+
+def test_record_compile_counters_and_span():
+    obs.GLOBAL_TRACER.reset()
+    before = obs.GLOBAL_COUNTERS.get("spfft_compile_events_total",
+                                     kind="unit_test")
+    obs.record_compile("unit_test", 0.5, batch=4)
+    assert obs.GLOBAL_COUNTERS.get("spfft_compile_events_total",
+                                   kind="unit_test") == before + 1
+    assert obs.GLOBAL_COUNTERS.get("spfft_compile_seconds_total",
+                                   kind="unit_test") >= 0.5
+    # span only when tracing is enabled
+    assert not [e for e in obs.GLOBAL_TRACER.events()
+                if getattr(e, "name", None) == "compile.unit_test"]
+    obs.enable()
+    obs.record_compile("unit_test", 0.25, batch=8)
+    spans = [e for e in obs.GLOBAL_TRACER.events()
+             if getattr(e, "name", None) == "compile.unit_test"]
+    assert len(spans) == 1 and spans[0].args["batch"] == 8
+    assert abs(spans[0].duration - 0.25) < 1e-6
+
+
+def test_record_hlo_counts_surfaces_metrics():
+    txt = ("stablehlo.all_to_all foo\nstablehlo.all_to_all bar\n"
+           "stablehlo.collective_permute baz\n")
+    compiled = "all-to-all-start x\nall-to-all-done x\n"
+    out = obs.record_hlo_counts("unit", lowered_text=txt,
+                                compiled_text=compiled)
+    assert out["collectives"]["all_to_all"] == 2
+    assert out["collectives"]["collective_permute"] == 1
+    assert out["async_split"]["starts"] == 1
+    assert obs.GLOBAL_COUNTERS.get("spfft_hlo_collectives",
+                                   label="unit", op="all_to_all") == 2
+    assert obs.GLOBAL_COUNTERS.get("spfft_hlo_async_starts",
+                                   label="unit") == 1

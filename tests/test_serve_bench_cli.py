@@ -3,7 +3,20 @@ deliverable, like spfft_tpu.benchmark — SURVEY.md §6)."""
 
 import json
 
+import pytest
+
 from spfft_tpu.serve.bench import main
+
+
+@pytest.fixture(autouse=True)
+def _obs_reset():
+    """--trace-out flips the process-global tracer on; restore the
+    default (off) state so later tests measure the disabled path."""
+    yield
+    from spfft_tpu import obs
+    obs.disable()
+    obs.GLOBAL_TRACER.reset()
+    obs.GLOBAL_TRACER.set_sample_rate(1.0)
 
 
 def _last_json(capsys):
@@ -108,6 +121,75 @@ def test_serve_bench_fault_rate_degrades_gracefully(capsys):
 
 def test_serve_bench_bad_fault_args():
     assert main(["--fault-rate", "1.5"]) == 2
+
+
+def test_serve_bench_smoke_trace_artifacts(tmp_path, capsys):
+    """The trace-smoke acceptance criterion (make trace-smoke runs the
+    same flags): --smoke with --trace-out/--prom-out produces a Chrome
+    trace whose spans cover all eight request stages plus compile and
+    exchange events with ZERO unclosed spans, and Prometheus text that
+    round-trips the validating exposition parser."""
+    from spfft_tpu import obs
+    from spfft_tpu.obs.__main__ import (REQUEST_STAGES,
+                                        validate_trace_payload)
+
+    trace_file = tmp_path / "trace.json"
+    prom_file = tmp_path / "metrics.prom"
+    rc = main(["--smoke", "--trace-out", str(trace_file),
+               "--prom-out", str(prom_file)])
+    assert rc == 0
+    payload, _ = _last_json(capsys)
+    assert payload["ok"]
+    assert payload["obs"]["open_spans"] == 0
+    trace = json.loads(trace_file.read_text())
+    # the conftest's 8-device virtual platform means the exchange demo
+    # plan built, so exchange events are required too
+    require = REQUEST_STAGES + ("serve.request",
+                                "compile.registry_build",
+                                "exchange.plan_build")
+    assert validate_trace_payload(trace, require_names=require) == []
+    names = {e["name"] for e in trace["traceEvents"]
+             if e["ph"] in ("X", "i", "C")}
+    assert "exchange.chunk_wire_bytes" in names  # per-chunk accounting
+    series = obs.parse_prometheus_text(prom_file.read_text())
+    assert series[("spfft_serve_completed_total", ())] == 30  # 6x5
+    assert any(name == "spfft_exchange_wire_bytes"
+               for name, _ in series)
+    assert any(name == "spfft_compile_seconds_total"
+               for name, _ in series)
+
+
+def test_serve_bench_fault_smoke_zero_unclosed_spans(tmp_path, capsys):
+    """The acceptance criterion's fault half: all six failure phases
+    (poisoned bucket, injected faults, quarantine, probation, crash,
+    restart) leave ZERO unclosed spans, with the trace exported."""
+    trace_file = tmp_path / "fault_trace.json"
+    rc = main(["--fault-smoke", "--trace-out", str(trace_file)])
+    assert rc == 0
+    payload, _ = _last_json(capsys)
+    assert payload["ok"]
+    assert payload["obs"]["open_spans"] == 0
+    trace = json.loads(trace_file.read_text())
+    errored = [e for e in trace["traceEvents"]
+               if e["ph"] == "X" and e["args"].get("status") == "error"]
+    assert errored, "failure phases must record error-status spans"
+    assert all(e["args"].get("error") for e in errored)
+
+
+def test_serve_bench_profile_dir(tmp_path, capsys):
+    """--profile-dir captures a jax.profiler session around the
+    measured window (the named_scope phase names become visible)."""
+    profile_dir = tmp_path / "profile"
+    rc = main(["--dim", "12", "--requests", "8", "--signatures", "1",
+               "--threads", "2", "--profile-dir", str(profile_dir)])
+    assert rc == 0
+    _, text = _last_json(capsys)
+    captured = list(profile_dir.rglob("*")) if profile_dir.exists() \
+        else []
+    # the capture is best-effort (warn-and-continue when the backend
+    # has no profiler), but on this container's CPU backend it works
+    assert any(p.is_file() for p in captured) \
+        or "jax.profiler capture unavailable" in text
 
 
 def test_serve_bench_priority_classes(capsys):

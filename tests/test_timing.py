@@ -2,6 +2,7 @@
 HOST_TIMING macro gating, src/timing/timing.hpp:44-62)."""
 
 import json
+import threading
 import time
 
 import numpy as np
@@ -56,6 +57,65 @@ def test_print_does_not_crash(capsys):
     t.process().print()
     out = capsys.readouterr().out
     assert "a" in out and "count" in out
+
+
+def test_scoped_stack_is_thread_local():
+    """Concurrency regression (obs round): nested scopes entered from
+    many threads concurrently must keep their OWN call paths — with the
+    old shared scope stack, interleaved enter/exit corrupted the tree
+    (inner scopes landed under other threads' nodes, counts drifted,
+    pops unbalanced the stack). The thread-local stack keeps the
+    structure exact: one outer -> inner chain, with every sample
+    accounted for."""
+    t = timing.Timer()
+    N, ITERS = 8, 40
+    barrier = threading.Barrier(N)
+    errors = []
+
+    def worker():
+        try:
+            barrier.wait()
+            for _ in range(ITERS):
+                with t.scoped("outer"):
+                    with t.scoped("inner"):
+                        pass
+        except Exception as exc:  # pragma: no cover - the regression
+            errors.append(exc)
+
+    threads = [threading.Thread(target=worker) for _ in range(N)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errors
+    rows = t.process()._rows()
+    shape = {(r["label"], r["depth"]): r["count"] for r in rows}
+    assert shape == {("outer", 0): N * ITERS, ("inner", 1): N * ITERS}
+
+
+def test_record_and_scoped_interleave_across_threads():
+    """Timer.record (dispatcher threads) and scoped (caller threads)
+    running concurrently: every sample lands, the tree stays sane."""
+    t = timing.Timer()
+    ITERS = 200
+
+    def recorder():
+        for _ in range(ITERS):
+            t.record("serve.request", 0.001)
+
+    def scoper():
+        for _ in range(ITERS):
+            with t.scoped("backward"):
+                pass
+
+    threads = [threading.Thread(target=recorder),
+               threading.Thread(target=scoper)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    rows = {r["label"]: r["count"] for r in t.process()._rows()}
+    assert rows == {"serve.request": ITERS, "backward": ITERS}
 
 
 def test_multi_transform_batch_timing():

@@ -492,6 +492,42 @@ def test_serve_fault_smoke_on_tpu():
                              "--fault-rate", "0.05"]) == 0
 
 
+def test_obs_smoke_on_tpu(tmp_path):
+    """Unified telemetry ON THE CHIP: the traced serving smoke must
+    produce a Chrome trace covering all eight request stages with zero
+    unclosed spans against real Mosaic/XLA:TPU executables (the CPU
+    tier-1 smoke covers the same lifecycle logic but not hardware
+    dispatch — on TPU the device_execute spans measure real async chip
+    work and a multi-chip host exercises per-device tracks), plus
+    Prometheus text that round-trips the exposition parser. The traced
+    fault smoke then proves the zero-leak contract across bucket
+    isolation / quarantine / crash recovery on the real device pool."""
+    import json
+
+    from spfft_tpu import obs
+    from spfft_tpu.obs.__main__ import (REQUEST_STAGES,
+                                        validate_trace_payload)
+    from spfft_tpu.serve.bench import main as serve_bench_main
+
+    trace_file = tmp_path / "tpu_trace.json"
+    prom_file = tmp_path / "tpu_metrics.prom"
+    try:
+        assert serve_bench_main(["--smoke",
+                                 "--trace-out", str(trace_file),
+                                 "--prom-out", str(prom_file)]) == 0
+        payload = json.loads(trace_file.read_text())
+        assert validate_trace_payload(
+            payload, require_names=REQUEST_STAGES) == []
+        series = obs.parse_prometheus_text(prom_file.read_text())
+        assert series[("spfft_trace_spans_open", ())] == 0
+        assert serve_bench_main(
+            ["--fault-smoke",
+             "--trace-out", str(tmp_path / "tpu_fault_trace.json")]) == 0
+    finally:
+        obs.disable()
+        obs.GLOBAL_TRACER.reset()
+
+
 def test_overlap_exchange_on_tpu():
     """Compute/communication overlap ON REAL CHIPS (multi-chip hosts
     only — the chunked exchange needs a real mesh): overlap_chunks=K
