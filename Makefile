@@ -15,7 +15,8 @@ CACHE_TAG   := $(shell python3 -c "import sys; print(sys.implementation.cache_ta
 PLANNER_SO  := $(NATIVE_DIR)/_planner_$(CACHE_TAG).so
 CAPI_SO     := lib/libspfft_tpu.so
 
-.PHONY: all native capi example-c test ci ci-tpu trace-smoke clean
+.PHONY: all native capi example-c test ci ci-tpu trace-smoke \
+        control-smoke bench-check clean
 
 # One-command CI (reference: .github/workflows/ci.yml builds + runs the
 # local test matrix): full CPU suite (8-device virtual mesh; includes the
@@ -61,6 +62,38 @@ trace-smoke:
 	python -m spfft_tpu.obs validate build/trace_smoke.json --require-request-stages
 	python -m spfft_tpu.obs prom build/trace_smoke.prom
 	@echo "TRACE-SMOKE GREEN"
+
+# Control-plane smoke (docs/control_plane.md): the traced deterministic
+# serving smoke WITH the feedback controller on — the scripted
+# queue-buildup trace must produce >= 1 recorded, bounds-clamped knob
+# decision (the CLI exits 1 otherwise), zero unclosed spans, bit-exact
+# results through a mid-stream retune, no SLO false positives, and the
+# Prometheus text must expose the spfft_control_* / spfft_slo_* series.
+# The same checks run in tier-1
+# (tests/test_serve_bench_cli.py::test_serve_bench_smoke_control_closes_the_loop).
+control-smoke:
+	@echo "== control-smoke: traced serve.bench --smoke --control + assertions =="
+	@mkdir -p build
+	python -m spfft_tpu.serve.bench --smoke --control --cpu --devices 2 \
+	  --trace-out build/control_smoke.json --prom-out build/control_smoke.prom
+	grep -q "spfft_control_decisions_total" build/control_smoke.prom
+	grep -q "spfft_slo_burn_rate" build/control_smoke.prom
+	grep -q "spfft_control_knob" build/control_smoke.prom
+	python -m spfft_tpu.obs validate build/control_smoke.json --require-request-stages
+	@echo "CONTROL-SMOKE GREEN"
+
+# Perf-trajectory guard (scripts/bench_regress.py): run the north-star
+# benchmark fresh and compare against the latest recorded BENCH_r*.json
+# with a noise threshold — nonzero exit on regression, so the perf
+# trajectory is machine-checked instead of eyeballed. Record with
+#   make bench-check 2>&1 | tee docs/bench_check_rNN.log
+bench-check:
+	@echo "== bench-check: fresh benchmark vs latest BENCH_r*.json =="
+	@mkdir -p build
+	python bench.py | tee build/bench_fresh.log
+	grep '^{' build/bench_fresh.log | tail -1 > build/bench_fresh.json
+	python scripts/bench_regress.py --fresh build/bench_fresh.json
+	@echo "BENCH-CHECK GREEN"
 
 all: native capi
 

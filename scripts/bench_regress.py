@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""Bench regression guard: machine-check the perf trajectory.
+
+Five rounds of BENCH_r*.json were compared by eyeball; this script
+makes the comparison a nonzero-exit mechanism (``make bench-check``):
+
+    python bench.py | grep '^{' | tail -1 > build/bench_fresh.json
+    python scripts/bench_regress.py --fresh build/bench_fresh.json
+
+* ``--fresh`` — a fresh measurement: either the single JSON line
+  ``bench.py`` prints ({"metric", "value", "unit", ...}) or a driver
+  BENCH_r*.json ({"parsed": {...}}).
+* ``--against`` — the reference (same formats). Default: the
+  highest-numbered BENCH_r*.json in the repo root; with none present
+  the check reports "no reference" and exits 0 (a fresh repo cannot
+  regress against nothing).
+* ``--threshold`` — the noise allowance (default 0.15: the r05 session
+  spread is sub-1%, but cross-session/container variance has measured
+  excursions near 10%; 15% flags real cliffs without crying wolf on
+  backend jitter).
+
+Direction is inferred from the unit: seconds-like units regress when
+the fresh value is HIGHER, rate-like units (req/s, GB/s, ...) when it
+is LOWER. Exit codes: 0 within threshold (or improved), 1 regression,
+2 usage/parse error. Prints one JSON verdict line (the bench.py
+convention).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+#: Units where SMALLER is better; anything else is treated as a rate.
+LOWER_IS_BETTER_UNITS = ("s", "ms", "us", "ns", "seconds", "bytes")
+
+
+def load_measurement(path: str):
+    """(value, unit, metric) from either bench.py's single JSON line or
+    a driver BENCH_r*.json wrapper."""
+    with open(path) as f:
+        payload = json.load(f)
+    if "parsed" in payload and isinstance(payload["parsed"], dict):
+        payload = payload["parsed"]
+    if "value" not in payload:
+        raise ValueError(f"{path}: no 'value' field (not a bench "
+                         f"measurement)")
+    return (float(payload["value"]), str(payload.get("unit", "")),
+            str(payload.get("metric", "")))
+
+
+def latest_reference(root: str):
+    """The highest-numbered BENCH_r*.json under ``root`` that carries a
+    parsed value, or None."""
+    best = None
+    for path in glob.glob(os.path.join(root, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            load_measurement(path)
+        except (ValueError, OSError, json.JSONDecodeError):
+            continue
+        n = int(m.group(1))
+        if best is None or n > best[0]:
+            best = (n, path)
+    return best[1] if best else None
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python scripts/bench_regress.py",
+        description="compare a fresh benchmark JSON against the "
+                    "recorded baseline; nonzero exit on regression")
+    ap.add_argument("--fresh", required=True,
+                    help="fresh measurement JSON (bench.py line or "
+                         "BENCH_r*.json format)")
+    ap.add_argument("--against", default=None,
+                    help="reference JSON (default: latest BENCH_r*.json "
+                         "in the repo root)")
+    ap.add_argument("--threshold", type=float, default=0.15,
+                    help="fractional noise allowance (default 0.15)")
+    ap.add_argument("--root", default=os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))),
+        help="repo root to scan for BENCH_r*.json")
+    args = ap.parse_args(argv)
+    if not 0.0 <= args.threshold < 1.0:
+        print("error: --threshold must be in [0, 1)", file=sys.stderr)
+        return 2
+    try:
+        fresh_v, fresh_unit, fresh_metric = load_measurement(args.fresh)
+    except (ValueError, OSError, json.JSONDecodeError) as exc:
+        print(f"error: cannot read --fresh: {exc}", file=sys.stderr)
+        return 2
+    against = args.against or latest_reference(args.root)
+    if against is None:
+        print(json.dumps({"ok": True, "verdict": "no-reference",
+                          "fresh": fresh_v, "unit": fresh_unit}))
+        print("no BENCH_r*.json reference found — nothing to regress "
+              "against", file=sys.stderr)
+        return 0
+    try:
+        ref_v, ref_unit, ref_metric = load_measurement(against)
+    except (ValueError, OSError, json.JSONDecodeError) as exc:
+        print(f"error: cannot read reference {against}: {exc}",
+              file=sys.stderr)
+        return 2
+    if fresh_unit and ref_unit and fresh_unit != ref_unit:
+        print(f"error: unit mismatch: fresh '{fresh_unit}' vs "
+              f"reference '{ref_unit}' — not comparable",
+              file=sys.stderr)
+        return 2
+    unit = fresh_unit or ref_unit
+    lower_better = unit in LOWER_IS_BETTER_UNITS
+    if ref_v == 0:
+        ratio = 1.0
+    elif lower_better:
+        ratio = fresh_v / ref_v      # > 1: slower
+    else:
+        ratio = ref_v / fresh_v      # > 1: fewer per second
+    regressed = ratio > 1.0 + args.threshold
+    change = (fresh_v / ref_v - 1.0) * 100 if ref_v else 0.0
+    verdict = {
+        "ok": not regressed,
+        "verdict": "regression" if regressed else "within-threshold",
+        "unit": unit,
+        "direction": "lower-is-better" if lower_better
+        else "higher-is-better",
+        "fresh": fresh_v,
+        "reference": ref_v,
+        "reference_file": against,
+        "change_pct": round(change, 2),
+        "threshold_pct": round(args.threshold * 100, 2),
+    }
+    print(json.dumps(verdict))
+    tag = "REGRESSION" if regressed else "OK"
+    print(f"{tag}: {fresh_v:g} {unit} vs {ref_v:g} {unit} "
+          f"({change:+.1f}%, threshold ±{args.threshold * 100:.0f}%, "
+          f"{verdict['direction']}) [ref: {os.path.basename(against)}]",
+          file=sys.stderr)
+    if regressed:
+        print(f"  fresh metric: {fresh_metric[:160]}", file=sys.stderr)
+        print(f"  ref metric:   {ref_metric[:160]}", file=sys.stderr)
+    return 1 if regressed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

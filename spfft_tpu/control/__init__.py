@@ -1,0 +1,41 @@
+"""spfft_tpu.control — the telemetry-driven control plane.
+
+Closes the obs→serve loop the ROADMAP names: round 10 made every
+tuning signal machine-readable (queue-wait spans, padded-rows
+counters, per-chunk wire bytes, compile durations); this package makes
+observability ACT on them instead of just exporting them.
+
+* :mod:`~spfft_tpu.control.config` — :class:`ServeConfig`, the one
+  typed home of every serving/execution knob: hot-swappable under
+  lock, bounds-clamped, every change recorded (history +
+  ``spfft_control_*`` Prometheus series + ``control.retune`` trace
+  annotation). ``SPFFT_TPU_SERVE_CONFIG`` loads a recommended-config
+  artifact at boot.
+* :mod:`~spfft_tpu.control.controller` — :class:`Controller` /
+  :class:`ControlLoop`, the deterministic rule-based feedback loop
+  (hysteresis + step-counted cooldown) retuning batch window, pin
+  policy, bucket cap and pipeline depth from live
+  ``ServeMetrics.signals()``.
+* :mod:`~spfft_tpu.control.slo` — :class:`SLOSpec` /
+  :class:`SLOWatchdog`: declared objectives (p99 latency, error rate,
+  quarantine ceiling) evaluated against metrics snapshots; burn rates
+  exported as ``spfft_slo_*`` gauges, violations degrade ``health()``.
+* ``python -m spfft_tpu.control`` — ``tune`` (offline auto-tuner over
+  the serve.bench / bench_overlap_ab protocols, emits the boot
+  artifact), ``show`` (knobs, bounds, signals), ``check`` (validate an
+  artifact).
+
+See docs/control_plane.md.
+"""
+
+from .config import (CONFIG_ENV, KNOB_SPECS, KnobSpec, ServeConfig,
+                     global_config, set_global_config)
+from .controller import MANAGED_KNOBS, ControlLoop, Controller, Decision
+from .slo import SLOSpec, SLOWatchdog
+
+__all__ = [
+    "ServeConfig", "KnobSpec", "KNOB_SPECS", "CONFIG_ENV",
+    "global_config", "set_global_config",
+    "Controller", "ControlLoop", "Decision", "MANAGED_KNOBS",
+    "SLOSpec", "SLOWatchdog",
+]

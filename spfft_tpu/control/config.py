@@ -1,0 +1,352 @@
+"""ServeConfig: THE typed home of every serving/execution knob.
+
+Until this round the executor's knobs were module constants scattered
+across three layers (``serve/executor.py`` batching/pinning/quarantine
+constants, ``serve/registry.py`` LRU bounds, ``parallel/dist.py``'s
+``overlap_chunks`` env default) — hand-retuned each round by reading
+the ci-tpu log. The reference library tunes its execution strategy from
+measured structure (buffer sizes, exchange mechanism, MPI-behind-compute
+scheduling all derive from the plan's exact byte accounting — PAPER.md
+execution layer); this module is the serving-era analogue's foundation:
+one :class:`ServeConfig` object that
+
+* declares every knob ONCE, with its default, hard bounds and the
+  telemetry signal that drives it (:data:`KNOB_SPECS` — the executor's
+  ``DEFAULT_*`` constants now alias these defaults, so there is exactly
+  one place a number lives);
+* is HOT-SWAPPABLE under a lock: the feedback controller
+  (:mod:`~spfft_tpu.control.controller`) retunes a live executor by
+  calling :meth:`ServeConfig.set` while the dispatcher reads the same
+  object through lock-guarded attribute access — a retune applies from
+  the next bucket, and the executor's correctness contract (vmap rows
+  independent, batch shape can never perturb live rows) makes any
+  mid-stream change bit-exact by construction;
+* BOUNDS-CLAMPS every write and RECORDS every accepted change as a
+  decision: a bounded in-memory history, a
+  ``spfft_control_decisions_total{knob,source}`` Prometheus counter, a
+  ``spfft_control_knob{knob}`` gauge, and (when tracing is on) a
+  ``control.retune`` instant event on the ``control`` track — so
+  Perfetto shows *why* a knob moved next to the request spans it moved
+  in response to;
+* round-trips a JSON artifact (:meth:`save` / :meth:`load`): the
+  offline auto-tuner (``python -m spfft_tpu.control tune``) emits a
+  recommended-config file and ``serve`` loads it at boot via the
+  ``SPFFT_TPU_SERVE_CONFIG`` env var (:meth:`boot`).
+
+See docs/control_plane.md for the signals → rules → knobs table.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..errors import InvalidParameterError
+
+#: Boot artifact location: when set, :meth:`ServeConfig.boot` (the
+#: executor's default config source) loads this JSON file — the
+#: auto-tuner's output becomes the fleet's serving defaults without a
+#: code change. A malformed artifact raises at boot (fail fast: a typo'd
+#: config silently ignored is worse than a crashed boot).
+CONFIG_ENV = "SPFFT_TPU_SERVE_CONFIG"
+
+#: Artifact schema marker (bumped on incompatible format changes).
+ARTIFACT_KEY = "spfft_tpu_serve_config"
+ARTIFACT_VERSION = 1
+
+#: Decisions kept in each config's in-memory history (ring).
+HISTORY_LIMIT = 256
+
+
+@dataclasses.dataclass(frozen=True)
+class KnobSpec:
+    """One knob's declaration: default, hard clamp bounds, type and the
+    telemetry signal the controller drives it from (documentation — the
+    rules live in :mod:`~spfft_tpu.control.controller`)."""
+
+    name: str
+    default: float
+    lo: float
+    hi: float
+    kind: type                  # int or float
+    signal: str                 # what drives it (docs + CLI `show`)
+    doc: str
+
+    def clamp(self, value) -> float:
+        v = self.kind(value)
+        if v < self.lo:
+            v = self.kind(self.lo)
+        elif v > self.hi:
+            v = self.kind(self.hi)
+        return v
+
+
+#: Every knob the control plane owns. Defaults carry their measured
+#: provenance forward from the modules that used to own them:
+#: batch_window 1 ms (round-7 arrival-latency retune), max_batch 8
+#: (latency amplification bound vs FUSED_BATCH_MAX_GRID), pin_after 3 /
+#: max_pinned_shapes 4 (round-7 adaptive pinning), quarantine 3 @ 0.25 s
+#: (round-8 fault tolerance), registry 2 GiB / 32 plans (round-6 LRU),
+#: overlap_chunks 1 (round-9: K=1 is the bit/HLO-identical monolithic
+#: path; K>1 pays only where the backend overlaps collectives).
+KNOB_SPECS: Dict[str, KnobSpec] = {spec.name: spec for spec in (
+    KnobSpec("batch_window", 0.001, 0.0, 0.1, float,
+             "queue-wait p95 vs device-execute p50",
+             "Same-signature batching window (seconds) a trickle bucket "
+             "waits for company."),
+    KnobSpec("max_batch", 8, 1, 128, int,
+             "fused batch histogram + queue depth",
+             "Bucket cap: most live rows one fused dispatch carries."),
+    KnobSpec("max_queue", 256, 1, 65536, int,
+             "rejected_queue_full counter",
+             "Bounded request queue capacity (overflow rejects, "
+             "QueueFullError)."),
+    KnobSpec("pin_after", 3, 0, 64, int,
+             "padded-rows ratio",
+             "Consecutive same-size fused buckets before that exact "
+             "shape is pinned (0 disables pinning)."),
+    KnobSpec("max_pinned_shapes", 4, 1, 64, int,
+             "pinned-shape churn",
+             "Pinned exact batch shapes kept per signature (LRU)."),
+    KnobSpec("pipeline_depth", 0, 0, 32, int,
+             "stage-vs-dispatch overlap ratio",
+             "In-flight bucket window; 0 = backend-aware auto (pool+1 "
+             "on accelerators, pool on CPU)."),
+    KnobSpec("quarantine_after", 3, 0, 64, int,
+             "device-attributed failure streaks",
+             "Consecutive device-attributed failures before a pool "
+             "device is quarantined (0 disables)."),
+    KnobSpec("quarantine_backoff", 0.25, 0.001, 60.0, float,
+             "probation outcomes",
+             "Initial quarantine probation backoff (seconds, doubles "
+             "per failed canary)."),
+    KnobSpec("overlap_chunks", 1, 1, 64, int,
+             "per-chunk wire bytes + async-split evidence",
+             "Distributed exchange pipeline chunks K (1 = monolithic, "
+             "bit-identical path)."),
+    KnobSpec("registry_max_bytes", 2 * 1024 ** 3, 1024 ** 2,
+             64 * 1024 ** 3, int,
+             "registry bytes_in_use / evictions",
+             "Plan registry LRU byte budget over estimated plan "
+             "residency."),
+    KnobSpec("registry_max_plans", 32, 1, 4096, int,
+             "registry evictions",
+             "Plan registry LRU entry cap."),
+)}
+
+
+def _counters():
+    # late import: obs is cheap, but keeping it out of module import
+    # keeps config importable from anywhere (dist.py, registry) without
+    # ordering concerns
+    from .. import obs
+    return obs
+
+
+class ServeConfig:
+    """Typed, bounds-clamped, hot-swappable serving configuration.
+
+    Reads (``config.batch_window`` or :meth:`get`) and writes
+    (:meth:`set`) are lock-guarded, so a controller thread can retune a
+    knob while the dispatcher reads it: the new value applies from the
+    reader's next access. Every ACCEPTED change (value actually moved)
+    is recorded as a decision — history entry, Prometheus counter/gauge
+    and, when tracing is on, a ``control.retune`` instant on the
+    ``control`` track.
+    """
+
+    def __init__(self, values: Optional[Dict] = None):
+        self._lock = threading.Lock()
+        self._values: Dict[str, float] = {
+            name: spec.default for name, spec in KNOB_SPECS.items()}
+        self._history: "collections.deque" = collections.deque(
+            maxlen=HISTORY_LIMIT)
+        self._seq = 0
+        self._decisions_by_source: Dict[str, int] = {}
+        if values:
+            self.update(values, reason="initial values", source="init")
+
+    # -- reading -----------------------------------------------------------
+    def __getattr__(self, name: str):
+        # only consulted when normal attribute lookup fails — i.e. for
+        # knob names (internal attributes hit __dict__ first)
+        specs = object.__getattribute__(self, "__dict__")
+        if name.startswith("_") or name not in KNOB_SPECS:
+            raise AttributeError(name)
+        with specs["_lock"]:
+            return specs["_values"][name]
+
+    def get(self, name: str):
+        if name not in KNOB_SPECS:
+            raise InvalidParameterError(f"unknown knob {name!r} "
+                                        f"(knobs: {sorted(KNOB_SPECS)})")
+        with self._lock:
+            return self._values[name]
+
+    def snapshot(self) -> Dict[str, float]:
+        """Point-in-time copy of every knob value."""
+        with self._lock:
+            return dict(self._values)
+
+    @staticmethod
+    def spec(name: str) -> KnobSpec:
+        spec = KNOB_SPECS.get(name)
+        if spec is None:
+            raise InvalidParameterError(f"unknown knob {name!r} "
+                                        f"(knobs: {sorted(KNOB_SPECS)})")
+        return spec
+
+    @staticmethod
+    def default(name: str):
+        return ServeConfig.spec(name).default
+
+    @staticmethod
+    def bounds(name: str) -> Tuple[float, float]:
+        spec = ServeConfig.spec(name)
+        return (spec.lo, spec.hi)
+
+    def decisions(self) -> List[Dict]:
+        """The bounded decision history, oldest first (each entry:
+        seq/knob/old/new/requested/clamped/reason/source)."""
+        with self._lock:
+            return list(self._history)
+
+    def decision_count(self, source: Optional[str] = None) -> int:
+        """Lifetime accepted-decision count (per ``source`` when given)
+        — survives the bounded history window."""
+        with self._lock:
+            if source is None:
+                return sum(self._decisions_by_source.values())
+            return self._decisions_by_source.get(source, 0)
+
+    # -- writing -----------------------------------------------------------
+    def set(self, name: str, value, reason: str = "",
+            source: str = "manual"):
+        """Clamp ``value`` into ``name``'s declared bounds and apply it.
+        Returns the CLAMPED value actually in effect. A write that does
+        not move the knob records nothing; an accepted change records a
+        decision everywhere an operator might look for it (history,
+        ``spfft_control_*`` series, trace annotation)."""
+        spec = self.spec(name)
+        clamped = spec.clamp(value)
+        with self._lock:
+            old = self._values[name]
+            if clamped == old:
+                return old
+            self._values[name] = clamped
+            self._seq += 1
+            requested = spec.kind(value)
+            entry = {
+                "seq": self._seq, "knob": name, "old": old,
+                "new": clamped, "requested": requested,
+                "clamped": clamped != requested,
+                "reason": reason, "source": source,
+            }
+            self._history.append(entry)
+            self._decisions_by_source[source] = \
+                self._decisions_by_source.get(source, 0) + 1
+        obs = _counters()
+        obs.GLOBAL_COUNTERS.inc(
+            "spfft_control_decisions_total", 1,
+            help="Accepted control-plane knob changes.",
+            knob=name, source=source)
+        obs.GLOBAL_COUNTERS.set(
+            "spfft_control_knob", clamped,
+            help="Current value of each control-plane knob.", knob=name)
+        if entry["clamped"]:
+            obs.GLOBAL_COUNTERS.inc(
+                "spfft_control_clamped_total", 1,
+                help="Knob writes clamped into their declared bounds.",
+                knob=name)
+        if obs.active():
+            obs.GLOBAL_TRACER.instant(
+                "control.retune", cat="control", track="control",
+                args={"knob": name, "old": old, "new": clamped,
+                      "clamped": entry["clamped"], "reason": reason,
+                      "source": source})
+        return clamped
+
+    def update(self, values: Dict, reason: str = "",
+               source: str = "manual") -> Dict[str, float]:
+        """Apply several knobs; unknown names raise before anything is
+        written. Returns {name: clamped value in effect}."""
+        for name in values:
+            self.spec(name)  # validate all names first
+        return {name: self.set(name, v, reason=reason, source=source)
+                for name, v in values.items()}
+
+    # -- persistence -------------------------------------------------------
+    def to_artifact(self, provenance: Optional[Dict] = None) -> Dict:
+        """The recommended-config artifact format the tuner emits and
+        :meth:`load` consumes."""
+        return {ARTIFACT_KEY: ARTIFACT_VERSION,
+                "values": self.snapshot(),
+                "provenance": provenance or {}}
+
+    def save(self, path: str, provenance: Optional[Dict] = None) -> None:
+        with open(path, "w") as f:
+            json.dump(self.to_artifact(provenance), f, indent=2)
+
+    @classmethod
+    def load(cls, path: str) -> "ServeConfig":
+        """Load a recommended-config artifact. Unknown knobs in the
+        file raise (a misspelt knob silently ignored is a tuning run
+        thrown away); out-of-bounds values clamp, like every write."""
+        try:
+            with open(path) as f:
+                payload = json.load(f)
+        except (OSError, ValueError) as exc:
+            raise InvalidParameterError(
+                f"cannot read serve-config artifact {path!r}: {exc}")
+        if not isinstance(payload, dict) \
+                or payload.get(ARTIFACT_KEY) != ARTIFACT_VERSION:
+            raise InvalidParameterError(
+                f"{path!r} is not a spfft_tpu serve-config artifact "
+                f"(want {ARTIFACT_KEY}={ARTIFACT_VERSION})")
+        values = payload.get("values")
+        if not isinstance(values, dict):
+            raise InvalidParameterError(
+                f"{path!r} carries no 'values' mapping")
+        cfg = cls()
+        cfg.update(values, reason=f"loaded from {path}", source="boot")
+        return cfg
+
+    @classmethod
+    def boot(cls) -> "ServeConfig":
+        """The executor's default config source: a fresh config, seeded
+        from the ``SPFFT_TPU_SERVE_CONFIG`` artifact when that env var
+        is set (the auto-tuner's output applied at boot). Each executor
+        gets its OWN config object — a controller owns one executor's
+        knobs, not the process's."""
+        path = os.environ.get(CONFIG_ENV)
+        if path:
+            return cls.load(path)
+        return cls()
+
+
+#: Process-global config: the default the NON-serving layers
+#: (``parallel/dist.py`` overlap_chunks, ``PlanRegistry`` bounds)
+#: resolve through when no explicit value or executor-owned config is
+#: in play. Lazily boots from the env artifact.
+_GLOBAL: Optional[ServeConfig] = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def global_config() -> ServeConfig:
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if _GLOBAL is None:
+            _GLOBAL = ServeConfig.boot()
+        return _GLOBAL
+
+
+def set_global_config(cfg: Optional[ServeConfig]) -> None:
+    """Replace (or with None: reset, re-booting lazily) the process
+    default — tests and embedding applications."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        _GLOBAL = cfg

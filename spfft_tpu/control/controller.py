@@ -1,0 +1,315 @@
+"""Feedback controller: the obs→serve loop, closed.
+
+Consumes the live telemetry the serving stack already produces
+(:meth:`ServeMetrics.signals` — queue-wait and device-execute
+reservoirs, padded-rows and batch-histogram counters, stage/dispatch
+overhead accounting) and retunes the executor's :class:`ServeConfig`
+online. Every rule is DETERMINISTIC — pure arithmetic over counter
+deltas between steps, no wall-clock reads, no randomness — so a
+scripted telemetry sequence always produces the same decision sequence
+(the property the tier-1 scenario tests pin).
+
+Signals → rules → knobs (the docs/control_plane.md table, in code):
+
+* **batch_window** ← queue-wait p95 vs device-execute p50. Requests
+  waiting much longer than a bucket takes to execute means the window
+  is holding a backlog hostage → HALVE the window. Queue drained well
+  below the execute time → decay back toward the default (the window
+  only ever helps a trickle).
+* **pin_after** ← padded-rows ratio. A pad-heavy delta (ladder pad rows
+  per fused live row above ``pad_hi``) means the adaptive pinning
+  observer is too slow for this trace → pin one bucket sooner. Pads
+  gone → decay back toward the default.
+* **max_batch** ← fused batch histogram + queue depth. Buckets
+  repeatedly full AT the cap while a backlog persists → double the cap
+  (more rows per dispatch). Largest fused bucket far below the cap →
+  halve back toward the default.
+* **pipeline_depth** ← stage-vs-dispatch overlap ratio. Host staging
+  cost rivaling dispatch cost means the host is on the critical path →
+  one more in-flight slot to overlap it. Staging negligible → decay to
+  the backend-aware auto depth (0).
+
+Stability machinery, also deterministic:
+
+* **hysteresis** — every rule's shrink and grow thresholds are far
+  apart (``shrink_ratio`` vs ``grow_ratio``, ``pad_hi`` vs ``pad_lo``),
+  so a signal sitting between them changes nothing;
+* **cooldown** — after a knob moves, that knob is frozen for
+  ``cooldown_steps`` controller steps (steps, not seconds: determinism
+  again), so one burst cannot see-saw a knob within its own settling
+  time;
+* **idle decay** — a step with zero completed work and an empty queue
+  walks every managed knob one move back toward its declared default.
+
+Bounds are the config's own clamp — a rule can *request* anything and
+the knob still never leaves its declared range (the fuzz invariant).
+
+:class:`ControlLoop` wraps a controller in a background thread for live
+serving (``serve.bench --control``); tests call :meth:`Controller.step`
+directly with scripted signals.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Optional
+
+from .config import ServeConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class Decision:
+    """One accepted knob change (the controller's view; the config's
+    history carries the same facts for exporters)."""
+
+    step: int
+    knob: str
+    old: float
+    new: float
+    reason: str
+
+
+#: Knobs the feedback rules manage (everything else in ServeConfig is
+#: hot-swappable but only moved by operators/the tuner).
+MANAGED_KNOBS = ("batch_window", "pin_after", "max_batch",
+                 "pipeline_depth")
+
+
+class Controller:
+    """Rule-based feedback controller over one executor's config.
+
+    ``metrics`` supplies live signals (:meth:`ServeMetrics.signals`);
+    tests may instead pass a ``signals`` dict straight to :meth:`step`.
+    ``executor`` is optional and only consulted for the backend-aware
+    auto pipeline depth (the depth rule is skipped without it).
+    ``watchdog`` (an :class:`~spfft_tpu.control.slo.SLOWatchdog`) is
+    evaluated once per step when given, so one loop drives both
+    retuning and SLO accounting.
+    """
+
+    def __init__(self, config: ServeConfig, metrics=None, executor=None,
+                 watchdog=None, cooldown_steps: int = 3,
+                 shrink_ratio: float = 2.0, grow_ratio: float = 0.5,
+                 pad_hi: float = 0.25, pad_lo: float = 0.02,
+                 exec_floor_s: float = 1e-4):
+        self.config = config
+        self.metrics = metrics
+        self.executor = executor
+        self.watchdog = watchdog
+        self.cooldown_steps = max(0, int(cooldown_steps))
+        self.shrink_ratio = float(shrink_ratio)
+        self.grow_ratio = float(grow_ratio)
+        self.pad_hi = float(pad_hi)
+        self.pad_lo = float(pad_lo)
+        self.exec_floor_s = float(exec_floor_s)
+        self._step = 0
+        self._prev: Optional[Dict] = None
+        self._last_change: Dict[str, int] = {}
+        self._decisions: List[Decision] = []
+
+    # -- bookkeeping -------------------------------------------------------
+    @property
+    def steps(self) -> int:
+        return self._step
+
+    def decisions(self) -> List[Decision]:
+        return list(self._decisions)
+
+    def _cool(self, knob: str) -> bool:
+        last = self._last_change.get(knob)
+        return (last is not None
+                and self._step - last <= self.cooldown_steps)
+
+    def _retune(self, out: List[Decision], knob: str, value,
+                reason: str) -> None:
+        if self._cool(knob):
+            return
+        old = self.config.get(knob)
+        new = self.config.set(knob, value, reason=reason,
+                              source="controller")
+        if new != old:
+            self._last_change[knob] = self._step
+            d = Decision(self._step, knob, old, new, reason)
+            self._decisions.append(d)
+            out.append(d)
+
+    def _delta(self, signals: Dict, key: str) -> float:
+        prev = (self._prev or {}).get(key, 0)
+        return signals.get(key, 0) - prev
+
+    # -- the rules ---------------------------------------------------------
+    def step(self, signals: Optional[Dict] = None) -> List[Decision]:
+        """One deterministic control step over ``signals`` (defaults to
+        ``self.metrics.signals()``). Returns the decisions accepted this
+        step (possibly empty)."""
+        if signals is None:
+            if self.metrics is None:
+                raise ValueError("Controller needs metrics or explicit "
+                                 "signals")
+            signals = self.metrics.signals()
+        self._step += 1
+        out: List[Decision] = []
+        first = self._prev is None
+        completed_d = self._delta(signals, "completed")
+        idle = (completed_d == 0 and signals.get("queue_depth", 0) == 0)
+        if first:
+            pass  # calibration step: record the baseline, act next
+        elif idle:
+            self._decay_toward_defaults(out)
+        else:
+            self._rule_batch_window(out, signals)
+            self._rule_pin_after(out, signals)
+            self._rule_max_batch(out, signals)
+            self._rule_pipeline_depth(out, signals)
+        self._prev = dict(signals)
+        from .. import obs
+        obs.GLOBAL_COUNTERS.inc(
+            "spfft_control_steps_total", 1,
+            help="Controller steps executed.")
+        if self.watchdog is not None:
+            self.watchdog.evaluate()
+        return out
+
+    def _decay_toward_defaults(self, out: List[Decision]) -> None:
+        """Idle: walk each managed knob one move back toward its
+        default — windows/halvings retrace their own path, integer knobs
+        step by one."""
+        for knob in MANAGED_KNOBS:
+            cur = self.config.get(knob)
+            default = ServeConfig.default(knob)
+            if cur == default:
+                continue
+            if knob == "batch_window":
+                # retrace the halving/doubling path, snapping onto the
+                # default once one move reaches or crosses it
+                if cur < default:
+                    nxt = default if cur == 0 or cur * 2 >= default \
+                        else cur * 2
+                else:
+                    nxt = max(default, cur / 2)
+            else:
+                nxt = cur + 1 if cur < default else cur - 1
+            self._retune(out, knob, nxt, "idle: decay toward default")
+
+    def _rule_batch_window(self, out, s) -> None:
+        qw = s.get("queue_wait_p95", 0.0)
+        dx = max(s.get("device_execute_p50", 0.0), self.exec_floor_s)
+        w = self.config.get("batch_window")
+        default = ServeConfig.default("batch_window")
+        if qw > self.shrink_ratio * dx and w > 0.0:
+            self._retune(out, "batch_window", w / 2.0,
+                         f"queue buildup: queue_wait p95 {qw * 1e3:.2f}"
+                         f" ms > {self.shrink_ratio:g} x device p50 "
+                         f"{dx * 1e3:.2f} ms")
+        elif qw < self.grow_ratio * dx and w < default:
+            nxt = default if w == 0.0 else min(default, w * 2.0)
+            self._retune(out, "batch_window", nxt,
+                         f"queue drained: queue_wait p95 "
+                         f"{qw * 1e3:.2f} ms < {self.grow_ratio:g} x "
+                         f"device p50 {dx * 1e3:.2f} ms")
+
+    def _rule_pin_after(self, out, s) -> None:
+        rows_d = self._delta(s, "fused_rows")
+        if rows_d <= 0:
+            return
+        pad_d = self._delta(s, "padded_rows")
+        ratio = pad_d / rows_d
+        pin = self.config.get("pin_after")
+        default = ServeConfig.default("pin_after")
+        if ratio > self.pad_hi and pin > 1:
+            self._retune(out, "pin_after", pin - 1,
+                         f"pad-heavy trace: {pad_d:g} pad rows / "
+                         f"{rows_d:g} live rows = {ratio:.2f}")
+        elif ratio < self.pad_lo and pin < default:
+            self._retune(out, "pin_after", pin + 1,
+                         f"pads gone ({ratio:.3f}): decay toward "
+                         f"default")
+
+    def _rule_max_batch(self, out, s) -> None:
+        mb = self.config.get("max_batch")
+        default = ServeConfig.default("max_batch")
+        hist = s.get("fused_hist") or {}
+        prev_hist = (self._prev or {}).get("fused_hist") or {}
+        full_d = hist.get(mb, 0) - prev_hist.get(mb, 0)
+        sizes_d = [b for b in hist
+                   if hist.get(b, 0) - prev_hist.get(b, 0) > 0]
+        if full_d >= 3 and s.get("max_queue_depth", 0) > mb:
+            self._retune(out, "max_batch", mb * 2,
+                         f"backlog of full buckets: {full_d:g} buckets "
+                         f"at the cap {mb} with queue depth "
+                         f"{s.get('max_queue_depth', 0):g}")
+        elif mb > default and sizes_d \
+                and max(sizes_d) <= max(1, mb // 4):
+            self._retune(out, "max_batch", max(default, mb // 2),
+                         f"buckets far below cap: largest fused "
+                         f"{max(sizes_d)} <= {mb}//4")
+
+    def _rule_pipeline_depth(self, out, s) -> None:
+        if self.executor is None:
+            return
+        stage_d = self._delta(s, "stage_s")
+        disp_d = self._delta(s, "dispatch_s")
+        if disp_d <= 0:
+            return
+        cur = self.config.get("pipeline_depth")
+        try:
+            auto = self.executor._pipeline_slots()
+        except Exception:
+            return
+        if stage_d > 0.5 * disp_d:
+            base = cur if cur > 0 else auto
+            self._retune(out, "pipeline_depth", base + 1,
+                         f"host staging on the critical path: stage "
+                         f"{stage_d * 1e3:.1f} ms vs dispatch "
+                         f"{disp_d * 1e3:.1f} ms")
+        elif cur > 0 and stage_d < 0.1 * disp_d:
+            nxt = cur - 1 if cur > auto else 0
+            self._retune(out, "pipeline_depth", nxt,
+                         "staging negligible: decay toward auto depth")
+
+
+class ControlLoop:
+    """Background thread stepping a :class:`Controller` every
+    ``interval`` seconds against a live executor. The loop thread is
+    the only caller of ``step`` (decisions stay ordered); stop() joins
+    it. Use as a context manager around a serving window."""
+
+    def __init__(self, controller: Controller, interval: float = 0.05):
+        if interval <= 0:
+            raise ValueError("interval must be > 0")
+        self.controller = controller
+        self.interval = float(interval)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "ControlLoop":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="spfft-control-loop", daemon=True)
+            self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.controller.step()
+            except Exception:
+                # the control plane must never take down the data
+                # plane; a broken rule skips a beat, counted below
+                from .. import obs
+                obs.GLOBAL_COUNTERS.inc(
+                    "spfft_control_step_errors_total", 1,
+                    help="Controller steps that raised (skipped).")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+    def __enter__(self) -> "ControlLoop":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

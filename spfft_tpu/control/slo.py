@@ -1,0 +1,196 @@
+"""SLO watchdog: declared objectives evaluated against live metrics.
+
+An operator declares service-level objectives — p99 request latency, an
+error-rate budget, a quarantine ceiling — and the watchdog evaluates
+them against :class:`~spfft_tpu.serve.metrics.ServeMetrics` snapshots:
+each objective's BURN RATE (observed / objective) is exported as a
+``spfft_slo_*`` Prometheus gauge, and when any burn rate exceeds the
+declared budget the executor's ``health()`` flips to ``degraded`` (via
+``ServeMetrics.record_slo`` — the raw lifecycle state is preserved;
+SLO pressure only ever degrades an otherwise-healthy report, it cannot
+mask a failed executor).
+
+Declaration formats (docs/control_plane.md "SLO declaration"):
+
+* programmatic — ``SLOSpec(latency_p99_s=0.050, error_rate=0.01,
+  max_quarantines=0)`` (any subset; None = objective not declared);
+* CLI string — ``"p99_ms=50,error_rate=0.01,max_quarantines=0"``
+  (``serve.bench --slo``);
+* JSON file — ``{"latency_p99_s": 0.05, "error_rate": 0.01,
+  "max_quarantines": 0}`` (``--slo @objectives.json``).
+
+Burn-rate semantics: for a positive objective, ``observed /
+objective``; for a ZERO objective (e.g. ``max_quarantines=0`` — "never
+quarantine"), any observation at all burns infinitely. A violation is
+``burn > budget`` (budget default 1.0 — at the objective is still
+within it). Evaluation is pure arithmetic over one consistent metrics
+snapshot: deterministic given the snapshot, cheap enough to run every
+controller step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+from typing import Dict, Optional
+
+from ..errors import InvalidParameterError
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOSpec:
+    """Declared objectives; ``None`` leaves an objective undeclared."""
+
+    latency_p99_s: Optional[float] = None
+    error_rate: Optional[float] = None
+    max_quarantines: Optional[float] = None
+
+    def __post_init__(self):
+        for name in ("latency_p99_s", "error_rate", "max_quarantines"):
+            v = getattr(self, name)
+            if v is not None and (not isinstance(v, (int, float))
+                                  or v < 0 or math.isnan(float(v))):
+                raise InvalidParameterError(
+                    f"SLO objective {name} must be a number >= 0, "
+                    f"got {v!r}")
+
+    def declared(self) -> Dict[str, float]:
+        return {name: float(v) for name, v in dataclasses.asdict(
+            self).items() if v is not None}
+
+    @classmethod
+    def parse(cls, text: str) -> "SLOSpec":
+        """``"p99_ms=50,error_rate=0.01,max_quarantines=0"`` or
+        ``"@file.json"`` (a JSON object of objective fields)."""
+        text = text.strip()
+        if text.startswith("@"):
+            try:
+                with open(text[1:]) as f:
+                    payload = json.load(f)
+            except (OSError, ValueError) as exc:
+                raise InvalidParameterError(
+                    f"cannot read SLO file {text[1:]!r}: {exc}")
+            if not isinstance(payload, dict):
+                raise InvalidParameterError(
+                    f"SLO file {text[1:]!r} must hold a JSON object")
+            try:
+                return cls(**payload)
+            except TypeError as exc:
+                raise InvalidParameterError(f"bad SLO file: {exc}")
+        kwargs: Dict[str, float] = {}
+        for part in text.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "=" not in part:
+                raise InvalidParameterError(
+                    f"bad SLO entry {part!r} (want key=value)")
+            key, _, value = part.partition("=")
+            key = key.strip()
+            try:
+                v = float(value)
+            except ValueError:
+                raise InvalidParameterError(
+                    f"bad SLO value in {part!r}")
+            if key in ("p99_ms", "latency_p99_ms"):
+                kwargs["latency_p99_s"] = v / 1e3
+            elif key in ("p99_s", "latency_p99_s"):
+                kwargs["latency_p99_s"] = v
+            elif key == "error_rate":
+                kwargs["error_rate"] = v
+            elif key == "max_quarantines":
+                kwargs["max_quarantines"] = v
+            else:
+                raise InvalidParameterError(
+                    f"unknown SLO objective {key!r} (want p99_ms / "
+                    f"p99_s / error_rate / max_quarantines)")
+        return cls(**kwargs)
+
+
+def _burn(observed: float, objective: float) -> float:
+    if objective > 0:
+        return observed / objective
+    return math.inf if observed > 0 else 0.0
+
+
+class SLOWatchdog:
+    """Evaluates an :class:`SLOSpec` against ``metrics`` snapshots.
+
+    :meth:`evaluate` returns ``{"violations": [...], "burn": {...},
+    "observed": {...}, "objectives": {...}}`` and pushes the result
+    into the Prometheus registry and the metrics sink's health state.
+    """
+
+    def __init__(self, metrics, spec: SLOSpec, budget: float = 1.0):
+        if budget <= 0:
+            raise InvalidParameterError("SLO budget must be > 0")
+        self.metrics = metrics
+        self.spec = spec
+        self.budget = float(budget)
+        self.evaluations = 0
+
+    def _observed(self, signals: Dict) -> Dict[str, float]:
+        completed = signals.get("completed", 0)
+        failed = signals.get("failed", 0)
+        total = completed + failed
+        return {
+            "latency_p99_s": signals.get("latency_p99", 0.0),
+            "error_rate": (failed / total) if total else 0.0,
+            "max_quarantines": signals.get("quarantines", 0),
+        }
+
+    def evaluate(self, signals: Optional[Dict] = None) -> Dict:
+        """One evaluation over ``signals`` (defaults to a fresh
+        ``metrics.signals()`` snapshot)."""
+        if signals is None:
+            signals = self.metrics.signals()
+        observed_all = self._observed(signals)
+        objectives = self.spec.declared()
+        burn: Dict[str, float] = {}
+        observed: Dict[str, float] = {}
+        violations = []
+        for name, objective in objectives.items():
+            obs_v = observed_all[name]
+            b = _burn(obs_v, objective)
+            burn[name] = b
+            observed[name] = obs_v
+            if b > self.budget:
+                violations.append(name)
+        self.evaluations += 1
+        from .. import obs
+        obs.GLOBAL_COUNTERS.inc("spfft_slo_evaluations_total", 1,
+                                help="SLO watchdog evaluations.")
+        for name, objective in objectives.items():
+            labels = {"slo": name}
+            obs.GLOBAL_COUNTERS.set(
+                "spfft_slo_objective", objective,
+                help="Declared SLO objective value.", **labels)
+            obs.GLOBAL_COUNTERS.set(
+                "spfft_slo_observed", observed[name],
+                help="Observed value at last SLO evaluation.", **labels)
+            obs.GLOBAL_COUNTERS.set(
+                "spfft_slo_burn_rate",
+                burn[name] if math.isfinite(burn[name]) else -1.0,
+                help="observed/objective at last evaluation (-1 = "
+                     "infinite: a zero objective was burned).",
+                **labels)
+            obs.GLOBAL_COUNTERS.set(
+                "spfft_slo_violation",
+                1 if name in violations else 0,
+                help="1 while this SLO's burn rate exceeds its budget.",
+                **labels)
+        if violations:
+            obs.GLOBAL_COUNTERS.inc(
+                "spfft_slo_violations_total", len(violations),
+                help="SLO violations observed across evaluations.")
+        if obs.active():
+            obs.GLOBAL_TRACER.instant(
+                "slo.evaluate", cat="control", track="control",
+                args={"violations": ",".join(violations) or "none",
+                      "budget": self.budget})
+        if self.metrics is not None:
+            self.metrics.record_slo(violations)
+        return {"violations": violations, "burn": burn,
+                "observed": observed, "objectives": objectives,
+                "budget": self.budget}

@@ -20,6 +20,10 @@ code.
   :func:`prometheus_text` (text exposition over ServeMetrics,
   PlanRegistry, timing.GlobalTimer and the obs counters), plus the
   validating :func:`parse_prometheus_text`.
+* :mod:`~spfft_tpu.obs.http` — :class:`MetricsServer`, the opt-in
+  stdlib HTTP scrape endpoint (``/metrics`` Prometheus text,
+  ``/healthz`` readiness JSON, ``/configz`` live knob values); enable
+  via ``serve.bench --metrics-port`` or ``SPFFT_TPU_METRICS_PORT``.
 * ``python -m spfft_tpu.obs`` — CLI: ``demo`` records a small traced
   serving run and writes both artifacts; ``validate`` structurally
   checks a trace JSON; ``prom`` prints/validates exposition text.
@@ -41,6 +45,7 @@ from typing import Optional
 from .counters import GLOBAL_COUNTERS, Counters
 from .exporters import (export_trace, parse_prometheus_text,
                         prometheus_text, trace_events)
+from .http import METRICS_PORT_ENV, MetricsServer, port_from_env
 from .trace import (GLOBAL_TRACER, RequestTrace, Span, Tracer, active,
                     disable, enable)
 
@@ -50,6 +55,7 @@ __all__ = [
     "active", "enable", "disable",
     "export_trace", "trace_events", "prometheus_text",
     "parse_prometheus_text",
+    "MetricsServer", "METRICS_PORT_ENV", "port_from_env",
     "record_compile", "record_plan_build", "record_exchange_plan",
     "record_hlo_counts",
 ]

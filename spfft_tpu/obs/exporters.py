@@ -155,6 +155,7 @@ def _serve_families(b: _PromBuilder, snap: dict) -> None:
         ("serial_batches", "Buckets dispatched serially."),
         ("padded_rows", "Ladder pad rows dispatched."),
         ("pinned_batches", "Buckets dispatched at a pinned shape."),
+        ("fused_rows", "Live rows dispatched through fused buckets."),
     ]
     for key, help_ in counters:
         b.add(f"spfft_serve_{key}_total", "counter", help_,
@@ -172,6 +173,16 @@ def _serve_families(b: _PromBuilder, snap: dict) -> None:
         b.add("spfft_serve_latency_seconds", "gauge",
               "Request latency percentiles over the bounded reservoir.",
               v, {"quantile": q})
+    for key, metric, help_ in (
+            ("queue_wait_seconds", "spfft_serve_queue_wait_seconds",
+             "Enqueue->dispatch wait percentiles (recent window) — "
+             "the controller's queue-pressure signal."),
+            ("device_execute_seconds",
+             "spfft_serve_device_execute_seconds",
+             "Dispatch->materialised bucket time percentiles (recent "
+             "window) — the controller's device-cost signal.")):
+        for q, v in (snap.get(key) or {}).items():
+            b.add(metric, "gauge", help_, v, {"quantile": q})
     for cls, per in (snap.get("latency_seconds_by_class") or {}).items():
         for q, v in per.items():
             b.add("spfft_serve_latency_by_class_seconds", "gauge",

@@ -230,8 +230,15 @@ class DistributedTransformPlan:
         # (buffered/ring, float-wire included) chunk by static row
         # slices with no extra tables.
         if overlap_chunks is None:
-            overlap_chunks = int(
-                _os.environ.get(OVERLAP_CHUNKS_ENV, "1") or "1")
+            env = _os.environ.get(OVERLAP_CHUNKS_ENV)
+            if env:
+                overlap_chunks = int(env)
+            else:
+                # round 11: the knob's default lives in the typed
+                # control-plane config (boot artifact / auto-tuner
+                # recommendation), not a hard-coded constant
+                from ..control.config import global_config
+                overlap_chunks = int(global_config().overlap_chunks)
         if int(overlap_chunks) < 1:
             raise InvalidParameterError(
                 f"overlap_chunks must be >= 1, got {overlap_chunks}")

@@ -38,7 +38,10 @@ from three cooperating pieces:
   quarantine with probation/readmission (``NoHealthyDeviceError`` on an
   empty pool) and a crash-proof supervised dispatch loop
   (``ExecutorCrashedError``; health states via
-  ``ServeMetrics.health()``). See docs/serving.md "Failure semantics".
+  ``ServeMetrics.health()``). Quarantine counts only
+  DEVICE-attributed failures (``attributes_device``) — a poisoned
+  payload indicts the request, never the device it ran on. See
+  docs/serving.md "Failure semantics".
 
 End-to-end request observability lives in :mod:`spfft_tpu.obs`: when
 tracing is enabled (``SPFFT_TPU_TRACE=1`` / ``obs.enable()``), every
@@ -55,13 +58,21 @@ trace and reports p50/p95/p99 latency (per priority class with
 ``--fault-smoke`` the deterministic failure-semantics check, and
 ``--fault-rate``/``--fault-script`` inject faults into a measured
 replay.
+
+Every tunable of this layer lives in the typed, hot-swappable
+:class:`spfft_tpu.control.ServeConfig` (round 11) — a feedback
+controller can retune a live executor from its own telemetry, an
+offline auto-tuner emits the boot artifact, and an SLO watchdog
+degrades ``health()`` when declared objectives burn. See
+docs/control_plane.md.
 """
 
 from ..errors import (DeadlineExpiredError, DistributedPlanUnsupportedError,
                       ExecutorCrashedError, NoHealthyDeviceError,
                       QueueFullError, RetryExhaustedError, ServeError)
 from .executor import ServeExecutor
-from .faults import FaultPlan, InjectedFault, is_transient
+from .faults import (FaultPlan, InjectedFault, attributes_device,
+                     is_transient)
 from .metrics import PRIORITY_CLASSES, ServeMetrics, percentile
 from .registry import (PlanRegistry, PlanSignature, index_digest,
                        signature_for)
@@ -69,7 +80,7 @@ from .registry import (PlanRegistry, PlanSignature, index_digest,
 __all__ = [
     "PlanRegistry", "PlanSignature", "index_digest", "signature_for",
     "ServeExecutor", "ServeMetrics", "percentile", "PRIORITY_CLASSES",
-    "FaultPlan", "InjectedFault", "is_transient",
+    "FaultPlan", "InjectedFault", "is_transient", "attributes_device",
     "ServeError", "QueueFullError", "DeadlineExpiredError",
     "RetryExhaustedError", "NoHealthyDeviceError",
     "ExecutorCrashedError", "DistributedPlanUnsupportedError",

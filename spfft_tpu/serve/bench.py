@@ -47,6 +47,19 @@ exposition (round-tripped through the validating parser first);
 ``--profile-dir DIR`` captures a ``jax.profiler`` session around the
 measured window. See docs/observability.md.
 
+Control plane (round 11): ``--control`` arms the telemetry-driven
+feedback controller (``spfft_tpu.control``) for the measured replay —
+live retuning of batch window / pin policy / bucket cap / pipeline
+depth from the metrics stream, every decision recorded; in ``--smoke``
+it instead runs the deterministic scripted queue-buildup scenario and
+asserts a recorded, bounds-clamped batch-window decision plus zero SLO
+false positives (the round-11 acceptance observable). ``--slo`` declares
+objectives for the SLO watchdog, ``--config`` loads a recommended-config
+artifact (the ``python -m spfft_tpu.control tune`` output), and
+``--metrics-port`` (or ``SPFFT_TPU_METRICS_PORT``) serves the HTTP
+``/metrics`` / ``/healthz`` / ``/configz`` scrape endpoint for the
+replay. See docs/control_plane.md.
+
 The workload reuses the benchmark CLI's dense-within-cutoff stick
 generator (``spfft_tpu.benchmark.cutoff_stick_triplets``, reference:
 tests/programs/benchmark.cpp:176-205) at several sparsities, so the
@@ -148,8 +161,56 @@ def _parse_args(argv):
                    help="capture a jax.profiler trace of the measured "
                         "replay into DIR (the jax.named_scope phase "
                         "names become visible in the device profile)")
+    p.add_argument("--control", action="store_true",
+                   help="enable the telemetry-driven control plane: a "
+                        "feedback controller retunes batch window / "
+                        "pin policy / bucket cap / pipeline depth from "
+                        "live metrics during the measured replay; in "
+                        "--smoke it runs a deterministic scripted "
+                        "queue-buildup scenario and asserts a recorded "
+                        "bounds-clamped knob decision")
+    p.add_argument("--control-interval", type=float, default=0.02,
+                   help="controller step interval seconds for the live "
+                        "replay loop (default 0.02)")
+    p.add_argument("--slo", default=None, metavar="SPEC",
+                   help="declare SLOs for the watchdog, e.g. "
+                        "'p99_ms=50,error_rate=0.01,max_quarantines=0' "
+                        "or '@objectives.json'; burn rates export as "
+                        "spfft_slo_* gauges and a violation degrades "
+                        "health()")
+    p.add_argument("--config", default=None, metavar="CONFIG.json",
+                   help="load a recommended-config artifact (the "
+                        "'python -m spfft_tpu.control tune' output) as "
+                        "the executor's boot config; explicit knob "
+                        "flags still override it")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="serve GET /metrics (Prometheus text), "
+                        "/healthz and /configz on 127.0.0.1:PORT for "
+                        "the replay (0 = ephemeral port; default: the "
+                        "SPFFT_TPU_METRICS_PORT env var, else off)")
     p.add_argument("-o", "--output", default=None, metavar="FILE.json")
     return p.parse_args(argv)
+
+
+def _make_watchdog(args, metrics):
+    """The --slo watchdog (None when undeclared). In the smoke modes a
+    default generous healthy-trace spec is used when --control is on
+    without --slo, so the no-false-positive property is always
+    exercised."""
+    from ..control import SLOSpec, SLOWatchdog
+    if args.slo:
+        return SLOWatchdog(metrics, SLOSpec.parse(args.slo))
+    if args.control and (args.smoke or args.fault_smoke):
+        return SLOWatchdog(metrics, SLOSpec(latency_p99_s=60.0,
+                                            error_rate=0.5,
+                                            max_quarantines=64))
+    return None
+
+
+def _metrics_port(args):
+    from ..obs.http import port_from_env
+    return args.metrics_port if args.metrics_port is not None \
+        else port_from_env()
 
 
 def _finish_obs(args, failures, metrics=None, registry=None,
@@ -203,6 +264,89 @@ def _block(result) -> None:
     np.asarray(result).ravel()[:1]
 
 
+def _run_control_scenario(args, ex, registry, sig, plan, make_vals,
+                          wave, failures):
+    """The deterministic closed-loop acceptance scenario (``--smoke
+    --control``): a SCRIPTED queue buildup — several max_batch-sized
+    waves staged before a single synchronous drain, so every request's
+    recorded queue wait spans the buckets dispatched ahead of it —
+    must make the feedback controller shrink the batching window:
+    a recorded, bounds-clamped decision visible in the config history,
+    the ``spfft_control_decisions_total`` counter and (when tracing) a
+    ``control.retune`` annotation. Every buildup result is checked
+    bit-exact against the serial oracle, one more wave is served AFTER
+    the retune (mid-stream retune cannot perturb results), and the SLO
+    watchdog must report zero violations on this healthy trace (the
+    no-false-positive half of the acceptance criterion)."""
+    from ..control import Controller, ServeConfig
+
+    watchdog = _make_watchdog(args, ex.metrics)
+    controller = Controller(ex.config, metrics=ex.metrics, executor=ex,
+                            watchdog=watchdog)
+    controller.step()  # baseline: deltas start at the post-wave state
+    window_before = ex.config.batch_window
+    if window_before <= 0.0:
+        failures.append("control scenario needs a nonzero batch "
+                        "window to retune")
+    buildup = make_vals(6 * ex.config.max_batch)
+    oracles = [np.asarray(plan.backward(v)) for v in buildup]
+    futs = [ex.submit(sig, v) for v in buildup]
+    ex._drain_once()
+    decisions = controller.step()
+    for i, (f, expect) in enumerate(zip(futs, oracles)):
+        if not np.array_equal(np.asarray(f.result(timeout=60)), expect):
+            failures.append(f"control buildup request {i} diverged "
+                            f"from the serial oracle")
+    window_after = ex.config.batch_window
+    moved = [d for d in controller.decisions()
+             if d.knob == "batch_window"]
+    if not moved:
+        failures.append(
+            f"scripted queue buildup produced no batch_window "
+            f"decision (window {window_before} -> {window_after}; "
+            f"signals: {ex.metrics.signals()})")
+    elif window_after >= window_before:
+        failures.append(f"batch_window did not shrink under buildup: "
+                        f"{window_before} -> {window_after}")
+    lo, hi = ServeConfig.bounds("batch_window")
+    if not lo <= window_after <= hi:
+        failures.append(f"batch_window left its declared bounds: "
+                        f"{window_after} not in [{lo}, {hi}]")
+    from .. import obs as _obs_mod
+    if _obs_mod.GLOBAL_COUNTERS.get(
+            "spfft_control_decisions_total", knob="batch_window",
+            source="controller") < 1:
+        failures.append("spfft_control_decisions_total{knob="
+                        "batch_window,source=controller} not recorded")
+    # one more wave AFTER the retune: a mid-stream knob change must not
+    # perturb results (the correctness contract, observed)
+    post = make_vals(wave)
+    futs = [ex.submit(sig, v) for v in post]
+    ex._drain_once()
+    for i, (v, f) in enumerate(zip(post, futs)):
+        if not np.array_equal(np.asarray(f.result(timeout=60)),
+                              np.asarray(plan.backward(v))):
+            failures.append(f"post-retune request {i} diverged from "
+                            f"the serial oracle")
+    slo_summary = None
+    if watchdog is not None:
+        slo_summary = watchdog.evaluate()
+        if slo_summary["violations"]:
+            failures.append(f"SLO false positive on a healthy trace: "
+                            f"{slo_summary['violations']}")
+    import dataclasses
+    control_summary = {
+        "decisions": [dataclasses.asdict(d)
+                      for d in controller.decisions()],
+        "window_before": window_before,
+        "window_after": window_after,
+        "bounds": [lo, hi],
+        "knobs": ex.config.snapshot(),
+        "steps": controller.steps,
+    }
+    return control_summary, slo_summary
+
+
 def _run_smoke(args) -> int:
     """Deterministic pinning smoke: one signature, ``WAVES`` waves of
     ``WAVE`` (deliberately NOT a power of two) requests, each wave
@@ -230,17 +374,28 @@ def _run_smoke(args) -> int:
         TransformType.C2C, n, n, n, triplets, precision=args.precision)
     nv = plan.index_plan.num_values
     rng = np.random.default_rng(args.seed)
-    ex = ServeExecutor(registry, autostart=False, batch_window=0.0,
-                       pin_after=pin_after)
+    cfg = None
+    if args.config:
+        from ..control import ServeConfig
+        cfg = ServeConfig.load(args.config)
+    # with --control the batching window stays at its (config) default
+    # so the scripted buildup has a window for the controller to move;
+    # _drain_once never waits windows, so the waves stay deterministic
+    ex = ServeExecutor(registry, autostart=False,
+                       batch_window=None if args.control else 0.0,
+                       pin_after=pin_after, config=cfg)
+
+    def make_vals(count):
+        if args.precision == "single":
+            return [rng.standard_normal((nv, 2)).astype(np.float32)
+                    for _ in range(count)]
+        return [rng.standard_normal(nv) + 1j * rng.standard_normal(nv)
+                for _ in range(count)]
+
     failures = []
     pad_rows_per_wave = []
     for w in range(WAVES):
-        if args.precision == "single":
-            vals = [rng.standard_normal((nv, 2)).astype(np.float32)
-                    for _ in range(WAVE)]
-        else:
-            vals = [rng.standard_normal(nv)
-                    + 1j * rng.standard_normal(nv) for _ in range(WAVE)]
+        vals = make_vals(WAVE)
         before = ex.metrics.padded_rows
         futures = [ex.submit(sig, v) for v in vals]
         ex._drain_once()
@@ -250,6 +405,10 @@ def _run_smoke(args) -> int:
                                   np.asarray(plan.backward(v))):
                 failures.append(f"wave {w} request {i} diverged from "
                                 f"the serial oracle")
+    control_summary = slo_summary = None
+    if args.control:
+        control_summary, slo_summary = _run_control_scenario(
+            args, ex, registry, sig, plan, make_vals, WAVE, failures)
     snap = ex.metrics.snapshot(registry)
     ex.close()
     pinned = snap["pinned_batches"]
@@ -290,6 +449,15 @@ def _run_smoke(args) -> int:
           f"pin_after={pin_after}")
     print(f"pad rows per wave: {pad_rows_per_wave} "
           f"(pinned_batches={pinned})")
+    if control_summary is not None:
+        print(f"control: {len(control_summary['decisions'])} "
+              f"decisions, batch_window "
+              f"{control_summary['window_before'] * 1e3:.2f} -> "
+              f"{control_summary['window_after'] * 1e3:.2f} ms "
+              f"(bounds {control_summary['bounds']})")
+    if slo_summary is not None:
+        print(f"slo: violations={slo_summary['violations'] or 'none'} "
+              f"burn={ {k: round(v, 3) for k, v in slo_summary['burn'].items()} }")
     for msg in failures:
         print(f"FAIL: {msg}", file=sys.stderr)
     result = {
@@ -305,6 +473,8 @@ def _run_smoke(args) -> int:
         "padded_rows_per_wave": pad_rows_per_wave,
         "failures": failures,
         "obs": obs_summary,
+        "control": control_summary,
+        "slo": slo_summary,
     }
     print(json.dumps(result))
     if args.output:
@@ -567,17 +737,9 @@ def main(argv=None) -> int:
     from ..benchmark import cutoff_stick_triplets
     from ..types import TransformType
     from ..utils.platform import platform_summary
-    from .executor import (DEFAULT_BATCH_WINDOW, DEFAULT_MAX_BATCH,
-                           DEFAULT_PIN_AFTER, ServeExecutor)
+    from .executor import ServeExecutor
     from .metrics import ServeMetrics
     from .registry import PlanRegistry
-
-    window = (args.window if args.window is not None
-              else DEFAULT_BATCH_WINDOW)
-    max_batch = (args.max_batch if args.max_batch is not None
-                 else DEFAULT_MAX_BATCH)
-    pin_after = (args.pin_after if args.pin_after is not None
-                 else DEFAULT_PIN_AFTER)
 
     n = args.dim
     rng = np.random.default_rng(args.seed)
@@ -650,13 +812,22 @@ def main(argv=None) -> int:
     pool = jax.devices()
     if args.devices > 0:
         pool = pool[:args.devices]
-    executor = ServeExecutor(registry, batch_window=window,
-                             max_batch=max_batch,
+    # knob resolution: explicit flags > --config artifact > boot env >
+    # declared defaults — all through the executor's typed ServeConfig
+    cfg = None
+    if args.config:
+        from ..control import ServeConfig
+        cfg = ServeConfig.load(args.config)
+    executor = ServeExecutor(registry, batch_window=args.window,
+                             max_batch=args.max_batch,
                              max_queue=args.max_queue,
                              batching=not args.no_batching,
                              devices=pool if len(pool) > 1 else None,
-                             pin_after=pin_after,
-                             metrics=metrics)
+                             pin_after=args.pin_after,
+                             metrics=metrics, config=cfg)
+    window = executor.config.batch_window
+    max_batch = executor.config.max_batch
+    pin_after = executor.config.pin_after
 
     # Warm every (signature, device, batch-shape) executable the replay
     # will dispatch, so the measurement reflects a warm server the same
@@ -699,6 +870,27 @@ def main(argv=None) -> int:
                                scope=args.fault_scope,
                                script=args.fault_script)
         executor.inject_faults(fault_plan)
+    # opt-in scrape endpoint + control plane around the MEASURED replay
+    metrics_server = None
+    mport = _metrics_port(args)
+    if mport is not None:
+        from ..obs.http import MetricsServer
+        metrics_server = MetricsServer(executor=executor, port=mport)
+        print(f"metrics endpoint: "
+              f"http://127.0.0.1:{metrics_server.start()}/metrics "
+              f"(also /healthz, /configz)")
+    watchdog = None
+    if args.slo:
+        from ..control import SLOSpec, SLOWatchdog
+        watchdog = SLOWatchdog(metrics, SLOSpec.parse(args.slo))
+    controller = control_loop = None
+    if args.control:
+        from ..control import Controller, ControlLoop
+        controller = Controller(executor.config, metrics=metrics,
+                                executor=executor, watchdog=watchdog)
+        control_loop = ControlLoop(controller,
+                                   interval=args.control_interval)
+        control_loop.start()
     lock = threading.Lock()
     cursor = [0]
 
@@ -727,7 +919,12 @@ def main(argv=None) -> int:
         except Exception:
             failed_requests += 1
     served_s = time.perf_counter() - t0
+    if control_loop is not None:
+        control_loop.stop()
     executor.close()
+    slo_final = watchdog.evaluate() if watchdog is not None else None
+    if metrics_server is not None:
+        metrics_server.stop()
     if profiling:
         try:
             jax.profiler.stop_trace()
@@ -808,6 +1005,27 @@ def main(argv=None) -> int:
     print(f"health: {health['state']} "
           f"(crashes={health['dispatcher_crashes']} "
           f"restarts={health['dispatcher_restarts']})")
+    control_summary = None
+    if controller is not None:
+        import dataclasses
+        control_summary = {
+            "steps": controller.steps,
+            "decisions": [dataclasses.asdict(d)
+                          for d in controller.decisions()],
+            "knobs": executor.config.snapshot(),
+        }
+        print(f"control: {controller.steps} steps, "
+              f"{len(control_summary['decisions'])} decisions; final "
+              f"window={executor.config.batch_window * 1e3:.2f}ms "
+              f"max_batch={executor.config.max_batch} "
+              f"pin_after={executor.config.pin_after} "
+              f"pipeline_depth={executor.config.pipeline_depth}")
+        for d in control_summary["decisions"]:
+            print(f"  step {d['step']}: {d['knob']} {d['old']:g} -> "
+                  f"{d['new']:g} ({d['reason']})")
+    if slo_final is not None:
+        print(f"slo: violations={slo_final['violations'] or 'none'} "
+              f"burn={ {k: round(v, 3) for k, v in slo_final['burn'].items()} }")
 
     result = {
         "metric": f"serve.bench {n}^3 x{len(sigs)} signatures, "
@@ -836,6 +1054,8 @@ def main(argv=None) -> int:
                    else None),
         "obs": obs_summary,
         "obs_failures": obs_failures,
+        "control": control_summary,
+        "slo": slo_final,
         "serve_metrics": snap,
         "platform": platform_summary(),
     }

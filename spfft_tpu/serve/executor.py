@@ -102,6 +102,21 @@ work), per-request deadlines that expire queued work with
 ``DeadlineExpiredError`` before it wastes device time, and
 ``batching=False`` (or a fusion-ineligible regime) degrading gracefully
 to serial per-request dispatch.
+
+Control plane (``spfft_tpu.control``, round 11): every tunable above —
+batch window, bucket cap, queue bound, pin policy, pipeline depth,
+quarantine policy — lives in ONE typed, bounds-clamped
+:class:`~spfft_tpu.control.config.ServeConfig` the executor reads
+through on every use. A feedback controller can hot-swap any knob
+under the config's lock (the change applies from the next bucket, and
+the correctness contract above makes any mid-stream retune bit-exact);
+every accepted change is recorded as a Prometheus
+``spfft_control_decisions_total`` tick and a ``control.retune`` trace
+annotation. The executor feeds the controller's signals through
+``ServeMetrics``: per-request queue waits and per-bucket device-execute
+times land in recent-window reservoirs next to the round-7 pad/batch
+counters. Boot-time configuration loads from the
+``SPFFT_TPU_SERVE_CONFIG`` artifact (the offline auto-tuner's output).
 """
 
 from __future__ import annotations
@@ -118,6 +133,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from .. import obs as _obs
+from ..control.config import KNOB_SPECS, ServeConfig
 from ..errors import (DeadlineExpiredError, DistributedPlanUnsupportedError,
                       ExecutorCrashedError, InvalidParameterError,
                       NoHealthyDeviceError, QueueFullError,
@@ -125,49 +141,23 @@ from ..errors import (DeadlineExpiredError, DistributedPlanUnsupportedError,
 from ..multi import fusion_eligible, planned_batch_size
 from ..plan import TransformPlan
 from ..types import Scaling
-from .faults import FaultPlan, is_transient
+from .faults import FaultPlan, attributes_device, is_transient
 from .metrics import ServeMetrics
 from .registry import PlanRegistry, PlanSignature
 
-#: Default same-signature batching window (seconds): long enough to
-#: collect a burst dispatched by concurrent submitters, short enough to
-#: be invisible next to a single transform execution (ms-class). Retuned
-#: round 7 against measured arrival/orchestration latency: 8 submitter
-#: threads spread a bucket-of-8 worth of arrivals over ~0.1 ms, so 1 ms
-#: still absorbs a burst while halving the worst-case latency a trickle
-#: request pays waiting for company that never arrives; throughput at
-#: 1 ms vs the old 2 ms is noise-equivalent under backlog, where the
-#: window never applies (BENCHMARKS.md round-7).
-DEFAULT_BATCH_WINDOW = 0.001
-
-#: Default bucket cap — the fused-batch regime gate
-#: (multi.FUSED_BATCH_MAX_GRID) bounds total work; this bounds latency
-#: amplification for the first request of a burst.
-DEFAULT_MAX_BATCH = 8
-
-DEFAULT_MAX_QUEUE = 256
-
-#: Consecutive same-size fused buckets before that exact shape is
-#: pinned. 3 rides out one-off stragglers without delaying a genuinely
-#: stable trace; 0 disables pinning.
-DEFAULT_PIN_AFTER = 3
-
-#: Pinned exact shapes kept per signature (LRU). Each pin compiles one
-#: extra executable per (kind, device), so the total compile bound stays
-#: O(log max_batch) ladder + this.
-DEFAULT_MAX_PINNED = 4
-
-#: Consecutive failures on one pool device before it is quarantined.
-#: 3 rides out a transient blip without condemning the device; 0
-#: disables quarantine entirely. Consecutive means successes reset the
-#: count — a sick device fails everything routed to it, a healthy
-#: device interleaves successes.
-DEFAULT_QUARANTINE_AFTER = 3
-
-#: Initial quarantine backoff (seconds). Each failed probation canary
-#: doubles it (capped), each successful canary re-admits the device and
-#: resets it.
-DEFAULT_QUARANTINE_BACKOFF = 0.25
+# Knob defaults live in ONE place since round 11: the control plane's
+# KNOB_SPECS (spfft_tpu/control/config.py), which also declares each
+# knob's hard bounds and driving telemetry signal. The aliases below
+# keep the historical import surface (bench/tests read these) — the
+# measured provenance of the values (round-7 window/pinning retunes,
+# round-8 quarantine policy) is documented on the specs.
+DEFAULT_BATCH_WINDOW = KNOB_SPECS["batch_window"].default
+DEFAULT_MAX_BATCH = KNOB_SPECS["max_batch"].default
+DEFAULT_MAX_QUEUE = KNOB_SPECS["max_queue"].default
+DEFAULT_PIN_AFTER = KNOB_SPECS["pin_after"].default
+DEFAULT_MAX_PINNED = KNOB_SPECS["max_pinned_shapes"].default
+DEFAULT_QUARANTINE_AFTER = KNOB_SPECS["quarantine_after"].default
+DEFAULT_QUARANTINE_BACKOFF = KNOB_SPECS["quarantine_backoff"].default
 
 #: Ceiling on the exponential probation backoff.
 QUARANTINE_BACKOFF_CAP = 60.0
@@ -328,35 +318,63 @@ class ServeExecutor:
     """
 
     def __init__(self, registry: PlanRegistry,
-                 batch_window: float = DEFAULT_BATCH_WINDOW,
-                 max_batch: int = DEFAULT_MAX_BATCH,
-                 max_queue: int = DEFAULT_MAX_QUEUE,
+                 batch_window: Optional[float] = None,
+                 max_batch: Optional[int] = None,
+                 max_queue: Optional[int] = None,
                  batching: bool = True,
                  devices=None,
                  metrics: Optional[ServeMetrics] = None,
-                 pin_after: int = DEFAULT_PIN_AFTER,
-                 max_pinned_shapes: int = DEFAULT_MAX_PINNED,
+                 pin_after: Optional[int] = None,
+                 max_pinned_shapes: Optional[int] = None,
                  pipeline_depth: Optional[int] = None,
                  fault_plan: Optional[FaultPlan] = None,
-                 quarantine_after: int = DEFAULT_QUARANTINE_AFTER,
-                 quarantine_backoff: float = DEFAULT_QUARANTINE_BACKOFF,
+                 quarantine_after: Optional[int] = None,
+                 quarantine_backoff: Optional[float] = None,
                  max_dispatch_restarts: int = DEFAULT_MAX_RESTARTS,
                  retry_budget: Optional[Dict[str, int]] = None,
                  prewarm_on_pin: bool = True,
-                 autostart: bool = True):
-        if max_batch < 1 or max_queue < 1:
+                 autostart: bool = True,
+                 config: Optional[ServeConfig] = None):
+        # Knob resolution (round 11): every tunable lives in ONE typed
+        # ServeConfig the control plane owns. Explicit constructor
+        # arguments are validated (the historical error contract) and
+        # override the config; None defers to the config's value —
+        # which is the declared default, the SPFFT_TPU_SERVE_CONFIG
+        # boot artifact, or whatever a live controller has retuned it
+        # to. The dispatcher reads the knobs through the config on
+        # every use, so a controller's set() applies from the next
+        # bucket (hot-swap under the config's lock).
+        if max_batch is not None and max_batch < 1 \
+                or max_queue is not None and max_queue < 1:
             raise InvalidParameterError(
                 "max_batch and max_queue must be >= 1")
         if pipeline_depth is not None and pipeline_depth < 1:
             raise InvalidParameterError("pipeline_depth must be >= 1")
-        if pin_after < 0 or max_pinned_shapes < 1:
+        if pin_after is not None and pin_after < 0 \
+                or max_pinned_shapes is not None \
+                and max_pinned_shapes < 1:
             raise InvalidParameterError(
                 "pin_after must be >= 0 and max_pinned_shapes >= 1")
-        if quarantine_after < 0 or quarantine_backoff <= 0.0 \
+        if quarantine_after is not None and quarantine_after < 0 \
+                or quarantine_backoff is not None \
+                and quarantine_backoff <= 0.0 \
                 or max_dispatch_restarts < 0:
             raise InvalidParameterError(
                 "quarantine_after and max_dispatch_restarts must be "
                 ">= 0, quarantine_backoff > 0")
+        self.config = config if config is not None else ServeConfig.boot()
+        overrides = {
+            "batch_window": batch_window, "max_batch": max_batch,
+            "max_queue": max_queue, "pin_after": pin_after,
+            "max_pinned_shapes": max_pinned_shapes,
+            "pipeline_depth": pipeline_depth,
+            "quarantine_after": quarantine_after,
+            "quarantine_backoff": quarantine_backoff,
+        }
+        for name, value in overrides.items():
+            if value is not None:
+                self.config.set(name, value, source="init",
+                                reason="constructor override")
         budget = dict(DEFAULT_RETRY_BUDGET)
         if retry_budget:
             unknown = set(retry_budget) - set(_PRIORITIES)
@@ -382,16 +400,9 @@ class ServeExecutor:
             devices = list(jax.devices())
         self._devices = list(devices) if devices else [None]
         self._rotor = 0
-        self._batch_window = float(batch_window)
-        self._max_batch = int(max_batch)
-        self._max_queue = int(max_queue)
+        self._auto_extra: Optional[int] = None
         self._batching = bool(batching)
-        self._pin_after = int(pin_after)
-        self._max_pinned = int(max_pinned_shapes)
-        self._pipeline_depth = pipeline_depth
         self._faults = fault_plan
-        self._q_after = int(quarantine_after)
-        self._q_backoff = float(quarantine_backoff)
         self._max_restarts = int(max_dispatch_restarts)
         self._prewarm_on_pin = bool(prewarm_on_pin)
         self._pool_lock = threading.Lock()
@@ -426,6 +437,40 @@ class ServeExecutor:
         self._thread: Optional[threading.Thread] = None
         if autostart:
             self.start()
+
+    # -- knobs (hot-swappable: every read goes through the config) ---------
+    @property
+    def _batch_window(self) -> float:
+        return self.config.batch_window
+
+    @property
+    def _max_batch(self) -> int:
+        return self.config.max_batch
+
+    @property
+    def _max_queue(self) -> int:
+        return self.config.max_queue
+
+    @property
+    def _pin_after(self) -> int:
+        return self.config.pin_after
+
+    @property
+    def _max_pinned(self) -> int:
+        return self.config.max_pinned_shapes
+
+    @property
+    def _pipeline_depth(self) -> Optional[int]:
+        depth = self.config.pipeline_depth
+        return None if depth == 0 else depth
+
+    @property
+    def _q_after(self) -> int:
+        return self.config.quarantine_after
+
+    @property
+    def _q_backoff(self) -> float:
+        return self.config.quarantine_backoff
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -511,13 +556,15 @@ class ServeExecutor:
     def health(self) -> Dict:
         """The :meth:`ServeMetrics.health` snapshot plus live per-device
         pool state (index, health state, consecutive failures, current
-        probation backoff)."""
+        probation backoff) and the current knob values (the config a
+        controller may be retuning live)."""
         snap = self.metrics.health()
         with self._pool_lock:
             snap["devices"] = [
                 {"index": s.index, "state": s.state,
                  "consecutive_failures": s.failures,
                  "backoff_s": s.backoff} for s in self._slots]
+        snap["config"] = self.config.snapshot()
         return snap
 
     def _fail_requests(self, reqs, exc: BaseException) -> None:
@@ -773,13 +820,16 @@ class ServeExecutor:
         thread pool and thrash it — the round-6 finding that serialised
         the pool in the first place; re-measured this round at -15% on
         the same-signature trace), so CPU keeps the strict
-        dispatch-then-resolve window of pool size. ``pipeline_depth``
-        overrides the choice."""
-        if self._pipeline_depth is not None:
-            return self._pipeline_depth
-        import jax
-        extra = 0 if jax.default_backend() == "cpu" else 1
-        return len(self._devices) + extra
+        dispatch-then-resolve window of pool size. The
+        ``pipeline_depth`` knob (nonzero) overrides the choice — read
+        per dispatch iteration, so a controller retune applies live."""
+        depth = self._pipeline_depth
+        if depth is not None:
+            return depth
+        if self._auto_extra is None:
+            import jax
+            self._auto_extra = 0 if jax.default_backend() == "cpu" else 1
+        return len(self._devices) + self._auto_extra
 
     def _run_dispatcher(self) -> None:
         """Crash-proof supervisor around :meth:`_dispatch_loop`. An
@@ -833,8 +883,10 @@ class ServeExecutor:
         # the forming bucket live on the executor (not loop locals) so
         # the supervisor can resolve their futures after a crash.
         inflight = self._inflight
-        depth = self._pipeline_slots()
         while True:
+            # read the (hot-swappable) depth each iteration so a
+            # controller retune of pipeline_depth applies immediately
+            depth = self._pipeline_slots()
             self._check_fault("loop")
             shard = bucket = None
             with self._cv:
@@ -956,11 +1008,28 @@ class ServeExecutor:
                                            track=_dev_track(slot))
             self._push_health()
 
-    def _device_fail(self, slot: Optional[_DeviceSlot]) -> None:
+    def _device_fail(self, slot: Optional[_DeviceSlot],
+                     exc: Optional[BaseException] = None) -> None:
         """A request failed on ``slot``: bump its consecutive-failure
         count; crossing ``quarantine_after`` (or failing its probation
-        canary) quarantines it with exponential backoff."""
+        canary) quarantines it with exponential backoff.
+
+        ``exc`` drives the ATTRIBUTION gate (the round-11 fix): a
+        REQUEST-attributed failure (``faults.attributes_device`` False
+        — a poisoned payload fails the same way on every healthy
+        device) never charges the device's streak, so a pure
+        poisoned-request flood can no longer spuriously quarantine a
+        healthy device. A probation canary that failed for request
+        reasons returns the slot to quarantine with its verdict
+        undecided — immediately probe-able, backoff NOT doubled."""
         if slot is None or self._q_after <= 0:
+            return
+        if exc is not None and not attributes_device(exc):
+            self.metrics.record_request_attributed_failure()
+            with self._pool_lock:
+                if slot.state == "probation":
+                    slot.state = "quarantined"
+                    slot.until = time.monotonic()
             return
         quarantined = False
         with self._pool_lock:
@@ -1185,8 +1254,8 @@ class ServeExecutor:
                 res = req.plan.forward(req.values, req.scaling,
                                        device=device)
             jax.block_until_ready(res)
-        except Exception:
-            self._device_fail(slot)
+        except Exception as exc:
+            self._device_fail(slot, exc)
             raise
         self._device_ok(slot)
         return res
@@ -1311,6 +1380,11 @@ class ServeExecutor:
         bucket-level trace spans; its ``serve.device_execute`` span
         stays open across the return and closes in :meth:`_finish`."""
         now = time.monotonic()
+        # control-plane signal: enqueue->dispatch wait per request
+        # (includes any batching window sat out) — what the feedback
+        # controller weighs against device-execute time
+        self.metrics.record_queue_waits(
+            [now - req.enqueued_at for req in bucket])
         live: List[_Request] = []
         expired: List[_Request] = []
         for req in bucket:
@@ -1389,7 +1463,7 @@ class ServeExecutor:
                 if bt is not None:
                     bt.end_all("error", type(exc).__name__)
                 self._release(shard.key, shape, buf)
-                self._device_fail(slot)
+                self._device_fail(slot, exc)
                 self.metrics.record_bucket_fallback()
                 self._annotate_fallback(live, exc)
                 self._recover_serial(live, exc, pooled)
@@ -1401,7 +1475,8 @@ class ServeExecutor:
             if bt is not None:
                 bt.end("serve.dispatch")
                 bt.begin("serve.device_execute", track=_dev_track(slot))
-            return live, results, shard.key, shape, buf, [slot], True, bt
+            return (live, results, shard.key, shape, buf, [slot], True,
+                    bt, t1)
         # serial path: dispatch every request before blocking on any
         # result (the multi.py async-overlap idiom), fanned round-robin
         # across the device pool; failures are isolated per request
@@ -1427,7 +1502,7 @@ class ServeExecutor:
                 self._fail_requests([req], exc)
                 continue
             except Exception as exc:
-                self._device_fail(slot)
+                self._device_fail(slot, exc)
                 self._retry_request(req, exc, pooled)
                 continue
             keep.append(req)
@@ -1444,10 +1519,12 @@ class ServeExecutor:
         if bt is not None:
             bt.begin("serve.device_execute",
                      track=_dev_track(slots[0] if slots else None))
-        return keep, results, shard.key, shape, buf, slots, False, bt
+        return (keep, results, shard.key, shape, buf, slots, False, bt,
+                t0)
 
     def _finish(self, live, results, shard_key=None, shape=0,
-                buf=None, slots=None, fused=False, bt=None) -> None:
+                buf=None, slots=None, fused=False, bt=None,
+                t_disp=None) -> None:
         """Materialise a dispatched bucket and resolve its futures:
         latency samples measure completion (not dispatch), and async XLA
         failures surface here as exceptions instead of poisoned arrays.
@@ -1473,7 +1550,7 @@ class ServeExecutor:
             self._release(shard_key, shape, buf)
             pooled = bool(slots) and slots[0] is not None
             if fused:
-                self._device_fail(slots[0] if slots else None)
+                self._device_fail(slots[0] if slots else None, exc)
                 self.metrics.record_bucket_fallback()
                 self._annotate_fallback(live, exc)
                 self._recover_serial(live, exc, pooled)
@@ -1483,7 +1560,7 @@ class ServeExecutor:
                 try:
                     jax.block_until_ready(results[i])
                 except Exception as exc_i:
-                    self._device_fail(slot)
+                    self._device_fail(slot, exc_i)
                     self._retry_request(req, exc_i, slot is not None)
                     continue
                 self._device_ok(slot)
@@ -1492,6 +1569,10 @@ class ServeExecutor:
         if bt is not None:
             bt.end("serve.materialise")
             bt.end("serve.device_execute")
+        if t_disp is not None:
+            # control-plane signal: dispatch -> materialised per bucket
+            self.metrics.record_device_execute(
+                time.perf_counter() - t_disp)
         self._release(shard_key, shape, buf)
         for slot in (slots or ()):
             self._device_ok(slot)

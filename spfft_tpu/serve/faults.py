@@ -46,7 +46,8 @@ import re
 import threading
 from typing import Dict, List, Optional, Tuple
 
-from ..errors import InvalidParameterError, ServeError
+from ..errors import (DuplicateIndicesError, InvalidIndicesError,
+                      InvalidParameterError, ServeError)
 
 #: The executor's named fault-check sites.
 SITES = ("stage", "dispatch", "materialise", "loop")
@@ -61,12 +62,17 @@ TRANSIENT_MARKERS = ("RESOURCE_EXHAUSTED", "UNAVAILABLE",
 
 class InjectedFault(ServeError):
     """A failure raised by a :class:`FaultPlan` check. Carries the
-    ``transient`` classification the executor's retry policy reads;
+    ``transient`` classification the executor's retry policy reads and
+    the ``device_attributed`` classification its quarantine accounting
+    reads (True by default — injection simulates infrastructure faults;
+    the ``poison`` script kind injects request-attributed ones);
     otherwise handled exactly like any runtime failure."""
 
-    def __init__(self, message: str, transient: bool = True):
+    def __init__(self, message: str, transient: bool = True,
+                 device_attributed: bool = True):
         super().__init__(message)
         self.transient = transient
+        self.device_attributed = device_attributed
 
 
 def is_transient(exc: BaseException) -> bool:
@@ -84,15 +90,46 @@ def is_transient(exc: BaseException) -> bool:
     return any(marker in text for marker in TRANSIENT_MARKERS)
 
 
+#: Exception types that indict the REQUEST, not the device it ran on:
+#: shape/type/index errors (a poisoned payload fails identically on
+#: every healthy device) and the library's own validation errors.
+REQUEST_ERROR_TYPES = (TypeError, ValueError, IndexError, KeyError,
+                       InvalidParameterError, InvalidIndicesError,
+                       DuplicateIndicesError)
+
+
+def attributes_device(exc: BaseException) -> bool:
+    """Whether a failure should count against the DEVICE it ran on
+    (quarantine accounting) rather than the request that triggered it.
+    An explicit ``device_attributed`` attribute wins (injected faults,
+    or a runtime that tags its errors); request-shaped errors
+    (:data:`REQUEST_ERROR_TYPES` — a poisoned payload raises the same
+    error on every healthy device) indict the request; everything else
+    — XLA runtime errors, timeouts, unknown failures — charges the
+    device, which preserves the round-8 quarantine behaviour for real
+    hardware faults. This is the classifier that stops a pure
+    poisoned-request flood from spuriously quarantining a healthy
+    device (ROADMAP round-11 follow-on)."""
+    tagged = getattr(exc, "device_attributed", None)
+    if tagged is not None:
+        return bool(tagged)
+    if isinstance(exc, REQUEST_ERROR_TYPES):
+        return False
+    return True
+
+
 _ENTRY_RE = re.compile(
     r"^(?P<site>[a-z]+|device\d+)@(?P<nth>\d+|\*)(?::(?P<kind>\w+))?$")
 
 
-def _parse_entry(spec: str) -> Tuple[str, Optional[int], bool]:
+def _parse_entry(spec: str) -> Tuple[str, Optional[int], str]:
     """One script entry ``SITE@N[:KIND]`` -> (counter key, nth-or-None
-    for always, transient flag). SITE is a check site or ``deviceK``;
-    ``N`` is the 1-based call index of that counter, ``*`` fires on
-    every call; KIND is ``transient`` (default) or ``permanent``."""
+    for always, kind). SITE is a check site or ``deviceK``; ``N`` is
+    the 1-based call index of that counter, ``*`` fires on every call;
+    KIND is ``transient`` (default), ``permanent`` (both
+    device-attributed) or ``poison`` (permanent AND request-attributed
+    — simulates a bad payload, exercising the quarantine-attribution
+    seam)."""
     m = _ENTRY_RE.match(spec.strip())
     if not m:
         raise InvalidParameterError(
@@ -106,10 +143,11 @@ def _parse_entry(spec: str) -> Tuple[str, Optional[int], bool]:
     if nth is not None and nth < 1:
         raise InvalidParameterError("fault-script call index is 1-based")
     kind = m.group("kind") or "transient"
-    if kind not in ("transient", "permanent"):
+    if kind not in ("transient", "permanent", "poison"):
         raise InvalidParameterError(
-            f"fault kind must be transient|permanent, got {kind!r}")
-    return site, nth, kind == "transient"
+            f"fault kind must be transient|permanent|poison, "
+            f"got {kind!r}")
+    return site, nth, kind
 
 
 class FaultPlan:
@@ -139,11 +177,12 @@ class FaultPlan:
         self._rate = float(rate)
         self._rng = random.Random(seed)
         self._scope = scope
-        self._script: List[Tuple[str, Optional[int], bool]] = \
+        self._script: List[Tuple[str, Optional[int], str]] = \
             [_parse_entry(s) for s in (script or [])]
         self._lock = threading.Lock()
         self._calls: Dict[str, int] = {}
-        self._fired: Dict[str, int] = {"transient": 0, "permanent": 0}
+        self._fired: Dict[str, int] = {"transient": 0, "permanent": 0,
+                                       "poison": 0}
         self._fired_by_site: Dict[str, int] = {}
 
     def _in_scope(self, site: str, dev_key: Optional[str]) -> bool:
@@ -164,25 +203,25 @@ class FaultPlan:
                 dn = self._calls[dev_key] = self._calls.get(dev_key,
                                                            0) + 1
             fire = None
-            for key, nth, transient in self._script:
+            for key, nth, kind in self._script:
                 hit = (key == site and (nth is None or nth == n)) or \
                       (key == dev_key and (nth is None or nth == dn))
                 if hit:
-                    fire = transient
+                    fire = kind
                     break
             if fire is None and self._rate > 0.0 \
                     and self._in_scope(site, dev_key):
                 if self._rng.random() < self._rate:
-                    fire = True
+                    fire = "transient"
             if fire is None:
                 return
-            kind = "transient" if fire else "permanent"
-            self._fired[kind] += 1
+            self._fired[fire] += 1
             self._fired_by_site[site] = \
                 self._fired_by_site.get(site, 0) + 1
         where = site if device is None else f"{site} (device {device})"
-        raise InjectedFault(f"injected {kind} fault at {where}",
-                            transient=fire)
+        raise InjectedFault(f"injected {fire} fault at {where}",
+                            transient=fire == "transient",
+                            device_attributed=fire != "poison")
 
     def stats(self) -> Dict:
         """Counter snapshot: checks seen and faults fired, per site."""
@@ -194,5 +233,6 @@ class FaultPlan:
                 "checks": dict(self._calls),
                 "fired_transient": self._fired["transient"],
                 "fired_permanent": self._fired["permanent"],
+                "fired_poison": self._fired["poison"],
                 "fired_by_site": dict(self._fired_by_site),
             }

@@ -43,6 +43,12 @@ PRIORITY_CLASSES = ("high", "normal")
 #: million-request day holds ~64 KB of floats per class.
 DEFAULT_LATENCY_WINDOW = 4096
 
+#: Reservoir size for the control-plane signal distributions
+#: (queue-wait per request, device-execute per bucket): the feedback
+#: controller reads a RECENT-window percentile, so a smaller ring keeps
+#: it responsive to regime changes.
+DEFAULT_SIGNAL_WINDOW = 1024
+
 
 def percentile(samples: List[float], p: float) -> float:
     """Nearest-rank percentile (p in [0, 100]); 0.0 on no samples. The
@@ -87,8 +93,17 @@ class ServeMetrics:
             self._serial_hist: Dict[int, int] = {}
             self._fused_batches = 0
             self._serial_batches = 0
+            self._fused_rows = 0
             self._padded_rows = 0
             self._pinned_batches = 0
+            # control-plane signal reservoirs (recent window):
+            # queue-wait is enqueue -> dispatch per request (includes
+            # the batching window a request sat out), device-execute is
+            # dispatch -> materialised per bucket
+            self._queue_waits: collections.deque = collections.deque(
+                maxlen=DEFAULT_SIGNAL_WINDOW)
+            self._device_exec: collections.deque = collections.deque(
+                maxlen=DEFAULT_SIGNAL_WINDOW)
             self._stage_s = 0.0
             self._dispatch_s = 0.0
             self._completed = 0
@@ -113,6 +128,8 @@ class ServeMetrics:
             self._dispatcher_crashes = 0
             self._dispatcher_restarts = 0
             self._pin_prewarms = 0
+            self._request_attributed_failures = 0
+            self._slo_violations: tuple = ()
             self._health_state = "healthy"
 
     # -- recording (executor-facing) ---------------------------------------
@@ -194,6 +211,37 @@ class ServeMetrics:
         with self._lock:
             self._pin_prewarms += 1
 
+    def record_request_attributed_failure(self) -> None:
+        """A pooled execution failed with a REQUEST-attributed error
+        (``faults.attributes_device`` said the payload, not the device,
+        is the culprit) — the device's quarantine streak was NOT
+        charged."""
+        with self._lock:
+            self._request_attributed_failures += 1
+
+    def record_queue_waits(self, waits) -> None:
+        """Enqueue->dispatch wait of each request in one dispatched
+        bucket (seconds) — the controller's queue-pressure signal. One
+        lock acquisition per bucket."""
+        with self._lock:
+            self._queue_waits.extend(waits)
+
+    def record_device_execute(self, seconds: float) -> None:
+        """Dispatch->materialised wall time of one bucket — the
+        controller's device-cost signal (on accelerators this spans the
+        async in-flight window; on CPU dispatch itself computes, so it
+        is close to the dispatch overhead)."""
+        with self._lock:
+            self._device_exec.append(seconds)
+
+    def record_slo(self, violations) -> None:
+        """The SLO watchdog's verdict: the currently-burning objective
+        names (empty = within budget). A non-empty set degrades the
+        reported health of an otherwise-healthy executor; it never
+        masks a worse lifecycle state."""
+        with self._lock:
+            self._slo_violations = tuple(violations)
+
     def record_health(self, state: str) -> None:
         """The executor pushes its lifecycle state here on transitions:
         ``healthy`` / ``degraded`` / ``draining`` / ``failed``."""
@@ -217,6 +265,7 @@ class ServeMetrics:
             hist[size] = hist.get(size, 0) + 1
             if fused:
                 self._fused_batches += 1
+                self._fused_rows += int(size)
                 self._padded_rows += int(padded_rows)
                 if pinned:
                     self._pinned_batches += 1
@@ -266,9 +315,18 @@ class ServeMetrics:
 
     def _health_locked(self) -> Dict:
         """Caller holds the lock — shared by :meth:`health` and the
-        single-lock :meth:`snapshot`."""
+        single-lock :meth:`snapshot`. The reported state is the
+        executor's lifecycle state, degraded by an active SLO burn when
+        (and only when) the lifecycle itself is healthy."""
+        state = self._health_state
+        if state == "healthy" and self._slo_violations:
+            state = "degraded"
         return {
-            "state": self._health_state,
+            "state": state,
+            "lifecycle_state": self._health_state,
+            "slo_violations": list(self._slo_violations),
+            "request_attributed_failures":
+                self._request_attributed_failures,
             "retries": self._retries,
             "retries_exhausted": self._retries_exhausted,
             "retries_by_class": dict(self._retries_by),
@@ -310,6 +368,40 @@ class ServeMetrics:
                 "p95": percentile(samples, 95.0),
                 "p99": percentile(samples, 99.0)}
 
+    def signals(self) -> Dict:
+        """The control plane's view: one consistent, JSON-ready dict of
+        every signal the feedback controller and SLO watchdog consume —
+        recent-window queue-wait / device-execute percentiles,
+        cumulative batch/pad/overhead counters and the fused histogram
+        (cumulative: the controller diffs successive snapshots itself,
+        which keeps this read side stateless). One lock acquisition."""
+        with self._lock:
+            qw = list(self._queue_waits)
+            dx = list(self._device_exec)
+            lat = [s for d in self._latencies.values() for s in d]
+            out = {
+                "completed": self._completed,
+                "failed": self._failed,
+                "queue_depth": self._queue_depth,
+                "max_queue_depth": self._max_queue_depth,
+                "rejected_queue_full": self._rejected_queue_full,
+                "padded_rows": self._padded_rows,
+                "pinned_batches": self._pinned_batches,
+                "fused_batches": self._fused_batches,
+                "serial_batches": self._serial_batches,
+                "fused_rows": self._fused_rows,
+                "fused_hist": dict(self._fused_hist),
+                "stage_s": self._stage_s,
+                "dispatch_s": self._dispatch_s,
+                "quarantines": self._quarantines,
+            }
+        out["queue_wait_p50"] = percentile(qw, 50.0)
+        out["queue_wait_p95"] = percentile(qw, 95.0)
+        out["device_execute_p50"] = percentile(dx, 50.0)
+        out["device_execute_p95"] = percentile(dx, 95.0)
+        out["latency_p99"] = percentile(lat, 99.0)
+        return out
+
     def snapshot(self, registry=None) -> Dict:
         """One JSON-ready dict of everything: counters, latency
         percentiles (merged and per priority class), both batch-size
@@ -332,6 +424,8 @@ class ServeMetrics:
                     merged[k] = merged.get(k, 0) + v
             buckets = self._fused_batches + self._serial_batches
             lat = {cls: list(d) for cls, d in self._latencies.items()}
+            qw = list(self._queue_waits)
+            dx = list(self._device_exec)
             snap = {
                 "completed": self._completed,
                 "completed_by_class": dict(self._completed_by),
@@ -342,6 +436,7 @@ class ServeMetrics:
                 "max_queue_depth": self._max_queue_depth,
                 "fused_batches": self._fused_batches,
                 "serial_batches": self._serial_batches,
+                "fused_rows": self._fused_rows,
                 "padded_rows": self._padded_rows,
                 "pinned_batches": self._pinned_batches,
                 "batch_size_histogram": {str(k): v for k, v in
@@ -363,6 +458,12 @@ class ServeMetrics:
                 },
                 "health": self._health_locked(),
             }
+        snap["queue_wait_seconds"] = {
+            "p50": percentile(qw, 50.0), "p95": percentile(qw, 95.0),
+            "p99": percentile(qw, 99.0)}
+        snap["device_execute_seconds"] = {
+            "p50": percentile(dx, 50.0), "p95": percentile(dx, 95.0),
+            "p99": percentile(dx, 99.0)}
         merged_lat = [s for d in lat.values() for s in d]
         snap["latency_seconds"] = {
             "p50": percentile(merged_lat, 50.0),

@@ -116,9 +116,12 @@ def signature_for(transform_type: TransformType, dim_x: int, dim_y: int,
                          int(device_count))
 
 
-#: Default registry bounds. 2 GiB of estimated plan residency covers a
-#: dozen 256^3-class plans or hundreds of small ones; a handful of live
-#: shapes is the realistic serving mix (SCF codes cycle 1-3 geometries).
+#: Default registry bounds — owned by the control plane since round 11
+#: (KNOB_SPECS "registry_max_bytes"/"registry_max_plans"): 2 GiB of
+#: estimated plan residency covers a dozen 256^3-class plans or
+#: hundreds of small ones; a handful of live shapes is the realistic
+#: serving mix (SCF codes cycle 1-3 geometries). Constructor ``None``
+#: resolves through the process config (the boot artifact applies).
 DEFAULT_MAX_BYTES = 2 * 1024 ** 3
 DEFAULT_MAX_PLANS = 32
 
@@ -174,8 +177,15 @@ class PlanRegistry:
     warmup time, not on the first real request.
     """
 
-    def __init__(self, max_bytes: int = DEFAULT_MAX_BYTES,
-                 max_plans: int = DEFAULT_MAX_PLANS):
+    def __init__(self, max_bytes: Optional[int] = None,
+                 max_plans: Optional[int] = None):
+        if max_bytes is None or max_plans is None:
+            from ..control.config import global_config
+            cfg = global_config()
+            if max_bytes is None:
+                max_bytes = cfg.registry_max_bytes
+            if max_plans is None:
+                max_plans = cfg.registry_max_plans
         if max_plans < 1:
             raise InvalidParameterError("max_plans must be >= 1")
         self._max_bytes = int(max_bytes)

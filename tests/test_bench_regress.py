@@ -1,0 +1,104 @@
+"""scripts/bench_regress.py: the machine-checked perf-trajectory guard
+(make bench-check). Exercised in-process via runpy-style import of the
+script's main(), with synthetic measurement files."""
+
+import json
+import importlib.util
+import os
+import sys
+
+import pytest
+
+_SCRIPT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts", "bench_regress.py")
+_spec = importlib.util.spec_from_file_location("bench_regress", _SCRIPT)
+bench_regress = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(bench_regress)
+
+
+def _write(path, value, unit="s", wrap=False, metric="m"):
+    payload = {"metric": metric, "value": value, "unit": unit}
+    if wrap:
+        payload = {"n": 1, "parsed": payload}
+    path.write_text(json.dumps(payload))
+    return str(path)
+
+
+def test_within_threshold_passes(tmp_path, capsys):
+    fresh = _write(tmp_path / "fresh.json", 0.0110)
+    ref = _write(tmp_path / "ref.json", 0.0106)
+    rc = bench_regress.main(["--fresh", fresh, "--against", ref,
+                             "--threshold", "0.15"])
+    assert rc == 0
+    verdict = json.loads(capsys.readouterr().out.splitlines()[-1])
+    assert verdict["ok"] and verdict["verdict"] == "within-threshold"
+
+
+def test_seconds_regression_fails(tmp_path, capsys):
+    fresh = _write(tmp_path / "fresh.json", 0.020)  # ~2x slower
+    ref = _write(tmp_path / "ref.json", 0.0106)
+    rc = bench_regress.main(["--fresh", fresh, "--against", ref])
+    assert rc == 1
+    verdict = json.loads(capsys.readouterr().out.splitlines()[-1])
+    assert not verdict["ok"]
+    assert verdict["direction"] == "lower-is-better"
+
+
+def test_rate_unit_direction(tmp_path, capsys):
+    # req/s: HIGHER is better — a drop regresses, a gain passes
+    ref = _write(tmp_path / "ref.json", 1000.0, unit="req/s")
+    worse = _write(tmp_path / "worse.json", 500.0, unit="req/s")
+    better = _write(tmp_path / "better.json", 2000.0, unit="req/s")
+    assert bench_regress.main(["--fresh", worse, "--against", ref]) == 1
+    assert bench_regress.main(["--fresh", better, "--against", ref]) == 0
+    capsys.readouterr()
+
+
+def test_improvement_always_passes(tmp_path):
+    fresh = _write(tmp_path / "fresh.json", 0.005)  # 2x faster
+    ref = _write(tmp_path / "ref.json", 0.0106)
+    assert bench_regress.main(["--fresh", fresh,
+                               "--against", ref]) == 0
+
+
+def test_wrapped_bench_r_format_and_latest_selection(tmp_path, capsys):
+    # driver BENCH_r*.json format resolves through "parsed", and the
+    # highest-numbered reference wins
+    _write(tmp_path / "BENCH_r02.json", 0.020, wrap=True)
+    _write(tmp_path / "BENCH_r10.json", 0.010, wrap=True)
+    fresh = _write(tmp_path / "fresh.json", 0.0105)
+    rc = bench_regress.main(["--fresh", fresh, "--root", str(tmp_path)])
+    assert rc == 0
+    verdict = json.loads(capsys.readouterr().out.splitlines()[-1])
+    assert verdict["reference"] == 0.010
+    assert verdict["reference_file"].endswith("BENCH_r10.json")
+
+
+def test_no_reference_is_not_a_failure(tmp_path, capsys):
+    fresh = _write(tmp_path / "fresh.json", 0.010)
+    rc = bench_regress.main(["--fresh", fresh, "--root", str(tmp_path)])
+    assert rc == 0
+    verdict = json.loads(capsys.readouterr().out.splitlines()[-1])
+    assert verdict["verdict"] == "no-reference"
+
+
+def test_usage_errors(tmp_path, capsys):
+    fresh = _write(tmp_path / "fresh.json", 0.010)
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert bench_regress.main(["--fresh", str(bad)]) == 2
+    other_unit = _write(tmp_path / "o.json", 5.0, unit="req/s")
+    assert bench_regress.main(["--fresh", fresh, "--against",
+                               other_unit]) == 2
+    assert bench_regress.main(["--fresh", fresh, "--against", fresh,
+                               "--threshold", "2.0"]) == 2
+    capsys.readouterr()
+
+
+def test_real_repo_reference_resolves():
+    """The repo's own BENCH_r*.json trail is a usable reference."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ref = bench_regress.latest_reference(root)
+    assert ref is not None and ref.endswith("BENCH_r05.json")
+    value, unit, metric = bench_regress.load_measurement(ref)
+    assert unit == "s" and value > 0

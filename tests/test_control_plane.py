@@ -1,0 +1,556 @@
+"""Telemetry-driven control plane (spfft_tpu/control/).
+
+The load-bearing properties, each deterministic on CPU:
+
+* ServeConfig — single typed home of every knob: bounds-clamped
+  writes, recorded decisions (history + spfft_control_* counters),
+  artifact round-trip, env boot, hot-swap visible to a live executor;
+* Controller scenarios — scripted telemetry sequences drive the rules:
+  queue buildup shrinks the batching window, a pad-heavy trace
+  tightens the pin policy, full-bucket backlog grows the bucket cap,
+  idle decays every managed knob back to its default;
+* stability invariants — hysteresis dead band (no decision between
+  the thresholds), cooldown (no oscillation of one knob within its
+  settling window), and an 8-thread fuzz in which knobs NEVER leave
+  their declared bounds;
+* correctness across retune — results stay bit-exact vs the serial
+  oracle while a controller thread retunes the executor mid-stream
+  (the acceptance criterion's no-deviation half);
+* SLO watchdog — declared objectives evaluated against metrics:
+  violations degrade health() and export spfft_slo_* gauges, a healthy
+  trace raises NO false positive, recovery clears the degradation;
+* HTTP scrape endpoint — /metrics round-trips the exposition parser,
+  /healthz carries readiness semantics (200 servable / 503 failed),
+  /configz exposes the live knob values.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from spfft_tpu import TransformType
+from spfft_tpu.control import (KNOB_SPECS, ControlLoop, Controller,
+                               ServeConfig, SLOSpec, SLOWatchdog)
+from spfft_tpu.errors import InvalidParameterError
+from spfft_tpu.obs.http import MetricsServer
+from spfft_tpu.serve import PlanRegistry, ServeExecutor
+from spfft_tpu.serve.metrics import ServeMetrics
+
+from test_util import random_sparse_triplets
+
+DIMS = (12, 13, 11)
+
+
+def _registry():
+    reg = PlanRegistry()
+    rng = np.random.default_rng(3)
+    t = random_sparse_triplets(rng, DIMS)
+    sig, plan = reg.get_or_build(TransformType.C2C, *DIMS, t,
+                                 precision="double")
+    return reg, sig, plan
+
+
+def _values(plan, rng):
+    n = plan.index_plan.num_values
+    return rng.uniform(-1, 1, n) + 1j * rng.uniform(-1, 1, n)
+
+
+# -- ServeConfig ------------------------------------------------------------
+def test_config_defaults_match_specs():
+    cfg = ServeConfig()
+    snap = cfg.snapshot()
+    for name, spec in KNOB_SPECS.items():
+        assert snap[name] == spec.default
+        assert spec.lo <= spec.default <= spec.hi
+    assert cfg.batch_window == 0.001 and cfg.max_batch == 8
+
+
+def test_config_set_clamps_and_records_decisions():
+    cfg = ServeConfig()
+    lo, hi = ServeConfig.bounds("batch_window")
+    v = cfg.set("batch_window", 99.0, reason="way out", source="test")
+    assert v == hi
+    v = cfg.set("max_batch", -5, source="test")
+    assert v == ServeConfig.bounds("max_batch")[0]
+    hist = cfg.decisions()
+    assert len(hist) == 2
+    assert hist[0]["knob"] == "batch_window" and hist[0]["clamped"]
+    assert hist[0]["requested"] == 99.0 and hist[0]["new"] == hi
+    assert cfg.decision_count() == 2
+    assert cfg.decision_count("test") == 2
+    # a write that does not move the knob records nothing
+    before = cfg.decision_count()
+    assert cfg.set("max_batch", cfg.max_batch) == cfg.max_batch
+    assert cfg.decision_count() == before
+
+
+def test_config_unknown_knob_raises():
+    cfg = ServeConfig()
+    with pytest.raises(InvalidParameterError):
+        cfg.set("warp_factor", 9)
+    with pytest.raises(InvalidParameterError):
+        cfg.get("warp_factor")
+    with pytest.raises(InvalidParameterError):
+        cfg.update({"batch_window": 0.0, "warp_factor": 9})
+    # update validates ALL names before writing anything
+    assert cfg.batch_window == ServeConfig.default("batch_window")
+    with pytest.raises(AttributeError):
+        cfg.warp_factor
+
+
+def test_config_artifact_roundtrip(tmp_path):
+    cfg = ServeConfig()
+    cfg.set("batch_window", 0.004, source="tuner")
+    cfg.set("max_batch", 16, source="tuner")
+    path = tmp_path / "recommended.json"
+    cfg.save(str(path), provenance={"protocol": "test"})
+    loaded = ServeConfig.load(str(path))
+    assert loaded.batch_window == 0.004 and loaded.max_batch == 16
+    payload = json.loads(path.read_text())
+    assert payload["spfft_tpu_serve_config"] == 1
+    assert payload["provenance"]["protocol"] == "test"
+
+
+def test_config_load_rejects_garbage(tmp_path):
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    with pytest.raises(InvalidParameterError):
+        ServeConfig.load(str(bad))
+    bad.write_text(json.dumps({"values": {"batch_window": 1}}))
+    with pytest.raises(InvalidParameterError):  # missing schema marker
+        ServeConfig.load(str(bad))
+    bad.write_text(json.dumps({"spfft_tpu_serve_config": 1,
+                               "values": {"warp_factor": 9}}))
+    with pytest.raises(InvalidParameterError):  # unknown knob
+        ServeConfig.load(str(bad))
+
+
+def test_config_boot_env(tmp_path, monkeypatch):
+    path = tmp_path / "boot.json"
+    ServeConfig({"max_batch": 32}).save(str(path))
+    monkeypatch.setenv("SPFFT_TPU_SERVE_CONFIG", str(path))
+    assert ServeConfig.boot().max_batch == 32
+    monkeypatch.delenv("SPFFT_TPU_SERVE_CONFIG")
+    assert ServeConfig.boot().max_batch == \
+        ServeConfig.default("max_batch")
+
+
+def test_executor_constructor_overrides_and_hot_swap():
+    """Explicit constructor knobs land in the config; a live set() is
+    visible to the executor's next read (the hot-swap seam)."""
+    reg, sig, plan = _registry()
+    ex = ServeExecutor(reg, autostart=False, batch_window=0.0,
+                       max_batch=4, pin_after=2)
+    assert ex.config.batch_window == 0.0
+    assert ex._max_batch == 4 and ex._pin_after == 2
+    ex.config.set("max_batch", 6, source="test")
+    assert ex._max_batch == 6
+    ex.config.set("pipeline_depth", 3, source="test")
+    assert ex._pipeline_slots() == 3
+    ex.config.set("pipeline_depth", 0, source="test")  # back to auto
+    assert ex._pipeline_slots() >= 1
+    assert ex.health()["config"]["max_batch"] == 6
+    ex.close()
+
+
+def test_executor_invalid_explicit_knobs_still_raise():
+    reg, sig, plan = _registry()
+    with pytest.raises(InvalidParameterError):
+        ServeExecutor(reg, max_batch=0, autostart=False)
+    with pytest.raises(InvalidParameterError):
+        ServeExecutor(reg, pipeline_depth=0, autostart=False)
+    with pytest.raises(InvalidParameterError):
+        ServeExecutor(reg, quarantine_backoff=0.0, autostart=False)
+
+
+# -- controller scenarios (scripted telemetry, no executor needed) ----------
+def _signals(completed=0, queue_depth=0, qw95=0.0, dx50=0.0,
+             fused_rows=0, padded_rows=0, fused_hist=None,
+             max_queue_depth=0, stage_s=0.0, dispatch_s=0.0):
+    return {"completed": completed, "failed": 0,
+            "queue_depth": queue_depth,
+            "max_queue_depth": max_queue_depth,
+            "queue_wait_p95": qw95, "device_execute_p50": dx50,
+            "fused_rows": fused_rows, "padded_rows": padded_rows,
+            "fused_hist": fused_hist or {}, "stage_s": stage_s,
+            "dispatch_s": dispatch_s, "quarantines": 0,
+            "latency_p99": 0.0}
+
+
+def test_controller_queue_buildup_shrinks_window():
+    cfg = ServeConfig()
+    ctl = Controller(cfg)
+    ctl.step(_signals(completed=1))  # baseline
+    decisions = ctl.step(_signals(completed=10, qw95=0.050, dx50=0.002))
+    moved = [d for d in decisions if d.knob == "batch_window"]
+    assert len(moved) == 1
+    assert moved[0].new == pytest.approx(0.0005)
+    assert moved[0].new < moved[0].old
+    assert "queue buildup" in moved[0].reason
+
+
+def test_controller_window_decays_when_drained():
+    cfg = ServeConfig()
+    cfg.set("batch_window", 0.00025, source="test")
+    ctl = Controller(cfg, cooldown_steps=0)
+    ctl.step(_signals(completed=1))
+    ctl.step(_signals(completed=10, qw95=0.0, dx50=0.010))
+    assert cfg.batch_window == pytest.approx(0.0005)
+    ctl.step(_signals(completed=20, qw95=0.0, dx50=0.010))
+    assert cfg.batch_window == pytest.approx(0.001)  # back at default
+    ctl.step(_signals(completed=30, qw95=0.0, dx50=0.010))
+    assert cfg.batch_window == pytest.approx(0.001)  # never overshoots
+
+
+def test_controller_pad_heavy_tightens_pin_policy():
+    cfg = ServeConfig()
+    ctl = Controller(cfg, cooldown_steps=0)
+    ctl.step(_signals(completed=1))
+    # 3 pad rows per 5 live rows over the delta: pad-heavy
+    decisions = ctl.step(_signals(completed=10, qw95=0.001, dx50=0.002,
+                                  fused_rows=10, padded_rows=6))
+    moved = [d for d in decisions if d.knob == "pin_after"]
+    assert len(moved) == 1 and moved[0].new == moved[0].old - 1
+    # pads gone -> decays back toward the default
+    ctl.step(_signals(completed=20, qw95=0.001, dx50=0.002,
+                      fused_rows=20, padded_rows=6))
+    assert cfg.pin_after == ServeConfig.default("pin_after")
+
+
+def test_controller_max_batch_grows_on_full_bucket_backlog():
+    cfg = ServeConfig()
+    ctl = Controller(cfg)
+    ctl.step(_signals(completed=1))
+    decisions = ctl.step(_signals(
+        completed=40, qw95=0.001, dx50=0.002,
+        fused_hist={8: 5}, max_queue_depth=40))
+    moved = [d for d in decisions if d.knob == "max_batch"]
+    assert len(moved) == 1 and moved[0].new == 16
+
+
+def test_controller_max_batch_shrinks_when_buckets_small():
+    cfg = ServeConfig()
+    cfg.set("max_batch", 32, source="test")
+    ctl = Controller(cfg)
+    ctl.step(_signals(completed=1))
+    ctl.step(_signals(completed=10, qw95=0.001, dx50=0.002,
+                      fused_hist={4: 6}))
+    assert cfg.max_batch == 16
+
+
+def test_controller_idle_decays_managed_knobs_to_defaults():
+    cfg = ServeConfig()
+    cfg.update({"batch_window": 0.000125, "pin_after": 1,
+                "max_batch": 16}, source="test")
+    ctl = Controller(cfg, cooldown_steps=0)
+    ctl.step(_signals(completed=5))  # baseline with traffic
+    for _ in range(8):  # idle: no new completions, empty queue
+        ctl.step(_signals(completed=5))
+    assert cfg.batch_window == pytest.approx(
+        ServeConfig.default("batch_window"))
+    assert cfg.pin_after == ServeConfig.default("pin_after")
+    assert cfg.max_batch == ServeConfig.default("max_batch")
+
+
+def test_controller_hysteresis_dead_band():
+    """A signal BETWEEN the shrink and grow thresholds moves nothing."""
+    cfg = ServeConfig()
+    ctl = Controller(cfg, cooldown_steps=0)
+    ctl.step(_signals(completed=1))
+    # qw95 = 1x dx50: below shrink_ratio (2.0), above grow_ratio (0.5)
+    for k in range(5):
+        decisions = ctl.step(_signals(completed=10 + k, qw95=0.002,
+                                      dx50=0.002))
+        assert decisions == []
+    assert cfg.batch_window == ServeConfig.default("batch_window")
+
+
+def test_controller_cooldown_blocks_oscillation():
+    """After a knob moves, opposite pressure within the cooldown window
+    cannot move it back; after the cooldown it can."""
+    cfg = ServeConfig()
+    ctl = Controller(cfg, cooldown_steps=3)
+    ctl.step(_signals(completed=1))
+    ctl.step(_signals(completed=10, qw95=0.050, dx50=0.002))
+    assert cfg.batch_window == pytest.approx(0.0005)  # shrank
+    changed_at = cfg.batch_window
+    for k in range(3):  # drained signal inside the cooldown window
+        decisions = ctl.step(_signals(completed=20 + k, qw95=0.0,
+                                      dx50=0.010))
+        assert all(d.knob != "batch_window" for d in decisions)
+        assert cfg.batch_window == changed_at
+    ctl.step(_signals(completed=40, qw95=0.0, dx50=0.010))
+    assert cfg.batch_window > changed_at  # cooldown over: grew
+
+
+def test_controller_pipeline_depth_rule_uses_executor_auto():
+    reg, sig, plan = _registry()
+    ex = ServeExecutor(reg, autostart=False)
+    cfg = ex.config
+    ctl = Controller(cfg, executor=ex, cooldown_steps=0)
+    ctl.step(_signals(completed=1))
+    auto = ex._pipeline_slots()
+    # staging cost rivals dispatch cost: deepen by one over auto
+    ctl.step(_signals(completed=10, qw95=0.001, dx50=0.002,
+                      stage_s=0.6, dispatch_s=1.0))
+    assert cfg.pipeline_depth == auto + 1
+    # staging negligible: decay back toward auto (0)
+    ctl.step(_signals(completed=20, qw95=0.001, dx50=0.002,
+                      stage_s=0.6, dispatch_s=11.0))
+    assert cfg.pipeline_depth in (0, auto)
+    ex.close()
+
+
+def test_controller_fuzz_knobs_never_leave_bounds():
+    """8 threads of adversarial writes + controller steps over
+    pseudo-random telemetry: every knob stays inside its declared
+    bounds at every observation, and nothing raises."""
+    cfg = ServeConfig()
+    ctl = Controller(cfg, cooldown_steps=0)
+    errors = []
+    stop = threading.Event()
+
+    def check_bounds():
+        snap = cfg.snapshot()
+        for name, value in snap.items():
+            lo, hi = ServeConfig.bounds(name)
+            if not lo <= value <= hi:
+                errors.append(f"{name}={value} outside [{lo}, {hi}]")
+
+    def hammer(seed):
+        rng = np.random.default_rng(seed)
+        knobs = list(KNOB_SPECS)
+        try:
+            for i in range(200):
+                name = knobs[int(rng.integers(len(knobs)))]
+                # adversarial values: far outside bounds both ways
+                value = float(rng.uniform(-1e9, 1e9))
+                cfg.set(name, value, source=f"fuzz{seed}")
+                check_bounds()
+        except Exception as exc:  # pragma: no cover
+            errors.append(repr(exc))
+
+    def steer(seed):
+        rng = np.random.default_rng(seed)
+        try:
+            for i in range(100):
+                ctl.step(_signals(
+                    completed=i * 3,
+                    qw95=float(rng.uniform(0, 0.1)),
+                    dx50=float(rng.uniform(0, 0.01)),
+                    fused_rows=i * 8,
+                    padded_rows=int(rng.integers(0, i * 4 + 1)),
+                    fused_hist={8: i},
+                    max_queue_depth=int(rng.integers(0, 100))))
+                check_bounds()
+        except Exception as exc:  # pragma: no cover
+            errors.append(repr(exc))
+
+    threads = [threading.Thread(target=hammer, args=(s,))
+               for s in range(6)]
+    threads += [threading.Thread(target=steer, args=(s,))
+                for s in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    assert errors == []
+    check_bounds()
+    assert errors == []
+
+
+# -- bit-exactness across mid-stream retune ---------------------------------
+def test_mid_stream_retune_is_bit_exact():
+    """Results while a controller thread retunes window / max_batch /
+    pin_after mid-stream are BIT-IDENTICAL to each request's serial
+    execution (the acceptance criterion's no-correctness-deviation
+    half)."""
+    reg, sig, plan = _registry()
+    rng = np.random.default_rng(11)
+    vals = [_values(plan, rng) for _ in range(60)]
+    oracles = [np.asarray(plan.backward(v)) for v in vals]
+    ex = ServeExecutor(reg, batch_window=0.0005, max_batch=8)
+    stop = threading.Event()
+
+    def retuner():
+        flip = 0
+        while not stop.is_set():
+            ex.config.set("batch_window",
+                          0.0 if flip % 2 else 0.002, source="test")
+            ex.config.set("max_batch", 4 if flip % 3 else 8,
+                          source="test")
+            ex.config.set("pin_after", 1 + flip % 3, source="test")
+            flip += 1
+            time.sleep(0.001)
+
+    t = threading.Thread(target=retuner)
+    t.start()
+    try:
+        futures = [ex.submit(sig, v) for v in vals]
+        results = [np.asarray(f.result(timeout=60)) for f in futures]
+    finally:
+        stop.set()
+        t.join()
+        ex.close()
+    for i, (got, want) in enumerate(zip(results, oracles)):
+        assert np.array_equal(got, want), f"request {i} diverged"
+    lo, hi = ServeConfig.bounds("batch_window")
+    assert lo <= ex.config.batch_window <= hi
+
+
+# -- SLO watchdog -----------------------------------------------------------
+def test_slo_spec_parse_forms(tmp_path):
+    spec = SLOSpec.parse("p99_ms=50,error_rate=0.01,max_quarantines=0")
+    assert spec.latency_p99_s == pytest.approx(0.050)
+    assert spec.error_rate == 0.01 and spec.max_quarantines == 0
+    assert SLOSpec.parse("p99_s=2").latency_p99_s == 2.0
+    f = tmp_path / "slo.json"
+    f.write_text(json.dumps({"latency_p99_s": 0.1, "error_rate": 0.5}))
+    spec = SLOSpec.parse(f"@{f}")
+    assert spec.latency_p99_s == 0.1 and spec.max_quarantines is None
+    for bad in ("p99_ms", "p99_ms=abc", "uptime=0.999"):
+        with pytest.raises(InvalidParameterError):
+            SLOSpec.parse(bad)
+    with pytest.raises(InvalidParameterError):
+        SLOSpec(latency_p99_s=-1.0)
+
+
+def test_slo_watchdog_violation_degrades_health_and_recovers():
+    metrics = ServeMetrics()
+    for _ in range(20):
+        metrics.record_request_done(0.200)  # 200 ms completions
+    dog = SLOWatchdog(metrics, SLOSpec(latency_p99_s=0.050))
+    verdict = dog.evaluate()
+    assert verdict["violations"] == ["latency_p99_s"]
+    assert verdict["burn"]["latency_p99_s"] == pytest.approx(4.0)
+    health = metrics.health()
+    assert health["state"] == "degraded"          # SLO burn degrades
+    assert health["lifecycle_state"] == "healthy"  # ...but not masks
+    assert health["slo_violations"] == ["latency_p99_s"]
+    from spfft_tpu import obs
+    assert obs.GLOBAL_COUNTERS.get("spfft_slo_violation",
+                                   slo="latency_p99_s") == 1
+    # recovery: fast completions refill the window, burn drops
+    for _ in range(metrics._window):
+        metrics.record_request_done(0.001)
+    verdict = dog.evaluate()
+    assert verdict["violations"] == []
+    assert metrics.health()["state"] == "healthy"
+
+
+def test_slo_watchdog_no_false_positive_on_healthy_trace():
+    metrics = ServeMetrics()
+    for _ in range(50):
+        metrics.record_request_done(0.002)
+    dog = SLOWatchdog(metrics, SLOSpec(latency_p99_s=0.050,
+                                       error_rate=0.01,
+                                       max_quarantines=0))
+    assert dog.evaluate()["violations"] == []
+    assert metrics.health()["state"] == "healthy"
+
+
+def test_slo_zero_objective_burns_infinitely():
+    metrics = ServeMetrics()
+    metrics.record_request_done(0.001)
+    metrics.record_quarantine()
+    dog = SLOWatchdog(metrics, SLOSpec(max_quarantines=0))
+    verdict = dog.evaluate()
+    assert verdict["violations"] == ["max_quarantines"]
+    assert verdict["burn"]["max_quarantines"] == float("inf")
+
+
+def test_slo_never_masks_worse_lifecycle_state():
+    metrics = ServeMetrics()
+    metrics.record_health("failed")
+    metrics.record_slo(["error_rate"])
+    assert metrics.health()["state"] == "failed"
+
+
+# -- metrics signals --------------------------------------------------------
+def test_metrics_signals_shape_and_reservoirs():
+    m = ServeMetrics()
+    m.record_queue_waits([0.001, 0.002, 0.500])
+    m.record_device_execute(0.004)
+    m.record_batch(8, True, padded_rows=2)
+    m.record_request_done(0.01)
+    s = m.signals()
+    assert s["queue_wait_p95"] == pytest.approx(0.5)
+    assert s["device_execute_p50"] == pytest.approx(0.004)
+    assert s["fused_rows"] == 8 and s["padded_rows"] == 2
+    assert s["fused_hist"] == {8: 1}
+    snap = m.snapshot()
+    assert snap["queue_wait_seconds"]["p95"] == pytest.approx(0.5)
+    assert snap["device_execute_seconds"]["p50"] == pytest.approx(0.004)
+
+
+# -- HTTP scrape endpoint ---------------------------------------------------
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.status, resp.read().decode()
+
+
+def test_metrics_server_endpoints():
+    reg, sig, plan = _registry()
+    ex = ServeExecutor(reg, autostart=False, batch_window=0.0)
+    rng = np.random.default_rng(0)
+    v = _values(plan, rng)
+    f = ex.submit(sig, v)
+    ex._drain_once()
+    f.result(timeout=30)
+    with MetricsServer(executor=ex, port=0) as srv:
+        status, text = _get(f"{srv.url}/metrics")
+        assert status == 200
+        from spfft_tpu import obs
+        series = obs.parse_prometheus_text(text)
+        assert series[("spfft_serve_completed_total", ())] == 1
+        assert any(name == "spfft_registry_builds_total"
+                   for name, _ in series)
+        status, body = _get(f"{srv.url}/healthz")
+        assert status == 200
+        assert json.loads(body)["state"] in ("healthy", "degraded")
+        status, body = _get(f"{srv.url}/configz")
+        assert status == 200
+        assert json.loads(body)["max_batch"] == ex.config.max_batch
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(f"{srv.url}/bogus")
+        assert err.value.code == 404
+    ex.close()
+
+
+def test_metrics_server_healthz_503_when_failed():
+    metrics = ServeMetrics()
+    metrics.record_health("failed")
+    with MetricsServer(metrics=metrics, port=0) as srv:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(f"{srv.url}/healthz")
+        assert err.value.code == 503
+        assert json.loads(err.value.read())["state"] == "failed"
+
+
+def test_metrics_port_env(monkeypatch):
+    from spfft_tpu.obs.http import port_from_env
+    monkeypatch.delenv("SPFFT_TPU_METRICS_PORT", raising=False)
+    assert port_from_env() is None
+    monkeypatch.setenv("SPFFT_TPU_METRICS_PORT", "9111")
+    assert port_from_env() == 9111
+    monkeypatch.setenv("SPFFT_TPU_METRICS_PORT", "junk")
+    assert port_from_env() is None
+
+
+# -- control loop thread ----------------------------------------------------
+def test_control_loop_steps_and_stops():
+    cfg = ServeConfig()
+    metrics = ServeMetrics()
+    ctl = Controller(cfg, metrics=metrics)
+    with ControlLoop(ctl, interval=0.005):
+        time.sleep(0.05)
+    steps = ctl.steps
+    assert steps >= 2
+    time.sleep(0.02)
+    assert ctl.steps == steps  # stopped means stopped

@@ -206,3 +206,96 @@ def test_serve_bench_priority_classes(capsys):
     assert counts["high"] + counts["normal"] == 32
     assert counts["high"] > 0
     assert "high  lane p50/p99" in text
+
+
+def test_serve_bench_smoke_control_closes_the_loop(tmp_path, capsys):
+    """The round-11 acceptance criterion, tier-1 (make control-smoke
+    runs the same flags): the scripted queue-buildup trace causes a
+    recorded, bounds-clamped batch_window decision — visible in the
+    payload, as a control.retune trace annotation and as the
+    spfft_control_decisions_total Prometheus counter — with bit-exact
+    results throughout (including a post-retune wave) and ZERO SLO
+    false positives on the healthy trace."""
+    from spfft_tpu import obs
+    from spfft_tpu.control import ServeConfig
+
+    trace_file = tmp_path / "control_trace.json"
+    prom_file = tmp_path / "control.prom"
+    rc = main(["--smoke", "--control", "--trace-out", str(trace_file),
+               "--prom-out", str(prom_file)])
+    assert rc == 0
+    payload, text = _last_json(capsys)
+    assert payload["ok"] and payload["failures"] == []
+    assert payload["obs"]["open_spans"] == 0
+    ctl = payload["control"]
+    moved = [d for d in ctl["decisions"]
+             if d["knob"] == "batch_window"]
+    assert moved, "no recorded batch_window decision"
+    assert ctl["window_after"] < ctl["window_before"]
+    lo, hi = ServeConfig.bounds("batch_window")
+    assert lo <= ctl["window_after"] <= hi
+    for knob, value in ctl["knobs"].items():
+        klo, khi = ServeConfig.bounds(knob)
+        assert klo <= value <= khi
+    assert payload["slo"]["violations"] == []
+    # the decision is visible in BOTH export formats
+    trace = json.loads(trace_file.read_text())
+    names = {e["name"] for e in trace["traceEvents"]
+             if e["ph"] in ("X", "i")}
+    assert "control.retune" in names
+    series = obs.parse_prometheus_text(prom_file.read_text())
+    decided = [v for (name, labels), v in series.items()
+               if name == "spfft_control_decisions_total"
+               and ("knob", "batch_window") in labels
+               and ("source", "controller") in labels]
+    assert decided and decided[0] >= 1
+    assert any(name == "spfft_slo_burn_rate" for name, _ in series)
+    assert any(name == "spfft_control_knob" for name, _ in series)
+    assert "control:" in text
+
+
+def test_serve_bench_loads_config_artifact(tmp_path, capsys):
+    """--config boots the executor from a recommended-config artifact
+    (the tuner's output format); explicit flags still win."""
+    from spfft_tpu.control import ServeConfig
+
+    cfg = ServeConfig()
+    cfg.set("batch_window", 0.003, source="tuner")
+    cfg.set("max_batch", 4, source="tuner")
+    path = tmp_path / "recommended.json"
+    cfg.save(str(path))
+    rc = main(["--dim", "12", "--requests", "8", "--signatures", "1",
+               "--threads", "2", "--config", str(path)])
+    assert rc == 0
+    _, text = _last_json(capsys)
+    assert "window=3.0ms" in text and "max_batch=4" in text
+    # explicit flag beats the artifact
+    rc = main(["--dim", "12", "--requests", "8", "--signatures", "1",
+               "--threads", "2", "--config", str(path),
+               "--max-batch", "6"])
+    assert rc == 0
+    _, text = _last_json(capsys)
+    assert "max_batch=6" in text and "window=3.0ms" in text
+
+
+def test_serve_bench_metrics_port_serves_scrape_endpoint(capsys):
+    """--metrics-port 0 binds an ephemeral scrape endpoint for the
+    replay window and prints its URL."""
+    rc = main(["--dim", "12", "--requests", "8", "--signatures", "1",
+               "--threads", "2", "--metrics-port", "0"])
+    assert rc == 0
+    _, text = _last_json(capsys)
+    assert "metrics endpoint: http://127.0.0.1:" in text
+
+
+def test_serve_bench_slo_flag_reports(capsys):
+    """--slo declares objectives; the JSON carries the watchdog verdict
+    and a generous healthy-trace spec reports no violations."""
+    rc = main(["--dim", "12", "--requests", "8", "--signatures", "1",
+               "--threads", "2",
+               "--slo", "p99_ms=60000,error_rate=0.5"])
+    assert rc == 0
+    payload, text = _last_json(capsys)
+    assert payload["slo"]["violations"] == []
+    assert payload["slo"]["objectives"]["latency_p99_s"] == 60.0
+    assert "slo:" in text

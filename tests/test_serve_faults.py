@@ -657,3 +657,133 @@ def test_recover_serial_draws_on_priority_budget():
         assert np.array_equal(np.asarray(f.result(timeout=30)), expect)
     assert ex.metrics.health()["retries_exhausted_by_class"]["normal"] == 1
     ex.close()
+
+
+# -- request-vs-device failure attribution (round 11) -----------------------
+def test_poisoned_flood_does_not_quarantine_healthy_devices():
+    """The ROADMAP regression: a pure poisoned-request flood used to
+    charge each payload failure against whatever healthy device the
+    serial recovery ran it on, spuriously quarantining the pool. With
+    request-vs-device attribution only device-attributed failures count
+    toward quarantine_after — the flood fails typed, the pool stays
+    healthy, and interleaved good requests keep succeeding."""
+    pool = jax.devices()[:2]
+    reg, (sig,) = _registry_with([1])
+    rng = np.random.default_rng(9)
+    plan = reg.get(sig)
+    ex = ServeExecutor(reg, autostart=False, devices=pool,
+                       quarantine_after=2)
+    poisoned, good = [], []
+    for i in range(12):
+        poisoned.append(ex.submit(sig, np.zeros(3)))  # wrong length
+        if i % 3 == 0:
+            v = _values_for(reg, sig, rng)
+            good.append((ex.submit(sig, v),
+                         np.asarray(plan.backward(v))))
+        ex._drain_once()
+    for f in poisoned:
+        with pytest.raises(Exception) as err:
+            f.result(timeout=30)
+        assert not isinstance(err.value, NoHealthyDeviceError)
+    for f, expect in good:
+        assert np.array_equal(np.asarray(f.result(timeout=30)), expect)
+    h = ex.health()
+    assert h["quarantines"] == 0
+    assert h["request_attributed_failures"] >= 12
+    assert all(d["state"] == "healthy" for d in h["devices"])
+    assert h["state"] == "healthy"
+    ex.close()
+
+
+def test_scripted_poison_kind_is_request_attributed():
+    """The FaultPlan seam's poison kind: scripted request-attributed
+    faults on one device fail their requests typed but never quarantine
+    it — while the same script with :permanent (device-attributed)
+    does. The A/B that pins the attribution gate itself."""
+    pool = jax.devices()[:2]
+    reg, (sig,) = _registry_with([2])
+    rng = np.random.default_rng(10)
+
+    def flood(kind):
+        ex = ServeExecutor(reg, autostart=False, devices=pool,
+                           quarantine_after=2, batching=False,
+                           fault_plan=FaultPlan(
+                               script=f"device0@*:{kind}"))
+        outcomes = []
+        for _ in range(8):
+            f = ex.submit(sig, _values_for(reg, sig, rng))
+            ex._drain_once()
+            try:
+                f.result(timeout=30)
+                outcomes.append("ok")
+            except Exception as exc:
+                outcomes.append(type(exc).__name__)
+        h = ex.health()
+        ex.close()
+        return outcomes, h
+
+    outcomes, h = flood("poison")
+    assert h["quarantines"] == 0
+    assert h["devices"][0]["state"] == "healthy"
+    assert h["request_attributed_failures"] >= 1
+    assert "InjectedFault" in outcomes  # the poisoned ones fail typed
+    assert "ok" in outcomes             # device-1 traffic succeeds
+
+    outcomes, h = flood("permanent")
+    assert h["quarantines"] == 1        # the control: device-attributed
+    assert h["devices"][0]["state"] == "quarantined"
+
+
+def test_attributes_device_classifier():
+    from spfft_tpu.serve.faults import attributes_device
+    assert attributes_device(RuntimeError("UNAVAILABLE: device lost"))
+    assert attributes_device(TimeoutError("slow"))
+    assert attributes_device(InjectedFault("x"))
+    assert not attributes_device(InjectedFault("x",
+                                               device_attributed=False))
+    assert not attributes_device(ValueError("bad shape"))
+    assert not attributes_device(TypeError("bad dtype"))
+    assert not attributes_device(InvalidParameterError("bad arg"))
+    tagged = RuntimeError("weird")
+    tagged.device_attributed = False
+    assert not attributes_device(tagged)
+
+
+def test_probation_canary_poisoned_leaves_verdict_open():
+    """A probation canary that fails for REQUEST reasons must neither
+    re-quarantine the device with a doubled backoff nor wedge it in
+    probation: the slot returns to quarantine immediately probe-able,
+    and the next healthy canary re-admits it."""
+    pool = jax.devices()[:2]
+    reg, (sig,) = _registry_with([3])
+    rng = np.random.default_rng(11)
+    plan = reg.get(sig)
+    ex = ServeExecutor(reg, autostart=False, devices=pool,
+                       quarantine_after=1, quarantine_backoff=0.05,
+                       batching=False,
+                       fault_plan=FaultPlan(script="device0@1"))
+    f = ex.submit(sig, _values_for(reg, sig, rng))
+    ex._drain_once()
+    f.result(timeout=30)  # recovered on device 1
+    assert ex.health()["devices"][0]["state"] == "quarantined"
+    time.sleep(0.08)  # probation due: the next request is the canary
+    bad = ex.submit(sig, np.zeros(3))
+    ex._drain_once()
+    with pytest.raises(Exception):
+        bad.result(timeout=30)
+    state = ex.health()["devices"][0]
+    assert state["state"] == "quarantined"
+    assert state["backoff_s"] == pytest.approx(0.05)  # NOT doubled
+    # verdict still open: a healthy canary re-admits immediately (the
+    # round-robin rotor may route the first request to device 1, so a
+    # couple of healthy requests guarantee one probes device 0)
+    for _ in range(3):
+        v = _values_for(reg, sig, rng)
+        f = ex.submit(sig, v)
+        ex._drain_once()
+        assert np.array_equal(np.asarray(f.result(timeout=30)),
+                              np.asarray(plan.backward(v)))
+    h = ex.health()
+    assert h["devices"][0]["state"] == "healthy"
+    assert h["readmissions"] == 1
+    ex.close()

@@ -601,3 +601,38 @@ def test_overlap_exchange_on_tpu():
                          "async_starts": split["starts"]})
     print("OVERLAP_AB " + json.dumps({"shards": S, "dim": n,
                                       "rows": rows}))
+
+
+def test_control_retune_on_tpu(tmp_path):
+    """The round-11 closed loop on the real chip: the deterministic
+    control smoke (scripted queue buildup -> recorded, bounds-clamped
+    batch_window decision; SLO watchdog clean on the healthy trace)
+    plus a measured replay with the live controller on — on-chip
+    queue-wait vs device-execute ratios differ from the CPU lane, so
+    this is where the controller's rules meet real dispatch latencies.
+    Record the printed decisions when retuning defaults per the
+    ROADMAP's on-chip backlog."""
+    import json as _json
+
+    from spfft_tpu.serve.bench import main as serve_bench_main
+
+    assert serve_bench_main([
+        "--smoke", "--control",
+        "--trace-out", str(tmp_path / "control_tpu_trace.json"),
+        "--prom-out", str(tmp_path / "control_tpu.prom")]) == 0
+    prom = (tmp_path / "control_tpu.prom").read_text()
+    assert "spfft_control_decisions_total" in prom
+    assert "spfft_slo_burn_rate" in prom
+    out = tmp_path / "control_tpu_replay.json"
+    assert serve_bench_main([
+        "--dim", "24", "--requests", "96", "--signatures", "3",
+        "--threads", "4", "--control",
+        "--slo", "p99_ms=60000,error_rate=0.1,max_quarantines=0",
+        "-o", str(out)]) == 0
+    payload = _json.loads(out.read_text())
+    assert payload["failed_requests"] == 0
+    assert payload["slo"]["violations"] == []
+    from spfft_tpu.control import ServeConfig
+    for knob, value in payload["control"]["knobs"].items():
+        lo, hi = ServeConfig.bounds(knob)
+        assert lo <= value <= hi
