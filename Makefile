@@ -16,7 +16,7 @@ PLANNER_SO  := $(NATIVE_DIR)/_planner_$(CACHE_TAG).so
 CAPI_SO     := lib/libspfft_tpu.so
 
 .PHONY: all native capi example-c test ci ci-tpu trace-smoke \
-        control-smoke bench-check clean
+        control-smoke fused-smoke bench-check clean
 
 # One-command CI (reference: .github/workflows/ci.yml builds + runs the
 # local test matrix): full CPU suite (8-device virtual mesh; includes the
@@ -81,6 +81,23 @@ control-smoke:
 	grep -q "spfft_control_knob" build/control_smoke.prom
 	python -m spfft_tpu.obs validate build/control_smoke.json --require-request-stages
 	@echo "CONTROL-SMOKE GREEN"
+
+# Fused compression+DFT smoke (docs/kernels.md): the interpret-mode
+# bit-exactness + fallback-gate suite for ops/fused_kernel.py, then a
+# benchmark.py --fused run whose JSON must report the fused path ACTIVE
+# with no gate declines. The same coverage runs in tier-1
+# (tests/test_fused_kernel.py, tests/test_benchmark_cli.py::
+# test_cli_fused_ab); on-chip bit-exactness + the profile evidence that
+# the dense stick intermediate is gone live in `make ci-tpu`
+# (test_fused_compression_dft_on_tpu).
+fused-smoke:
+	@echo "== fused-smoke: interpret-mode fused compression+DFT checks =="
+	@mkdir -p build
+	python -m pytest tests/test_fused_kernel.py -q
+	python -m spfft_tpu.benchmark -d 8 6 128 -r 1 --fused \
+	  -o build/fused_smoke.json
+	python -c "import json; p = json.load(open('build/fused_smoke.json'))['parameters']; assert p['fused'] and not p['fused_fallback'], p"
+	@echo "FUSED-SMOKE GREEN"
 
 # Perf-trajectory guard (scripts/bench_regress.py): run the north-star
 # benchmark fresh and compare against the latest recorded BENCH_r*.json

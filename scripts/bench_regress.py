@@ -19,11 +19,19 @@ makes the comparison a nonzero-exit mechanism (``make bench-check``):
   excursions near 10%; 15% flags real cliffs without crying wolf on
   backend jitter).
 
+Beyond the primary measurement, any named SUB-ROW present in BOTH
+files is compared with the same rules: a ``"fused"`` entry (the fused
+compression+z-DFT path's pair time, ``benchmark.py --fused`` —
+expected from BENCH_r06.json on) regresses the exit code exactly like
+the primary row. A row present on only one side is reported as
+``row-no-reference`` and never fails (a fresh repo cannot regress
+against nothing; an older reference predates the row).
+
 Direction is inferred from the unit: seconds-like units regress when
 the fresh value is HIGHER, rate-like units (req/s, GB/s, ...) when it
 is LOWER. Exit codes: 0 within threshold (or improved), 1 regression,
-2 usage/parse error. Prints one JSON verdict line (the bench.py
-convention).
+2 usage/parse error. Prints one JSON verdict line per compared row
+(the bench.py convention).
 """
 
 from __future__ import annotations
@@ -38,19 +46,39 @@ import sys
 #: Units where SMALLER is better; anything else is treated as a rate.
 LOWER_IS_BETTER_UNITS = ("s", "ms", "us", "ns", "seconds", "bytes")
 
+#: Named sub-measurements compared alongside the primary row whenever
+#: both files carry them (e.g. {"fused": {"value": ..., "unit": "s"}}).
+SUB_ROWS = ("fused",)
 
-def load_measurement(path: str):
-    """(value, unit, metric) from either bench.py's single JSON line or
+
+def load_payload(path: str) -> dict:
+    """The measurement dict from either bench.py's single JSON line or
     a driver BENCH_r*.json wrapper."""
     with open(path) as f:
         payload = json.load(f)
     if "parsed" in payload and isinstance(payload["parsed"], dict):
         payload = payload["parsed"]
+    return payload
+
+
+def measurement(payload: dict, path: str, row: str = None):
+    """(value, unit, metric) of the primary row, or of sub-row ``row``
+    (None when the payload does not carry that row)."""
+    if row is not None:
+        payload = payload.get(row)
+        if not isinstance(payload, dict) or "value" not in payload:
+            return None
     if "value" not in payload:
         raise ValueError(f"{path}: no 'value' field (not a bench "
                          f"measurement)")
     return (float(payload["value"]), str(payload.get("unit", "")),
             str(payload.get("metric", "")))
+
+
+def load_measurement(path: str):
+    """(value, unit, metric) from either bench.py's single JSON line or
+    a driver BENCH_r*.json wrapper."""
+    return measurement(load_payload(path), path)
 
 
 def latest_reference(root: str):
@@ -92,7 +120,9 @@ def main(argv=None) -> int:
         print("error: --threshold must be in [0, 1)", file=sys.stderr)
         return 2
     try:
-        fresh_v, fresh_unit, fresh_metric = load_measurement(args.fresh)
+        fresh_payload = load_payload(args.fresh)
+        fresh_v, fresh_unit, fresh_metric = measurement(fresh_payload,
+                                                        args.fresh)
     except (ValueError, OSError, json.JSONDecodeError) as exc:
         print(f"error: cannot read --fresh: {exc}", file=sys.stderr)
         return 2
@@ -104,48 +134,78 @@ def main(argv=None) -> int:
               "against", file=sys.stderr)
         return 0
     try:
-        ref_v, ref_unit, ref_metric = load_measurement(against)
+        ref_payload = load_payload(against)
+        ref_v, ref_unit, ref_metric = measurement(ref_payload, against)
     except (ValueError, OSError, json.JSONDecodeError) as exc:
         print(f"error: cannot read reference {against}: {exc}",
               file=sys.stderr)
         return 2
-    if fresh_unit and ref_unit and fresh_unit != ref_unit:
-        print(f"error: unit mismatch: fresh '{fresh_unit}' vs "
-              f"reference '{ref_unit}' — not comparable",
+
+    def compare_row(row, fresh_m, ref_m):
+        fresh_v, fresh_unit, fresh_metric = fresh_m
+        ref_v, ref_unit, ref_metric = ref_m
+        if fresh_unit and ref_unit and fresh_unit != ref_unit:
+            print(f"error: unit mismatch: fresh '{fresh_unit}' vs "
+                  f"reference '{ref_unit}' — not comparable",
+                  file=sys.stderr)
+            return 2
+        unit = fresh_unit or ref_unit
+        lower_better = unit in LOWER_IS_BETTER_UNITS
+        if ref_v == 0:
+            ratio = 1.0
+        elif lower_better:
+            ratio = fresh_v / ref_v      # > 1: slower
+        else:
+            ratio = ref_v / fresh_v      # > 1: fewer per second
+        regressed = ratio > 1.0 + args.threshold
+        change = (fresh_v / ref_v - 1.0) * 100 if ref_v else 0.0
+        verdict = {
+            "ok": not regressed,
+            "verdict": "regression" if regressed else "within-threshold",
+            "row": row,
+            "unit": unit,
+            "direction": "lower-is-better" if lower_better
+            else "higher-is-better",
+            "fresh": fresh_v,
+            "reference": ref_v,
+            "reference_file": against,
+            "change_pct": round(change, 2),
+            "threshold_pct": round(args.threshold * 100, 2),
+        }
+        print(json.dumps(verdict))
+        tag = "REGRESSION" if regressed else "OK"
+        print(f"{tag} [{row}]: {fresh_v:g} {unit} vs {ref_v:g} {unit} "
+              f"({change:+.1f}%, threshold ±{args.threshold * 100:.0f}%, "
+              f"{verdict['direction']}) "
+              f"[ref: {os.path.basename(against)}]",
               file=sys.stderr)
+        if regressed:
+            print(f"  fresh metric: {fresh_metric[:160]}",
+                  file=sys.stderr)
+            print(f"  ref metric:   {ref_metric[:160]}", file=sys.stderr)
+        return 1 if regressed else 0
+
+    rc = compare_row("primary", (fresh_v, fresh_unit, fresh_metric),
+                     (ref_v, ref_unit, ref_metric))
+    if rc == 2:
         return 2
-    unit = fresh_unit or ref_unit
-    lower_better = unit in LOWER_IS_BETTER_UNITS
-    if ref_v == 0:
-        ratio = 1.0
-    elif lower_better:
-        ratio = fresh_v / ref_v      # > 1: slower
-    else:
-        ratio = ref_v / fresh_v      # > 1: fewer per second
-    regressed = ratio > 1.0 + args.threshold
-    change = (fresh_v / ref_v - 1.0) * 100 if ref_v else 0.0
-    verdict = {
-        "ok": not regressed,
-        "verdict": "regression" if regressed else "within-threshold",
-        "unit": unit,
-        "direction": "lower-is-better" if lower_better
-        else "higher-is-better",
-        "fresh": fresh_v,
-        "reference": ref_v,
-        "reference_file": against,
-        "change_pct": round(change, 2),
-        "threshold_pct": round(args.threshold * 100, 2),
-    }
-    print(json.dumps(verdict))
-    tag = "REGRESSION" if regressed else "OK"
-    print(f"{tag}: {fresh_v:g} {unit} vs {ref_v:g} {unit} "
-          f"({change:+.1f}%, threshold ±{args.threshold * 100:.0f}%, "
-          f"{verdict['direction']}) [ref: {os.path.basename(against)}]",
-          file=sys.stderr)
-    if regressed:
-        print(f"  fresh metric: {fresh_metric[:160]}", file=sys.stderr)
-        print(f"  ref metric:   {ref_metric[:160]}", file=sys.stderr)
-    return 1 if regressed else 0
+    for row in SUB_ROWS:
+        fresh_row = measurement(fresh_payload, args.fresh, row=row)
+        ref_row = measurement(ref_payload, against, row=row)
+        if fresh_row is None and ref_row is None:
+            continue
+        if fresh_row is None or ref_row is None:
+            side = "fresh" if fresh_row is None else "reference"
+            print(json.dumps({"ok": True, "verdict": "row-no-reference",
+                              "row": row, "missing": side}))
+            print(f"NOTE [{row}]: no {side} measurement — skipped "
+                  f"(expected once BENCH_r06.json lands the fused row)",
+                  file=sys.stderr)
+            continue
+        rc = max(rc, compare_row(row, fresh_row, ref_row))
+        if rc == 2:
+            return 2
+    return rc
 
 
 if __name__ == "__main__":

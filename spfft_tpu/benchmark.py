@@ -14,14 +14,16 @@ tests/programs/benchmark.cpp) with the same knobs and output schema:
 
 Flags mirror reference benchmark.cpp:138-156: -d dims, -r repeats,
 -s sparsity, -t c2c|r2c, -e exchange, -p host|device, -m num transforms,
--o json output; plus --shards to run distributed over a device mesh and
---precision for the float twin.
+-o json output; plus --shards to run distributed over a device mesh,
+--precision for the float twin, and --fused/--no-fused to A/B the fused
+compression+z-DFT Pallas path (docs/kernels.md).
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -70,6 +72,17 @@ def _parse_args(argv):
     p.add_argument("--fused-pair", action="store_true",
                    help="time backward+forward as ONE fused executable "
                         "(apply_pointwise identity; requires -m 1)")
+    p.add_argument("--fused", dest="fused", action="store_true",
+                   default=None,
+                   help="force the fused compression+z-DFT Pallas "
+                        "kernels on (ops/fused_kernel.py; implies "
+                        "use_pallas=True). Off-TPU this also forces the "
+                        "matmul-DFT pipeline and interpret-mode kernel "
+                        "execution, so CPU A/B numbers vs --no-fused "
+                        "are honest overhead-only (docs/kernels.md)")
+    p.add_argument("--no-fused", dest="fused", action="store_false",
+                   help="disable the fused compression+z-DFT path (the "
+                        "two-kernel pipeline; the A/B twin of --fused)")
     p.add_argument("--serve", action="store_true",
                    help="route the -m transforms through the serving "
                         "layer (spfft_tpu.serve: registry + batching "
@@ -183,6 +196,36 @@ def _exchange_sweep(args, dims, ttype, triplets, rng, cdt) -> int:
 
 def main(argv=None) -> int:
     args = _parse_args(argv if argv is not None else sys.argv[1:])
+    if args.cpu:
+        from .utils.platform import force_virtual_cpu_devices
+        force_virtual_cpu_devices(max(args.shards, 1))
+    restore = {}
+    if args.fused is not None:
+        import jax
+
+        def _setenv(key, value):
+            restore.setdefault(key, os.environ.get(key))
+            os.environ[key] = value
+
+        _setenv("SPFFT_TPU_FUSED_COMPRESS", "1" if args.fused else "0")
+        if args.fused and jax.default_backend() != "tpu":
+            # the fused seam only exists in the matmul-DFT pipeline and
+            # off-TPU the kernels execute in interpret mode: the CPU A/B
+            # lane measures honest orchestration overhead only
+            # (docs/kernels.md)
+            _setenv("SPFFT_TPU_FORCE_MATMUL_DFT", "1")
+            _setenv("SPFFT_TPU_FUSED_INTERPRET", "1")
+    try:
+        return _run(args)
+    finally:
+        for key, value in restore.items():
+            if value is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = value
+
+
+def _run(args) -> int:
     dims = args.dimensions
     if len(dims) == 1:
         dims = dims * 3
@@ -193,10 +236,6 @@ def main(argv=None) -> int:
         print("error: -m must be >= 1", file=sys.stderr)
         return 2
     nx, ny, nz = dims
-
-    if args.cpu:
-        from .utils.platform import force_virtual_cpu_devices
-        force_virtual_cpu_devices(max(args.shards, 1))
 
     import jax
     from . import timing
@@ -243,7 +282,8 @@ def main(argv=None) -> int:
         values = plan.shard_values(values_np)
     else:
         plan = make_local_plan(ttype, nx, ny, nz, triplets,
-                               precision=args.precision)
+                               precision=args.precision,
+                               use_pallas=True if args.fused else None)
         n = len(triplets)
         v = (rng.uniform(-1, 1, n) + 1j * rng.uniform(-1, 1, n)).astype(cdt)
         values_np = np.asarray(as_interleaved(v, args.precision))
@@ -354,6 +394,9 @@ def main(argv=None) -> int:
         "num_values": int(len(triplets)),
         "pallas": bool(getattr(plan, "_pallas_active", False)
                        or getattr(plan, "_pallas_dist", None) is not None),
+        "fused": bool(getattr(plan, "fused_active", False)),
+        "fused_fallback": dict(getattr(plan, "fused_fallback_reasons",
+                                       None) or {}),
         "plan_seconds": round(plan_s, 4),
         "pair_seconds": round(pair_s, 6),
     }

@@ -116,6 +116,21 @@ class HostExecutionError(GenericError):
     code = ErrorCode.HOST_EXECUTION
 
 
+class TableBuildError(HostExecutionError):
+    """The plan's BACKGROUND compression-table build raised off-thread
+    (``TransformPlan._build_compression_tables``). Surfaced as this
+    typed error on the first execution call and STICKY thereafter —
+    never a silent fallback to the XLA path, never a raw foreign
+    exception type. ``cause`` (also chained as ``__cause__``) carries
+    the original exception."""
+
+    def __init__(self, message: str, cause: BaseException = None):
+        super().__init__(message)
+        self.cause = cause
+        if cause is not None:
+            self.__cause__ = cause
+
+
 class ServeError(HostExecutionError):
     """Base class of serving-layer failures (spfft_tpu.serve). The
     serving layer is host-side orchestration over compiled plans, so

@@ -57,8 +57,25 @@ __all__ = [
     "parse_prometheus_text",
     "MetricsServer", "METRICS_PORT_ENV", "port_from_env",
     "record_compile", "record_plan_build", "record_exchange_plan",
-    "record_hlo_counts",
+    "record_hlo_counts", "record_plan_fallback",
 ]
+
+
+def record_plan_fallback(stage: str, reason: str) -> None:
+    """One plan-time Pallas fallback decision — a compression stage or
+    a fused compression+DFT direction routed to the slower path, with
+    why. Counter always (``spfft_plan_pallas_fallback_total`` by
+    {stage, reason} — scrapeable fleet-wide via the /metrics endpoint),
+    plus an instant span annotation on the compile track when tracing
+    is on."""
+    GLOBAL_COUNTERS.inc("spfft_plan_pallas_fallback_total", 1,
+                        help="Plan-time Pallas fallback decisions by "
+                             "stage and reason.",
+                        stage=stage, reason=reason)
+    if active():
+        GLOBAL_TRACER.instant("plan.pallas_fallback", cat="compile",
+                              track="compile",
+                              args={"stage": stage, "reason": reason})
 
 
 def record_compile(what: str, seconds: float, t0: Optional[float] = None,

@@ -1,0 +1,682 @@
+"""Fused sparse-compression + z-stick-DFT Pallas TPU kernels.
+
+The reference's single biggest GPU win is fusing the sparse compression
+scatter/gather directly into the transform kernels (compression_gpu +
+the z-stick FFT never round-trip through global memory). Our pipeline
+reproduced both halves as *separate* Pallas kernels — the windowed
+gather (:mod:`~spfft_tpu.ops.gather_kernel`) and the matmul-DFT z stage
+(:mod:`~spfft_tpu.ops.dft_kernel`) — with the dense
+``(num_sticks, dim_z)`` planar stick pair materialised in HBM between
+them, in both directions. These kernels close that gap:
+
+* :func:`run_decompress_zdft` (backward): each grid step is one chunk
+  of the narrow windowed-gather decomposition — it DMAs its K-row
+  source window, assembles its gathered 1024-slot tile and accumulates
+  it into a VMEM scratch covering a SUPER-TILE of ``r_sticks`` whole
+  sticks; on a super-tile's last chunk the scratch is reshaped to
+  ``(r_sticks, dim_z)`` and contracted against the resident Karatsuba
+  DFT matrices, and only the *transformed* planar block is written.
+  The dense pre-FFT stick intermediate never touches HBM.
+* :func:`run_zdft_compress` (forward twin): each grid step DMAs the
+  RAW stick rows covering its chunk's source window, z-transforms them
+  in VMEM (any FULL scaling folded into the matrices at plan time —
+  compile-time scaling, zero extra passes), slices the transformed
+  window out of the flat slot layout, and runs the windowed compress
+  gather against it. The transformed stick array never touches HBM;
+  the cost is a bounded DFT recompute where windows overlap, which the
+  plan-time cost model gates (:func:`compress_recompute_rows`).
+
+Geometry: tables reuse the NARROW gather decomposition (chunks of one
+1024-slot tile; chunks of a tile are consecutive grid steps). A fused
+super-tile groups ``p_tiles`` consecutive 1024-slot tiles so that
+``r_sticks * dim_z == p_tiles * 1024`` exactly — whole sticks per
+output block. ``dim_z % 128 == 0`` keeps every in-kernel reshape in
+the lane-preserving / sublane-merge family that the existing two-stage
+kernels (ops.dft_kernel._kernel2) already exercise on Mosaic, and
+makes the forward window slice row-aligned.
+
+Eligibility (:func:`eligible_dim` + the plan's gate): f32 only,
+``dim_z % 128 == 0``, ``dim_z`` within the fused-kernel axis cap
+(:func:`spfft_tpu.ops.dft_kernel.max_dim` — the VMEM/perf ceiling),
+unsegmented narrow tables, and the forward recompute model under
+:data:`RECOMPUTE_LIMIT`. Everything else falls back to the two-kernel
+path — same math, same layouts — with the reason recorded through
+``obs`` (``spfft_plan_pallas_fallback_total``).
+
+``SPFFT_TPU_FUSED_COMPRESS=0`` disables the fused path;
+``SPFFT_TPU_FUSED_INTERPRET=1`` forces interpret-mode execution (and
+activation off-TPU) for the CPU A/B lane (``benchmark.py --fused``,
+``make fused-smoke``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .dft_kernel import _kara
+from .gather_kernel import (TILE, TILE_LANE, TILE_SUB,
+                            MonotoneGatherTables, _tile_compute_win)
+
+#: Target stick rows per backward super-tile: large enough that the
+#: per-super-tile (r, dim_z) x (dim_z, dim_z) Karatsuba dot keeps the
+#: MXU busy (>= 64 rows), small enough that the accumulation scratch
+#: stays a footnote in the VMEM budget.
+TARGET_R = 64
+
+#: Hard cap on 1024-slot tiles per super-tile (scratch rows =
+#: p_tiles * 8; 64 tiles = 512 KB of f32 scratch per channel pair).
+MAX_P_TILES = 64
+
+#: Forward recompute ceiling: the fused forward z-transforms every
+#: stick its chunk windows touch, so overlapping windows re-transform
+#: sticks. The fused path declines when the modelled transformed rows
+#: exceed this multiple of the unfused single pass (num_sticks rows) —
+#: past it the DFT recompute outweighs the saved HBM round trip of the
+#: transformed stick array (2 * num_sticks * dim_z * 8 bytes).
+RECOMPUTE_LIMIT = 4.0
+
+#: Per-kernel VMEM budget the geometry chooser stays under — matches
+#: the single-stage DFT kernel's empirically-calibrated ceiling
+#: (ops.dft_kernel._VMEM_BUDGET rationale).
+_VMEM_BUDGET = int(5.5 * 1024 * 1024)
+
+
+def enabled() -> bool:
+    """Fused compression+DFT is on by default where eligible;
+    ``SPFFT_TPU_FUSED_COMPRESS=0`` disables (read per decision so tests
+    and the benchmark A/B flag can flip it)."""
+    return os.environ.get("SPFFT_TPU_FUSED_COMPRESS", "1").strip() != "0"
+
+
+def interpret_forced() -> bool:
+    """``SPFFT_TPU_FUSED_INTERPRET=1`` runs the fused kernels in
+    interpret mode and activates them off-TPU — the CPU A/B and smoke
+    lane (numbers there are honest overhead-only, like the overlap
+    round's CPU A/B)."""
+    return os.environ.get("SPFFT_TPU_FUSED_INTERPRET", "").strip() == "1"
+
+
+def eligible_dim(dim_z: int):
+    """Gate on the z-axis length alone. Returns ``None`` when eligible,
+    else the fallback-reason string."""
+    from . import dft_kernel as dk
+    if dim_z <= 0 or dim_z % TILE_LANE != 0:
+        return "dimz_not_multiple_128"
+    if dim_z > dk.max_dim():
+        return "dimz_over_cap"
+    if not dk.fits1(dim_z, dim_z):
+        return "vmem"
+    return None
+
+
+def super_tile_geometry(dim_z: int):
+    """``(r_sticks, p_tiles)`` with ``r_sticks * dim_z == p_tiles *
+    TILE`` exactly: whole sticks per super-tile, whole 1024-slot gather
+    tiles per super-tile."""
+    g = math.gcd(dim_z, TILE)
+    r_min = TILE // g          # sticks per minimal super-tile
+    p_min = dim_z // g         # 1024-slot tiles per minimal super-tile
+    k = max(1, -(-TARGET_R // r_min))
+    k = min(k, max(1, MAX_P_TILES // p_min))
+    return r_min * k, p_min * k
+
+
+def _fits_backward(dim_z: int, p_tiles: int, span_rows: int) -> bool:
+    mats = 3 * dim_z * dim_z
+    window = 2 * 2 * span_rows * TILE_LANE
+    scratch = 2 * p_tiles * TILE_SUB * TILE_LANE
+    out = 2 * 2 * p_tiles * TILE  # double-buffered output blocks
+    return (mats + window + scratch + out) * 4 <= _VMEM_BUDGET
+
+
+def _fits_forward(dim_z: int, win_sticks: int, span_rows: int) -> bool:
+    mats = 3 * dim_z * dim_z
+    window = 2 * 2 * win_sticks * dim_z          # raw-stick DMA buffers
+    work = 6 * win_sticks * dim_z                # transformed + flat views
+    out = 2 * 2 * TILE
+    return (mats + window + work + out) * 4 <= _VMEM_BUDGET
+
+
+# -- plan-time tables --------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class FusedDecompressTables:
+    """Backward fused tables: the narrow decompress gather tables plus
+    per-chunk super-tile metadata. Chunk order is tile-major (the
+    narrow builder's revisiting order), so a super-tile's chunks are
+    consecutive grid steps."""
+
+    row0: np.ndarray     # (C,) int32 — DMA window start row (as narrow)
+    pos: np.ndarray      # (C,) int32 — chunk's 1024-tile index WITHIN
+                         # its super-tile (scratch slot)
+    sfirst: np.ndarray   # (C,) int32 — 1 on a super-tile's first chunk
+    slast: np.ndarray    # (C,) int32 — 1 on a super-tile's last chunk
+    sup: np.ndarray      # (C,) int32 — output super-tile index
+    packed: np.ndarray   # (C, 8, 128) int32 — narrow selector words
+    dim_z: int
+    r_sticks: int        # sticks per super-tile (output block rows)
+    p_tiles: int         # 1024-slot tiles per super-tile
+    num_super: int       # output blocks: ceil(num_tiles / p_tiles)
+    num_sticks: int      # valid stick rows (callers slice [:num_sticks])
+    src_rows: int        # padded source rows (as narrow)
+    span_rows: int       # K: DMA window height
+
+
+@dataclasses.dataclass(frozen=True)
+class FusedCompressTables:
+    """Forward fused tables: the narrow compress gather tables with the
+    source windows re-expressed as RAW STICK ranges (the kernel
+    transforms them in VMEM before gathering)."""
+
+    s0: np.ndarray       # (C,) int32 — first raw stick of the window DMA
+    off: np.ndarray      # (C,) int32 — transformed-window start row,
+                         # relative to s0, in the flat (rows, 128) layout
+    out_tile: np.ndarray  # (C,) int32 — output value tile (as narrow)
+    first: np.ndarray    # (C,) int32 — 1 on a tile's first chunk
+    packed: np.ndarray   # (C, 8, 128) int32 — narrow selector words
+    dim_z: int
+    win_sticks: int      # S_w: raw sticks DMA'd per chunk
+    num_tiles: int       # output value tiles
+    num_out: int         # valid output slots
+    src_sticks: int      # padded raw-stick rows the source must carry
+    span_rows: int       # K: transformed-window height (as narrow)
+
+
+def build_fused_decompress_tables(t: MonotoneGatherTables, dim_z: int,
+                                  num_sticks: int):
+    """Extend narrow decompress tables with the super-tile metadata the
+    fused kernel needs, or return a fallback-reason string."""
+    reason = eligible_dim(dim_z)
+    if reason:
+        return reason
+    if t.segs:
+        return "segmented"
+    r_sticks, p_tiles = super_tile_geometry(dim_z)
+    if not _fits_backward(dim_z, p_tiles, t.span_rows):
+        return "vmem"
+    sup = t.out_tile // p_tiles
+    pos = t.out_tile - sup * p_tiles
+    C = int(t.row0.shape[0])
+    sfirst = np.zeros(C, np.int32)
+    slast = np.zeros(C, np.int32)
+    sfirst[0] = 1
+    slast[-1] = 1
+    sfirst[1:] |= (sup[1:] != sup[:-1]).astype(np.int32)
+    slast[:-1] |= (sup[1:] != sup[:-1]).astype(np.int32)
+    num_super = -(-t.num_tiles // p_tiles)
+    return FusedDecompressTables(
+        row0=t.row0, pos=pos.astype(np.int32), sfirst=sfirst,
+        slast=slast, sup=sup.astype(np.int32), packed=t.packed,
+        dim_z=int(dim_z), r_sticks=r_sticks, p_tiles=p_tiles,
+        num_super=num_super, num_sticks=int(num_sticks),
+        src_rows=t.src_rows, span_rows=t.span_rows)
+
+
+def compress_recompute_rows(t: MonotoneGatherTables, dim_z: int) -> int:
+    """Stick rows the fused forward would z-transform in total (each
+    chunk transforms its whole window) — the cost model's numerator."""
+    q = dim_z // TILE_LANE
+    win_sticks = -(-t.span_rows // q) + 1
+    return int(t.row0.shape[0]) * win_sticks
+
+
+def build_fused_compress_tables(t: MonotoneGatherTables, dim_z: int,
+                                num_sticks: int):
+    """Re-express narrow compress tables as raw-stick windows, or
+    return a fallback-reason string. The cost-model gate declines when
+    the window-overlap DFT recompute exceeds :data:`RECOMPUTE_LIMIT`
+    times the unfused single transform pass."""
+    reason = eligible_dim(dim_z)
+    if reason:
+        return reason
+    if t.segs:
+        return "segmented"
+    q = dim_z // TILE_LANE
+    win_sticks = -(-t.span_rows // q) + 1
+    if not _fits_forward(dim_z, win_sticks, t.span_rows):
+        return "vmem"
+    if compress_recompute_rows(t, dim_z) > RECOMPUTE_LIMIT \
+            * max(int(num_sticks), 1):
+        return "recompute_blowup"
+    # window rows [row0, row0+K) of the flat (rows, 128) transformed
+    # layout live inside raw sticks [s0, s0 + win_sticks)
+    s0 = (t.row0.astype(np.int64) * TILE_LANE) // dim_z
+    off = t.row0.astype(np.int64) - s0 * q
+    assert int((off + t.span_rows).max(initial=0)) <= win_sticks * q
+    # the DMA always reads the STATIC win_sticks rows from s0, so the
+    # source must be padded to the furthest row any window's DMA touches
+    src_sticks = max(int((s0 + win_sticks).max(initial=0)),
+                     int(num_sticks))
+    return FusedCompressTables(
+        s0=s0.astype(np.int32), off=off.astype(np.int32),
+        out_tile=t.out_tile, first=t.first, packed=t.packed,
+        dim_z=int(dim_z), win_sticks=win_sticks,
+        num_tiles=t.num_tiles, num_out=t.num_out,
+        src_sticks=src_sticks, span_rows=t.span_rows)
+
+
+def decompress_device_tables(t: FusedDecompressTables) -> tuple:
+    """Device-committed table tuple for :func:`run_decompress_zdft`."""
+    return (jnp.asarray(t.row0), jnp.asarray(t.pos),
+            jnp.asarray(t.sfirst), jnp.asarray(t.slast),
+            jnp.asarray(t.sup), jnp.asarray(t.packed))
+
+
+def compress_device_tables(t: FusedCompressTables) -> tuple:
+    """Device-committed table tuple for :func:`run_zdft_compress`."""
+    return (jnp.asarray(t.s0), jnp.asarray(t.off),
+            jnp.asarray(t.out_tile), jnp.asarray(t.first),
+            jnp.asarray(t.packed))
+
+
+def commit_mats(mats) -> tuple:
+    """Device-committed Karatsuba DFT matrix triple. Any FULL scaling
+    is already folded into the matrix VALUES at plan time —
+    compile-time scaling, the kernels never multiply by a runtime
+    scalar."""
+    return tuple(jnp.asarray(np.asarray(m, np.float32)) for m in mats)
+
+
+# -- backward kernel: gather-decompress -> z-DFT -----------------------------
+
+def _dec_zdft_body(K, P, R, dz, g, pos_ref, sfirst_ref,
+                   slast_ref, packed_ref, cr_ref, ci_ref, cs_ref,
+                   write, acc, sc, slot):
+    """Shared per-step body of the backward fused kernel. ``write``
+    stores the transformed (R, dz) planar pair on the super-tile's last
+    chunk; DMA wait has already happened."""
+    acc_re, acc_im = _tile_compute_win(K, packed_ref[0],
+                                       sc[slot, 0], sc[slot, 1])
+
+    @pl.when(sfirst_ref[g] == 1)
+    def _():
+        acc[0] = jnp.zeros((P * TILE_SUB, TILE_LANE), jnp.float32)
+        acc[1] = jnp.zeros((P * TILE_SUB, TILE_LANE), jnp.float32)
+
+    p8 = pos_ref[g] * TILE_SUB
+    acc[0, pl.ds(p8, TILE_SUB)] = acc[0, pl.ds(p8, TILE_SUB)] + acc_re
+    acc[1, pl.ds(p8, TILE_SUB)] = acc[1, pl.ds(p8, TILE_SUB)] + acc_im
+
+    @pl.when(slast_ref[g] == 1)
+    def _():
+        xr = acc[0].reshape(R, dz)
+        xi = acc[1].reshape(R, dz)
+        yr, yi = _kara(xr, xi, cr_ref[...], ci_ref[...], cs_ref[...])
+        write(yr, yi)
+
+
+def _kernel_dec_zdft(K, P, R, dz, row0_ref, pos_ref, sfirst_ref, slast_ref,
+                     sup_ref, packed_ref, cr_ref, ci_ref, cs_ref,
+                     re_hbm, im_hbm, out_r_ref, out_i_ref, acc, sc, sem):
+    g = pl.program_id(0)
+    n_g = pl.num_programs(0)
+
+    def dma(gg, slot, chan, hbm):
+        return pltpu.make_async_copy(
+            hbm.at[pl.ds(row0_ref[gg], K), :], sc.at[slot, chan],
+            sem.at[slot, chan])
+
+    def start(gg):
+        slot = jax.lax.rem(jnp.asarray(gg, jnp.int32), jnp.int32(2))
+        dma(gg, slot, 0, re_hbm).start()
+        dma(gg, slot, 1, im_hbm).start()
+
+    @pl.when(g == 0)
+    def _():
+        start(0)
+
+    @pl.when(g + 1 < n_g)
+    def _():
+        start(g + 1)
+
+    slot = jax.lax.rem(jnp.asarray(g, jnp.int32), jnp.int32(2))
+    dma(g, slot, 0, re_hbm).wait()
+    dma(g, slot, 1, im_hbm).wait()
+
+    def write(yr, yi):
+        out_r_ref[...] = yr
+        out_i_ref[...] = yi
+
+    _dec_zdft_body(K, P, R, dz, g, pos_ref, sfirst_ref,
+                   slast_ref, packed_ref, cr_ref, ci_ref, cs_ref,
+                   write, acc, sc, slot)
+
+
+def _kernel_dec_zdft_batched(K, P, R, dz, row0_ref, pos_ref, sfirst_ref,
+                             slast_ref, sup_ref, packed_ref, cr_ref, ci_ref,
+                             cs_ref, re_hbm, im_hbm, out_r_ref, out_i_ref,
+                             acc, sc, sem):
+    """Batched grid (B, C): batch b gathers+transforms slab b through
+    the shared tables; DMA pipeline prefetches across the batch
+    boundary (the gather kernels' pattern)."""
+    b = pl.program_id(0)
+    g = pl.program_id(1)
+    n_b = pl.num_programs(0)
+    n_g = pl.num_programs(1)
+    step = b * n_g + g
+
+    def dma(bb, gg, slot, chan, hbm):
+        return pltpu.make_async_copy(
+            hbm.at[bb, pl.ds(row0_ref[gg], K), :], sc.at[slot, chan],
+            sem.at[slot, chan])
+
+    def start(bb, gg, slot):
+        dma(bb, gg, slot, 0, re_hbm).start()
+        dma(bb, gg, slot, 1, im_hbm).start()
+
+    @pl.when(step == 0)
+    def _():
+        start(0, 0, 0)
+
+    @pl.when(step + 1 < n_b * n_g)
+    def _():
+        nxt_b = jnp.where(g + 1 < n_g, b, b + 1)
+        nxt_g = jnp.where(g + 1 < n_g, g + 1, 0)
+        start(nxt_b, nxt_g, jax.lax.rem(step + 1, jnp.int32(2)))
+
+    slot = jax.lax.rem(step, jnp.int32(2))
+    dma(b, g, slot, 0, re_hbm).wait()
+    dma(b, g, slot, 1, im_hbm).wait()
+
+    def write(yr, yi):
+        out_r_ref[0] = yr
+        out_i_ref[0] = yi
+
+    _dec_zdft_body(K, P, R, dz, g, pos_ref, sfirst_ref,
+                   slast_ref, packed_ref, cr_ref, ci_ref, cs_ref,
+                   write, acc, sc, slot)
+
+
+def run_decompress_zdft(re, im, dev_tables: tuple, mats: tuple,
+                        t: FusedDecompressTables,
+                        interpret: bool = False):
+    """Gathered decompress + z-DFT in one ``pallas_call``.
+
+    Args:
+      re, im: (src_rows, 128) planar f32 value source — or
+        (B, src_rows, 128) batched.
+      dev_tables: :func:`decompress_device_tables` output.
+      mats: :func:`commit_mats` backward z-DFT triple.
+    Returns:
+      (sr, si): transformed planar sticks, each
+      ``(num_super * r_sticks, dim_z)`` f32 (leading B when batched);
+      rows ``[:num_sticks]`` are the valid sticks.
+    """
+    C = int(t.row0.shape[0])
+    K, P, R, dz = t.span_rows, t.p_tiles, t.r_sticks, t.dim_z
+    scratch = [
+        pltpu.VMEM((2, P * TILE_SUB, TILE_LANE), jnp.float32),
+        pltpu.VMEM((2, 2, K, TILE_LANE), jnp.float32),
+        pltpu.SemaphoreType.DMA((2, 2)),
+    ]
+    mat_specs = [pl.BlockSpec((dz, dz), lambda *a: (0, 0))] * 3
+    if re.ndim == 3:
+        B = re.shape[0]
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=5,  # row0, pos, sfirst, slast, sup
+            grid=(B, C),
+            in_specs=[
+                pl.BlockSpec((1, TILE_SUB, TILE_LANE),
+                             lambda b, g, r0, ps, sf, sl, sp: (g, 0, 0)),
+            ] + mat_specs + [
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=(
+                pl.BlockSpec((1, R, dz),
+                             lambda b, g, r0, ps, sf, sl, sp:
+                             (b, sp[g], 0)),
+                pl.BlockSpec((1, R, dz),
+                             lambda b, g, r0, ps, sf, sl, sp:
+                             (b, sp[g], 0)),
+            ),
+            scratch_shapes=scratch,
+        )
+        out_shape = (
+            jax.ShapeDtypeStruct((B, t.num_super * R, dz), jnp.float32),
+            jax.ShapeDtypeStruct((B, t.num_super * R, dz), jnp.float32))
+        kern = functools.partial(_kernel_dec_zdft_batched, K, P, R, dz)
+    else:
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=5,
+            grid=(C,),
+            in_specs=[
+                pl.BlockSpec((1, TILE_SUB, TILE_LANE),
+                             lambda g, r0, ps, sf, sl, sp: (g, 0, 0)),
+            ] + mat_specs + [
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=(
+                pl.BlockSpec((R, dz),
+                             lambda g, r0, ps, sf, sl, sp: (sp[g], 0)),
+                pl.BlockSpec((R, dz),
+                             lambda g, r0, ps, sf, sl, sp: (sp[g], 0)),
+            ),
+            scratch_shapes=scratch,
+        )
+        out_shape = (
+            jax.ShapeDtypeStruct((t.num_super * R, dz), jnp.float32),
+            jax.ShapeDtypeStruct((t.num_super * R, dz), jnp.float32))
+        kern = functools.partial(_kernel_dec_zdft, K, P, R, dz)
+    row0, pos, sfirst, slast, sup, packed = dev_tables
+    cr, ci, cs = mats
+    return pl.pallas_call(
+        kern, out_shape=out_shape, grid_spec=grid_spec,
+        interpret=interpret,
+    )(row0, pos, sfirst, slast, sup, packed, cr, ci, cs, re, im)
+
+
+# -- forward kernel: z-DFT -> windowed compress gather -----------------------
+
+def _zdft_cmp_body(K, S_w, q, g, off_ref, first_ref, packed_ref,
+                   cr_ref, ci_ref, cs_ref, sc, slot, store):
+    """Shared per-step body of the forward fused kernel: transform the
+    DMA'd raw sticks, slice the chunk's flat window out, gather."""
+    xr = sc[slot, 0]
+    xi = sc[slot, 1]
+    yr, yi = _kara(xr, xi, cr_ref[...], ci_ref[...], cs_ref[...])
+    # (S_w, q*128) -> (S_w*q, 128): lane-preserving leading-dim split
+    fr = yr.reshape(S_w * q, TILE_LANE)
+    fi = yi.reshape(S_w * q, TILE_LANE)
+    win_re = jax.lax.dynamic_slice_in_dim(fr, off_ref[g], K, 0)
+    win_im = jax.lax.dynamic_slice_in_dim(fi, off_ref[g], K, 0)
+    acc_re, acc_im = _tile_compute_win(K, packed_ref[0], win_re, win_im)
+    store(first_ref[g], acc_re, acc_im)
+
+
+def _kernel_zdft_cmp(K, S_w, q, s0_ref, off_ref, out_tile_ref, first_ref,
+                     packed_ref, cr_ref, ci_ref, cs_ref, re_hbm, im_hbm,
+                     out_re_ref, out_im_ref, sc, sem):
+    g = pl.program_id(0)
+    n_g = pl.num_programs(0)
+
+    def dma(gg, slot, chan, hbm):
+        return pltpu.make_async_copy(
+            hbm.at[pl.ds(s0_ref[gg], S_w), :], sc.at[slot, chan],
+            sem.at[slot, chan])
+
+    def start(gg):
+        slot = jax.lax.rem(jnp.asarray(gg, jnp.int32), jnp.int32(2))
+        dma(gg, slot, 0, re_hbm).start()
+        dma(gg, slot, 1, im_hbm).start()
+
+    @pl.when(g == 0)
+    def _():
+        start(0)
+
+    @pl.when(g + 1 < n_g)
+    def _():
+        start(g + 1)
+
+    slot = jax.lax.rem(jnp.asarray(g, jnp.int32), jnp.int32(2))
+    dma(g, slot, 0, re_hbm).wait()
+    dma(g, slot, 1, im_hbm).wait()
+
+    def store(frst, acc_re, acc_im):
+        @pl.when(frst == 1)
+        def _():
+            out_re_ref[0] = acc_re
+            out_im_ref[0] = acc_im
+
+        @pl.when(frst == 0)
+        def _():
+            out_re_ref[0] = out_re_ref[0] + acc_re
+            out_im_ref[0] = out_im_ref[0] + acc_im
+
+    _zdft_cmp_body(K, S_w, q, g, off_ref, first_ref, packed_ref,
+                   cr_ref, ci_ref, cs_ref, sc, slot, store)
+
+
+def _kernel_zdft_cmp_batched(K, S_w, q, s0_ref, off_ref, out_tile_ref,
+                             first_ref, packed_ref, cr_ref, ci_ref, cs_ref,
+                             re_hbm, im_hbm, out_re_ref, out_im_ref,
+                             sc, sem):
+    b = pl.program_id(0)
+    g = pl.program_id(1)
+    n_b = pl.num_programs(0)
+    n_g = pl.num_programs(1)
+    step = b * n_g + g
+
+    def dma(bb, gg, slot, chan, hbm):
+        return pltpu.make_async_copy(
+            hbm.at[bb, pl.ds(s0_ref[gg], S_w), :], sc.at[slot, chan],
+            sem.at[slot, chan])
+
+    def start(bb, gg, slot):
+        dma(bb, gg, slot, 0, re_hbm).start()
+        dma(bb, gg, slot, 1, im_hbm).start()
+
+    @pl.when(step == 0)
+    def _():
+        start(0, 0, 0)
+
+    @pl.when(step + 1 < n_b * n_g)
+    def _():
+        nxt_b = jnp.where(g + 1 < n_g, b, b + 1)
+        nxt_g = jnp.where(g + 1 < n_g, g + 1, 0)
+        start(nxt_b, nxt_g, jax.lax.rem(step + 1, jnp.int32(2)))
+
+    slot = jax.lax.rem(step, jnp.int32(2))
+    dma(b, g, slot, 0, re_hbm).wait()
+    dma(b, g, slot, 1, im_hbm).wait()
+
+    def store(frst, acc_re, acc_im):
+        @pl.when(frst == 1)
+        def _():
+            out_re_ref[0, 0] = acc_re
+            out_im_ref[0, 0] = acc_im
+
+        @pl.when(frst == 0)
+        def _():
+            out_re_ref[0, 0] = out_re_ref[0, 0] + acc_re
+            out_im_ref[0, 0] = out_im_ref[0, 0] + acc_im
+
+    _zdft_cmp_body(K, S_w, q, g, off_ref, first_ref, packed_ref,
+                   cr_ref, ci_ref, cs_ref, sc, slot, store)
+
+
+def run_zdft_compress(sr, si, dev_tables: tuple, mats: tuple,
+                      t: FusedCompressTables,
+                      interpret: bool = False):
+    """z-DFT + windowed compress gather in one ``pallas_call``.
+
+    Args:
+      sr, si: (src_sticks, dim_z) planar f32 RAW (un-transformed)
+        sticks — or (B, src_sticks, dim_z) batched. Rows past the
+        plan's num_sticks must be zero.
+      dev_tables: :func:`compress_device_tables` output.
+      mats: :func:`commit_mats` forward z-DFT triple (scaling folded
+        into the matrices).
+    Returns:
+      (out_re, out_im): each (num_tiles, 8, 128) f32 (leading B when
+      batched); the flat prefix holds the ``num_out`` output values.
+    """
+    C = int(t.s0.shape[0])
+    K, S_w, dz = t.span_rows, t.win_sticks, t.dim_z
+    q = dz // TILE_LANE
+    scratch = [
+        pltpu.VMEM((2, 2, S_w, dz), jnp.float32),
+        pltpu.SemaphoreType.DMA((2, 2)),
+    ]
+    mat_specs = [pl.BlockSpec((dz, dz), lambda *a: (0, 0))] * 3
+    if sr.ndim == 3:
+        B = sr.shape[0]
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,  # s0, off, out_tile, first
+            grid=(B, C),
+            in_specs=[
+                pl.BlockSpec((1, TILE_SUB, TILE_LANE),
+                             lambda b, g, s0, of, ot, fs: (g, 0, 0)),
+            ] + mat_specs + [
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=(
+                pl.BlockSpec((1, 1, TILE_SUB, TILE_LANE),
+                             lambda b, g, s0, of, ot, fs:
+                             (b, ot[g], 0, 0)),
+                pl.BlockSpec((1, 1, TILE_SUB, TILE_LANE),
+                             lambda b, g, s0, of, ot, fs:
+                             (b, ot[g], 0, 0)),
+            ),
+            scratch_shapes=scratch,
+        )
+        out_shape = (
+            jax.ShapeDtypeStruct((B, t.num_tiles, TILE_SUB, TILE_LANE),
+                                 jnp.float32),
+            jax.ShapeDtypeStruct((B, t.num_tiles, TILE_SUB, TILE_LANE),
+                                 jnp.float32))
+        kern = functools.partial(_kernel_zdft_cmp_batched, K, S_w, q)
+    else:
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            grid=(C,),
+            in_specs=[
+                pl.BlockSpec((1, TILE_SUB, TILE_LANE),
+                             lambda g, s0, of, ot, fs: (g, 0, 0)),
+            ] + mat_specs + [
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=(
+                pl.BlockSpec((1, TILE_SUB, TILE_LANE),
+                             lambda g, s0, of, ot, fs: (ot[g], 0, 0)),
+                pl.BlockSpec((1, TILE_SUB, TILE_LANE),
+                             lambda g, s0, of, ot, fs: (ot[g], 0, 0)),
+            ),
+            scratch_shapes=scratch,
+        )
+        out_shape = (
+            jax.ShapeDtypeStruct((t.num_tiles, TILE_SUB, TILE_LANE),
+                                 jnp.float32),
+            jax.ShapeDtypeStruct((t.num_tiles, TILE_SUB, TILE_LANE),
+                                 jnp.float32))
+        kern = functools.partial(_kernel_zdft_cmp, K, S_w, q)
+    s0, off, out_tile, first, packed = dev_tables
+    cr, ci, cs = mats
+    return pl.pallas_call(
+        kern, out_shape=out_shape, grid_spec=grid_spec,
+        interpret=interpret,
+    )(s0, off, out_tile, first, packed, cr, ci, cs, sr, si)
+
+
+def pad_sticks_planar(sr, si, src_sticks: int):
+    """Zero-pad planar (num_sticks, dim_z) stick channels — or batched
+    (B, num_sticks, dim_z) — to the ``src_sticks`` rows the forward
+    kernel's window DMAs may touch (a handful of rows; XLA folds the
+    pad into the producing op's output buffer)."""
+    pad = src_sticks - sr.shape[-2]
+    if pad <= 0:
+        return sr, si
+    widths = [(0, 0)] * (sr.ndim - 2) + [(0, pad), (0, 0)]
+    return jnp.pad(sr, widths), jnp.pad(si, widths)

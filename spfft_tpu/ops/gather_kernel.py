@@ -554,10 +554,12 @@ def pad_tables_to(t: "MonotoneGatherTables", c_max: int):
     return row0, out_tile, first, packed
 
 
-def _tile_compute(K: int, packed_ref, sc, slot):
-    """Shared per-tile compute: decode the packed selector words, gather K
-    candidate rows from the VMEM window, select-accumulate."""
-    t = packed_ref[0]
+def _tile_compute_win(K: int, t, win_re, win_im):
+    """Per-tile compute on explicit (K, 128) window ARRAYS: decode the
+    packed selector words ``t`` (8, 128), gather K candidate rows from
+    the window, select-accumulate. Shared with the fused
+    compression+DFT kernels (ops.fused_kernel), whose windows are
+    computed in VMEM rather than DMA'd."""
     lane = t & (TILE_LANE - 1)
     row = (t >> _ROW_SHIFT) & _ROW_MASK
     m = (t >> _VALID_SHIFT).astype(jnp.float32)
@@ -565,13 +567,19 @@ def _tile_compute(K: int, packed_ref, sc, slot):
     acc_im = jnp.zeros((TILE_SUB, TILE_LANE), jnp.float32)
     for k in range(K):
         sel = row == k
-        src_re = jnp.broadcast_to(sc[slot, 0, k][None, :],
+        src_re = jnp.broadcast_to(win_re[k][None, :],
                                   (TILE_SUB, TILE_LANE))
-        src_im = jnp.broadcast_to(sc[slot, 1, k][None, :],
+        src_im = jnp.broadcast_to(win_im[k][None, :],
                                   (TILE_SUB, TILE_LANE))
         acc_re += jnp.where(sel, jnp.take_along_axis(src_re, lane, axis=1), 0)
         acc_im += jnp.where(sel, jnp.take_along_axis(src_im, lane, axis=1), 0)
     return acc_re * m, acc_im * m
+
+
+def _tile_compute(K: int, packed_ref, sc, slot):
+    """Shared per-tile compute: decode the packed selector words, gather K
+    candidate rows from the VMEM window, select-accumulate."""
+    return _tile_compute_win(K, packed_ref[0], sc[slot, 0], sc[slot, 1])
 
 
 def _kernel(K: int, row0_ref, out_tile_ref, first_ref, packed_ref,

@@ -229,6 +229,13 @@ class TransformPlan:
         # 256^3) commit lazily via _commit_fallback / the _tables property.
         self._pallas_box = None
         self._pallas_active_flag = False
+        #: Fused compression+z-DFT state (ops/fused_kernel.py): per-
+        #: direction tables ("dec"/"cmp"), per-direction fallback
+        #: reasons, and the activation flag. Built on the same
+        #: background thread as the gather tables.
+        self._fused_box = {"dec": None, "cmp": None}
+        self._fused_reasons = {}
+        self._fused_active_flag = False
         self._build_thread = None
         self._build_exc = None
         self._tables_full = None
@@ -371,9 +378,12 @@ class TransformPlan:
             if cmp_ is not None:
                 self._tables_hot["cmp_tabs"] = gk.gather_device_tables(cmp_)
             if dec is None or cmp_ is None:
+                from . import obs as _obs
                 fell_back = [n for n, t in (("decompress", dec),
                                             ("compress", cmp_))
                              if t is None]
+                for stage in fell_back:
+                    _obs.record_plan_fallback(stage, "value_order")
                 # WARNING only when the caller explicitly asked for the
                 # kernel; auto mode logs at INFO.
                 log = logger.warning if use_pallas is True else logger.info
@@ -383,6 +393,8 @@ class TransformPlan:
                     "path there (sort triplets with utils.workloads."
                     "sort_triplets_stick_major for the fast path)",
                     " and ".join(fell_back))
+            self._build_fused_tables(dec_idx, occupied, cmp_idx, cmp_valid,
+                                     num_slots, dec, cmp_)
             if dec is None and cmp_ is None:
                 self._pallas_box = None
                 return
@@ -397,6 +409,88 @@ class TransformPlan:
                 _time.perf_counter() - _t0_tables, _t0_tables,
                 num_values=int(self.index_plan.num_values),
                 failed=self._build_exc is not None)
+
+    def _build_fused_tables(self, dec_idx, occupied, cmp_idx, cmp_valid,
+                            num_slots, dec_best, cmp_best) -> None:
+        """Build the fused compression+z-DFT tables (ops/fused_kernel)
+        for whichever directions pass the gate; record every decline as
+        a ``spfft_plan_pallas_fallback_total`` reason. Runs on the
+        background build thread, after the gather tables.
+
+        The fused kernels consume the NARROW chunk decomposition
+        (chunks of one 1024-slot tile, tile-major — the revisiting
+        order the super-tile accumulation needs); when the preferred
+        gather tables came out wide, a narrow set is built here just
+        for the fused path."""
+        from . import obs as _obs
+        from .ops import dft as _dft
+        from .ops import fused_kernel as fkm
+        from .ops import gather_kernel as gk
+
+        p = self.index_plan
+        if not self._use_mdft or not fkm.enabled() \
+                or not (self._backend_ok or fkm.interpret_forced()):
+            return  # the fused path was never in play — nothing to record
+
+        def narrow(best, idx, valid, n_src):
+            if isinstance(best, gk.MonotoneGatherTables):
+                return best
+            if best is None:  # best-effort build already blew up
+                return None
+            return gk.build_monotone_gather_tables(idx, valid, n_src)
+
+        reasons = {}
+        box = {"dec": None, "cmp": None}
+        # backward: gather-decompress + z-DFT. The r2c (0,0)-stick
+        # hermitian completion runs BETWEEN decompress and the z stage,
+        # so plans that need it keep the two-kernel path.
+        if self._is_r2c and p.zero_stick_id is not None:
+            reasons["dec"] = "hermitian_completion"
+        else:
+            nt = narrow(dec_best, dec_idx, occupied, p.num_values)
+            if nt is None:
+                reasons["dec"] = "value_order"
+            else:
+                out = fkm.build_fused_decompress_tables(nt, p.dim_z,
+                                                        self._s_pad)
+                if isinstance(out, str):
+                    reasons["dec"] = out
+                else:
+                    box["dec"] = out
+                    self._tables_hot["fzd_tabs"] = \
+                        fkm.decompress_device_tables(out)
+                    self._tables_hot["fzd_mats"] = fkm.commit_mats(
+                        _dft.c2c_mats(p.dim_z, _dft.BACKWARD))
+        # forward twin: z-DFT + compress gather, FULL scaling folded
+        # into a second matrix triple at plan time
+        ct = narrow(cmp_best, cmp_idx, cmp_valid, num_slots)
+        if ct is None:
+            reasons["cmp"] = "value_order"
+        else:
+            out = fkm.build_fused_compress_tables(ct, p.dim_z, self._s_pad)
+            if isinstance(out, str):
+                reasons["cmp"] = out
+            else:
+                box["cmp"] = out
+                self._tables_hot["fzc_tabs"] = \
+                    fkm.compress_device_tables(out)
+                self._tables_hot["fzc_mats"] = fkm.commit_mats(
+                    _dft.c2c_mats(p.dim_z, _dft.FORWARD))
+                self._tables_hot["fzc_mats_s"] = fkm.commit_mats(
+                    _dft.c2c_mats(p.dim_z, _dft.FORWARD,
+                                  scale=1.0 / float(self.global_size)))
+        stage_name = {"dec": "fused_decompress_zdft",
+                      "cmp": "fused_zdft_compress"}
+        for which, why in reasons.items():
+            _obs.record_plan_fallback(stage_name[which], why)
+            logger.info(
+                "spfft_tpu: fused compression+DFT kernel unavailable for "
+                "%s (%s) — keeping the two-kernel path there",
+                stage_name[which], why)
+        self._fused_reasons = reasons
+        self._fused_box = box
+        self._fused_active_flag = box["dec"] is not None \
+            or box["cmp"] is not None
 
     def _commit_fallback(self, which: str) -> None:
         """Commit the XLA-gather fallback table for one compression
@@ -414,25 +508,56 @@ class TransformPlan:
             self._tables_hot["value_indices"] = jnp.asarray(
                 p.value_indices)
 
-    def _finalize(self) -> None:
+    def _join_build(self) -> None:
         """Join the background table build (no-op afterwards) and commit
-        whatever fallback tables the outcome requires. A build failure is
-        STICKY: every subsequent execution call re-raises the original
-        error (a one-shot raise would leave later calls with neither
-        pallas nor fallback tables committed and fail with a confusing
-        KeyError inside the jitted pipeline — round-4 advisor finding)."""
+        whatever fallback tables the outcome requires. Never raises —
+        :meth:`close`/``__del__`` use it for a silent teardown join."""
         th = self._build_thread
         if th is not None:
             th.join()
             self._build_thread = None
             if self._build_exc is None:
                 box = self._pallas_box
-                if box is None or box["dec"] is None:
+                # an INACTIVE kernel (tables built off-TPU for testing)
+                # still executes through the XLA gather, which needs the
+                # fallback tables committed — use_pallas=True plans on
+                # CPU used to KeyError on their first execution here
+                active = self._pallas_active_flag
+                if box is None or box["dec"] is None or not active:
                     self._commit_fallback("dec")
-                if box is None or box["cmp"] is None:
+                if box is None or box["cmp"] is None or not active:
                     self._commit_fallback("cmp")
+
+    def _finalize(self) -> None:
+        """Join the background table build and surface any off-thread
+        build failure as a typed :class:`~spfft_tpu.errors.TableBuildError`
+        on first use. The failure is STICKY: every subsequent execution
+        call re-raises it (a one-shot raise would leave later calls with
+        neither pallas nor fallback tables committed and fail with a
+        confusing KeyError inside the jitted pipeline — round-4 advisor
+        finding)."""
+        self._join_build()
         if self._build_exc is not None:
-            raise self._build_exc
+            from .errors import TableBuildError
+            raise TableBuildError(
+                f"the plan's background compression-table build failed: "
+                f"{self._build_exc!r}", cause=self._build_exc)
+
+    def close(self) -> None:
+        """Join the plan's background compression-table build thread.
+        Plans are otherwise passive (XLA owns the executables), but an
+        abandoned plan must not leak a running builder: ``close`` (or
+        garbage collection via ``__del__``) blocks until the thread is
+        done. Never raises — a failed build surfaces as
+        :class:`~spfft_tpu.errors.TableBuildError` on the next
+        execution call, not at teardown. Idempotent."""
+        self._join_build()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass  # interpreter teardown: modules may already be gone
 
     @property
     def _pallas(self):
@@ -449,6 +574,18 @@ class TransformPlan:
         # tests force the kernel path in interpret mode on CPU
         self._finalize()
         self._pallas_active_flag = bool(value)
+
+    @property
+    def _fused(self):
+        self._finalize()
+        return self._fused_box
+
+    def _fused_on(self, which: str, pallas: bool = True) -> bool:
+        """Trace-time dispatch gate for one fused direction (``"dec"``
+        backward / ``"cmp"`` forward). Callers reach this inside the
+        jitted pipelines, after the public entry already finalized."""
+        return (pallas and self._fused_active_flag
+                and self._fused_box.get(which) is not None)
 
     @property
     def _tables(self):
@@ -576,6 +713,27 @@ class TransformPlan:
         kernel (TPU backend, single precision, value order coherent enough
         for the chunk decomposition). False means the XLA gather path."""
         return self._pallas_active
+
+    @property
+    def fused_active(self) -> bool:
+        """True when at least one direction runs the FUSED
+        compression+z-DFT kernel (ops/fused_kernel.py): the dense
+        ``(num_sticks, dim_z)`` planar stick intermediate between the
+        compression gather and the z stage never touches HBM there.
+        Per-direction detail in :attr:`fused_fallback_reasons`."""
+        self._finalize()
+        return self._fused_active_flag
+
+    @property
+    def fused_fallback_reasons(self) -> dict:
+        """Per-direction fallback reasons of the fused
+        compression+z-DFT gate: ``{"dec": reason, "cmp": reason}`` with
+        entries only for directions that DECLINED (empty dict = both
+        fused, or the fused path was never in play — non-mdft pipeline,
+        disabled, or no Pallas build). Reasons mirror the
+        ``spfft_plan_pallas_fallback_total{stage,reason}`` counter."""
+        self._finalize()
+        return dict(self._fused_reasons)
 
     @property
     def pair_values_io(self) -> bool:
@@ -708,6 +866,60 @@ class TransformPlan:
         return gk.interleaved_from_planar(out_re, out_im, t.num_out,
                                           pair=self._pair_io)
 
+    def _decompress_zdft(self, values_il, tables):
+        """Values -> z-TRANSFORMED planar stick channels (sr, si) in ONE
+        fused Pallas kernel (ops/fused_kernel.run_decompress_zdft): the
+        dense pre-FFT stick intermediate never touches HBM. Accepts the
+        batched (B, ...) boundary too (batched kernel grid)."""
+        from .ops import fused_kernel as fkm
+        from .ops import gather_kernel as gk
+        t = self._fused_box["dec"]
+        re, im = gk.planar_from_interleaved(values_il.astype(np.float32),
+                                            t.src_rows, pair=self._pair_io)
+        sr, si = fkm.run_decompress_zdft(
+            re, im, tables["fzd_tabs"], tables["fzd_mats"], t,
+            interpret=fkm.interpret_forced())
+        s = self._s_pad
+        if sr.ndim == 3:
+            return sr[:, :s], si[:, :s]
+        return sr[:s], si[:s]
+
+    def _zdft_compress(self, sr, si, tables, scaled: bool):
+        """RAW planar stick channels -> the plan's value output layout
+        in ONE fused Pallas kernel (ops/fused_kernel.run_zdft_compress);
+        FULL scaling is folded into the plan-time matrix triple
+        (compile-time scaling). Accepts batched (B, ...) sticks."""
+        from .ops import fused_kernel as fkm
+        from .ops import gather_kernel as gk
+        t = self._fused_box["cmp"]
+        sr, si = fkm.pad_sticks_planar(sr, si, t.src_sticks)
+        out_re, out_im = fkm.run_zdft_compress(
+            sr, si, tables["fzc_tabs"],
+            tables["fzc_mats_s" if scaled else "fzc_mats"], t,
+            interpret=fkm.interpret_forced())
+        return gk.interleaved_from_planar(out_re, out_im, t.num_out,
+                                          pair=self._pair_io)
+
+    def _bwd_space_tp(self, values_il, tables, pallas=True):
+        """The mdft backward pipeline with the fused-kernel dispatch:
+        values -> planar/real space. Fused when the gate admitted the
+        decompress direction, else decompress + z + tail unfused."""
+        if self._fused_on("dec", pallas):
+            sr, si = self._decompress_zdft(values_il, tables)
+            return self._backward_after_z(sr, si, tables)
+        sr, si = self._decompress_planar(values_il, tables, pallas)
+        return self._backward_rest_tp(sr, si, tables)
+
+    def _fwd_values_tp(self, space_p, tables, scaled: bool, pallas=True):
+        """The mdft forward pipeline with the fused-kernel dispatch:
+        planar/real space -> values in the plan's output layout."""
+        if self._fused_on("cmp", pallas):
+            sr, si = self._forward_pre_z(space_p, tables)
+            return self._zdft_compress(sr, si, tables, scaled)
+        scale = 1.0 / self.global_size if scaled else None
+        sr, si = self._forward_head_tp(space_p, tables, scale)
+        return self._compress_planar(sr, si, tables, pallas)
+
     def _backward_rest_tp(self, sr, si, tables):
         """Matmul-DFT T-layout tail of backward, fully PLANAR (separate
         re/im f32 arrays — XLA stores c64 interleaved T(2,128), so every
@@ -727,6 +939,15 @@ class TransformPlan:
             si = si.at[zid].set(jnp.where(nz, ri, -jnp.roll(ri[::-1], 1)))
         sr, si = dft.pdft_last_opt(sr, si,
                                    dft.c2c_mats(p.dim_z, dft.BACKWARD))
+        return self._backward_after_z(sr, si, tables)
+
+    def _backward_after_z(self, sr, si, tables):
+        """Everything of the T-layout backward tail AFTER the z-stage:
+        unpack into the transposed plane grid, y-DFT, swap, x-stage.
+        Split out so the fused decompress+z-DFT kernel (which emits
+        already-transformed sticks) can join the pipeline here."""
+        from .ops import dft
+        p = self.index_plan
         xf = p.dim_x_freq
         unpack = stages.sticks_to_grid_padded \
             if self._s_pad > p.num_sticks else stages.sticks_to_grid
@@ -775,6 +996,17 @@ class TransformPlan:
         (sr, si) planar sticks."""
         from .ops import dft
         p = self.index_plan
+        sr, si = self._forward_pre_z(space_p, tables)
+        return dft.pdft_last_opt(
+            sr, si, dft.c2c_mats(p.dim_z, dft.FORWARD,
+                                 scale=scale if scale else 1.0))
+
+    def _forward_pre_z(self, space_p, tables):
+        """The forward head UP TO the z-stage (xy stages + pack into
+        raw sticks) — the seam the fused z-DFT+compress kernel joins
+        at. Returns un-transformed (sr, si) planar sticks."""
+        from .ops import dft
+        p = self.index_plan
         xf = p.dim_x_freq
         y_mats = dft.c2c_mats(p.dim_y, dft.FORWARD)
         if self._split_x is not None:
@@ -802,9 +1034,7 @@ class TransformPlan:
                                          y_mats)
         sr = stages.grid_to_sticks(gr, cols_tab)
         si = stages.grid_to_sticks(gi, cols_tab)
-        return dft.pdft_last_opt(
-            sr, si, dft.c2c_mats(p.dim_z, dft.FORWARD,
-                                 scale=scale if scale else 1.0))
+        return sr, si
 
     def _forward_head_t(self, space, tables, scale):
         """Complex-dtype wrapper of :meth:`_forward_head_tp` (batched
@@ -930,8 +1160,7 @@ class TransformPlan:
         if self._ds:
             return self._ds_backward_impl(values_il, tables)
         if self._use_mdft:
-            sr, si = self._decompress_planar(values_il, tables, pallas)
-            out = self._backward_rest_tp(sr, si, tables)
+            out = self._bwd_space_tp(values_il, tables, pallas)
             if self._is_r2c:
                 return out
             return jnp.stack([out[0], out[1]], axis=-1)
@@ -968,11 +1197,10 @@ class TransformPlan:
     def _forward_impl(self, space, tables, *, scaled: bool, pallas=True):
         if self._ds:
             return self._ds_forward_impl(space, tables, scaled)
-        scale = 1.0 / self.global_size if scaled else None
         if self._use_mdft:  # planar pipeline, scale folded into z matrix
             sp = space if self._is_r2c else (space[..., 0], space[..., 1])
-            sr, si = self._forward_head_tp(sp, tables, scale)
-            return self._compress_planar(sr, si, tables, pallas)
+            return self._fwd_values_tp(sp, tables, scaled, pallas)
+        scale = 1.0 / self.global_size if scaled else None
         sticks = self._forward_head(space, tables)
         return self._compress(sticks, tables, scale, pallas)
 
@@ -1019,10 +1247,25 @@ class TransformPlan:
             values = values * jnp.asarray(scale, values.dtype)
         return values
 
+    def _backward_after_z_il(self, sr, si, tables):
+        """:meth:`_backward_after_z` in the public space layout
+        (interleaved for C2C, real for R2C) — the batched fused path's
+        vmap body."""
+        out = self._backward_after_z(sr, si, tables)
+        if self._is_r2c:
+            return out
+        return jnp.stack([out[0], out[1]], axis=-1)
+
     def _backward_impl_batched(self, values_b, tables):
         if self._ds:
             return jax.vmap(
                 lambda v: self._ds_backward_impl(v, tables))(values_b)
+        if self._use_mdft and self._fused_on("dec"):
+            # one batched-grid fused kernel launch, then the xy tail
+            # per slab (the z-transformed sticks never touch HBM dense)
+            sr_b, si_b = self._decompress_zdft(values_b, tables)
+            return jax.vmap(self._backward_after_z_il,
+                            in_axes=(0, 0, None))(sr_b, si_b, tables)
         sticks_b = self._decompress_batched(values_b, tables)
         return jax.vmap(self._backward_rest,
                         in_axes=(0, None))(sticks_b, tables)
@@ -1032,6 +1275,12 @@ class TransformPlan:
             return jax.vmap(lambda sp: self._ds_forward_impl(
                 sp, tables, scaled))(space_b)
         scale = 1.0 / self.global_size if scaled else None
+        if self._use_mdft and self._fused_on("cmp"):
+            sp_b = space_b if self._is_r2c \
+                else (space_b[..., 0], space_b[..., 1])
+            sr_b, si_b = jax.vmap(self._forward_pre_z,
+                                  in_axes=(0, None))(sp_b, tables)
+            return self._zdft_compress(sr_b, si_b, tables, scaled)
         if self._use_mdft:
             sticks_b = jax.vmap(
                 lambda s, t: self._forward_head(s, t, scale),
@@ -1188,8 +1437,7 @@ class TransformPlan:
             # fully planar round trip; the space domain is materialised
             # in the public interleaved layout ONLY when a pointwise fn
             # needs to see it
-            sr, si = self._decompress_planar(values_il, tables)
-            space = self._backward_rest_tp(sr, si, tables)
+            space = self._bwd_space_tp(values_il, tables)
             if fn is not None:
                 if self._is_r2c:
                     space = fn(space, *fn_args)
@@ -1197,9 +1445,7 @@ class TransformPlan:
                     s = fn(jnp.stack([space[0], space[1]], axis=-1),
                            *fn_args)
                     space = (s[..., 0], s[..., 1])
-            scale = 1.0 / self.global_size if scaled else None
-            out_sr, out_si = self._forward_head_tp(space, tables, scale)
-            return self._compress_planar(out_sr, out_si, tables)
+            return self._fwd_values_tp(space, tables, scaled)
         space = self._backward_impl(values_il, tables)
         if fn is not None:
             space = fn(space, *fn_args)

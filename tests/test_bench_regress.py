@@ -102,3 +102,55 @@ def test_real_repo_reference_resolves():
     assert ref is not None and ref.endswith("BENCH_r05.json")
     value, unit, metric = bench_regress.load_measurement(ref)
     assert unit == "s" and value > 0
+
+
+def _write_with_fused(path, value, fused_value, unit="s", wrap=False):
+    payload = {"metric": "m", "value": value, "unit": unit,
+               "fused": {"metric": "m fused", "value": fused_value,
+                         "unit": unit}}
+    if wrap:
+        payload = {"n": 1, "parsed": payload}
+    path.write_text(json.dumps(payload))
+    return str(path)
+
+
+def test_fused_row_compared_when_both_sides_carry_it(tmp_path, capsys):
+    """A 'fused' sub-row in both files is compared with the same rules
+    and regresses the exit code on its own (BENCH_r06.json onward)."""
+    ref = _write_with_fused(tmp_path / "BENCH_r06.json", 0.0106, 0.008,
+                            wrap=True)
+    ok = _write_with_fused(tmp_path / "ok.json", 0.0107, 0.0081)
+    assert bench_regress.main(["--fresh", ok, "--against", ref]) == 0
+    lines = [json.loads(li) for li in
+             capsys.readouterr().out.splitlines()]
+    assert [v["row"] for v in lines] == ["primary", "fused"]
+    assert all(v["ok"] for v in lines)
+
+    # primary fine, fused 2x slower -> regression from the fused row
+    bad = _write_with_fused(tmp_path / "bad.json", 0.0107, 0.016)
+    assert bench_regress.main(["--fresh", bad, "--against", ref]) == 1
+    lines = [json.loads(li) for li in
+             capsys.readouterr().out.splitlines()]
+    by_row = {v["row"]: v for v in lines}
+    assert by_row["primary"]["ok"]
+    assert not by_row["fused"]["ok"]
+
+
+def test_fused_row_one_sided_is_skipped(tmp_path, capsys):
+    """A fused row on only one side (older reference predates it, or a
+    fresh run without --fused) is reported and never fails."""
+    ref_plain = _write(tmp_path / "ref.json", 0.0106)
+    fresh_fused = _write_with_fused(tmp_path / "fresh.json", 0.0107,
+                                    0.008)
+    assert bench_regress.main(["--fresh", fresh_fused,
+                               "--against", ref_plain]) == 0
+    lines = [json.loads(li) for li in
+             capsys.readouterr().out.splitlines()]
+    assert lines[-1]["verdict"] == "row-no-reference"
+    assert lines[-1]["row"] == "fused"
+    # and the mirror: reference has it, fresh does not
+    ref_fused = _write_with_fused(tmp_path / "r.json", 0.0106, 0.008)
+    fresh_plain = _write(tmp_path / "f.json", 0.0107)
+    assert bench_regress.main(["--fresh", fresh_plain,
+                               "--against", ref_fused]) == 0
+    capsys.readouterr()

@@ -85,3 +85,44 @@ def test_cli_exchange_all_needs_shards():
 
 def test_cli_bad_dims():
     assert main(["-d", "4", "4"]) == 2
+
+
+def test_cli_fused_ab(tmp_path, monkeypatch):
+    """--fused / --no-fused: the A/B flag of the fused compression+
+    z-DFT Pallas path (docs/kernels.md). --fused must report the fused
+    kernels ACTIVE (off-TPU via the forced matmul-DFT pipeline +
+    interpret mode), --no-fused must report them off, and main() must
+    restore the env knobs it set (the tier-1 suite shares a process)."""
+    for var in ("SPFFT_TPU_FUSED_COMPRESS", "SPFFT_TPU_FUSED_INTERPRET",
+                "SPFFT_TPU_FORCE_MATMUL_DFT"):
+        monkeypatch.delenv(var, raising=False)
+    out_on = tmp_path / "fused_on.json"
+    assert main(["-d", "8", "6", "128", "-r", "1", "--fused",
+                 "-o", str(out_on)]) == 0
+    p_on = json.loads(out_on.read_text())["parameters"]
+    assert p_on["fused"] is True
+    assert p_on["fused_fallback"] == {}
+    assert os.environ.get("SPFFT_TPU_FUSED_COMPRESS") is None
+
+    out_off = tmp_path / "fused_off.json"
+    assert main(["-d", "8", "6", "128", "-r", "1", "--no-fused",
+                 "-o", str(out_off)]) == 0
+    p_off = json.loads(out_off.read_text())["parameters"]
+    assert p_off["fused"] is False
+    assert os.environ.get("SPFFT_TPU_FUSED_COMPRESS") is None
+
+
+def test_cli_fused_reports_fallback_reason(tmp_path, monkeypatch):
+    """--fused on a fused-ineligible workload (dim_z not a multiple of
+    128) still runs — two-kernel path — and the JSON carries the
+    per-direction gate reasons the obs counter records."""
+    for var in ("SPFFT_TPU_FUSED_COMPRESS", "SPFFT_TPU_FUSED_INTERPRET",
+                "SPFFT_TPU_FORCE_MATMUL_DFT"):
+        monkeypatch.delenv(var, raising=False)
+    out = tmp_path / "fused_fb.json"
+    assert main(["-d", "8", "6", "96", "-r", "1", "--fused",
+                 "-o", str(out)]) == 0
+    params = json.loads(out.read_text())["parameters"]
+    assert params["fused"] is False
+    assert params["fused_fallback"] == {
+        "dec": "dimz_not_multiple_128", "cmp": "dimz_not_multiple_128"}

@@ -1,0 +1,374 @@
+"""Interpret-mode tests for the fused compression+z-DFT Pallas kernels
+(ops/fused_kernel.py) and their plan dispatch: bit-exact (fp32) against
+the unfused decompress -> pdft_last composition across c2c/r2c, batched,
+shuffled-stick orders and sentinel/zero-stick edge cases, plus the
+fallback gate (every unsupported case routes to the two-kernel path
+with a recorded reason) and the HLO evidence that the dense gather-tile
+intermediate is gone from the fused program."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from spfft_tpu import Scaling, TransformType, make_local_plan
+from spfft_tpu.ops import dft
+from spfft_tpu.ops import fused_kernel as fkm
+from spfft_tpu.ops import gather_kernel as gk
+
+DIM_Z = 128  # smallest fused-eligible z (dim_z % 128 == 0)
+
+
+@pytest.fixture
+def fused_env(monkeypatch):
+    """The CPU fused lane: the mdft T pipeline forced on (the fused
+    seam only exists there) and the fused kernels in interpret mode."""
+    monkeypatch.setenv("SPFFT_TPU_FORCE_MATMUL_DFT", "1")
+    monkeypatch.setenv("SPFFT_TPU_FUSED_INTERPRET", "1")
+
+
+def _plan(triplets, nx=8, ny=6, nz=DIM_Z, ttype=TransformType.C2C,
+          **kw):
+    return make_local_plan(ttype, nx, ny, nz, np.asarray(triplets,
+                                                         np.int32),
+                           precision="single", use_pallas=True, **kw)
+
+
+def _gappy_triplets(nx=8, ny=6, nz=DIM_Z, z_step=2):
+    """Sparse sticks (every other z slot empty) — the sentinel/empty-
+    slot edge the gather mask must zero before the DFT sees it."""
+    return [(x, y, z) for x in range(nx) for y in range(ny)
+            if (x + y) % 3 != 0 for z in range(0, nz, z_step)]
+
+
+def _values(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal(n)
+            + 1j * rng.standard_normal(n)).astype(np.complex64)
+
+
+def _unfused_backward(plan, vals):
+    return np.asarray(jax.jit(
+        lambda v, t: plan._backward_impl(v, t, pallas=False))(
+            plan._coerce_values(vals), plan._tables))
+
+
+def _unfused_forward(plan, space, scaled):
+    return np.asarray(jax.jit(
+        lambda s, t: plan._forward_impl(s, t, scaled=scaled,
+                                        pallas=False))(
+            plan._coerce_space(space), plan._tables))
+
+
+# -- kernel level ------------------------------------------------------------
+
+def test_kernel_decompress_zdft_matches_composition():
+    """run_decompress_zdft == windowed gather -> pdft_last, elementwise
+    (fp32), on a sparse slot set."""
+    rng = np.random.default_rng(0)
+    s_pad, dim_z = 32, DIM_Z
+    num_slots = s_pad * dim_z
+    occ = rng.random(num_slots) < 0.6
+    vi = np.flatnonzero(occ)
+    (dec_idx, occupied), _ = gk.compression_gather_inputs(vi, num_slots)
+    nt = gk.build_monotone_gather_tables(dec_idx, occupied, len(vi))
+    assert nt is not None and not nt.segs
+    ft = fkm.build_fused_decompress_tables(nt, dim_z, s_pad)
+    assert not isinstance(ft, str)
+    assert ft.r_sticks * dim_z == ft.p_tiles * gk.TILE
+
+    vals = rng.standard_normal((len(vi), 2)).astype(np.float32)
+    re, im = gk.planar_from_interleaved(jnp.asarray(vals), nt.src_rows)
+    mats = dft.c2c_mats(dim_z, dft.BACKWARD)
+    sr, si = fkm.run_decompress_zdft(
+        re, im, fkm.decompress_device_tables(ft), fkm.commit_mats(mats),
+        ft, interpret=True)
+
+    o_re, o_im = gk.run_gather(re, im, gk.gather_device_tables(nt), nt,
+                               interpret=True)
+    ur = np.asarray(o_re).reshape(-1)[:num_slots].reshape(s_pad, dim_z)
+    ui = np.asarray(o_im).reshape(-1)[:num_slots].reshape(s_pad, dim_z)
+    wr, wi = dft.pdft_last(jnp.asarray(ur), jnp.asarray(ui), mats)
+    np.testing.assert_allclose(np.asarray(sr)[:s_pad], np.asarray(wr),
+                               rtol=2e-6, atol=2e-6)
+    np.testing.assert_allclose(np.asarray(si)[:s_pad], np.asarray(wi),
+                               rtol=2e-6, atol=2e-6)
+
+
+def test_kernel_zdft_compress_matches_composition():
+    """run_zdft_compress == pdft_last -> windowed gather, with the
+    scale folded into the matrices (compile-time scaling)."""
+    rng = np.random.default_rng(1)
+    s_pad, dim_z = 32, DIM_Z
+    num_slots = s_pad * dim_z
+    vi = np.flatnonzero(rng.random(num_slots) < 0.5)
+    _, (cmp_idx, cmp_valid) = gk.compression_gather_inputs(vi, num_slots)
+    nt = gk.build_monotone_gather_tables(cmp_idx, cmp_valid, num_slots)
+    assert nt is not None and not nt.segs
+    ct = fkm.build_fused_compress_tables(nt, dim_z, s_pad)
+    assert not isinstance(ct, str)
+
+    sr = rng.standard_normal((s_pad, dim_z)).astype(np.float32)
+    si = rng.standard_normal((s_pad, dim_z)).astype(np.float32)
+    mats = dft.c2c_mats(dim_z, dft.FORWARD, scale=1.0 / num_slots)
+    psr, psi = fkm.pad_sticks_planar(jnp.asarray(sr), jnp.asarray(si),
+                                     ct.src_sticks)
+    fo_re, fo_im = fkm.run_zdft_compress(
+        psr, psi, fkm.compress_device_tables(ct), fkm.commit_mats(mats),
+        ct, interpret=True)
+    got_re = np.asarray(fo_re).reshape(-1)[:ct.num_out]
+    got_im = np.asarray(fo_im).reshape(-1)[:ct.num_out]
+
+    tr, ti = dft.pdft_last(jnp.asarray(sr), jnp.asarray(si), mats)
+    pad = nt.src_rows * 128 - num_slots
+    fre = jnp.pad(jnp.asarray(tr).reshape(-1),
+                  (0, pad)).reshape(nt.src_rows, 128)
+    fim = jnp.pad(jnp.asarray(ti).reshape(-1),
+                  (0, pad)).reshape(nt.src_rows, 128)
+    w_re, w_im = gk.run_gather(fre, fim, gk.gather_device_tables(nt), nt,
+                               interpret=True)
+    np.testing.assert_allclose(
+        got_re, np.asarray(w_re).reshape(-1)[:nt.num_out],
+        rtol=2e-6, atol=2e-6)
+    np.testing.assert_allclose(
+        got_im, np.asarray(w_im).reshape(-1)[:nt.num_out],
+        rtol=2e-6, atol=2e-6)
+
+
+def test_super_tile_geometry_invariant():
+    for dz in (128, 256, 384, 512, 640):
+        r, p = fkm.super_tile_geometry(dz)
+        assert r * dz == p * gk.TILE
+        assert p <= fkm.MAX_P_TILES
+
+
+# -- plan level --------------------------------------------------------------
+
+def test_plan_backward_forward_fused_bit_exact(fused_env):
+    """Fused c2c round trip == the unfused two-kernel composition,
+    elementwise, both scalings — the gappy (sentinel-heavy) stick set."""
+    plan = _plan(_gappy_triplets())
+    assert plan.fused_active
+    assert plan.fused_fallback_reasons == {}
+    vals = _values(plan.num_local_elements, seed=2)
+    space = np.asarray(plan.backward(vals))
+    np.testing.assert_allclose(space, _unfused_backward(plan, vals),
+                               rtol=2e-6, atol=2e-6)
+    for scaling, scaled in ((Scaling.NONE, False), (Scaling.FULL, True)):
+        out = np.asarray(plan.forward(space, scaling))
+        np.testing.assert_allclose(out,
+                                   _unfused_forward(plan, space, scaled),
+                                   rtol=2e-6, atol=2e-6)
+
+
+def test_plan_fused_pair_round_trip(fused_env):
+    """apply_pointwise (the benchmark's fused pair) through the fused
+    kernels recovers the inputs at FULL scaling."""
+    plan = _plan(_gappy_triplets())
+    assert plan.fused_active
+    vals = _values(plan.num_local_elements, seed=3)
+    out = np.asarray(plan.apply_pointwise(vals, scaling=Scaling.FULL))
+    np.testing.assert_allclose(out[:, 0] + 1j * out[:, 1], vals,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_plan_shuffled_stick_order_fused(fused_env):
+    """Shuffled triplet order (locally-coherent but not stick-major)
+    still passes the fused gate and stays bit-exact."""
+    rng = np.random.default_rng(7)
+    trip = np.asarray(_gappy_triplets(), np.int32)
+    trip = trip[rng.permutation(len(trip))]
+    plan = _plan(trip)
+    assert plan.fused_active, plan.fused_fallback_reasons
+    vals = _values(len(trip), seed=4)
+    space = np.asarray(plan.backward(vals))
+    np.testing.assert_allclose(space, _unfused_backward(plan, vals),
+                               rtol=2e-6, atol=2e-6)
+    out = np.asarray(plan.forward(space))
+    np.testing.assert_allclose(out, _unfused_forward(plan, space, False),
+                               rtol=2e-6, atol=2e-6)
+
+
+def test_plan_batched_fused(fused_env):
+    """The batched boundary runs the batched fused grids and matches
+    per-slab unfused execution."""
+    plan = _plan(_gappy_triplets())
+    assert plan.fused_active
+    rng = np.random.default_rng(8)
+    B, N = 3, plan.num_local_elements
+    vb = rng.standard_normal((B, N, 2)).astype(np.float32)
+    got = np.asarray(plan.backward_batched(vb))
+    for b in range(B):
+        np.testing.assert_allclose(
+            got[b], _unfused_backward(plan, vb[b]), rtol=2e-6, atol=2e-6)
+    out = np.asarray(plan.forward_batched(got, Scaling.FULL))
+    for b in range(B):
+        np.testing.assert_allclose(
+            out[b], _unfused_forward(plan, got[b], True),
+            rtol=2e-6, atol=2e-6)
+
+
+def test_plan_r2c_fused(fused_env):
+    """R2C without a (0,0) stick: both directions fuse; with it, the
+    backward direction falls back (hermitian completion runs between
+    decompress and the z stage) while forward stays fused — both
+    bit-exact vs the unfused composition."""
+    nx, ny = 8, 6
+    no_zero = [(x, y, z) for x in range(nx // 2 + 1) for y in range(ny)
+               if (x, y) != (0, 0) for z in range(0, DIM_Z, 2)]
+    plan = _plan(no_zero, ttype=TransformType.R2C)
+    assert plan.fused_active and plan.fused_fallback_reasons == {}
+    vals = _values(len(no_zero), seed=5)
+    space = np.asarray(plan.backward(vals))
+    np.testing.assert_allclose(space, _unfused_backward(plan, vals),
+                               rtol=2e-6, atol=2e-6)
+    out = np.asarray(plan.forward(space, Scaling.FULL))
+    np.testing.assert_allclose(out, _unfused_forward(plan, space, True),
+                               rtol=2e-6, atol=2e-6)
+
+    with_zero = [(x, y, z) for x in range(nx // 2 + 1) for y in range(ny)
+                 for z in range(0, DIM_Z, 2)]
+    plan_z = _plan(with_zero, ttype=TransformType.R2C)
+    assert plan_z.fused_fallback_reasons.get("dec") \
+        == "hermitian_completion"
+    assert plan_z._fused["cmp"] is not None
+    vz = _values(len(with_zero), seed=6)
+    sz = np.asarray(plan_z.backward(vz))
+    np.testing.assert_allclose(sz, _unfused_backward(plan_z, vz),
+                               rtol=2e-6, atol=2e-6)
+    oz = np.asarray(plan_z.forward(sz))
+    np.testing.assert_allclose(oz, _unfused_forward(plan_z, sz, False),
+                               rtol=2e-6, atol=2e-6)
+
+
+def test_plan_empty_sticks_zeroed(fused_env):
+    """Sticks whose slots carry no values at all come out as exact
+    zeros of the z-DFT (the scratch zeroing + validity mask contract),
+    and the round trip stays bit-exact."""
+    # only 3 z-values per stick, most of each stick empty
+    trip = [(x, y, z) for x in range(8) for y in range(6)
+            if (x + y) % 2 == 0 for z in (0, 1, DIM_Z - 1)]
+    plan = _plan(trip)
+    assert plan.fused_active, plan.fused_fallback_reasons
+    vals = _values(len(trip), seed=9)
+    space = np.asarray(plan.backward(vals))
+    np.testing.assert_allclose(space, _unfused_backward(plan, vals),
+                               rtol=2e-6, atol=2e-6)
+
+
+# -- fallback gate -----------------------------------------------------------
+
+def test_gate_dimz_not_multiple_128(fused_env):
+    trip = [(x, y, z) for x in range(8) for y in range(8)
+            for z in range(96)]
+    plan = _plan(trip, nx=8, ny=8, nz=96)
+    assert not plan.fused_active
+    assert plan.fused_fallback_reasons == {
+        "dec": "dimz_not_multiple_128", "cmp": "dimz_not_multiple_128"}
+    vals = _values(len(trip), seed=10)
+    space = np.asarray(plan.backward(vals))  # two-kernel path still runs
+    np.testing.assert_allclose(space, _unfused_backward(plan, vals),
+                               rtol=2e-6, atol=2e-6)
+
+
+def test_gate_oversized_z(fused_env):
+    """dim_z above the fused-kernel axis cap (dft_kernel.max_dim)
+    routes to the two-kernel path with the recorded reason."""
+    from spfft_tpu.ops import dft_kernel as dk
+    nz = 384
+    assert nz % 128 == 0 and nz > dk.max_dim()
+    trip = [(x, y, z) for x in range(4) for y in range(4)
+            for z in range(0, nz, 2)]
+    plan = _plan(trip, nx=4, ny=4, nz=nz)
+    assert not plan.fused_active
+    assert plan.fused_fallback_reasons == {
+        "dec": "dimz_over_cap", "cmp": "dimz_over_cap"}
+
+
+def test_gate_double_precision_never_fused(fused_env):
+    """Double precision never reaches the fused gate (the Pallas
+    compression path is single-only)."""
+    trip = _gappy_triplets(nx=4, ny=4)
+    plan = make_local_plan(TransformType.C2C, 4, 4, DIM_Z,
+                           np.asarray(trip, np.int32),
+                           precision="double")
+    assert not plan.fused_active
+    assert "fzd_tabs" not in plan._tables
+
+
+def test_gate_env_disable(fused_env, monkeypatch):
+    monkeypatch.setenv("SPFFT_TPU_FUSED_COMPRESS", "0")
+    plan = _plan(_gappy_triplets())
+    assert not plan.fused_active
+    assert "fzd_tabs" not in plan._tables
+    vals = _values(plan.num_local_elements, seed=11)
+    space = np.asarray(plan.backward(vals))
+    np.testing.assert_allclose(space, _unfused_backward(plan, vals),
+                               rtol=2e-6, atol=2e-6)
+
+
+def test_gate_recompute_blowup_model():
+    """The forward cost model declines when window-overlap recompute
+    exceeds RECOMPUTE_LIMIT x the unfused pass."""
+    rng = np.random.default_rng(12)
+    s_pad, dim_z = 32, DIM_Z
+    num_slots = s_pad * dim_z
+    vi = np.flatnonzero(rng.random(num_slots) < 0.5)
+    _, (cmp_idx, cmp_valid) = gk.compression_gather_inputs(vi, num_slots)
+    nt = gk.build_monotone_gather_tables(cmp_idx, cmp_valid, num_slots)
+    rows = fkm.compress_recompute_rows(nt, dim_z)
+    # a tiny stick count makes ANY recompute blow the model
+    out = fkm.build_fused_compress_tables(nt, dim_z,
+                                          num_sticks=max(1, int(
+                                              rows / 100)))
+    assert out == "recompute_blowup"
+
+
+def test_fallback_counter_recorded(fused_env):
+    from spfft_tpu import obs
+    before = obs.GLOBAL_COUNTERS.get(
+        "spfft_plan_pallas_fallback_total",
+        stage="fused_decompress_zdft", reason="dimz_not_multiple_128")
+    trip = [(x, y, z) for x in range(4) for y in range(4)
+            for z in range(96)]
+    plan = _plan(trip, nx=4, ny=4, nz=96)
+    plan._finalize()
+    after = obs.GLOBAL_COUNTERS.get(
+        "spfft_plan_pallas_fallback_total",
+        stage="fused_decompress_zdft", reason="dimz_not_multiple_128")
+    assert after == before + 1
+
+
+# -- the acceptance criterion: no dense gather-tile intermediate -------------
+
+def test_fused_backward_hlo_drops_gather_intermediate(fused_env,
+                                                      monkeypatch):
+    """The fused backward program must not contain the unfused path's
+    dense gather-output buffer (num_tiles, 8, 128) — the HBM
+    intermediate this kernel exists to remove — while the forced
+    UNFUSED kernel path does lower it."""
+    import functools
+    plan = _plan(_gappy_triplets())
+    assert plan.fused_active
+    dec = plan._pallas["dec"]
+    vil = plan._coerce_values(_values(plan.num_local_elements, seed=13))
+    shape = "%dx%dx%dxf32" % ((dec.num_super * dec.p_tiles)
+                              if isinstance(dec, gk.WideGatherTables)
+                              else dec.num_tiles, gk.TILE_SUB,
+                              gk.TILE_LANE)
+    fused_text = jax.jit(
+        lambda v: plan._backward_impl(v, plan._tables_hot)).lower(
+            vil).as_text()
+    assert shape not in fused_text
+
+    # contrast: the unfused kernel path (gather kernel in interpret,
+    # fused dispatch off) materialises exactly that buffer
+    monkeypatch.setattr(plan, "_fused_active_flag", False)
+    monkeypatch.setattr(gk, "run_gather",
+                        functools.partial(gk.run_gather, interpret=True))
+    monkeypatch.setattr(plan, "_pallas_active", True)
+    unfused_text = jax.jit(
+        lambda v: plan._backward_impl(v, plan._tables)).lower(
+            vil).as_text()
+    assert shape in unfused_text

@@ -1,5 +1,6 @@
-"""Plan-construction robustness: sticky background-build failures and
-int32 table-range guards (round-4 advisor findings)."""
+"""Plan-construction robustness: sticky background-build failures
+(typed, joined at close), and int32 table-range guards (round-4
+advisor findings)."""
 
 import threading
 
@@ -7,7 +8,7 @@ import numpy as np
 import pytest
 
 from spfft_tpu import TransformType, make_local_plan
-from spfft_tpu.errors import OverflowError_
+from spfft_tpu.errors import OverflowError_, TableBuildError
 from spfft_tpu.indexing import build_index_plan
 
 
@@ -17,10 +18,12 @@ def _tiny_plan():
                            precision="single")
 
 
-def test_background_build_failure_is_sticky():
-    """A compression-table build failure must re-raise the ORIGINAL
-    error on every subsequent execution call — not once, then decay
-    into a KeyError inside the jitted pipeline (advisor r4 #1)."""
+def test_background_build_failure_is_sticky_and_typed():
+    """A compression-table build failure must surface as the TYPED
+    TableBuildError carrying the original as its cause, on EVERY
+    subsequent execution call — not once, then decay into a KeyError
+    inside the jitted pipeline (advisor r4 #1), and never as a raw
+    foreign exception type."""
     plan = _tiny_plan()
     boom = RuntimeError("table build exploded")
     th = threading.Thread(target=lambda: None)
@@ -30,10 +33,56 @@ def test_background_build_failure_is_sticky():
     plan._build_exc = boom
     vals = np.zeros(3, np.complex64)
     for _ in range(3):  # every call, same typed error
-        with pytest.raises(RuntimeError, match="table build exploded"):
+        with pytest.raises(TableBuildError,
+                           match="table build exploded") as ei:
             plan.backward(vals)
-    with pytest.raises(RuntimeError, match="table build exploded"):
+        assert ei.value.cause is boom
+        assert ei.value.__cause__ is boom
+    with pytest.raises(TableBuildError, match="table build exploded"):
         plan.apply_pointwise(vals)
+
+
+def test_real_offthread_build_failure_surfaces_typed(monkeypatch):
+    """An exception raised INSIDE the background builder thread (not
+    injected post-hoc) reaches the caller as TableBuildError on first
+    use."""
+    from spfft_tpu.ops import gather_kernel as gk
+    def explode(*a, **k):
+        raise ValueError("cover builder corrupted")
+    monkeypatch.setattr(gk, "build_best_gather_tables", explode)
+    trip = np.array([[x, y, z] for x in range(8) for y in range(8)
+                     for z in range(8)], np.int32)
+    plan = make_local_plan(TransformType.C2C, 8, 8, 8, trip,
+                           precision="single", use_pallas=True)
+    with pytest.raises(TableBuildError,
+                       match="cover builder corrupted") as ei:
+        plan.backward(np.zeros(len(trip), np.complex64))
+    assert isinstance(ei.value.cause, ValueError)
+
+
+def test_close_joins_background_build():
+    """close() joins the builder thread without raising — even when
+    the build failed — and the failure still surfaces typed on the
+    next execution call. __del__ must also tolerate a pending build."""
+    trip = np.array([[x, y, z] for x in range(8) for y in range(8)
+                     for z in range(8)], np.int32)
+    plan = make_local_plan(TransformType.C2C, 8, 8, 8, trip,
+                           precision="single", use_pallas=True)
+    assert plan._build_thread is not None or plan._pallas_box is not None
+    plan.close()
+    assert plan._build_thread is None
+    plan.close()  # idempotent
+
+    failed = _tiny_plan()
+    th = threading.Thread(target=lambda: None)
+    th.start()
+    failed._build_thread = th
+    failed._build_exc = RuntimeError("boom")
+    failed.close()  # must not raise
+    assert failed._build_thread is None
+    with pytest.raises(TableBuildError):
+        failed.backward(np.zeros(3, np.complex64))
+    failed.__del__()  # explicit: teardown path never raises
 
 
 def test_plane_size_int32_guard():
