@@ -16,7 +16,7 @@ PLANNER_SO  := $(NATIVE_DIR)/_planner_$(CACHE_TAG).so
 CAPI_SO     := lib/libspfft_tpu.so
 
 .PHONY: all native capi example-c test ci ci-tpu trace-smoke \
-        control-smoke fused-smoke bench-check clean
+        control-smoke fused-smoke store-smoke bench-check clean
 
 # One-command CI (reference: .github/workflows/ci.yml builds + runs the
 # local test matrix): full CPU suite (8-device virtual mesh; includes the
@@ -98,6 +98,32 @@ fused-smoke:
 	  -o build/fused_smoke.json
 	python -c "import json; p = json.load(open('build/fused_smoke.json'))['parameters']; assert p['fused'] and not p['fused_fallback'], p"
 	@echo "FUSED-SMOKE GREEN"
+
+# Plan-artifact store smoke (docs/artifact_cache.md): the zero-cold-
+# start contract across REAL process boundaries — process A builds one
+# canonical workload into a store (index tables + kernel tables + AOT
+# executables, async-spilled), records a manifest and a backward-
+# execution reference; process B (a fresh interpreter) prewarms from
+# the manifest and must resolve the same request with builds==0, no
+# registry-build/table-build compile events, and a bit-exact backward
+# vs process A's recorded output (--strict exits 1 on any of those
+# failing). The same checks run in tier-1
+# (tests/test_plan_store.py::test_store_smoke_cross_process); the
+# on-chip AOT-beats-fresh-compile assertion is staged in `make ci-tpu`
+# (test_plan_store_on_tpu).
+store-smoke:
+	@echo "== store-smoke: cross-process plan-artifact warm boot =="
+	@mkdir -p build; rm -rf build/store_smoke
+	env JAX_PLATFORMS=cpu python -m spfft_tpu.serve.store seed \
+	  build/store_smoke --dim 24 --use-pallas --reference --json
+	env JAX_PLATFORMS=cpu python -m spfft_tpu.serve.store manifest \
+	  build/store_smoke
+	env JAX_PLATFORMS=cpu python -m spfft_tpu.serve.store prewarm \
+	  build/store_smoke --manifest build/store_smoke/manifest.json \
+	  --compile --check-reference --strict --json
+	env JAX_PLATFORMS=cpu python -m spfft_tpu.serve.store verify \
+	  build/store_smoke --json > /dev/null
+	@echo "STORE-SMOKE GREEN"
 
 # Perf-trajectory guard (scripts/bench_regress.py): run the north-star
 # benchmark fresh and compare against the latest recorded BENCH_r*.json

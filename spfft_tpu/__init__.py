@@ -25,7 +25,8 @@ from .parallel import (DistributedIndexPlan, DistributedTransformPlan,
 from . import obs, timing
 from .grid import Grid, Transform
 from .multi import multi_transform_backward, multi_transform_forward
-from .plan import TransformPlan, make_local_plan, predicted_rel_error
+from .plan import (PlanTables, TransformPlan, make_local_plan,
+                   predicted_rel_error, restore_plan)
 from .types import (ExchangeType, IndexFormat, ProcessingUnit, Scaling,
                     TransformType)
 
@@ -43,6 +44,7 @@ __all__ = [
     "Scaling",
     "IndexPlan", "build_index_plan", "check_stick_duplicates",
     "TransformPlan", "make_local_plan", "predicted_rel_error",
+    "PlanTables", "restore_plan",
     "PrecisionContractError",
     "DistributedIndexPlan", "DistributedTransformPlan",
     "build_distributed_plan", "build_distributed_plan_multihost",

@@ -102,6 +102,17 @@ def _parse_args(argv):
                         "(multi-chip simulation, like the test conftest)")
     p.add_argument("--precision", choices=["single", "double"],
                    default="single")
+    p.add_argument("--store-dir", default=None, metavar="DIR",
+                   help="cold/warm plan-resolution A/B through the "
+                        "persistent plan-artifact store "
+                        "(spfft_tpu.serve.store): resolve this "
+                        "workload's plan through a store-backed "
+                        "registry in-process (cold when DIR starts "
+                        "empty: build + async spill), then re-resolve "
+                        "it in a FRESH subprocess (warm: artifact load, "
+                        "zero builds). Adds cold_start_ms/warm_start_ms "
+                        "to the JSON; use a fresh DIR per honest A/B "
+                        "(docs/artifact_cache.md)")
     p.add_argument("--profile-dir", default=None, metavar="DIR",
                    help="capture a jax.profiler trace of the measured "
                         "window into DIR — the pipeline's "
@@ -113,6 +124,9 @@ def _parse_args(argv):
         p.error("--fused-pair requires -m 1")
     if args.serve and (args.shards > 1 or args.fused_pair):
         p.error("--serve requires --shards 1 and no --fused-pair")
+    if args.store_dir and args.shards > 1:
+        p.error("--store-dir measures local plan resolution "
+                "(requires --shards 1)")
     return args
 
 
@@ -192,6 +206,58 @@ def _exchange_sweep(args, dims, ttype, triplets, rng, cdt) -> int:
             json.dump(payload, f, indent=2)
         print(f"wrote {args.output}")
     return 0
+
+
+def _store_cold_warm(args, ttype, dims, triplets) -> dict:
+    """The --store-dir A/B: resolve this workload's plan + first
+    execution through a store-backed registry in-process (a true COLD
+    start when the store directory begins empty — build, spill), then
+    measure the WARM boot in a genuinely fresh interpreter (``python -m
+    spfft_tpu.serve.store prewarm --compile``: artifact load + first
+    execution, builds == 0). Returns the cold_start_ms/warm_start_ms
+    pair BENCH_r06.json records and scripts/bench_regress.py compares
+    from round 13 on."""
+    import subprocess
+
+    from .serve.registry import PlanRegistry
+    from .serve.store import PlanArtifactStore
+
+    store = PlanArtifactStore(args.store_dir)
+    reg = PlanRegistry(store=store)
+    t0 = time.perf_counter()
+    sig, plan = reg.get_or_build(ttype, *dims, triplets,
+                                 precision=args.precision)
+    n = plan.index_plan.num_values
+    plan.backward(np.zeros((n, 2), np.float32)
+                  if plan.precision == "single"
+                  else np.zeros(n, np.complex128))
+    cold_ms = (time.perf_counter() - t0) * 1e3
+    store.drain()
+    out = {
+        "store_dir": args.store_dir,
+        # a pre-populated DIR makes the in-process number a warm one;
+        # disclose rather than silently mislabel
+        "store_was_cold": reg.stats()["builds"] == 1,
+        "cold_start_ms": {"value": round(cold_ms, 3), "unit": "ms",
+                          "metric": "plan resolve + first execute, "
+                                    "empty store (build + spill)"},
+    }
+    proc = subprocess.run(
+        [sys.executable, "-m", "spfft_tpu.serve.store", "prewarm",
+         args.store_dir, "--compile", "--strict", "--json"],
+        capture_output=True, text=True, timeout=1800)
+    if proc.returncode != 0:
+        print(f"warning: warm-boot subprocess failed:\n{proc.stderr}",
+              file=sys.stderr)
+        return out
+    report = json.loads(proc.stdout.strip().splitlines()[-1])
+    out["warm_start_ms"] = {
+        "value": report["warm_resolve_ms"], "unit": "ms",
+        "metric": "plan resolve + first execute, fresh process over "
+                  "the populated store (artifact load, builds==0)"}
+    out["warm_builds"] = report["builds"]
+    out["warm_store"] = report["store"]
+    return out
 
 
 def main(argv=None) -> int:
@@ -404,6 +470,9 @@ def _run(args) -> int:
         serve_executor.close()
         params["serve"] = serve_executor.metrics.snapshot(
             serve_executor.registry)
+    if args.store_dir:
+        params.update(_store_cold_warm(args, ttype, (nx, ny, nz),
+                                       triplets))
     print(json.dumps(params, indent=2))
     result.print()
     if args.output:

@@ -136,7 +136,20 @@ KNOB_SPECS: Dict[str, KnobSpec] = {spec.name: spec for spec in (
     KnobSpec("registry_max_plans", 32, 1, 4096, int,
              "registry evictions",
              "Plan registry LRU entry cap."),
+    KnobSpec("plan_store_max_bytes", 16 * 1024 ** 3, 0,
+             1024 ** 4, int,
+             "spfft_store_{spills,evictions}_total",
+             "Persistent plan-artifact store byte cap (oldest-first "
+             "GC on spill; 0 = unbounded)."),
 )}
+
+#: String-valued settings (paths) the numeric KnobSpec clamp cannot
+#: carry. They live beside the knobs: hot-readable under the same
+#: lock, round-tripped through the JSON artifact (under ``"paths"``),
+#: but never exported as Prometheus gauges. ``plan_store_path`` ""
+#: (the default) disables the disk plan tier unless the
+#: ``SPFFT_TPU_PLAN_STORE`` env var names one.
+PATH_SETTINGS: Dict[str, str] = {"plan_store_path": ""}
 
 
 def _counters():
@@ -163,12 +176,32 @@ class ServeConfig:
         self._lock = threading.Lock()
         self._values: Dict[str, float] = {
             name: spec.default for name, spec in KNOB_SPECS.items()}
+        self._paths: Dict[str, str] = dict(PATH_SETTINGS)
         self._history: "collections.deque" = collections.deque(
             maxlen=HISTORY_LIMIT)
         self._seq = 0
         self._decisions_by_source: Dict[str, int] = {}
         if values:
             self.update(values, reason="initial values", source="init")
+
+    # -- path settings -----------------------------------------------------
+    @property
+    def plan_store_path(self) -> str:
+        with self._lock:
+            return self._paths["plan_store_path"]
+
+    def set_path(self, name: str, value: str) -> str:
+        if name not in PATH_SETTINGS:
+            raise InvalidParameterError(
+                f"unknown path setting {name!r} "
+                f"(settings: {sorted(PATH_SETTINGS)})")
+        with self._lock:
+            self._paths[name] = str(value or "")
+            return self._paths[name]
+
+    def paths(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._paths)
 
     # -- reading -----------------------------------------------------------
     def __getattr__(self, name: str):
@@ -285,6 +318,7 @@ class ServeConfig:
         :meth:`load` consumes."""
         return {ARTIFACT_KEY: ARTIFACT_VERSION,
                 "values": self.snapshot(),
+                "paths": self.paths(),
                 "provenance": provenance or {}}
 
     def save(self, path: str, provenance: Optional[Dict] = None) -> None:
@@ -313,6 +347,13 @@ class ServeConfig:
                 f"{path!r} carries no 'values' mapping")
         cfg = cls()
         cfg.update(values, reason=f"loaded from {path}", source="boot")
+        paths = payload.get("paths")
+        if paths is not None:
+            if not isinstance(paths, dict):
+                raise InvalidParameterError(
+                    f"{path!r} 'paths' must be a mapping")
+            for name, value in paths.items():
+                cfg.set_path(name, value)
         return cfg
 
     @classmethod

@@ -28,6 +28,14 @@ Signals → rules → knobs (the docs/control_plane.md table, in code):
   cost rivaling dispatch cost means the host is on the critical path →
   one more in-flight slot to overlap it. Staging negligible → decay to
   the backend-aware auto depth (0).
+* **max_queue** ← ``rejected_queue_full`` burn. Rejects on
+  ``reject_streak_steps`` CONSECUTIVE steps mean the queue bound is
+  turning a transient burst into dropped traffic → DOUBLE the bound
+  (still clamped to the declared KNOB_SPECS range; memory pressure is
+  the hard bound, not the soft one). A single-step blip changes
+  nothing — backpressure on a genuine overload is the knob working as
+  designed. Idle periods decay the bound back toward the default by
+  halving (retracing the growth path).
 
 Stability machinery, also deterministic:
 
@@ -73,7 +81,7 @@ class Decision:
 #: Knobs the feedback rules manage (everything else in ServeConfig is
 #: hot-swappable but only moved by operators/the tuner).
 MANAGED_KNOBS = ("batch_window", "pin_after", "max_batch",
-                 "pipeline_depth")
+                 "pipeline_depth", "max_queue")
 
 
 class Controller:
@@ -92,7 +100,8 @@ class Controller:
                  watchdog=None, cooldown_steps: int = 3,
                  shrink_ratio: float = 2.0, grow_ratio: float = 0.5,
                  pad_hi: float = 0.25, pad_lo: float = 0.02,
-                 exec_floor_s: float = 1e-4):
+                 exec_floor_s: float = 1e-4,
+                 reject_streak_steps: int = 2):
         self.config = config
         self.metrics = metrics
         self.executor = executor
@@ -103,6 +112,8 @@ class Controller:
         self.pad_hi = float(pad_hi)
         self.pad_lo = float(pad_lo)
         self.exec_floor_s = float(exec_floor_s)
+        self.reject_streak_steps = max(1, int(reject_streak_steps))
+        self._reject_streak = 0
         self._step = 0
         self._prev: Optional[Dict] = None
         self._last_change: Dict[str, int] = {}
@@ -122,9 +133,11 @@ class Controller:
                 and self._step - last <= self.cooldown_steps)
 
     def _retune(self, out: List[Decision], knob: str, value,
-                reason: str) -> None:
+                reason: str) -> bool:
+        """Apply one rule's request; True when the knob actually moved
+        (cooldown respected, clamped no-ops record nothing)."""
         if self._cool(knob):
-            return
+            return False
         old = self.config.get(knob)
         new = self.config.set(knob, value, reason=reason,
                               source="controller")
@@ -133,6 +146,8 @@ class Controller:
             d = Decision(self._step, knob, old, new, reason)
             self._decisions.append(d)
             out.append(d)
+            return True
+        return False
 
     def _delta(self, signals: Dict, key: str) -> float:
         prev = (self._prev or {}).get(key, 0)
@@ -156,12 +171,14 @@ class Controller:
         if first:
             pass  # calibration step: record the baseline, act next
         elif idle:
+            self._reject_streak = 0
             self._decay_toward_defaults(out)
         else:
             self._rule_batch_window(out, signals)
             self._rule_pin_after(out, signals)
             self._rule_max_batch(out, signals)
             self._rule_pipeline_depth(out, signals)
+            self._rule_max_queue(out, signals)
         self._prev = dict(signals)
         from .. import obs
         obs.GLOBAL_COUNTERS.inc(
@@ -188,6 +205,11 @@ class Controller:
                         else cur * 2
                 else:
                     nxt = max(default, cur / 2)
+            elif knob == "max_queue":
+                # the grow rule doubles, so the decay halves — one
+                # idle step per growth step back toward the default
+                nxt = max(default, cur // 2) if cur > default \
+                    else min(default, cur * 2)
             else:
                 nxt = cur + 1 if cur < default else cur - 1
             self._retune(out, knob, nxt, "idle: decay toward default")
@@ -244,6 +266,29 @@ class Controller:
             self._retune(out, "max_batch", max(default, mb // 2),
                          f"buckets far below cap: largest fused "
                          f"{max(sizes_d)} <= {mb}//4")
+
+    def _rule_max_queue(self, out, s) -> None:
+        """Grow the queue bound on SUSTAINED ``rejected_queue_full``
+        burn (ROADMAP control follow-on #3): rejects on
+        ``reject_streak_steps`` consecutive non-idle steps double
+        ``max_queue`` within its declared bounds; the idle decay walks
+        it back by halving. One blip is backpressure doing its job and
+        moves nothing (the streak is the hysteresis)."""
+        rej_d = self._delta(s, "rejected_queue_full")
+        if rej_d <= 0:
+            self._reject_streak = 0
+            return
+        self._reject_streak += 1
+        if self._reject_streak < self.reject_streak_steps:
+            return
+        mq = self.config.get("max_queue")
+        new = self._retune(
+            out, "max_queue", mq * 2,
+            f"sustained queue-full burn: +{rej_d:g} rejects on step "
+            f"{self._step} ({self._reject_streak} consecutive "
+            f"reject steps)")
+        if new:
+            self._reject_streak = 0
 
     def _rule_pipeline_depth(self, out, s) -> None:
         if self.executor is None:

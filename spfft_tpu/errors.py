@@ -192,6 +192,15 @@ class ExecutorCrashedError(ServeError):
     future was failed with this error instead of hanging forever."""
 
 
+class PlanArtifactError(ServeError):
+    """A plan artifact named by a warmup manifest could not be loaded
+    (missing, rejected, or incompatible with the requested kwargs).
+    Raised by strict manifest prewarm — a replacement process must not
+    silently join the pool half-warm; the ad-hoc ``get_or_build`` path
+    never raises this (a rejected artifact there falls back to a clean
+    rebuild with the reason counted)."""
+
+
 class FFTError(GenericError):
     """Failure inside the FFT backend (reference: exceptions.hpp:160-167,
     FFTWError; here: XLA Fft HLO)."""

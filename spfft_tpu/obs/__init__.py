@@ -57,8 +57,43 @@ __all__ = [
     "parse_prometheus_text",
     "MetricsServer", "METRICS_PORT_ENV", "port_from_env",
     "record_compile", "record_plan_build", "record_exchange_plan",
-    "record_hlo_counts", "record_plan_fallback",
+    "record_hlo_counts", "record_plan_fallback", "record_store",
+    "record_store_aot_skip",
 ]
+
+
+def record_store(event: str, reason: Optional[str] = None) -> None:
+    """One plan-artifact-store outcome (``hit`` / ``miss`` / ``spill``
+    / ``evict`` / ``reject``; rejects carry their typed reason label).
+    Counters always (``spfft_store_{hits,misses,spills,evictions,
+    rejects}_total``); a ``store`` instant on the compile track when
+    tracing is on — next to the ``compile.store_load`` /
+    ``compile.store_spill`` spans the store records, so Perfetto shows
+    load-vs-build decisions inline with the compile timeline."""
+    name = {"hit": "spfft_store_hits_total",
+            "miss": "spfft_store_misses_total",
+            "spill": "spfft_store_spills_total",
+            "evict": "spfft_store_evictions_total",
+            "reject": "spfft_store_rejects_total"}[event]
+    labels = {"reason": reason} if event == "reject" else {}
+    GLOBAL_COUNTERS.inc(name, 1,
+                        help="Plan-artifact store outcomes.", **labels)
+    if active():
+        args = {"event": event}
+        if reason:
+            args["reason"] = reason
+        GLOBAL_TRACER.instant("store." + event, cat="compile",
+                              track="compile", args=args)
+
+
+def record_store_aot_skip(reason: str) -> None:
+    """One non-fatal AOT executable skip (export or deserialize failed,
+    platform mismatch) — the artifact/plan is fine, only the
+    ahead-of-time executable is absent."""
+    GLOBAL_COUNTERS.inc("spfft_store_aot_skipped_total", 1,
+                        help="AOT executables skipped (non-fatal) by "
+                             "reason.",
+                        reason=reason)
 
 
 def record_plan_fallback(stage: str, reason: str) -> None:

@@ -220,7 +220,8 @@ def _serve_families(b: _PromBuilder, snap: dict) -> None:
 
 def _registry_families(b: _PromBuilder, stats: dict) -> None:
     gauges = {"plans", "bytes_in_use", "max_bytes", "max_plans",
-              "sig_memo_entries", "sig_memo_bytes", "hit_rate"}
+              "sig_memo_entries", "sig_memo_bytes", "hit_rate",
+              "store_attached"}
     for key, value in stats.items():
         if not isinstance(value, (int, float)):
             continue

@@ -67,17 +67,39 @@ from ..errors import InvalidParameterError
 from .exchange import _ragged_direction_tables, _size_classes
 
 
-def chunk_bounds(true_counts, padded: int, num_chunks: int) -> tuple:
+def chunk_bounds(true_counts, padded: int, num_chunks: int,
+                 skew_weight: float = 1.0) -> tuple:
     """Split the padded row range ``[0, padded)`` into ``num_chunks``
-    contiguous slices balancing the TRUE row population per slice.
+    contiguous slices, SKEW-AWARE: balance per-destination ingress
+    within each chunk, not just true-row totals.
 
     ``true_counts[r]`` is shard r's populated row count (``<= padded``;
-    rows are always a prefix of the padded extent). Padded row ``i``
-    weighs ``#{r : true_counts[r] > i}`` — slicing at equal cumulative
-    weight makes every chunk carry ~the same number of real rows summed
-    over shards, which (multiplied by each destination's plane/stick
-    count) balances every destination's per-chunk ingress. Bounds are
-    strictly increasing and cover ``[0, padded)`` exactly.
+    rows are always a prefix of the padded extent). Two normalised
+    weights are summed per padded row and the bounds slice at equal
+    cumulative weight:
+
+    * the INGRESS weight ``#{r : true_counts[r] > i} / total`` — every
+      populated row of every shard ships the same per-destination
+      element count (``num_planes(d)`` sticks backward /
+      ``num_sticks(d)`` planes forward), so equal cumulative population
+      per chunk equalises every destination's per-chunk ingress;
+    * the BUSIEST-SOURCE weight ``[i < max(true_counts)] / max`` —
+      within one chunk the heaviest (src, dst) link belongs to the
+      shard with the most populated rows there, and prefix-populated
+      rows make that ``clip(max(true_counts), lo, hi)`` for any slice,
+      so equal cumulative share of the largest shard's rows equalises
+      the per-chunk busiest link.
+
+    Balancing only the first (the pre-round-13 behavior,
+    ``skew_weight=0``) lets one dominant shard concentrate in a chunk
+    under skewed stick ownership: ``true_counts=[10, 100]`` at K=2 cut
+    the total 55/55 but the dominant shard's link 45/55 — the pipeline
+    then stalls on the uneven chunk exactly where overlap was supposed
+    to hide the wire. The combined weight splits the difference;
+    perfectly uniform shards reproduce the old bounds (both weights
+    are then proportional). Bounds are strictly increasing and cover
+    ``[0, padded)`` exactly, so the union/conservation/no-hot-spot
+    schedule invariants hold for every ``skew_weight``.
     """
     K = int(num_chunks)
     if K < 1:
@@ -85,10 +107,16 @@ def chunk_bounds(true_counts, padded: int, num_chunks: int) -> tuple:
     if K > padded:
         raise InvalidParameterError(
             f"num_chunks ({K}) exceeds padded rows ({padded})")
-    w = np.zeros(padded, np.int64)
+    w = np.zeros(padded, np.float64)
     for c in true_counts:
-        w[:int(c)] += 1
-    cum = np.concatenate([[0], np.cumsum(w)])
+        w[: int(c)] += 1.0
+    total = w.sum()
+    if total > 0:
+        w /= total
+    cmax = int(max(true_counts, default=0))
+    if skew_weight and cmax > 0:
+        w[:cmax] += float(skew_weight) / cmax
+    cum = np.concatenate([[0.0], np.cumsum(w)])
     bounds = [0]
     for c in range(1, K):
         target = cum[-1] * c / K

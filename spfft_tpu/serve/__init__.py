@@ -69,19 +69,35 @@ docs/control_plane.md.
 
 from ..errors import (DeadlineExpiredError, DistributedPlanUnsupportedError,
                       ExecutorCrashedError, NoHealthyDeviceError,
-                      QueueFullError, RetryExhaustedError, ServeError)
-from .executor import ServeExecutor
+                      PlanArtifactError, QueueFullError,
+                      RetryExhaustedError, ServeError)
+from .executor import PLAN_MANIFEST_ENV, ServeExecutor
 from .faults import (FaultPlan, InjectedFault, attributes_device,
                      is_transient)
 from .metrics import PRIORITY_CLASSES, ServeMetrics, percentile
 from .registry import (PlanRegistry, PlanSignature, index_digest,
                        signature_for)
 
+
+def __getattr__(name):
+    # PEP 562 lazy re-export: `python -m spfft_tpu.serve.store` runs
+    # store.py as __main__ AFTER this package imports — an eager
+    # `from .store import ...` here would execute the module twice
+    # (runpy's found-in-sys.modules RuntimeWarning). Everything else
+    # reaches the store through these names on first touch.
+    if name in ("PlanArtifactStore", "PLAN_STORE_ENV"):
+        from . import store
+        return getattr(store, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
 __all__ = [
     "PlanRegistry", "PlanSignature", "index_digest", "signature_for",
     "ServeExecutor", "ServeMetrics", "percentile", "PRIORITY_CLASSES",
+    "PlanArtifactStore", "PLAN_STORE_ENV", "PLAN_MANIFEST_ENV",
     "FaultPlan", "InjectedFault", "is_transient", "attributes_device",
     "ServeError", "QueueFullError", "DeadlineExpiredError",
     "RetryExhaustedError", "NoHealthyDeviceError",
     "ExecutorCrashedError", "DistributedPlanUnsupportedError",
+    "PlanArtifactError",
 ]

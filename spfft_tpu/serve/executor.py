@@ -145,6 +145,13 @@ from .faults import FaultPlan, attributes_device, is_transient
 from .metrics import ServeMetrics
 from .registry import PlanRegistry, PlanSignature
 
+#: Boot-prewarm manifest location: when set (and no explicit
+#: ``prewarm_manifest`` argument is given), a constructing executor
+#: warm-loads every listed plan artifact — and compiles it — BEFORE its
+#: dispatcher thread starts, so a replacement process joins the pool
+#: fully warm (docs/artifact_cache.md "Prewarm workflow").
+PLAN_MANIFEST_ENV = "SPFFT_TPU_PLAN_MANIFEST"
+
 # Knob defaults live in ONE place since round 11: the control plane's
 # KNOB_SPECS (spfft_tpu/control/config.py), which also declares each
 # knob's hard bounds and driving telemetry signal. The aliases below
@@ -334,7 +341,8 @@ class ServeExecutor:
                  retry_budget: Optional[Dict[str, int]] = None,
                  prewarm_on_pin: bool = True,
                  autostart: bool = True,
-                 config: Optional[ServeConfig] = None):
+                 config: Optional[ServeConfig] = None,
+                 prewarm_manifest: Optional[str] = None):
         # Knob resolution (round 11): every tunable lives in ONE typed
         # ServeConfig the control plane owns. Explicit constructor
         # arguments are validated (the historical error contract) and
@@ -435,6 +443,14 @@ class ServeExecutor:
         self._cv = threading.Condition()
         self._closed = False
         self._thread: Optional[threading.Thread] = None
+        # zero-cold-start boot: prewarm every manifest-listed plan
+        # artifact (load + compile) BEFORE the dispatcher accepts work
+        import os as _os
+        manifest = prewarm_manifest \
+            if prewarm_manifest is not None \
+            else _os.environ.get(PLAN_MANIFEST_ENV)
+        if manifest:
+            self.registry.warmup_manifest(manifest, compile=True)
         if autostart:
             self.start()
 

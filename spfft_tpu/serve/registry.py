@@ -178,7 +178,8 @@ class PlanRegistry:
     """
 
     def __init__(self, max_bytes: Optional[int] = None,
-                 max_plans: Optional[int] = None):
+                 max_plans: Optional[int] = None,
+                 store=None):
         if max_bytes is None or max_plans is None:
             from ..control.config import global_config
             cfg = global_config()
@@ -188,6 +189,22 @@ class PlanRegistry:
                 max_plans = cfg.registry_max_plans
         if max_plans < 1:
             raise InvalidParameterError("max_plans must be >= 1")
+        # The persistent plan-artifact tier below the in-memory LRU
+        # (spfft_tpu.serve.store): a ``PlanArtifactStore``, a path
+        # string, ``None`` (resolve the process default — the config's
+        # plan_store_path or SPFFT_TPU_PLAN_STORE; usually disabled) or
+        # ``False`` to force the tier off. Read-through on miss (a warm
+        # load counts NO build), write-behind spill on build.
+        if store is False:
+            self._disk = None
+        elif store is None:
+            from .store import default_store
+            self._disk = default_store()
+        elif isinstance(store, str):
+            from .store import PlanArtifactStore
+            self._disk = PlanArtifactStore(store)
+        else:
+            self._disk = store
         self._max_bytes = int(max_bytes)
         self._max_plans = int(max_plans)
         self._store: "collections.OrderedDict[PlanSignature, Tuple[TransformPlan, int]]" = \
@@ -211,19 +228,50 @@ class PlanRegistry:
         self._sig_memo_bytes = 0
         self._build_flights: Dict[tuple, "_BuildFlight"] = {}
         self._build_failures = 0
+        self._store_hits = 0
+        self._store_misses = 0
+        self._store_spills = 0
+
+    @property
+    def store(self):
+        """The attached persistent artifact tier, or None."""
+        return self._disk
 
     # -- lookup ------------------------------------------------------------
-    def get(self, signature: PlanSignature) -> Optional[TransformPlan]:
-        """The plan for ``signature``, marking it most-recently-used —
-        or None (counted as a miss)."""
+    def _get_memory(self,
+                    signature: PlanSignature) -> Optional[TransformPlan]:
+        """LRU-only lookup (counts hit/miss, no disk tier) — the
+        in-memory half of :meth:`get`."""
         with self._lock:
             entry = self._store.get(signature)
-            if entry is None:
-                self._misses += 1
-                return None
-            self._hits += 1
-            self._store.move_to_end(signature)
-            return entry[0]
+            if entry is not None:
+                self._hits += 1
+                self._store.move_to_end(signature)
+                return entry[0]
+            self._misses += 1
+            return None
+
+    def get(self, signature: PlanSignature) -> Optional[TransformPlan]:
+        """The plan for ``signature``, marking it most-recently-used —
+        or None (counted as a miss). With a disk tier attached, an LRU
+        miss falls through to the artifact store (a replacement process
+        can answer signature-addressed traffic it has never built):
+        a warm load inserts into the LRU and returns the plan; the
+        counted miss stands (``store_hits`` disambiguates how the miss
+        was then resolved)."""
+        plan = self._get_memory(signature)
+        if plan is not None:
+            return plan
+        if self._disk is None:
+            return None
+        loaded = self._disk.load_signature(signature)
+        if loaded is None:
+            return None
+        sig, plan = loaded
+        with self._lock:
+            self._store_hits += 1
+        self.put(sig, plan)
+        return plan
 
     def __contains__(self, signature: PlanSignature) -> bool:
         with self._lock:  # no counter side effects
@@ -342,13 +390,42 @@ class PlanRegistry:
             if flight.exc is not None:
                 raise flight.exc
         try:
+            # the disk tier, consulted BEFORE any index-table work: a
+            # warm artifact resolves the raw request through its alias
+            # (triplet-byte digest), reconstructs the plan with zero
+            # builds, and enters the LRU + memo like any other plan
+            if self._disk is not None:
+                loaded = self._disk.load_for_request(
+                    transform_type, dim_x, dim_y, dim_z, arr,
+                    precision, scaling, plan_kwargs=plan_kwargs)
+                if loaded is not None:
+                    sig, plan = loaded
+                    with self._lock:
+                        self._store_hits += 1
+                    self.put(sig, plan)
+                    self._memoize(memo_key, arr, sig)
+                    return sig, plan
+                with self._lock:
+                    self._store_misses += 1
             t_build = time.perf_counter()
             ip = build_index_plan(TransformType(transform_type), dim_x,
                                   dim_y, dim_z, arr)
             sig = PlanSignature(TransformType(transform_type).value,
                                 dim_x, dim_y, dim_z, index_digest(ip),
                                 precision, Scaling(scaling).value, 1)
-            plan = self.get(sig)
+            plan = self._get_memory(sig)
+            if plan is None and self._disk is not None:
+                # a DIFFERENT spelling of this sparse set may have
+                # spilled the canonical artifact (the raw alias is
+                # representation sensitive, the signature is not) —
+                # kwargs-aware, unlike the public get() read-through
+                loaded = self._disk.load_signature(
+                    sig, plan_kwargs=plan_kwargs)
+                if loaded is not None:
+                    _, plan = loaded
+                    with self._lock:
+                        self._store_hits += 1
+                    self.put(sig, plan)
             if plan is None:
                 plan = TransformPlan(ip, precision=precision,
                                      **plan_kwargs)
@@ -361,6 +438,11 @@ class PlanRegistry:
                     "registry_build", time.perf_counter() - t_build,
                     t_build, dims=f"{dim_x}x{dim_y}x{dim_z}",
                     precision=precision, digest=sig.index_digest[:12])
+                if self._disk is not None:
+                    # write-behind: serialize off the serving thread
+                    self._disk.spill_async(sig, plan, arr)
+                    with self._lock:
+                        self._store_spills += 1
             self._memoize(memo_key, arr, sig)
             return sig, plan
         except BaseException as exc:
@@ -374,24 +456,58 @@ class PlanRegistry:
             flight.done.set()
 
     # -- warmup ------------------------------------------------------------
-    def warmup(self, specs: Iterable[dict],
-               compile: bool = False) -> List[PlanSignature]:
+    def warmup(self, specs: Iterable[dict], compile: bool = False,
+               strict: bool = True) -> List[PlanSignature]:
         """Prefetch plans for a list of shape specs before traffic.
 
-        Each spec is a dict with keys ``transform_type, dim_x, dim_y,
-        dim_z, triplets`` plus optional ``precision``/``scaling`` and
-        plan kwargs. ``compile=True`` additionally runs one zero-valued
-        backward per plan so the first real request hits a fully warm
-        executable (on TPU this loads/populates the persistent XLA
-        compilation cache). Returns the signatures in spec order."""
+        Each spec is either a SHAPE spec (keys ``transform_type, dim_x,
+        dim_y, dim_z, triplets`` plus optional ``precision``/``scaling``
+        and plan kwargs — resolved through ``get_or_build``, so the disk
+        tier applies) or an ARTIFACT spec (key ``artifact`` naming a
+        store key, as recorded by ``python -m spfft_tpu.serve.store
+        manifest``; optional ``signature`` cross-check and
+        ``plan_kwargs``; other keys are manifest metadata and ignored).
+        An artifact spec that fails to load raises
+        :class:`~spfft_tpu.errors.PlanArtifactError` when ``strict``
+        (the default — a prewarming replacement process must not
+        silently join the pool half-warm) and is skipped otherwise.
+
+        ``compile=True`` additionally runs one zero-valued backward per
+        plan so the first real request hits a fully warm executable (an
+        artifact's AOT executable, the persistent XLA compilation
+        cache, or a fresh compile — in that order of cheapness).
+        Returns the signatures in spec order (loaded ones only when
+        ``strict=False``)."""
+        from ..errors import PlanArtifactError
         sigs = []
         for spec in specs:
             spec = dict(spec)
-            ttype = spec.pop("transform_type")
-            dims = (spec.pop("dim_x"), spec.pop("dim_y"),
-                    spec.pop("dim_z"))
-            triplets = spec.pop("triplets")
-            sig, plan = self.get_or_build(ttype, *dims, triplets, **spec)
+            if "artifact" in spec:
+                if self._disk is None:
+                    raise InvalidParameterError(
+                        "warmup spec names an artifact but the "
+                        "registry has no plan store attached")
+                loaded = self._disk.load_key(
+                    spec["artifact"],
+                    plan_kwargs=spec.get("plan_kwargs"),
+                    expect_sig=spec.get("signature"))
+                if loaded is None:
+                    if strict:
+                        raise PlanArtifactError(
+                            f"plan artifact {spec['artifact'][:12]}... "
+                            f"failed to load during warmup (see "
+                            f"spfft_store_rejects_total for the "
+                            f"reason)")
+                    continue
+                sig, plan = loaded
+                self.put(sig, plan)
+            else:
+                ttype = spec.pop("transform_type")
+                dims = (spec.pop("dim_x"), spec.pop("dim_y"),
+                        spec.pop("dim_z"))
+                triplets = spec.pop("triplets")
+                sig, plan = self.get_or_build(ttype, *dims, triplets,
+                                              **spec)
             if compile:
                 n = plan.index_plan.num_values
                 plan.backward(np.zeros((n, 2), np.float32)
@@ -399,6 +515,17 @@ class PlanRegistry:
                               else np.zeros(n, np.complex128))
             sigs.append(sig)
         return sigs
+
+    def warmup_manifest(self, path: str, compile: bool = False,
+                        strict: bool = True) -> List[PlanSignature]:
+        """Boot prewarm from a recorded manifest (``python -m
+        spfft_tpu.serve.store manifest``): load every listed artifact
+        into the LRU so a replacement process compiles/loads everything
+        BEFORE joining the pool. Returns the loaded signatures."""
+        from .store import load_manifest
+        payload = load_manifest(path)
+        return self.warmup(payload.get("entries", ()), compile=compile,
+                           strict=strict)
 
     # -- counters ----------------------------------------------------------
     @property
@@ -433,4 +560,8 @@ class PlanRegistry:
                                         self._sig_memo.values()),
                 "sig_memo_bytes": self._sig_memo_bytes,
                 "hit_rate": self._hits / total if total else 0.0,
+                "store_hits": self._store_hits,
+                "store_misses": self._store_misses,
+                "store_spills": self._store_spills,
+                "store_attached": self._disk is not None,
             }

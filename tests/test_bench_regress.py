@@ -99,9 +99,14 @@ def test_real_repo_reference_resolves():
     """The repo's own BENCH_r*.json trail is a usable reference."""
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     ref = bench_regress.latest_reference(root)
-    assert ref is not None and ref.endswith("BENCH_r05.json")
+    assert ref is not None and ref.endswith("BENCH_r06.json")
     value, unit, metric = bench_regress.load_measurement(ref)
     assert unit == "s" and value > 0
+    # the round-13 cold/warm sub-rows are present and well-formed
+    payload = bench_regress.load_payload(ref)
+    for row in ("cold_start_ms", "warm_start_ms"):
+        v, u, _ = bench_regress.measurement(payload, ref, row=row)
+        assert u == "ms" and v > 0
 
 
 def _write_with_fused(path, value, fused_value, unit="s", wrap=False):

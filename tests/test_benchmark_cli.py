@@ -74,6 +74,30 @@ def test_cli_serve_reports_metrics(tmp_path):
     assert serve["registry"]["plans"] == 1
 
 
+def test_cli_store_dir_cold_warm_ab(tmp_path):
+    """--store-dir (round 13): the JSON must carry the cold/warm pair
+    bench_regress.py compares — a true cold start (empty store, one
+    build + spill) and a fresh-subprocess warm boot with zero builds."""
+    out = tmp_path / "bench.json"
+    store_dir = tmp_path / "store"
+    assert main(["-d", "12", "-r", "1", "-s", "0.5",
+                 "--store-dir", str(store_dir),
+                 "-o", str(out)]) == 0
+    params = json.loads(out.read_text())["parameters"]
+    assert params["store_was_cold"] is True
+    assert params["cold_start_ms"]["value"] > 0
+    assert params["cold_start_ms"]["unit"] == "ms"
+    assert params["warm_start_ms"]["value"] > 0
+    assert params["warm_builds"] == 0
+    assert params["warm_store"]["hits"] == 1
+
+
+def test_cli_store_dir_rejects_shards():
+    with pytest.raises(SystemExit):
+        main(["-d", "12", "-r", "1", "--shards", "2",
+              "--store-dir", "/tmp/x"])
+
+
 def test_cli_serve_rejects_shards():
     with pytest.raises(SystemExit):
         main(["-d", "12", "--serve", "--shards", "2"])

@@ -171,7 +171,8 @@ def test_executor_invalid_explicit_knobs_still_raise():
 # -- controller scenarios (scripted telemetry, no executor needed) ----------
 def _signals(completed=0, queue_depth=0, qw95=0.0, dx50=0.0,
              fused_rows=0, padded_rows=0, fused_hist=None,
-             max_queue_depth=0, stage_s=0.0, dispatch_s=0.0):
+             max_queue_depth=0, stage_s=0.0, dispatch_s=0.0,
+             rejected=0):
     return {"completed": completed, "failed": 0,
             "queue_depth": queue_depth,
             "max_queue_depth": max_queue_depth,
@@ -179,6 +180,7 @@ def _signals(completed=0, queue_depth=0, qw95=0.0, dx50=0.0,
             "fused_rows": fused_rows, "padded_rows": padded_rows,
             "fused_hist": fused_hist or {}, "stage_s": stage_s,
             "dispatch_s": dispatch_s, "quarantines": 0,
+            "rejected_queue_full": rejected,
             "latency_p99": 0.0}
 
 
@@ -241,6 +243,67 @@ def test_controller_max_batch_shrinks_when_buckets_small():
     ctl.step(_signals(completed=10, qw95=0.001, dx50=0.002,
                       fused_hist={4: 6}))
     assert cfg.max_batch == 16
+
+
+def test_controller_max_queue_grows_on_sustained_reject_burn():
+    """ROADMAP control follow-on #3: sustained rejected_queue_full
+    burn doubles max_queue within its declared bounds; a single blip
+    moves nothing (the streak is the hysteresis)."""
+    cfg = ServeConfig()
+    ctl = Controller(cfg, cooldown_steps=0)
+    ctl.step(_signals(completed=1))                      # baseline
+    # one reject step: backpressure doing its job, no move yet
+    d1 = ctl.step(_signals(completed=5, queue_depth=10, rejected=4))
+    assert not [d for d in d1 if d.knob == "max_queue"]
+    assert cfg.max_queue == ServeConfig.default("max_queue")
+    # second consecutive reject step: sustained burn -> double
+    d2 = ctl.step(_signals(completed=9, queue_depth=12, rejected=11))
+    moved = [d for d in d2 if d.knob == "max_queue"]
+    assert len(moved) == 1
+    assert moved[0].new == 2 * ServeConfig.default("max_queue")
+    assert "queue-full burn" in moved[0].reason
+    # the burn continues: grows again, still bounds-clamped
+    ctl.step(_signals(completed=12, queue_depth=12, rejected=15))
+    ctl.step(_signals(completed=15, queue_depth=12, rejected=20))
+    assert cfg.max_queue == 4 * ServeConfig.default("max_queue")
+    lo, hi = ServeConfig.bounds("max_queue")
+    assert lo <= cfg.max_queue <= hi
+
+
+def test_controller_max_queue_blip_then_quiet_never_moves():
+    cfg = ServeConfig()
+    ctl = Controller(cfg, cooldown_steps=0)
+    ctl.step(_signals(completed=1))
+    ctl.step(_signals(completed=5, queue_depth=4, rejected=2))   # blip
+    ctl.step(_signals(completed=9, queue_depth=2, rejected=2))   # quiet
+    ctl.step(_signals(completed=12, queue_depth=1, rejected=2))
+    assert cfg.max_queue == ServeConfig.default("max_queue")
+    assert not [d for d in ctl.decisions() if d.knob == "max_queue"]
+
+
+def test_controller_max_queue_clamps_at_declared_bound():
+    cfg = ServeConfig()
+    _, hi = ServeConfig.bounds("max_queue")
+    cfg.set("max_queue", hi, source="test")
+    ctl = Controller(cfg, cooldown_steps=0)
+    ctl.step(_signals(completed=1))
+    ctl.step(_signals(completed=5, queue_depth=9, rejected=3))
+    ctl.step(_signals(completed=9, queue_depth=9, rejected=9))
+    assert cfg.max_queue == hi   # clamp held, no runaway
+
+
+def test_controller_max_queue_idle_decays_by_halving():
+    cfg = ServeConfig()
+    default = ServeConfig.default("max_queue")
+    cfg.set("max_queue", 4 * default, source="test")
+    ctl = Controller(cfg, cooldown_steps=0)
+    ctl.step(_signals(completed=5))          # baseline with traffic
+    ctl.step(_signals(completed=5))          # idle
+    assert cfg.max_queue == 2 * default
+    ctl.step(_signals(completed=5))
+    assert cfg.max_queue == default
+    ctl.step(_signals(completed=5))
+    assert cfg.max_queue == default          # never undershoots
 
 
 def test_controller_idle_decays_managed_knobs_to_defaults():

@@ -79,6 +79,45 @@ def test_chunk_bounds_partition_and_balance():
     assert max(shares) <= 2 * (sum(counts) / 4)
 
 
+def test_chunk_bounds_skewed_ingress_balances_dominant_shard():
+    """Round-13 skew-aware partitioner: under heavily skewed stick
+    ownership the DOMINANT shard's rows (= the per-chunk busiest link,
+    since prefix-populated rows make the heaviest link the largest
+    shard's) must split near-evenly across chunks, while total
+    true-row balance (= every destination's ingress share) stays
+    within the old bound."""
+    counts = [10, 100]
+    K = 2
+
+    def dominant(b):
+        m = max(counts)
+        return [max(0, min(m, hi) - min(m, lo)) for lo, hi in b]
+
+    def shares(b):
+        return [sum(max(0, min(c, hi) - min(c, lo)) for c in counts)
+                for lo, hi in b]
+
+    legacy = chunk_bounds(counts, 100, K, skew_weight=0.0)
+    skew = chunk_bounds(counts, 100, K)
+    # the skew-aware split divides the dominant shard strictly more
+    # evenly than the totals-only split ...
+    assert max(dominant(skew)) - min(dominant(skew)) \
+        < max(dominant(legacy)) - min(dominant(legacy))
+    assert max(dominant(skew)) <= 1.1 * (max(counts) / K) + 1
+    # ... without giving up the destination-ingress balance bound
+    assert sum(shares(skew)) == sum(counts)
+    assert max(shares(skew)) <= 2 * (sum(counts) / K)
+
+
+def test_chunk_bounds_uniform_counts_match_legacy():
+    """Uniform shards: both weights are proportional, so the
+    skew-aware bounds reproduce the pre-round-13 partition exactly."""
+    for counts, padded, k in (([7, 7, 7, 7], 8, 3),
+                              ([20, 20], 25, 4), ([5], 5, 5)):
+        assert chunk_bounds(counts, padded, k) \
+            == chunk_bounds(counts, padded, k, skew_weight=0.0)
+
+
 def test_chunk_bounds_rejects_bad_k():
     with pytest.raises(InvalidParameterError):
         chunk_bounds([3], 4, 0)

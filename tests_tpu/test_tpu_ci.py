@@ -723,3 +723,65 @@ def test_fused_compression_dft_on_tpu(tmp_path, monkeypatch):
             lambda v: plan._backward_impl(v, plan._tables_hot)).lower(
                 plan._coerce_values(vals)).as_text()
         assert ("%dx8x128xf32" % n_tiles) not in text
+
+
+def test_plan_store_on_tpu(tmp_path):
+    """The round-13 persistent plan-artifact store on the real chip:
+    a warm load must (1) resolve with ZERO builds and restore the
+    Pallas/fused kernel tables ACTIVE (the table cover build — seconds
+    at this size — is the biggest cold-start line item the artifact
+    exists to persist), (2) stay bit-exact vs the cold-built plan, and
+    (3) first-execute FASTER through the jax.export AOT deserialize
+    than a fresh trace+compile of the identical plan. Record the
+    printed STORE_AB line into BENCHMARKS.md "Round-13" chip rows."""
+    import time
+
+    from spfft_tpu.serve.registry import PlanRegistry
+    from spfft_tpu.serve.store import PlanArtifactStore
+
+    n = 128
+    tr = spherical_cutoff_triplets(n)
+    store = PlanArtifactStore(str(tmp_path / "store"))
+    reg = PlanRegistry(store=store)
+    t0 = time.perf_counter()
+    sig, plan = reg.get_or_build(TransformType.C2C, n, n, n, tr)
+    plan._finalize()            # cold pays the whole table build
+    cold_s = time.perf_counter() - t0
+    store.drain()
+    vals = _values(len(tr), 13)
+    want = np.asarray(plan.backward(vals))
+
+    # warm boot: fresh registry over the populated store
+    reg2 = PlanRegistry(store=PlanArtifactStore(store.root))
+    t0 = time.perf_counter()
+    sig2, plan2 = reg2.get_or_build(TransformType.C2C, n, n, n, tr)
+    load_s = time.perf_counter() - t0
+    assert sig2 == sig
+    assert reg2.stats()["builds"] == 0
+    assert reg2.stats()["store_hits"] == 1
+    assert plan2._build_thread is None
+    assert plan2.pallas_active == plan.pallas_active
+    assert plan2.fused_active == plan.fused_active
+    assert plan2._aot is not None and "backward" in plan2._aot
+    t0 = time.perf_counter()
+    got = np.asarray(plan2.backward(vals))
+    aot_first_s = time.perf_counter() - t0
+    assert np.array_equal(got, want)    # bit-exact vs the cold build
+
+    # fresh-compile twin: the SAME artifact with the AOT executables
+    # stripped — identical restore cost, only trace+compile differs
+    loaded = PlanArtifactStore(store.root).load_signature(sig)
+    assert loaded is not None
+    _, plan3 = loaded
+    plan3._aot = None
+    t0 = time.perf_counter()
+    out3 = np.asarray(plan3.backward(vals))
+    fresh_first_s = time.perf_counter() - t0
+    assert np.array_equal(out3, want)
+    print(f"STORE_AB n={n} cold_resolve={cold_s * 1e3:.1f}ms "
+          f"warm_load={load_s * 1e3:.1f}ms "
+          f"aot_first_execute={aot_first_s * 1e3:.1f}ms "
+          f"fresh_first_execute={fresh_first_s * 1e3:.1f}ms")
+    assert load_s < cold_s, "warm load failed to beat the cold build"
+    assert aot_first_s < fresh_first_s, \
+        "AOT deserialize failed to beat the fresh trace+compile"
