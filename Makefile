@@ -16,7 +16,8 @@ PLANNER_SO  := $(NATIVE_DIR)/_planner_$(CACHE_TAG).so
 CAPI_SO     := lib/libspfft_tpu.so
 
 .PHONY: all native capi example-c test ci ci-tpu trace-smoke \
-        control-smoke fused-smoke store-smoke bench-check clean
+        control-smoke fused-smoke store-smoke bench-check lint \
+        analyze clean
 
 # One-command CI (reference: .github/workflows/ci.yml builds + runs the
 # local test matrix): full CPU suite (8-device virtual mesh; includes the
@@ -35,6 +36,37 @@ ci: native capi
 	JAX_PLATFORMS=cpu DIMS="32 64" python scripts/precision_matrix.py
 	@echo "CI GREEN"
 
+# Baseline lint (docs/static_analysis.md): pyflakes-family rules only
+# (unused imports, undefined names; config under [tool.ruff] in
+# pyproject.toml, scripts/probe_* excluded there). Uses a real ruff
+# when the environment has one; otherwise the dependency-free built-in
+# twin runs the same two rule families, so the gate never silently
+# degrades to a no-op on a machine without ruff.
+lint:
+	@echo "== lint: baseline (ruff, or the built-in pyflakes-lite twin) =="
+	@if command -v ruff >/dev/null 2>&1; then \
+	  ruff check spfft_tpu/; \
+	else \
+	  echo "(ruff not installed; running python -m spfft_tpu.analysis --baseline-only)"; \
+	  python -m spfft_tpu.analysis --baseline-only -q; \
+	fi
+	@echo "LINT GREEN"
+
+# Project lint engine (docs/static_analysis.md): the AST-based checkers
+# that enforce the contracts the code claims — lock-discipline over
+# `#: guarded by _lock` fields + the lock-acquisition-order graph
+# (deadlock-shape cycles fail), span-closure for every obs span open
+# site, the spfft_* counter/series registry, the error taxonomy and the
+# control-plane knob registry. Zero unwaived findings required; every
+# waiver is listed in the report with its reason. The same checks run
+# in tier-1 (tests/test_analysis.py::test_real_package_analysis_is_clean
+# and the fixture suite around it).
+analyze:
+	@echo "== analyze: project static-analysis pass =="
+	@mkdir -p build
+	python -m spfft_tpu.analysis --json build/analysis_report.json
+	@echo "ANALYZE GREEN"
+
 # On-TPU regression lane (tests_tpu/): oracle matrix, forced Pallas,
 # the segmented aliased-carry accumulate, split-x, pair-IO, two-stage
 # axes and repeated-backward — the silent-corruption bug classes the
@@ -42,7 +74,9 @@ ci: native capi
 # fault-injection: bucket isolation, device quarantine over the real
 # chip pool, crash-proof dispatch). Needs the real chip; record with
 #   make ci-tpu 2>&1 | tee docs/ci_tpu_r05.log
-ci-tpu:
+# lint + analyze run first: the chip lane is expensive, so it never
+# starts on a tree the static passes already know is dirty.
+ci-tpu: lint analyze
 	@echo "== CI-TPU: on-device regression lane =="
 	python -m pytest tests_tpu/ -q -rA
 	@echo "CI-TPU GREEN"

@@ -1,0 +1,99 @@
+"""spfft_tpu.analysis — the project lint engine.
+
+An AST-based static-analysis pass that enforces the contracts the code
+already claims (see docs/static_analysis.md for the checker catalogue
+and annotation syntax):
+
+* ``lock-discipline`` / ``lock-order`` — ``#: guarded by _lock``
+  fields only touched under their lock; acquisition-order graph with
+  deadlock-shape (cycle) detection (:mod:`.locks`);
+* ``span-closure`` — every obs span open site has a closure story on
+  all paths (:mod:`.spans`);
+* ``counter-registry`` — every ``spfft_*`` series declared exactly
+  once in ``obs/counters.py`` and surfaced by ``prometheus_text``
+  (:mod:`.counters_check`);
+* ``error-taxonomy`` — every exception class carries a code, is
+  raised somewhere and is documented (:mod:`.errors_check`);
+* ``knob-registry`` — ``KNOB_SPECS`` sanity, env spellings, docs rows
+  (:mod:`.knobs`);
+* ``baseline-lint`` — unused imports + undefined names, the
+  dependency-free twin of the ruff config (:mod:`.baseline`).
+
+Run with ``python -m spfft_tpu.analysis`` or ``make analyze``; the
+package is parsed ONCE (:func:`core.index_package`) and every checker
+consumes the shared index.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+from . import (baseline, counters_check, errors_check, knobs, locks,
+               spans)
+from .core import (Finding, PackageIndex, Report, index_package,
+                   index_sources)
+
+__all__ = ["Finding", "PackageIndex", "Report", "index_package",
+           "index_sources", "run_analysis", "CHECKERS"]
+
+#: Checker registry: name -> callable(index) -> (findings, extras).
+#: errors/knobs take repo-dependent doc arguments; run_analysis wires
+#: them.
+CHECKERS = ("lock-discipline", "span-closure", "counter-registry",
+            "error-taxonomy", "knob-registry", "baseline-lint")
+
+
+def package_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def repo_root() -> str:
+    return os.path.dirname(package_root())
+
+
+def run_analysis(root: Optional[str] = None,
+                 checkers: Optional[List[str]] = None,
+                 docs_root: Optional[str] = None) -> Report:
+    """Run the selected ``checkers`` (default: all) over the package at
+    ``root`` (default: the installed spfft_tpu package) and return the
+    combined :class:`Report`."""
+    root = root or package_root()
+    docs_root = docs_root if docs_root is not None else \
+        os.path.dirname(os.path.abspath(root))
+    selected = list(checkers) if checkers else list(CHECKERS)
+    unknown = set(selected) - set(CHECKERS)
+    if unknown:
+        raise ValueError(f"unknown checkers: {sorted(unknown)} "
+                         f"(available: {list(CHECKERS)})")
+    index = index_package(root)
+    report = Report()
+    if "lock-discipline" in selected:
+        findings, extras = locks.check(index)
+        report.extend("lock-discipline", findings)
+        report.extras.update(extras)
+    if "span-closure" in selected:
+        findings, extras = spans.check(index)
+        report.extend("span-closure", findings)
+        report.extras.update(extras)
+    if "counter-registry" in selected:
+        findings, extras = counters_check.check(index)
+        report.extend("counter-registry", findings)
+        report.extras.update(extras)
+    if "error-taxonomy" in selected:
+        docs = errors_check.default_docs_paths(docs_root)
+        findings, extras = errors_check.check(
+            index, docs_paths=docs or None)
+        report.extend("error-taxonomy", findings)
+        report.extras.update(extras)
+    if "knob-registry" in selected:
+        doc = os.path.join(docs_root, "docs", "control_plane.md")
+        findings, extras = knobs.check(
+            index, doc_path=doc if os.path.exists(doc) else None)
+        report.extend("knob-registry", findings)
+        report.extras.update(extras)
+    if "baseline-lint" in selected:
+        findings, extras = baseline.check(index)
+        report.extend("baseline-lint", findings)
+        report.extras.update(extras)
+    return report

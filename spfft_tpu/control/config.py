@@ -174,13 +174,15 @@ class ServeConfig:
 
     def __init__(self, values: Optional[Dict] = None):
         self._lock = threading.Lock()
+        #: guarded by _lock
         self._values: Dict[str, float] = {
             name: spec.default for name, spec in KNOB_SPECS.items()}
-        self._paths: Dict[str, str] = dict(PATH_SETTINGS)
+        self._paths: Dict[str, str] = dict(PATH_SETTINGS)  #: guarded by _lock
+        #: guarded by _lock
         self._history: "collections.deque" = collections.deque(
             maxlen=HISTORY_LIMIT)
-        self._seq = 0
-        self._decisions_by_source: Dict[str, int] = {}
+        self._seq = 0  #: guarded by _lock
+        self._decisions_by_source: Dict[str, int] = {}  #: guarded by _lock
         if values:
             self.update(values, reason="initial values", source="init")
 
@@ -206,12 +208,12 @@ class ServeConfig:
     # -- reading -----------------------------------------------------------
     def __getattr__(self, name: str):
         # only consulted when normal attribute lookup fails — i.e. for
-        # knob names (internal attributes hit __dict__ first)
-        specs = object.__getattribute__(self, "__dict__")
+        # knob names (internal attributes hit __dict__ first, so the
+        # self._lock/self._values lookups below never recurse)
         if name.startswith("_") or name not in KNOB_SPECS:
             raise AttributeError(name)
-        with specs["_lock"]:
-            return specs["_values"][name]
+        with self._lock:
+            return self._values[name]
 
     def get(self, name: str):
         if name not in KNOB_SPECS:
@@ -373,7 +375,7 @@ class ServeConfig:
 #: (``parallel/dist.py`` overlap_chunks, ``PlanRegistry`` bounds)
 #: resolve through when no explicit value or executor-owned config is
 #: in play. Lazily boots from the env artifact.
-_GLOBAL: Optional[ServeConfig] = None
+_GLOBAL: Optional[ServeConfig] = None  #: guarded by _GLOBAL_LOCK
 _GLOBAL_LOCK = threading.Lock()
 
 

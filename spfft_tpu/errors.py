@@ -61,6 +61,7 @@ class OverflowError_(GenericError):
     code = ErrorCode.OVERFLOW
 
 
+# errors: waived(API-parity class - reference SPFFT_ALLOCATION_ERROR; kept for mechanical migration)
 class AllocationError(GenericError):
     """Failed buffer allocation (reference: exceptions.hpp:62-71)."""
 
@@ -88,6 +89,7 @@ class InvalidIndicesError(GenericError):
     code = ErrorCode.INVALID_INDICES
 
 
+# errors: waived(API-parity class - reference MPISupportError; local-only builds never raise it)
 class DistributedSupportError(GenericError):
     """Distributed operation requested without a device mesh
     (reference: exceptions.hpp:110-121, MPISupportError)."""
@@ -218,6 +220,7 @@ class PrecisionContractError(FFTError):
     the distinction, docs/precision.md explains why this one does)."""
 
 
+# errors: waived(API-parity class - reference InternalError; no internal-assert surface yet)
 class InternalError(GenericError):
     """Internal consistency failure (reference: exceptions.hpp:170-177)."""
 
@@ -231,6 +234,7 @@ class DeviceError(GenericError):
     code = ErrorCode.DEVICE
 
 
+# errors: waived(API-parity class - reference GPUSupportError; XLA reports device absence itself)
 class DeviceSupportError(DeviceError):
     """Device execution requested but no accelerator is available
     (reference: exceptions.hpp:193-204)."""
@@ -238,12 +242,14 @@ class DeviceSupportError(DeviceError):
     code = ErrorCode.DEVICE_SUPPORT
 
 
+# errors: waived(API-parity class - reference GPUAllocationError; XLA owns device allocation)
 class DeviceAllocationError(DeviceError):
     """Failed allocation on device (reference: exceptions.hpp:221-230)."""
 
     code = ErrorCode.DEVICE_ALLOCATION
 
 
+# errors: waived(API-parity class - reference GPUFFTError; XLA owns the device FFT path)
 class DeviceFFTError(DeviceError):
     """Failure in the device FFT path (reference: exceptions.hpp:295-304)."""
 

@@ -23,6 +23,209 @@ from typing import Dict, Optional, Tuple
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
 
+#: THE series registry: every ``spfft_*`` counter/gauge any part of the
+#: process emits — through :data:`GLOBAL_COUNTERS` or synthesised by
+#: ``obs.exporters.prometheus_text``'s ServeMetrics/registry/timing
+#: families — declared exactly once, as ``name: (type, help)``. The
+#: static counter-registry checker (``python -m spfft_tpu.analysis``)
+#: fails the build on a recorded series missing here (a typo'd name
+#: would otherwise become a silently-new series) and on a declared
+#: series nothing records or renders; at runtime :class:`Counters`
+#: enforces the declared type and defaults the help text from here.
+METRIC_SPECS: Dict[str, Tuple[str, str]] = {
+    # compile / plan observability (obs.record_* helpers)
+    "spfft_compile_events_total":
+        ("counter", "Compile-path events by kind."),
+    "spfft_compile_seconds_total":
+        ("counter", "Compile-path seconds by kind."),
+    "spfft_plan_builds_total":
+        ("counter", "Transform plans constructed."),
+    "spfft_plan_build_seconds_total":
+        ("counter", "Seconds spent constructing plans."),
+    "spfft_plan_pallas_fallback_total":
+        ("counter",
+         "Plan-time Pallas fallback decisions by stage and reason."),
+    # distributed exchange accounting
+    "spfft_exchange_plans_total":
+        ("counter", "Distributed plans constructed."),
+    "spfft_exchange_wire_bytes":
+        ("gauge",
+         "Exact off-shard bytes per exchange of the most recent plan."),
+    "spfft_exchange_busiest_link_bytes":
+        ("gauge",
+         "Bottleneck-link bytes per exchange of the most recent plan."),
+    "spfft_hlo_collectives":
+        ("gauge", "Collective launches in the most recently inspected "
+                  "lowered module."),
+    "spfft_hlo_async_starts":
+        ("gauge", "Async collective starts in the most recently "
+                  "inspected compiled module."),
+    "spfft_hlo_async_dones":
+        ("gauge", "Async collective dones in the most recently "
+                  "inspected compiled module."),
+    # plan-artifact store
+    "spfft_store_hits_total":
+        ("counter", "Plan-artifact store outcomes: warm loads."),
+    "spfft_store_misses_total":
+        ("counter", "Plan-artifact store outcomes: misses."),
+    "spfft_store_spills_total":
+        ("counter", "Plan-artifact store outcomes: write-behind "
+                    "spills."),
+    "spfft_store_evictions_total":
+        ("counter", "Plan-artifact store outcomes: GC evictions."),
+    "spfft_store_rejects_total":
+        ("counter", "Plan-artifact store outcomes: typed artifact "
+                    "rejections by reason."),
+    "spfft_store_aot_skipped_total":
+        ("counter", "AOT executables skipped (non-fatal) by reason."),
+    # control plane
+    "spfft_control_decisions_total":
+        ("counter", "Accepted control-plane knob changes."),
+    "spfft_control_knob":
+        ("gauge", "Current value of each control-plane knob."),
+    "spfft_control_clamped_total":
+        ("counter", "Knob writes clamped into their declared bounds."),
+    "spfft_control_steps_total":
+        ("counter", "Feedback-controller evaluation steps."),
+    "spfft_control_step_errors_total":
+        ("counter", "Feedback-controller steps that raised."),
+    # SLO watchdog
+    "spfft_slo_evaluations_total":
+        ("counter", "SLO watchdog evaluations."),
+    "spfft_slo_objective":
+        ("gauge", "Declared SLO objective value."),
+    "spfft_slo_observed":
+        ("gauge", "Observed value at last SLO evaluation."),
+    "spfft_slo_burn_rate":
+        ("gauge", "observed/objective at last evaluation (-1 = "
+                  "infinite: a zero objective was burned)."),
+    "spfft_slo_violation":
+        ("gauge", "1 while this SLO's burn rate exceeds its budget."),
+    "spfft_slo_violations_total":
+        ("counter", "SLO violations observed across evaluations."),
+    # serving families (rendered by exporters._serve_families from a
+    # ServeMetrics snapshot)
+    "spfft_serve_completed_total":
+        ("counter", "Requests completed successfully."),
+    "spfft_serve_failed_total":
+        ("counter", "Requests resolved with an error."),
+    "spfft_serve_rejected_queue_full_total":
+        ("counter", "Submits rejected by backpressure."),
+    "spfft_serve_expired_deadline_total":
+        ("counter", "Requests expired before dispatch."),
+    "spfft_serve_fused_batches_total":
+        ("counter", "Buckets dispatched through the fused path."),
+    "spfft_serve_serial_batches_total":
+        ("counter", "Buckets dispatched serially."),
+    "spfft_serve_padded_rows_total":
+        ("counter", "Ladder pad rows dispatched."),
+    "spfft_serve_pinned_batches_total":
+        ("counter", "Buckets dispatched at a pinned shape."),
+    "spfft_serve_fused_rows_total":
+        ("counter", "Live rows dispatched through fused buckets."),
+    "spfft_serve_completed_by_class_total":
+        ("counter", "Completions per priority class."),
+    "spfft_serve_queue_depth":
+        ("gauge", "Request queue depth at last enqueue/dequeue."),
+    "spfft_serve_max_queue_depth":
+        ("gauge", "High-water queue depth."),
+    "spfft_serve_latency_seconds":
+        ("gauge",
+         "Request latency percentiles over the bounded reservoir."),
+    "spfft_serve_queue_wait_seconds":
+        ("gauge", "Enqueue->dispatch wait percentiles (recent window) "
+                  "— the controller's queue-pressure signal."),
+    "spfft_serve_device_execute_seconds":
+        ("gauge", "Dispatch->materialised bucket time percentiles "
+                  "(recent window) — the controller's device-cost "
+                  "signal."),
+    "spfft_serve_latency_by_class_seconds":
+        ("gauge", "Per-priority-class latency percentiles."),
+    "spfft_serve_batch_size_total":
+        ("counter", "Dispatched buckets by live-row count and path."),
+    "spfft_serve_overhead_seconds_total":
+        ("counter", "Host-side orchestration seconds."),
+    "spfft_serve_health":
+        ("gauge", "Executor lifecycle state (one-hot)."),
+    # serving failure-handling families (the ServeMetrics.health()
+    # numeric counters, rendered as spfft_serve_<key>_total)
+    "spfft_serve_retries_total":
+        ("counter", "Failure-handling counter: retries."),
+    "spfft_serve_retries_exhausted_total":
+        ("counter", "Failure-handling counter: retries_exhausted."),
+    "spfft_serve_retries_by_class_total":
+        ("counter", "Failure-handling counter: retries_by_class."),
+    "spfft_serve_retries_exhausted_by_class_total":
+        ("counter",
+         "Failure-handling counter: retries_exhausted_by_class."),
+    "spfft_serve_bucket_fallbacks_total":
+        ("counter", "Failure-handling counter: bucket_fallbacks."),
+    "spfft_serve_quarantines_total":
+        ("counter", "Failure-handling counter: quarantines."),
+    "spfft_serve_probations_total":
+        ("counter", "Failure-handling counter: probations."),
+    "spfft_serve_readmissions_total":
+        ("counter", "Failure-handling counter: readmissions."),
+    "spfft_serve_no_healthy_device_total":
+        ("counter", "Failure-handling counter: no_healthy_device."),
+    "spfft_serve_dispatcher_crashes_total":
+        ("counter", "Failure-handling counter: dispatcher_crashes."),
+    "spfft_serve_dispatcher_restarts_total":
+        ("counter", "Failure-handling counter: dispatcher_restarts."),
+    "spfft_serve_pin_prewarms_total":
+        ("counter", "Failure-handling counter: pin_prewarms."),
+    "spfft_serve_purged_expired_total":
+        ("counter", "Failure-handling counter: purged_expired."),
+    "spfft_serve_request_attributed_failures_total":
+        ("counter",
+         "Failure-handling counter: request_attributed_failures."),
+    # plan-registry families (exporters._registry_families over
+    # PlanRegistry.stats())
+    "spfft_registry_plans": ("gauge", "Plan registry plans."),
+    "spfft_registry_bytes_in_use":
+        ("gauge", "Plan registry bytes in use."),
+    "spfft_registry_max_bytes": ("gauge", "Plan registry max bytes."),
+    "spfft_registry_max_plans": ("gauge", "Plan registry max plans."),
+    "spfft_registry_sig_memo_entries":
+        ("gauge", "Plan registry sig memo entries."),
+    "spfft_registry_sig_memo_bytes":
+        ("gauge", "Plan registry sig memo bytes."),
+    "spfft_registry_hit_rate": ("gauge", "Plan registry hit rate."),
+    "spfft_registry_store_attached":
+        ("gauge", "Plan registry store attached."),
+    "spfft_registry_hits_total": ("counter", "Plan registry hits."),
+    "spfft_registry_misses_total":
+        ("counter", "Plan registry misses."),
+    "spfft_registry_fast_hits_total":
+        ("counter", "Plan registry fast hits."),
+    "spfft_registry_evictions_total":
+        ("counter", "Plan registry evictions."),
+    "spfft_registry_builds_total":
+        ("counter", "Plan registry builds."),
+    "spfft_registry_build_failures_total":
+        ("counter", "Plan registry build failures."),
+    "spfft_registry_store_hits_total":
+        ("counter", "Plan registry store hits."),
+    "spfft_registry_store_misses_total":
+        ("counter", "Plan registry store misses."),
+    "spfft_registry_store_spills_total":
+        ("counter", "Plan registry store spills."),
+    # timing + tracer lifecycle families
+    "spfft_timing_seconds_total":
+        ("counter",
+         "Accumulated scope-timer seconds (timing.GlobalTimer)."),
+    "spfft_timing_calls_total":
+        ("counter", "Scope-timer call counts (timing.GlobalTimer)."),
+    "spfft_trace_spans_started_total":
+        ("counter", "Spans begun since the tracer's last reset."),
+    "spfft_trace_spans_closed_total":
+        ("counter", "Spans finished since the tracer's last reset."),
+    "spfft_trace_spans_open":
+        ("gauge", "Spans currently open (must be 0 at quiescence)."),
+    "spfft_trace_events_dropped_total":
+        ("counter", "Events dropped by the bounded ring buffer."),
+}
+
 
 class Counters:
     """Thread-safe registry of named counter/gauge families."""
@@ -31,11 +234,23 @@ class Counters:
         self._lock = threading.Lock()
         # name -> {"type": "counter"|"gauge", "help": str,
         #          "samples": {(("k","v"), ...): float}}
-        self._metrics: Dict[str, dict] = {}
+        self._metrics: Dict[str, dict] = {}  #: guarded by _lock
 
+    # lock: holds(_lock)
     def _family(self, name: str, mtype: str, help_: Optional[str]):
         if not _NAME_RE.match(name):
             raise ValueError(f"bad metric name {name!r}")
+        spec = METRIC_SPECS.get(name)
+        if spec is not None:
+            # the declared registry is authoritative: a recorder that
+            # disagrees with the declared type is the same bug the
+            # static counter-registry checker catches, enforced live
+            if spec[0] != mtype:
+                raise ValueError(
+                    f"metric {name!r} is declared a {spec[0]} in "
+                    f"METRIC_SPECS but recorded as a {mtype}")
+            if help_ is None:
+                help_ = spec[1]
         fam = self._metrics.get(name)
         if fam is None:
             fam = self._metrics[name] = {

@@ -40,7 +40,7 @@ import os
 import threading
 import time
 from collections import deque
-from typing import Any, Dict, List, Optional
+from typing import Dict, List, Optional
 
 #: Environment knobs (read at import; enable()/set_sample_rate() override).
 TRACE_ENV = "SPFFT_TPU_TRACE"
@@ -116,15 +116,16 @@ class Tracer:
         self._lock = threading.Lock()
         self._max_events = max(1, int(max_events))
         self.epoch = time.perf_counter()
-        self._events: deque = deque(maxlen=self._max_events)
-        self._open: Dict[int, Span] = {}
+        self._events: deque = deque(maxlen=self._max_events)  #: guarded by _lock
+        self._open: Dict[int, Span] = {}  #: guarded by _lock
+        # GIL-atomic id sources: begin() stamps ids OUTSIDE the lock
         self._span_ids = itertools.count(1)
         self._trace_ids = itertools.count(1)
-        self._spans_started = 0
-        self._spans_closed = 0
-        self._dropped = 0
-        self._sample_rate = self._env_sample_rate()
-        self._sample_acc = 0.0
+        self._spans_started = 0   #: guarded by _lock
+        self._spans_closed = 0    #: guarded by _lock
+        self._dropped = 0         #: guarded by _lock
+        self._sample_rate = self._env_sample_rate()  #: guarded by _lock
+        self._sample_acc = 0.0    #: guarded by _lock
 
     @staticmethod
     def _env_sample_rate() -> float:
@@ -254,6 +255,7 @@ class Tracer:
                                  "ts": time.perf_counter(),
                                  "args": dict(values)})
 
+    # lock: holds(_lock)
     def _append_locked(self, event) -> None:
         if len(self._events) >= self._max_events:
             self._dropped += 1
@@ -300,12 +302,14 @@ class RequestTrace:
         self.tracer = tracer
         self.trace_id = tracer.new_trace_id()
         self.lane = f"lane:{lane}"
+        # span: closed-by(RequestTrace.close)
         self.root = tracer.begin("serve.request", trace_id=self.trace_id,
                                  track=self.lane, args=args)
         self.open: Dict[str, Span] = {}
 
     def begin(self, name: str, track: Optional[str] = None,
               args: Optional[dict] = None) -> Span:
+        # span: closed-by(RequestTrace.finish)
         sp = self.tracer.begin(name, trace_id=self.trace_id,
                                parent=self.root,
                                track=track or self.lane, args=args)

@@ -47,7 +47,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..errors import InvalidParameterError, ParameterMismatchError
-from ..indexing import (IndexPlan, build_index_plan, check_stick_duplicates,
+from ..indexing import (build_index_plan, check_stick_duplicates,
                         occupied_x_window, window_sub_cols)
 from ..ops import stages
 from ..timing import timed_transform
@@ -60,7 +60,7 @@ from .exchange import (all_to_all_blocks, build_compact_schedule,
                        ragged_exchange, pack_freq_to_blocks,
                        pack_space_to_blocks, ring_exchange_blocks,
                        unpack_blocks_to_grid, unpack_blocks_to_sticks)
-from .mesh import SHARD_AXIS, make_mesh, shard_map
+from .mesh import make_mesh, shard_map
 from .overlap import build_overlap_schedule
 
 #: Environment default for the plan's ``overlap_chunks`` knob: split the

@@ -237,6 +237,7 @@ class _BucketTrace:
         a = {"member_trace_ids": list(self.ids)}
         if args:
             a.update(args)
+        # span: closed-by(_BucketTrace.end_all)
         self.open[name] = self.tracer.begin(
             name, trace_id=self.trace_id, parent=self.parent,
             track=track, args=a)
@@ -407,18 +408,19 @@ class ServeExecutor:
             import jax
             devices = list(jax.devices())
         self._devices = list(devices) if devices else [None]
-        self._rotor = 0
+        self._rotor = 0          #: guarded by _pool_lock
         self._auto_extra: Optional[int] = None
         self._batching = bool(batching)
         self._faults = fault_plan
         self._max_restarts = int(max_dispatch_restarts)
         self._prewarm_on_pin = bool(prewarm_on_pin)
         self._pool_lock = threading.Lock()
+        #: guarded by _pool_lock
         self._slots = [_DeviceSlot(d, i, self._q_backoff)
                        for i, d in enumerate(self._devices)]
-        self._shards: Dict[tuple, _Shard] = {}
-        self._pending = 0
-        self._high_pending = 0
+        self._shards: Dict[tuple, _Shard] = {}  #: guarded by _cv
+        self._pending = 0        #: guarded by _cv
+        self._high_pending = 0   #: guarded by _cv
         # GIL-atomic arrival counter: requests are stamped BEFORE the
         # queue lock so Future/request construction never extends the
         # lock hold; heap ties only need uniqueness + rough arrival
@@ -438,11 +440,11 @@ class ServeExecutor:
         # futures instead of stranding them in dead local variables
         self._inflight: "collections.deque" = collections.deque()
         self._forming: Optional[List[_Request]] = None
-        self._restarts = 0
-        self._failed = False
+        self._restarts = 0       #: guarded by _cv
+        self._failed = False     #: guarded by _cv
         self._cv = threading.Condition()
-        self._closed = False
-        self._thread: Optional[threading.Thread] = None
+        self._closed = False     #: guarded by _cv
+        self._thread: Optional[threading.Thread] = None  #: guarded by _cv
         # zero-cold-start boot: prewarm every manifest-listed plan
         # artifact (load + compile) BEFORE the dispatcher accepts work
         import os as _os
@@ -742,6 +744,7 @@ class ServeExecutor:
                            timeout=timeout, priority=priority)
 
     # -- scheduling (caller holds the lock) --------------------------------
+    # lock: holds(_cv)
     def _purge_expired_locked(self, now: float) -> List[_Request]:
         """Reap queued requests whose deadline has already passed
         (caller holds the lock; futures resolve OUTSIDE it). Runs only
@@ -764,6 +767,7 @@ class ServeExecutor:
                                       if r.priority == "high")
         return reaped
 
+    # lock: holds(_cv)
     def _select_shard(self) -> Optional[_Shard]:
         """The shard whose head request is most urgent: high lane before
         normal, then earliest deadline, then arrival order. O(#active
@@ -776,6 +780,7 @@ class ServeExecutor:
                 best, best_rank = shard, rank
         return best
 
+    # lock: holds(_cv)
     def _pop_into(self, shard: _Shard, bucket: List[_Request],
                   limit: int) -> None:
         """Move up to ``limit - len(bucket)`` requests from the shard's
@@ -791,6 +796,7 @@ class ServeExecutor:
                 if req.trace is not None:
                     req.trace.finish("serve.queue_wait")
 
+    # lock: holds(_cv)
     def _earliest_deadline(self) -> float:
         """The soonest deadline among ALL queued requests (inf when
         none) — lane heads are heap minima, so this is O(#shards)."""
@@ -933,12 +939,18 @@ class ServeExecutor:
             # else queued after the take): under backlog the queued
             # requests are already late and a window wait just adds
             # latency without improving fill — the take itself drains
-            # every same-key request the shard holds.
-            if len(bucket) < self._max_batch and depth_now == 0 \
-                    and self._batching and self._batch_window > 0 \
-                    and not self._closed:
-                self._fill_bucket(shard, bucket)
+            # every same-key request the shard holds. The window wait
+            # runs INSIDE the bucket trace's protective try: a crash
+            # anywhere between formation-begin and execute must close
+            # the bucket spans (the supervisor settles request traces,
+            # not bucket traces — the static span-closure pass found
+            # this window).
             try:
+                # lock: waived(benign racy pre-check - _fill_bucket re-reads _closed under the cv before waiting)
+                if len(bucket) < self._max_batch and depth_now == 0 \
+                        and self._batching and self._batch_window > 0 \
+                        and not self._closed:
+                    self._fill_bucket(shard, bucket)
                 work = self._execute(shard, bucket, bt)
             except BaseException:
                 if bt is not None:
@@ -966,6 +978,7 @@ class ServeExecutor:
             self.metrics.record_dequeue(depth_now)
             bt = self._bucket_trace(bucket)
             if bt is not None:
+                # span: closed-by(ServeExecutor._execute)
                 bt.begin("serve.bucket_formation")
             work = self._execute(shard, bucket, bt)
             if work is not None:
@@ -1001,6 +1014,7 @@ class ServeExecutor:
                     "serve.probation", track=_dev_track(probed),
                     args={"backoff_s": probed.backoff})
             return probed
+        # lock: waived(pool list is append-never after __init__ - diagnostic count only)
         raise NoHealthyDeviceError(
             f"all {len(self._slots)} pool devices are quarantined and "
             f"none is due for probation")

@@ -84,53 +84,59 @@ class ServeMetrics:
         error, but its samples land on whichever side of the reset the
         lock decides."""
         with self._lock:
+            #: guarded by _lock
             self._latencies: Dict[str, collections.deque] = {
                 cls: collections.deque(maxlen=self._window)
                 for cls in PRIORITY_CLASSES}
+            #: guarded by _lock
             self._completed_by: Dict[str, int] = {
                 cls: 0 for cls in PRIORITY_CLASSES}
-            self._fused_hist: Dict[int, int] = {}
-            self._serial_hist: Dict[int, int] = {}
-            self._fused_batches = 0
-            self._serial_batches = 0
-            self._fused_rows = 0
-            self._padded_rows = 0
-            self._pinned_batches = 0
+            self._fused_hist: Dict[int, int] = {}   #: guarded by _lock
+            self._serial_hist: Dict[int, int] = {}  #: guarded by _lock
+            self._fused_batches = 0     #: guarded by _lock
+            self._serial_batches = 0    #: guarded by _lock
+            self._fused_rows = 0        #: guarded by _lock
+            self._padded_rows = 0       #: guarded by _lock
+            self._pinned_batches = 0    #: guarded by _lock
             # control-plane signal reservoirs (recent window):
             # queue-wait is enqueue -> dispatch per request (includes
             # the batching window a request sat out), device-execute is
             # dispatch -> materialised per bucket
+            #: guarded by _lock
             self._queue_waits: collections.deque = collections.deque(
                 maxlen=DEFAULT_SIGNAL_WINDOW)
+            #: guarded by _lock
             self._device_exec: collections.deque = collections.deque(
                 maxlen=DEFAULT_SIGNAL_WINDOW)
-            self._stage_s = 0.0
-            self._dispatch_s = 0.0
-            self._completed = 0
-            self._failed = 0
-            self._rejected_queue_full = 0
-            self._expired_deadline = 0
-            self._purged_expired = 0
-            self._queue_depth = 0
-            self._max_queue_depth = 0
+            self._stage_s = 0.0             #: guarded by _lock
+            self._dispatch_s = 0.0          #: guarded by _lock
+            self._completed = 0             #: guarded by _lock
+            self._failed = 0                #: guarded by _lock
+            self._rejected_queue_full = 0   #: guarded by _lock
+            self._expired_deadline = 0      #: guarded by _lock
+            self._purged_expired = 0        #: guarded by _lock
+            self._queue_depth = 0           #: guarded by _lock
+            self._max_queue_depth = 0       #: guarded by _lock
             # failure-handling counters (fault tolerance layer)
-            self._retries = 0
-            self._retries_exhausted = 0
+            self._retries = 0               #: guarded by _lock
+            self._retries_exhausted = 0     #: guarded by _lock
+            #: guarded by _lock
             self._retries_by: Dict[str, int] = {
                 cls: 0 for cls in PRIORITY_CLASSES}
+            #: guarded by _lock
             self._retries_exhausted_by: Dict[str, int] = {
                 cls: 0 for cls in PRIORITY_CLASSES}
-            self._bucket_fallbacks = 0
-            self._quarantines = 0
-            self._probations = 0
-            self._readmissions = 0
-            self._no_healthy_device = 0
-            self._dispatcher_crashes = 0
-            self._dispatcher_restarts = 0
-            self._pin_prewarms = 0
-            self._request_attributed_failures = 0
-            self._slo_violations: tuple = ()
-            self._health_state = "healthy"
+            self._bucket_fallbacks = 0      #: guarded by _lock
+            self._quarantines = 0           #: guarded by _lock
+            self._probations = 0            #: guarded by _lock
+            self._readmissions = 0          #: guarded by _lock
+            self._no_healthy_device = 0     #: guarded by _lock
+            self._dispatcher_crashes = 0    #: guarded by _lock
+            self._dispatcher_restarts = 0   #: guarded by _lock
+            self._pin_prewarms = 0          #: guarded by _lock
+            self._request_attributed_failures = 0  #: guarded by _lock
+            self._slo_violations: tuple = ()       #: guarded by _lock
+            self._health_state = "healthy"         #: guarded by _lock
 
     # -- recording (executor-facing) ---------------------------------------
     def record_enqueue(self, depth: int) -> None:
@@ -313,6 +319,7 @@ class ServeMetrics:
         with self._lock:
             return max(self._fused_hist, default=0)
 
+    # lock: holds(_lock)
     def _health_locked(self) -> Dict:
         """Caller holds the lock — shared by :meth:`health` and the
         single-lock :meth:`snapshot`. The reported state is the

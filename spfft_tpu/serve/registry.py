@@ -207,30 +207,32 @@ class PlanRegistry:
             self._disk = store
         self._max_bytes = int(max_bytes)
         self._max_plans = int(max_plans)
+        #: guarded by _lock
         self._store: "collections.OrderedDict[PlanSignature, Tuple[TransformPlan, int]]" = \
             collections.OrderedDict()
-        self._bytes = 0
+        self._bytes = 0      #: guarded by _lock
         self._lock = threading.Lock()
-        self._hits = 0
-        self._misses = 0
-        self._evictions = 0
-        self._builds = 0
-        self._fast_hits = 0
+        self._hits = 0       #: guarded by _lock
+        self._misses = 0     #: guarded by _lock
+        self._evictions = 0  #: guarded by _lock
+        self._builds = 0     #: guarded by _lock
+        self._fast_hits = 0  #: guarded by _lock
         # raw-bytes -> canonical-signature memo (the get_or_build fast
         # path: a hit skips build_index_plan entirely). Keyed by the
         # scalar request tuple; each key holds (triplet snapshot, sig)
         # candidates verified by exact byte comparison. Bounded by
         # entry count AND snapshot bytes. Per-key singleflight build
         # locks serialise concurrent misses (one build per shape).
+        #: guarded by _lock
         self._sig_memo: "collections.OrderedDict[tuple, List[Tuple[np.ndarray, PlanSignature]]]" = \
             collections.OrderedDict()
         self._sig_memo_cap = max(64, 4 * self._max_plans)
-        self._sig_memo_bytes = 0
-        self._build_flights: Dict[tuple, "_BuildFlight"] = {}
-        self._build_failures = 0
-        self._store_hits = 0
-        self._store_misses = 0
-        self._store_spills = 0
+        self._sig_memo_bytes = 0  #: guarded by _lock
+        self._build_flights: Dict[tuple, "_BuildFlight"] = {}  #: guarded by _lock
+        self._build_failures = 0  #: guarded by _lock
+        self._store_hits = 0      #: guarded by _lock
+        self._store_misses = 0    #: guarded by _lock
+        self._store_spills = 0    #: guarded by _lock
 
     @property
     def store(self):
@@ -300,6 +302,7 @@ class PlanRegistry:
                 self._bytes -= b
                 self._evictions += 1
 
+    # lock: holds(_lock)
     def _fast_lookup_locked(self, memo_key, arr: np.ndarray):
         """Memoed (signature, plan) for a raw request, or None. Caller
         holds the lock. Candidates under the key are verified by exact

@@ -456,10 +456,11 @@ class PlanArtifactStore:
         self.root = str(root)
         self._max_bytes = max_bytes
         self._lock = threading.Lock()
-        self._hits = 0
-        self._misses = 0
-        self._spills = 0
-        self._rejects: Dict[str, int] = {}
+        self._hits = 0    #: guarded by _lock
+        self._misses = 0  #: guarded by _lock
+        self._spills = 0  #: guarded by _lock
+        self._rejects: Dict[str, int] = {}  #: guarded by _lock
+        #: guarded by _lock
         self._spill_threads: List[threading.Thread] = []
         os.makedirs(self._dir("artifacts"), exist_ok=True)
         os.makedirs(self._dir("requests"), exist_ok=True)
@@ -802,7 +803,7 @@ class PlanArtifactStore:
 
 
 # -- process-default store resolution ----------------------------------------
-_DEFAULT_STORES: Dict[str, PlanArtifactStore] = {}
+_DEFAULT_STORES: Dict[str, PlanArtifactStore] = {}  #: guarded by _DEFAULT_LOCK
 _DEFAULT_LOCK = threading.Lock()
 
 

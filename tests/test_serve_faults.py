@@ -787,3 +787,39 @@ def test_probation_canary_poisoned_leaves_verdict_open():
     assert h["devices"][0]["state"] == "healthy"
     assert h["readmissions"] == 1
     ex.close()
+
+
+# -- span closure during the window wait (static-analysis follow-up) --------
+def test_crash_during_window_wait_closes_bucket_spans(monkeypatch):
+    """Regression for the window the span-closure checker exposed: a
+    dispatcher crash BETWEEN bucket-formation-begin and _execute's
+    protective try (i.e. inside the batching-window wait) must close
+    the bucket trace spans — the crash supervisor settles request
+    traces but knows nothing of _BucketTrace handles. Before the fix
+    the serve.bucket_formation span leaked open."""
+    from spfft_tpu import obs
+    from spfft_tpu.errors import ExecutorCrashedError
+
+    reg, (sig,) = _registry_with([1])
+    rng = np.random.default_rng(5)
+    obs.enable()
+    obs.GLOBAL_TRACER.reset()
+    obs.GLOBAL_TRACER.set_sample_rate(1.0)
+    try:
+        ex = ServeExecutor(reg, autostart=False, batch_window=0.05,
+                           max_dispatch_restarts=0)
+
+        def boom(self, shard, bucket):
+            raise RuntimeError("window wait crashed")
+
+        monkeypatch.setattr(ServeExecutor, "_fill_bucket", boom)
+        fut = ex.submit(sig, _values_for(reg, sig, rng))
+        ex.start()
+        with pytest.raises(ExecutorCrashedError):
+            fut.result(timeout=30)
+        ex.close()
+        assert obs.GLOBAL_TRACER.open_count() == 0, \
+            obs.GLOBAL_TRACER.open_names()
+    finally:
+        obs.disable()
+        obs.GLOBAL_TRACER.reset()
