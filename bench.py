@@ -72,6 +72,116 @@ def split_outlier_sessions(values):
     return kept, [v for v in values if v > cut]
 
 
+def symmetry_rows() -> dict:
+    """The hermitian-symmetry sub-rows, computed in a forced-CPU
+    subprocess (fresh interpreter: the accounting is backend-independent
+    and must not claim this process's backend):
+
+    * ``wire_bytes_r2c`` — table-derived aggregate exchange wire bytes
+      of the trimmed R2C distributed plan on the flagship spherical
+      workload (deterministic accounting, no execution);
+    * ``fused_r2c`` — how many of the two r2c fused seams (local
+      backward kernel + distributed pre-exchange twin) are ACTIVE on
+      the interpret lane (deterministic; 2 = the r2c decline stays
+      lifted).
+
+    Returns {} (with a stderr note) if the probe subprocess fails —
+    the primary measurement must not die on an accounting row.
+    """
+    env = dict(os.environ, SPFFT_BENCH_SYMMETRY_INNER="1",
+               JAX_PLATFORMS="cpu",
+               SPFFT_TPU_FORCE_MATMUL_DFT="1",
+               SPFFT_TPU_FUSED_INTERPRET="1")
+    proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                          capture_output=True, text=True, env=env)
+    line = next((ln for ln in reversed(proc.stdout.splitlines())
+                 if ln.startswith("{")), None)
+    if proc.returncode != 0 or line is None:
+        sys.stderr.write("symmetry sub-row probe failed (rows omitted):\n"
+                         + proc.stdout[-1000:] + proc.stderr[-1000:])
+        return {}
+    return json.loads(line)
+
+
+def symmetry_inner() -> None:
+    """SPFFT_BENCH_SYMMETRY_INNER=1: compute the symmetry sub-rows on a
+    virtual-CPU backend and print them as one JSON line."""
+    from spfft_tpu.utils.platform import force_virtual_cpu_devices
+    force_virtual_cpu_devices(2)
+    from spfft_tpu import TransformType, make_local_plan
+    from spfft_tpu.parallel import make_distributed_plan, make_mesh
+    from spfft_tpu.parallel.dist import build_distributed_plan
+    from spfft_tpu.parallel.exchange import build_ragged_schedule
+    from spfft_tpu.utils.workloads import (
+        even_plane_split, round_robin_stick_partition,
+        sort_triplets_stick_major, spherical_cutoff_triplets)
+
+    # --- wire_bytes_r2c: host-side accounting only, no device work ---
+    n = int(os.environ.get("SPFFT_BENCH_DIM", "256"))
+    shards = 8
+    full = spherical_cutoff_triplets(n)
+    x, y, z = full[:, 0], full[:, 1], full[:, 2]
+    # the non-redundant hermitian half: x > 0 plus the x = 0 plane's
+    # canonical half-spectrum (docs/distributed.md "Hermitian symmetry")
+    half = full[(x > 0) | ((x == 0) & ((y > 0) | ((y == 0) & (z >= 0))))]
+    planes = even_plane_split(n, shards)
+    dims = (n, n, n)
+    elem = 8  # complex64 wire
+    r2c_wire = build_ragged_schedule(build_distributed_plan(
+        TransformType.R2C, n, n, n,
+        round_robin_stick_partition(half, dims, shards),
+        planes)).wire_elements() * elem
+    c2c_wire = build_ragged_schedule(build_distributed_plan(
+        TransformType.C2C, n, n, n,
+        round_robin_stick_partition(full, dims, shards),
+        planes)).wire_elements() * elem
+
+    # --- fused_r2c: the two r2c fused seams on the interpret lane ---
+    fd = (8, 6, 128)  # dim_z % 128 == 0: fused eligibility floor
+    xs, ys, zs = (np.arange(0, fd[0] // 2),
+                  np.arange(-(fd[1] // 2 - 1), fd[1] // 2 + 1),
+                  np.arange(-(fd[2] // 2 - 1), fd[2] // 2 + 1))
+    X, Y, Z = np.meshgrid(xs, ys, zs, indexing="ij")
+    t = np.stack([X.ravel(), Y.ravel(), Z.ravel()], axis=1)
+    t = t[(t[:, 0] > 0) | ((t[:, 1] > 0) | ((t[:, 1] == 0)
+                                            & (t[:, 2] >= 0)))]
+    t = sort_triplets_stick_major(t, fd)
+    local = make_local_plan(TransformType.R2C, *fd, t,
+                            precision="single", use_pallas=True)
+    dist = make_distributed_plan(
+        TransformType.R2C, *fd,
+        [sort_triplets_stick_major(p, fd)
+         for p in round_robin_stick_partition(t, fd, 2)],
+        even_plane_split(fd[2], 2), mesh=make_mesh(2),
+        precision="single", use_pallas=True)
+    active = int(bool(local.fused_active)) + int(bool(
+        dist.fused_dist_active))
+
+    print(json.dumps({
+        "wire_bytes_r2c": {
+            "metric": f"{n}^3 spherical-cutoff R2C distributed exchange "
+                      f"aggregate wire bytes ({shards} shards, compact "
+                      f"schedule, table-derived accounting): hermitian-"
+                      f"trimmed non-redundant stick set "
+                      f"({len(half)} of {len(full)} values; untrimmed "
+                      f"C2C wire {c2c_wire} B, ratio "
+                      f"{r2c_wire / c2c_wire:.3f})",
+            "value": int(r2c_wire),
+            "unit": "bytes",
+        },
+        "fused_r2c": {
+            "metric": "r2c fused seams ACTIVE on the interpret lane "
+                      "(local decompress+z-DFT backward kernel + "
+                      "distributed pre-exchange twin; 2 = the "
+                      "hermitian_completion decline stays lifted, "
+                      f"fallbacks: local={local.fused_fallback_reasons} "
+                      f"dist={dist.fused_dist_fallback_reason})",
+            "value": active,
+            "unit": "seams",
+        },
+    }))
+
+
 def run_sessions(k: int) -> None:
     """Run the measurement in k fresh subprocesses (each gets its own
     backend session) and emit the best session's JSON with the per-session
@@ -105,6 +215,7 @@ def run_sessions(k: int) -> None:
                        f"{baseline_s:.3f}s)")
     best["vs_baseline"] = (round(baseline_s / best["value"], 3)
                            if baseline_s else 0.0)
+    best.update(symmetry_rows())
     print(json.dumps(best))
 
 
@@ -157,6 +268,8 @@ def cpu_baseline_pair_seconds(plan, values: np.ndarray, reps: int = 2) -> float:
 
 
 def main() -> None:
+    if os.environ.get("SPFFT_BENCH_SYMMETRY_INNER") == "1":
+        return symmetry_inner()
     k = int(os.environ.get("SPFFT_BENCH_SESSIONS", "4"))
     if "SPFFT_BENCH_INNER" not in os.environ and k > 1:
         return run_sessions(k)
@@ -257,6 +370,8 @@ def main() -> None:
         "unit": "s",
         "vs_baseline": round(baseline_s / pair_s, 3) if baseline_s else 0.0,
     }
+    if "SPFFT_BENCH_INNER" not in os.environ:
+        result.update(symmetry_rows())  # single-session direct run
     print(json.dumps(result))
 
 

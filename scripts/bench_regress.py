@@ -52,7 +52,16 @@ LOWER_IS_BETTER_UNITS = ("s", "ms", "us", "ns", "seconds", "bytes")
 #: from BENCH_r06.json on) guard the zero-cold-start trajectory the
 #: round-13 plan-artifact store opened; "ms" units regress when the
 #: fresh value is higher, like every seconds-like row.
-SUB_ROWS = ("fused", "cold_start_ms", "warm_start_ms")
+#: wire_bytes_r2c (unit "bytes", lower is better) is the hermitian-
+#: trimmed R2C distributed exchange's table-derived aggregate wire on
+#: the flagship spherical workload — deterministic accounting, so any
+#: growth past threshold means the trimming regressed. fused_r2c
+#: (unit "seams", higher is better) counts the ACTIVE r2c fused seams
+#: on the interpret lane (local kernel + distributed twin, 2 when the
+#: hermitian_completion decline stays lifted); a drop below 2 trips
+#: the rate-direction comparison. Both emitted by bench.py every run.
+SUB_ROWS = ("fused", "cold_start_ms", "warm_start_ms",
+            "wire_bytes_r2c", "fused_r2c")
 
 
 def load_payload(path: str) -> dict:

@@ -17,6 +17,13 @@ declaration, the env-var spellings and the operator docs in sync:
    knob's canonical ``SPFFT_TPU_<KNOB>`` spelling (edit distance 1-2,
    not exact) is flagged — the typo'd-env-that-silently-does-nothing
    failure mode.
+4. **Controller coverage** — the controller's ``MANAGED_KNOBS``
+   declaration and its feedback rules must agree: a managed knob no
+   ``_retune(...)`` call ever moves (the idle decay walks it but
+   nothing drives it away from default — dead management), or a rule
+   moving a knob missing from ``MANAGED_KNOBS`` (it never decays back
+   on idle), is a finding; so is a managed name that is not a declared
+   knob at all.
 """
 
 from __future__ import annotations
@@ -158,6 +165,40 @@ def _doc_rows(doc_text: str) -> Dict[str, Tuple[str, int]]:
     return rows
 
 
+def _find_managed(index: PackageIndex):
+    """The controller's ``MANAGED_KNOBS`` declaration: the module, the
+    declared (name, lineno) entries, and the knob-name literals passed
+    to ``self._retune(out, "<knob>", ...)`` anywhere in that module.
+    Returns None when no module declares MANAGED_KNOBS (fixture indexes
+    without a controller stay out of section 4)."""
+    for mod in index.modules.values():
+        for stmt in mod.tree.body:
+            if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            if not any(isinstance(t, ast.Name)
+                       and t.id == "MANAGED_KNOBS" for t in targets):
+                continue
+            entries: List[Tuple[str, int]] = []
+            if isinstance(stmt.value, (ast.Tuple, ast.List)):
+                for el in stmt.value.elts:
+                    if isinstance(el, ast.Constant) \
+                            and isinstance(el.value, str):
+                        entries.append((el.value, el.lineno))
+            retuned: Dict[str, int] = {}
+            for node in ast.walk(mod.tree):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "_retune"
+                        and len(node.args) >= 2
+                        and isinstance(node.args[1], ast.Constant)
+                        and isinstance(node.args[1].value, str)):
+                    retuned.setdefault(node.args[1].value, node.lineno)
+            return mod, entries, retuned
+    return None
+
+
 def _num(cell: str) -> Optional[float]:
     cell = cell.strip().strip("`")
     try:
@@ -286,4 +327,33 @@ def check(index: PackageIndex,
                     f"knob reference table row {name!r} matches no "
                     f"declared knob or path setting (stale docs?)"))
 
-    return findings, {"knobs": len(decls), "path_settings": len(paths)}
+    # 4 — controller coverage: MANAGED_KNOBS vs the _retune rules
+    managed_count = 0
+    managed = _find_managed(index)
+    if managed is not None:
+        cmod, entries, retuned = managed
+        managed_count = len(entries)
+        declared = {d.name for d in decls}
+        managed_names = {name for name, _ in entries}
+        for name, lineno in entries:
+            if name not in declared:
+                findings.append(Finding(
+                    CHECKER, "error", cmod.relpath, lineno,
+                    f"MANAGED_KNOBS entry {name!r} is not a declared "
+                    f"knob in KNOB_SPECS"))
+            elif name not in retuned:
+                findings.append(Finding(
+                    CHECKER, "error", cmod.relpath, lineno,
+                    f"managed knob {name!r} has no controller rule — "
+                    f"no _retune(...) call ever moves it, so the idle "
+                    f"decay manages a knob nothing drives"))
+        for name, lineno in sorted(retuned.items()):
+            if name not in managed_names:
+                findings.append(Finding(
+                    CHECKER, "error", cmod.relpath, lineno,
+                    f"controller rule moves knob {name!r} which is "
+                    f"not in MANAGED_KNOBS — it will never decay back "
+                    f"to default on idle"))
+
+    return findings, {"knobs": len(decls), "path_settings": len(paths),
+                      "managed_knobs": managed_count}

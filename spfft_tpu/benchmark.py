@@ -176,20 +176,32 @@ def _exchange_sweep(args, dims, ttype, triplets, rng, cdt) -> int:
         jax.block_until_ready(out)
         np.asarray(jax.tree_util.tree_leaves(out)[-1]).ravel()[:1]
         pair_s = (time.perf_counter() - t0) / args.repeats
+        # Hermitian trimming state, disclosed per row: an r2c plan's
+        # exchange ships only the non-redundant stick set, so its wire
+        # column is NOT comparable against a c2c (untrimmed) sweep of
+        # the same sphere without this tag (docs/distributed.md).
+        folded = sum(int(sp.value_conj.sum())
+                     for sp in plan.dist_plan.shard_plans
+                     if sp.value_conj is not None)
         rows.append({
             "exchange": name,
             "overlap_chunks": plan.overlap_chunks,
             "pair_seconds": round(pair_s, 6),
             "wire_total_bytes": int(plan.exchange_wire_bytes()),
             "busiest_link_bytes": int(plan.exchange_busiest_link_bytes()),
+            "hermitian_trimmed": bool(plan.dist_plan.hermitian),
+            "folded_mirror_values": folded,
         })
     hdr = (f"{'exchange':>14s} {'pair ms':>10s} {'wire total MB':>14s} "
-           f"{'busiest link MB':>16s}")
+           f"{'busiest link MB':>16s} {'stick set':>18s}")
     print(hdr)
     for r in rows:
+        trim = ("r2c-trimmed" + (f"(+{r['folded_mirror_values']}f)"
+                                 if r["folded_mirror_values"] else "")
+                if r["hermitian_trimmed"] else "untrimmed")
         print(f"{r['exchange']:>14s} {r['pair_seconds'] * 1e3:10.3f} "
               f"{r['wire_total_bytes'] / 1e6:14.3f} "
-              f"{r['busiest_link_bytes'] / 1e6:16.3f}")
+              f"{r['busiest_link_bytes'] / 1e6:16.3f} {trim:>18s}")
     if args.output:
         payload = {
             "parameters": {

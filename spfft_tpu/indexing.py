@@ -47,7 +47,12 @@ def to_storage_index(dim: int, index: np.ndarray) -> np.ndarray:
 def _check_triplet_bounds(hermitian: bool, centered: bool,
                           dim_x: int, dim_y: int, dim_z: int,
                           x: np.ndarray, y: np.ndarray, z: np.ndarray) -> None:
-    """Bounds validation, exactly as reference indices.hpp:137-149."""
+    """Bounds validation, exactly as reference indices.hpp:137-149.
+
+    Runs AFTER :func:`canonicalize_hermitian_triplets`, so hermitian sets
+    reaching it always satisfy x >= 0 — the x < 0 half of a redundant
+    (Gamma-style full-sphere) set has already been folded onto its
+    conjugate mirror sticks."""
     max_x = (dim_x // 2 + 1 if (hermitian or centered) else dim_x) - 1
     max_y = (dim_y // 2 + 1 if centered else dim_y) - 1
     max_z = (dim_z // 2 + 1 if centered else dim_z) - 1
@@ -62,16 +67,58 @@ def _check_triplet_bounds(hermitian: bool, centered: bool,
             f"hermitian={hermitian}, centered={centered}")
 
 
+def canonicalize_hermitian_triplets(dim_x: int, dim_y: int, dim_z: int,
+                                    x: np.ndarray, y: np.ndarray,
+                                    z: np.ndarray):
+    """Fold the redundant x < 0 half of a hermitian frequency set onto
+    its conjugate-mirror triplets (reference ``symmetry-GPU`` layer:
+    F(-x,-y,-z) = conj(F(x,y,z)) for real fields, so a Gamma-style full
+    sphere carries each value twice).
+
+    Every triplet with x < 0 maps to (-x, -y, -z) with a per-value
+    conjugate flag; the plan then stores, transforms, and — critically —
+    EXCHANGES only the non-redundant stick set (the distributed wire
+    halving), while the existing post-exchange completions
+    (:func:`~spfft_tpu.ops.stages.complete_plane_hermitian` /
+    ``complete_stick_hermitian``) and the implicit mirror half of the
+    r2c x-stage matrices reconstruct the rest. Triplets with x >= 0 are
+    untouched, so every previously-valid hermitian set builds a
+    byte-identical plan.
+
+    Returns ``(x, y, z, conj)`` with ``conj`` a boolean per-value mask
+    (None when nothing was folded). The frequency negation keeps centered
+    bounds except at the even-dimension edge -N/2, whose mirror +N/2 is
+    the SAME storage index — normalised here so the bounds check (which
+    rejects a user-supplied -N/2, matching the reference) still accepts
+    the mirror of a valid edge value.
+    """
+    neg = x < 0
+    if not neg.any():
+        return x, y, z, None
+
+    def mirror(v, dim):
+        mv = np.where(neg, -v, v)
+        return np.where(neg & (2 * v == dim), -(dim // 2), mv)
+
+    return (np.where(neg, -x, x), mirror(y, dim_y), mirror(z, dim_z),
+            neg)
+
+
 def convert_index_triplets(hermitian: bool, dim_x: int, dim_y: int, dim_z: int,
                            triplets: np.ndarray):
     """Convert (n, 3) index triplets into per-value flat indices and the
     ordered unique stick-key list.
 
-    Returns ``(value_indices, stick_keys, centered)`` where
-    ``value_indices[i] = stick_id(i) * dim_z + z_storage(i)`` and
-    ``stick_keys`` is the ascending list of unique ``x*dim_y + y`` keys.
+    Returns ``(value_indices, stick_keys, centered, conj)`` where
+    ``value_indices[i] = stick_id(i) * dim_z + z_storage(i)``,
+    ``stick_keys`` is the ascending list of unique ``x*dim_y + y`` keys,
+    and ``conj`` is the per-value conjugate mask of
+    :func:`canonicalize_hermitian_triplets` (None when no hermitian
+    folding happened).
 
-    Semantics of reference indices.hpp:120-186, vectorised.
+    Semantics of reference indices.hpp:120-186, vectorised; hermitian
+    sets may additionally carry the redundant x < 0 half, which is
+    canonicalised onto conjugate-mirror sticks first.
     """
     triplets = np.asarray(triplets)
     if triplets.ndim != 2 or triplets.shape[1] != 3:
@@ -85,14 +132,21 @@ def convert_index_triplets(hermitian: bool, dim_x: int, dim_y: int, dim_z: int,
         raise InvalidParameterError(
             "more frequency values than grid elements (indices.hpp:126-128)")
 
-    from . import native
-    res = native.plan_indices(hermitian, dim_x, dim_y, dim_z, triplets)
-    if res is not None:
-        return res
-
     x, y, z = (triplets[:, 0].astype(np.int64), triplets[:, 1].astype(np.int64),
                triplets[:, 2].astype(np.int64))
     centered = bool((triplets < 0).any())
+    conj = None
+    if hermitian and (x < 0).any():
+        x, y, z, conj = canonicalize_hermitian_triplets(
+            dim_x, dim_y, dim_z, x, y, z)
+    else:
+        # The native core predates hermitian folding (it rejects x < 0 for
+        # hermitian, matching the reference) — only un-folded sets take it.
+        from . import native
+        res = native.plan_indices(hermitian, dim_x, dim_y, dim_z, triplets)
+        if res is not None:
+            return res + (None,)
+
     _check_triplet_bounds(hermitian, centered, dim_x, dim_y, dim_z, x, y, z)
 
     xs = to_storage_index(dim_x, x)
@@ -103,7 +157,7 @@ def convert_index_triplets(hermitian: bool, dim_x: int, dim_y: int, dim_z: int,
     stick_keys, stick_ids = np.unique(keys, return_inverse=True)
     value_indices = stick_ids.astype(np.int64) * dim_z + zs
     return (value_indices.astype(np.int32), stick_keys.astype(np.int32),
-            centered)
+            centered, conj)
 
 
 def check_stick_duplicates(stick_keys_per_shard: Sequence[np.ndarray]) -> None:
@@ -135,6 +189,12 @@ class IndexPlan:
     value_indices: np.ndarray
     #: ascending unique ``x*dim_y + y`` stick keys (indices.hpp:179-185)
     stick_keys: np.ndarray
+    #: per-value conjugate mask from hermitian x < 0 folding
+    #: (:func:`canonicalize_hermitian_triplets`), or None when the user's
+    #: triplets were already non-redundant. Marked values are read and
+    #: written through a conjugation: backward conjugates them before
+    #: decompress, forward conjugates the compressed output.
+    value_conj: Optional[np.ndarray] = None
 
     @property
     def num_values(self) -> int:
@@ -341,7 +401,7 @@ def build_index_plan(transform_type: TransformType,
     check_size_overflow(dim_x, dim_y, dim_z)
     transform_type = TransformType(transform_type)
     hermitian = transform_type == TransformType.R2C
-    value_indices, stick_keys, centered = convert_index_triplets(
+    value_indices, stick_keys, centered, value_conj = convert_index_triplets(
         hermitian, dim_x, dim_y, dim_z, triplets)
     # Stick-slot space and per-value flat indices are int32 tables
     # (value_indices, slot_src); num_sticks is known only after the
@@ -358,4 +418,5 @@ def build_index_plan(transform_type: TransformType,
             f"compression gather tables")
     return IndexPlan(transform_type=transform_type, dim_x=dim_x, dim_y=dim_y,
                      dim_z=dim_z, centered=centered,
-                     value_indices=value_indices, stick_keys=stick_keys)
+                     value_indices=value_indices, stick_keys=stick_keys,
+                     value_conj=value_conj)

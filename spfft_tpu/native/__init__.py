@@ -27,7 +27,9 @@ _SRC = os.path.join(_DIR, "planner.cpp")
 _LIB = os.path.join(_DIR, f"_planner_{sys.implementation.cache_tag}.so")
 
 _lock = threading.Lock()
+#: guarded by _lock
 _lib: Optional[ctypes.CDLL] = None
+#: guarded by _lock
 _load_failed = False
 
 
@@ -83,8 +85,10 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
 def _load() -> Optional[ctypes.CDLL]:
     """Compile (if stale) and load the native library; None if unavailable."""
     global _lib, _load_failed
+    # lock: waived(double-checked fast path - _lib is write-once under _lock and a stale None just falls through to the locked slow path)
     if _lib is not None:
-        return _lib
+        return _lib  # lock: waived(same benign race - the handle is immutable once published)
+    # lock: waived(racy pre-check - the locked block re-reads _load_failed before deciding)
     if _load_failed or os.environ.get("SPFFT_TPU_NO_NATIVE") == "1":
         return None
     with _lock:
@@ -104,7 +108,7 @@ def _load() -> Optional[ctypes.CDLL]:
                 _lib = _bind(ctypes.CDLL(_LIB))
         except (OSError, AttributeError, subprocess.CalledProcessError):
             _load_failed = True
-    return _lib
+    return _lib  # lock: waived(post-with read - either the handle this call published or another loader's, both final)
 
 
 def available() -> bool:

@@ -120,8 +120,8 @@ class _ByteLRU:
         self._builder = builder
         self._max_entries = max_entries
         self._max_bytes = max_bytes
-        self._store = collections.OrderedDict()
-        self._bytes = 0
+        self._store = collections.OrderedDict()  #: guarded by _lock
+        self._bytes = 0                          #: guarded by _lock
         self._lock = threading.Lock()
 
     def __call__(self, *key):

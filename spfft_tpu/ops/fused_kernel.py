@@ -55,6 +55,7 @@ import dataclasses
 import functools
 import math
 import os
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -62,7 +63,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .dft_kernel import _kara
+from .dft_kernel import _dot, _kara
 from .gather_kernel import (TILE, TILE_LANE, TILE_SUB,
                             MonotoneGatherTables, _tile_compute_win)
 
@@ -130,12 +131,16 @@ def super_tile_geometry(dim_z: int):
     return r_min * k, p_min * k
 
 
-def _fits_backward(dim_z: int, p_tiles: int, span_rows: int) -> bool:
+def _fits_backward(dim_z: int, p_tiles: int, span_rows: int,
+                   complete: bool = False) -> bool:
     mats = 3 * dim_z * dim_z
     window = 2 * 2 * span_rows * TILE_LANE
     scratch = 2 * p_tiles * TILE_SUB * TILE_LANE
     out = 2 * 2 * p_tiles * TILE  # double-buffered output blocks
-    return (mats + window + scratch + out) * 4 <= _VMEM_BUDGET
+    # hermitian completion: in-kernel one-hot mirror matrix + iota
+    # transients (generous — the compiler reuses most of them)
+    mirror = 4 * dim_z * dim_z if complete else 0
+    return (mats + window + scratch + out + mirror) * 4 <= _VMEM_BUDGET
 
 
 def _fits_forward(dim_z: int, win_sticks: int, span_rows: int) -> bool:
@@ -169,6 +174,13 @@ class FusedDecompressTables:
     num_sticks: int      # valid stick rows (callers slice [:num_sticks])
     src_rows: int        # padded source rows (as narrow)
     span_rows: int       # K: DMA window height
+    #: r2c hermitian (0,0)-stick completion info: (2,) int32
+    #: ``[z_sup, z_row]`` — the zero stick's super-tile and its row
+    #: within it — or None for plans that need no in-kernel completion
+    #: (c2c, or r2c without the (0,0) stick). ``z_sup = -1`` is the
+    #: "never matches" sentinel the distributed shape-uniform tables
+    #: use for shards that don't own the zero stick.
+    zinfo: Optional[np.ndarray] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -192,16 +204,23 @@ class FusedCompressTables:
 
 
 def build_fused_decompress_tables(t: MonotoneGatherTables, dim_z: int,
-                                  num_sticks: int):
+                                  num_sticks: int,
+                                  zero_stick_id: Optional[int] = None):
     """Extend narrow decompress tables with the super-tile metadata the
-    fused kernel needs, or return a fallback-reason string."""
+    fused kernel needs, or return a fallback-reason string.
+
+    ``zero_stick_id`` (r2c plans that own the (0,0) stick) folds the
+    hermitian stick completion into the kernel: the zero stick's
+    super-tile / row position rides along as the ``zinfo`` scalar pair
+    and the kernel mirror-fills its empty z half before the z-DFT."""
     reason = eligible_dim(dim_z)
     if reason:
         return reason
     if t.segs:
         return "segmented"
+    complete = zero_stick_id is not None
     r_sticks, p_tiles = super_tile_geometry(dim_z)
-    if not _fits_backward(dim_z, p_tiles, t.span_rows):
+    if not _fits_backward(dim_z, p_tiles, t.span_rows, complete):
         return "vmem"
     sup = t.out_tile // p_tiles
     pos = t.out_tile - sup * p_tiles
@@ -213,12 +232,16 @@ def build_fused_decompress_tables(t: MonotoneGatherTables, dim_z: int,
     sfirst[1:] |= (sup[1:] != sup[:-1]).astype(np.int32)
     slast[:-1] |= (sup[1:] != sup[:-1]).astype(np.int32)
     num_super = -(-t.num_tiles // p_tiles)
+    zinfo = None
+    if complete:
+        zid = int(zero_stick_id)
+        zinfo = np.array([zid // r_sticks, zid % r_sticks], np.int32)
     return FusedDecompressTables(
         row0=t.row0, pos=pos.astype(np.int32), sfirst=sfirst,
         slast=slast, sup=sup.astype(np.int32), packed=t.packed,
         dim_z=int(dim_z), r_sticks=r_sticks, p_tiles=p_tiles,
         num_super=num_super, num_sticks=int(num_sticks),
-        src_rows=t.src_rows, span_rows=t.span_rows)
+        src_rows=t.src_rows, span_rows=t.span_rows, zinfo=zinfo)
 
 
 def compress_recompute_rows(t: MonotoneGatherTables, dim_z: int) -> int:
@@ -265,10 +288,16 @@ def build_fused_compress_tables(t: MonotoneGatherTables, dim_z: int,
 
 
 def decompress_device_tables(t: FusedDecompressTables) -> tuple:
-    """Device-committed table tuple for :func:`run_decompress_zdft`."""
-    return (jnp.asarray(t.row0), jnp.asarray(t.pos),
+    """Device-committed table tuple for :func:`run_decompress_zdft`
+    (plus the ``zinfo`` completion pair when the plan carries one — the
+    kernel signature is static on its presence, so c2c plans trace the
+    exact program they always did)."""
+    base = (jnp.asarray(t.row0), jnp.asarray(t.pos),
             jnp.asarray(t.sfirst), jnp.asarray(t.slast),
             jnp.asarray(t.sup), jnp.asarray(t.packed))
+    if t.zinfo is None:
+        return base
+    return base + (jnp.asarray(t.zinfo),)
 
 
 def compress_device_tables(t: FusedCompressTables) -> tuple:
@@ -288,12 +317,42 @@ def commit_mats(mats) -> tuple:
 
 # -- backward kernel: gather-decompress -> z-DFT -----------------------------
 
-def _dec_zdft_body(K, P, R, dz, g, pos_ref, sfirst_ref,
-                   slast_ref, packed_ref, cr_ref, ci_ref, cs_ref,
-                   write, acc, sc, slot):
+def _complete_zero_stick(R, dz, xr, xi, is_z, z_row):
+    """In-kernel r2c hermitian completion of the (0,0) stick, on the
+    RAW (pre-z-DFT) super-tile rows: fill each empty z slot from its
+    conjugate mirror ``F(-z) = conj(F(z))`` — exactly the unfused
+    ``where(nz, v, ±roll(v[::-1], 1))`` of the two-kernel path
+    (plan._backward_rest_tp), expressed as a one-hot MXU contraction
+    because Mosaic has no ``rev`` lowering. One-hot rows make the dot a
+    single exact f32 product per element, so the fused and unfused
+    paths stay bit-identical. ``is_z`` (this super-tile owns the zero
+    stick) and ``z_row`` arrive as DATA, not trace constants, so one
+    compiled program serves every shard of a distributed plan."""
+    row_r = jax.lax.dynamic_slice_in_dim(xr, z_row, 1, 0)   # (1, dz)
+    row_i = jax.lax.dynamic_slice_in_dim(xi, z_row, 1, 0)
+    jj = jax.lax.broadcasted_iota(jnp.int32, (dz, dz), 0)
+    kk = jax.lax.broadcasted_iota(jnp.int32, (dz, dz), 1)
+    jk = jj + kk
+    # M[j, k] = 1 iff (j + k) % dz == 0, so (row @ M)[k] = row[(dz-k)%dz]
+    mir = jnp.where((jk == 0) | (jk == dz), 1.0, 0.0).astype(jnp.float32)
+    mir_r = _dot(row_r, mir)
+    mir_i = _dot(row_i, mir)
+    nz = (row_r != 0.0) | (row_i != 0.0)
+    new_r = jnp.where(nz, row_r, mir_r)
+    new_i = jnp.where(nz, row_i, -mir_i)
+    rowsel = (jax.lax.broadcasted_iota(jnp.int32, (R, 1), 0)
+              == z_row) & is_z
+    return jnp.where(rowsel, new_r, xr), jnp.where(rowsel, new_i, xi)
+
+
+def _dec_zdft_body(K, P, R, dz, complete, g, pos_ref, sfirst_ref,
+                   slast_ref, sup_ref, zinfo_ref, packed_ref,
+                   cr_ref, ci_ref, cs_ref, write, acc, sc, slot):
     """Shared per-step body of the backward fused kernel. ``write``
     stores the transformed (R, dz) planar pair on the super-tile's last
-    chunk; DMA wait has already happened."""
+    chunk; DMA wait has already happened. ``complete`` statically gates
+    the r2c (0,0)-stick hermitian completion (``zinfo_ref`` is None —
+    and never read — without it)."""
     acc_re, acc_im = _tile_compute_win(K, packed_ref[0],
                                        sc[slot, 0], sc[slot, 1])
 
@@ -310,13 +369,23 @@ def _dec_zdft_body(K, P, R, dz, g, pos_ref, sfirst_ref,
     def _():
         xr = acc[0].reshape(R, dz)
         xi = acc[1].reshape(R, dz)
+        if complete:
+            xr, xi = _complete_zero_stick(
+                R, dz, xr, xi, zinfo_ref[0] == sup_ref[g], zinfo_ref[1])
         yr, yi = _kara(xr, xi, cr_ref[...], ci_ref[...], cs_ref[...])
         write(yr, yi)
 
 
-def _kernel_dec_zdft(K, P, R, dz, row0_ref, pos_ref, sfirst_ref, slast_ref,
-                     sup_ref, packed_ref, cr_ref, ci_ref, cs_ref,
-                     re_hbm, im_hbm, out_r_ref, out_i_ref, acc, sc, sem):
+def _kernel_dec_zdft(K, P, R, dz, complete, *refs):
+    if complete:
+        (row0_ref, pos_ref, sfirst_ref, slast_ref, sup_ref, zinfo_ref,
+         packed_ref, cr_ref, ci_ref, cs_ref, re_hbm, im_hbm,
+         out_r_ref, out_i_ref, acc, sc, sem) = refs
+    else:
+        (row0_ref, pos_ref, sfirst_ref, slast_ref, sup_ref,
+         packed_ref, cr_ref, ci_ref, cs_ref, re_hbm, im_hbm,
+         out_r_ref, out_i_ref, acc, sc, sem) = refs
+        zinfo_ref = None
     g = pl.program_id(0)
     n_g = pl.num_programs(0)
 
@@ -346,18 +415,24 @@ def _kernel_dec_zdft(K, P, R, dz, row0_ref, pos_ref, sfirst_ref, slast_ref,
         out_r_ref[...] = yr
         out_i_ref[...] = yi
 
-    _dec_zdft_body(K, P, R, dz, g, pos_ref, sfirst_ref,
-                   slast_ref, packed_ref, cr_ref, ci_ref, cs_ref,
-                   write, acc, sc, slot)
+    _dec_zdft_body(K, P, R, dz, complete, g, pos_ref, sfirst_ref,
+                   slast_ref, sup_ref, zinfo_ref, packed_ref,
+                   cr_ref, ci_ref, cs_ref, write, acc, sc, slot)
 
 
-def _kernel_dec_zdft_batched(K, P, R, dz, row0_ref, pos_ref, sfirst_ref,
-                             slast_ref, sup_ref, packed_ref, cr_ref, ci_ref,
-                             cs_ref, re_hbm, im_hbm, out_r_ref, out_i_ref,
-                             acc, sc, sem):
+def _kernel_dec_zdft_batched(K, P, R, dz, complete, *refs):
     """Batched grid (B, C): batch b gathers+transforms slab b through
     the shared tables; DMA pipeline prefetches across the batch
     boundary (the gather kernels' pattern)."""
+    if complete:
+        (row0_ref, pos_ref, sfirst_ref, slast_ref, sup_ref, zinfo_ref,
+         packed_ref, cr_ref, ci_ref, cs_ref, re_hbm, im_hbm,
+         out_r_ref, out_i_ref, acc, sc, sem) = refs
+    else:
+        (row0_ref, pos_ref, sfirst_ref, slast_ref, sup_ref,
+         packed_ref, cr_ref, ci_ref, cs_ref, re_hbm, im_hbm,
+         out_r_ref, out_i_ref, acc, sc, sem) = refs
+        zinfo_ref = None
     b = pl.program_id(0)
     g = pl.program_id(1)
     n_b = pl.num_programs(0)
@@ -391,9 +466,9 @@ def _kernel_dec_zdft_batched(K, P, R, dz, row0_ref, pos_ref, sfirst_ref,
         out_r_ref[0] = yr
         out_i_ref[0] = yi
 
-    _dec_zdft_body(K, P, R, dz, g, pos_ref, sfirst_ref,
-                   slast_ref, packed_ref, cr_ref, ci_ref, cs_ref,
-                   write, acc, sc, slot)
+    _dec_zdft_body(K, P, R, dz, complete, g, pos_ref, sfirst_ref,
+                   slast_ref, sup_ref, zinfo_ref, packed_ref,
+                   cr_ref, ci_ref, cs_ref, write, acc, sc, slot)
 
 
 def run_decompress_zdft(re, im, dev_tables: tuple, mats: tuple,
@@ -413,7 +488,9 @@ def run_decompress_zdft(re, im, dev_tables: tuple, mats: tuple,
     """
     C = int(t.row0.shape[0])
     K, P, R, dz = t.span_rows, t.p_tiles, t.r_sticks, t.dim_z
-    scratch = [
+    complete = t.zinfo is not None
+    n_scalar = 6 if complete else 5  # (+ zinfo) row0, pos, sfirst,
+    scratch = [                      # slast, sup
         pltpu.VMEM((2, P * TILE_SUB, TILE_LANE), jnp.float32),
         pltpu.VMEM((2, 2, K, TILE_LANE), jnp.float32),
         pltpu.SemaphoreType.DMA((2, 2)),
@@ -422,21 +499,21 @@ def run_decompress_zdft(re, im, dev_tables: tuple, mats: tuple,
     if re.ndim == 3:
         B = re.shape[0]
         grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=5,  # row0, pos, sfirst, slast, sup
+            num_scalar_prefetch=n_scalar,
             grid=(B, C),
             in_specs=[
                 pl.BlockSpec((1, TILE_SUB, TILE_LANE),
-                             lambda b, g, r0, ps, sf, sl, sp: (g, 0, 0)),
+                             lambda b, g, *_: (g, 0, 0)),
             ] + mat_specs + [
                 pl.BlockSpec(memory_space=pl.ANY),
                 pl.BlockSpec(memory_space=pl.ANY),
             ],
             out_specs=(
                 pl.BlockSpec((1, R, dz),
-                             lambda b, g, r0, ps, sf, sl, sp:
+                             lambda b, g, r0, ps, sf, sl, sp, *_:
                              (b, sp[g], 0)),
                 pl.BlockSpec((1, R, dz),
-                             lambda b, g, r0, ps, sf, sl, sp:
+                             lambda b, g, r0, ps, sf, sl, sp, *_:
                              (b, sp[g], 0)),
             ),
             scratch_shapes=scratch,
@@ -444,36 +521,41 @@ def run_decompress_zdft(re, im, dev_tables: tuple, mats: tuple,
         out_shape = (
             jax.ShapeDtypeStruct((B, t.num_super * R, dz), jnp.float32),
             jax.ShapeDtypeStruct((B, t.num_super * R, dz), jnp.float32))
-        kern = functools.partial(_kernel_dec_zdft_batched, K, P, R, dz)
+        kern = functools.partial(_kernel_dec_zdft_batched, K, P, R, dz,
+                                 complete)
     else:
         grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=5,
+            num_scalar_prefetch=n_scalar,
             grid=(C,),
             in_specs=[
                 pl.BlockSpec((1, TILE_SUB, TILE_LANE),
-                             lambda g, r0, ps, sf, sl, sp: (g, 0, 0)),
+                             lambda g, *_: (g, 0, 0)),
             ] + mat_specs + [
                 pl.BlockSpec(memory_space=pl.ANY),
                 pl.BlockSpec(memory_space=pl.ANY),
             ],
             out_specs=(
                 pl.BlockSpec((R, dz),
-                             lambda g, r0, ps, sf, sl, sp: (sp[g], 0)),
+                             lambda g, r0, ps, sf, sl, sp, *_:
+                             (sp[g], 0)),
                 pl.BlockSpec((R, dz),
-                             lambda g, r0, ps, sf, sl, sp: (sp[g], 0)),
+                             lambda g, r0, ps, sf, sl, sp, *_:
+                             (sp[g], 0)),
             ),
             scratch_shapes=scratch,
         )
         out_shape = (
             jax.ShapeDtypeStruct((t.num_super * R, dz), jnp.float32),
             jax.ShapeDtypeStruct((t.num_super * R, dz), jnp.float32))
-        kern = functools.partial(_kernel_dec_zdft, K, P, R, dz)
-    row0, pos, sfirst, slast, sup, packed = dev_tables
+        kern = functools.partial(_kernel_dec_zdft, K, P, R, dz, complete)
+    assert len(dev_tables) == (7 if complete else 6)
+    row0, pos, sfirst, slast, sup, packed = dev_tables[:6]
+    zex = dev_tables[6:]
     cr, ci, cs = mats
     return pl.pallas_call(
         kern, out_shape=out_shape, grid_spec=grid_spec,
         interpret=interpret,
-    )(row0, pos, sfirst, slast, sup, packed, cr, ci, cs, re, im)
+    )(row0, pos, sfirst, slast, sup, *zex, packed, cr, ci, cs, re, im)
 
 
 # -- forward kernel: z-DFT -> windowed compress gather -----------------------

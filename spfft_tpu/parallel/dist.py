@@ -300,7 +300,8 @@ class DistributedTransformPlan:
             jax.device_put(self._cols_flat, self._replicated),
             jax.device_put(self._col_inv, self._replicated),
             jax.device_put(self._zmap, self._replicated),
-            jax.device_put(self._z_src, self._replicated))
+            jax.device_put(self._z_src, self._replicated),
+            jax.device_put(self._conj_mult, self._sharded))
         if self._pallas_dist is not None:
             self._device_tables = self._device_tables + tuple(
                 jax.device_put(a, self._sharded)
@@ -333,6 +334,21 @@ class DistributedTransformPlan:
             self._n_ctables = len(ctables)
             self._device_tables = self._device_tables + tuple(
                 jax.device_put(a, self._sharded) for a in ctables)
+        # Fused decompress + z-DFT twin for the non-overlapped backward
+        # (ops/fused_kernel.py): tables appended LAST so the bodies keep
+        # slicing ptables/ctables by the existing counts.
+        self._init_fused_dist(use_pallas)
+        self._n_ftables = 0
+        fused_specs = ()
+        if self._fused_dist is not None:
+            fd = self._fused_dist
+            self._n_ftables = len(fd["stacked"]) + len(fd["mats"])
+            fused_specs = ((P(self.axis_name),) * len(fd["stacked"])
+                           + (P(),) * len(fd["mats"]))
+            self._device_tables = self._device_tables + tuple(
+                jax.device_put(a, self._sharded)
+                for a in fd["stacked"]) + tuple(
+                jax.device_put(m, self._replicated) for m in fd["mats"])
         # Comm-size-1 collapse (reference: grid_internal.cpp:182 treats a
         # size-1 communicator as local): single-shard plans EXECUTE
         # through the local pipeline (planar T-layout matmul-DFT, stick
@@ -360,12 +376,15 @@ class DistributedTransformPlan:
             (P(self.axis_name),                       # data
              P(self.axis_name), P(self.axis_name),    # vi, slot_src
              P(self.axis_name),                       # onehot
-             P(), P(), P(), P())      # cols, col_inv, zmap, z_src
-            + (P(self.axis_name),) * (self._n_ptables + self._n_ctables))
+             P(), P(), P(), P(),      # cols, col_inv, zmap, z_src
+             P(self.axis_name))                       # conj_mult
+            + (P(self.axis_name),) * (self._n_ptables + self._n_ctables)
+            + fused_specs)
         # pallas_call outputs carry no varying-mesh-axes metadata, so the
         # vma consistency check must be off when the kernel is in the body;
         # XLA-path plans keep the check (specs pin every sharding anyway)
-        self._check_vma = self._pallas_dist is None
+        self._check_vma = (self._pallas_dist is None
+                           and self._fused_dist is None)
         shmap = functools.partial(
             shard_map, mesh=self.mesh, in_specs=self._base_in_specs,
             out_specs=P(self.axis_name), check_vma=self._check_vma)
@@ -471,6 +490,24 @@ class DistributedTransformPlan:
         for r, p in enumerate(dp.shard_plans):
             if p.zero_stick_id is not None:
                 onehot[r, p.zero_stick_id] = 1.0
+        # Hermitian x < 0 folding (indexing.canonicalize_hermitian_triplets):
+        # per-shard ±1 multiplier on the interleaved value lanes, -1 on the
+        # imaginary lane of folded conjugate mirrors. Static _has_conj keeps
+        # unfolded plans byte-identical (the multiply is never traced); the
+        # table stays a (S, 1, 2) ones placeholder then, so the extra pytree
+        # leaf ships nothing per call.
+        self._has_conj = any(
+            p.value_conj is not None and bool(p.value_conj.any())
+            for p in dp.shard_plans)
+        if self._has_conj:
+            conj_mult = np.ones((S, mv, 2), self._rdt)
+            for r, p in enumerate(dp.shard_plans):
+                if p.value_conj is not None:
+                    conj_mult[r, :p.num_values, 1] = np.where(
+                        p.value_conj, -1.0, 1.0)
+        else:
+            conj_mult = np.ones((S, 1, 2), self._rdt)
+        self._conj_mult = conj_mult
         self._vi = vi
         self._slot_src = slot_src
         self._cols_flat = cols.reshape(-1)
@@ -608,6 +645,149 @@ class DistributedTransformPlan:
                 src_rows=t["src_rows"], num_tiles=t["tiles_p1"],
                 interpret=self._pallas_interpret)
         return gk.interleaved_from_planar(out_re, out_im, t["num_out"])
+
+    def _init_fused_dist(self, use_pallas: Optional[bool]) -> None:
+        """Fused decompress + z-DFT tables for the distributed backward's
+        local pre-exchange stage: one ``run_decompress_zdft`` launch
+        replaces the decompress gather, the r2c (0,0)-stick hermitian
+        completion AND ``stages.z_backward`` — the dense raw stick array
+        never round-trips through HBM (the same fusion the local plan
+        runs, ops/fused_kernel.py). Shape-uniform per-shard tables (a
+        common DMA window height, chunk counts padded with no-op chunks
+        routed to one dummy output super-tile) keep the SPMD body a
+        single program. Gated by the same eligibility/cost model as the
+        local fusion; every decline that keeps an otherwise-kernel-ready
+        plan on the two-launch path is recorded as a
+        ``dist_fused_decompress_zdft`` fallback reason."""
+        from .. import obs as _obs
+        from ..ops import dft as _dft
+        from ..ops import fused_kernel as fkm
+        from ..ops import gather_kernel as gk
+
+        dp = self.dist_plan
+        self._fused_dist = None
+        self._fused_dist_reason = None
+        backend_ok = jax.default_backend() == "tpu"
+        # Silent returns: configurations where the fused kernel was never
+        # in play (mirrors _init_pallas's activation envelope).
+        if not fkm.enabled() or not (backend_ok or fkm.interpret_forced()):
+            return
+        if use_pallas is False or self.precision != "single":
+            return
+        ms, mv, dim_z = dp.max_sticks, dp.max_values, dp.dim_z
+        if mv == 0 or ms == 0:
+            return
+        if (use_pallas is None and not fkm.interpret_forced()
+                and mv < 200_000):
+            return  # below the kernel-vs-XLA crossover (_init_pallas)
+
+        def decline(reason: str) -> None:
+            self._fused_dist_reason = reason
+            _obs.record_plan_fallback("dist_fused_decompress_zdft", reason)
+            logger.info(
+                "spfft_tpu: distributed fused decompress+z-DFT kernel "
+                "unavailable (%s) — keeping the two-launch backward",
+                reason)
+
+        if not _dft.use_matmul_dft(dim_z, np.dtype(np.complex64)):
+            return decline("no_matmul_dft")
+        if self._overlap is not None:
+            # the fused launch transforms whole super-tiles; the overlap
+            # pipeline needs per-chunk stick slices between z and exchange
+            return decline("overlap_chunks")
+        reason = fkm.eligible_dim(dim_z)
+        if reason:
+            return decline(reason)
+        num_slots = ms * dim_z
+        per = [gk.compression_gather_inputs(p.value_indices, num_slots,
+                                            pad_values_to=mv)[0]
+               for p in dp.shard_plans]
+        tables = [gk.build_monotone_gather_tables(idx, valid, mv,
+                                                  allow_segments=False)
+                  for idx, valid in per]
+        if any(t is None for t in tables):
+            return decline("value_order")
+        # force one DMA window height K across shards (selector words
+        # encode (row, lane, valid) independent of K, so rebuilding the
+        # smaller-span shards under the max is exact)
+        k_u = max(t.span_rows for t in tables)
+        tables = [t if t.span_rows == k_u else
+                  gk.build_monotone_gather_tables(
+                      per[r][0], per[r][1], mv, k_rows=k_u,
+                      allow_segments=False)
+                  for r, t in enumerate(tables)]
+        if any(t is None for t in tables):
+            return decline("value_order")
+        fused = []
+        for r, t in enumerate(tables):
+            zid = dp.shard_plans[r].zero_stick_id if dp.hermitian else None
+            ft = fkm.build_fused_decompress_tables(t, dim_z, ms,
+                                                   zero_stick_id=zid)
+            if isinstance(ft, str):
+                return decline(ft)
+            fused.append(ft)
+        # num_super/p_tiles/r_sticks are uniform already (num_slots is the
+        # padded common max on every shard); the zero-stick owner differs,
+        # so non-owners get the never-matching (-1) zinfo sentinel and the
+        # static `complete` flag stays shard-invariant.
+        complete = any(f.zinfo is not None for f in fused)
+        num_super = fused[0].num_super
+        c_max = max(f.row0.shape[0] for f in fused)
+        src_rows = max(f.src_rows for f in fused)
+
+        def pad(f):
+            p_ = c_max - f.row0.shape[0]
+            # no-op padding chunks: all-invalid selector words gather
+            # zeros, never first/last, and target the DUMMY super-tile
+            # ``num_super`` so the flush-on-block-change at the real->pad
+            # boundary lands outside the sliced result.
+            return (np.concatenate([f.row0, np.zeros(p_, np.int32)]),
+                    np.concatenate([f.pos, np.zeros(p_, np.int32)]),
+                    np.concatenate([f.sfirst, np.zeros(p_, np.int32)]),
+                    np.concatenate([f.slast, np.zeros(p_, np.int32)]),
+                    np.concatenate([f.sup,
+                                    np.full(p_, num_super, np.int32)]),
+                    np.concatenate([f.packed,
+                                    np.zeros((p_, 8, 128), np.int32)]))
+
+        padded = [pad(f) for f in fused]
+        stacked = [np.stack([p_[i] for p_ in padded]) for i in range(6)]
+        if complete:
+            stacked.append(np.stack([
+                f.zinfo if f.zinfo is not None
+                else np.array([-1, 0], np.int32) for f in fused]))
+        rep = dataclasses.replace(
+            fused[0], row0=padded[0][0], pos=padded[0][1],
+            sfirst=padded[0][2], slast=padded[0][3], sup=padded[0][4],
+            packed=padded[0][5], num_super=num_super + 1,
+            src_rows=src_rows, span_rows=k_u, num_sticks=ms,
+            zinfo=(np.array([-1, 0], np.int32) if complete else None))
+        self._fused_dist = {
+            "rep": rep, "stacked": stacked, "n_tabs": len(stacked),
+            "mats": fkm.commit_mats(_dft.c2c_mats(dim_z, _dft.BACKWARD)),
+            "interpret": not backend_ok,
+        }
+
+    def _fused_dec_zdft_shard(self, vals, xtables):
+        """Per-shard fused decompress + (0,0)-stick completion + z-IFFT:
+        the drop-in for ``_decompress_shard`` followed by
+        ``_bwd_pre_exchange`` in the non-overlapped backward. ``vals`` is
+        (mv, 2) interleaved — or batched (B, mv, 2) through the batched
+        kernel grid. Returns complex z-transformed sticks
+        (..., max_sticks, dim_z)."""
+        from ..ops import fused_kernel as fkm
+        from ..ops import gather_kernel as gk
+        fd = self._fused_dist
+        rep = fd["rep"]
+        ft = xtables[self._n_ptables + self._n_ctables:]
+        dev = tuple(a[0] for a in ft[:fd["n_tabs"]])  # drop the shard axis
+        mats = ft[fd["n_tabs"]:]                      # replicated, as-is
+        re, im = gk.planar_from_interleaved(vals.astype(np.float32),
+                                            rep.src_rows)
+        sr, si = fkm.run_decompress_zdft(re, im, dev, mats, rep,
+                                         interpret=fd["interpret"])
+        ms = self.dist_plan.max_sticks
+        return (sr[..., :ms, :] + 1j * si[..., :ms, :]).astype(self._cdt)
 
     # -- SPMD bodies ---------------------------------------------------------
     def _exchange_freq_to_grid(self, sticks, zmap, col_inv, ctables):
@@ -870,15 +1050,26 @@ class DistributedTransformPlan:
         return self._bwd_post_exchange(grid)
 
     def _backward_body(self, values_il, vi, slot_src, onehot, cols_flat,
-                       col_inv, zmap, z_src, *xtables):
+                       col_inv, zmap, z_src, conj_mult, *xtables):
         ptables = xtables[:self._n_ptables]
         ctables = xtables[self._n_ptables:self._n_ptables + self._n_ctables]
-        sticks = self._decompress_shard(values_il[0], slot_src, ptables)
+        vals = values_il[0]
+        if self._has_conj:  # conjugate the folded hermitian mirrors
+            vals = vals * conj_mult[0]
+        if self._fused_dist is not None:
+            # decompress + stick symmetry + z-IFFT in ONE kernel launch
+            # (overlap declined at build time, so the tail is monolithic)
+            sticks_z = self._fused_dec_zdft_shard(vals, xtables)
+            grid = self._exchange_freq_to_grid(sticks_z, zmap, col_inv,
+                                               ctables)
+            return self._bwd_post_exchange(grid)[None]
+        sticks = self._decompress_shard(vals, slot_src, ptables)
         return self._backward_tail(sticks, onehot, col_inv, zmap,
                                    ctables)[None]
 
     def _backward_body_batched(self, values_il, vi, slot_src, onehot,
-                               cols_flat, col_inv, zmap, z_src, *xtables):
+                               cols_flat, col_inv, zmap, z_src, conj_mult,
+                               *xtables):
         """Batched SPMD body: data carries a per-shard batch axis
         (1, B, ...); compression runs ONE batched-grid kernel launch, the
         rest of the pipeline (collectives included) is vmapped over B —
@@ -887,7 +1078,22 @@ class DistributedTransformPlan:
         multi_transform_internal.hpp:47-94)."""
         ptables = xtables[:self._n_ptables]
         ctables = xtables[self._n_ptables:self._n_ptables + self._n_ctables]
-        sticks_b = self._decompress_shard(values_il[0], slot_src, ptables)
+        vals_b = values_il[0]
+        if self._has_conj:  # (B, mv, 2) * (mv, 2) broadcasts over B
+            vals_b = vals_b * conj_mult[0]
+        if self._fused_dist is not None:
+            # one batched-grid fused launch covers decompress + symmetry
+            # + z-IFFT for the whole batch (overlap declined at build)
+            sticks_zb = self._fused_dec_zdft_shard(vals_b, xtables)
+            if self._ragged is not None:
+                grid_b = self._exchange_freq_to_grid(sticks_zb, zmap,
+                                                     col_inv, ctables)
+            else:
+                grid_b = jax.vmap(
+                    lambda s: self._exchange_freq_to_grid(
+                        s, zmap, col_inv, ctables))(sticks_zb)
+            return jax.vmap(self._bwd_post_exchange)(grid_b)[None]
+        sticks_b = self._decompress_shard(vals_b, slot_src, ptables)
         if self._overlap is not None and self._overlap.kind == "ragged":
             # chunk loop identical to the unbatched path; each chunk's
             # collective carries the batch as trailing dims
@@ -963,14 +1169,18 @@ class DistributedTransformPlan:
         return values
 
     def _forward_body(self, space, vi, slot_src, onehot, cols_flat, col_inv,
-                      zmap, z_src, *xtables, scaled: bool):
+                      zmap, z_src, conj_mult, *xtables, scaled: bool):
         ptables = xtables[:self._n_ptables]
         ctables = xtables[self._n_ptables:self._n_ptables + self._n_ctables]
         sticks = self._forward_head(space[0], cols_flat, z_src, ctables)
-        return self._compress_shard(sticks, vi, ptables, scaled)[None]
+        values = self._compress_shard(sticks, vi, ptables, scaled)
+        if self._has_conj:  # folded mirrors leave conjugated
+            values = values * conj_mult[0]
+        return values[None]
 
     def _forward_body_batched(self, space, vi, slot_src, onehot, cols_flat,
-                              col_inv, zmap, z_src, *xtables, scaled: bool):
+                              col_inv, zmap, z_src, conj_mult, *xtables,
+                              scaled: bool):
         ptables = xtables[:self._n_ptables]
         ctables = xtables[self._n_ptables:self._n_ptables + self._n_ctables]
         if self._overlap is not None and self._overlap.kind == "ragged":
@@ -988,7 +1198,10 @@ class DistributedTransformPlan:
             sticks_b = jax.vmap(
                 lambda s: self._forward_head(s, cols_flat, z_src,
                                              ctables))(space[0])
-        return self._compress_shard(sticks_b, vi, ptables, scaled)[None]
+        values_b = self._compress_shard(sticks_b, vi, ptables, scaled)
+        if self._has_conj:
+            values_b = values_b * conj_mult[0]
+        return values_b[None]
 
     def _pair_shmap(self, n_fn_args: int):
         """shard_map wrapper for the fused-pair entry points: base specs
@@ -1000,16 +1213,16 @@ class DistributedTransformPlan:
             out_specs=P(self.axis_name), check_vma=self._check_vma)
 
     def _pair_body(self, values_il, vi, slot_src, onehot, cols_flat,
-                   col_inv, zmap, z_src, *rest, scaled: bool, fn):
-        n_tab = self._n_ptables + self._n_ctables
+                   col_inv, zmap, z_src, conj_mult, *rest, scaled: bool, fn):
+        n_tab = self._n_ptables + self._n_ctables + self._n_ftables
         xtables, fn_args = rest[:n_tab], rest[n_tab:]
         space = self._backward_body(values_il, vi, slot_src, onehot,
                                     cols_flat, col_inv, zmap, z_src,
-                                    *xtables)
+                                    conj_mult, *xtables)
         if fn is not None:
             space = fn(space, *fn_args)
         return self._forward_body(space, vi, slot_src, onehot, cols_flat,
-                                  col_inv, zmap, z_src, *xtables,
+                                  col_inv, zmap, z_src, conj_mult, *xtables,
                                   scaled=scaled)
 
     def apply_pointwise(self, values, fn=None, *fn_args,
@@ -1127,6 +1340,19 @@ class DistributedTransformPlan:
 
     def num_local_elements(self, shard: int) -> int:
         return self.dist_plan.shard_plans[shard].num_values
+
+    @property
+    def fused_dist_active(self) -> bool:
+        """True when the backward's local pre-exchange stage (decompress +
+        r2c stick symmetry + z-IFFT) runs as ONE fused Pallas launch."""
+        return self._fused_dist is not None
+
+    @property
+    def fused_dist_fallback_reason(self) -> Optional[str]:
+        """Why the fused pre-exchange stage declined on an
+        otherwise-kernel-ready plan (None when active or never in play);
+        also recorded under ``dist_fused_decompress_zdft`` in obs."""
+        return self._fused_dist_reason
 
     def _wire_elem_bytes(self) -> int:
         elem = np.dtype(self._cdt).itemsize

@@ -297,6 +297,23 @@ class TransformPlan:
             self._commit_fallback("dec")
             self._commit_fallback("cmp")
         self._init_split_x()
+        # Hermitian x < 0 folding (indexing.canonicalize_hermitian_triplets):
+        # folded values are stored conjugated, so the boundary applies a
+        # ±1 sign to the imaginary lane — backward input and forward
+        # output — in each value layout. ±1 multiplies are exact in f32,
+        # so the fold costs no precision and works identically under the
+        # XLA gather, the Pallas gather kernel, and the fused z-DFT kernel.
+        vc = index_plan.value_conj
+        if vc is not None and bool(np.asarray(vc).any()):
+            s = np.where(np.asarray(vc), -1.0, 1.0)
+            o = np.ones_like(s)
+            self._conj_mults = {
+                "il": np.stack([o, s], axis=-1),        # (N, 2)
+                "pair": np.stack([o, s], axis=0),       # (2, N)
+                "ds": np.stack([o, o, s, s], axis=-1),  # (N, 4) [rh,rl,ih,il]
+            }
+        else:
+            self._conj_mults = None
         if self._ds:
             from .ops import dsdft as _dsdft
             gs = 1.0 / float(self.global_size)
@@ -489,25 +506,24 @@ class TransformPlan:
         reasons = {}
         box = {"dec": None, "cmp": None}
         # backward: gather-decompress + z-DFT. The r2c (0,0)-stick
-        # hermitian completion runs BETWEEN decompress and the z stage,
-        # so plans that need it keep the two-kernel path.
-        if self._is_r2c and p.zero_stick_id is not None:
-            reasons["dec"] = "hermitian_completion"
+        # hermitian completion runs BETWEEN decompress and the z stage
+        # and rides INSIDE the kernel (ops/fused_kernel
+        # ._complete_zero_stick), so r2c plans take the fused path too.
+        zid = p.zero_stick_id if self._is_r2c else None
+        nt = narrow(dec_best, dec_idx, occupied, p.num_values)
+        if nt is None:
+            reasons["dec"] = "value_order"
         else:
-            nt = narrow(dec_best, dec_idx, occupied, p.num_values)
-            if nt is None:
-                reasons["dec"] = "value_order"
+            out = fkm.build_fused_decompress_tables(
+                nt, p.dim_z, self._s_pad, zero_stick_id=zid)
+            if isinstance(out, str):
+                reasons["dec"] = out
             else:
-                out = fkm.build_fused_decompress_tables(nt, p.dim_z,
-                                                        self._s_pad)
-                if isinstance(out, str):
-                    reasons["dec"] = out
-                else:
-                    box["dec"] = out
-                    self._tables_hot["fzd_tabs"] = \
-                        fkm.decompress_device_tables(out)
-                    self._tables_hot["fzd_mats"] = fkm.commit_mats(
-                        _dft.c2c_mats(p.dim_z, _dft.BACKWARD))
+                box["dec"] = out
+                self._tables_hot["fzd_tabs"] = \
+                    fkm.decompress_device_tables(out)
+                self._tables_hot["fzd_mats"] = fkm.commit_mats(
+                    _dft.c2c_mats(p.dim_z, _dft.BACKWARD))
         # forward twin: z-DFT + compress gather, FULL scaling folded
         # into a second matrix triple at plan time
         ct = narrow(cmp_best, cmp_idx, cmp_valid, num_slots)
@@ -1290,7 +1306,26 @@ class TransformPlan:
 
     _ds_values_to_host = _ds_space_to_host  # same channel layout
 
+    def _apply_value_conj(self, values, *, batched=False):
+        """Sign-flip the imaginary lane of the values folded from the
+        redundant hermitian half (:attr:`IndexPlan.value_conj`): the
+        backward input and the forward output are conjugated at the
+        boundary in whatever layout the values take — interleaved
+        (..., N, 2), the planar pair (..., 2, N), or double-single
+        channels (..., N, 4). No-op (no graph nodes) when nothing was
+        folded."""
+        if self._conj_mults is None:
+            return values
+        if self._ds:
+            m = self._conj_mults["ds"]
+        elif self._pair_io and values.shape[1 if batched else 0] == 2:
+            m = self._conj_mults["pair"]
+        else:
+            m = self._conj_mults["il"]
+        return values * jnp.asarray(m, values.dtype)
+
     def _backward_impl(self, values_il, tables, *, pallas=True):
+        values_il = self._apply_value_conj(values_il)
         if self._ds:
             return self._ds_backward_impl(values_il, tables)
         if self._use_mdft:
@@ -1330,13 +1365,16 @@ class TransformPlan:
 
     def _forward_impl(self, space, tables, *, scaled: bool, pallas=True):
         if self._ds:
-            return self._ds_forward_impl(space, tables, scaled)
+            return self._apply_value_conj(
+                self._ds_forward_impl(space, tables, scaled))
         if self._use_mdft:  # planar pipeline, scale folded into z matrix
             sp = space if self._is_r2c else (space[..., 0], space[..., 1])
-            return self._fwd_values_tp(sp, tables, scaled, pallas)
+            return self._apply_value_conj(
+                self._fwd_values_tp(sp, tables, scaled, pallas))
         scale = 1.0 / self.global_size if scaled else None
         sticks = self._forward_head(space, tables)
-        return self._compress(sticks, tables, scale, pallas)
+        return self._apply_value_conj(
+            self._compress(sticks, tables, scale, pallas))
 
     # -- batched execution ---------------------------------------------------
     def _decompress_batched(self, values_b, tables):
@@ -1391,6 +1429,7 @@ class TransformPlan:
         return jnp.stack([out[0], out[1]], axis=-1)
 
     def _backward_impl_batched(self, values_b, tables):
+        values_b = self._apply_value_conj(values_b, batched=True)
         if self._ds:
             return jax.vmap(
                 lambda v: self._ds_backward_impl(v, tables))(values_b)
@@ -1406,23 +1445,29 @@ class TransformPlan:
 
     def _forward_impl_batched(self, space_b, tables, *, scaled: bool):
         if self._ds:
-            return jax.vmap(lambda sp: self._ds_forward_impl(
-                sp, tables, scaled))(space_b)
+            return self._apply_value_conj(jax.vmap(
+                lambda sp: self._ds_forward_impl(sp, tables, scaled))(
+                    space_b), batched=True)
         scale = 1.0 / self.global_size if scaled else None
         if self._use_mdft and self._fused_on("cmp"):
             sp_b = space_b if self._is_r2c \
                 else (space_b[..., 0], space_b[..., 1])
             sr_b, si_b = jax.vmap(self._forward_pre_z,
                                   in_axes=(0, None))(sp_b, tables)
-            return self._zdft_compress(sr_b, si_b, tables, scaled)
+            return self._apply_value_conj(
+                self._zdft_compress(sr_b, si_b, tables, scaled),
+                batched=True)
         if self._use_mdft:
             sticks_b = jax.vmap(
                 lambda s, t: self._forward_head(s, t, scale),
                 in_axes=(0, None))(space_b, tables)
-            return self._compress_batched(sticks_b, tables, None)
+            return self._apply_value_conj(
+                self._compress_batched(sticks_b, tables, None),
+                batched=True)
         sticks_b = jax.vmap(self._forward_head,
                             in_axes=(0, None))(space_b, tables)
-        return self._compress_batched(sticks_b, tables, scale)
+        return self._apply_value_conj(
+            self._compress_batched(sticks_b, tables, scale), batched=True)
 
     def _batched_jits(self):
         """Lazily-built batched executables over a leading batch axis.
@@ -1562,16 +1607,22 @@ class TransformPlan:
 
     # -- fused round trip ----------------------------------------------------
     def _pair_impl(self, values_il, tables, *fn_args, scaled, fn):
+        # the ds/mdft branches bypass _backward_impl/_forward_impl, so
+        # the hermitian-fold conjugation applies here; the final branch
+        # delegates to those impls, which conjugate themselves
         if self._ds:
             # fn is rejected up front (apply_pointwise): a pointwise fn
             # would run at f32 and silently break the double contract
-            space4 = self._ds_backward_impl(values_il, tables)
-            return self._ds_forward_impl(space4, tables, scaled)
+            space4 = self._ds_backward_impl(
+                self._apply_value_conj(values_il), tables)
+            return self._apply_value_conj(
+                self._ds_forward_impl(space4, tables, scaled))
         if self._use_mdft:
             # fully planar round trip; the space domain is materialised
             # in the public interleaved layout ONLY when a pointwise fn
             # needs to see it
-            space = self._bwd_space_tp(values_il, tables)
+            space = self._bwd_space_tp(
+                self._apply_value_conj(values_il), tables)
             if fn is not None:
                 if self._is_r2c:
                     space = fn(space, *fn_args)
@@ -1579,7 +1630,8 @@ class TransformPlan:
                     s = fn(jnp.stack([space[0], space[1]], axis=-1),
                            *fn_args)
                     space = (s[..., 0], s[..., 1])
-            return self._fwd_values_tp(space, tables, scaled)
+            return self._apply_value_conj(
+                self._fwd_values_tp(space, tables, scaled))
         space = self._backward_impl(values_il, tables)
         if fn is not None:
             space = fn(space, *fn_args)

@@ -175,15 +175,16 @@ class FaultPlan:
         if isinstance(script, str):
             script = [s for s in script.split(",") if s.strip()]
         self._rate = float(rate)
-        self._rng = random.Random(seed)
+        self._rng = random.Random(seed)  #: guarded by _lock
         self._scope = scope
         self._script: List[Tuple[str, Optional[int], str]] = \
             [_parse_entry(s) for s in (script or [])]
         self._lock = threading.Lock()
-        self._calls: Dict[str, int] = {}
+        self._calls: Dict[str, int] = {}  #: guarded by _lock
+        #: guarded by _lock
         self._fired: Dict[str, int] = {"transient": 0, "permanent": 0,
                                        "poison": 0}
-        self._fired_by_site: Dict[str, int] = {}
+        self._fired_by_site: Dict[str, int] = {}  #: guarded by _lock
 
     def _in_scope(self, site: str, dev_key: Optional[str]) -> bool:
         if self._scope is None:

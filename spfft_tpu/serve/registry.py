@@ -69,6 +69,12 @@ def index_digest(index_plan: IndexPlan) -> str:
         index_plan.value_indices.astype(np.int64)).tobytes())
     h.update(np.ascontiguousarray(
         index_plan.stick_keys.astype(np.int64)).tobytes())
+    if index_plan.value_conj is not None:
+        # hermitian x < 0 folding: the conj mask changes execution
+        # (boundary sign flips), so two plans differing only in it must
+        # never share an artifact; unfolded plans hash exactly as before
+        h.update(np.ascontiguousarray(
+            index_plan.value_conj.astype(np.uint8)).tobytes())
     return h.hexdigest()
 
 

@@ -94,7 +94,7 @@ FORMAT_VERSION = 1
 #: (gather_kernel.*GatherTables, fused_kernel.Fused*Tables) change
 #: fields — an old artifact then rejects cleanly instead of
 #: reconstructing garbage.
-TABLE_SCHEMA = 1
+TABLE_SCHEMA = 2  # 2: FusedDecompressTables.zinfo (r2c completion)
 
 MANIFEST_KEY = "spfft_tpu_plan_manifest"
 MANIFEST_VERSION = 1
@@ -145,6 +145,8 @@ def _pack_tables(obj, prefix: str, arrays: dict, tables_meta: dict) -> None:
         elif f.name == "segs":
             arrays[f"{prefix}.segs"] = \
                 np.asarray(v, np.int64).reshape(-1, 4)
+        elif v is None:
+            pass  # Optional field at its default — omitted entirely
         else:
             meta[f.name] = int(v)
     tables_meta[prefix] = meta
@@ -162,8 +164,9 @@ def _unpack_tables(prefix: str, arrays: dict, tables_meta: dict):
                                    for row in segs)
         elif key in arrays:
             kwargs[f.name] = arrays[key]
-        else:
+        elif f.name in meta:
             kwargs[f.name] = meta[f.name]
+        # else: Optional field serialized at its None default
     return cls(**kwargs)
 
 
@@ -216,6 +219,9 @@ def serialize_artifact(sig: PlanSignature, plan: TransformPlan,
         "value_indices": np.ascontiguousarray(p.value_indices),
         "stick_keys": np.ascontiguousarray(p.stick_keys),
     }
+    if p.value_conj is not None:
+        arrays["value_conj"] = np.ascontiguousarray(
+            p.value_conj.astype(np.uint8))
     tables_meta: dict = {}
     if tabs.pallas_box:
         for which, t in tabs.pallas_box.items():
@@ -329,7 +335,9 @@ def _index_plan_of(header: dict, arrays: dict) -> IndexPlan:
             dim_x=int(meta["dim_x"]), dim_y=int(meta["dim_y"]),
             dim_z=int(meta["dim_z"]), centered=bool(meta["centered"]),
             value_indices=arrays["value_indices"],
-            stick_keys=arrays["stick_keys"])
+            stick_keys=arrays["stick_keys"],
+            value_conj=(arrays["value_conj"].astype(bool)
+                        if "value_conj" in arrays else None))
     except (KeyError, ValueError) as exc:
         raise StoreReject(REASON_CORRUPT, f"bad index metadata: {exc!r}")
 

@@ -519,6 +519,58 @@ CHUNKS = os.environ.get("SPFFT_TPU_DEPHT", "1")
     assert any("near-miss" in f.message for f in errs)
 
 
+CONTROLLER_OK = '''
+MANAGED_KNOBS = ("window", "depth")
+
+class Controller:
+    def _retune(self, out, knob, value, reason):
+        pass
+
+    def step(self, out):
+        self._retune(out, "window", 0.25, "load spike")
+        self._retune(out, "depth", 8, "queue deep")
+'''
+
+
+def test_knob_registry_controller_coverage_clean():
+    findings, extras = knobs.check(index_sources(
+        {"config.py": KNOBS_OK, "controller.py": CONTROLLER_OK}),
+        doc_text=KNOBS_DOC)
+    assert _errors(findings) == []
+    assert extras["managed_knobs"] == 2
+
+
+def test_knob_registry_catches_managed_knob_without_rule():
+    src = CONTROLLER_OK.replace(
+        'self._retune(out, "depth", 8, "queue deep")', "pass")
+    findings, _ = knobs.check(index_sources(
+        {"config.py": KNOBS_OK, "controller.py": src}))
+    errs = _errors(findings)
+    assert any("has no controller rule" in f.message
+               and "'depth'" in f.message for f in errs)
+
+
+def test_knob_registry_catches_unmanaged_knob_with_rule():
+    src = CONTROLLER_OK.replace('MANAGED_KNOBS = ("window", "depth")',
+                                'MANAGED_KNOBS = ("window",)')
+    findings, _ = knobs.check(index_sources(
+        {"config.py": KNOBS_OK, "controller.py": src}))
+    errs = _errors(findings)
+    assert any("not in MANAGED_KNOBS" in f.message
+               and "'depth'" in f.message for f in errs)
+
+
+def test_knob_registry_catches_managed_name_not_a_knob():
+    src = CONTROLLER_OK.replace(
+        'MANAGED_KNOBS = ("window", "depth")',
+        'MANAGED_KNOBS = ("window", "depth", "ghost")')
+    findings, _ = knobs.check(index_sources(
+        {"config.py": KNOBS_OK, "controller.py": src}))
+    errs = _errors(findings)
+    assert any("not a declared knob" in f.message
+               and "'ghost'" in f.message for f in errs)
+
+
 # ---------------------------------------------------------------------------
 # baseline lint
 # ---------------------------------------------------------------------------

@@ -107,6 +107,13 @@ def test_real_repo_reference_resolves():
     for row in ("cold_start_ms", "warm_start_ms"):
         v, u, _ = bench_regress.measurement(payload, ref, row=row)
         assert u == "ms" and v > 0
+    # the round-15 hermitian-symmetry sub-rows: trimmed wire at half
+    # the recorded untrimmed C2C bytes, both fused r2c seams active
+    v, u, m = bench_regress.measurement(payload, ref,
+                                        row="wire_bytes_r2c")
+    assert u == "bytes" and 0 < v <= 0.55 * 92164352
+    v, u, _ = bench_regress.measurement(payload, ref, row="fused_r2c")
+    assert u == "seams" and v == 2
 
 
 def _write_with_fused(path, value, fused_value, unit="s", wrap=False):
@@ -159,3 +166,50 @@ def test_fused_row_one_sided_is_skipped(tmp_path, capsys):
     assert bench_regress.main(["--fresh", fresh_plain,
                                "--against", ref_fused]) == 0
     capsys.readouterr()
+
+
+def _write_symmetry(path, value, wire, seams, wrap=False):
+    payload = {"metric": "m", "value": value, "unit": "s",
+               "wire_bytes_r2c": {"metric": "w", "value": wire,
+                                  "unit": "bytes"},
+               "fused_r2c": {"metric": "f", "value": seams,
+                             "unit": "seams"}}
+    if wrap:
+        payload = {"n": 1, "parsed": payload}
+    path.write_text(json.dumps(payload))
+    return str(path)
+
+
+def test_wire_bytes_row_gates_the_halving(tmp_path, capsys):
+    """wire_bytes_r2c is bytes = lower-is-better: the deterministic
+    trimmed accounting passes at equality and fails if the exchange
+    re-inflates toward the untrimmed byte count."""
+    ref = _write_symmetry(tmp_path / "BENCH_r06.json", 0.0106,
+                          46084864, 2, wrap=True)
+    same = _write_symmetry(tmp_path / "same.json", 0.0106, 46084864, 2)
+    assert bench_regress.main(["--fresh", same, "--against", ref]) == 0
+    lines = [json.loads(li) for li in
+             capsys.readouterr().out.splitlines()]
+    by_row = {v["row"]: v for v in lines}
+    assert by_row["wire_bytes_r2c"]["direction"] == "lower-is-better"
+    assert by_row["fused_r2c"]["direction"] == "higher-is-better"
+
+    untrimmed = _write_symmetry(tmp_path / "bad.json", 0.0106,
+                                92164352, 2)
+    assert bench_regress.main(["--fresh", untrimmed,
+                               "--against", ref]) == 1
+    capsys.readouterr()
+
+
+def test_fused_r2c_row_gates_the_decline(tmp_path, capsys):
+    """A fused r2c seam dropping back to declined (2 -> 1 active) trips
+    the rate-direction comparison on its own."""
+    ref = _write_symmetry(tmp_path / "ref.json", 0.0106, 46084864, 2)
+    declined = _write_symmetry(tmp_path / "bad.json", 0.0106,
+                               46084864, 1)
+    assert bench_regress.main(["--fresh", declined,
+                               "--against", ref]) == 1
+    by_row = {v["row"]: v for v in
+              (json.loads(li) for li in
+               capsys.readouterr().out.splitlines())}
+    assert not by_row["fused_r2c"]["ok"]

@@ -1,0 +1,126 @@
+"""Distributed fused decompress+z-DFT twin (parallel/dist.py
+``_init_fused_dist``): the backward's local pre-exchange stage —
+decompress gather, r2c (0,0)-stick hermitian completion and z-IFFT —
+as ONE Pallas launch per shard, A/B'd bit-exact against the two-launch
+path in interpret mode on the virtual CPU mesh (the same lane as
+test_fused_kernel.py's local A/B)."""
+
+import numpy as np
+import pytest
+
+from spfft_tpu import ExchangeType, TransformType
+from spfft_tpu.parallel import make_distributed_plan, make_mesh
+from spfft_tpu.utils.workloads import sort_triplets_stick_major
+
+from test_distributed import split_by_sticks, split_planes
+from test_util import dense_forward, hermitian_triplets, sample_cube
+
+DIMS = (8, 6, 128)  # dim_z % 128 == 0: the fused eligibility floor
+
+
+@pytest.fixture
+def fused_env(monkeypatch):
+    """The CPU fused lane: mdft T pipeline forced on (the fused seam
+    only exists there) and the fused kernels in interpret mode."""
+    monkeypatch.setenv("SPFFT_TPU_FORCE_MATMUL_DFT", "1")
+    monkeypatch.setenv("SPFFT_TPU_FUSED_INTERPRET", "1")
+
+
+def _parts_planes(ttype, seed=11):
+    rng = np.random.default_rng(seed)
+    nx, ny, nz = DIMS
+    if ttype is TransformType.R2C:
+        trips = hermitian_triplets(rng, DIMS)
+    else:
+        pts = np.stack([rng.integers(0, nx, 300), rng.integers(0, ny, 300),
+                        rng.integers(0, nz, 300)], 1)
+        trips = np.unique(pts, axis=0)
+    parts = [sort_triplets_stick_major(p, DIMS)
+             for p in split_by_sticks(trips, DIMS, [2, 1])]
+    return parts, split_planes(nz, [1, 1])
+
+
+def _build(ttype, parts, planes, fused, **kw):
+    import os
+    old = os.environ.get("SPFFT_TPU_FUSED_COMPRESS")
+    os.environ["SPFFT_TPU_FUSED_COMPRESS"] = "1" if fused else "0"
+    try:
+        return make_distributed_plan(
+            ttype, *DIMS, parts, planes, mesh=make_mesh(2),
+            precision="single", use_pallas=True,
+            overlap_chunks=kw.pop("overlap_chunks", 1), **kw)
+    finally:
+        if old is None:
+            os.environ.pop("SPFFT_TPU_FUSED_COMPRESS", None)
+        else:
+            os.environ["SPFFT_TPU_FUSED_COMPRESS"] = old
+
+
+@pytest.mark.parametrize("ttype", [TransformType.R2C, TransformType.C2C])
+@pytest.mark.parametrize("exchange", [ExchangeType.BUFFERED,
+                                      ExchangeType.COMPACT_BUFFERED])
+def test_dist_fused_backward_bit_exact(fused_env, ttype, exchange):
+    """Fused pre-exchange stage == two-launch path, to the bit, for both
+    transform types and both monolithic exchange kinds — the zero stick's
+    in-kernel completion included (R2C shard 0 owns (0,0))."""
+    parts, planes = _parts_planes(ttype)
+    rng = np.random.default_rng(3)
+    nz, ny, nx = DIMS[2], DIMS[1], DIMS[0]
+    freq = dense_forward(rng.uniform(-1, 1, (nz, ny, nx)))
+    vals = [sample_cube(freq, p, DIMS).astype(np.complex64) for p in parts]
+
+    plan = _build(ttype, parts, planes, fused=True, exchange=exchange)
+    assert plan.fused_dist_active, plan.fused_dist_fallback_reason
+    assert plan.fused_dist_fallback_reason is None
+    ref_plan = _build(ttype, parts, planes, fused=False, exchange=exchange)
+    assert not ref_plan.fused_dist_active
+
+    got = np.concatenate(plan.unshard_space(plan.backward(vals)), axis=0)
+    ref = np.concatenate(
+        ref_plan.unshard_space(ref_plan.backward(vals)), axis=0)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_dist_fused_batched_and_pair_bit_exact(fused_env):
+    """The batched-grid launch and the fused pointwise pair body (which
+    slices ftables past ptables+ctables) both route through the twin."""
+    parts, planes = _parts_planes(TransformType.R2C)
+    rng = np.random.default_rng(5)
+    nz, ny, nx = DIMS[2], DIMS[1], DIMS[0]
+    freq = dense_forward(rng.uniform(-1, 1, (nz, ny, nx)))
+    vals = [sample_cube(freq, p, DIMS).astype(np.complex64) for p in parts]
+
+    plan = _build(TransformType.R2C, parts, planes, fused=True)
+    assert plan.fused_dist_active, plan.fused_dist_fallback_reason
+    ref_plan = _build(TransformType.R2C, parts, planes, fused=False)
+
+    batch = [[(v * (b + 1)).astype(np.complex64) for v in vals]
+             for b in range(3)]
+    got_b = np.asarray(plan.backward_batched(plan.shard_values_batch(batch)))
+    ref_b = np.asarray(
+        ref_plan.backward_batched(ref_plan.shard_values_batch(batch)))
+    np.testing.assert_array_equal(got_b, ref_b)
+
+    got_p = np.asarray(plan.apply_pointwise(plan.shard_values(vals)))
+    ref_p = np.asarray(
+        ref_plan.apply_pointwise(ref_plan.shard_values(vals)))
+    np.testing.assert_array_equal(got_p, ref_p)
+
+
+def test_dist_fused_overlap_declines_with_reason(fused_env):
+    """overlap_chunks > 1 needs per-chunk stick slices between the z-stage
+    and the exchange — the fused twin declines and records why."""
+    parts, planes = _parts_planes(TransformType.R2C)
+    plan = _build(TransformType.R2C, parts, planes, fused=True,
+                  overlap_chunks=2)
+    assert not plan.fused_dist_active
+    assert plan.fused_dist_fallback_reason == "overlap_chunks"
+
+
+def test_dist_fused_off_when_disabled(fused_env):
+    """SPFFT_TPU_FUSED_COMPRESS=0 keeps the twin silently out of play
+    (no fallback reason — it was never eligible to record one)."""
+    parts, planes = _parts_planes(TransformType.R2C)
+    plan = _build(TransformType.R2C, parts, planes, fused=False)
+    assert not plan.fused_dist_active
+    assert plan.fused_dist_fallback_reason is None

@@ -210,15 +210,18 @@ def test_plan_batched_fused(fused_env):
 
 
 def test_plan_r2c_fused(fused_env):
-    """R2C without a (0,0) stick: both directions fuse; with it, the
-    backward direction falls back (hermitian completion runs between
-    decompress and the z stage) while forward stays fused — both
-    bit-exact vs the unfused composition."""
+    """R2C fuses BOTH directions whether or not the (0,0) stick is
+    present: its hermitian completion now rides inside the backward
+    kernel (the one-hot mirror contraction of
+    fused_kernel._complete_zero_stick), so ``fused_active`` holds with
+    no recorded reason and both variants stay bit-exact vs the unfused
+    composition."""
     nx, ny = 8, 6
     no_zero = [(x, y, z) for x in range(nx // 2 + 1) for y in range(ny)
                if (x, y) != (0, 0) for z in range(0, DIM_Z, 2)]
     plan = _plan(no_zero, ttype=TransformType.R2C)
     assert plan.fused_active and plan.fused_fallback_reasons == {}
+    assert plan._fused["dec"].zinfo is None  # no (0,0) stick to complete
     vals = _values(len(no_zero), seed=5)
     space = np.asarray(plan.backward(vals))
     np.testing.assert_allclose(space, _unfused_backward(plan, vals),
@@ -230,16 +233,33 @@ def test_plan_r2c_fused(fused_env):
     with_zero = [(x, y, z) for x in range(nx // 2 + 1) for y in range(ny)
                  for z in range(0, DIM_Z, 2)]
     plan_z = _plan(with_zero, ttype=TransformType.R2C)
-    assert plan_z.fused_fallback_reasons.get("dec") \
-        == "hermitian_completion"
+    assert plan_z.fused_active and plan_z.fused_fallback_reasons == {}
+    assert plan_z._fused["dec"] is not None
+    assert plan_z._fused["dec"].zinfo is not None
     assert plan_z._fused["cmp"] is not None
     vz = _values(len(with_zero), seed=6)
     sz = np.asarray(plan_z.backward(vz))
-    np.testing.assert_allclose(sz, _unfused_backward(plan_z, vz),
-                               rtol=2e-6, atol=2e-6)
+    np.testing.assert_array_equal(sz, _unfused_backward(plan_z, vz))
     oz = np.asarray(plan_z.forward(sz))
     np.testing.assert_allclose(oz, _unfused_forward(plan_z, sz, False),
                                rtol=2e-6, atol=2e-6)
+
+
+def test_plan_r2c_fused_batched_zero_stick(fused_env):
+    """The batched backward grid completes the (0,0) stick per slab,
+    bit-exactly vs per-slab unfused execution."""
+    nx, ny = 8, 6
+    with_zero = [(x, y, z) for x in range(nx // 2 + 1) for y in range(ny)
+                 for z in range(0, DIM_Z, 2)]
+    plan = _plan(with_zero, ttype=TransformType.R2C)
+    assert plan.fused_active, plan.fused_fallback_reasons
+    rng = np.random.default_rng(21)
+    B, N = 3, plan.num_local_elements
+    vb = rng.standard_normal((B, N, 2)).astype(np.float32)
+    got = np.asarray(plan.backward_batched(vb))
+    for b in range(B):
+        np.testing.assert_array_equal(got[b],
+                                      _unfused_backward(plan, vb[b]))
 
 
 def test_plan_empty_sticks_zeroed(fused_env):

@@ -24,8 +24,9 @@ def test_stick_ordering_matches_reference():
         [1, 0, 2],   # key 4
         [0, 3, 0],   # key 3 (same stick)
     ])
-    vi, keys, centered = convert_index_triplets(False, 3, 4, 5, triplets)
+    vi, keys, centered, conj = convert_index_triplets(False, 3, 4, 5, triplets)
     assert not centered
+    assert conj is None
     np.testing.assert_array_equal(keys, [3, 4, 9])
     # value flat index = stick_id * dimZ + z (reference: indices.hpp:168-176)
     np.testing.assert_array_equal(vi, [2 * 5 + 0, 0 * 5 + 1, 1 * 5 + 2,
@@ -36,8 +37,9 @@ def test_centered_detection_and_conversion():
     # Any negative index flips the whole set to centered interpretation
     # (reference: indices.hpp:129-135).
     triplets = np.array([[0, 0, 0], [-1, 2, -3]])
-    vi, keys, centered = convert_index_triplets(False, 8, 8, 8, triplets)
+    vi, keys, centered, conj = convert_index_triplets(False, 8, 8, 8, triplets)
     assert centered
+    assert conj is None
     # storage: (-1 -> 7), z: -3 -> 5
     np.testing.assert_array_equal(keys, [0, 7 * 8 + 2])
     np.testing.assert_array_equal(vi, [0, 1 * 8 + 5])
@@ -60,8 +62,51 @@ def test_hermitian_bounds():
     convert_index_triplets(True, 8, 8, 8, np.array([[4, 7, 7]]))
     with pytest.raises(InvalidIndicesError):
         convert_index_triplets(True, 8, 8, 8, np.array([[5, 0, 0]]))
-    with pytest.raises(InvalidIndicesError):
-        convert_index_triplets(True, 8, 8, 8, np.array([[-1, 0, 0]]))
+
+
+def test_hermitian_negative_x_folds_to_mirror():
+    # x < 0 hermitian triplets canonicalise onto the conjugate mirror
+    # (-x, -y, -z) instead of being rejected: (-1, 2, -3) and (1, -2, 3)
+    # are the same stored value up to conjugation.
+    tr = np.array([[1, -2, 3], [-1, 2, -3]])
+    vi, keys, centered, conj = convert_index_triplets(True, 8, 8, 8, tr)
+    assert centered
+    np.testing.assert_array_equal(conj, [False, True])
+    # Both rows land on the same stick and the same flat value index.
+    np.testing.assert_array_equal(keys, [1 * 8 + 6])
+    np.testing.assert_array_equal(vi, [3, 3])
+
+
+def test_hermitian_fold_edge_dimension_half():
+    # The mirror of a valid -N/2 edge index is +N/2, which is the SAME
+    # storage index; the fold must normalise it back so the bounds check
+    # (which rejects a user-supplied +N/2 in centered mode) still accepts
+    # the mirror of a valid edge value.
+    tr = np.array([[-1, -4, -4]])
+    vi, keys, centered, conj = convert_index_triplets(True, 8, 8, 8, tr)
+    assert centered
+    np.testing.assert_array_equal(conj, [True])
+    # mirror: x 1, y 4 -> -4 (storage 4), z 4 -> -4 (storage 4)
+    np.testing.assert_array_equal(keys, [1 * 8 + 4])
+    np.testing.assert_array_equal(vi, [4])
+
+
+def test_hermitian_fold_matches_explicit_mirror_plan():
+    # A folded full-sphere set builds the identical stick table as the
+    # hand-canonicalised non-redundant half.
+    rng = np.random.default_rng(7)
+    half = np.unique(
+        np.stack([rng.integers(1, 4, 40), rng.integers(-3, 4, 40),
+                  rng.integers(-3, 4, 40)], axis=1), axis=0)
+    full = np.concatenate([half, -half])
+    vi_f, keys_f, cen_f, conj_f = convert_index_triplets(True, 8, 8, 8, full)
+    vi_h, keys_h, cen_h, conj_h = convert_index_triplets(True, 8, 8, 8, half)
+    assert conj_h is None
+    np.testing.assert_array_equal(keys_f, keys_h)
+    np.testing.assert_array_equal(vi_f[:len(half)], vi_h)
+    np.testing.assert_array_equal(vi_f[len(half):], vi_h)
+    np.testing.assert_array_equal(conj_f, [False] * len(half)
+                                  + [True] * len(half))
 
 
 def test_too_many_values_rejected():

@@ -89,10 +89,18 @@ def test_plan_indices_bounds(bad):
         build_index_plan(TransformType.C2C, 4, 4, 4, bad)
 
 
-def test_hermitian_negative_x_rejected():
+def test_hermitian_negative_x_folds_onto_mirror():
+    # round 15: negative-x r2c triplets are no longer rejected — they
+    # fold onto the conjugate mirror stick (value_conj marks the read
+    # as conjugated), so full-sphere inputs build trimmed plans
+    p = build_index_plan(TransformType.R2C, 8, 8, 8,
+                         np.array([[-1, 0, 0]]))
+    assert p.stick_x.tolist() == [1] and p.stick_y.tolist() == [0]
+    assert p.value_conj is not None and p.value_conj.tolist() == [True]
+    # out-of-range x is still a bounds error after the fold
     with pytest.raises(InvalidIndicesError):
         build_index_plan(TransformType.R2C, 8, 8, 8,
-                         np.array([[-1, 0, 0]]))
+                         np.array([[-5, 0, 0]]))
 
 
 def test_inverse_map_parity():
