@@ -119,11 +119,14 @@ control-smoke:
 # Fused compression+DFT smoke (docs/kernels.md): the interpret-mode
 # bit-exactness + fallback-gate suite for ops/fused_kernel.py, then a
 # benchmark.py --fused run whose JSON must report the fused path ACTIVE
-# with no gate declines. The same coverage runs in tier-1
-# (tests/test_fused_kernel.py, tests/test_benchmark_cli.py::
+# with no gate declines, then the distributed twin under the overlap
+# pipeline (K=2 compact exchange, r2c-trimmed stick set) which must
+# report BOTH fused directions active with no per-direction declines.
+# The same coverage runs in tier-1 (tests/test_fused_kernel.py,
+# tests/test_fused_dist.py, tests/test_benchmark_cli.py::
 # test_cli_fused_ab); on-chip bit-exactness + the profile evidence that
 # the dense stick intermediate is gone live in `make ci-tpu`
-# (test_fused_compression_dft_on_tpu).
+# (test_fused_compression_dft_on_tpu, test_fused_overlap_on_tpu).
 fused-smoke:
 	@echo "== fused-smoke: interpret-mode fused compression+DFT checks =="
 	@mkdir -p build
@@ -131,6 +134,11 @@ fused-smoke:
 	python -m spfft_tpu.benchmark -d 8 6 128 -r 1 --fused \
 	  -o build/fused_smoke.json
 	python -c "import json; p = json.load(open('build/fused_smoke.json'))['parameters']; assert p['fused'] and not p['fused_fallback'], p"
+	SPFFT_TPU_COMPACT_PPERMUTE=1 SPFFT_TPU_FUSED_RECOMPUTE_LIMIT=16 \
+	  python -m spfft_tpu.benchmark -d 8 6 128 -r 1 --fused --cpu \
+	  --shards 2 -e compact --overlap-chunks 2 --transform r2c \
+	  -o build/fused_dist_smoke.json
+	python -c "import json; p = json.load(open('build/fused_dist_smoke.json'))['parameters']; assert p['fused_dist'] and not p['fused_dist_fallback'] and p['overlap_chunks'] == 2, p"
 	@echo "FUSED-SMOKE GREEN"
 
 # Plan-artifact store smoke (docs/artifact_cache.md): the zero-cold-

@@ -148,14 +148,23 @@ def symmetry_inner() -> None:
     t = sort_triplets_stick_major(t, fd)
     local = make_local_plan(TransformType.R2C, *fd, t,
                             precision="single", use_pallas=True)
+    fparts = [sort_triplets_stick_major(p, fd)
+              for p in round_robin_stick_partition(t, fd, 2)]
+    fplanes = even_plane_split(fd[2], 2)
     dist = make_distributed_plan(
-        TransformType.R2C, *fd,
-        [sort_triplets_stick_major(p, fd)
-         for p in round_robin_stick_partition(t, fd, 2)],
-        even_plane_split(fd[2], 2), mesh=make_mesh(2),
+        TransformType.R2C, *fd, fparts, fplanes, mesh=make_mesh(2),
         precision="single", use_pallas=True)
+    # backward-twin activity, the seam this row has always counted
+    # (the forward twin reports through the fused_dist row below)
     active = int(bool(local.fused_active)) + int(bool(
-        dist.fused_dist_active))
+        dist.fused_dist_bwd_active))
+
+    # --- fused_dist: both fused directions composed with overlap ---
+    dist_ov = make_distributed_plan(
+        TransformType.R2C, *fd, fparts, fplanes, mesh=make_mesh(2),
+        precision="single", use_pallas=True, overlap_chunks=2)
+    dist_active = (int(bool(dist_ov.fused_dist_bwd_active))
+                   + int(bool(dist_ov.fused_dist_fwd_active)))
 
     print(json.dumps({
         "wire_bytes_r2c": {
@@ -178,6 +187,17 @@ def symmetry_inner() -> None:
                       f"dist={dist.fused_dist_fallback_reason})",
             "value": active,
             "unit": "seams",
+        },
+        "fused_dist": {
+            "metric": "distributed fused directions ACTIVE under the "
+                      "K=2 overlap pipeline (chunk-sliceable "
+                      "decompress+z-DFT backward + post-exchange "
+                      "z-DFT+compress forward twin; 2 = fusion and "
+                      "overlap compose in both directions, reasons: "
+                      f"bwd={dist_ov.fused_dist_fallback_reason} "
+                      f"fwd={dist_ov.fused_dist_fwd_fallback_reason})",
+            "value": dist_active,
+            "unit": "directions",
         },
     }))
 

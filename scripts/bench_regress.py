@@ -59,9 +59,14 @@ LOWER_IS_BETTER_UNITS = ("s", "ms", "us", "ns", "seconds", "bytes")
 #: (unit "seams", higher is better) counts the ACTIVE r2c fused seams
 #: on the interpret lane (local kernel + distributed twin, 2 when the
 #: hermitian_completion decline stays lifted); a drop below 2 trips
-#: the rate-direction comparison. Both emitted by bench.py every run.
+#: the rate-direction comparison. fused_dist (unit "directions",
+#: higher is better) counts the distributed fused directions active
+#: under the K=2 overlap pipeline (chunk-sliceable backward + forward
+#: twin; 2 = fusion and overlap compose both ways) — a drop means a
+#: gate regressed to declining the composition. All emitted by
+#: bench.py every run.
 SUB_ROWS = ("fused", "cold_start_ms", "warm_start_ms",
-            "wire_bytes_r2c", "fused_r2c")
+            "wire_bytes_r2c", "fused_r2c", "fused_dist")
 
 
 def load_payload(path: str) -> dict:

@@ -353,7 +353,9 @@ def _run(args) -> int:
                                      mesh=make_mesh(args.shards),
                                      precision=args.precision,
                                      exchange=exchange,
-                                     overlap_chunks=args.overlap_chunks)
+                                     overlap_chunks=args.overlap_chunks,
+                                     use_pallas=True if args.fused
+                                     else None)
         values_np = [
             (rng.uniform(-1, 1, len(p)) + 1j * rng.uniform(-1, 1, len(p)))
             .astype(cdt) for p in parts]
@@ -475,6 +477,17 @@ def _run(args) -> int:
         "fused": bool(getattr(plan, "fused_active", False)),
         "fused_fallback": dict(getattr(plan, "fused_fallback_reasons",
                                        None) or {}),
+        # distributed fused twins (both directions), with the decline or
+        # inactive:<why> reason disclosed per direction — the --fused
+        # --overlap-chunks crossed A/B reads these to explain a seam
+        # that did not engage
+        "fused_dist": bool(getattr(plan, "fused_dist_active", False)),
+        "fused_dist_fallback": {
+            k: v for k, v in
+            (("bwd", getattr(plan, "fused_dist_fallback_reason", None)),
+             ("fwd", getattr(plan, "fused_dist_fwd_fallback_reason",
+                             None)))
+            if v is not None},
         "plan_seconds": round(plan_s, 4),
         "pair_seconds": round(pair_s, 6),
     }

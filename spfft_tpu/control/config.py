@@ -141,6 +141,15 @@ KNOB_SPECS: Dict[str, KnobSpec] = {spec.name: spec for spec in (
              "spfft_store_{spills,evictions}_total",
              "Persistent plan-artifact store byte cap (oldest-first "
              "GC on spill; 0 = unbounded)."),
+    KnobSpec("fused_target_r", 64, 8, 512, int,
+             "measured chip profiles (offline retune)",
+             "Fused-kernel super-tile row target R: decompress+z-DFT "
+             "gather window sizing (ops/fused_kernel.py cost model)."),
+    KnobSpec("fused_recompute_limit", 4.0, 1.0, 64.0, float,
+             "spfft_plan_pallas_fallback_total{reason=recompute_blowup}",
+             "Fused compress recompute-blowup gate: decline when "
+             "windowed gather rows exceed this multiple of the stick "
+             "count."),
 )}
 
 #: String-valued settings (paths) the numeric KnobSpec clamp cannot

@@ -44,7 +44,10 @@ METRIC_SPECS: Dict[str, Tuple[str, str]] = {
         ("counter", "Seconds spent constructing plans."),
     "spfft_plan_pallas_fallback_total":
         ("counter",
-         "Plan-time Pallas fallback decisions by stage and reason."),
+         "Plan-time Pallas fallback decisions by stage and reason. "
+         "Stages: decompress, compress, fused_decompress_zdft, "
+         "fused_zdft_compress, dist_fused_decompress_zdft, "
+         "dist_fused_zdft_compress."),
     # distributed exchange accounting
     "spfft_exchange_plans_total":
         ("counter", "Distributed plans constructed."),

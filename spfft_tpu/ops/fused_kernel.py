@@ -70,7 +70,8 @@ from .gather_kernel import (TILE, TILE_LANE, TILE_SUB,
 #: Target stick rows per backward super-tile: large enough that the
 #: per-super-tile (r, dim_z) x (dim_z, dim_z) Karatsuba dot keeps the
 #: MXU busy (>= 64 rows), small enough that the accumulation scratch
-#: stays a footnote in the VMEM budget.
+#: stays a footnote in the VMEM budget. Default for :func:`target_r`;
+#: override per-experiment with ``SPFFT_TPU_FUSED_TARGET_R``.
 TARGET_R = 64
 
 #: Hard cap on 1024-slot tiles per super-tile (scratch rows =
@@ -83,7 +84,36 @@ MAX_P_TILES = 64
 #: exceed this multiple of the unfused single pass (num_sticks rows) —
 #: past it the DFT recompute outweighs the saved HBM round trip of the
 #: transformed stick array (2 * num_sticks * dim_z * 8 bytes).
+#: Default for :func:`recompute_limit`; override per-experiment with
+#: ``SPFFT_TPU_FUSED_RECOMPUTE_LIMIT``.
 RECOMPUTE_LIMIT = 4.0
+
+
+def target_r() -> int:
+    """Effective backward super-tile row target: the
+    ``SPFFT_TPU_FUSED_TARGET_R`` env override (clamped to [8, 512],
+    read per plan build so chip-profile retuning needs no code change)
+    or :data:`TARGET_R`."""
+    raw = os.environ.get("SPFFT_TPU_FUSED_TARGET_R", "").strip()
+    if raw:
+        try:
+            return max(8, min(int(raw), 512))
+        except ValueError:
+            pass
+    return TARGET_R
+
+
+def recompute_limit() -> float:
+    """Effective forward recompute ceiling: the
+    ``SPFFT_TPU_FUSED_RECOMPUTE_LIMIT`` env override (clamped to
+    [1.0, 64.0], read per plan build) or :data:`RECOMPUTE_LIMIT`."""
+    raw = os.environ.get("SPFFT_TPU_FUSED_RECOMPUTE_LIMIT", "").strip()
+    if raw:
+        try:
+            return max(1.0, min(float(raw), 64.0))
+        except ValueError:
+            pass
+    return RECOMPUTE_LIMIT
 
 #: Per-kernel VMEM budget the geometry chooser stays under — matches
 #: the single-stage DFT kernel's empirically-calibrated ceiling
@@ -126,7 +156,7 @@ def super_tile_geometry(dim_z: int):
     g = math.gcd(dim_z, TILE)
     r_min = TILE // g          # sticks per minimal super-tile
     p_min = dim_z // g         # 1024-slot tiles per minimal super-tile
-    k = max(1, -(-TARGET_R // r_min))
+    k = max(1, -(-target_r() // r_min))
     k = min(k, max(1, MAX_P_TILES // p_min))
     return r_min * k, p_min * k
 
@@ -267,7 +297,7 @@ def build_fused_compress_tables(t: MonotoneGatherTables, dim_z: int,
     win_sticks = -(-t.span_rows // q) + 1
     if not _fits_forward(dim_z, win_sticks, t.span_rows):
         return "vmem"
-    if compress_recompute_rows(t, dim_z) > RECOMPUTE_LIMIT \
+    if compress_recompute_rows(t, dim_z) > recompute_limit() \
             * max(int(num_sticks), 1):
         return "recompute_blowup"
     # window rows [row0, row0+K) of the flat (rows, 128) transformed

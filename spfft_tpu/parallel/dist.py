@@ -253,6 +253,22 @@ class DistributedTransformPlan:
                 overlap_chunks, k_eff)
         self.overlap_chunks = k_eff
         self._overlap = None
+        # Fused-plausible plans snap the backward chunk bounds to
+        # super-tile multiples (overlap.chunk_bounds_aligned) so the
+        # per-chunk fused decompress+z-DFT launches waste no partial
+        # super-tile at chunk seams. Cheap pre-check only — the full
+        # gate runs in _init_fused_dist once the schedule exists; the
+        # per-chunk table sets handle unaligned bounds too, so a
+        # later decline costs nothing.
+        from ..ops import fused_kernel as _fkm
+        stick_align = 1
+        if (k_eff > 1 and _fkm.enabled()
+                and (jax.default_backend() == "tpu"
+                     or _fkm.interpret_forced())
+                and use_pallas is not False
+                and self.precision == "single"
+                and _fkm.eligible_dim(dist_plan.dim_z) is None):
+            stick_align = _fkm.super_tile_geometry(dist_plan.dim_z)[0]
         use_ppermute_compact = _os.environ.get(
             "SPFFT_TPU_COMPACT_PPERMUTE") == "1"
         if self.exchange.compact:
@@ -260,19 +276,22 @@ class DistributedTransformPlan:
                 if k_eff > 1:
                     self._overlap = build_overlap_schedule(
                         dist_plan, k_eff, "ragged",
-                        x_window=self._split_x)
+                        x_window=self._split_x,
+                        stick_align=stick_align)
                 else:
                     self._ragged = build_ragged_schedule(
                         dist_plan, x_window=self._split_x)
             elif k_eff > 1 and dist_plan.num_shards > 1:
                 self._overlap = build_overlap_schedule(
-                    dist_plan, k_eff, "compact", x_window=self._split_x)
+                    dist_plan, k_eff, "compact", x_window=self._split_x,
+                    stick_align=stick_align)
             else:
                 self._compact = build_compact_schedule(
                     dist_plan, x_window=self._split_x)
         elif k_eff > 1:
             self._overlap = build_overlap_schedule(dist_plan, k_eff,
-                                                   "block")
+                                                   "block",
+                                                   stick_align=stick_align)
         # SPFFT_TPU_FORCE_RAGGED_OP=1 lowers the REAL ragged op off-TPU
         # (XLA:CPU can lower it but not execute it) — used by the HLO
         # launch-count checks in tests and scripts/scaling_model.py.
@@ -334,21 +353,35 @@ class DistributedTransformPlan:
             self._n_ctables = len(ctables)
             self._device_tables = self._device_tables + tuple(
                 jax.device_put(a, self._sharded) for a in ctables)
-        # Fused decompress + z-DFT twin for the non-overlapped backward
-        # (ops/fused_kernel.py): tables appended LAST so the bodies keep
-        # slicing ptables/ctables by the existing counts.
+        # Fused local-stage twins (ops/fused_kernel.py): the backward
+        # decompress+z-DFT (one table set PER OVERLAP CHUNK) and the
+        # forward z-DFT+compress. Tables appended LAST — backward set
+        # then forward set — so the bodies keep slicing
+        # ptables/ctables by the existing counts.
         self._init_fused_dist(use_pallas)
-        self._n_ftables = 0
+        self._init_fused_dist_fwd(use_pallas)
+        self._n_fb = 0
+        self._n_ff = 0
         fused_specs = ()
         if self._fused_dist is not None:
             fd = self._fused_dist
-            self._n_ftables = len(fd["stacked"]) + len(fd["mats"])
-            fused_specs = ((P(self.axis_name),) * len(fd["stacked"])
-                           + (P(),) * len(fd["mats"]))
+            self._n_fb = len(fd["stacked"]) + len(fd["mats"])
+            fused_specs += ((P(self.axis_name),) * len(fd["stacked"])
+                            + (P(),) * len(fd["mats"]))
             self._device_tables = self._device_tables + tuple(
                 jax.device_put(a, self._sharded)
                 for a in fd["stacked"]) + tuple(
                 jax.device_put(m, self._replicated) for m in fd["mats"])
+        if self._fused_dist_fwd is not None:
+            ff = self._fused_dist_fwd
+            self._n_ff = len(ff["stacked"]) + len(ff["mats"])
+            fused_specs += ((P(self.axis_name),) * len(ff["stacked"])
+                            + (P(),) * len(ff["mats"]))
+            self._device_tables = self._device_tables + tuple(
+                jax.device_put(a, self._sharded)
+                for a in ff["stacked"]) + tuple(
+                jax.device_put(m, self._replicated) for m in ff["mats"])
+        self._n_ftables = self._n_fb + self._n_ff
         # Comm-size-1 collapse (reference: grid_internal.cpp:182 treats a
         # size-1 communicator as local): single-shard plans EXECUTE
         # through the local pipeline (planar T-layout matmul-DFT, stick
@@ -384,7 +417,8 @@ class DistributedTransformPlan:
         # vma consistency check must be off when the kernel is in the body;
         # XLA-path plans keep the check (specs pin every sharding anyway)
         self._check_vma = (self._pallas_dist is None
-                           and self._fused_dist is None)
+                           and self._fused_dist is None
+                           and self._fused_dist_fwd is None)
         shmap = functools.partial(
             shard_map, mesh=self.mesh, in_specs=self._base_in_specs,
             out_specs=P(self.axis_name), check_vma=self._check_vma)
@@ -646,6 +680,32 @@ class DistributedTransformPlan:
                 interpret=self._pallas_interpret)
         return gk.interleaved_from_planar(out_re, out_im, t["num_out"])
 
+    def _fused_inactive_why(self, use_pallas: Optional[bool]) -> Optional[str]:
+        """Shared activation envelope for BOTH distributed fused local
+        stages (backward decompress+z-DFT, forward z-DFT+compress):
+        returns the ``inactive:<why>`` introspection value when the
+        fused kernels were never in play for this configuration — a
+        by-design inactivity, reported through the fallback-reason
+        properties but NOT counted as a plan fallback — or None when
+        the builds should proceed to the real eligibility gates."""
+        from ..ops import fused_kernel as fkm
+        dp = self.dist_plan
+        if not fkm.enabled():
+            return "inactive:env_disabled"
+        if not (jax.default_backend() == "tpu" or fkm.interpret_forced()):
+            return "inactive:backend"
+        if use_pallas is False:
+            return "inactive:use_pallas_false"
+        if self.precision != "single":
+            return "inactive:precision"
+        if dp.max_values == 0 or dp.max_sticks == 0:
+            return "inactive:empty"
+        if (use_pallas is None and not fkm.interpret_forced()
+                and dp.max_values < 200_000):
+            # below the kernel-vs-XLA crossover (_init_pallas)
+            return "inactive:below_crossover"
+        return None
+
     def _init_fused_dist(self, use_pallas: Optional[bool]) -> None:
         """Fused decompress + z-DFT tables for the distributed backward's
         local pre-exchange stage: one ``run_decompress_zdft`` launch
@@ -655,10 +715,15 @@ class DistributedTransformPlan:
         runs, ops/fused_kernel.py). Shape-uniform per-shard tables (a
         common DMA window height, chunk counts padded with no-op chunks
         routed to one dummy output super-tile) keep the SPMD body a
-        single program. Gated by the same eligibility/cost model as the
-        local fusion; every decline that keeps an otherwise-kernel-ready
-        plan on the two-launch path is recorded as a
-        ``dist_fused_decompress_zdft`` fallback reason."""
+        single program. With ``overlap_chunks > 1`` one table set is
+        built PER OVERLAP CHUNK (restricted to that chunk's stick rows)
+        so the pipeline keeps one fused launch per chunk with each
+        chunk's collective issued as its sticks emerge — the monolithic
+        plan is simply the single-chunk case of the same build. Gated by
+        the same eligibility/cost model as the local fusion; every
+        decline that keeps an otherwise-kernel-ready plan on the
+        two-launch path is recorded as a ``dist_fused_decompress_zdft``
+        fallback reason."""
         from .. import obs as _obs
         from ..ops import dft as _dft
         from ..ops import fused_kernel as fkm
@@ -667,19 +732,11 @@ class DistributedTransformPlan:
         dp = self.dist_plan
         self._fused_dist = None
         self._fused_dist_reason = None
+        self._fused_dist_inactive = self._fused_inactive_why(use_pallas)
+        if self._fused_dist_inactive is not None:
+            return
         backend_ok = jax.default_backend() == "tpu"
-        # Silent returns: configurations where the fused kernel was never
-        # in play (mirrors _init_pallas's activation envelope).
-        if not fkm.enabled() or not (backend_ok or fkm.interpret_forced()):
-            return
-        if use_pallas is False or self.precision != "single":
-            return
         ms, mv, dim_z = dp.max_sticks, dp.max_values, dp.dim_z
-        if mv == 0 or ms == 0:
-            return
-        if (use_pallas is None and not fkm.interpret_forced()
-                and mv < 200_000):
-            return  # below the kernel-vs-XLA crossover (_init_pallas)
 
         def decline(reason: str) -> None:
             self._fused_dist_reason = reason
@@ -691,10 +748,6 @@ class DistributedTransformPlan:
 
         if not _dft.use_matmul_dft(dim_z, np.dtype(np.complex64)):
             return decline("no_matmul_dft")
-        if self._overlap is not None:
-            # the fused launch transforms whole super-tiles; the overlap
-            # pipeline needs per-chunk stick slices between z and exchange
-            return decline("overlap_chunks")
         reason = fkm.eligible_dim(dim_z)
         if reason:
             return decline(reason)
@@ -702,92 +755,265 @@ class DistributedTransformPlan:
         per = [gk.compression_gather_inputs(p.value_indices, num_slots,
                                             pad_values_to=mv)[0]
                for p in dp.shard_plans]
-        tables = [gk.build_monotone_gather_tables(idx, valid, mv,
+        # One table set per overlap chunk, each restricted to the
+        # chunk's stick rows [s0, s1). A chunk slice of a stick-major
+        # monotone index sequence is itself monotone, and every chunk
+        # launch reads from the SAME full-height planar value source,
+        # so num_src stays mv throughout.
+        bounds = (self._overlap.stick_bounds()
+                  if self._overlap is not None else ((0, ms),))
+
+        def build(r, s0, s1, k_rows=0):
+            idx, valid = per[r]
+            return gk.build_monotone_gather_tables(
+                idx[s0 * dim_z:s1 * dim_z], valid[s0 * dim_z:s1 * dim_z],
+                mv, k_rows=k_rows, allow_segments=False)
+
+        chunk_tabs = []
+        for s0, s1 in bounds:
+            tabs = [build(r, s0, s1) for r in range(dp.num_shards)]
+            if any(t is None for t in tabs):
+                return decline("value_order")
+            chunk_tabs.append(tabs)
+        # force one DMA window height K across shards AND chunks
+        # (selector words encode (row, lane, valid) independent of K, so
+        # rebuilding the smaller-span sets under the max is exact)
+        k_u = max(t.span_rows for tabs in chunk_tabs for t in tabs)
+        chunk_tabs = [
+            [t if t.span_rows == k_u else build(r, s0, s1, k_rows=k_u)
+             for r, t in enumerate(tabs)]
+            for (s0, s1), tabs in zip(bounds, chunk_tabs)]
+        if any(t is None for tabs in chunk_tabs for t in tabs):
+            return decline("value_order")
+        # one padded planar source height feeds every chunk's launch
+        src_rows = max(t.src_rows for tabs in chunk_tabs for t in tabs)
+        chunks = []
+        stacked_all: list = []
+        for (s0, s1), tabs in zip(bounds, chunk_tabs):
+            fused = []
+            for r, t in enumerate(tabs):
+                zid = (dp.shard_plans[r].zero_stick_id
+                       if dp.hermitian else None)
+                # hermitian completion is within-stick, so the zero
+                # stick completes inside whichever chunk slices it
+                zc = (zid - s0 if zid is not None and s0 <= zid < s1
+                      else None)
+                ft = fkm.build_fused_decompress_tables(
+                    t, dim_z, s1 - s0, zero_stick_id=zc)
+                if isinstance(ft, str):
+                    return decline(ft)
+                fused.append(ft)
+            # num_super/p_tiles/r_sticks are uniform across shards (the
+            # chunk's slot count (s1-s0)*dim_z is common); the
+            # zero-stick owner differs, so non-owners get the
+            # never-matching (-1) zinfo sentinel and the static
+            # `complete` flag stays shard-invariant.
+            complete = any(f.zinfo is not None for f in fused)
+            num_super = fused[0].num_super
+            c_max = max(f.row0.shape[0] for f in fused)
+
+            def pad(f):
+                p_ = c_max - f.row0.shape[0]
+                # no-op padding chunks: all-invalid selector words gather
+                # zeros, never first/last, and target the DUMMY
+                # super-tile ``num_super`` so the flush-on-block-change
+                # at the real->pad boundary lands outside the sliced
+                # result.
+                return (np.concatenate([f.row0, np.zeros(p_, np.int32)]),
+                        np.concatenate([f.pos, np.zeros(p_, np.int32)]),
+                        np.concatenate([f.sfirst, np.zeros(p_, np.int32)]),
+                        np.concatenate([f.slast, np.zeros(p_, np.int32)]),
+                        np.concatenate([f.sup,
+                                        np.full(p_, num_super, np.int32)]),
+                        np.concatenate([f.packed,
+                                        np.zeros((p_, 8, 128), np.int32)]))
+
+            padded = [pad(f) for f in fused]
+            stacked = [np.stack([p_[i] for p_ in padded])
+                       for i in range(6)]
+            if complete:
+                stacked.append(np.stack([
+                    f.zinfo if f.zinfo is not None
+                    else np.array([-1, 0], np.int32) for f in fused]))
+            rep = dataclasses.replace(
+                fused[0], row0=padded[0][0], pos=padded[0][1],
+                sfirst=padded[0][2], slast=padded[0][3], sup=padded[0][4],
+                packed=padded[0][5], num_super=num_super + 1,
+                src_rows=src_rows, span_rows=k_u, num_sticks=s1 - s0,
+                zinfo=(np.array([-1, 0], np.int32) if complete else None))
+            chunks.append({"rep": rep, "t0": len(stacked_all),
+                           "t1": len(stacked_all) + len(stacked),
+                           "n_sticks": s1 - s0})
+            stacked_all.extend(stacked)
+        self._fused_dist = {
+            "chunks": chunks, "stacked": stacked_all,
+            "n_tabs": len(stacked_all), "src_rows": src_rows,
+            "mats": fkm.commit_mats(_dft.c2c_mats(dim_z, _dft.BACKWARD)),
+            "interpret": not backend_ok,
+        }
+
+    def _fused_bwd_chunk_sticks(self, vals, xtables):
+        """Per-shard fused decompress + (0,0)-stick completion + z-IFFT,
+        ONE ``run_decompress_zdft`` launch per overlap chunk (one total
+        for monolithic plans): the drop-in for ``_decompress_shard``
+        followed by ``_bwd_pre_exchange``. ``vals`` is (mv, 2)
+        interleaved — or batched (B, mv, 2) through the batched kernel
+        grid. Returns the list of per-chunk complex z-transformed stick
+        arrays (..., stick_hi - stick_lo, dim_z), chunk order matching
+        ``self._overlap.chunks``."""
+        from ..ops import fused_kernel as fkm
+        from ..ops import gather_kernel as gk
+        fd = self._fused_dist
+        ft = xtables[self._n_ptables + self._n_ctables:]
+        tabs = ft[:fd["n_tabs"]]
+        mats = ft[fd["n_tabs"]:fd["n_tabs"] + 3]      # replicated, as-is
+        re, im = gk.planar_from_interleaved(vals.astype(np.float32),
+                                            fd["src_rows"])
+        out = []
+        for ch in fd["chunks"]:
+            # drop the shard axis on this chunk's table slice
+            dev = tuple(a[0] for a in tabs[ch["t0"]:ch["t1"]])
+            sr, si = fkm.run_decompress_zdft(re, im, dev, mats, ch["rep"],
+                                             interpret=fd["interpret"])
+            n = ch["n_sticks"]
+            out.append((sr[..., :n, :]
+                        + 1j * si[..., :n, :]).astype(self._cdt))
+        return out
+
+    def _fused_dec_zdft_shard(self, vals, xtables):
+        """Monolithic (no-overlap) fused backward local stage: the
+        single-chunk case of :meth:`_fused_bwd_chunk_sticks`."""
+        return self._fused_bwd_chunk_sticks(vals, xtables)[0]
+
+    def _init_fused_dist_fwd(self, use_pallas: Optional[bool]) -> None:
+        """Fused z-DFT + compress tables for the distributed forward's
+        local post-exchange stage: one ``run_zdft_compress`` launch
+        replaces ``stages.z_forward`` + the compress gather — the dense
+        z-transformed stick array never round-trips through HBM (the
+        forward twin of :meth:`_init_fused_dist`, built with the same
+        shape-uniform per-shard machinery: a common DMA window height,
+        chunk counts padded with no-op chunks storing zeros into one
+        dummy output tile). A z-stick needs exchanged planes from EVERY
+        chunk, so this launch runs once, post-exchange; the chunked
+        overlap pipeline upstream (xy + exchange) keeps its
+        one-launch-per-chunk structure either way, which is why there is
+        no ``overlap_chunks`` decline here. Declines that keep an
+        otherwise-kernel-ready plan on the two-launch forward are
+        recorded as ``dist_fused_zdft_compress`` fallback reasons."""
+        from .. import obs as _obs
+        from ..ops import dft as _dft
+        from ..ops import fused_kernel as fkm
+        from ..ops import gather_kernel as gk
+
+        dp = self.dist_plan
+        self._fused_dist_fwd = None
+        self._fused_dist_fwd_reason = None
+        if self._fused_inactive_why(use_pallas) is not None:
+            return  # shared envelope, reported via _fused_dist_inactive
+        backend_ok = jax.default_backend() == "tpu"
+        ms, mv, dim_z = dp.max_sticks, dp.max_values, dp.dim_z
+
+        def decline(reason: str) -> None:
+            self._fused_dist_fwd_reason = reason
+            _obs.record_plan_fallback("dist_fused_zdft_compress", reason)
+            logger.info(
+                "spfft_tpu: distributed fused z-DFT+compress kernel "
+                "unavailable (%s) — keeping the two-launch forward",
+                reason)
+
+        if not _dft.use_matmul_dft(dim_z, np.dtype(np.complex64)):
+            return decline("no_matmul_dft")
+        reason = fkm.eligible_dim(dim_z)
+        if reason:
+            return decline(reason)
+        num_slots = ms * dim_z
+        per = [gk.compression_gather_inputs(p.value_indices, num_slots,
+                                            pad_values_to=mv)[1]
+               for p in dp.shard_plans]
+        tables = [gk.build_monotone_gather_tables(idx, valid, num_slots,
                                                   allow_segments=False)
                   for idx, valid in per]
         if any(t is None for t in tables):
             return decline("value_order")
-        # force one DMA window height K across shards (selector words
-        # encode (row, lane, valid) independent of K, so rebuilding the
-        # smaller-span shards under the max is exact)
+        # force one DMA window height K across shards (exact rebuild,
+        # same argument as the backward build)
         k_u = max(t.span_rows for t in tables)
         tables = [t if t.span_rows == k_u else
                   gk.build_monotone_gather_tables(
-                      per[r][0], per[r][1], mv, k_rows=k_u,
+                      per[r][0], per[r][1], num_slots, k_rows=k_u,
                       allow_segments=False)
                   for r, t in enumerate(tables)]
         if any(t is None for t in tables):
             return decline("value_order")
         fused = []
-        for r, t in enumerate(tables):
-            zid = dp.shard_plans[r].zero_stick_id if dp.hermitian else None
-            ft = fkm.build_fused_decompress_tables(t, dim_z, ms,
-                                                   zero_stick_id=zid)
+        for t in tables:
+            ft = fkm.build_fused_compress_tables(t, dim_z, ms)
             if isinstance(ft, str):
                 return decline(ft)
             fused.append(ft)
-        # num_super/p_tiles/r_sticks are uniform already (num_slots is the
-        # padded common max on every shard); the zero-stick owner differs,
-        # so non-owners get the never-matching (-1) zinfo sentinel and the
-        # static `complete` flag stays shard-invariant.
-        complete = any(f.zinfo is not None for f in fused)
-        num_super = fused[0].num_super
-        c_max = max(f.row0.shape[0] for f in fused)
-        src_rows = max(f.src_rows for f in fused)
+        # num_tiles and win_sticks are uniform (mv and the forced window
+        # height are common); src_sticks and chunk counts differ, so pad
+        # to the maxima with no-op chunks that store zeros into the
+        # DUMMY output tile ``num_tiles`` (all-invalid selector words
+        # gather zeros; first=1 so nothing accumulates onto garbage).
+        num_tiles = fused[0].num_tiles
+        c_max = max(f.s0.shape[0] for f in fused)
+        src_sticks = max(f.src_sticks for f in fused)
 
         def pad(f):
-            p_ = c_max - f.row0.shape[0]
-            # no-op padding chunks: all-invalid selector words gather
-            # zeros, never first/last, and target the DUMMY super-tile
-            # ``num_super`` so the flush-on-block-change at the real->pad
-            # boundary lands outside the sliced result.
-            return (np.concatenate([f.row0, np.zeros(p_, np.int32)]),
-                    np.concatenate([f.pos, np.zeros(p_, np.int32)]),
-                    np.concatenate([f.sfirst, np.zeros(p_, np.int32)]),
-                    np.concatenate([f.slast, np.zeros(p_, np.int32)]),
-                    np.concatenate([f.sup,
-                                    np.full(p_, num_super, np.int32)]),
+            p_ = c_max - f.s0.shape[0]
+            return (np.concatenate([f.s0, np.zeros(p_, np.int32)]),
+                    np.concatenate([f.off, np.zeros(p_, np.int32)]),
+                    np.concatenate([f.out_tile,
+                                    np.full(p_, num_tiles, np.int32)]),
+                    np.concatenate([f.first, np.ones(p_, np.int32)]),
                     np.concatenate([f.packed,
                                     np.zeros((p_, 8, 128), np.int32)]))
 
         padded = [pad(f) for f in fused]
-        stacked = [np.stack([p_[i] for p_ in padded]) for i in range(6)]
-        if complete:
-            stacked.append(np.stack([
-                f.zinfo if f.zinfo is not None
-                else np.array([-1, 0], np.int32) for f in fused]))
+        stacked = [np.stack([p_[i] for p_ in padded]) for i in range(5)]
         rep = dataclasses.replace(
-            fused[0], row0=padded[0][0], pos=padded[0][1],
-            sfirst=padded[0][2], slast=padded[0][3], sup=padded[0][4],
-            packed=padded[0][5], num_super=num_super + 1,
-            src_rows=src_rows, span_rows=k_u, num_sticks=ms,
-            zinfo=(np.array([-1, 0], np.int32) if complete else None))
-        self._fused_dist = {
+            fused[0], s0=padded[0][0], off=padded[0][1],
+            out_tile=padded[0][2], first=padded[0][3], packed=padded[0][4],
+            num_tiles=num_tiles + 1, src_sticks=src_sticks,
+            span_rows=k_u, num_out=mv)
+        # UNSCALED forward matrices: Scaling.FULL stays the same
+        # post-gather multiply the unfused _compress_shard applies, so
+        # the fused forward is bit-identical to the unfused
+        # z_forward+gather+scale path (folding the scale into the
+        # matrix values would not be).
+        self._fused_dist_fwd = {
             "rep": rep, "stacked": stacked, "n_tabs": len(stacked),
-            "mats": fkm.commit_mats(_dft.c2c_mats(dim_z, _dft.BACKWARD)),
+            "mats": fkm.commit_mats(_dft.c2c_mats(dim_z, _dft.FORWARD)),
             "interpret": not backend_ok,
         }
 
-    def _fused_dec_zdft_shard(self, vals, xtables):
-        """Per-shard fused decompress + (0,0)-stick completion + z-IFFT:
-        the drop-in for ``_decompress_shard`` followed by
-        ``_bwd_pre_exchange`` in the non-overlapped backward. ``vals`` is
-        (mv, 2) interleaved — or batched (B, mv, 2) through the batched
-        kernel grid. Returns complex z-transformed sticks
-        (..., max_sticks, dim_z)."""
+    def _fused_zdft_cmp_shard(self, sticks, xtables, scaled: bool):
+        """Per-shard fused z-FFT + compress gather: the drop-in for
+        ``stages.z_forward`` followed by ``_compress_shard`` after the
+        forward exchange. ``sticks`` are RAW (un-z-transformed) complex
+        local sticks (..., max_sticks, dim_z); the exchange unpack fills
+        padding rows with zeros, satisfying the kernel's
+        rows-past-num_sticks-are-zero contract. Returns (..., mv, 2)
+        interleaved real values."""
         from ..ops import fused_kernel as fkm
         from ..ops import gather_kernel as gk
-        fd = self._fused_dist
-        rep = fd["rep"]
-        ft = xtables[self._n_ptables + self._n_ctables:]
-        dev = tuple(a[0] for a in ft[:fd["n_tabs"]])  # drop the shard axis
-        mats = ft[fd["n_tabs"]:]                      # replicated, as-is
-        re, im = gk.planar_from_interleaved(vals.astype(np.float32),
-                                            rep.src_rows)
-        sr, si = fkm.run_decompress_zdft(re, im, dev, mats, rep,
-                                         interpret=fd["interpret"])
-        ms = self.dist_plan.max_sticks
-        return (sr[..., :ms, :] + 1j * si[..., :ms, :]).astype(self._cdt)
+        ff = self._fused_dist_fwd
+        rep = ff["rep"]
+        base = self._n_ptables + self._n_ctables + self._n_fb
+        seg = xtables[base:base + self._n_ff]
+        dev = tuple(a[0] for a in seg[:ff["n_tabs"]])  # drop the shard axis
+        mats = seg[ff["n_tabs"]:ff["n_tabs"] + 3]      # replicated, as-is
+        sr = jnp.real(sticks).astype(jnp.float32)
+        si = jnp.imag(sticks).astype(jnp.float32)
+        sr, si = fkm.pad_sticks_planar(sr, si, rep.src_sticks)
+        out_re, out_im = fkm.run_zdft_compress(sr, si, dev, mats, rep,
+                                               interpret=ff["interpret"])
+        values = gk.interleaved_from_planar(out_re, out_im, rep.num_out)
+        if scaled:
+            values = values * jnp.asarray(1.0 / self.global_size,
+                                          self._rdt)
+        return values.astype(self._rdt)
 
     # -- SPMD bodies ---------------------------------------------------------
     def _exchange_freq_to_grid(self, sticks, zmap, col_inv, ctables):
@@ -868,7 +1094,7 @@ class DistributedTransformPlan:
 
     # -- chunk-pipelined exchange (compute/communication overlap) -----------
     def _overlap_bwd_to_grid(self, sticks_raw, onehot_row, col_inv, zmap,
-                             ctables):
+                             ctables, pre_chunks=None):
         """Backward overlap pipeline: per chunk, run stick symmetry +
         z-IFFT on the chunk's stick rows and ISSUE its collective
         immediately; unpack once, after every chunk's exchange has been
@@ -878,19 +1104,30 @@ class DistributedTransformPlan:
         async start/done pair and run chunk i's z-stage during chunk
         i-1's wire time. Batch-aware for the ragged kind only (batch
         dims lead, collectives carry them trailing); block/compact
-        batched callers vmap the whole per-example tail instead."""
+        batched callers vmap the whole per-example tail instead.
+
+        ``pre_chunks`` (the fused pipeline) supplies the per-chunk
+        z-transformed stick arrays directly — one fused
+        decompress+z-DFT launch per chunk has already replaced the
+        slice + stick symmetry + z-IFFT — so the loop only packs and
+        issues each chunk's collective."""
         ov = self._overlap
         dp = self.dist_plan
-        batch = sticks_raw.shape[:-2]
+        batch = (pre_chunks[0].shape[:-2] if pre_chunks is not None
+                 else sticks_raw.shape[:-2])
         recvs = []
         for c, ch in enumerate(ov.chunks):
-            s_c = sticks_raw[..., ch.stick_lo:ch.stick_hi, :]
-            oh_c = onehot_row[ch.stick_lo:ch.stick_hi]
-            if batch:
-                s_c = jax.vmap(
-                    lambda s, oh=oh_c: self._bwd_pre_exchange(s, oh))(s_c)
+            if pre_chunks is not None:
+                s_c = pre_chunks[c]
             else:
-                s_c = self._bwd_pre_exchange(s_c, oh_c)
+                s_c = sticks_raw[..., ch.stick_lo:ch.stick_hi, :]
+                oh_c = onehot_row[ch.stick_lo:ch.stick_hi]
+                if batch:
+                    s_c = jax.vmap(
+                        lambda s, oh=oh_c:
+                        self._bwd_pre_exchange(s, oh))(s_c)
+                else:
+                    s_c = self._bwd_pre_exchange(s_c, oh_c)
             if ov.kind == "block":
                 blocks = pack_freq_to_blocks(s_c, zmap)
                 if dp.num_shards > 1:
@@ -1058,10 +1295,17 @@ class DistributedTransformPlan:
             vals = vals * conj_mult[0]
         if self._fused_dist is not None:
             # decompress + stick symmetry + z-IFFT in ONE kernel launch
-            # (overlap declined at build time, so the tail is monolithic)
-            sticks_z = self._fused_dec_zdft_shard(vals, xtables)
-            grid = self._exchange_freq_to_grid(sticks_z, zmap, col_inv,
-                                               ctables)
+            # per overlap chunk (one total for monolithic plans), each
+            # chunk's collective issued as its fused launch completes
+            if self._overlap is not None:
+                pre = self._fused_bwd_chunk_sticks(vals, xtables)
+                grid = self._overlap_bwd_to_grid(None, None, col_inv,
+                                                 zmap, ctables,
+                                                 pre_chunks=pre)
+            else:
+                sticks_z = self._fused_dec_zdft_shard(vals, xtables)
+                grid = self._exchange_freq_to_grid(sticks_z, zmap,
+                                                   col_inv, ctables)
             return self._bwd_post_exchange(grid)[None]
         sticks = self._decompress_shard(vals, slot_src, ptables)
         return self._backward_tail(sticks, onehot, col_inv, zmap,
@@ -1082,8 +1326,24 @@ class DistributedTransformPlan:
         if self._has_conj:  # (B, mv, 2) * (mv, 2) broadcasts over B
             vals_b = vals_b * conj_mult[0]
         if self._fused_dist is not None:
-            # one batched-grid fused launch covers decompress + symmetry
-            # + z-IFFT for the whole batch (overlap declined at build)
+            # one batched-grid fused launch per chunk covers decompress
+            # + symmetry + z-IFFT for the whole batch
+            if self._overlap is not None:
+                pre_b = self._fused_bwd_chunk_sticks(vals_b, xtables)
+                if self._overlap.kind == "ragged":
+                    # ragged collectives carry the batch trailing
+                    grid_b = self._overlap_bwd_to_grid(
+                        None, None, col_inv, zmap, ctables,
+                        pre_chunks=pre_b)
+                else:
+                    # block/compact exchange per example: batched fused
+                    # launches first, then the pack/exchange/unpack tail
+                    # vmapped over the per-chunk stick arrays
+                    grid_b = jax.vmap(
+                        lambda *cs: self._overlap_bwd_to_grid(
+                            None, None, col_inv, zmap, ctables,
+                            pre_chunks=cs))(*pre_b)
+                return jax.vmap(self._bwd_post_exchange)(grid_b)[None]
             sticks_zb = self._fused_dec_zdft_shard(vals_b, xtables)
             if self._ragged is not None:
                 grid_b = self._exchange_freq_to_grid(sticks_zb, zmap,
@@ -1133,19 +1393,25 @@ class DistributedTransformPlan:
         return stages.xy_forward_c2c(
             interleaved_to_complex(space).astype(self._cdt))
 
+    def _forward_head_raw(self, space, cols_flat, z_src, ctables):
+        """Per-shard forward pipeline up to (not including) the z-stage:
+        xy-FFT + exchange, output RAW (un-z-transformed) local sticks
+        (max_sticks, dim_z) — the seam the fused z-DFT+compress kernel
+        joins at. With ``overlap_chunks > 1`` the xy-stage and exchange
+        run chunk-pipelined (the forward mirror of the backward
+        overlap)."""
+        if self._overlap is not None:
+            return self._overlap_fwd_to_sticks(space, cols_flat, z_src,
+                                               ctables)
+        grid = self._fwd_pre_exchange(space)
+        return self._exchange_grid_to_sticks(grid, cols_flat, z_src,
+                                             ctables)
+
     def _forward_head(self, space, cols_flat, z_src, ctables):
         """Per-shard pipeline before compress: xy-FFT, exchange, z-FFT.
-        Input the per-shard space slab; output (max_sticks, dim_z).
-        With ``overlap_chunks > 1`` the xy-stage and exchange run
-        chunk-pipelined (the forward mirror of the backward overlap)."""
-        if self._overlap is not None:
-            sticks = self._overlap_fwd_to_sticks(space, cols_flat, z_src,
-                                                 ctables)
-        else:
-            grid = self._fwd_pre_exchange(space)
-            sticks = self._exchange_grid_to_sticks(grid, cols_flat, z_src,
-                                                   ctables)
-        return stages.z_forward(sticks)
+        Input the per-shard space slab; output (max_sticks, dim_z)."""
+        return stages.z_forward(
+            self._forward_head_raw(space, cols_flat, z_src, ctables))
 
     def _compress_shard(self, sticks, vi, ptables, scaled: bool):
         """Per-shard compress: (max_sticks, dim_z) -> (mv, 2) values —
@@ -1172,8 +1438,15 @@ class DistributedTransformPlan:
                       zmap, z_src, conj_mult, *xtables, scaled: bool):
         ptables = xtables[:self._n_ptables]
         ctables = xtables[self._n_ptables:self._n_ptables + self._n_ctables]
-        sticks = self._forward_head(space[0], cols_flat, z_src, ctables)
-        values = self._compress_shard(sticks, vi, ptables, scaled)
+        if self._fused_dist_fwd is not None:
+            # post-exchange z-FFT + compress gather in ONE kernel launch
+            raw = self._forward_head_raw(space[0], cols_flat, z_src,
+                                         ctables)
+            values = self._fused_zdft_cmp_shard(raw, xtables, scaled)
+        else:
+            sticks = self._forward_head(space[0], cols_flat, z_src,
+                                        ctables)
+            values = self._compress_shard(sticks, vi, ptables, scaled)
         if self._has_conj:  # folded mirrors leave conjugated
             values = values * conj_mult[0]
         return values[None]
@@ -1183,6 +1456,27 @@ class DistributedTransformPlan:
                               scaled: bool):
         ptables = xtables[:self._n_ptables]
         ctables = xtables[self._n_ptables:self._n_ptables + self._n_ctables]
+        if self._fused_dist_fwd is not None:
+            # raw sticks assembled batched through the same exchange
+            # structure as the unfused branches below, then ONE
+            # batched-grid fused z-FFT+compress launch replaces
+            # z_forward + the gather
+            if (self._overlap is not None
+                    and self._overlap.kind == "ragged"):
+                raw_b = self._overlap_fwd_to_sticks(space[0], cols_flat,
+                                                    z_src, ctables)
+            elif self._ragged is not None:
+                grid_b = jax.vmap(self._fwd_pre_exchange)(space[0])
+                raw_b = self._exchange_grid_to_sticks(grid_b, cols_flat,
+                                                      z_src, ctables)
+            else:
+                raw_b = jax.vmap(
+                    lambda s: self._forward_head_raw(
+                        s, cols_flat, z_src, ctables))(space[0])
+            values_b = self._fused_zdft_cmp_shard(raw_b, xtables, scaled)
+            if self._has_conj:
+                values_b = values_b * conj_mult[0]
+            return values_b[None]
         if self._overlap is not None and self._overlap.kind == "ragged":
             # chunked forward with the batch on the collectives'
             # trailing dims (_overlap_fwd_to_sticks is batch-aware)
@@ -1343,16 +1637,45 @@ class DistributedTransformPlan:
 
     @property
     def fused_dist_active(self) -> bool:
-        """True when the backward's local pre-exchange stage (decompress +
-        r2c stick symmetry + z-IFFT) runs as ONE fused Pallas launch."""
+        """True when BOTH distributed fused local stages run: the
+        backward decompress + r2c stick symmetry + z-IFFT (one Pallas
+        launch per overlap chunk) AND the forward z-FFT + compress
+        gather (one post-exchange launch)."""
+        return (self._fused_dist is not None
+                and self._fused_dist_fwd is not None)
+
+    @property
+    def fused_dist_bwd_active(self) -> bool:
+        """True when the backward's local pre-exchange stage (decompress
+        + r2c stick symmetry + z-IFFT) runs as fused Pallas launches
+        (one per overlap chunk)."""
         return self._fused_dist is not None
 
     @property
+    def fused_dist_fwd_active(self) -> bool:
+        """True when the forward's local post-exchange stage (z-FFT +
+        compress gather) runs as ONE fused Pallas launch."""
+        return self._fused_dist_fwd is not None
+
+    @property
     def fused_dist_fallback_reason(self) -> Optional[str]:
-        """Why the fused pre-exchange stage declined on an
-        otherwise-kernel-ready plan (None when active or never in play);
-        also recorded under ``dist_fused_decompress_zdft`` in obs."""
-        return self._fused_dist_reason
+        """Why the fused backward pre-exchange stage is not running:
+        None when active; a decline reason (also recorded under
+        ``dist_fused_decompress_zdft`` in obs) on an
+        otherwise-kernel-ready plan; an ``inactive:<why>`` value when
+        the fused kernels were never in play for this configuration
+        (by design — not a fallback, so not counted in obs)."""
+        if self._fused_dist is not None:
+            return None
+        return self._fused_dist_reason or self._fused_dist_inactive
+
+    @property
+    def fused_dist_fwd_fallback_reason(self) -> Optional[str]:
+        """Forward-twin analogue of :attr:`fused_dist_fallback_reason`
+        (decline reasons recorded under ``dist_fused_zdft_compress``)."""
+        if self._fused_dist_fwd is not None:
+            return None
+        return self._fused_dist_fwd_reason or self._fused_dist_inactive
 
     def _wire_elem_bytes(self) -> int:
         elem = np.dtype(self._cdt).itemsize

@@ -128,6 +128,33 @@ def chunk_bounds(true_counts, padded: int, num_chunks: int,
     return tuple(zip(bounds[:-1], bounds[1:]))
 
 
+def chunk_bounds_aligned(true_counts, padded: int, num_chunks: int,
+                         align: int, skew_weight: float = 1.0) -> tuple:
+    """Super-tile-aligned variant of :func:`chunk_bounds`: every
+    INTERIOR bound snaps to the nearest multiple of ``align`` (the
+    fused backward kernel's ``r_sticks`` super-tile height), so a
+    chunk-sliced fused launch wastes no partial super-tile at chunk
+    seams — only the final chunk may end unaligned (``padded`` itself
+    need not be a multiple). Falls back to the unaligned bounds when
+    the padded extent cannot give every chunk at least one full
+    super-tile (``padded < align * num_chunks``); the per-chunk table
+    sets handle arbitrary bounds, alignment is purely a waste
+    reduction. Same strict-increase / exact-cover invariants as
+    :func:`chunk_bounds`."""
+    base = chunk_bounds(true_counts, padded, num_chunks, skew_weight)
+    a, K = int(align), int(num_chunks)
+    if a <= 1 or padded < a * K:
+        return base
+    bounds = [0]
+    for lo, hi in base[:-1]:
+        snapped = int(round(hi / a)) * a
+        snapped = max(snapped, bounds[-1] + a)
+        snapped = min(snapped, padded - a * (K - len(bounds)))
+        bounds.append(snapped)
+    bounds.append(padded)
+    return tuple(zip(bounds[:-1], bounds[1:]))
+
+
 def _clip_count(count: int, lo: int, hi: int) -> int:
     """Rows of a populated prefix ``[0, count)`` falling in ``[lo, hi)``."""
     return max(0, min(int(count), hi) - lo)
@@ -224,6 +251,18 @@ class OverlapSchedule:
     @property
     def num_chunks(self) -> int:
         return len(self.chunks)
+
+    # -- schedule introspection (fused-dist per-chunk table builds) ---------
+    def stick_bounds(self) -> tuple:
+        """Per-chunk backward stick-row slices ``((lo, hi), ...)`` of
+        the padded local stick extent — the slices a chunk-sliced fused
+        decompress+z-DFT build restricts its gather tables to."""
+        return tuple((ch.stick_lo, ch.stick_hi) for ch in self.chunks)
+
+    def plane_bounds(self) -> tuple:
+        """Per-chunk forward plane-row slices ``((lo, hi), ...)`` of
+        the padded local plane extent."""
+        return tuple((ch.plane_lo, ch.plane_hi) for ch in self.chunks)
 
     # -- exact accounting ---------------------------------------------------
     def _chunk_links(self, c: int, forward: bool):
@@ -375,11 +414,15 @@ class OverlapSchedule:
     _grid_row_cached: int = dataclasses.field(default=0, compare=False)
 
 
-def _chunk_geometry(dp, num_chunks: int):
+def _chunk_geometry(dp, num_chunks: int, stick_align: int = 1):
     S = dp.num_shards
     ns = [p.num_sticks for p in dp.shard_plans]
     npl = list(dp.num_planes)
-    sb = chunk_bounds(ns, dp.max_sticks, num_chunks)
+    if stick_align > 1:
+        sb = chunk_bounds_aligned(ns, dp.max_sticks, num_chunks,
+                                  stick_align)
+    else:
+        sb = chunk_bounds(ns, dp.max_sticks, num_chunks)
     pb = chunk_bounds(npl, dp.max_planes, num_chunks)
     return S, ns, npl, list(dp.plane_offsets), sb, pb
 
@@ -393,15 +436,19 @@ def _pair_counts(S, ns, npl, ns_c, npl_c):
 
 
 def build_overlap_schedule(dp, num_chunks: int, kind: str,
-                           x_window=None) -> OverlapSchedule:
+                           x_window=None,
+                           stick_align: int = 1) -> OverlapSchedule:
     """Build the K-chunk overlap schedule from a ``DistributedIndexPlan``
     (same duck-typed contract and x-window composition as the monolithic
-    builders in exchange.py)."""
+    builders in exchange.py). ``stick_align > 1`` snaps the backward
+    stick bounds to super-tile multiples via
+    :func:`chunk_bounds_aligned` (best effort — unaligned fallback when
+    the extent is too small) for the chunk-sliced fused launches."""
     from ..indexing import window_sub_cols
 
     if kind not in ("block", "ragged", "compact"):
         raise InvalidParameterError(f"unknown overlap kind {kind!r}")
-    S, ns, npl, off, sb, pb = _chunk_geometry(dp, num_chunks)
+    S, ns, npl, off, sb, pb = _chunk_geometry(dp, num_chunks, stick_align)
     ms, mp_ = dp.max_sticks, dp.max_planes
     dz, Y, Xf = dp.dim_z, dp.dim_y, dp.dim_x_freq
     Xe = Xf if x_window is None else x_window[1]

@@ -114,6 +114,10 @@ def test_real_repo_reference_resolves():
     assert u == "bytes" and 0 < v <= 0.55 * 92164352
     v, u, _ = bench_regress.measurement(payload, ref, row="fused_r2c")
     assert u == "seams" and v == 2
+    # the round-16 fused x overlap composition row: both distributed
+    # fused directions active under the K=2 pipeline
+    v, u, _ = bench_regress.measurement(payload, ref, row="fused_dist")
+    assert u == "directions" and v == 2
 
 
 def _write_with_fused(path, value, fused_value, unit="s", wrap=False):
@@ -213,3 +217,45 @@ def test_fused_r2c_row_gates_the_decline(tmp_path, capsys):
               (json.loads(li) for li in
                capsys.readouterr().out.splitlines())}
     assert not by_row["fused_r2c"]["ok"]
+
+
+def _write_fused_dist(path, value, directions, wrap=False):
+    payload = {"metric": "m", "value": value, "unit": "s",
+               "fused_dist": {"metric": "d", "value": directions,
+                              "unit": "directions"}}
+    if wrap:
+        payload = {"n": 1, "parsed": payload}
+    path.write_text(json.dumps(payload))
+    return str(path)
+
+
+def test_fused_dist_row_gates_the_composition(tmp_path, capsys):
+    """The fused x overlap composition row: a distributed fused
+    direction dropping back to declined (2 -> 1) trips the
+    rate-direction comparison, and a one-sided row (reference predates
+    the composition) stays a skip."""
+    ref = _write_fused_dist(tmp_path / "ref.json", 0.0106, 2)
+    both = _write_fused_dist(tmp_path / "ok.json", 0.0106, 2)
+    assert bench_regress.main(["--fresh", both, "--against", ref]) == 0
+    by_row = {v["row"]: v for v in
+              (json.loads(li) for li in
+               capsys.readouterr().out.splitlines())}
+    assert by_row["fused_dist"]["direction"] == "higher-is-better"
+
+    declined = _write_fused_dist(tmp_path / "bad.json", 0.0106, 1)
+    assert bench_regress.main(["--fresh", declined,
+                               "--against", ref]) == 1
+    by_row = {v["row"]: v for v in
+              (json.loads(li) for li in
+               capsys.readouterr().out.splitlines())}
+    assert not by_row["fused_dist"]["ok"]
+
+    # one-sided-skip semantics preserved: an older reference without
+    # the row never fails the fresh run that carries it
+    old_ref = _write(tmp_path / "old.json", 0.0106)
+    assert bench_regress.main(["--fresh", both,
+                               "--against", old_ref]) == 0
+    lines = [json.loads(li) for li in
+             capsys.readouterr().out.splitlines()]
+    assert lines[-1] == {"ok": True, "verdict": "row-no-reference",
+                         "row": "fused_dist", "missing": "reference"}

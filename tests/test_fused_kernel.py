@@ -392,3 +392,58 @@ def test_fused_backward_hlo_drops_gather_intermediate(fused_env,
         lambda v: plan._backward_impl(v, plan._tables)).lower(
             vil).as_text()
     assert shape in unfused_text
+
+
+# -- distributed forward-twin gate rows (parallel/dist.py) -------------------
+
+def _dist_plan(**kw):
+    """A tiny 2-shard r2c plan on the virtual CPU mesh — the same
+    flagship shape test_fused_dist.py fuzzes, here only to poke the
+    dist gate matrix."""
+    from spfft_tpu.parallel import make_distributed_plan, make_mesh
+    from spfft_tpu.utils.workloads import sort_triplets_stick_major
+    from test_distributed import split_by_sticks, split_planes
+    from test_util import hermitian_triplets
+    dims = (8, 6, DIM_Z)
+    trips = hermitian_triplets(np.random.default_rng(11), dims)
+    parts = [sort_triplets_stick_major(p, dims)
+             for p in split_by_sticks(trips, dims, [2, 1])]
+    return make_distributed_plan(
+        TransformType.R2C, *dims, parts, split_planes(DIM_Z, [1, 1]),
+        mesh=make_mesh(2), precision="single",
+        use_pallas=kw.pop("use_pallas", True), **kw)
+
+
+def test_dist_gate_no_matmul_dft(monkeypatch):
+    """Without the mdft T pipeline both distributed twins decline with
+    a recorded no_matmul_dft reason (the fused seam only exists on the
+    matmul-DFT path)."""
+    monkeypatch.delenv("SPFFT_TPU_FORCE_MATMUL_DFT", raising=False)
+    monkeypatch.setenv("SPFFT_TPU_FUSED_INTERPRET", "1")
+    plan = _dist_plan()
+    assert not plan.fused_dist_active
+    assert plan.fused_dist_fallback_reason == "no_matmul_dft"
+    assert plan.fused_dist_fwd_fallback_reason == "no_matmul_dft"
+
+
+def test_dist_fwd_twin_recompute_counter_recorded(fused_env):
+    """The forward twin's recompute_blowup decline records under the
+    dist_fused_zdft_compress stage (declared in METRIC_SPECS) and the
+    series surfaces through the Prometheus exposition — the runtime
+    coverage for the new fallback stage label."""
+    from spfft_tpu import obs
+    before = obs.GLOBAL_COUNTERS.get(
+        "spfft_plan_pallas_fallback_total",
+        stage="dist_fused_zdft_compress", reason="recompute_blowup")
+    # at the default RECOMPUTE_LIMIT this workload's window-overlap
+    # recompute blows the forward cost model (the backward stays active)
+    plan = _dist_plan()
+    assert plan.fused_dist_bwd_active
+    assert plan.fused_dist_fwd_fallback_reason == "recompute_blowup"
+    after = obs.GLOBAL_COUNTERS.get(
+        "spfft_plan_pallas_fallback_total",
+        stage="dist_fused_zdft_compress", reason="recompute_blowup")
+    assert after == before + 1
+    text = obs.prometheus_text()
+    assert ('spfft_plan_pallas_fallback_total{reason="recompute_blowup"'
+            ',stage="dist_fused_zdft_compress"}') in text

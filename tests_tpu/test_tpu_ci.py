@@ -852,3 +852,105 @@ def test_plan_store_on_tpu(tmp_path):
     assert load_s < cold_s, "warm load failed to beat the cold build"
     assert aot_first_s < fresh_first_s, \
         "AOT deserialize failed to beat the fresh trace+compile"
+
+
+def test_fused_overlap_on_tpu(monkeypatch):
+    """Fusion x overlap ON REAL CHIPS (multi-chip hosts only): a plan
+    with overlap_chunks=K>1 and use_pallas=True must run BOTH fused
+    distributed twins (chunk-sliceable decompress+z-DFT backward,
+    post-exchange z-DFT+compress forward) while keeping the per-chunk
+    collective structure — K collectives split into async start/done
+    pairs by the latency-hiding scheduler — and match the monolithic
+    UNFUSED oracle (rel <= 1e-6; the Mosaic matmul accumulation order
+    differs from the XLA z-stage, so bitwise equality is the CPU
+    interpret suite's contract, tests/test_fused_dist.py). The
+    measured same-session A/B (unfused-monolithic vs fused xK) prints
+    as FUSED_OVERLAP_AB for BENCHMARKS.md's chip trajectory."""
+    import json
+    import time
+
+    import jax
+
+    from spfft_tpu import ExchangeType, make_distributed_plan
+    from spfft_tpu.parallel import make_mesh
+    from spfft_tpu.utils.hlo_inspect import (collective_async_split,
+                                             count_collectives)
+    from spfft_tpu.utils.workloads import (even_plane_split,
+                                           round_robin_stick_partition,
+                                           sort_triplets_stick_major)
+
+    S = min(len(jax.devices()), 8)
+    if S < 2:
+        pytest.skip("fused overlap A/B needs >= 2 TPU devices; "
+                    f"this host exposes {len(jax.devices())}")
+    # the random spherical workload's window-overlap recompute can trip
+    # the default forward cost gate at toy densities — widen it with
+    # the declared knob (control/config.py fused_recompute_limit)
+    monkeypatch.setenv("SPFFT_TPU_FUSED_RECOMPUTE_LIMIT", "16")
+    nx = ny = 64
+    nz = 128  # dim_z % 128 == 0: the fused eligibility floor
+    tr = spherical_cutoff_triplets(nx, radius=nx // 2 - 1)
+    tr = np.stack([tr[:, 0], tr[:, 1], tr[:, 2] * 2], axis=1)
+    dims = (nx, ny, nz)
+    parts = [sort_triplets_stick_major(p, dims)
+             for p in round_robin_stick_partition(tr, dims, S)]
+    planes = even_plane_split(nz, S)
+    mesh = make_mesh(S)
+    rng = np.random.default_rng(5)
+    vals = [(rng.uniform(-1, 1, len(p))
+             + 1j * rng.uniform(-1, 1, len(p))).astype(np.complex64)
+            for p in parts]
+
+    def build(use_pallas, k):
+        return make_distributed_plan(
+            TransformType.C2C, nx, ny, nz, parts, planes, mesh=mesh,
+            exchange=ExchangeType.BUFFERED, overlap_chunks=k,
+            precision="single", use_pallas=use_pallas)
+
+    ref = build(False, 1)                 # monolithic unfused oracle
+    assert not ref.fused_dist_active
+    ref_space = np.asarray(ref.backward(vals))
+    ref_fwd = np.asarray(ref.forward(ref.backward(vals)))
+
+    def timed_pair(p):
+        out = p.apply_pointwise(vals)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(10):
+            out = p.apply_pointwise(vals)
+        jax.block_until_ready(out)
+        return round((time.perf_counter() - t0) / 10 * 1e3, 3)
+
+    rows = [{"fused": False, "k": 1, "pair_ms": timed_pair(ref)}]
+    for k in (1, 2, 4):
+        plan = build(True, k)
+        # the composition this round exists for: fusion AND overlap,
+        # both directions, no decline
+        assert plan.fused_dist_active, (
+            plan.fused_dist_fallback_reason,
+            plan.fused_dist_fwd_fallback_reason)
+        assert plan.fused_dist_fallback_reason is None
+        assert plan.fused_dist_fwd_fallback_reason is None
+        # fusion and chunking move no extra bytes over the wire
+        assert plan.exchange_wire_bytes() == ref.exchange_wire_bytes()
+        space = plan.backward(vals)
+        got = np.asarray(space)
+        assert _rel(got[..., 0] + 1j * got[..., 1],
+                    ref_space[..., 0] + 1j * ref_space[..., 1]) < TOL
+        fwd = np.asarray(plan.forward(space))
+        assert _rel(fwd[..., 0] + 1j * fwd[..., 1],
+                    ref_fwd[..., 0] + 1j * ref_fwd[..., 1]) < TOL
+        v = plan.shard_values(vals)
+        lowered = plan._backward_jit.lower(v, *plan._device_tables)
+        launches = sum(count_collectives(lowered.as_text()).values())
+        split = collective_async_split(lowered.compile().as_text())
+        if k > 1:
+            assert launches >= k  # one collective per fused chunk
+            # start/done evidence WITH fusion active: the scheduler can
+            # still hide chunk i-1's exchange behind chunk i's launch
+            assert split["starts"] >= k
+        rows.append({"fused": True, "k": k, "pair_ms": timed_pair(plan),
+                     "collectives": launches,
+                     "async_starts": split["starts"]})
+    print("FUSED_OVERLAP_AB " + json.dumps({"shards": S, "dims": dims,
+                                            "rows": rows}))
