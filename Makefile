@@ -16,8 +16,8 @@ PLANNER_SO  := $(NATIVE_DIR)/_planner_$(CACHE_TAG).so
 CAPI_SO     := lib/libspfft_tpu.so
 
 .PHONY: all native capi example-c test ci ci-tpu trace-smoke \
-        control-smoke fused-smoke store-smoke bench-check lint \
-        analyze clean
+        control-smoke fused-smoke store-smoke chaos-smoke bench-check \
+        lint analyze clean
 
 # One-command CI (reference: .github/workflows/ci.yml builds + runs the
 # local test matrix): full CPU suite (8-device virtual mesh; includes the
@@ -74,9 +74,10 @@ analyze:
 # fault-injection: bucket isolation, device quarantine over the real
 # chip pool, crash-proof dispatch). Needs the real chip; record with
 #   make ci-tpu 2>&1 | tee docs/ci_tpu_r05.log
-# lint + analyze run first: the chip lane is expensive, so it never
-# starts on a tree the static passes already know is dirty.
-ci-tpu: lint analyze
+# lint + analyze + chaos-smoke run first: the chip lane is expensive,
+# so it never starts on a tree the static passes already know is dirty
+# or whose failure semantics the CPU chaos harness can already break.
+ci-tpu: lint analyze chaos-smoke
 	@echo "== CI-TPU: on-device regression lane =="
 	python -m pytest tests_tpu/ -q -rA
 	@echo "CI-TPU GREEN"
@@ -166,6 +167,25 @@ store-smoke:
 	env JAX_PLATFORMS=cpu python -m spfft_tpu.serve.store verify \
 	  build/store_smoke --json > /dev/null
 	@echo "STORE-SMOKE GREEN"
+
+# Chaos smoke (docs/serving.md "Failure semantics"): the seeded chaos
+# harness on two deterministic seeds — the three degradation-ladder
+# acceptance phases (runtime fused demotion, ENOSPC -> memory-only
+# store, execute-timeout watchdog) plus 16 seeded multi-seam fault
+# storms per seed across executor/plan/registry/store, asserting zero
+# hangs, typed failures only, bit-exact healthy requests, zero
+# unclosed spans and no torn store artifacts. Exit 1 on any violation.
+# The same harness runs in tier-1
+# (tests/test_serve_bench_cli.py::test_serve_bench_chaos_harness);
+# the on-chip twin is staged in tests_tpu/test_chaos_on_tpu.py.
+chaos-smoke:
+	@echo "== chaos-smoke: seeded multi-seam fault storms =="
+	@mkdir -p build
+	env JAX_PLATFORMS=cpu python -m spfft_tpu.serve.bench --chaos 7 \
+	  -o build/chaos_smoke_s7.json > /dev/null
+	env JAX_PLATFORMS=cpu python -m spfft_tpu.serve.bench --chaos 1234 \
+	  -o build/chaos_smoke_s1234.json > /dev/null
+	@echo "CHAOS-SMOKE GREEN"
 
 # Perf-trajectory guard (scripts/bench_regress.py): run the north-star
 # benchmark fresh and compare against the latest recorded BENCH_r*.json

@@ -16,6 +16,9 @@ and annotation syntax):
   raised somewhere and is documented (:mod:`.errors_check`);
 * ``knob-registry`` — ``KNOB_SPECS`` sanity, env spellings, docs rows
   (:mod:`.knobs`);
+* ``fault-sites`` — every fault-injection check names a site declared
+  exactly once in ``faults.SITES``, and every declared site is checked
+  somewhere (:mod:`.faults_check`);
 * ``baseline-lint`` — unused imports + undefined names, the
   dependency-free twin of the ruff config (:mod:`.baseline`).
 
@@ -29,8 +32,8 @@ from __future__ import annotations
 import os
 from typing import Dict, List, Optional
 
-from . import (baseline, counters_check, errors_check, knobs, locks,
-               spans)
+from . import (baseline, counters_check, errors_check, faults_check,
+               knobs, locks, spans)
 from .core import (Finding, PackageIndex, Report, index_package,
                    index_sources)
 
@@ -41,7 +44,8 @@ __all__ = ["Finding", "PackageIndex", "Report", "index_package",
 #: errors/knobs take repo-dependent doc arguments; run_analysis wires
 #: them.
 CHECKERS = ("lock-discipline", "span-closure", "counter-registry",
-            "error-taxonomy", "knob-registry", "baseline-lint")
+            "error-taxonomy", "knob-registry", "fault-sites",
+            "baseline-lint")
 
 
 def package_root() -> str:
@@ -91,6 +95,10 @@ def run_analysis(root: Optional[str] = None,
         findings, extras = knobs.check(
             index, doc_path=doc if os.path.exists(doc) else None)
         report.extend("knob-registry", findings)
+        report.extras.update(extras)
+    if "fault-sites" in selected:
+        findings, extras = faults_check.check(index)
+        report.extend("fault-sites", findings)
         report.extras.update(extras)
     if "baseline-lint" in selected:
         findings, extras = baseline.check(index)

@@ -49,7 +49,7 @@ from typing import Dict, Iterable, List, Optional, Set, Tuple
 #: Annotation comment patterns.
 GUARD_RE = re.compile(r"#:\s*guarded by\s+([A-Za-z_][A-Za-z0-9_]*)")
 WAIVE_RE = re.compile(
-    r"#\s*(lock|span|counters|errors|knobs|lint)\s*:\s*"
+    r"#\s*(lock|span|counters|errors|knobs|lint|faults)\s*:\s*"
     r"waived\(([^)]*)\)")
 HOLDS_RE = re.compile(
     r"#\s*lock\s*:\s*holds\(([A-Za-z_][A-Za-z0-9_]*)\)")

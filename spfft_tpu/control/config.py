@@ -150,6 +150,12 @@ KNOB_SPECS: Dict[str, KnobSpec] = {spec.name: spec for spec in (
              "Fused compress recompute-blowup gate: decline when "
              "windowed gather rows exceed this multiple of the stick "
              "count."),
+    KnobSpec("execute_timeout_ms", 0, 0, 600_000, int,
+             "spfft_execute_timeouts_total",
+             "Per-bucket device-execute watchdog (ms): a "
+             "materialisation exceeding it is abandoned and failed as "
+             "a typed transient ExecuteTimeoutError feeding the retry "
+             "+ quarantine ladder (0 = off)."),
 )}
 
 #: String-valued settings (paths) the numeric KnobSpec clamp cannot

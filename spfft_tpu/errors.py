@@ -194,6 +194,20 @@ class ExecutorCrashedError(ServeError):
     future was failed with this error instead of hanging forever."""
 
 
+class ExecuteTimeoutError(ServeError):
+    """A bucket's device execute exceeded the ``execute_timeout_ms``
+    watchdog knob. The wedged ``block_until_ready`` is abandoned to a
+    daemon thread and the bucket fails with this TYPED, transient,
+    device-attributed error — feeding the existing retry + quarantine
+    ladder instead of hanging the dispatch loop forever (the last
+    "zero hangs" gap). ``transient``/``device_attributed`` are the
+    attribute tags ``faults.is_transient`` / ``attributes_device``
+    read first."""
+
+    transient = True
+    device_attributed = True
+
+
 class PlanArtifactError(ServeError):
     """A plan artifact named by a warmup manifest could not be loaded
     (missing, rejected, or incompatible with the requested kwargs).

@@ -1,0 +1,407 @@
+"""Deterministic fault injection for the whole package.
+
+Round 8 proved the pattern on the serving executor: the
+failure-handling machinery (bucket-failure isolation, bounded retries,
+device quarantine, the crash-proof dispatch supervisor) is only
+trustworthy if every path is TESTABLE without real hardware faults.
+This module is that seam, promoted from ``serve/faults.py`` to package
+level so every subsystem built since — plan table builds, the artifact
+store, the registry's singleflight build, fused Pallas launches, the
+distributed exchange — shares one oracle. A :class:`FaultPlan` is
+consulted at named check sites:
+
+===================== ====================================================
+site                  where it fires
+===================== ====================================================
+``stage``             host-side payload staging of a fused bucket
+``dispatch``          the executable dispatch call (fused or serial;
+                      carries the pool-device index when a pool is in use)
+``materialise``       ``block_until_ready`` on a bucket's results
+``loop``              top of each dispatch-loop iteration (crashing here
+                      exercises the supervisor, not per-bucket handling)
+``plan.build``        compression-table build (foreground join AND the
+                      background builder thread — fires inside the thread,
+                      surfacing through the sticky ``TableBuildError``)
+``registry.build``    the singleflight owner's build in
+                      ``PlanRegistry.get_or_build``
+``store.load``        artifact read from disk
+``store.spill``       top of a plan spill (serialize + write)
+``store.replace``     the atomic ``os.replace`` publish step
+``store.fsync``       the pre-publish ``fsync`` of a temp file
+``store.aot``         AOT executable deserialize while loading
+``kernel.launch``     a fused Pallas kernel launch (fires at trace time
+                      under jit — once per compile, not per step)
+``exchange.pack``     distributed pre-exchange pack (trace time)
+``exchange.collective`` the all-to-all / collective itself (trace time)
+``exchange.unpack``   distributed post-exchange unpack (trace time)
+``exchange.chunk``    each chunk of an overlapped exchange (trace time)
+===================== ====================================================
+
+A firing check raises :class:`InjectedFault` (or an
+:class:`InjectedDiskFull` ``OSError`` for the ``enospc`` kind), which
+flows through the SAME except-paths a real XLA/runtime/disk failure
+would — nothing special-cases injected errors beyond their
+transient/permanent tag. Faults fire two ways, both deterministic:
+
+* **scripted** — ``"dispatch@3"`` fails the 3rd dispatch check,
+  ``"store.spill@1:enospc"`` makes the first spill hit a full disk,
+  ``"device1@*:permanent"`` fails every check on pool device 1. Site
+  call counters are per-site (and per-device), so a script replays
+  identically on an identical sequence of checks.
+* **probabilistic** — ``rate`` per-check probability from a seeded RNG
+  (``random.Random(seed)``), optionally restricted to one ``scope``
+  site or ``"device:N"``. Same seed + same check sequence = same fault
+  sequence, which is what lets ``serve.bench --fault-rate`` and
+  ``--chaos`` measure degradation instead of just asserting it.
+
+Script kinds beyond the round-8 trio:
+
+* ``enospc`` — raises :class:`InjectedDiskFull`, an ``OSError`` with
+  ``errno.ENOSPC``, so store code paths that branch on ``OSError`` /
+  errno exercise their real handling (the memory-only degradation
+  ladder).
+* ``hang`` — sleeps ``hang_seconds`` before raising a transient fault,
+  simulating a wedged device execute; pairs with the executor's
+  ``execute_timeout_ms`` watchdog knob.
+
+Subsystems outside the executor reach the seam through the ambient
+hook: ``faults.arm(plan)`` installs a process-global plan that
+:func:`check_site` consults (a no-op when nothing is armed, so the hot
+path costs one global read). The executor keeps its per-instance
+``inject_faults`` API.
+
+Transient-vs-permanent classification (:func:`is_transient`) drives
+retry policy: injected faults carry an explicit ``transient`` flag;
+real exceptions classify by an explicit ``transient`` attribute when
+present, then by type (``TimeoutError``), then by the gRPC-style
+status markers XLA runtime errors embed (``RESOURCE_EXHAUSTED``,
+``UNAVAILABLE``, ...). Everything else is permanent — retrying a shape
+error or a poisoned payload would just burn device time twice.
+tests/data/runtime_error_corpus.json pins both classifiers against
+real XLA/PJRT/Mosaic error text.
+"""
+
+from __future__ import annotations
+
+import errno
+import random
+import re
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .errors import (DuplicateIndicesError, InvalidIndicesError,
+                     InvalidParameterError, ServeError)
+
+#: The package's named fault-check sites. Dotted names group by
+#: subsystem; the analyzer's fault-site checker enforces that every
+#: ``check``/``check_site`` call uses a name declared here exactly
+#: once, and that every declared site is checked somewhere.
+SITES = (
+    # serving executor (round 8)
+    "stage", "dispatch", "materialise", "loop",
+    # plan lifecycle
+    "plan.build",
+    # registry
+    "registry.build",
+    # artifact store
+    "store.load", "store.spill", "store.replace", "store.fsync",
+    "store.aot",
+    # fused Pallas kernels
+    "kernel.launch",
+    # distributed exchange
+    "exchange.pack", "exchange.collective", "exchange.unpack",
+    "exchange.chunk",
+)
+
+#: Substrings of runtime error text treated as transient — the
+#: retryable subset of the gRPC status codes XLA/PJRT embed in
+#: RuntimeError messages (device OOM under fragmentation, a briefly
+#: unreachable device, a preempted collective).
+TRANSIENT_MARKERS = ("RESOURCE_EXHAUSTED", "UNAVAILABLE",
+                     "DEADLINE_EXCEEDED", "ABORTED")
+
+#: Script kinds a :class:`FaultPlan` entry may carry.
+KINDS = ("transient", "permanent", "poison", "enospc", "hang")
+
+
+class InjectedFault(ServeError):
+    """A failure raised by a :class:`FaultPlan` check. Carries the
+    ``transient`` classification retry policies read and the
+    ``device_attributed`` classification quarantine accounting reads
+    (True by default — injection simulates infrastructure faults; the
+    ``poison`` script kind injects request-attributed ones); otherwise
+    handled exactly like any runtime failure."""
+
+    def __init__(self, message: str, transient: bool = True,
+                 device_attributed: bool = True):
+        super().__init__(message)
+        self.transient = transient
+        self.device_attributed = device_attributed
+
+
+class InjectedDiskFull(InjectedFault, OSError):
+    """The ``enospc`` script kind: an injected disk-full failure. It IS
+    an ``OSError`` with ``errno.ENOSPC`` so store code that branches on
+    ``OSError``/errno (atomic writes, the memory-only degradation
+    ladder) exercises its real handling, and it IS an
+    :class:`InjectedFault` so harnesses can tell injected storms from
+    genuine disk trouble. Permanent and not device-attributed — a full
+    volume is neither retryable in place nor the accelerator's fault."""
+
+    def __init__(self, message: str):
+        InjectedFault.__init__(self, message, transient=False,
+                               device_attributed=False)
+        self.errno = errno.ENOSPC
+        self.strerror = "No space left on device"
+
+
+#: ``OSError`` errnos that mark a PERSISTENT disk problem — retrying
+#: the same write cannot help; the store's degradation ladder flips to
+#: memory-only instead. Everything else OSError-shaped (EINTR, EAGAIN,
+#: a transient NFS hiccup) gets the bounded-retry rung first.
+PERSISTENT_DISK_ERRNOS = (errno.ENOSPC, errno.EROFS, errno.EDQUOT,
+                          errno.EIO)
+
+
+def is_persistent_disk_error(exc: BaseException) -> bool:
+    """Whether ``exc`` is an ``OSError`` whose errno marks the disk
+    itself as unusable (:data:`PERSISTENT_DISK_ERRNOS`) — the trigger
+    for the store's memory-only degradation, as opposed to a transient
+    I/O error worth a bounded retry."""
+    return (isinstance(exc, OSError)
+            and getattr(exc, "errno", None) in PERSISTENT_DISK_ERRNOS)
+
+
+def is_transient(exc: BaseException) -> bool:
+    """Whether ``exc`` warrants the one bounded retry. An explicit
+    ``transient`` attribute wins (injected faults, or any runtime that
+    tags its errors); ``TimeoutError`` and XLA runtime errors carrying a
+    retryable gRPC status marker are transient; everything else —
+    shape/type errors, poisoned payloads, logic bugs — is permanent."""
+    tagged = getattr(exc, "transient", None)
+    if tagged is not None:
+        return bool(tagged)
+    if isinstance(exc, TimeoutError):
+        return True
+    text = str(exc)
+    return any(marker in text for marker in TRANSIENT_MARKERS)
+
+
+#: Exception types that indict the REQUEST, not the device it ran on:
+#: shape/type/index errors (a poisoned payload fails identically on
+#: every healthy device) and the library's own validation errors.
+REQUEST_ERROR_TYPES = (TypeError, ValueError, IndexError, KeyError,
+                       InvalidParameterError, InvalidIndicesError,
+                       DuplicateIndicesError)
+
+
+def attributes_device(exc: BaseException) -> bool:
+    """Whether a failure should count against the DEVICE it ran on
+    (quarantine accounting) rather than the request that triggered it.
+    An explicit ``device_attributed`` attribute wins (injected faults,
+    or a runtime that tags its errors); request-shaped errors
+    (:data:`REQUEST_ERROR_TYPES` — a poisoned payload raises the same
+    error on every healthy device) indict the request; everything else
+    — XLA runtime errors, timeouts, unknown failures — charges the
+    device, which preserves the round-8 quarantine behaviour for real
+    hardware faults. This is the classifier that stops a pure
+    poisoned-request flood from spuriously quarantining a healthy
+    device (ROADMAP round-11 follow-on)."""
+    tagged = getattr(exc, "device_attributed", None)
+    if tagged is not None:
+        return bool(tagged)
+    if isinstance(exc, REQUEST_ERROR_TYPES):
+        return False
+    return True
+
+
+_ENTRY_RE = re.compile(
+    r"^(?P<site>[a-z][a-z0-9_.]*|device\d+)"
+    r"@(?P<nth>\d+|\*)(?::(?P<kind>\w+))?$")
+
+
+def _parse_entry(spec: str) -> Tuple[str, Optional[int], str]:
+    """One script entry ``SITE@N[:KIND]`` -> (counter key, nth-or-None
+    for always, kind). SITE is a check site or ``deviceK``; ``N`` is
+    the 1-based call index of that counter, ``*`` fires on every call;
+    KIND is ``transient`` (default), ``permanent`` (both
+    device-attributed), ``poison`` (permanent AND request-attributed —
+    simulates a bad payload, exercising the quarantine-attribution
+    seam), ``enospc`` (an ``OSError`` disk-full, exercising the store's
+    degradation ladder) or ``hang`` (sleeps ``hang_seconds`` before a
+    transient fault, exercising the execute watchdog)."""
+    m = _ENTRY_RE.match(spec.strip())
+    if not m:
+        raise InvalidParameterError(
+            f"bad fault-script entry {spec!r} (want SITE@N[:KIND], e.g. "
+            f"'dispatch@3', 'store.spill@1:enospc', "
+            f"'device1@*:permanent')")
+    site = m.group("site")
+    if site not in SITES and not site.startswith("device"):
+        raise InvalidParameterError(
+            f"unknown fault site {site!r} (sites: {SITES} or deviceK)")
+    nth = None if m.group("nth") == "*" else int(m.group("nth"))
+    if nth is not None and nth < 1:
+        raise InvalidParameterError("fault-script call index is 1-based")
+    kind = m.group("kind") or "transient"
+    if kind not in KINDS:
+        raise InvalidParameterError(
+            f"fault kind must be one of {'|'.join(KINDS)}, got {kind!r}")
+    return site, nth, kind
+
+
+def _record(metric: str, **labels) -> None:
+    """Best-effort counter recording; import is lazy because obs is a
+    heavier import than this leaf module and faults must stay
+    importable everywhere (including from obs-free unit tests)."""
+    try:
+        from .obs import GLOBAL_COUNTERS
+    except Exception:  # pragma: no cover - circular/partial import
+        return
+    GLOBAL_COUNTERS.inc(metric, **labels)
+
+
+class FaultPlan:
+    """Deterministic fault-injection oracle, shared package-wide.
+
+    ``script`` is an iterable of ``SITE@N[:KIND]`` entries (or one
+    comma-separated string); ``rate`` adds seeded per-check transient
+    faults, optionally restricted to ``scope`` (a site name or
+    ``"device:N"``); ``hang_seconds`` is how long a ``hang`` entry
+    wedges its caller before failing. Thread-safe: checks run on
+    dispatcher/builder/spill threads, stats reads come from anywhere.
+    """
+
+    def __init__(self, rate: float = 0.0, seed: int = 0,
+                 scope: Optional[str] = None, script=None,
+                 hang_seconds: float = 30.0):
+        if not 0.0 <= rate <= 1.0:
+            raise InvalidParameterError("fault rate must be in [0, 1]")
+        if scope is not None:
+            key = scope.replace("device:", "device")
+            if key not in SITES and not (key.startswith("device")
+                                         and key[6:].isdigit()):
+                raise InvalidParameterError(
+                    f"bad fault scope {scope!r} (sites: {SITES} or "
+                    f"'device:N')")
+            scope = key
+        if isinstance(script, str):
+            script = [s for s in script.split(",") if s.strip()]
+        if hang_seconds < 0:
+            raise InvalidParameterError("hang_seconds must be >= 0")
+        self._rate = float(rate)
+        self._rng = random.Random(seed)  #: guarded by _lock
+        self._scope = scope
+        self._script: List[Tuple[str, Optional[int], str]] = \
+            [_parse_entry(s) for s in (script or [])]
+        self._hang_seconds = float(hang_seconds)
+        self._lock = threading.Lock()
+        self._calls: Dict[str, int] = {}  #: guarded by _lock
+        #: guarded by _lock
+        self._fired: Dict[str, int] = {kind: 0 for kind in KINDS}
+        self._fired_by_site: Dict[str, int] = {}  #: guarded by _lock
+
+    def _in_scope(self, site: str, dev_key: Optional[str]) -> bool:
+        if self._scope is None:
+            return site != "loop"  # rate faults never crash the loop
+        return self._scope == site or self._scope == dev_key
+
+    def check(self, site: str, device: Optional[int] = None) -> None:
+        """One pipeline checkpoint: increments the ``site`` counter (and
+        the ``deviceN`` counter when a pool device index is given) and
+        raises :class:`InjectedFault` (or :class:`InjectedDiskFull`)
+        when a script entry or the seeded rate says this call fails.
+        No-op otherwise."""
+        with self._lock:
+            n = self._calls[site] = self._calls.get(site, 0) + 1
+            dev_key = dn = None
+            if device is not None:
+                dev_key = f"device{device}"
+                dn = self._calls[dev_key] = self._calls.get(dev_key,
+                                                           0) + 1
+            fire = None
+            for key, nth, kind in self._script:
+                hit = (key == site and (nth is None or nth == n)) or \
+                      (key == dev_key and (nth is None or nth == dn))
+                if hit:
+                    fire = kind
+                    break
+            if fire is None and self._rate > 0.0 \
+                    and self._in_scope(site, dev_key):
+                if self._rng.random() < self._rate:
+                    fire = "transient"
+            if fire is None:
+                return
+            self._fired[fire] += 1
+            self._fired_by_site[site] = \
+                self._fired_by_site.get(site, 0) + 1
+            hang = self._hang_seconds if fire == "hang" else 0.0
+        _record("spfft_faults_injected_total", site=site, kind=fire)
+        where = site if device is None else f"{site} (device {device})"
+        if fire == "enospc":
+            raise InjectedDiskFull(f"injected disk-full at {where}")
+        if hang:
+            time.sleep(hang)  # outside the lock: only the caller wedges
+        raise InjectedFault(f"injected {fire} fault at {where}",
+                            transient=fire in ("transient", "hang"),
+                            device_attributed=fire != "poison")
+
+    def stats(self) -> Dict:
+        """Counter snapshot: checks seen and faults fired, per site."""
+        with self._lock:
+            return {
+                "rate": self._rate,
+                "scope": self._scope,
+                "script_entries": len(self._script),
+                "checks": dict(self._calls),
+                "fired_transient": self._fired["transient"],
+                "fired_permanent": self._fired["permanent"],
+                "fired_poison": self._fired["poison"],
+                "fired_enospc": self._fired["enospc"],
+                "fired_hang": self._fired["hang"],
+                "fired_by_site": dict(self._fired_by_site),
+            }
+
+
+#: The process-global ambient plan :func:`check_site` consults. Plain
+#: attribute read on the hot path; writes go through :func:`arm` /
+#: :func:`disarm` (tests and the chaos harness are the only writers).
+_AMBIENT: Optional[FaultPlan] = None
+_AMBIENT_LOCK = threading.Lock()
+
+
+def arm(plan: Optional[FaultPlan]) -> None:
+    """Install ``plan`` as the process-global ambient fault plan that
+    :func:`check_site` consults (``None`` disarms). Subsystems without
+    an injection API of their own — plan builds, the store, the
+    registry, fused kernels, the exchange — fire through this hook."""
+    global _AMBIENT
+    with _AMBIENT_LOCK:
+        _AMBIENT = plan
+    try:
+        from .obs import GLOBAL_COUNTERS
+    except Exception:  # pragma: no cover - circular/partial import
+        return
+    GLOBAL_COUNTERS.set("spfft_faults_armed",
+                        0.0 if plan is None else 1.0)
+
+
+def disarm() -> None:
+    """Remove the ambient fault plan (idempotent)."""
+    arm(None)
+
+
+def armed() -> Optional[FaultPlan]:
+    """The currently armed ambient plan, if any."""
+    return _AMBIENT
+
+
+def check_site(site: str, device: Optional[int] = None) -> None:
+    """Package-wide fault checkpoint: consult the ambient
+    :class:`FaultPlan` if one is armed, else no-op. This is the ONE
+    line a subsystem adds per seam; cost when disarmed is a global
+    read and an ``is not None``."""
+    plan = _AMBIENT
+    if plan is not None:
+        plan.check(site, device)

@@ -227,6 +227,36 @@ METRIC_SPECS: Dict[str, Tuple[str, str]] = {
         ("gauge", "Spans currently open (must be 0 at quiescence)."),
     "spfft_trace_events_dropped_total":
         ("counter", "Events dropped by the bounded ring buffer."),
+    # package-wide fault seam (spfft_tpu.faults) + degradation ladders
+    "spfft_faults_injected_total":
+        ("counter",
+         "Faults fired by a FaultPlan, labelled {site, kind}."),
+    "spfft_faults_armed":
+        ("gauge", "1 while an ambient fault plan is armed."),
+    "spfft_fused_demotions_total":
+        ("counter",
+         "Runtime fused-kernel demotions to the unfused composition, "
+         "labelled by plan direction (which=dec|cmp)."),
+    "spfft_fused_reprobes_total":
+        ("counter",
+         "Fused-path re-probe attempts after a runtime demotion, "
+         "labelled {which, outcome=readmitted|failed}."),
+    "spfft_store_degraded":
+        ("gauge",
+         "1 while the plan-artifact store is in memory-only "
+         "degradation (persistent disk fault; spills disabled)."),
+    "spfft_store_io_retries_total":
+        ("counter",
+         "Transient store I/O errors absorbed by the bounded "
+         "retry-with-backoff rung, labelled by op."),
+    "spfft_store_reprobes_total":
+        ("counter",
+         "Degraded-store disk re-probe attempts, labelled "
+         "{outcome=recovered|failed}."),
+    "spfft_execute_timeouts_total":
+        ("counter",
+         "Bucket materialisations that exceeded execute_timeout_ms "
+         "and were failed as typed transient ExecuteTimeoutError."),
 }
 
 

@@ -516,6 +516,8 @@ def run_decompress_zdft(re, im, dev_tables: tuple, mats: tuple,
       ``(num_super * r_sticks, dim_z)`` f32 (leading B when batched);
       rows ``[:num_sticks]`` are the valid sticks.
     """
+    from .. import faults as _faults
+    _faults.check_site("kernel.launch")  # trace time: once per compile
     C = int(t.row0.shape[0])
     K, P, R, dz = t.span_rows, t.p_tiles, t.r_sticks, t.dim_z
     complete = t.zinfo is not None
@@ -713,6 +715,8 @@ def run_zdft_compress(sr, si, dev_tables: tuple, mats: tuple,
       (out_re, out_im): each (num_tiles, 8, 128) f32 (leading B when
       batched); the flat prefix holds the ``num_out`` output values.
     """
+    from .. import faults as _faults
+    _faults.check_site("kernel.launch")  # trace time: once per compile
     C = int(t.s0.shape[0])
     K, S_w, dz = t.span_rows, t.win_sticks, t.dim_z
     q = dz // TILE_LANE

@@ -46,6 +46,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import faults as _faults
 from ..errors import InvalidParameterError, ParameterMismatchError
 from ..indexing import (build_index_plan, check_stick_duplicates,
                         occupied_x_window, window_sub_cols)
@@ -1019,6 +1020,7 @@ class DistributedTransformPlan:
     def _exchange_freq_to_grid(self, sticks, zmap, col_inv, ctables):
         """z-sticks -> local plane grid across the mesh, via the selected
         exchange mechanism."""
+        _faults.check_site("exchange.collective")  # trace time: per compile
         dp = self.dist_plan
         if self._ragged is not None:
             # sticks: (max_sticks, dim_z) or batched (B, max_sticks, dim_z)
@@ -1060,6 +1062,7 @@ class DistributedTransformPlan:
 
     def _exchange_grid_to_sticks(self, grid, cols_flat, z_src, ctables):
         """Local plane grid -> z-sticks across the mesh (forward mirror)."""
+        _faults.check_site("exchange.collective")  # trace time: per compile
         dp = self.dist_plan
         if self._ragged is not None:
             batch = grid.shape[:-3]
@@ -1117,6 +1120,7 @@ class DistributedTransformPlan:
                  else sticks_raw.shape[:-2])
         recvs = []
         for c, ch in enumerate(ov.chunks):
+            _faults.check_site("exchange.chunk")  # trace: once per chunk
             if pre_chunks is not None:
                 s_c = pre_chunks[c]
             else:
@@ -1177,6 +1181,7 @@ class DistributedTransformPlan:
         axis = space.ndim - nd_slab
         recvs = []
         for c, ch in enumerate(ov.chunks):
+            _faults.check_site("exchange.chunk")  # trace: once per chunk
             s_c = jax.lax.slice_in_dim(space, ch.plane_lo, ch.plane_hi,
                                        axis=axis)
             g_c = (jax.vmap(self._fwd_pre_exchange)(s_c) if batch
@@ -1242,6 +1247,7 @@ class DistributedTransformPlan:
         per-shard (max_sticks,) mask row — the overlap pipeline passes
         chunk SLICES of both arguments (the stages are per-stick
         independent, so a row slice is exact)."""
+        _faults.check_site("exchange.pack")  # trace time: per compile
         dp = self.dist_plan
         if dp.hermitian:
             # Complete every stick, then blend by the one-hot (0,0)-stick
@@ -1254,6 +1260,7 @@ class DistributedTransformPlan:
 
     def _bwd_post_exchange(self, grid):
         """Plane symmetry + xy-IFFT (after the exchange)."""
+        _faults.check_site("exchange.unpack")  # trace time: per compile
         dp = self.dist_plan
         if dp.hermitian:
             if self._split_x is not None:
@@ -1379,6 +1386,7 @@ class DistributedTransformPlan:
 
     def _fwd_pre_exchange(self, space):
         """xy-FFT (the per-example half before the forward exchange)."""
+        _faults.check_site("exchange.pack")  # trace time: per compile
         dp = self.dist_plan
         if dp.hermitian:
             if self._split_x is not None:

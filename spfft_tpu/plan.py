@@ -334,6 +334,11 @@ class TransformPlan:
         self._batched = None
         self._device_tables = {}
         self._pair_jits = {}
+        # runtime fused-kernel demotion ladder (docs/kernels.md): per
+        # direction {"reason", "unfused_ok", "probes", "probing",
+        # "permanent"}. Written only by the thread driving executions
+        # (the serving dispatcher, or the single caller thread).
+        self._fused_demotions = {}
         self._backward_jit = jax.jit(self._backward_impl)
         self._forward_jit = {
             Scaling.NONE: jax.jit(functools.partial(self._forward_impl,
@@ -341,6 +346,10 @@ class TransformPlan:
             Scaling.FULL: jax.jit(functools.partial(self._forward_impl,
                                                     scaled=True)),
         }
+        # the FOREGROUND half of the plan.build fault seam: constructing
+        # the plan (the background builder thread carries the other half)
+        from . import faults as _faults
+        _faults.check_site("plan.build")
         if will_build:
             # The compression-table build (native cover + device commit,
             # ~2-3 s at 256^3) runs CONCURRENTLY with whatever the caller
@@ -426,6 +435,8 @@ class TransformPlan:
         from .ops import gather_kernel as gk
         _t0_tables = _time.perf_counter()
         try:
+            from . import faults as _faults
+            _faults.check_site("plan.build")
             p = self.index_plan
             use_pallas = self._use_pallas_req
             vi = p.value_indices.astype(np.int64)
@@ -693,6 +704,21 @@ class TransformPlan:
                 f"the plan's background compression-table build failed: "
                 f"{self._build_exc!r}", cause=self._build_exc)
 
+    def check_build(self, wait: bool = False) -> None:
+        """Surface background-builder DEATH without waiting for a
+        request. ``wait=False`` (registration time: registry
+        ``get_or_build`` resolution, executor registration) raises the
+        sticky :class:`~spfft_tpu.errors.TableBuildError` only when the
+        builder thread has ALREADY finished and failed — a live build
+        is never blocked on. ``wait=True`` (warmup/prewarm, where
+        blocking is the point) joins the build first, so a doomed plan
+        fails before it is declared warm instead of on the first
+        request (the round-14 error-latency fix)."""
+        th = self._build_thread
+        if not wait and th is not None and th.is_alive():
+            return
+        self._finalize()
+
     def close(self) -> None:
         """Join the plan's background compression-table build thread.
         Plans are otherwise passive (XLA owns the executables), but an
@@ -733,9 +759,132 @@ class TransformPlan:
     def _fused_on(self, which: str, pallas: bool = True) -> bool:
         """Trace-time dispatch gate for one fused direction (``"dec"``
         backward / ``"cmp"`` forward). Callers reach this inside the
-        jitted pipelines, after the public entry already finalized."""
+        jitted pipelines, after the public entry already finalized. A
+        direction demoted at RUNTIME (:meth:`_fused_demote`) gates off
+        here too, except while its bounded re-probe is running."""
+        rec = self._fused_demotions.get(which)
+        if rec is not None and not rec["probing"]:
+            return False
         return (pallas and self._fused_active_flag
                 and self._fused_box.get(which) is not None)
+
+    #: Unfused successes a demoted direction banks before one fused
+    #: re-probe, and how many failed probes make the demotion permanent.
+    FUSED_REPROBE_AFTER = 32
+    FUSED_REPROBE_MAX = 3
+
+    def _invalidate_fused_jits(self, which: str) -> None:
+        """Drop every cached executable that baked the ``which``
+        direction's fused gate into its traced program, so the next
+        dispatch re-traces under the CURRENT gate. Runtime demotion
+        needs this: a real execution-time kernel failure lives inside
+        an already-compiled executable, which would otherwise re-run
+        the same broken launch forever."""
+        if which == "dec":
+            self._backward_jit = jax.jit(self._backward_impl)
+            if self._aot is not None:
+                self._aot.pop("backward", None)
+        else:
+            self._forward_jit = {
+                Scaling.NONE: jax.jit(functools.partial(
+                    self._forward_impl, scaled=False)),
+                Scaling.FULL: jax.jit(functools.partial(
+                    self._forward_impl, scaled=True)),
+            }
+            if self._aot is not None:
+                self._aot.pop("forward_none", None)
+                self._aot.pop("forward_full", None)
+        self._batched = None
+        self._pair_jits = {}
+
+    def _fused_demote(self, which: str, exc: BaseException,
+                      probing: bool) -> None:
+        """Stickily demote one direction to the unfused composition
+        after a device-attributed launch/execution failure: record the
+        reason (``fused_fallback_reasons`` + counter), gate the
+        direction off and invalidate its executables. A failure during
+        a re-probe re-demotes with the probe budget decremented; out of
+        budget, the demotion is permanent (no further probes)."""
+        rec = self._fused_demotions.get(which)
+        if rec is None:
+            rec = self._fused_demotions[which] = {
+                "reason": "", "unfused_ok": 0, "probes": 0,
+                "probing": False, "permanent": False}
+        rec["reason"] = f"runtime: {type(exc).__name__}: {exc}"
+        rec["unfused_ok"] = 0
+        rec["probing"] = False
+        if probing:
+            rec["probes"] += 1
+            rec["permanent"] = rec["probes"] >= self.FUSED_REPROBE_MAX
+        self._fused_reasons[which] = rec["reason"]
+        from . import obs as _obs
+        _obs.GLOBAL_COUNTERS.inc("spfft_fused_demotions_total",
+                                 which=which)
+        if probing:
+            _obs.GLOBAL_COUNTERS.inc("spfft_fused_reprobes_total",
+                                     which=which, outcome="failed")
+        logger.warning(
+            "spfft_tpu: fused %s kernel failed at runtime (%r) — "
+            "demoted to the unfused composition%s", which, exc,
+            " permanently" if rec["permanent"] else
+            f" (re-probe after {self.FUSED_REPROBE_AFTER} requests)")
+        self._invalidate_fused_jits(which)
+
+    def _fused_readmit(self, which: str) -> None:
+        """A re-probe succeeded: lift the demotion (the fused trace that
+        just ran stays cached) and count the readmission."""
+        rec = self._fused_demotions.pop(which, None)
+        self._fused_reasons.pop(which, None)
+        from . import obs as _obs
+        _obs.GLOBAL_COUNTERS.inc("spfft_fused_reprobes_total",
+                                 which=which, outcome="readmitted")
+        logger.info(
+            "spfft_tpu: fused %s kernel re-probe succeeded after %d "
+            "failed probe(s) — readmitted", which,
+            rec["probes"] if rec else 0)
+
+    def fused_demotions(self) -> dict:
+        """Snapshot of the runtime demotion ladder, per direction:
+        ``{"dec"/"cmp": {"reason", "unfused_ok", "probes", "probing",
+        "permanent"}}`` — empty when nothing is demoted."""
+        return {k: dict(v) for k, v in self._fused_demotions.items()}
+
+    def _guarded(self, which: str, call):
+        """Dispatch one public execution whose traced program may run
+        the ``which`` fused kernel, under the runtime demotion ladder:
+        a device-attributed failure (an injected ``kernel.launch``
+        fault, a Mosaic lowering error, a runtime launch failure)
+        demotes the direction and RETRIES the same dispatch unfused —
+        the request succeeds on the fallback composition instead of
+        failing, and so does every subsequent request. Request-shaped
+        errors (bad payloads) propagate untouched. ``call`` must read
+        the jit caches at call time (a closure over ``self``), so the
+        post-demotion retry picks up the re-traced executables."""
+        rec = self._fused_demotions.get(which)
+        probing = rec is not None and rec["probing"]
+        fused = (self._fused_active_flag
+                 and self._fused_box.get(which) is not None
+                 and (rec is None or probing))
+        if not fused:
+            out = call()
+            if rec is not None and not probing and not rec["permanent"]:
+                rec["unfused_ok"] += 1
+                if rec["unfused_ok"] >= self.FUSED_REPROBE_AFTER:
+                    rec["probing"] = True
+                    self._invalidate_fused_jits(which)
+            return out
+        from . import faults as _faults
+        try:
+            _faults.check_site("kernel.launch")
+            out = call()
+        except Exception as exc:
+            if not _faults.attributes_device(exc):
+                raise
+            self._fused_demote(which, exc, probing)
+            return call()
+        if probing:
+            self._fused_readmit(which)
+        return out
 
     @property
     def _tables(self):
@@ -1562,8 +1711,9 @@ class TransformPlan:
         with timed_transform("backward_batched") as box:
             if device is not None:
                 batch = jax.device_put(batch, device)
-            box.value = self._batched_jits()["backward"](
-                batch, self._tables_on(device))
+            box.value = self._guarded(
+                "dec", lambda: self._batched_jits()["backward"](
+                    batch, self._tables_on(device)))
             if self._ds:
                 box.value = self._ds_space_to_host(box.value)
         return box.value
@@ -1599,8 +1749,9 @@ class TransformPlan:
         with timed_transform("forward_batched") as box:
             if device is not None:
                 batch = jax.device_put(batch, device)
-            box.value = self._batched_jits()[scaling](
-                batch, self._tables_on(device))
+            box.value = self._guarded(
+                "cmp", lambda: self._batched_jits()[scaling](
+                    batch, self._tables_on(device)))
             if self._ds:
                 box.value = self._ds_values_to_host(box.value)
         return box.value
@@ -1743,8 +1894,9 @@ class TransformPlan:
         with timed_transform("backward") as box:
             if device is not None:
                 values_il = jax.device_put(values_il, device)
-            box.value = self._call_aot_or_jit(
-                "backward", self._backward_jit, values_il, device)
+            box.value = self._guarded(
+                "dec", lambda: self._call_aot_or_jit(
+                    "backward", self._backward_jit, values_il, device))
             if self._ds:
                 box.value = self._ds_space_to_host(box.value)
         return box.value
@@ -1787,8 +1939,9 @@ class TransformPlan:
                 space = jax.device_put(space, device)
             key = "forward_full" if scaling is Scaling.FULL \
                 else "forward_none"
-            box.value = self._call_aot_or_jit(
-                key, self._forward_jit[scaling], space, device)
+            box.value = self._guarded(
+                "cmp", lambda: self._call_aot_or_jit(
+                    key, self._forward_jit[scaling], space, device))
             if self._ds:
                 box.value = self._ds_values_to_host(box.value)
         return box.value

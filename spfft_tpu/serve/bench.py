@@ -36,6 +36,18 @@ Two extra modes exercise the adaptive dispatch path:
   (then re-admitted via probation), and a scripted dispatch-loop crash
   resolves EVERY pending future with a typed error — zero hangs. Exit
   code 1 on any violation.
+* ``--chaos SEED`` — the seeded chaos harness (``make chaos-smoke``):
+  three deterministic acceptance phases (a fused-launch fault demotes
+  exactly that plan direction and the next request succeeds; an
+  injected ENOSPC flips the artifact store to the memory-only tier
+  with ``health()`` degraded while serving continues; a wedged device
+  execute trips the ``execute_timeout_ms`` watchdog and recovers) and
+  then 16 seeded fault STORMS, all drawn from one RNG, across the
+  package-wide fault seam (executor, plan build, registry, store).
+  Invariants per storm: every future resolves (zero hangs), every
+  failure is typed, healthy requests are bit-exact vs a clean serial
+  oracle, zero unclosed obs spans, and the store never keeps a
+  half-written artifact. Exit code 1 on any violation.
 
 Observability (round 10): ``--trace-out FILE`` enables
 ``spfft_tpu.obs`` request tracing for the measured replay (or the
@@ -133,6 +145,11 @@ def _parse_args(argv):
                         "(tier-1 CI + make ci-tpu): bucket isolation, "
                         "retry, quarantine/probation, crash-proof "
                         "dispatch — exit 1 on any violation")
+    p.add_argument("--chaos", type=int, default=None, metavar="SEED",
+                   help="run the seeded chaos harness: deterministic "
+                        "degradation-ladder acceptance phases plus 16 "
+                        "seeded multi-seam fault storms; exit 1 on any "
+                        "violated invariant (make chaos-smoke)")
     p.add_argument("--fault-rate", type=float, default=0.0,
                    help="per-check probability of an injected transient "
                         "fault during the measured replay (seeded by "
@@ -705,6 +722,343 @@ def _run_fault_smoke(args) -> int:
     return 0 if ok else 1
 
 
+def _run_chaos(args) -> int:
+    """Seeded chaos harness (``--chaos SEED`` / ``make chaos-smoke``):
+    the package-wide fault seam exercised end to end. Three
+    deterministic acceptance phases prove each degradation ladder —
+
+    A. a fused-kernel launch fault at execution time stickily demotes
+       EXACTLY that plan direction to the unfused composition
+       (recorded reason), the demoted retry is bit-exact, and the next
+       request succeeds;
+    B. an injected ENOSPC mid-spill flips the artifact store to the
+       memory-only tier (``health()`` degraded, spills skipped,
+       rejects counted) while serving continues, leaving no
+       half-written artifact behind;
+    C. a wedged bucket execute trips the ``execute_timeout_ms``
+       watchdog into a typed transient failure and every request is
+       recovered through the serial fallback —
+
+    then 16 fault STORMS, every choice drawn from ONE seeded RNG: each
+    storm arms a scripted multi-site :class:`~spfft_tpu.faults`
+    ambient plan over a menu spanning four subsystems (executor
+    stage/dispatch/materialise/loop, plan build, registry build, store
+    load/spill/fsync/replace), drives a fresh registry + store +
+    executor through a request wave, and asserts the invariants: every
+    future resolves (zero hangs), every failure is a TYPED taxonomy
+    error, healthy requests are bit-exact vs a clean serial oracle,
+    zero unclosed obs spans after quiescence, and the store holds no
+    torn ``.tmp-`` files and verifies clean. Exit code 1 on any
+    violation."""
+    import concurrent.futures as cf
+    import os
+    import shutil
+    import tempfile
+
+    from .. import faults, obs
+    from ..benchmark import cutoff_stick_triplets
+    from ..errors import GenericError
+    from ..types import TransformType
+    from .executor import ServeExecutor
+    from .faults import FaultPlan
+    from .registry import PlanRegistry
+    from .store import PlanArtifactStore
+
+    obs.enable()
+    obs.GLOBAL_TRACER.reset()
+    faults.disarm()
+    seed = int(args.chaos)
+    rng = np.random.default_rng(seed)
+    failures: list = []
+    phases = {}
+    #: the typed-failure contract: every rejected/failed request raises
+    #: a taxonomy error (GenericError covers Serve/TableBuild/Injected)
+    #: or a request-shaped builtin (poisoned payloads)
+    typed = (GenericError,) + faults.REQUEST_ERROR_TYPES
+    fired_sites: dict = {}
+
+    def check(cond, msg):
+        if not cond:
+            failures.append(msg)
+
+    def tally(plan_f):
+        for s, c in plan_f.stats()["fired_by_site"].items():
+            fired_sites[s] = fired_sites.get(s, 0) + c
+
+    def spans_closed(where):
+        n = obs.GLOBAL_TRACER.open_count()
+        check(n == 0, f"{where}: {n} unclosed obs spans: "
+                      f"{obs.GLOBAL_TRACER.open_names()[:10]}")
+
+    def torn_files(root):
+        return [f for _, _, fs in os.walk(root) for f in fs
+                if f.startswith(".tmp-")]
+
+    # -- phase A: fused-launch fault demotes exactly that direction ----
+    env = {"SPFFT_TPU_FORCE_MATMUL_DFT": "1",
+           "SPFFT_TPU_FUSED_INTERPRET": "1"}
+    saved_env = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        from .. import make_local_plan
+        trip = np.asarray([(x, y, z) for x in range(8) for y in range(6)
+                           if (x + y) % 3 != 0 for z in range(0, 128, 2)],
+                          np.int32)
+        fp = make_local_plan(TransformType.C2C, 8, 6, 128, trip,
+                             precision="single", use_pallas=True)
+        nvf = fp.index_plan.num_values
+        v = (rng.standard_normal(nvf)
+             + 1j * rng.standard_normal(nvf)).astype(np.complex64)
+        oracle = np.asarray(fp.backward(v))  # fused, disarmed
+        check(not fp.fused_demotions(),
+              "phaseA: plan started demoted on the CPU fused lane")
+        kplan = FaultPlan(script="kernel.launch@1")
+        faults.arm(kplan)
+        out = np.asarray(fp.backward(v))  # demote + unfused retry
+        faults.disarm()
+        check(np.array_equal(out, oracle),
+              "phaseA: demoted retry diverged from the fused result")
+        dem = fp.fused_demotions()
+        check(set(dem) == {"dec"},
+              f"phaseA: expected exactly the backward direction "
+              f"demoted, got {sorted(dem)}")
+        check("runtime" in dem.get("dec", {}).get("reason", ""),
+              f"phaseA: demotion reason not recorded: {dem}")
+        out2 = np.asarray(fp.backward(v))  # next request: unfused path
+        check(np.array_equal(out2, oracle),
+              "phaseA: request after demotion failed or diverged")
+        tally(kplan)
+        phases["A_fused_demotion"] = dem
+    finally:
+        faults.disarm()
+        for k, old in saved_env.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
+    spans_closed("phaseA")
+
+    # -- shared workload: one signature, one clean oracle plan ---------
+    n = 10
+    trip = cutoff_stick_triplets(n, n, n, 0.8, hermitian=False)
+    oracle_reg = PlanRegistry(store=False)
+    osig, oplan = oracle_reg.get_or_build(
+        TransformType.C2C, n, n, n, trip, precision=args.precision)
+    nv = oplan.index_plan.num_values
+
+    def vals():
+        if args.precision == "single":
+            return rng.standard_normal((nv, 2)).astype(np.float32)
+        return rng.standard_normal(nv) + 1j * rng.standard_normal(nv)
+
+    # -- phase B: ENOSPC mid-spill -> memory-only tier, serving on -----
+    tmp = tempfile.mkdtemp(prefix="spfft-chaos-store-")
+    try:
+        store = PlanArtifactStore(tmp)
+        splan = FaultPlan(script="store.spill@1:enospc")
+        faults.arm(splan)
+        try:
+            store.save_plan(osig, oplan, trip)
+            check(False, "phaseB: injected ENOSPC did not surface")
+        except OSError as exc:
+            check(faults.is_persistent_disk_error(exc),
+                  f"phaseB: ENOSPC surfaced untyped: {exc!r}")
+        faults.disarm()
+        check(store.degraded and store.health()["state"] == "degraded",
+              f"phaseB: store not degraded after ENOSPC: "
+              f"{store.health()}")
+        # serving continues: spills are SKIPPED (counted), requests run
+        key = store.save_plan(osig, oplan, trip)
+        check(store.stats()["rejects"].get("degraded", 0) >= 1,
+              f"phaseB: degraded spill not counted: {store.stats()}")
+        check(not os.path.exists(store.artifact_path(key)),
+              "phaseB: memory-only tier still wrote an artifact")
+        with ServeExecutor(PlanRegistry(store=store), autostart=False,
+                           batch_window=0.0) as ex:
+            ex.registry.get_or_build(TransformType.C2C, n, n, n, trip,
+                                     precision=args.precision)
+            w = vals()
+            f = ex.submit(osig, w)
+            ex._drain_once()
+            check(np.array_equal(np.asarray(f.result(timeout=60)),
+                                 np.asarray(oplan.backward(w))),
+                  "phaseB: request failed while the store is degraded")
+        store.drain()
+        check(not torn_files(tmp),
+              "phaseB: torn .tmp- artifact left behind")
+        tally(splan)
+        phases["B_enospc_memory_only"] = store.health()
+    finally:
+        faults.disarm()
+        shutil.rmtree(tmp, ignore_errors=True)
+    spans_closed("phaseB")
+
+    # -- phase C: execute watchdog turns a wedged execute transient ----
+    wplan = FaultPlan(script="materialise@1:hang", hang_seconds=5.0)
+    ex = ServeExecutor(PlanRegistry(store=False), autostart=False,
+                       batch_window=0.0, fault_plan=wplan)
+    ex.registry.get_or_build(TransformType.C2C, n, n, n, trip,
+                             precision=args.precision)
+    ex.config.set("execute_timeout_ms", 200, source="init",
+                  reason="chaos watchdog phase")
+    t0_wd = obs.GLOBAL_COUNTERS.get("spfft_execute_timeouts_total")
+    good = [vals() for _ in range(3)]
+    oracles = [np.asarray(oplan.backward(w)) for w in good]
+    t_wedge = time.perf_counter()
+    futs = [ex.submit(osig, w) for w in good]
+    ex._drain_once()
+    for i, (f, expect) in enumerate(zip(futs, oracles)):
+        check(np.array_equal(np.asarray(f.result(timeout=60)), expect),
+              f"phaseC: request {i} not recovered around the wedged "
+              f"execute")
+    elapsed = time.perf_counter() - t_wedge
+    check(elapsed < 5.0,
+          f"phaseC: recovery waited out the full hang "
+          f"({elapsed:.1f} s) — watchdog never tripped")
+    wd = obs.GLOBAL_COUNTERS.get("spfft_execute_timeouts_total") - t0_wd
+    check(wd >= 1, "phaseC: spfft_execute_timeouts_total not bumped")
+    h = ex.metrics.health()
+    ex.close()
+    check(h["bucket_fallbacks"] >= 1,
+          f"phaseC: wedged bucket never fell back serial: {h}")
+    tally(wplan)
+    phases["C_execute_watchdog"] = {"timeouts": wd,
+                                    "recovered_in_s": round(elapsed, 2)}
+    spans_closed("phaseC")
+
+    # -- seeded storms -------------------------------------------------
+    #: site menu: (site, subsystem, flow order, script kinds). Extras
+    #: are only drawn from LATER flow stages than the primary, so the
+    #: primary always fires even when it aborts the storm's flow.
+    menu = (
+        ("store.load", "store", 0, ("transient", "enospc")),
+        ("registry.build", "registry", 1, ("transient", "permanent")),
+        ("plan.build", "plan", 2, ("transient", "permanent")),
+        ("store.spill", "store", 3, ("transient", "enospc")),
+        ("store.fsync", "store", 4, ("transient", "enospc")),
+        ("store.replace", "store", 5, ("transient", "enospc")),
+        ("stage", "executor", 6, ("transient", "permanent", "poison")),
+        ("dispatch", "executor", 7, ("transient", "permanent")),
+        ("materialise", "executor", 8, ("transient", "hang")),
+        ("loop", "executor", 9, ("transient", "permanent")),
+    )
+    subsystem_of = {site: sub for site, sub, _, _ in menu}
+    storms = 16
+    wave = 5
+    storm_log = []
+    for storm in range(storms):
+        site, _, order, kinds = menu[storm % len(menu)]
+        kind = kinds[int(rng.integers(len(kinds)))]
+        nth = int(rng.integers(1, 3)) if order >= 6 else 1
+        script = [f"{site}@{nth}:{kind}"]
+        later = [m for m in menu if m[2] > order]
+        if later and rng.random() < 0.5:
+            extra = later[int(rng.integers(len(later)))]
+            script.append(f"{extra[0]}@1:{extra[3][0]}")
+        plan_f = FaultPlan(script=script, hang_seconds=0.2)
+        good = [vals() for _ in range(wave)]
+        oracles = [np.asarray(oplan.backward(w)) for w in good]
+        obs.GLOBAL_TRACER.reset()
+        tmp = tempfile.mkdtemp(prefix="spfft-chaos-")
+        outcome = {"script": script, "served": 0, "typed_failures": 0}
+        try:
+            faults.arm(plan_f)
+            registry = PlanRegistry(store=PlanArtifactStore(tmp))
+            try:
+                sig, _ = registry.get_or_build(
+                    TransformType.C2C, n, n, n, trip,
+                    precision=args.precision)
+            except typed:
+                outcome["typed_failures"] += 1
+                outcome["build"] = "typed failure"
+            except Exception as exc:
+                check(False, f"storm {storm} {script}: UNTYPED build "
+                             f"failure {type(exc).__name__}: {exc}")
+            else:
+                ex = ServeExecutor(registry, autostart=False,
+                                   batch_window=0.0,
+                                   max_dispatch_restarts=2,
+                                   fault_plan=plan_f)
+                futs = [ex.submit(sig, w) for w in good]
+                ex.start()
+                for i, (f, expect) in enumerate(zip(futs, oracles)):
+                    try:
+                        got = f.result(timeout=120)
+                    except cf.TimeoutError:
+                        check(False, f"storm {storm} {script}: request "
+                                     f"{i} HUNG")
+                    except typed:
+                        outcome["typed_failures"] += 1
+                    except Exception as exc:
+                        check(False,
+                              f"storm {storm} {script}: request {i} "
+                              f"failed UNTYPED "
+                              f"{type(exc).__name__}: {exc}")
+                    else:
+                        outcome["served"] += 1
+                        check(np.array_equal(np.asarray(got), expect),
+                              f"storm {storm} {script}: request {i} "
+                              f"diverged from the serial oracle")
+                ex.close()
+            if registry._disk is not None:
+                registry._disk.drain()
+            faults.disarm()
+            check(not torn_files(tmp),
+                  f"storm {storm} {script}: torn .tmp- artifact left")
+            bad = [row for row in PlanArtifactStore(tmp).verify()
+                   if not row.get("ok")]
+            check(not bad,
+                  f"storm {storm} {script}: store verify failed: {bad}")
+            spans_closed(f"storm {storm} {script}")
+            tally(plan_f)
+        finally:
+            faults.disarm()
+            shutil.rmtree(tmp, ignore_errors=True)
+        storm_log.append(outcome)
+
+    subsystems = sorted({subsystem_of[s] for s in fired_sites
+                         if s in subsystem_of}
+                        | ({"kernel"} if "kernel.launch" in fired_sites
+                           else set()))
+    check(len(fired_sites) >= 8,
+          f"chaos coverage: only {len(fired_sites)} fault sites fired "
+          f"({sorted(fired_sites)})")
+    check(len(subsystems) >= 4,
+          f"chaos coverage: only {len(subsystems)} subsystems hit "
+          f"({subsystems})")
+
+    ok = not failures
+    print(f"chaos: seed={seed} storms={storms} wave={wave} "
+          f"precision={args.precision}")
+    for name, p in phases.items():
+        print(f"  {name}: {p}")
+    print(f"  sites fired ({len(fired_sites)}): "
+          f"{ {s: c for s, c in sorted(fired_sites.items())} }")
+    print(f"  subsystems: {subsystems}")
+    for msg in failures:
+        print(f"FAIL: {msg}", file=sys.stderr)
+    result = {
+        "metric": f"serve.bench --chaos (3 ladders + {storms} seeded "
+                  f"storms over {len(fired_sites)} fault sites)",
+        "value": 1 if ok else 0,
+        "unit": "ok",
+        "chaos": True,
+        "ok": ok,
+        "seed": seed,
+        "failures": failures,
+        "phases": phases,
+        "fired_sites": fired_sites,
+        "subsystems": subsystems,
+        "storms": storm_log,
+    }
+    print(json.dumps(result, default=str))
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(result, f, indent=2, default=str)
+        print(f"wrote {args.output}")
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     args = _parse_args(argv if argv is not None else sys.argv[1:])
     if args.requests < 1 or args.signatures < 1 or args.threads < 1:
@@ -729,6 +1083,8 @@ def main(argv=None) -> int:
         return _run_smoke(args)
     if args.fault_smoke:
         return _run_fault_smoke(args)
+    if args.chaos is not None:
+        return _run_chaos(args)
 
     import threading
 

@@ -135,9 +135,9 @@ import numpy as np
 from .. import obs as _obs
 from ..control.config import KNOB_SPECS, ServeConfig
 from ..errors import (DeadlineExpiredError, DistributedPlanUnsupportedError,
-                      ExecutorCrashedError, InvalidParameterError,
-                      NoHealthyDeviceError, QueueFullError,
-                      RetryExhaustedError, ServeError)
+                      ExecuteTimeoutError, ExecutorCrashedError,
+                      InvalidParameterError, NoHealthyDeviceError,
+                      QueueFullError, RetryExhaustedError, ServeError)
 from ..multi import fusion_eligible, planned_batch_size
 from ..plan import TransformPlan
 from ..types import Scaling
@@ -1100,6 +1100,10 @@ class ServeExecutor:
         if plan is None:
             raise InvalidParameterError(
                 f"signature not in registry: {signature}")
+        # prewarm is a blocking pre-traffic step: join the background
+        # table build so a dead builder surfaces here, typed, instead
+        # of poisoning the first request routed at this signature
+        plan.check_build(wait=True)
         import jax
         t_warm = time.perf_counter()
         nv = plan.index_plan.num_values
@@ -1552,6 +1556,46 @@ class ServeExecutor:
         return (keep, results, shard.key, shape, buf, slots, False, bt,
                 t0)
 
+    def _materialise(self, results) -> None:
+        """``block_until_ready`` on a bucket's results, under the
+        ``execute_timeout_ms`` watchdog when that knob is non-zero. The
+        wait runs on a short-lived daemon worker; if it outlives the
+        deadline the worker is abandoned (a wedged XLA execute cannot
+        be cancelled from the host) and the bucket fails with the TYPED
+        transient :class:`ExecuteTimeoutError`, which feeds the
+        existing retry + quarantine ladder exactly like a device fault
+        — closing the last "zero hangs" gap. With the knob at 0
+        (default) this is the plain inline wait round 8 shipped."""
+        import jax
+        timeout_ms = self.config.execute_timeout_ms
+        if timeout_ms <= 0:
+            self._check_fault("materialise")
+            jax.block_until_ready(results)
+            return
+        box: Dict[str, BaseException] = {}
+        done = threading.Event()
+
+        def _work():
+            try:
+                self._check_fault("materialise")
+                jax.block_until_ready(results)
+            except BaseException as exc:
+                box["exc"] = exc
+            finally:
+                done.set()
+
+        worker = threading.Thread(target=_work, daemon=True,
+                                  name="spfft-materialise")
+        worker.start()
+        if not done.wait(timeout_ms / 1000.0):
+            _obs.GLOBAL_COUNTERS.inc("spfft_execute_timeouts_total")
+            raise ExecuteTimeoutError(
+                f"bucket materialisation exceeded execute_timeout_ms="
+                f"{timeout_ms:g} ms; abandoning the wedged execute")
+        exc = box.get("exc")
+        if exc is not None:
+            raise exc
+
     def _finish(self, live, results, shard_key=None, shape=0,
                 buf=None, slots=None, fused=False, bt=None,
                 t_disp=None) -> None:
@@ -1572,8 +1616,7 @@ class ServeExecutor:
             bt.begin("serve.materialise",
                      track=_dev_track(slots[0] if slots else None))
         try:
-            self._check_fault("materialise")
-            jax.block_until_ready(results)
+            self._materialise(results)
         except Exception as exc:
             if bt is not None:
                 bt.end_all("error", type(exc).__name__)

@@ -1,239 +1,26 @@
-"""Deterministic fault injection for the serving layer.
+"""Compatibility shim: fault injection is now package-level.
 
-The failure-handling machinery in ``ServeExecutor`` (bucket-failure
-isolation, bounded retries, device quarantine, the crash-proof dispatch
-supervisor) is only trustworthy if every path is TESTABLE without real
-hardware faults. This module is that seam: a :class:`FaultPlan` is an
-injectable oracle the executor consults at four named sites of its
-dispatch pipeline —
-
-* ``stage``       — host-side payload staging of a fused bucket
-* ``dispatch``    — the executable dispatch call (fused or serial;
-  carries the pool-device index when a pool is in use)
-* ``materialise`` — ``block_until_ready`` on a bucket's results
-* ``loop``        — top of each dispatch-loop iteration (crashing here
-  exercises the supervisor, not the per-bucket error handling)
-
-A firing check raises :class:`InjectedFault`, which flows through the
-SAME except-paths a real XLA/runtime failure would — nothing in the
-executor special-cases injected errors beyond their transient/permanent
-tag. Faults fire two ways, both deterministic:
-
-* **scripted** — ``"dispatch@3"`` fails the 3rd dispatch check,
-  ``"device1@*:permanent"`` fails every check on pool device 1,
-  ``"loop@1"`` crashes the first loop iteration. Site call counters are
-  per-site (and per-device), so a script replays identically on an
-  identical sequence of checks.
-* **probabilistic** — ``rate`` per-check probability from a seeded RNG
-  (``random.Random(seed)``), optionally restricted to one ``scope``
-  site or ``"device:N"``. Same seed + same check sequence = same fault
-  sequence, which is what lets ``serve.bench --fault-rate`` measure
-  degradation instead of just asserting it.
-
-Transient-vs-permanent classification (:func:`is_transient`) drives the
-executor's retry policy: injected faults carry an explicit ``transient``
-flag; real exceptions classify by an explicit ``transient`` attribute
-when present, then by type (``TimeoutError``), then by the gRPC-style
-status markers XLA runtime errors embed (``RESOURCE_EXHAUSTED``,
-``UNAVAILABLE``, ...). Everything else is permanent — retrying a shape
-error or a poisoned payload would just burn device time twice.
+Round 8 introduced deterministic fault injection here, scoped to the
+serving executor's four check sites. The seam since outgrew the
+serving layer — plan builds, the artifact store, the registry, fused
+kernels and the distributed exchange all consult the same oracle — so
+the implementation lives in :mod:`spfft_tpu.faults`. This module
+re-exports the public surface so existing imports
+(``from spfft_tpu.serve.faults import FaultPlan``) keep working.
 """
 
 from __future__ import annotations
 
-import random
-import re
-import threading
-from typing import Dict, List, Optional, Tuple
+from ..faults import (KINDS, PERSISTENT_DISK_ERRNOS, REQUEST_ERROR_TYPES,
+                      SITES, TRANSIENT_MARKERS, FaultPlan,
+                      InjectedDiskFull, InjectedFault, arm, armed,
+                      attributes_device, check_site, disarm,
+                      is_persistent_disk_error, is_transient)
 
-from ..errors import (DuplicateIndicesError, InvalidIndicesError,
-                      InvalidParameterError, ServeError)
-
-#: The executor's named fault-check sites.
-SITES = ("stage", "dispatch", "materialise", "loop")
-
-#: Substrings of runtime error text treated as transient — the
-#: retryable subset of the gRPC status codes XLA/PJRT embed in
-#: RuntimeError messages (device OOM under fragmentation, a briefly
-#: unreachable device, a preempted collective).
-TRANSIENT_MARKERS = ("RESOURCE_EXHAUSTED", "UNAVAILABLE",
-                     "DEADLINE_EXCEEDED", "ABORTED")
-
-
-class InjectedFault(ServeError):
-    """A failure raised by a :class:`FaultPlan` check. Carries the
-    ``transient`` classification the executor's retry policy reads and
-    the ``device_attributed`` classification its quarantine accounting
-    reads (True by default — injection simulates infrastructure faults;
-    the ``poison`` script kind injects request-attributed ones);
-    otherwise handled exactly like any runtime failure."""
-
-    def __init__(self, message: str, transient: bool = True,
-                 device_attributed: bool = True):
-        super().__init__(message)
-        self.transient = transient
-        self.device_attributed = device_attributed
-
-
-def is_transient(exc: BaseException) -> bool:
-    """Whether ``exc`` warrants the one bounded retry. An explicit
-    ``transient`` attribute wins (injected faults, or any runtime that
-    tags its errors); ``TimeoutError`` and XLA runtime errors carrying a
-    retryable gRPC status marker are transient; everything else —
-    shape/type errors, poisoned payloads, logic bugs — is permanent."""
-    tagged = getattr(exc, "transient", None)
-    if tagged is not None:
-        return bool(tagged)
-    if isinstance(exc, TimeoutError):
-        return True
-    text = str(exc)
-    return any(marker in text for marker in TRANSIENT_MARKERS)
-
-
-#: Exception types that indict the REQUEST, not the device it ran on:
-#: shape/type/index errors (a poisoned payload fails identically on
-#: every healthy device) and the library's own validation errors.
-REQUEST_ERROR_TYPES = (TypeError, ValueError, IndexError, KeyError,
-                       InvalidParameterError, InvalidIndicesError,
-                       DuplicateIndicesError)
-
-
-def attributes_device(exc: BaseException) -> bool:
-    """Whether a failure should count against the DEVICE it ran on
-    (quarantine accounting) rather than the request that triggered it.
-    An explicit ``device_attributed`` attribute wins (injected faults,
-    or a runtime that tags its errors); request-shaped errors
-    (:data:`REQUEST_ERROR_TYPES` — a poisoned payload raises the same
-    error on every healthy device) indict the request; everything else
-    — XLA runtime errors, timeouts, unknown failures — charges the
-    device, which preserves the round-8 quarantine behaviour for real
-    hardware faults. This is the classifier that stops a pure
-    poisoned-request flood from spuriously quarantining a healthy
-    device (ROADMAP round-11 follow-on)."""
-    tagged = getattr(exc, "device_attributed", None)
-    if tagged is not None:
-        return bool(tagged)
-    if isinstance(exc, REQUEST_ERROR_TYPES):
-        return False
-    return True
-
-
-_ENTRY_RE = re.compile(
-    r"^(?P<site>[a-z]+|device\d+)@(?P<nth>\d+|\*)(?::(?P<kind>\w+))?$")
-
-
-def _parse_entry(spec: str) -> Tuple[str, Optional[int], str]:
-    """One script entry ``SITE@N[:KIND]`` -> (counter key, nth-or-None
-    for always, kind). SITE is a check site or ``deviceK``; ``N`` is
-    the 1-based call index of that counter, ``*`` fires on every call;
-    KIND is ``transient`` (default), ``permanent`` (both
-    device-attributed) or ``poison`` (permanent AND request-attributed
-    — simulates a bad payload, exercising the quarantine-attribution
-    seam)."""
-    m = _ENTRY_RE.match(spec.strip())
-    if not m:
-        raise InvalidParameterError(
-            f"bad fault-script entry {spec!r} (want SITE@N[:KIND], e.g. "
-            f"'dispatch@3', 'device1@*:permanent', 'loop@1')")
-    site = m.group("site")
-    if site not in SITES and not site.startswith("device"):
-        raise InvalidParameterError(
-            f"unknown fault site {site!r} (sites: {SITES} or deviceK)")
-    nth = None if m.group("nth") == "*" else int(m.group("nth"))
-    if nth is not None and nth < 1:
-        raise InvalidParameterError("fault-script call index is 1-based")
-    kind = m.group("kind") or "transient"
-    if kind not in ("transient", "permanent", "poison"):
-        raise InvalidParameterError(
-            f"fault kind must be transient|permanent|poison, "
-            f"got {kind!r}")
-    return site, nth, kind
-
-
-class FaultPlan:
-    """Deterministic fault-injection oracle for ``ServeExecutor``.
-
-    ``script`` is an iterable of ``SITE@N[:KIND]`` entries (or one
-    comma-separated string); ``rate`` adds seeded per-check transient
-    faults, optionally restricted to ``scope`` (a site name or
-    ``"device:N"``). Thread-safe: checks run on the dispatcher thread,
-    stats reads come from anywhere.
-    """
-
-    def __init__(self, rate: float = 0.0, seed: int = 0,
-                 scope: Optional[str] = None, script=None):
-        if not 0.0 <= rate <= 1.0:
-            raise InvalidParameterError("fault rate must be in [0, 1]")
-        if scope is not None:
-            key = scope.replace("device:", "device")
-            if key not in SITES and not (key.startswith("device")
-                                         and key[6:].isdigit()):
-                raise InvalidParameterError(
-                    f"bad fault scope {scope!r} (sites: {SITES} or "
-                    f"'device:N')")
-            scope = key
-        if isinstance(script, str):
-            script = [s for s in script.split(",") if s.strip()]
-        self._rate = float(rate)
-        self._rng = random.Random(seed)  #: guarded by _lock
-        self._scope = scope
-        self._script: List[Tuple[str, Optional[int], str]] = \
-            [_parse_entry(s) for s in (script or [])]
-        self._lock = threading.Lock()
-        self._calls: Dict[str, int] = {}  #: guarded by _lock
-        #: guarded by _lock
-        self._fired: Dict[str, int] = {"transient": 0, "permanent": 0,
-                                       "poison": 0}
-        self._fired_by_site: Dict[str, int] = {}  #: guarded by _lock
-
-    def _in_scope(self, site: str, dev_key: Optional[str]) -> bool:
-        if self._scope is None:
-            return site != "loop"  # rate faults never crash the loop
-        return self._scope == site or self._scope == dev_key
-
-    def check(self, site: str, device: Optional[int] = None) -> None:
-        """One pipeline checkpoint: increments the ``site`` counter (and
-        the ``deviceN`` counter when a pool device index is given) and
-        raises :class:`InjectedFault` when a script entry or the seeded
-        rate says this call fails. No-op otherwise."""
-        with self._lock:
-            n = self._calls[site] = self._calls.get(site, 0) + 1
-            dev_key = dn = None
-            if device is not None:
-                dev_key = f"device{device}"
-                dn = self._calls[dev_key] = self._calls.get(dev_key,
-                                                           0) + 1
-            fire = None
-            for key, nth, kind in self._script:
-                hit = (key == site and (nth is None or nth == n)) or \
-                      (key == dev_key and (nth is None or nth == dn))
-                if hit:
-                    fire = kind
-                    break
-            if fire is None and self._rate > 0.0 \
-                    and self._in_scope(site, dev_key):
-                if self._rng.random() < self._rate:
-                    fire = "transient"
-            if fire is None:
-                return
-            self._fired[fire] += 1
-            self._fired_by_site[site] = \
-                self._fired_by_site.get(site, 0) + 1
-        where = site if device is None else f"{site} (device {device})"
-        raise InjectedFault(f"injected {fire} fault at {where}",
-                            transient=fire == "transient",
-                            device_attributed=fire != "poison")
-
-    def stats(self) -> Dict:
-        """Counter snapshot: checks seen and faults fired, per site."""
-        with self._lock:
-            return {
-                "rate": self._rate,
-                "scope": self._scope,
-                "script_entries": len(self._script),
-                "checks": dict(self._calls),
-                "fired_transient": self._fired["transient"],
-                "fired_permanent": self._fired["permanent"],
-                "fired_poison": self._fired["poison"],
-                "fired_by_site": dict(self._fired_by_site),
-            }
+__all__ = [
+    "FaultPlan", "InjectedFault", "InjectedDiskFull",
+    "SITES", "KINDS", "TRANSIENT_MARKERS", "REQUEST_ERROR_TYPES",
+    "PERSISTENT_DISK_ERRNOS",
+    "is_transient", "attributes_device", "is_persistent_disk_error",
+    "arm", "armed", "disarm", "check_site",
+]

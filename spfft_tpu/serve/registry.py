@@ -50,6 +50,7 @@ from typing import Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
 
+from .. import faults as _faults
 from .. import obs as _obs
 from ..errors import InvalidParameterError
 from ..indexing import IndexPlan, build_index_plan
@@ -376,13 +377,19 @@ class PlanRegistry:
         while True:
             with self._lock:
                 fast = self._fast_lookup_locked(memo_key, arr)
-                if fast is not None:
-                    return fast
-                flight = self._build_flights.get(memo_key)
-                owner = flight is None
-                if owner:
-                    flight = self._build_flights[memo_key] = \
-                        _BuildFlight()
+                if fast is None:
+                    flight = self._build_flights.get(memo_key)
+                    owner = flight is None
+                    if owner:
+                        flight = self._build_flights[memo_key] = \
+                            _BuildFlight()
+            if fast is not None:
+                # surface background-builder DEATH at resolution time
+                # instead of on the first request (round-14 fix) —
+                # non-blocking (and off the registry lock): a live
+                # build is never waited on here
+                fast[1].check_build()
+                return fast
             if owner:
                 break
             # Follower: wait for the in-flight build, sharing its
@@ -417,6 +424,7 @@ class PlanRegistry:
                 with self._lock:
                     self._store_misses += 1
             t_build = time.perf_counter()
+            _faults.check_site("registry.build")
             ip = build_index_plan(TransformType(transform_type), dim_x,
                                   dim_y, dim_z, arr)
             sig = PlanSignature(TransformType(transform_type).value,
@@ -452,6 +460,7 @@ class PlanRegistry:
                     self._disk.spill_async(sig, plan, arr)
                     with self._lock:
                         self._store_spills += 1
+            plan.check_build()
             self._memoize(memo_key, arr, sig)
             return sig, plan
         except BaseException as exc:
@@ -517,6 +526,10 @@ class PlanRegistry:
                 triplets = spec.pop("triplets")
                 sig, plan = self.get_or_build(ttype, *dims, triplets,
                                               **spec)
+            # warmup is the blocking pre-traffic path: join the
+            # background table build so a doomed plan fails HERE, not
+            # on the first request it would otherwise poison
+            plan.check_build(wait=True)
             if compile:
                 n = plan.index_plan.num_values
                 plan.backward(np.zeros((n, 2), np.float32)

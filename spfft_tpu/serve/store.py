@@ -70,6 +70,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from .. import faults as _faults
 from .. import obs as _obs
 from ..errors import InvalidParameterError
 from ..indexing import IndexPlan
@@ -108,6 +109,18 @@ REASON_DIGEST = "digest_mismatch"     # stored index digest is stale
 REASON_IO = "io"                      # unreadable file
 REASON_INCOMPATIBLE = "incompatible"  # caller kwargs the artifact
                                       # cannot honour (rebuild instead)
+REASON_DEGRADED = "degraded"          # spill skipped: memory-only tier
+
+#: Store I/O degradation ladder (docs/artifact_cache.md): a TRANSIENT
+#: I/O error gets IO_RETRIES bounded retries with IO_BACKOFF_S
+#: geometric backoff; a PERSISTENT disk fault (ENOSPC, read-only or
+#: corrupt volume — faults.PERSISTENT_DISK_ERRNOS) flips the store to
+#: the memory-only tier, re-probed every REPROBE_INTERVAL_S (doubling
+#: to REPROBE_MAX_INTERVAL_S while the disk stays broken).
+IO_RETRIES = 2
+IO_BACKOFF_S = 0.05
+REPROBE_INTERVAL_S = 30.0
+REPROBE_MAX_INTERVAL_S = 480.0
 
 
 def aot_enabled() -> bool:
@@ -421,6 +434,9 @@ def _install_aot(plan: TransformPlan, header: dict, arrays: dict) -> int:
     aot_meta = header["meta"].get("aot") or {}
     if not aot_meta:
         return 0
+    # fault seam: an injected failure here flows into load_key's
+    # poisoned-restore handling -> typed CORRUPT reject + clean rebuild
+    _faults.check_site("store.aot")
     try:
         import jax
         from jax import export as jax_export
@@ -470,6 +486,12 @@ class PlanArtifactStore:
         self._rejects: Dict[str, int] = {}  #: guarded by _lock
         #: guarded by _lock
         self._spill_threads: List[threading.Thread] = []
+        self._degraded_reason: Optional[str] = None  #: guarded by _lock
+        self._degraded_since = 0.0  #: guarded by _lock
+        self._reprobe_at = 0.0      #: guarded by _lock
+        #: guarded by _lock
+        self._reprobe_interval = REPROBE_INTERVAL_S
+        self._io_retries = 0        #: guarded by _lock
         os.makedirs(self._dir("artifacts"), exist_ok=True)
         os.makedirs(self._dir("requests"), exist_ok=True)
 
@@ -508,15 +530,143 @@ class PlanArtifactStore:
                     "spills": self._spills,
                     "rejects": dict(self._rejects)}
 
+    # -- degradation ladder ------------------------------------------------
+    def _degrade(self, exc: BaseException) -> None:
+        """Flip to the MEMORY-ONLY tier after a persistent disk fault:
+        spills are skipped (the registry's LRU keeps serving), loads
+        still attempt (per-artifact failures reject to clean rebuilds),
+        ``health()`` reports degraded, and a periodic re-probe checks
+        whether the volume recovered."""
+        now = time.monotonic()
+        with self._lock:
+            fresh = self._degraded_reason is None
+            self._degraded_reason = f"{type(exc).__name__}: {exc}"
+            if fresh:
+                self._degraded_since = now
+                self._reprobe_interval = REPROBE_INTERVAL_S
+            else:
+                self._reprobe_interval = min(
+                    self._reprobe_interval * 2, REPROBE_MAX_INTERVAL_S)
+            self._reprobe_at = now + self._reprobe_interval
+            interval = self._reprobe_interval
+        _obs.GLOBAL_COUNTERS.set("spfft_store_degraded", 1.0)
+        import logging
+        logging.getLogger("spfft_tpu").warning(
+            "spfft_tpu: plan-artifact store degraded to memory-only "
+            "(%r) — spills disabled, re-probe in %.0f s", exc, interval)
+
+    def _maybe_reprobe(self) -> None:
+        """While degraded, probe the volume once per backoff interval:
+        an atomic probe write that succeeds lifts the degradation; a
+        failure doubles the interval (capped)."""
+        with self._lock:
+            if self._degraded_reason is None \
+                    or time.monotonic() < self._reprobe_at:
+                return
+            # claim this probe slot so concurrent callers don't stack
+            self._reprobe_at = time.monotonic() + self._reprobe_interval
+        probe = os.path.join(self.root, ".reprobe")
+        try:
+            self._atomic_write_once(probe, b"probe")
+            os.unlink(probe)
+        except Exception:
+            self._degrade_extend()
+            _obs.GLOBAL_COUNTERS.inc("spfft_store_reprobes_total",
+                                     outcome="failed")
+            return
+        with self._lock:
+            self._degraded_reason = None
+            self._degraded_since = 0.0
+            self._reprobe_interval = REPROBE_INTERVAL_S
+        _obs.GLOBAL_COUNTERS.set("spfft_store_degraded", 0.0)
+        _obs.GLOBAL_COUNTERS.inc("spfft_store_reprobes_total",
+                                 outcome="recovered")
+        import logging
+        logging.getLogger("spfft_tpu").warning(
+            "spfft_tpu: plan-artifact store disk re-probe succeeded — "
+            "memory-only degradation lifted, spills re-enabled")
+
+    def _degrade_extend(self) -> None:
+        with self._lock:
+            self._reprobe_interval = min(
+                self._reprobe_interval * 2, REPROBE_MAX_INTERVAL_S)
+            self._reprobe_at = time.monotonic() + self._reprobe_interval
+
+    @property
+    def degraded(self) -> bool:
+        """True while the store runs the memory-only tier."""
+        with self._lock:
+            return self._degraded_reason is not None
+
+    def health(self) -> Dict:
+        """Liveness snapshot for operators and the executor's
+        ``health()``: ``state`` is ``"ok"`` or ``"degraded"``
+        (memory-only tier after a persistent disk fault), with the
+        triggering reason, how long it has been degraded, and when the
+        next disk re-probe is due."""
+        with self._lock:
+            if self._degraded_reason is None:
+                return {"state": "ok", "mode": "disk",
+                        "io_retries": self._io_retries}
+            now = time.monotonic()
+            return {
+                "state": "degraded",
+                "mode": "memory-only",
+                "reason": self._degraded_reason,
+                "degraded_for_s": round(now - self._degraded_since, 3),
+                "next_probe_in_s": round(
+                    max(0.0, self._reprobe_at - now), 3),
+                "io_retries": self._io_retries,
+            }
+
+    def _check(self, site: str) -> None:
+        """Fault checkpoint that classifies like real I/O: an injected
+        persistent disk fault (the ``enospc`` kind) degrades the store
+        exactly as a genuine one surfacing from the filesystem would."""
+        try:
+            _faults.check_site(site)
+        except OSError as exc:
+            if _faults.is_persistent_disk_error(exc):
+                self._degrade(exc)
+            raise
+
+    def _retry_io(self, op: str, fn):
+        """Run one I/O operation under the degradation ladder: a
+        transient ``OSError`` (EINTR, a brief NFS hiccup — anything
+        outside :data:`~spfft_tpu.faults.PERSISTENT_DISK_ERRNOS`) gets
+        :data:`IO_RETRIES` bounded retries with geometric backoff; a
+        persistent disk fault degrades the store to memory-only and
+        re-raises for the caller's typed handling."""
+        delay = IO_BACKOFF_S
+        for attempt in range(IO_RETRIES + 1):
+            try:
+                return fn()
+            except FileNotFoundError:
+                raise  # a miss, not an I/O fault
+            except OSError as exc:
+                if _faults.is_persistent_disk_error(exc):
+                    self._degrade(exc)
+                    raise
+                if attempt >= IO_RETRIES:
+                    raise
+                with self._lock:
+                    self._io_retries += 1
+                _obs.GLOBAL_COUNTERS.inc("spfft_store_io_retries_total",
+                                         op=op)
+                time.sleep(delay)
+                delay *= 2
+
     # -- writing -----------------------------------------------------------
-    def _atomic_write(self, path: str, data: bytes) -> None:
+    def _atomic_write_once(self, path: str, data: bytes) -> None:
         fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
                                    prefix=".tmp-")
         try:
             with os.fdopen(fd, "wb") as f:
                 f.write(data)
                 f.flush()
+                _faults.check_site("store.fsync")
                 os.fsync(f.fileno())
+            _faults.check_site("store.replace")
             os.replace(tmp, path)
         except BaseException:
             try:
@@ -525,17 +675,29 @@ class PlanArtifactStore:
                 pass
             raise
 
+    def _atomic_write(self, path: str, data: bytes) -> None:
+        self._retry_io("write",
+                       lambda: self._atomic_write_once(path, data))
+
     def save_plan(self, sig: PlanSignature, plan: TransformPlan,
                   triplets=None, aot: Optional[bool] = None) -> str:
         """Serialize and atomically write one artifact (plus a request
         alias when the raw ``triplets`` are given). Returns the
-        artifact key."""
+        artifact key. While the store is DEGRADED (memory-only tier)
+        the write is skipped — counted under
+        ``spfft_store_rejects_total{reason=degraded}`` — unless the
+        periodic re-probe just lifted the degradation."""
         t0 = time.perf_counter()
+        self._check("store.spill")
+        self._maybe_reprobe()
+        key = signature_key(sig)
+        if self.degraded:
+            self._count("reject", REASON_DEGRADED)
+            return key
         if aot is None:
             aot = aot_enabled()
         blobs = export_aot_blobs(plan) if aot else {}
         data = serialize_artifact(sig, plan, blobs)
-        key = signature_key(sig)
         self._atomic_write(self.artifact_path(key), data)
         if triplets is not None:
             rkey = request_key(sig.transform_type, sig.dim_x, sig.dim_y,
@@ -590,9 +752,14 @@ class PlanArtifactStore:
     # -- reading -----------------------------------------------------------
     def _read_artifact(self, key: str):
         path = self.artifact_path(key)
-        try:
+
+        def read():
             with open(path, "rb") as f:
-                data = f.read()
+                return f.read()
+
+        try:
+            self._check("store.load")
+            data = self._retry_io("read", read)
         except FileNotFoundError:
             return None
         except OSError as exc:

@@ -17,7 +17,8 @@ import sys
 import pytest
 
 from spfft_tpu.analysis import (baseline, counters_check, errors_check,
-                                knobs, locks, run_analysis, spans)
+                                faults_check, knobs, locks, run_analysis,
+                                spans)
 from spfft_tpu.analysis.core import index_sources
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -664,7 +665,8 @@ def test_analysis_cli_smoke(tmp_path):
     assert payload["summary"]["errors"] == 0
     assert set(payload["checkers"]) == {
         "lock-discipline", "span-closure", "counter-registry",
-        "error-taxonomy", "knob-registry", "baseline-lint"}
+        "error-taxonomy", "knob-registry", "fault-sites",
+        "baseline-lint"}
     assert payload["waivers"], "the report must list the waivers"
 
 
@@ -723,3 +725,120 @@ def test_counters_enforce_declared_types_at_runtime():
     snap = c.snapshot()
     assert snap["spfft_control_knob"]["help"] == \
         "Current value of each control-plane knob."
+
+
+# ---------------------------------------------------------------------------
+# fault-sites
+# ---------------------------------------------------------------------------
+
+FAULT_SITES_DECL = '''
+SITES = (
+    "store.spill",
+    "kernel.launch",
+)
+'''
+
+FAULT_SITES_OK = '''
+from . import faults as _faults
+
+def spill():
+    _faults.check_site("store.spill")
+
+def launch():
+    _faults.check_site("kernel.launch")
+'''
+
+
+def test_fault_sites_clean():
+    findings, extras = faults_check.check(index_sources({
+        "faults.py": FAULT_SITES_DECL, "store.py": FAULT_SITES_OK}))
+    assert _errors(findings) == []
+    assert extras == {"declared_sites": 2, "checked_sites": 2}
+
+
+def test_fault_sites_catches_undeclared_reference():
+    src = FAULT_SITES_OK.replace('check_site("store.spill")',
+                                 'check_site("store.spil")')
+    findings, _ = faults_check.check(index_sources({
+        "faults.py": FAULT_SITES_DECL, "store.py": src}))
+    errs = _errors(findings)
+    assert any("store.spil" in f.message and "not declared" in f.message
+               for f in errs)
+    # the typo also orphans the declared site
+    assert any("store.spill" in f.message
+               and "dead coverage claim" in f.message for f in errs)
+
+
+def test_fault_sites_catches_never_checked_declaration():
+    src = FAULT_SITES_OK.replace(
+        'def launch():\n    _faults.check_site("kernel.launch")\n', "")
+    findings, _ = faults_check.check(index_sources({
+        "faults.py": FAULT_SITES_DECL, "store.py": src}))
+    errs = _errors(findings)
+    assert any("kernel.launch" in f.message
+               and "dead coverage claim" in f.message for f in errs)
+
+
+def test_fault_sites_catches_duplicate_declaration():
+    dup = FAULT_SITES_DECL.replace('    "store.spill",',
+                                   '    "store.spill",\n'
+                                   '    "store.spill",')
+    findings, _ = faults_check.check(index_sources({
+        "faults.py": dup, "store.py": FAULT_SITES_OK}))
+    errs = _errors(findings)
+    assert any("more than once" in f.message for f in errs)
+
+
+def test_fault_sites_waiver_is_listed_not_failed():
+    src = FAULT_SITES_OK.replace(
+        '_faults.check_site("store.spill")',
+        '_faults.check_site("store.probe")'
+        '  # faults: waived(staging: declared next round)')
+    findings, _ = faults_check.check(index_sources({
+        "faults.py": FAULT_SITES_DECL, "store.py": src}))
+    waived = [f for f in findings if f.waived]
+    assert any("store.probe" in f.message for f in waived)
+    assert not [f for f in _errors(findings)
+                if "store.probe" in f.message]
+
+
+def test_fault_sites_loose_check_calls_need_dots():
+    """Unrelated .check("x") calls (no dot, not a declared site) are
+    NOT fault-seam references; dotted literals and declared names
+    are."""
+    src = '''
+def other(validator, seam):
+    validator.check("shape")          # unrelated: ignored
+    seam.check("exchange.pack")       # dotted: a seam reference
+    seam.check("kernel.launch")       # declared: a seam reference
+'''
+    findings, extras = faults_check.check(index_sources({
+        "faults.py": FAULT_SITES_DECL, "ops.py": src}))
+    errs = _errors(findings)
+    assert not any("'shape'" in f.message for f in errs)
+    assert any("exchange.pack" in f.message and "not declared"
+               in f.message for f in errs)
+    assert extras["checked_sites"] == 2
+
+
+def test_fault_sites_missing_registry_is_an_error():
+    findings, extras = faults_check.check(index_sources({
+        "store.py": FAULT_SITES_OK}))
+    errs = _errors(findings)
+    assert any("no SITES declaration" in f.message for f in errs)
+    assert extras == {}
+
+
+def test_fault_sites_grammar_and_non_literal_entries():
+    bad = '''
+PREFIX = "store"
+SITES = (
+    "Store.Spill",
+    PREFIX + ".load",
+)
+'''
+    findings, _ = faults_check.check(index_sources({
+        "faults.py": bad, "store.py": "x = 1\n"}))
+    errs = _errors(findings)
+    assert any("site grammar" in f.message for f in errs)
+    assert any("non-literal entry" in f.message for f in errs)

@@ -447,3 +447,140 @@ def test_dist_fwd_twin_recompute_counter_recorded(fused_env):
     text = obs.prometheus_text()
     assert ('spfft_plan_pallas_fallback_total{reason="recompute_blowup"'
             ',stage="dist_fused_zdft_compress"}') in text
+
+
+# -- runtime demotion ladder -------------------------------------------------
+
+def test_runtime_launch_fault_demotes_one_direction(fused_env):
+    """An injected kernel.launch fault during backward demotes EXACTLY
+    the dec direction: the failing request itself succeeds on the
+    unfused retry (bit-exact), the reason is recorded, forward stays
+    fused, and the next backward runs unfused without re-failing."""
+    from spfft_tpu import faults
+
+    tr = _gappy_triplets()
+    plan = _plan(tr)
+    vals = _values(plan.index_plan.num_values)
+    want = _unfused_backward(plan, vals)
+    try:
+        faults.arm(faults.FaultPlan(script="kernel.launch@1"))
+        got = np.asarray(plan.backward(vals))
+    finally:
+        faults.disarm()
+    np.testing.assert_array_equal(got, want)
+
+    dem = plan.fused_demotions()
+    assert set(dem) == {"dec"}
+    assert "runtime" in dem["dec"]["reason"]
+    assert "InjectedFault" in dem["dec"]["reason"]
+    assert not dem["dec"]["permanent"]
+
+    # the direction stays demoted and serving continues
+    np.testing.assert_array_equal(np.asarray(plan.backward(vals)), want)
+    assert plan.fused_demotions()["dec"]["unfused_ok"] >= 1
+
+
+def test_runtime_demotion_reprobe_readmits(fused_env):
+    """The bounded re-probe: a demoted direction banks
+    FUSED_REPROBE_AFTER unfused successes, then the next dispatch runs
+    the fused kernel again as a probe — success lifts the demotion."""
+    from spfft_tpu import faults
+
+    tr = _gappy_triplets()
+    plan = _plan(tr)
+    vals = _values(plan.index_plan.num_values)
+    want = _unfused_backward(plan, vals)
+    try:
+        faults.arm(faults.FaultPlan(script="kernel.launch@1"))
+        np.testing.assert_array_equal(
+            np.asarray(plan.backward(vals)), want)
+    finally:
+        faults.disarm()
+    assert set(plan.fused_demotions()) == {"dec"}
+
+    for i in range(plan.FUSED_REPROBE_AFTER - 1):
+        plan.backward(vals)
+    rec = plan.fused_demotions()["dec"]
+    assert rec["unfused_ok"] == plan.FUSED_REPROBE_AFTER - 1
+    assert not rec["probing"]
+
+    plan.backward(vals)  # banks the last unfused success
+    assert plan.fused_demotions()["dec"]["probing"]
+
+    # the probe call runs fused (no fault armed) and readmits
+    got = np.asarray(plan.backward(vals))
+    np.testing.assert_array_equal(got, want)
+    assert plan.fused_demotions() == {}
+
+
+def test_runtime_demotion_permanent_after_failed_probes(fused_env):
+    """kernel.launch@* (the device really is broken): every re-probe
+    fails, and after FUSED_REPROBE_MAX failed probes the demotion is
+    permanent — no further probes, requests keep succeeding unfused."""
+    from spfft_tpu import faults
+
+    tr = _gappy_triplets()
+    plan = _plan(tr)
+    vals = _values(plan.index_plan.num_values)
+    want = _unfused_backward(plan, vals)
+    try:
+        # @* only fires on FUSED dispatches; banked unfused calls never
+        # reach the kernel.launch check, so the script stays armed
+        faults.arm(faults.FaultPlan(script="kernel.launch@*"))
+        np.testing.assert_array_equal(
+            np.asarray(plan.backward(vals)), want)
+        for probe in range(plan.FUSED_REPROBE_MAX):
+            for _ in range(plan.FUSED_REPROBE_AFTER):
+                plan.backward(vals)
+            assert plan.fused_demotions()["dec"]["probing"]
+            # the probe dispatch fails fused, re-demotes, serves unfused
+            np.testing.assert_array_equal(
+                np.asarray(plan.backward(vals)), want)
+            rec = plan.fused_demotions()["dec"]
+            assert rec["probes"] == probe + 1
+    finally:
+        faults.disarm()
+    rec = plan.fused_demotions()["dec"]
+    assert rec["permanent"]
+    assert rec["probes"] == plan.FUSED_REPROBE_MAX
+
+    # permanent: banking many successes never flips probing again
+    for _ in range(plan.FUSED_REPROBE_AFTER + 1):
+        plan.backward(vals)
+    rec = plan.fused_demotions()["dec"]
+    assert rec["permanent"] and not rec["probing"]
+    np.testing.assert_array_equal(np.asarray(plan.backward(vals)), want)
+
+
+def test_runtime_demotion_forward_direction_independent(fused_env):
+    """Demoting cmp (forward) leaves dec (backward) fused: the ladder
+    is strictly per-direction."""
+    from spfft_tpu import faults
+
+    tr = _gappy_triplets()
+    plan = _plan(tr)
+    vals = _values(plan.index_plan.num_values)
+    space = plan.backward(vals)
+    want = _unfused_forward(plan, space, scaled=False)
+    try:
+        faults.arm(faults.FaultPlan(script="kernel.launch@1"))
+        got = np.asarray(plan.forward(space, scaling=Scaling.NONE))
+    finally:
+        faults.disarm()
+    np.testing.assert_array_equal(got, want)
+    assert set(plan.fused_demotions()) == {"cmp"}
+    # backward still dispatches fused (no demotion recorded for dec)
+    plan.backward(vals)
+    assert set(plan.fused_demotions()) == {"cmp"}
+
+
+def test_request_shaped_error_does_not_demote(fused_env):
+    """A poisoned payload (request-attributed) must propagate untouched
+    and never demote the kernel — demotion is for device faults only."""
+    from spfft_tpu.errors import InvalidParameterError
+
+    tr = _gappy_triplets()
+    plan = _plan(tr)
+    with pytest.raises(InvalidParameterError):
+        plan.backward(np.zeros(3, np.complex64))
+    assert plan.fused_demotions() == {}

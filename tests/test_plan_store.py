@@ -531,3 +531,186 @@ def test_store_smoke_cross_process(tmp_path):
     assert report["builds"] == 0
     assert report["reference_bit_exact"] is True
     assert report["compile_events"]["compression_tables"] == 0
+
+
+# -- degradation ladder: disk faults at the write seams ---------------------
+
+def _no_tmp_files(store):
+    leftovers = []
+    for dirpath, _, names in os.walk(store.root):
+        leftovers += [os.path.join(dirpath, n) for n in names
+                      if n.startswith(".tmp-")]
+    return leftovers
+
+
+def test_store_transient_io_retry_succeeds(tmp_path, monkeypatch):
+    """A transient OSError (EINTR-shaped) during the atomic write gets
+    the bounded retry and the spill SUCCEEDS — no degradation, the
+    retry is counted, and the artifact round-trips."""
+    import errno
+
+    store, reg, tr, sig, plan = _build_store(tmp_path)
+    key = signature_key(sig)
+    os.unlink(store.artifact_path(key))
+
+    real_fsync = os.fsync
+    fails = {"n": 0}
+
+    def flaky_fsync(fd):
+        if fails["n"] == 0:
+            fails["n"] += 1
+            raise OSError(errno.EINTR, "Interrupted system call")
+        return real_fsync(fd)
+
+    monkeypatch.setattr(store_mod.os, "fsync", flaky_fsync)
+    assert store.save_plan(sig, plan) == key
+    monkeypatch.undo()
+
+    health = store.health()
+    assert health["state"] == "ok"
+    assert health["io_retries"] >= 1
+    assert fails["n"] == 1
+    assert os.path.exists(store.artifact_path(key))
+    got = store.load_key(key)
+    assert got is not None and signature_key(got[0]) == key
+    assert not _no_tmp_files(store)
+
+
+def test_store_enospc_mid_spill_degrades_to_memory_only(tmp_path):
+    """An injected ENOSPC at the spill seam flips the store to the
+    memory-only tier: the failing save raises typed OSError and leaves
+    NO artifact and NO temp file; subsequent saves are skipped and
+    counted under rejects{degraded}; a forced re-probe on a healthy
+    volume lifts the degradation and spills resume."""
+    from spfft_tpu import faults
+
+    store, reg, tr, sig, plan = _build_store(tmp_path)
+    key = signature_key(sig)
+    os.unlink(store.artifact_path(key))
+    try:
+        faults.arm(faults.FaultPlan(script="store.spill@1:enospc"))
+        with pytest.raises(OSError):
+            store.save_plan(sig, plan)
+    finally:
+        faults.disarm()
+
+    assert store.degraded
+    health = store.health()
+    assert health["state"] == "degraded" and health["mode"] == "memory-only"
+    assert "InjectedDiskFull" in health["reason"]
+    assert not os.path.exists(store.artifact_path(key))
+    assert not _no_tmp_files(store)
+
+    # degraded: the next save is skipped, typed-counted, still no file
+    assert store.save_plan(sig, plan) == key
+    assert store.stats()["rejects"].get("degraded", 0) >= 1
+    assert not os.path.exists(store.artifact_path(key))
+
+    # volume is actually fine (the fault was injected): a due re-probe
+    # lifts the degradation and the same save goes to disk
+    store._reprobe_at = 0.0
+    assert store.save_plan(sig, plan) == key
+    assert not store.degraded
+    assert os.path.exists(store.artifact_path(key))
+    got = store.load_key(key)
+    assert got is not None and signature_key(got[0]) == key
+
+
+def test_store_torn_write_leaves_no_partial_artifact(tmp_path):
+    """A disk-full at the replace seam — after the temp file is fully
+    written but before it lands — must never leave either a torn
+    artifact or the orphan temp: the cleanup unlinks the temp, the
+    store degrades, and verify() stays clean."""
+    from spfft_tpu import faults
+
+    store, reg, tr, sig, plan = _build_store(tmp_path)
+    key = signature_key(sig)
+    os.unlink(store.artifact_path(key))
+    try:
+        faults.arm(faults.FaultPlan(script="store.replace@1:enospc"))
+        with pytest.raises(OSError):
+            store.save_plan(sig, plan)
+    finally:
+        faults.disarm()
+
+    assert store.degraded
+    assert not os.path.exists(store.artifact_path(key))
+    assert not _no_tmp_files(store)
+    assert not [row for row in store.verify() if not row.get("ok")]
+
+
+def test_store_read_only_directory_degrades_and_serving_continues(
+        tmp_path, monkeypatch):
+    """EROFS (a genuinely read-only volume, simulated at os.replace
+    because tests run as root and chmod is advisory) classifies as a
+    PERSISTENT disk fault: the store degrades, and the registry keeps
+    building and serving plans from memory with spills skipped."""
+    import errno
+
+    store, reg, tr, sig, plan = _build_store(tmp_path)
+
+    def erofs(src, dst):
+        raise OSError(errno.EROFS, "Read-only file system")
+
+    monkeypatch.setattr(store_mod.os, "replace", erofs)
+    with pytest.raises(OSError):
+        store.save_plan(sig, plan)
+    monkeypatch.undo()
+
+    assert store.degraded
+    assert "Read-only" in store.health()["reason"]
+
+    # serving continues: a fresh build succeeds, its spill is skipped
+    sig2, plan2 = reg.get_or_build(TransformType.C2C, 16, 16, 16,
+                                   _triplets(16))
+    store.drain()
+    assert reg.get(sig2) is plan2
+    assert plan2.index_plan.num_values > 0
+    assert store.stats()["rejects"].get("degraded", 0) >= 1
+    assert not os.path.exists(store.artifact_path(signature_key(sig2)))
+
+
+def test_gc_during_load_is_typed_and_rebuilds_clean(tmp_path):
+    """Concurrent GC racing readers: every load_key result is either a
+    full (signature, plan) or a clean miss (None) — never an exception,
+    never a torn read — and after GC empties the store the registry
+    rebuilds from scratch to the same signature."""
+    store, reg, tr, sig, plan = _build_store(tmp_path)
+    key = signature_key(sig)
+    results, errors = [], []
+    stop = threading.Event()
+
+    def loader():
+        for _ in range(500):
+            if stop.is_set():
+                break
+            try:
+                results.append(store.load_key(key))
+            except BaseException as exc:  # noqa: BLE001 - the assertion
+                errors.append(exc)
+                break
+
+    threads = [threading.Thread(target=loader) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(10):
+            store.gc(max_bytes=1)
+            store.save_plan(sig, plan)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+    assert not errors, errors
+    assert results
+    for got in results:
+        assert got is None or signature_key(got[0]) == key
+
+    # empty the store for real: a miss, then a bit-exact clean rebuild
+    store.gc(max_bytes=1)
+    assert store.load_key(key) is None
+    reg2 = PlanRegistry(store=store)
+    sig3, plan3 = reg2.get_or_build(TransformType.C2C, DIM, DIM, DIM, tr)
+    assert signature_key(sig3) == key
+    np.testing.assert_array_equal(
+        plan.index_plan.slot_src, plan3.index_plan.slot_src)

@@ -299,3 +299,29 @@ def test_serve_bench_slo_flag_reports(capsys):
     assert payload["slo"]["violations"] == []
     assert payload["slo"]["objectives"]["latency_p99_s"] == 60.0
     assert "slo:" in text
+
+
+def test_serve_bench_chaos_harness(capsys):
+    """The tier-1 chaos twin (make chaos-smoke runs two more seeds):
+    three scripted recovery-ladder phases plus seeded multi-seam fault
+    storms — no hangs, typed failures only, bit-exact healthy requests,
+    no torn artifacts, zero open spans — exit 1 on any violation."""
+    from spfft_tpu import faults
+
+    try:
+        rc = main(["--chaos", "7"])
+    finally:
+        faults.disarm()
+    assert rc == 0
+    payload, text = _last_json(capsys)
+    assert payload["chaos"] and payload["ok"]
+    assert payload["failures"] == []
+    assert payload["seed"] == 7
+    assert "A_fused_demotion" in payload["phases"]
+    assert "B_enospc_memory_only" in payload["phases"]
+    assert "C_execute_watchdog" in payload["phases"]
+    # the coverage floor the harness itself enforces, restated here so
+    # a silent scope regression fails the tier-1 suite too
+    assert len(payload["fired_sites"]) >= 8
+    assert len(payload["subsystems"]) >= 4
+    assert "chaos" in text

@@ -823,3 +823,70 @@ def test_crash_during_window_wait_closes_bucket_spans(monkeypatch):
     finally:
         obs.disable()
         obs.GLOBAL_TRACER.reset()
+
+
+# -- runtime error-text corpus ----------------------------------------------
+
+def _load_corpus():
+    import json
+    import os
+    path = os.path.join(os.path.dirname(__file__), "data",
+                        "runtime_error_corpus.json")
+    with open(path) as f:
+        return json.load(f)["entries"]
+
+
+def _build_exc(entry):
+    exc_type = {"RuntimeError": RuntimeError, "TimeoutError": TimeoutError,
+                "TypeError": TypeError, "ValueError": ValueError,
+                "IndexError": IndexError, "KeyError": KeyError,
+                "OSError": OSError}[entry["exc_type"]]
+    if entry["exc_type"] == "OSError":
+        return OSError(entry["errno"], entry["text"])
+    return exc_type(entry["text"])
+
+
+@pytest.mark.parametrize("entry", _load_corpus(),
+                         ids=lambda e: e["name"])
+def test_error_corpus_classification(entry):
+    """Table-driven classifier contract against REAL runtime error text
+    (XLA/PJRT status strings, Mosaic compile failures, OS errnos): the
+    corpus in tests/data/runtime_error_corpus.json pins is_transient,
+    attributes_device and is_persistent_disk_error to the strings the
+    runtime actually emits, so a classifier regression fails with the
+    exact message it would mishandle in production."""
+    from spfft_tpu import faults
+
+    exc = _build_exc(entry)
+    assert faults.is_transient(exc) == entry["transient"], \
+        f"is_transient wrong for {entry['name']}: {entry['text']!r}"
+    assert faults.attributes_device(exc) == entry["device_attributed"], \
+        f"attributes_device wrong for {entry['name']}"
+    if "persistent_disk" in entry:
+        assert faults.is_persistent_disk_error(exc) \
+            == entry["persistent_disk"], \
+            f"is_persistent_disk_error wrong for {entry['name']}"
+    else:
+        assert not faults.is_persistent_disk_error(exc)
+
+
+def test_error_corpus_covers_every_transient_marker():
+    """Every marker in faults.TRANSIENT_MARKERS appears in at least one
+    corpus entry — adding a marker without a real-text exemplar is a
+    coverage hole."""
+    from spfft_tpu import faults
+
+    texts = [e["text"] for e in _load_corpus()]
+    for marker in faults.TRANSIENT_MARKERS:
+        assert any(marker in t for t in texts), \
+            f"no corpus entry exercises marker {marker!r}"
+
+
+def test_error_corpus_covers_every_persistent_errno():
+    """Every errno in faults.PERSISTENT_DISK_ERRNOS appears in the
+    corpus with persistent_disk=true."""
+    from spfft_tpu import faults
+
+    errnos = {e["errno"] for e in _load_corpus()
+              if e.get("persistent_disk")}
+    assert set(faults.PERSISTENT_DISK_ERRNOS) <= errnos

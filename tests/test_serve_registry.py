@@ -324,3 +324,89 @@ def test_singleflight_failure_released_to_all_waiters_at_once(
     sig, plan = reg.get_or_build(TransformType.C2C, *DIMS, t,
                                  precision="double")
     assert plan is not None and reg.stats()["builds"] == 1
+
+
+# -- background-builder death -----------------------------------------------
+# The BACKGROUND half of the plan.build seam is ambient call #2 (call
+# #1 is the foreground construction); use_pallas=True spawns the
+# builder thread even on CPU. The hang variant (sleep-then-fail) makes
+# the death land AFTER construction returns, pinning down exactly which
+# later checkpoint must surface it.
+
+def test_builder_death_surfaces_at_get_or_build_resolution():
+    """A builder that dies IMMEDIATELY is surfaced typed at registry
+    resolution: either the owner-path check_build catches it inside the
+    building get_or_build, or (when the race goes the other way) the
+    sticky TableBuildError surfaces on the very next fast-path hit —
+    never on a request."""
+    from spfft_tpu import faults
+    from spfft_tpu.errors import TableBuildError
+
+    t = _triplets()
+    reg = PlanRegistry()
+    try:
+        faults.arm(faults.FaultPlan(script="plan.build@2"))
+        try:
+            sig, plan = reg.get_or_build(TransformType.C2C, *DIMS, t,
+                                         use_pallas=True)
+        except TableBuildError:
+            return  # owner path saw the dead builder — done
+        with pytest.raises(TableBuildError):
+            plan.check_build(wait=True)
+        # the error is sticky: the memoized fast path refuses to hand
+        # the doomed plan out
+        with pytest.raises(TableBuildError):
+            reg.get_or_build(TransformType.C2C, *DIMS, t,
+                             use_pallas=True)
+    finally:
+        faults.disarm()
+
+
+def test_builder_death_surfaces_in_warmup():
+    """warmup() is the blocking pre-traffic path: it JOINS the build,
+    so a builder doomed to die later still fails the warmup call
+    itself, not the first request."""
+    from spfft_tpu import faults
+    from spfft_tpu.errors import TableBuildError
+
+    t = _triplets()
+    reg = PlanRegistry()
+    spec = {"transform_type": TransformType.C2C, "dim_x": DIMS[0],
+            "dim_y": DIMS[1], "dim_z": DIMS[2], "triplets": t,
+            "use_pallas": True}
+    try:
+        faults.arm(faults.FaultPlan(script="plan.build@2:hang",
+                                    hang_seconds=0.2))
+        with pytest.raises(TableBuildError):
+            reg.warmup([spec])
+    finally:
+        faults.disarm()
+
+
+def test_builder_death_surfaces_in_executor_prewarm():
+    """Executor prewarm joins the background build before compiling:
+    a plan whose builder dies after registration fails prewarm with the
+    typed TableBuildError instead of poisoning the first routed
+    request."""
+    from spfft_tpu import faults
+    from spfft_tpu.errors import TableBuildError
+    from spfft_tpu.serve import ServeExecutor
+
+    t = _triplets()
+    reg = PlanRegistry()
+    try:
+        faults.arm(faults.FaultPlan(script="plan.build@2:hang",
+                                    hang_seconds=0.2))
+        # the builder sleeps 0.2 s before dying, so registration and
+        # executor construction see a live (not-yet-failed) build
+        sig, plan = reg.get_or_build(TransformType.C2C, *DIMS, t,
+                                     use_pallas=True)
+        assert reg.get(sig) is plan
+        ex = ServeExecutor(reg, autostart=False)
+        try:
+            with pytest.raises(TableBuildError):
+                ex.prewarm(sig)
+        finally:
+            ex.close()
+    finally:
+        faults.disarm()

@@ -1,0 +1,73 @@
+"""On-TPU chaos twin (make ci-tpu): the seeded multi-seam fault storms
+and the fused-demotion ladder against REAL Mosaic kernels and real
+device dispatch.
+
+The CPU chaos lane (tests/test_serve_bench_cli.py::
+test_serve_bench_chaos_harness + make chaos-smoke) proves the recovery
+ladders over interpret-mode kernels; this lane re-proves the two
+behaviours where the hardware itself is load-bearing:
+
+  * a kernel.launch fault demoting a REAL fused Mosaic kernel to the
+    unfused composition, bit-exact, with the re-probe running actual
+    codegen again;
+  * a full storm sweep where injected faults race genuine device
+    dispatch/transfer latencies instead of interpret-mode timing.
+"""
+
+import numpy as np
+
+from spfft_tpu import Scaling, TransformType, faults, make_local_plan
+from spfft_tpu.serve.bench import main
+
+DIM_Z = 128
+
+
+def _gappy_triplets(nx=8, ny=6, nz=DIM_Z, z_step=2):
+    return [(x, y, z) for x in range(nx) for y in range(ny)
+            if (x + y) % 3 != 0 for z in range(0, nz, z_step)]
+
+
+def _values(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal(n)
+            + 1j * rng.standard_normal(n)).astype(np.complex64)
+
+
+def test_chaos_harness_on_tpu(capsys):
+    """The full seeded chaos run on the real chip: same invariants (no
+    hangs, typed failures only, bit-exact healthy requests, clean
+    store, zero open spans), real kernels and device queues underneath.
+    A different seed from the CPU lane's, on purpose."""
+    try:
+        rc = main(["--chaos", "31"])
+    finally:
+        faults.disarm()
+    assert rc == 0
+
+
+def test_fused_demotion_on_real_mosaic():
+    """Runtime demotion with a REAL fused Mosaic kernel: the injected
+    launch fault demotes dec, the unfused retry is bit-exact against
+    the pre-fault fused output, and the re-probe (a genuine second
+    Mosaic dispatch) readmits."""
+    tr = _gappy_triplets()
+    plan = make_local_plan(TransformType.C2C, 8, 6, DIM_Z,
+                           np.asarray(tr, np.int32),
+                           precision="single", use_pallas=True)
+    vals = _values(plan.index_plan.num_values)
+    want = np.asarray(plan.backward(vals))  # fused, healthy
+    assert plan.fused_demotions() == {}
+    try:
+        faults.arm(faults.FaultPlan(script="kernel.launch@1"))
+        got = np.asarray(plan.backward(vals))
+    finally:
+        faults.disarm()
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+    assert set(plan.fused_demotions()) == {"dec"}
+
+    for _ in range(plan.FUSED_REPROBE_AFTER):
+        plan.backward(vals)
+    assert plan.fused_demotions()["dec"]["probing"]
+    got = np.asarray(plan.backward(vals))  # the probe: real codegen
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+    assert plan.fused_demotions() == {}
