@@ -16,8 +16,8 @@ PLANNER_SO  := $(NATIVE_DIR)/_planner_$(CACHE_TAG).so
 CAPI_SO     := lib/libspfft_tpu.so
 
 .PHONY: all native capi example-c test ci ci-tpu trace-smoke \
-        control-smoke fused-smoke store-smoke chaos-smoke bench-check \
-        lint analyze clean
+        control-smoke fused-smoke store-smoke chaos-smoke \
+        cluster-smoke bench-check lint analyze clean
 
 # One-command CI (reference: .github/workflows/ci.yml builds + runs the
 # local test matrix): full CPU suite (8-device virtual mesh; includes the
@@ -74,10 +74,11 @@ analyze:
 # fault-injection: bucket isolation, device quarantine over the real
 # chip pool, crash-proof dispatch). Needs the real chip; record with
 #   make ci-tpu 2>&1 | tee docs/ci_tpu_r05.log
-# lint + analyze + chaos-smoke run first: the chip lane is expensive,
-# so it never starts on a tree the static passes already know is dirty
-# or whose failure semantics the CPU chaos harness can already break.
-ci-tpu: lint analyze chaos-smoke
+# lint + analyze + chaos-smoke + cluster-smoke run first: the chip
+# lane is expensive, so it never starts on a tree the static passes
+# already know is dirty or whose failure semantics the CPU chaos
+# harness / emulated pod can already break.
+ci-tpu: lint analyze chaos-smoke cluster-smoke
 	@echo "== CI-TPU: on-device regression lane =="
 	python -m pytest tests_tpu/ -q -rA
 	@echo "CI-TPU GREEN"
@@ -169,9 +170,10 @@ store-smoke:
 	@echo "STORE-SMOKE GREEN"
 
 # Chaos smoke (docs/serving.md "Failure semantics"): the seeded chaos
-# harness on two deterministic seeds — the three degradation-ladder
+# harness on two deterministic seeds — the four degradation-ladder
 # acceptance phases (runtime fused demotion, ENOSPC -> memory-only
-# store, execute-timeout watchdog) plus 16 seeded multi-seam fault
+# store, execute-timeout watchdog, pod lane death mid-trace) plus 16
+# seeded multi-seam fault
 # storms per seed across executor/plan/registry/store, asserting zero
 # hangs, typed failures only, bit-exact healthy requests, zero
 # unclosed spans and no torn store artifacts. Exit 1 on any violation.
@@ -186,6 +188,23 @@ chaos-smoke:
 	env JAX_PLATFORMS=cpu python -m spfft_tpu.serve.bench --chaos 1234 \
 	  -o build/chaos_smoke_s1234.json > /dev/null
 	@echo "CHAOS-SMOKE GREEN"
+
+# Pod smoke (docs/cluster.md): the in-process 2-host emulated pod —
+# 25 requests (single-device routed power-of-two-choices + one
+# DistributedTransformPlan through the pod-wide SPMD lane) bit-exact
+# vs direct plan execution, both hosts exercised, one trace id
+# end-to-end across the host boundary with zero unclosed spans, the
+# federated /metrics exposition re-parsed by the validating parser,
+# host-death failover, and the routing-policy simulation gates
+# (round-robin skew >= 4x, p2c <= 2x). Exit 1 on any violation. The
+# same checks run in tier-1 (tests/test_cluster.py); the on-chip twin
+# is staged in tests_tpu/test_pod_serve_on_tpu.py.
+cluster-smoke:
+	@echo "== cluster-smoke: emulated 2-host pod + routing simulation =="
+	env JAX_PLATFORMS=cpu \
+	  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	  python -m spfft_tpu.serve.cluster --smoke
+	@echo "CLUSTER-SMOKE GREEN"
 
 # Perf-trajectory guard (scripts/bench_regress.py): run the north-star
 # benchmark fresh and compare against the latest recorded BENCH_r*.json

@@ -83,7 +83,11 @@ def symmetry_rows() -> dict:
     * ``fused_r2c`` — how many of the two r2c fused seams (local
       backward kernel + distributed pre-exchange twin) are ACTIVE on
       the interpret lane (deterministic; 2 = the r2c decline stays
-      lifted).
+      lifted);
+    * ``pod_routing`` — the round-18 pod frontend's skewed-trace
+      imbalance reduction, rr completed-work skew over p2c skew
+      (seeded discrete-event replay of the real ``load_score``;
+      deterministic, so a drop means the routing policy regressed).
 
     Returns {} (with a stderr note) if the probe subprocess fails —
     the primary measurement must not die on an accounting row.
@@ -166,6 +170,11 @@ def symmetry_inner() -> None:
     dist_active = (int(bool(dist_ov.fused_dist_bwd_active))
                    + int(bool(dist_ov.fused_dist_fwd_active)))
 
+    # --- pod_routing: p2c-vs-rr skew on the recorded skewed trace ---
+    from spfft_tpu.serve.cluster import simulate_routing
+    rr = simulate_routing("rr")
+    p2c = simulate_routing("p2c")
+
     print(json.dumps({
         "wire_bytes_r2c": {
             "metric": f"{n}^3 spherical-cutoff R2C distributed exchange "
@@ -198,6 +207,16 @@ def symmetry_inner() -> None:
                       f"fwd={dist_ov.fused_dist_fwd_fallback_reason})",
             "value": dist_active,
             "unit": "directions",
+        },
+        "pod_routing": {
+            "metric": "pod frontend skewed-trace imbalance reduction: "
+                      "round-robin completed-work skew over p2c skew, "
+                      "seeded discrete-event replay of the live "
+                      "load_score (rr "
+                      f"{rr['ratio']:.2f}x vs p2c {p2c['ratio']:.2f}x; "
+                      "python -m spfft_tpu.serve.cluster --simulate)",
+            "value": round(rr["ratio"] / p2c["ratio"], 3),
+            "unit": "x",
         },
     }))
 

@@ -63,10 +63,14 @@ LOWER_IS_BETTER_UNITS = ("s", "ms", "us", "ns", "seconds", "bytes")
 #: higher is better) counts the distributed fused directions active
 #: under the K=2 overlap pipeline (chunk-sliceable backward + forward
 #: twin; 2 = fusion and overlap compose both ways) — a drop means a
-#: gate regressed to declining the composition. All emitted by
-#: bench.py every run.
+#: gate regressed to declining the composition. pod_routing (unit
+#: "x", higher is better) is the round-18 pod frontend's skewed-trace
+#: imbalance reduction (rr completed-work skew / p2c skew over the
+#: seeded discrete-event replay of the live load_score) — a drop past
+#: threshold means the routing policy stopped spreading the skewed
+#: load. All emitted by bench.py every run.
 SUB_ROWS = ("fused", "cold_start_ms", "warm_start_ms",
-            "wire_bytes_r2c", "fused_r2c", "fused_dist")
+            "wire_bytes_r2c", "fused_r2c", "fused_dist", "pod_routing")
 
 
 def load_payload(path: str) -> dict:

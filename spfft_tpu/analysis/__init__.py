@@ -19,6 +19,10 @@ and annotation syntax):
 * ``fault-sites`` — every fault-injection check names a site declared
   exactly once in ``faults.SITES``, and every declared site is checked
   somewhere (:mod:`.faults_check`);
+* ``trace-context`` — every ``# trace: boundary(param)``-annotated
+  cluster RPC boundary forwards its propagated trace context, opens
+  no context-less span, and is never called without the context bound
+  (:mod:`.trace_check`);
 * ``baseline-lint`` — unused imports + undefined names, the
   dependency-free twin of the ruff config (:mod:`.baseline`).
 
@@ -33,7 +37,7 @@ import os
 from typing import Dict, List, Optional
 
 from . import (baseline, counters_check, errors_check, faults_check,
-               knobs, locks, spans)
+               knobs, locks, spans, trace_check)
 from .core import (Finding, PackageIndex, Report, index_package,
                    index_sources)
 
@@ -45,7 +49,7 @@ __all__ = ["Finding", "PackageIndex", "Report", "index_package",
 #: them.
 CHECKERS = ("lock-discipline", "span-closure", "counter-registry",
             "error-taxonomy", "knob-registry", "fault-sites",
-            "baseline-lint")
+            "trace-context", "baseline-lint")
 
 
 def package_root() -> str:
@@ -99,6 +103,10 @@ def run_analysis(root: Optional[str] = None,
     if "fault-sites" in selected:
         findings, extras = faults_check.check(index)
         report.extend("fault-sites", findings)
+        report.extras.update(extras)
+    if "trace-context" in selected:
+        findings, extras = trace_check.check(index)
+        report.extend("trace-context", findings)
         report.extras.update(extras)
     if "baseline-lint" in selected:
         findings, extras = baseline.check(index)

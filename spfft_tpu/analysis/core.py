@@ -31,8 +31,13 @@ exact line numbers):
     On a span-open line: closure happens cross-function in
     ``<Qualname>`` (``Class.method`` or a function name), which must
     exist and contain a close call.
+``# trace: boundary(<param>)``
+    On a ``def`` line: the function is a cluster RPC boundary whose
+    ``<param>`` carries the propagated trace context (see
+    :mod:`.trace_check` for the three rules this enables).
 ``# span: waived(<reason>)`` / ``# counters: waived(...)`` /
-``# errors: waived(...)`` / ``# knobs: waived(...)``
+``# errors: waived(...)`` / ``# knobs: waived(...)`` /
+``# trace: waived(...)``
     Per-checker escape hatches, all listed in the report.
 """
 
@@ -49,7 +54,7 @@ from typing import Dict, Iterable, List, Optional, Set, Tuple
 #: Annotation comment patterns.
 GUARD_RE = re.compile(r"#:\s*guarded by\s+([A-Za-z_][A-Za-z0-9_]*)")
 WAIVE_RE = re.compile(
-    r"#\s*(lock|span|counters|errors|knobs|lint|faults)\s*:\s*"
+    r"#\s*(lock|span|counters|errors|knobs|lint|faults|trace)\s*:\s*"
     r"waived\(([^)]*)\)")
 HOLDS_RE = re.compile(
     r"#\s*lock\s*:\s*holds\(([A-Za-z_][A-Za-z0-9_]*)\)")

@@ -28,6 +28,16 @@ Signals → rules → knobs (the docs/control_plane.md table, in code):
   cost rivaling dispatch cost means the host is on the critical path →
   one more in-flight slot to overlap it. Staging negligible → decay to
   the backend-aware auto depth (0).
+* **overlap_chunks** ← exchange-vs-compute span ratio. The
+  distributed dispatch path records cumulative exchange and
+  exchange-compute seconds (``ServeMetrics.record_exchange_overlap``,
+  fed from the overlap pipeline's recorded spans); exchange time
+  rivaling compute time on ``overlap_streak_steps`` CONSECUTIVE steps
+  means the pipeline has compute left to hide the wire behind → DOUBLE
+  K (within the declared 1..64 clamp). Exchange well hidden (ratio
+  below ``overlap_lo``) → halve back toward the K=1 default, which is
+  the bit-identical monolithic path. The streak is the hysteresis —
+  one chunky step moves nothing.
 * **max_queue** ← ``rejected_queue_full`` burn. Rejects on
   ``reject_streak_steps`` CONSECUTIVE steps mean the queue bound is
   turning a transient burst into dropped traffic → DOUBLE the bound
@@ -81,7 +91,7 @@ class Decision:
 #: Knobs the feedback rules manage (everything else in ServeConfig is
 #: hot-swappable but only moved by operators/the tuner).
 MANAGED_KNOBS = ("batch_window", "pin_after", "max_batch",
-                 "pipeline_depth", "max_queue")
+                 "pipeline_depth", "max_queue", "overlap_chunks")
 
 
 class Controller:
@@ -101,7 +111,9 @@ class Controller:
                  shrink_ratio: float = 2.0, grow_ratio: float = 0.5,
                  pad_hi: float = 0.25, pad_lo: float = 0.02,
                  exec_floor_s: float = 1e-4,
-                 reject_streak_steps: int = 2):
+                 reject_streak_steps: int = 2,
+                 overlap_hi: float = 1.0, overlap_lo: float = 0.25,
+                 overlap_streak_steps: int = 2):
         self.config = config
         self.metrics = metrics
         self.executor = executor
@@ -113,6 +125,10 @@ class Controller:
         self.pad_lo = float(pad_lo)
         self.exec_floor_s = float(exec_floor_s)
         self.reject_streak_steps = max(1, int(reject_streak_steps))
+        self.overlap_hi = float(overlap_hi)
+        self.overlap_lo = float(overlap_lo)
+        self.overlap_streak_steps = max(1, int(overlap_streak_steps))
+        self._overlap_streak = 0
         self._reject_streak = 0
         self._step = 0
         self._prev: Optional[Dict] = None
@@ -172,6 +188,7 @@ class Controller:
             pass  # calibration step: record the baseline, act next
         elif idle:
             self._reject_streak = 0
+            self._overlap_streak = 0
             self._decay_toward_defaults(out)
         else:
             self._rule_batch_window(out, signals)
@@ -179,6 +196,7 @@ class Controller:
             self._rule_max_batch(out, signals)
             self._rule_pipeline_depth(out, signals)
             self._rule_max_queue(out, signals)
+            self._rule_overlap_chunks(out, signals)
         self._prev = dict(signals)
         from .. import obs
         obs.GLOBAL_COUNTERS.inc(
@@ -205,8 +223,8 @@ class Controller:
                         else cur * 2
                 else:
                     nxt = max(default, cur / 2)
-            elif knob == "max_queue":
-                # the grow rule doubles, so the decay halves — one
+            elif knob in ("max_queue", "overlap_chunks"):
+                # these grow rules double, so the decay halves — one
                 # idle step per growth step back toward the default
                 nxt = max(default, cur // 2) if cur > default \
                     else min(default, cur * 2)
@@ -289,6 +307,42 @@ class Controller:
             f"reject steps)")
         if new:
             self._reject_streak = 0
+
+    def _rule_overlap_chunks(self, out, s) -> None:
+        """Retune the exchange-overlap chunk count K from recorded
+        exchange-vs-compute span seconds (round-18 satellite of the pod
+        frontend): exchange time above ``overlap_hi`` x compute time on
+        ``overlap_streak_steps`` consecutive distributed steps doubles
+        K within the declared clamp — more chunks, more compute to hide
+        the wire behind; exchange below ``overlap_lo`` x compute halves
+        K back toward the K=1 default (the bit-identical monolithic
+        path, which round 9 measured as strictly cheaper when there is
+        nothing to hide). Steps with no distributed work reset the
+        streak and move nothing."""
+        ex_d = self._delta(s, "exchange_s")
+        cp_d = self._delta(s, "exchange_compute_s")
+        if ex_d <= 0 and cp_d <= 0:
+            self._overlap_streak = 0
+            return
+        k = self.config.get("overlap_chunks")
+        default = ServeConfig.default("overlap_chunks")
+        ratio = ex_d / max(cp_d, self.exec_floor_s)
+        if ratio > self.overlap_hi:
+            self._overlap_streak += 1
+            if self._overlap_streak >= self.overlap_streak_steps \
+                    and self._retune(
+                        out, "overlap_chunks", k * 2,
+                        f"exchange rivals compute: {ex_d * 1e3:.1f} ms "
+                        f"exchange vs {cp_d * 1e3:.1f} ms compute over "
+                        f"{self._overlap_streak} consecutive steps"):
+                self._overlap_streak = 0
+        else:
+            self._overlap_streak = 0
+            if ratio < self.overlap_lo and k > default:
+                self._retune(out, "overlap_chunks",
+                             max(default, k // 2),
+                             f"exchange hidden ({ratio:.2f} x compute):"
+                             f" decay toward default")
 
     def _rule_pipeline_depth(self, out, s) -> None:
         if self.executor is None:

@@ -26,14 +26,27 @@ quarantine"), any observation at all burns infinitely. A violation is
 within it). Evaluation is pure arithmetic over one consistent metrics
 snapshot: deterministic given the snapshot, cheap enough to run every
 controller step.
+
+Multi-window alerting (round 18, the SRE-workbook shape): a single
+evaluation's violation degrades ``health()`` immediately (cheap,
+reversible), but PAGING on it would wake an operator for every blip.
+The watchdog therefore also keeps two rolling burn windows per
+objective — ``fast_window`` and ``slow_window`` evaluations
+(evaluation counts, not seconds: determinism again) — and raises the
+page condition only while BOTH window means exceed the budget: the
+fast window proves the burn is current, the slow window proves it is
+sustained. Exported as ``spfft_slo_window_burn_rate{slo,window}``,
+``spfft_slo_window_alert`` and the rising-edge counter
+``spfft_slo_window_alerts_total``.
 """
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import json
 import math
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 from ..errors import InvalidParameterError
 
@@ -122,13 +135,32 @@ class SLOWatchdog:
     into the Prometheus registry and the metrics sink's health state.
     """
 
-    def __init__(self, metrics, spec: SLOSpec, budget: float = 1.0):
+    def __init__(self, metrics, spec: SLOSpec, budget: float = 1.0,
+                 fast_window: int = 6, slow_window: int = 30):
         if budget <= 0:
             raise InvalidParameterError("SLO budget must be > 0")
+        if fast_window < 1 or slow_window < fast_window:
+            raise InvalidParameterError(
+                "want 1 <= fast_window <= slow_window, got "
+                f"{fast_window}/{slow_window}")
         self.metrics = metrics
         self.spec = spec
         self.budget = float(budget)
+        self.fast_window = int(fast_window)
+        self.slow_window = int(slow_window)
         self.evaluations = 0
+        #: per-objective rolling burn history (slow_window deep) and
+        #: the set of objectives currently in the page condition (for
+        #: rising-edge counting) — evaluate() is the only writer
+        self._burn_hist: Dict[str, collections.deque] = {}
+        self._alerting: set = set()
+
+    def _window_burns(self, name: str) -> Dict[str, float]:
+        hist = self._burn_hist[name]
+        fast = list(hist)[-self.fast_window:]
+        slow = list(hist)
+        return {"fast": sum(fast) / len(fast),
+                "slow": sum(slow) / len(slow)}
 
     def _observed(self, signals: Dict) -> Dict[str, float]:
         completed = signals.get("completed", 0)
@@ -158,6 +190,21 @@ class SLOWatchdog:
             if b > self.budget:
                 violations.append(name)
         self.evaluations += 1
+        window_burn: Dict[str, Dict[str, float]] = {}
+        window_alerts: List[str] = []
+        for name in objectives:
+            hist = self._burn_hist.setdefault(
+                name, collections.deque(maxlen=self.slow_window))
+            hist.append(burn[name])
+            window_burn[name] = self._window_burns(name)
+            # Page only on evidence a full fast window deep: both
+            # windows burning above budget. Shorter history is at most
+            # a health degradation (the single-eval violation above),
+            # never a page.
+            if (len(hist) >= self.fast_window
+                    and window_burn[name]["fast"] > self.budget
+                    and window_burn[name]["slow"] > self.budget):
+                window_alerts.append(name)
         from .. import obs
         obs.GLOBAL_COUNTERS.inc("spfft_slo_evaluations_total", 1,
                                 help="SLO watchdog evaluations.")
@@ -180,6 +227,28 @@ class SLOWatchdog:
                 1 if name in violations else 0,
                 help="1 while this SLO's burn rate exceeds its budget.",
                 **labels)
+            for window in ("fast", "slow"):
+                wb = window_burn[name][window]
+                obs.GLOBAL_COUNTERS.set(
+                    "spfft_slo_window_burn_rate",
+                    wb if math.isfinite(wb) else -1.0,
+                    help="Mean burn rate over each alerting window "
+                         "(labels: slo, window=fast|slow; -1 = "
+                         "infinite).",
+                    slo=name, window=window)
+            obs.GLOBAL_COUNTERS.set(
+                "spfft_slo_window_alert",
+                1 if name in window_alerts else 0,
+                help="1 while BOTH burn windows of this SLO exceed "
+                     "the budget (multi-window page condition).",
+                **labels)
+        for name in window_alerts:
+            if name not in self._alerting:
+                obs.GLOBAL_COUNTERS.inc(
+                    "spfft_slo_window_alerts_total", 1,
+                    help="Multi-window page conditions entered.",
+                    slo=name)
+        self._alerting = set(window_alerts)
         if violations:
             obs.GLOBAL_COUNTERS.inc(
                 "spfft_slo_violations_total", len(violations),
@@ -193,4 +262,5 @@ class SLOWatchdog:
             self.metrics.record_slo(violations)
         return {"violations": violations, "burn": burn,
                 "observed": observed, "objectives": objectives,
-                "budget": self.budget}
+                "budget": self.budget, "window_burn": window_burn,
+                "window_alerts": window_alerts}

@@ -175,17 +175,54 @@ class NoHealthyDeviceError(ServeError):
 
 
 class DistributedPlanUnsupportedError(ServeError):
-    """A ``DistributedTransformPlan`` was submitted to the serving
-    executor. The executor's device pool, batching shards and staging
-    buffers are built around LOCAL plans (one device per request); a
-    distributed plan spans its own mesh and pins its own placement, so
-    routing it through the pool is undefined — it is rejected at submit
-    time instead of failing deep inside dispatch. Multi-host serve
-    (ROADMAP) is the path that will carry distributed-plan requests.
-    Reports through the distributed-support branch (reference
-    SPFFT_MPI_SUPPORT_ERROR, exceptions.hpp:110-121)."""
+    """A ``DistributedTransformPlan`` was submitted to a bare
+    single-host ``ServeExecutor``. The executor's device pool, batching
+    shards and staging buffers are built around LOCAL plans (one device
+    per request); a distributed plan spans its own mesh and pins its
+    own placement, so routing it through the pool is undefined — it is
+    rejected at submit time instead of failing deep inside dispatch.
+    ``serve.cluster.PodFrontend`` is the submit surface that DOES carry
+    distributed-plan requests (it routes them to the pod-wide SPMD lane
+    instead of a host's device pool). Reports through the
+    distributed-support branch (reference SPFFT_MPI_SUPPORT_ERROR,
+    exceptions.hpp:110-121)."""
 
     code = ErrorCode.DISTRIBUTED_SUPPORT
+
+
+class ClusterError(ServeError):
+    """Base class of pod-frontend failures (``spfft_tpu.serve.cluster``):
+    routing, host-lane RPC and reconciliation problems report through
+    this branch so pod callers can catch one type. Reports through the
+    distributed branch (reference SPFFT_MPI_ERROR, exceptions.hpp:
+    124-131) — a pod is this framework's communicator."""
+
+    code = ErrorCode.DISTRIBUTED
+
+
+class HostLaneError(ClusterError):
+    """A host lane's RPC failed or the lane is marked dead. Transient
+    and host-attributed: the frontend's routing policy treats the lane
+    like the executor's quarantine ladder treats a device — route
+    around it and degrade pod health, never hang the caller. ``host``
+    carries the lane's descriptor name."""
+
+    transient = True
+
+    def __init__(self, message: str, host: str = None):
+        super().__init__(message)
+        self.host = host
+
+
+class ClusterReconciliationError(ClusterError):
+    """Pod reconciliation found hosts disagreeing — a plan-signature
+    digest mismatch across lanes, or a lane that failed the
+    ``parallel.multihost`` digest-validation collective. The pod
+    refuses to route onto a split-brain plan set; mirrors the
+    reference's cross-rank parameter checks (grid_internal.cpp:148-167)
+    at the serving tier."""
+
+    code = ErrorCode.PARAMETER_MISMATCH
 
 
 class ExecutorCrashedError(ServeError):

@@ -35,6 +35,12 @@ site                  where it fires
 ``exchange.collective`` the all-to-all / collective itself (trace time)
 ``exchange.unpack``   distributed post-exchange unpack (trace time)
 ``exchange.chunk``    each chunk of an overlapped exchange (trace time)
+``cluster.route``     the pod frontend's host-pick for a single-device
+                      request (before the lane RPC)
+``cluster.rpc``       each host-lane RPC through the pod transport
+                      (submit / signals / metrics / health)
+``cluster.reconcile`` the per-host digest-validation collective during
+                      pod reconciliation
 ===================== ====================================================
 
 A firing check raises :class:`InjectedFault` (or an
@@ -112,6 +118,8 @@ SITES = (
     # distributed exchange
     "exchange.pack", "exchange.collective", "exchange.unpack",
     "exchange.chunk",
+    # pod cluster (round 18)
+    "cluster.route", "cluster.rpc", "cluster.reconcile",
 )
 
 #: Substrings of runtime error text treated as transient — the

@@ -46,11 +46,12 @@ from .counters import GLOBAL_COUNTERS, Counters
 from .exporters import (export_trace, parse_prometheus_text,
                         prometheus_text, trace_events)
 from .http import METRICS_PORT_ENV, MetricsServer, port_from_env
-from .trace import (GLOBAL_TRACER, RequestTrace, Span, Tracer, active,
-                    disable, enable)
+from .trace import (GLOBAL_TRACER, RequestTrace, Span, TraceContext,
+                    Tracer, active, disable, enable, span_context)
 
 __all__ = [
     "Tracer", "Span", "RequestTrace", "GLOBAL_TRACER",
+    "TraceContext", "span_context",
     "Counters", "GLOBAL_COUNTERS",
     "active", "enable", "disable",
     "export_trace", "trace_events", "prometheus_text",
@@ -64,17 +65,20 @@ __all__ = [
 
 def record_store(event: str, reason: Optional[str] = None) -> None:
     """One plan-artifact-store outcome (``hit`` / ``miss`` / ``spill``
-    / ``evict`` / ``reject``; rejects carry their typed reason label).
-    Counters always (``spfft_store_{hits,misses,spills,evictions,
-    rejects}_total``); a ``store`` instant on the compile track when
-    tracing is on — next to the ``compile.store_load`` /
+    / ``evict`` / ``reject`` / ``manifest_refresh``; rejects carry
+    their typed reason label). Counters always
+    (``spfft_store_{hits,misses,spills,evictions,rejects,
+    manifest_refreshes}_total``); a ``store`` instant on the compile
+    track when tracing is on — next to the ``compile.store_load`` /
     ``compile.store_spill`` spans the store records, so Perfetto shows
     load-vs-build decisions inline with the compile timeline."""
     name = {"hit": "spfft_store_hits_total",
             "miss": "spfft_store_misses_total",
             "spill": "spfft_store_spills_total",
             "evict": "spfft_store_evictions_total",
-            "reject": "spfft_store_rejects_total"}[event]
+            "reject": "spfft_store_rejects_total",
+            "manifest_refresh":
+                "spfft_store_manifest_refreshes_total"}[event]
     labels = {"reason": reason} if event == "reject" else {}
     GLOBAL_COUNTERS.inc(name, 1,
                         help="Plan-artifact store outcomes.", **labels)

@@ -79,6 +79,9 @@ METRIC_SPECS: Dict[str, Tuple[str, str]] = {
     "spfft_store_rejects_total":
         ("counter", "Plan-artifact store outcomes: typed artifact "
                     "rejections by reason."),
+    "spfft_store_manifest_refreshes_total":
+        ("counter", "Plan-artifact store outcomes: live boot-prewarm "
+                    "manifest merges on spill."),
     "spfft_store_aot_skipped_total":
         ("counter", "AOT executables skipped (non-fatal) by reason."),
     # control plane
@@ -106,6 +109,37 @@ METRIC_SPECS: Dict[str, Tuple[str, str]] = {
         ("gauge", "1 while this SLO's burn rate exceeds its budget."),
     "spfft_slo_violations_total":
         ("counter", "SLO violations observed across evaluations."),
+    "spfft_slo_window_burn_rate":
+        ("gauge", "Mean burn rate over each alerting window "
+                  "(labels: slo, window=fast|slow; -1 = infinite)."),
+    "spfft_slo_window_alert":
+        ("gauge", "1 while BOTH burn windows of this SLO exceed the "
+                  "budget (multi-window page condition)."),
+    "spfft_slo_window_alerts_total":
+        ("counter", "Multi-window page conditions entered."),
+    # pod frontend (serve.cluster)
+    "spfft_cluster_hosts":
+        ("gauge", "Pod frontend host lanes, labelled by lane state."),
+    "spfft_cluster_health":
+        ("gauge", "Pod aggregate health state (one-hot; worst lane "
+                  "health wins)."),
+    "spfft_cluster_routed_total":
+        ("counter", "Requests routed by the pod frontend, labelled "
+                    "{host, kind=single|distributed}."),
+    "spfft_cluster_rpcs_total":
+        ("counter", "Host-lane RPCs issued by the pod frontend, "
+                    "labelled {host, op}."),
+    "spfft_cluster_rpc_failures_total":
+        ("counter", "Host-lane RPCs that failed, labelled {host, op}."),
+    "spfft_cluster_reconciliations_total":
+        ("counter", "Pod plan reconciliations, labelled by outcome "
+                    "(ok|mismatch|failed)."),
+    "spfft_cluster_spmd_requests_total":
+        ("counter", "Distributed-plan requests executed on the "
+                    "pod-wide SPMD lane."),
+    "spfft_cluster_lane_deaths_total":
+        ("counter", "Host lanes marked dead by the pod frontend, "
+                    "labelled by host."),
     # serving families (rendered by exporters._serve_families from a
     # ServeMetrics snapshot)
     "spfft_serve_completed_total":

@@ -70,7 +70,8 @@ class MetricsServer:
     """
 
     def __init__(self, metrics=None, registry=None, executor=None,
-                 port: int = 0, host: str = "127.0.0.1"):
+                 port: int = 0, host: str = "127.0.0.1",
+                 text_fn=None, health_fn=None):
         if executor is not None:
             metrics = metrics if metrics is not None else executor.metrics
             registry = registry if registry is not None \
@@ -78,6 +79,12 @@ class MetricsServer:
         self.metrics = metrics
         self.registry = registry
         self.executor = executor
+        # Aggregation hooks: a pod frontend overrides what /metrics
+        # renders (its merged multi-host exposition) and what /healthz
+        # reports (worst-lane-health-wins) without subclassing the
+        # handler; None keeps the single-process defaults.
+        self.text_fn = text_fn
+        self.health_fn = health_fn
         self.host = host
         self.port = int(port)
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -103,12 +110,17 @@ class MetricsServer:
                 path = self.path.split("?", 1)[0]
                 try:
                     if path == "/metrics":
-                        self._send(200, prometheus_text(
-                            metrics=server.metrics,
-                            registry=server.registry),
-                            PROM_CONTENT_TYPE)
+                        if server.text_fn is not None:
+                            body = server.text_fn()
+                        else:
+                            body = prometheus_text(
+                                metrics=server.metrics,
+                                registry=server.registry)
+                        self._send(200, body, PROM_CONTENT_TYPE)
                     elif path == "/healthz":
-                        if server.executor is not None:
+                        if server.health_fn is not None:
+                            snap = server.health_fn()
+                        elif server.executor is not None:
                             snap = server.executor.health()
                         elif server.metrics is not None:
                             snap = server.metrics.health()

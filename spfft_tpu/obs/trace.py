@@ -40,7 +40,7 @@ import os
 import threading
 import time
 from collections import deque
-from typing import Dict, List, Optional
+from typing import Dict, List, NamedTuple, Optional
 
 #: Environment knobs (read at import; enable()/set_sample_rate() override).
 TRACE_ENV = "SPFFT_TPU_TRACE"
@@ -96,6 +96,37 @@ class Span:
     @property
     def duration(self) -> float:
         return (self.t1 - self.t0) if self.t1 is not None else 0.0
+
+
+class TraceContext(NamedTuple):
+    """The wire-serializable slice of a span a cross-host RPC carries:
+    the trace id (stable end-to-end) and the span id of the remote
+    parent. Exposes ``span_id`` so it can stand in for a ``parent=``
+    argument on the receiving host — :meth:`Tracer.begin` only reads
+    ``parent.span_id``, never the rest of the Span. Build one with
+    :meth:`Span.context`, restore with ``RequestTrace(..., ctx=...)``."""
+
+    trace_id: int
+    span_id: int
+
+    def to_wire(self) -> dict:
+        """Plain-dict form for an RPC payload (loopback or real)."""
+        return {"trace_id": self.trace_id, "span_id": self.span_id}
+
+    @classmethod
+    def from_wire(cls, payload: Optional[dict]) -> Optional["TraceContext"]:
+        if not payload:
+            return None
+        return cls(int(payload["trace_id"]), int(payload["span_id"]))
+
+
+def span_context(span: Optional[Span]) -> Optional[TraceContext]:
+    """The propagatable context of ``span`` (None-safe; None when the
+    span carries no trace id — an unsampled request propagates
+    nothing)."""
+    if span is None or span.trace_id is None:
+        return None
+    return TraceContext(span.trace_id, span.span_id)
 
 
 class Tracer:
@@ -298,14 +329,24 @@ class RequestTrace:
     __slots__ = ("tracer", "trace_id", "lane", "root", "open")
 
     def __init__(self, tracer: Tracer, lane: str,
-                 args: Optional[dict] = None):
+                 args: Optional[dict] = None,
+                 ctx: Optional[TraceContext] = None):
         self.tracer = tracer
-        self.trace_id = tracer.new_trace_id()
+        # A propagated context (cross-host RPC) pins the trace id and
+        # parents this request's root under the remote frontend span —
+        # one trace id end-to-end, frontend parent / host-lane child.
+        self.trace_id = ctx.trace_id if ctx is not None \
+            else tracer.new_trace_id()
         self.lane = f"lane:{lane}"
         # span: closed-by(RequestTrace.close)
         self.root = tracer.begin("serve.request", trace_id=self.trace_id,
-                                 track=self.lane, args=args)
+                                 parent=ctx, track=self.lane, args=args)
         self.open: Dict[str, Span] = {}
+
+    def context(self) -> Optional[TraceContext]:
+        """Propagatable context of this request's root span (None once
+        closed)."""
+        return span_context(self.root)
 
     def begin(self, name: str, track: Optional[str] = None,
               args: Optional[dict] = None) -> Span:

@@ -59,6 +59,16 @@ trace and reports p50/p95/p99 latency (per priority class with
 ``--fault-rate``/``--fault-script`` inject faults into a measured
 replay.
 
+Pod scale (round 18) lives in :mod:`~spfft_tpu.serve.cluster`:
+``PodFrontend`` owns one ``ServeExecutor`` lane per host, reconciles
+plan digests across hosts at construction, routes single-device
+requests by power-of-two-choices over live load signals, hands
+``DistributedTransformPlan`` requests to a pod-wide SPMD lane, and
+federates telemetry (one trace id across the host boundary, one merged
+``/metrics`` + worst-health-wins ``/healthz``). See docs/cluster.md;
+``python -m spfft_tpu.serve.cluster --smoke`` is the tier-1 2-host
+loopback check behind ``make cluster-smoke``.
+
 Every tunable of this layer lives in the typed, hot-swappable
 :class:`spfft_tpu.control.ServeConfig` (round 11) — a feedback
 controller can retune a live executor from its own telemetry, an
@@ -67,10 +77,11 @@ degrades ``health()`` when declared objectives burn. See
 docs/control_plane.md.
 """
 
-from ..errors import (DeadlineExpiredError, DistributedPlanUnsupportedError,
-                      ExecutorCrashedError, NoHealthyDeviceError,
-                      PlanArtifactError, QueueFullError,
-                      RetryExhaustedError, ServeError)
+from ..errors import (ClusterError, ClusterReconciliationError,
+                      DeadlineExpiredError, DistributedPlanUnsupportedError,
+                      ExecutorCrashedError, HostLaneError,
+                      NoHealthyDeviceError, PlanArtifactError,
+                      QueueFullError, RetryExhaustedError, ServeError)
 from .executor import PLAN_MANIFEST_ENV, ServeExecutor
 from .faults import (FaultPlan, InjectedFault, attributes_device,
                      is_transient)
@@ -88,6 +99,12 @@ def __getattr__(name):
     if name in ("PlanArtifactStore", "PLAN_STORE_ENV"):
         from . import store
         return getattr(store, name)
+    if name in ("PodFrontend", "HostLane", "LoopbackTransport",
+                "load_score", "simulate_routing"):
+        # Same rationale: `python -m spfft_tpu.serve.cluster --smoke`
+        # runs cluster.py as __main__.
+        from . import cluster
+        return getattr(cluster, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
@@ -100,4 +117,7 @@ __all__ = [
     "RetryExhaustedError", "NoHealthyDeviceError",
     "ExecutorCrashedError", "DistributedPlanUnsupportedError",
     "PlanArtifactError",
+    "PodFrontend", "HostLane", "LoopbackTransport", "load_score",
+    "simulate_routing",
+    "ClusterError", "HostLaneError", "ClusterReconciliationError",
 ]

@@ -724,7 +724,7 @@ def _run_fault_smoke(args) -> int:
 
 def _run_chaos(args) -> int:
     """Seeded chaos harness (``--chaos SEED`` / ``make chaos-smoke``):
-    the package-wide fault seam exercised end to end. Three
+    the package-wide fault seam exercised end to end. Four
     deterministic acceptance phases prove each degradation ladder —
 
     A. a fused-kernel launch fault at execution time stickily demotes
@@ -737,7 +737,10 @@ def _run_chaos(args) -> int:
        half-written artifact behind;
     C. a wedged bucket execute trips the ``execute_timeout_ms``
        watchdog into a typed transient failure and every request is
-       recovered through the serial fallback —
+       recovered through the serial fallback;
+    D. killing one host lane of a 2-host pod mid-trace degrades the
+       pod, the killed lane's queue resolves typed (never hangs), and
+       every post-kill request lands bit-exact on the survivor —
 
     then 16 fault STORMS, every choice drawn from ONE seeded RNG: each
     storm arms a scripted multi-site :class:`~spfft_tpu.faults`
@@ -926,6 +929,52 @@ def _run_chaos(args) -> int:
                                     "recovered_in_s": round(elapsed, 2)}
     spans_closed("phaseC")
 
+    # -- phase D: pod lane death mid-trace -> degraded, survivors on --
+    from .cluster import PodFrontend
+    lanes = []
+    for host in ("h0", "h1"):
+        reg = PlanRegistry(store=False)
+        reg.put(osig, oplan)
+        lanes.append((host, ServeExecutor(reg)))
+    pod = PodFrontend(lanes, seed=seed)
+    try:
+        good = [vals() for _ in range(8)]
+        oracles = [np.asarray(oplan.backward(w)) for w in good]
+        futs = [pod.submit_backward(osig, w) for w in good[:4]]
+        pod.kill_host("h1")  # half the trace already in flight
+        futs += [pod.submit_backward(osig, w) for w in good[4:]]
+        served = failed = 0
+        for i, (f, expect) in enumerate(zip(futs, oracles)):
+            try:
+                got = f.result(timeout=60)
+            except cf.TimeoutError:
+                check(False, f"phaseD: pod request {i} HUNG across "
+                             f"the lane death")
+            except typed:
+                failed += 1  # killed lane's queue resolves typed
+            except Exception as exc:
+                check(False, f"phaseD: pod request {i} failed UNTYPED "
+                             f"{type(exc).__name__}: {exc}")
+            else:
+                served += 1
+                check(np.array_equal(np.asarray(got), expect),
+                      f"phaseD: pod request {i} diverged from the "
+                      f"serial oracle after the lane death")
+        check(served >= 4,
+              f"phaseD: survivor host served only {served}/8 — the "
+              f"post-kill wave must all land on the live lane")
+        h = pod.health()
+        check(h["state"] == "degraded" and h["alive"] == 1,
+              f"phaseD: pod health wrong after lane death: {h}")
+        phases["D_pod_lane_death"] = {"served": served,
+                                      "typed_failures": failed,
+                                      "health": h["state"]}
+    finally:
+        pod.close()
+        for _, ex_l in lanes:
+            ex_l.close()
+    spans_closed("phaseD")
+
     # -- seeded storms -------------------------------------------------
     #: site menu: (site, subsystem, flow order, script kinds). Extras
     #: are only drawn from LATER flow stages than the primary, so the
@@ -1038,7 +1087,7 @@ def _run_chaos(args) -> int:
     for msg in failures:
         print(f"FAIL: {msg}", file=sys.stderr)
     result = {
-        "metric": f"serve.bench --chaos (3 ladders + {storms} seeded "
+        "metric": f"serve.bench --chaos (4 ladders + {storms} seeded "
                   f"storms over {len(fired_sites)} fault sites)",
         "value": 1 if ok else 0,
         "unit": "ok",

@@ -149,8 +149,11 @@ from .registry import PlanRegistry, PlanSignature
 #: ``prewarm_manifest`` argument is given), a constructing executor
 #: warm-loads every listed plan artifact — and compiles it — BEFORE its
 #: dispatcher thread starts, so a replacement process joins the pool
-#: fully warm (docs/artifact_cache.md "Prewarm workflow").
-PLAN_MANIFEST_ENV = "SPFFT_TPU_PLAN_MANIFEST"
+#: fully warm (docs/artifact_cache.md "Prewarm workflow"). The store
+#: keeps the same manifest LIVE: every spill merges its entry in
+#: (``PlanArtifactStore.append_manifest_entry``); the canonical
+#: spelling lives there.
+from .store import PLAN_MANIFEST_ENV  # noqa: E402  (re-export)
 
 # Knob defaults live in ONE place since round 11: the control plane's
 # KNOB_SPECS (spfft_tpu/control/config.py), which also declares each
@@ -621,8 +624,15 @@ class ServeExecutor:
                kind: str = "backward",
                scaling: Scaling = Scaling.NONE,
                timeout: Optional[float] = None,
-               priority: str = "normal") -> Future:
+               priority: str = "normal",
+               trace_ctx=None) -> Future:
         """Queue one transform request; returns its Future.
+
+        ``trace_ctx`` is an optional propagated ``obs.TraceContext``
+        (a pod frontend's submit span): when given and tracing is on,
+        this request is traced unconditionally — sampling already
+        happened on the frontend — with the remote span as the root's
+        parent, so one trace id spans the host boundary.
 
         ``kind`` is ``"backward"`` (values -> space) or ``"forward"``
         (space -> values, with ``scaling``). ``timeout`` (seconds) sets
@@ -653,13 +663,15 @@ class ServeExecutor:
             # machinery is built around LOCAL plans (one device per
             # request); a distributed plan spans its own mesh and pins
             # its own placement, so routing it through the device pool
-            # was an undefined path that failed deep inside dispatch
-            # (ROADMAP "multi-host serve" owns the real support).
+            # was an undefined path that failed deep inside dispatch.
+            # serve.cluster.PodFrontend is the submit surface that DOES
+            # carry distributed plans (its pod-wide SPMD lane).
             raise DistributedPlanUnsupportedError(
                 f"ServeExecutor serves local TransformPlans only; "
                 f"signature {signature} resolves to a "
-                f"{type(plan).__name__}. Run distributed plans directly "
-                f"(plan.backward/forward) until multi-host serve lands.")
+                f"{type(plan).__name__}. Submit distributed plans "
+                f"through serve.cluster.PodFrontend (SPMD lane) or run "
+                f"them directly (plan.backward/forward).")
         deadline = (time.monotonic() + timeout
                     if timeout is not None else None)
         key = (signature, kind, scaling)
@@ -670,9 +682,10 @@ class ServeExecutor:
         # MUST begin before the request becomes visible to the
         # dispatcher (which finishes it when the request is popped)
         rt = None
-        if _obs.active() and _obs.GLOBAL_TRACER.sample():
+        if _obs.active() and (trace_ctx is not None
+                              or _obs.GLOBAL_TRACER.sample()):
             rt = _obs.RequestTrace(
-                _obs.GLOBAL_TRACER, priority,
+                _obs.GLOBAL_TRACER, priority, ctx=trace_ctx,
                 args={"kind": kind, "scaling": scaling.value})
             rt.begin("serve.submit")
             req.trace = rt

@@ -110,6 +110,10 @@ class ServeMetrics:
                 maxlen=DEFAULT_SIGNAL_WINDOW)
             self._stage_s = 0.0             #: guarded by _lock
             self._dispatch_s = 0.0          #: guarded by _lock
+            # distributed-exchange overlap accounting (cumulative
+            # seconds; the overlap_chunks controller rule diffs them)
+            self._exchange_s = 0.0          #: guarded by _lock
+            self._exchange_compute_s = 0.0  #: guarded by _lock
             self._completed = 0             #: guarded by _lock
             self._failed = 0                #: guarded by _lock
             self._rejected_queue_full = 0   #: guarded by _lock
@@ -280,6 +284,15 @@ class ServeMetrics:
             self._stage_s += stage_s
             self._dispatch_s += dispatch_s
 
+    def record_exchange_overlap(self, exchange_s: float,
+                                compute_s: float) -> None:
+        """Cumulative exchange-vs-compute seconds for one distributed
+        dispatch (from the overlap pipeline's recorded spans) — the
+        signal pair the ``overlap_chunks`` controller rule diffs."""
+        with self._lock:
+            self._exchange_s += exchange_s
+            self._exchange_compute_s += compute_s
+
     def record_request_done(self, latency_s: float, failed: bool = False,
                             priority: str = "normal") -> None:
         with self._lock:
@@ -400,6 +413,8 @@ class ServeMetrics:
                 "fused_hist": dict(self._fused_hist),
                 "stage_s": self._stage_s,
                 "dispatch_s": self._dispatch_s,
+                "exchange_s": self._exchange_s,
+                "exchange_compute_s": self._exchange_compute_s,
                 "quarantines": self._quarantines,
             }
         out["queue_wait_p50"] = percentile(qw, 50.0)

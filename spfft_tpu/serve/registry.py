@@ -282,6 +282,14 @@ class PlanRegistry:
         self.put(sig, plan)
         return plan
 
+    def signatures(self) -> List[PlanSignature]:
+        """Snapshot of the in-memory tier's signatures, LRU order
+        (oldest first), with no counter side effects — the pod
+        frontend's reconciliation input (every host must hold the same
+        set)."""
+        with self._lock:
+            return list(self._store)
+
     def __contains__(self, signature: PlanSignature) -> bool:
         with self._lock:  # no counter side effects
             return signature in self._store

@@ -83,6 +83,23 @@ from .registry import PlanSignature, index_digest
 #: the boot artifact) takes precedence when set.
 PLAN_STORE_ENV = "SPFFT_TPU_PLAN_STORE"
 
+#: Live boot-prewarm manifest: when set, every successful spill merges
+#: its entry into the manifest at this path (read -> dedupe by
+#: artifact key -> atomic replace), so the manifest a replacement
+#: process prewarms from tracks the fleet's working set WITHOUT a
+#: periodic ``python -m spfft_tpu.serve.store manifest`` sweep. The
+#: same spelling is the executor's boot-prewarm source
+#: (``ServeExecutor`` reads it through ``executor.PLAN_MANIFEST_ENV``).
+PLAN_MANIFEST_ENV = "SPFFT_TPU_PLAN_MANIFEST"
+
+#: Serializes live-manifest read/merge/replace cycles: the env var
+#: names ONE file shared by every store object in the process, so the
+#: append path locks process-wide, not per-store. Across processes the
+#: atomic replace keeps the file untorn (a concurrent writer can lose
+#: an update to the read-modify-write race, never corrupt the file —
+#: the losing entry re-merges on that plan's next spill).
+_MANIFEST_LOCK = threading.Lock()
+
 #: ``0`` disables AOT executable export on spill (artifacts then carry
 #: tables only). Deserialize failures are always non-fatal: the plan
 #: loads and falls back to a fresh jit.
@@ -711,9 +728,38 @@ class PlanArtifactStore:
         _obs.record_compile("store_spill", time.perf_counter() - t0, t0,
                             key=key[:12], bytes=len(data),
                             aot=bool(blobs))
+        manifest = os.environ.get(PLAN_MANIFEST_ENV)
+        if manifest:
+            self._refresh_manifest(manifest, key, sig, plan,
+                                   len(data), blobs)
         if self.max_bytes:
             self.gc(keep=key)
         return key
+
+    def _refresh_manifest(self, path: str, key: str,
+                          sig: PlanSignature, plan: TransformPlan,
+                          nbytes: int, blobs: Dict) -> None:
+        """Merge this spill into the live boot-prewarm manifest. Never
+        fails the spill: a broken manifest file is a warning plus an
+        ``io`` reject, and the next sweep (``python -m
+        spfft_tpu.serve.store manifest``) rebuilds it from the store."""
+        entry = {
+            "artifact": key,
+            "signature": dataclasses.asdict(sig),
+            "dims": [sig.dim_x, sig.dim_y, sig.dim_z],
+            "num_values": plan.index_plan.num_values,
+            "precision": sig.precision,
+            "bytes": nbytes,
+            "aot": sorted(blobs or ()),
+        }
+        try:
+            self.append_manifest_entry(path, entry)
+            self._count("manifest_refresh")
+        except (OSError, InvalidParameterError) as exc:
+            self._count("reject", REASON_IO)
+            import logging
+            logging.getLogger("spfft_tpu").warning(
+                "spfft_tpu: live manifest refresh failed (%r)", exc)
 
     def spill_async(self, sig: PlanSignature, plan: TransformPlan,
                     triplets=None) -> threading.Thread:
@@ -975,6 +1021,29 @@ class PlanArtifactStore:
         m = self.manifest()
         self._atomic_write(path, json.dumps(m, indent=2).encode())
         return m
+
+    def append_manifest_entry(self, path: str, entry: Dict) -> Dict:
+        """Merge one entry into the live boot-prewarm manifest at
+        ``path``: read (a missing file starts a fresh manifest),
+        validate, dedupe on the artifact key (last write wins), atomic
+        replace. In-process appenders serialize on the module-wide
+        ``_MANIFEST_LOCK``; torn reads are impossible by the temp-file
+        + ``os.replace`` write contract. An existing-but-invalid file
+        raises ``InvalidParameterError`` rather than being clobbered.
+        Returns the merged payload."""
+        with _MANIFEST_LOCK:
+            if os.path.exists(path):
+                payload = load_manifest(path)
+            else:
+                payload = {MANIFEST_KEY: MANIFEST_VERSION,
+                           "store": self.root, "entries": []}
+            entries = [e for e in payload.get("entries", ())
+                       if e.get("artifact") != entry.get("artifact")]
+            entries.append(dict(entry))
+            payload["entries"] = entries
+            self._atomic_write(
+                path, json.dumps(payload, indent=2).encode())
+        return payload
 
 
 # -- process-default store resolution ----------------------------------------

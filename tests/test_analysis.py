@@ -18,7 +18,7 @@ import pytest
 
 from spfft_tpu.analysis import (baseline, counters_check, errors_check,
                                 faults_check, knobs, locks, run_analysis,
-                                spans)
+                                spans, trace_check)
 from spfft_tpu.analysis.core import index_sources
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -666,7 +666,7 @@ def test_analysis_cli_smoke(tmp_path):
     assert set(payload["checkers"]) == {
         "lock-discipline", "span-closure", "counter-registry",
         "error-taxonomy", "knob-registry", "fault-sites",
-        "baseline-lint"}
+        "trace-context", "baseline-lint"}
     assert payload["waivers"], "the report must list the waivers"
 
 
@@ -842,3 +842,151 @@ SITES = (
     errs = _errors(findings)
     assert any("site grammar" in f.message for f in errs)
     assert any("non-literal entry" in f.message for f in errs)
+
+
+# ---------------------------------------------------------------------------
+# trace-context
+# ---------------------------------------------------------------------------
+
+TRACE_CLEAN = '''
+class Lane:
+    # trace: boundary(ctx)
+    def rpc_submit(self, values, ctx=None):
+        return self.executor.submit(values, trace_ctx=ctx)
+
+
+class Frontend:
+    def route(self, lane, values, ctx):
+        return lane.rpc_submit(values, ctx=ctx)
+'''
+
+TRACE_DROPPED_AT_CALL = '''
+class Lane:
+    # trace: boundary(ctx)
+    def rpc_submit(self, values, ctx=None):
+        return self.executor.submit(values, trace_ctx=ctx)
+
+
+class Frontend:
+    def route(self, lane, values, ctx):
+        return lane.rpc_submit(values)
+'''
+
+TRACE_NEVER_FORWARDED = '''
+class Lane:
+    # trace: boundary(ctx)
+    def rpc_submit(self, values, ctx=None):
+        return self.executor.submit(values)
+
+
+class Frontend:
+    def route(self, lane, values, ctx):
+        return lane.rpc_submit(values, ctx=ctx)
+'''
+
+TRACE_CONTEXTLESS_SPAN = '''
+class Lane:
+    # trace: boundary(ctx)
+    def rpc_submit(self, tracer, values, ctx=None):
+        span = tracer.begin("lane.request")
+        try:
+            return self.executor.submit(values, trace_ctx=ctx)
+        finally:
+            tracer.finish(span)
+
+
+class Frontend:
+    def route(self, lane, tracer, values, ctx):
+        return lane.rpc_submit(tracer, values, ctx=ctx)
+'''
+
+
+def test_trace_context_clean():
+    findings, extras = trace_check.check(
+        index_sources({"cluster.py": TRACE_CLEAN}))
+    assert _errors(findings) == []
+    assert extras["trace_boundaries"] == 1
+    assert extras["boundary_calls_checked"] == 1
+
+
+def test_trace_context_catches_call_dropping_context():
+    findings, _ = trace_check.check(
+        index_sources({"cluster.py": TRACE_DROPPED_AT_CALL}))
+    errs = _errors(findings)
+    assert len(errs) == 1
+    assert "does not bind its context" in errs[0].message
+    assert errs[0].line == 10
+
+
+def test_trace_context_catches_boundary_never_forwarding():
+    findings, _ = trace_check.check(
+        index_sources({"cluster.py": TRACE_NEVER_FORWARDED}))
+    errs = _errors(findings)
+    assert len(errs) == 1
+    assert "never forwards its context" in errs[0].message
+
+
+def test_trace_context_catches_contextless_span_open():
+    findings, _ = trace_check.check(
+        index_sources({"cluster.py": TRACE_CONTEXTLESS_SPAN}))
+    errs = _errors(findings)
+    assert len(errs) == 1
+    assert "without its context" in errs[0].message
+    assert "new trace id" in errs[0].message
+
+
+def test_trace_context_span_open_with_context_is_clean():
+    src = TRACE_CONTEXTLESS_SPAN.replace(
+        'tracer.begin("lane.request")',
+        'tracer.begin("lane.request", parent=ctx)')
+    findings, _ = trace_check.check(
+        index_sources({"cluster.py": src}))
+    assert _errors(findings) == []
+
+
+def test_trace_context_positional_bind_counts():
+    src = TRACE_DROPPED_AT_CALL.replace(
+        "lane.rpc_submit(values)", "lane.rpc_submit(values, ctx)")
+    findings, _ = trace_check.check(
+        index_sources({"cluster.py": src}))
+    assert _errors(findings) == []
+
+
+def test_trace_context_kwargs_forwarding_counts():
+    src = TRACE_DROPPED_AT_CALL.replace(
+        "lane.rpc_submit(values)", "lane.rpc_submit(values, **kw)")
+    findings, _ = trace_check.check(
+        index_sources({"cluster.py": src}))
+    assert _errors(findings) == []
+
+
+def test_trace_context_waiver_is_listed_not_failed():
+    src = TRACE_DROPPED_AT_CALL.replace(
+        "lane.rpc_submit(values)",
+        "lane.rpc_submit(values)  "
+        "# trace: waived(fire-and-forget maintenance ping)")
+    findings, _ = trace_check.check(
+        index_sources({"cluster.py": src}))
+    assert _errors(findings) == []
+    waived = _waived(findings)
+    assert len(waived) == 1
+    assert "maintenance ping" in waived[0].reason
+
+
+def test_trace_context_bad_param_name_is_an_error():
+    src = TRACE_CLEAN.replace("boundary(ctx)", "boundary(missing)")
+    findings, _ = trace_check.check(
+        index_sources({"cluster.py": src}))
+    errs = _errors(findings)
+    assert any("not a parameter" in f.message for f in errs)
+
+
+def test_trace_context_real_package_has_boundaries():
+    """Clean-repo meta-test: the checker runs green over the real tree
+    AND actually has something to check — the pod frontend's submit
+    RPC is annotated and every call site binds the context."""
+    report = run_analysis(root=PACKAGE_ROOT, docs_root=REPO_ROOT,
+                          checkers=["trace-context"])
+    assert report.ok(), report.text()
+    assert report.extras["trace_boundaries"] >= 1
+    assert report.extras["boundary_calls_checked"] >= 1

@@ -1,0 +1,379 @@
+"""Pod-scale multi-host serving (serve/cluster.py): the tier-1 twin of
+``make cluster-smoke``.
+
+The contracts under test (docs/cluster.md): a 2-host emulated pod
+serves mixed single-device + ``DistributedTransformPlan`` traffic
+bit-exact vs direct plan calls; construction reconciles the pod (plan
+sets and distributed-plan fingerprints, typed
+``ClusterReconciliationError`` on any disagreement); routing is
+power-of-two-choices over live load signals (the skewed-load
+simulation gates rr >= 4x vs p2c <= 2x); one trace id survives the
+host boundary with valid parent/child nesting; the federated /metrics
+document re-parses; and under injected ``cluster.*`` faults every
+issued future resolves with zero unclosed spans.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from spfft_tpu import faults, obs
+from spfft_tpu.benchmark import cutoff_stick_triplets
+from spfft_tpu.errors import (ClusterError, ClusterReconciliationError,
+                              DistributedPlanUnsupportedError,
+                              HostLaneError, InvalidParameterError)
+from spfft_tpu.faults import FaultPlan, InjectedFault
+from spfft_tpu.parallel import make_distributed_plan, make_mesh
+from spfft_tpu.serve.cluster import (HostLane, PodFrontend,
+                                     load_score, simulate_routing)
+from spfft_tpu.serve.executor import ServeExecutor
+from spfft_tpu.serve.registry import PlanRegistry, signature_for
+from spfft_tpu.types import TransformType
+from spfft_tpu.utils.workloads import (even_plane_split,
+                                       round_robin_stick_partition)
+
+N = 8
+DIMS = (N, N, N)
+SHARDS = 2
+
+
+@pytest.fixture(scope="module")
+def pod_plans():
+    """One local plan + one 2-shard distributed plan, built once and
+    shared across every pod in the module (lanes ``put`` the same plan
+    objects, which is exactly what reconciliation must accept)."""
+    trip = cutoff_stick_triplets(N, N, N, 0.9, hermitian=False)
+    reg = PlanRegistry()
+    sig, plan = reg.get_or_build(TransformType.C2C, *DIMS, trip,
+                                 precision="double")
+    parts = round_robin_stick_partition(trip, DIMS, SHARDS)
+    planes = even_plane_split(DIMS[2], SHARDS)
+    dplan = make_distributed_plan(TransformType.C2C, *DIMS, parts,
+                                  planes, mesh=make_mesh(SHARDS),
+                                  precision="double")
+    dsig = signature_for(TransformType.C2C, *DIMS, trip,
+                         precision="double", device_count=SHARDS)
+    return {"trip": trip, "sig": sig, "plan": plan,
+            "dsig": dsig, "dplan": dplan, "parts": parts,
+            "planes": planes}
+
+
+def _make_pod(p, hosts=("h0", "h1"), with_dist=True, **kw):
+    lanes = []
+    for host in hosts:
+        reg = PlanRegistry()
+        reg.put(p["sig"], p["plan"])
+        if with_dist:
+            reg.put(p["dsig"], p["dplan"])
+        lanes.append((host, ServeExecutor(reg)))
+    return PodFrontend(lanes, **kw)
+
+
+def _close_all(pod):
+    pod.close()
+    for lane in pod._lanes:  # close() skips dead lanes' executors
+        lane.executor.close()
+
+
+def _values(p, rng):
+    n = len(p["trip"])
+    return rng.standard_normal(n) + 1j * rng.standard_normal(n)
+
+
+def _dvalues(p, rng):
+    return [rng.standard_normal(sp.num_values)
+            + 1j * rng.standard_normal(sp.num_values)
+            for sp in p["dplan"].dist_plan.shard_plans]
+
+
+# -- routing + execution ------------------------------------------------------
+def test_pod_mixed_traffic_bit_exact(pod_plans):
+    """Singles route across hosts, the distributed request runs on the
+    SPMD lane — all bit-exact vs direct plan calls — and the frontend
+    retires DistributedPlanUnsupportedError (it remains the bare
+    single-host executor's answer)."""
+    p = pod_plans
+    rng = np.random.default_rng(0)
+    pod = _make_pod(p)
+    try:
+        singles = [(v, pod.submit_backward(p["sig"], v))
+                   for v in (_values(p, rng) for _ in range(8))]
+        dv = _dvalues(p, rng)
+        dfut = pod.submit(p["dsig"], dv)
+        for v, fut in singles:
+            assert np.array_equal(
+                np.asarray(fut.result(timeout=60)),
+                np.asarray(p["plan"].backward(v)))
+        assert np.array_equal(np.asarray(dfut.result(timeout=60)),
+                              np.asarray(p["dplan"].backward(dv)))
+    finally:
+        _close_all(pod)
+
+    reg = PlanRegistry()
+    reg.put(p["dsig"], p["dplan"])
+    with ServeExecutor(reg) as ex:
+        with pytest.raises(DistributedPlanUnsupportedError):
+            ex.submit(p["dsig"], _dvalues(p, rng))
+
+
+def test_p2c_beats_rr_on_skewed_load():
+    """The Round-18 routing scenario: round-robin aliases every heavy
+    request onto one host (completed-skew >= 4x) while p2c over the
+    live load_score keeps the pod balanced (<= 2x)."""
+    rr = simulate_routing("rr")
+    p2c = simulate_routing("p2c")
+    assert sum(rr["assigned"]) == sum(p2c["assigned"]) == 400
+    assert rr["ratio"] >= 4.0
+    assert p2c["ratio"] <= 2.0
+    assert rr["ratio"] / p2c["ratio"] >= 2.0
+
+
+def test_load_score_orders_hosts():
+    idle = {"queue_depth": 0, "device_execute_p50": 0.002}
+    busy = {"queue_depth": 5, "device_execute_p50": 0.002}
+    cold = {"queue_depth": 1, "device_execute_p50": 0.0}
+    assert load_score(idle) < load_score(cold) < load_score(busy)
+
+
+def test_pod_validation_errors(pod_plans):
+    p = pod_plans
+    with pytest.raises(InvalidParameterError):
+        PodFrontend([], policy="p2c")
+    with pytest.raises(InvalidParameterError):
+        _make_pod(p, policy="weighted")
+    with pytest.raises(InvalidParameterError):
+        _make_pod(p, hosts=("h0", "h0"))
+    pod = _make_pod(p, with_dist=False)
+    try:
+        with pytest.raises(InvalidParameterError):
+            pod.submit(p["dsig"], [])  # signature never warmed up
+        with pytest.raises(InvalidParameterError):
+            pod.submit(p["sig"], [], kind="sideways")
+    finally:
+        _close_all(pod)
+
+
+# -- federated telemetry ------------------------------------------------------
+def test_cross_host_trace_single_trace_id(pod_plans):
+    """Every host-side serve.request / cluster.spmd_execute span is a
+    child of the frontend's cluster.request root with the SAME trace
+    id, and nothing leaks open."""
+    p = pod_plans
+    rng = np.random.default_rng(1)
+    obs.enable()
+    tracer = obs.GLOBAL_TRACER
+    tracer.reset()
+    tracer.set_sample_rate(1.0)
+    pod = _make_pod(p)
+    try:
+        futs = [pod.submit_backward(p["sig"], _values(p, rng))
+                for _ in range(6)]
+        futs.append(pod.submit(p["dsig"], _dvalues(p, rng)))
+        for fut in futs:
+            fut.result(timeout=60)
+    finally:
+        _close_all(pod)
+        obs.disable()
+    assert tracer.open_count() == 0, tracer.open_names()
+    spans = [e for e in tracer.events() if isinstance(e, obs.Span)]
+    roots = [s for s in spans if s.name == "cluster.request"]
+    assert len(roots) == 7
+    by_id = {s.span_id: s for s in spans}
+    crossed = 0
+    for s in spans:
+        if s.name in ("serve.request", "cluster.spmd_execute"):
+            parent = by_id[s.parent_id]
+            assert parent.name == "cluster.request"
+            assert s.trace_id == parent.trace_id
+            crossed += 1
+    assert crossed == 7
+
+
+def test_merged_metrics_parse_and_health(pod_plans):
+    p = pod_plans
+    rng = np.random.default_rng(2)
+    pod = _make_pod(p)
+    try:
+        for _ in range(6):
+            pod.submit_backward(p["sig"],
+                                _values(p, rng)).result(timeout=60)
+        assert pod.health()["state"] == "healthy"
+        parsed = obs.parse_prometheus_text(pod.metrics_text())
+        hosts = {dict(labels).get("host") for (name, labels) in parsed
+                 if name == "spfft_serve_completed_total"}
+        assert {"h0", "h1"} <= hosts
+        families = {name for name, _ in parsed}
+        assert "spfft_cluster_routed_total" in families
+        assert "spfft_cluster_health" in families
+
+        pod.kill_host("h1")
+        health = pod.health()
+        assert health["state"] == "degraded"
+        assert health["alive"] == 1
+        assert health["hosts"]["h1"]["state"] == "failed"
+        # the merged document stays valid with a lane down
+        obs.parse_prometheus_text(pod.metrics_text())
+        got = np.asarray(pod.submit_backward(
+            p["sig"], _values(p, rng)).result(timeout=60))
+        assert got.shape  # survivor still serves
+    finally:
+        _close_all(pod)
+
+
+# -- reconciliation -----------------------------------------------------------
+def test_reconciliation_rejects_differing_plan_sets(pod_plans):
+    p = pod_plans
+    lanes = []
+    try:
+        for host, with_dist in (("h0", True), ("h1", False)):
+            reg = PlanRegistry()
+            reg.put(p["sig"], p["plan"])
+            if with_dist:
+                reg.put(p["dsig"], p["dplan"])
+            lanes.append(HostLane(host, ServeExecutor(reg)))
+        with pytest.raises(ClusterReconciliationError,
+                           match="different plan set"):
+            PodFrontend(lanes)
+    finally:
+        for lane in lanes:
+            lane.executor.close()
+
+
+def test_reconciliation_rejects_fingerprint_mismatch(pod_plans):
+    """Same signature, different sharding: host h1 holds a distributed
+    plan whose stick partition is permuted — the loopback digest
+    collective must catch it exactly as the real one would."""
+    p = pod_plans
+    other = make_distributed_plan(
+        TransformType.C2C, *DIMS, list(reversed(p["parts"])),
+        p["planes"], mesh=make_mesh(SHARDS), precision="double")
+    lanes = []
+    try:
+        for host, dplan in (("h0", p["dplan"]), ("h1", other)):
+            reg = PlanRegistry()
+            reg.put(p["sig"], p["plan"])
+            reg.put(p["dsig"], dplan)
+            lanes.append(HostLane(host, ServeExecutor(reg)))
+        with pytest.raises(ClusterReconciliationError,
+                           match="disagrees across the pod"):
+            PodFrontend(lanes)
+    finally:
+        for lane in lanes:
+            lane.executor.close()
+
+
+def test_reconciliation_rpc_fault_is_typed(pod_plans):
+    p = pod_plans
+    faults.arm(FaultPlan(script="cluster.rpc@1"))
+    try:
+        with pytest.raises(ClusterReconciliationError,
+                           match="reconciliation RPC failed"):
+            _make_pod(p, with_dist=False)
+    finally:
+        faults.disarm()
+
+
+# -- failure semantics --------------------------------------------------------
+def test_dead_lane_failover(pod_plans):
+    """A lane whose transport is down is routed around (and marked
+    dead); a scripted cluster.route fault surfaces as the typed
+    injected fault, not a hang."""
+    p = pod_plans
+    rng = np.random.default_rng(3)
+    pod = _make_pod(p, with_dist=False)
+    try:
+        pod._lanes[0].transport.alive = False
+        v = _values(p, rng)
+        got = np.asarray(
+            pod.submit_backward(p["sig"], v).result(timeout=60))
+        assert np.array_equal(got, np.asarray(p["plan"].backward(v)))
+        assert pod._lanes[1].executor.metrics.snapshot()["completed"] \
+            >= 1
+
+        faults.arm(FaultPlan(script="cluster.route@1"))
+        try:
+            with pytest.raises(InjectedFault):
+                pod.submit_backward(p["sig"], v)
+        finally:
+            faults.disarm()
+        assert pod.health()["state"] == "degraded"
+    finally:
+        _close_all(pod)
+
+
+def test_all_lanes_dead_is_typed(pod_plans):
+    p = pod_plans
+    pod = _make_pod(p, with_dist=False)
+    try:
+        for lane in pod._lanes:
+            lane.transport.alive = False
+        with pytest.raises(ClusterError):
+            pod.submit_backward(p["sig"], np.zeros(len(p["trip"]),
+                                                   complex))
+        assert pod.health()["state"] == "failed"
+    finally:
+        _close_all(pod)
+
+
+def test_fuzz_cluster_faults_zero_unclosed_spans(pod_plans):
+    """8 threads hammering the pod under seeded cluster.rpc transient
+    faults: every failure is typed, every issued future resolves, and
+    the tracer ends with zero open spans."""
+    p = pod_plans
+    obs.enable()
+    tracer = obs.GLOBAL_TRACER
+    tracer.reset()
+    tracer.set_sample_rate(1.0)
+    pod = _make_pod(p)
+    errors = []
+    futures = []
+    flock = threading.Lock()
+
+    def worker(tid):
+        rng = np.random.default_rng(100 + tid)
+        for i in range(6):
+            try:
+                if i == 3:
+                    fut = pod.submit(p["dsig"], _dvalues(p, rng))
+                else:
+                    fut = pod.submit_backward(p["sig"],
+                                              _values(p, rng))
+                with flock:
+                    futures.append(fut)
+            except (HostLaneError, ClusterError, InjectedFault) as exc:
+                with flock:
+                    errors.append(exc)
+            except Exception as exc:  # untyped = a real bug
+                with flock:
+                    errors.append(AssertionError(repr(exc)))
+
+    faults.arm(FaultPlan(rate=0.15, seed=7, scope="cluster.rpc"))
+    try:
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        faults.disarm()
+
+    try:
+        for fut in futures:
+            try:
+                fut.result(timeout=60)  # resolves either way
+            except Exception:
+                pass
+    finally:
+        _close_all(pod)
+        obs.disable()
+    assert not [e for e in errors if isinstance(e, AssertionError)], \
+        errors
+    assert tracer.open_count() == 0, tracer.open_names()
+
+
+def test_pod_frontend_importable_from_serve():
+    from spfft_tpu import serve
+    assert serve.PodFrontend is PodFrontend
+    assert callable(serve.simulate_routing)

@@ -172,7 +172,7 @@ def test_executor_invalid_explicit_knobs_still_raise():
 def _signals(completed=0, queue_depth=0, qw95=0.0, dx50=0.0,
              fused_rows=0, padded_rows=0, fused_hist=None,
              max_queue_depth=0, stage_s=0.0, dispatch_s=0.0,
-             rejected=0):
+             rejected=0, exchange_s=0.0, compute_s=0.0):
     return {"completed": completed, "failed": 0,
             "queue_depth": queue_depth,
             "max_queue_depth": max_queue_depth,
@@ -181,6 +181,8 @@ def _signals(completed=0, queue_depth=0, qw95=0.0, dx50=0.0,
             "fused_hist": fused_hist or {}, "stage_s": stage_s,
             "dispatch_s": dispatch_s, "quarantines": 0,
             "rejected_queue_full": rejected,
+            "exchange_s": exchange_s,
+            "exchange_compute_s": compute_s,
             "latency_p99": 0.0}
 
 
@@ -304,6 +306,94 @@ def test_controller_max_queue_idle_decays_by_halving():
     assert cfg.max_queue == default
     ctl.step(_signals(completed=5))
     assert cfg.max_queue == default          # never undershoots
+
+
+def test_controller_overlap_chunks_grows_on_sustained_exposed_exchange():
+    """Round-18 satellite: exchange time rivaling compute time on
+    CONSECUTIVE distributed steps doubles overlap_chunks within its
+    declared clamp; one chunky step moves nothing (the streak is the
+    hysteresis, mirroring the max_queue rule)."""
+    cfg = ServeConfig()
+    ctl = Controller(cfg, cooldown_steps=0)
+    ctl.step(_signals(completed=1))                      # baseline
+    # one exposed-exchange step: no move yet
+    d1 = ctl.step(_signals(completed=5, exchange_s=0.4, compute_s=0.2))
+    assert not [d for d in d1 if d.knob == "overlap_chunks"]
+    assert cfg.overlap_chunks == ServeConfig.default("overlap_chunks")
+    # second consecutive exposed step: sustained -> double
+    d2 = ctl.step(_signals(completed=9, exchange_s=0.9, compute_s=0.4))
+    moved = [d for d in d2 if d.knob == "overlap_chunks"]
+    assert len(moved) == 1
+    assert moved[0].new == 2 * ServeConfig.default("overlap_chunks")
+    assert "exchange rivals compute" in moved[0].reason
+    # burn continues -> grows again, still bounds-clamped
+    ctl.step(_signals(completed=12, exchange_s=1.5, compute_s=0.6))
+    ctl.step(_signals(completed=15, exchange_s=2.2, compute_s=0.8))
+    assert cfg.overlap_chunks == 4 * ServeConfig.default("overlap_chunks")
+    lo, hi = ServeConfig.bounds("overlap_chunks")
+    assert lo <= cfg.overlap_chunks <= hi
+
+
+def test_controller_overlap_chunks_decays_when_exchange_hidden():
+    """Exchange well below compute halves K back toward the K=1
+    default (the bit-identical monolithic path); local-only steps
+    (no exchange/compute delta) reset the streak and move nothing."""
+    cfg = ServeConfig()
+    cfg.set("overlap_chunks", 8, source="test")
+    ctl = Controller(cfg, cooldown_steps=0)
+    ctl.step(_signals(completed=1))
+    # hidden exchange: 0.02 / 0.5 = 0.04 < overlap_lo (0.25) -> halve
+    ctl.step(_signals(completed=5, exchange_s=0.02, compute_s=0.5))
+    assert cfg.overlap_chunks == 4
+    ctl.step(_signals(completed=9, exchange_s=0.04, compute_s=1.0))
+    assert cfg.overlap_chunks == 2
+    # local-only traffic: nothing distributed ran, nothing moves
+    ctl.step(_signals(completed=12))
+    assert cfg.overlap_chunks == 2
+    ctl.step(_signals(completed=15, exchange_s=0.06, compute_s=1.5))
+    assert cfg.overlap_chunks == ServeConfig.default("overlap_chunks")
+    ctl.step(_signals(completed=18, exchange_s=0.08, compute_s=2.0))
+    assert cfg.overlap_chunks == ServeConfig.default("overlap_chunks")
+
+
+def test_controller_overlap_chunks_streak_broken_by_local_step():
+    """A local-only step between two exposed-exchange steps breaks the
+    streak — the rule needs CONSECUTIVE evidence, so alternating
+    traffic never ratchets K."""
+    cfg = ServeConfig()
+    ctl = Controller(cfg, cooldown_steps=0)
+    ctl.step(_signals(completed=1))
+    ctl.step(_signals(completed=5, exchange_s=0.4, compute_s=0.2))
+    ctl.step(_signals(completed=9))                      # local only
+    ctl.step(_signals(completed=12, exchange_s=0.8, compute_s=0.4))
+    assert cfg.overlap_chunks == ServeConfig.default("overlap_chunks")
+    assert not [d for d in ctl.decisions()
+                if d.knob == "overlap_chunks"]
+
+
+def test_controller_overlap_chunks_idle_decays_by_halving():
+    cfg = ServeConfig()
+    cfg.set("overlap_chunks", 4, source="test")
+    ctl = Controller(cfg, cooldown_steps=0)
+    ctl.step(_signals(completed=5))          # baseline with traffic
+    ctl.step(_signals(completed=5))          # idle
+    assert cfg.overlap_chunks == 2
+    ctl.step(_signals(completed=5))
+    assert cfg.overlap_chunks == ServeConfig.default("overlap_chunks")
+    ctl.step(_signals(completed=5))
+    assert cfg.overlap_chunks == ServeConfig.default("overlap_chunks")
+
+
+def test_metrics_record_exchange_overlap_feeds_signals():
+    """ServeMetrics carries the cumulative exchange/compute second
+    pair the overlap_chunks rule diffs."""
+    from spfft_tpu.serve import ServeMetrics
+    m = ServeMetrics()
+    m.record_exchange_overlap(0.25, 0.75)
+    m.record_exchange_overlap(0.05, 0.10)
+    s = m.signals()
+    assert s["exchange_s"] == pytest.approx(0.30)
+    assert s["exchange_compute_s"] == pytest.approx(0.85)
 
 
 def test_controller_idle_decays_managed_knobs_to_defaults():
@@ -533,6 +623,91 @@ def test_slo_never_masks_worse_lifecycle_state():
     metrics.record_health("failed")
     metrics.record_slo(["error_rate"])
     assert metrics.health()["state"] == "failed"
+
+
+# -- multi-window SLO alerting (round 18) -----------------------------------
+def _slo_signals(p99):
+    return {"completed": 10, "failed": 0, "latency_p99": p99,
+            "quarantines": 0}
+
+
+def test_slo_multiwindow_pages_only_on_sustained_burn():
+    """The SRE-workbook shape: both the fast and the slow window must
+    burn above budget before the page condition raises — the first
+    burning evaluations degrade health (single-eval violation) but do
+    not page; a full fast window of sustained burn does."""
+    from spfft_tpu import obs
+    dog = SLOWatchdog(None, SLOSpec(latency_p99_s=0.010),
+                      fast_window=3, slow_window=9)
+    base = obs.GLOBAL_COUNTERS.get("spfft_slo_window_alerts_total",
+                                   slo="latency_p99_s")
+    for i in range(2):  # burning, but shallower than the fast window
+        v = dog.evaluate(_slo_signals(0.050))
+        assert v["violations"] == ["latency_p99_s"]  # health layer
+        assert v["window_alerts"] == []              # page layer quiet
+    v = dog.evaluate(_slo_signals(0.050))            # 3rd: sustained
+    assert v["window_alerts"] == ["latency_p99_s"]
+    assert v["window_burn"]["latency_p99_s"]["fast"] > 1.0
+    assert v["window_burn"]["latency_p99_s"]["slow"] > 1.0
+    assert obs.GLOBAL_COUNTERS.get(
+        "spfft_slo_window_alerts_total", slo="latency_p99_s") == base + 1
+    assert obs.GLOBAL_COUNTERS.get(
+        "spfft_slo_window_alert", slo="latency_p99_s") == 1
+    # the page condition HOLDS without re-counting (rising edge only)
+    dog.evaluate(_slo_signals(0.050))
+    assert obs.GLOBAL_COUNTERS.get(
+        "spfft_slo_window_alerts_total", slo="latency_p99_s") == base + 1
+
+
+def test_slo_multiwindow_no_false_positive_on_transient_blip():
+    """A transient burn blip inside an otherwise healthy trace never
+    raises the page condition: the single-eval violation (and its
+    health degradation) comes and goes, the window alert stays 0 and
+    the rising-edge counter does not move."""
+    from spfft_tpu import obs
+    dog = SLOWatchdog(None, SLOSpec(latency_p99_s=0.010),
+                      fast_window=3, slow_window=9)
+    base = obs.GLOBAL_COUNTERS.get("spfft_slo_window_alerts_total",
+                                   slo="latency_p99_s")
+    trace = [0.002, 0.002, 0.015, 0.002, 0.002, 0.002]
+    for p99 in trace:
+        v = dog.evaluate(_slo_signals(p99))
+        if p99 > 0.010:
+            assert v["violations"] == ["latency_p99_s"]
+        assert v["window_alerts"] == []
+    assert obs.GLOBAL_COUNTERS.get(
+        "spfft_slo_window_alert", slo="latency_p99_s") == 0
+    assert obs.GLOBAL_COUNTERS.get(
+        "spfft_slo_window_alerts_total",
+        slo="latency_p99_s") == base
+
+
+def test_slo_multiwindow_slow_window_clears_after_recovery():
+    """After a real page, recovery drains the fast window first (alert
+    clears) while the slow window still remembers the burn — then both
+    clear. Gauges follow."""
+    from spfft_tpu import obs
+    dog = SLOWatchdog(None, SLOSpec(latency_p99_s=0.010),
+                      fast_window=2, slow_window=6)
+    for _ in range(4):
+        dog.evaluate(_slo_signals(0.050))
+    assert dog.evaluate(_slo_signals(0.050))["window_alerts"] \
+        == ["latency_p99_s"]
+    v = dog.evaluate(_slo_signals(0.002))   # recovery begins
+    v = dog.evaluate(_slo_signals(0.002))   # fast window now clean
+    assert v["window_alerts"] == []
+    assert v["window_burn"]["latency_p99_s"]["fast"] < 1.0
+    assert v["window_burn"]["latency_p99_s"]["slow"] > 1.0
+    assert obs.GLOBAL_COUNTERS.get(
+        "spfft_slo_window_alert", slo="latency_p99_s") == 0
+
+
+def test_slo_multiwindow_window_validation():
+    with pytest.raises(InvalidParameterError):
+        SLOWatchdog(None, SLOSpec(latency_p99_s=0.01), fast_window=0)
+    with pytest.raises(InvalidParameterError):
+        SLOWatchdog(None, SLOSpec(latency_p99_s=0.01),
+                    fast_window=10, slow_window=5)
 
 
 # -- metrics signals --------------------------------------------------------

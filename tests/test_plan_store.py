@@ -27,7 +27,8 @@ from spfft_tpu.plan import TransformPlan, restore_plan
 from spfft_tpu.indexing import build_index_plan
 from spfft_tpu.serve.registry import PlanRegistry
 from spfft_tpu.serve import store as store_mod
-from spfft_tpu.serve.store import (MAGIC, PlanArtifactStore,
+from spfft_tpu.serve.store import (MAGIC, PLAN_MANIFEST_ENV,
+                                   PlanArtifactStore, load_manifest,
                                    parse_artifact, serialize_artifact,
                                    signature_key)
 from spfft_tpu.types import Scaling, TransformType
@@ -431,6 +432,88 @@ def test_manifest_warmup_and_strict_failure(tmp_path):
     reg4 = PlanRegistry(store=PlanArtifactStore(store.root))
     assert reg4.warmup_manifest(mpath, strict=False) == []
     assert _reject_count(reg4.store, "corrupt") == 1
+
+
+def test_live_manifest_auto_refresh_on_spill(tmp_path, monkeypatch):
+    """With ``SPFFT_TPU_PLAN_MANIFEST`` set, every spill merges its
+    entry into the live manifest — deduped on the artifact key — and a
+    replacement registry prewarms from it with zero builds."""
+    mpath = str(tmp_path / "live-manifest.json")
+    monkeypatch.setenv(PLAN_MANIFEST_ENV, mpath)
+    before = obs.GLOBAL_COUNTERS.get(
+        "spfft_store_manifest_refreshes_total")
+    store, reg, tr, sig, plan = _build_store(tmp_path)
+
+    m = load_manifest(mpath)
+    assert [e["artifact"] for e in m["entries"]] \
+        == [signature_key(sig)]
+    assert m["entries"][0]["signature"] == dataclasses.asdict(sig)
+    assert m["entries"][0]["num_values"] == plan.index_plan.num_values
+
+    # re-spilling the same plan replaces, never duplicates
+    store.save_plan(sig, plan, tr)
+    assert len(load_manifest(mpath)["entries"]) == 1
+
+    # a second signature appends
+    dim2 = 16
+    sig2, _ = reg.get_or_build(TransformType.C2C, dim2, dim2, dim2,
+                               _triplets(dim2))
+    store.drain()
+    m = load_manifest(mpath)
+    assert {e["artifact"] for e in m["entries"]} \
+        == {signature_key(sig), signature_key(sig2)}
+    assert obs.GLOBAL_COUNTERS.get(
+        "spfft_store_manifest_refreshes_total") >= before + 3
+
+    reg2 = PlanRegistry(store=PlanArtifactStore(store.root))
+    assert set(reg2.warmup_manifest(mpath)) == {sig, sig2}
+    assert reg2.stats()["builds"] == 0
+
+
+def test_live_manifest_concurrent_appends_atomic(tmp_path):
+    """16 threads hammering ``append_manifest_entry`` (with key
+    collisions) leave one valid, complete, duplicate-free manifest and
+    no temp droppings — the read/merge/replace cycle is atomic."""
+    store = PlanArtifactStore(str(tmp_path / "store"))
+    mpath = str(tmp_path / "live-manifest.json")
+    keys = [f"art-{i % 12:02d}" for i in range(16)]  # 12 distinct
+
+    def append(key):
+        store.append_manifest_entry(mpath, {
+            "artifact": key, "signature": {"k": key}, "bytes": 1})
+
+    threads = [threading.Thread(target=append, args=(k,))
+               for k in keys]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    m = load_manifest(mpath)
+    got = [e["artifact"] for e in m["entries"]]
+    assert sorted(got) == sorted(set(keys))  # all present, none twice
+    assert not [n for n in os.listdir(str(tmp_path))
+                if n.startswith(".tmp-")]
+
+
+def test_live_manifest_invalid_file_never_clobbered(tmp_path,
+                                                    monkeypatch):
+    """An existing-but-invalid manifest is an error for the direct
+    append, a counted non-fatal reject for the spill path — and its
+    bytes survive untouched either way."""
+    from spfft_tpu.errors import InvalidParameterError
+    mpath = str(tmp_path / "live-manifest.json")
+    open(mpath, "w").write("not a manifest")
+    monkeypatch.setenv(PLAN_MANIFEST_ENV, mpath)
+
+    store, reg, tr, sig, plan = _build_store(tmp_path)
+    with pytest.raises(InvalidParameterError):
+        store.append_manifest_entry(mpath, {"artifact": "x"})
+    # the spill itself (hook included) already ran and must not have
+    # failed: the artifact landed, the manifest stayed as-is
+    assert store.load_signature(sig) is not None
+    assert open(mpath).read() == "not a manifest"
+    assert _reject_count(store, "io") >= 1
 
 
 def test_executor_boot_prewarm_from_manifest_env(tmp_path, monkeypatch):
