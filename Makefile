@@ -17,7 +17,7 @@ CAPI_SO     := lib/libspfft_tpu.so
 
 .PHONY: all native capi example-c test ci ci-tpu trace-smoke \
         control-smoke fused-smoke store-smoke chaos-smoke \
-        cluster-smoke bench-check lint analyze clean
+        cluster-smoke pod-smoke bench-check lint analyze clean
 
 # One-command CI (reference: .github/workflows/ci.yml builds + runs the
 # local test matrix): full CPU suite (8-device virtual mesh; includes the
@@ -74,11 +74,11 @@ analyze:
 # fault-injection: bucket isolation, device quarantine over the real
 # chip pool, crash-proof dispatch). Needs the real chip; record with
 #   make ci-tpu 2>&1 | tee docs/ci_tpu_r05.log
-# lint + analyze + chaos-smoke + cluster-smoke run first: the chip
-# lane is expensive, so it never starts on a tree the static passes
-# already know is dirty or whose failure semantics the CPU chaos
-# harness / emulated pod can already break.
-ci-tpu: lint analyze chaos-smoke cluster-smoke
+# lint + analyze + chaos-smoke + cluster-smoke + pod-smoke run first:
+# the chip lane is expensive, so it never starts on a tree the static
+# passes already know is dirty or whose failure semantics the CPU
+# chaos harness / emulated pod / real-TCP pod can already break.
+ci-tpu: lint analyze chaos-smoke cluster-smoke pod-smoke
 	@echo "== CI-TPU: on-device regression lane =="
 	python -m pytest tests_tpu/ -q -rA
 	@echo "CI-TPU GREEN"
@@ -205,6 +205,21 @@ cluster-smoke:
 	  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 	  python -m spfft_tpu.serve.cluster --smoke
 	@echo "CLUSTER-SMOKE GREEN"
+
+# Real-wire pod smoke (docs/cluster.md "Deployment"): two AGENT
+# PROCESSES over localhost TCP behind a PodFrontend of TcpHostLanes —
+# a mixed single+distributed trace bit-exact vs a serial oracle built
+# in the parent, one trace id across the process boundary (asserted
+# via the agents' `spans` RPC), a mid-stream join that boots warm off
+# the shared blob tier (joiner registry builds == 0), kill -9 failover
+# with bit-exact survivors, and a drain-leave walking the membership
+# ladder. Exit 1 on any violation.
+pod-smoke:
+	@echo "== pod-smoke: real two-process pod over localhost TCP =="
+	env JAX_PLATFORMS=cpu \
+	  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	  python -m spfft_tpu.net.smoke
+	@echo "POD-SMOKE GREEN"
 
 # Perf-trajectory guard (scripts/bench_regress.py): run the north-star
 # benchmark fresh and compare against the latest recorded BENCH_r*.json

@@ -175,6 +175,10 @@ def symmetry_inner() -> None:
     rr = simulate_routing("rr")
     p2c = simulate_routing("p2c")
 
+    # --- pod_wire: what the real TCP wire costs over loopback ---
+    from spfft_tpu.net.transport import wire_overhead_probe
+    wire = wire_overhead_probe(repeats=48)
+
     print(json.dumps({
         "wire_bytes_r2c": {
             "metric": f"{n}^3 spherical-cutoff R2C distributed exchange "
@@ -217,6 +221,18 @@ def symmetry_inner() -> None:
                       "python -m spfft_tpu.serve.cluster --simulate)",
             "value": round(rr["ratio"] / p2c["ratio"], 3),
             "unit": "x",
+        },
+        "pod_wire": {
+            "metric": "pod wire overhead: median rpc_submit round "
+                      "trip through an in-process localhost-TCP "
+                      "HostAgent minus the loopback lane's, same "
+                      "executor + tiny C2C workload "
+                      f"(loopback {wire['loopback_us']:.0f} us vs "
+                      f"TCP {wire['tcp_us']:.0f} us, "
+                      f"{wire['repeats']} warmed repeats; "
+                      "net.transport.wire_overhead_probe)",
+            "value": round(wire["overhead_us"], 1),
+            "unit": "us",
         },
     }))
 

@@ -59,7 +59,12 @@ LOWER_IS_BETTER_UNITS = ("s", "ms", "us", "ns", "seconds", "bytes")
 #: (unit "seams", higher is better) counts the ACTIVE r2c fused seams
 #: on the interpret lane (local kernel + distributed twin, 2 when the
 #: hermitian_completion decline stays lifted); a drop below 2 trips
-#: the rate-direction comparison. fused_dist (unit "directions",
+#: the rate-direction comparison. pod_wire (unit "us", lower is
+#: better, recorded from BENCH_r06.json round 19 on) is the median
+#: TCP-vs-loopback rpc_submit round-trip overhead through an
+#: in-process localhost HostAgent — growth past threshold means the
+#: frame protocol or lane client got slower on the wire.
+#: fused_dist (unit "directions",
 #: higher is better) counts the distributed fused directions active
 #: under the K=2 overlap pipeline (chunk-sliceable backward + forward
 #: twin; 2 = fusion and overlap compose both ways) — a drop means a
@@ -70,7 +75,8 @@ LOWER_IS_BETTER_UNITS = ("s", "ms", "us", "ns", "seconds", "bytes")
 #: threshold means the routing policy stopped spreading the skewed
 #: load. All emitted by bench.py every run.
 SUB_ROWS = ("fused", "cold_start_ms", "warm_start_ms",
-            "wire_bytes_r2c", "fused_r2c", "fused_dist", "pod_routing")
+            "wire_bytes_r2c", "fused_r2c", "fused_dist", "pod_routing",
+            "pod_wire")
 
 
 def load_payload(path: str) -> dict:

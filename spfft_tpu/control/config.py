@@ -156,6 +156,14 @@ KNOB_SPECS: Dict[str, KnobSpec] = {spec.name: spec for spec in (
              "materialisation exceeding it is abandoned and failed as "
              "a typed transient ExecuteTimeoutError feeding the retry "
              "+ quarantine ladder (0 = off)."),
+    KnobSpec("net_connect_timeout_ms", 2000, 1, 600_000, int,
+             "spfft_cluster_rpc_failures_total",
+             "TCP connect timeout (ms) for a host lane's wire RPCs: "
+             "an unreachable agent fails over this fast."),
+    KnobSpec("net_rpc_timeout_ms", 30_000, 1, 600_000, int,
+             "spfft_net_rpc_rtt_seconds",
+             "Per-RPC socket read timeout (ms) on the pod wire; a "
+             "submit adds the request's own deadline on top."),
 )}
 
 #: String-valued settings (paths) the numeric KnobSpec clamp cannot
@@ -163,8 +171,12 @@ KNOB_SPECS: Dict[str, KnobSpec] = {spec.name: spec for spec in (
 #: lock, round-tripped through the JSON artifact (under ``"paths"``),
 #: but never exported as Prometheus gauges. ``plan_store_path`` ""
 #: (the default) disables the disk plan tier unless the
-#: ``SPFFT_TPU_PLAN_STORE`` env var names one.
-PATH_SETTINGS: Dict[str, str] = {"plan_store_path": ""}
+#: ``SPFFT_TPU_PLAN_STORE`` env var names one; ``blob_store_url`` ""
+#: disables the remote blob artifact tier unless
+#: ``SPFFT_TPU_BLOB_STORE`` names one (http:// URL or a shared
+#: directory — see ``net/blobstore.py``).
+PATH_SETTINGS: Dict[str, str] = {"plan_store_path": "",
+                                 "blob_store_url": ""}
 
 
 def _counters():
@@ -206,6 +218,11 @@ class ServeConfig:
     def plan_store_path(self) -> str:
         with self._lock:
             return self._paths["plan_store_path"]
+
+    @property
+    def blob_store_url(self) -> str:
+        with self._lock:
+            return self._paths["blob_store_url"]
 
     def set_path(self, name: str, value: str) -> str:
         if name not in PATH_SETTINGS:

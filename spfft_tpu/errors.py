@@ -225,6 +225,17 @@ class ClusterReconciliationError(ClusterError):
     code = ErrorCode.PARAMETER_MISMATCH
 
 
+class NetProtocolError(ClusterError):
+    """A wire frame failed to parse: bad magic, version mismatch, a
+    truncated header/payload, or a malformed typed record
+    (``spfft_tpu.net.frame``). Transient from the pod's point of view —
+    the frontend routes around the lane that produced it exactly like a
+    dead transport — but typed separately so a protocol-version skew
+    across a fleet shows up as itself, not as generic lane death."""
+
+    transient = True
+
+
 class ExecutorCrashedError(ServeError):
     """The dispatch loop crashed unexpectedly and its supervisor
     exhausted the bounded restart budget; every queued and in-flight
@@ -252,6 +263,15 @@ class PlanArtifactError(ServeError):
     silently join the pool half-warm; the ad-hoc ``get_or_build`` path
     never raises this (a rejected artifact there falls back to a clean
     rebuild with the reason counted)."""
+
+
+class BlobStoreError(ServeError):
+    """A remote blob-tier operation failed (``spfft_tpu.net.blobstore``):
+    the backing object store is unreachable, answered a non-OK status,
+    or the local file backend hit an I/O error. The plan-artifact store
+    treats it as a remote-tier miss (counted, never raised through a
+    load) — the remote tier is an optimisation below the disk tier, not
+    a correctness dependency."""
 
 
 class FFTError(GenericError):

@@ -41,6 +41,15 @@ site                  where it fires
                       (submit / signals / metrics / health)
 ``cluster.reconcile`` the per-host digest-validation collective during
                       pod reconciliation
+``net.frame``         encode/decode of one wire frame (either socket
+                      end of the pod's TCP transport)
+``net.send``          the socket send of a framed request/response
+``net.recv``          each socket read while receiving a frame (a
+                      firing check is a dropped/truncated frame)
+``net.accept``        the host agent's accept of an inbound connection
+                      (a firing check drops the connection)
+``blob.get``          a remote blob-tier read (artifact or alias)
+``blob.put``          a remote blob-tier write
 ===================== ====================================================
 
 A firing check raises :class:`InjectedFault` (or an
@@ -120,6 +129,9 @@ SITES = (
     "exchange.chunk",
     # pod cluster (round 18)
     "cluster.route", "cluster.rpc", "cluster.reconcile",
+    # wire transport + remote artifact tier (net/)
+    "net.frame", "net.send", "net.recv", "net.accept",
+    "blob.get", "blob.put",
 )
 
 #: Substrings of runtime error text treated as transient — the

@@ -1,0 +1,39 @@
+"""Real wire transport for the pod: framed TCP RPC, host agents and
+the remote blob artifact tier.
+
+``serve.cluster`` defined the host boundary (the five-RPC
+``HostLane`` seam) against an in-process ``LoopbackTransport``; this
+package is the same seam crossed by a real socket:
+
+* :mod:`~spfft_tpu.net.frame` — the framed protocol (length-prefixed,
+  versioned header, typed JSON records, npz array payloads) plus the
+  wire forms of ``PlanSignature``, ``obs.TraceContext`` and the typed
+  error taxonomy.
+* :mod:`~spfft_tpu.net.transport` — :class:`TcpTransport` (the client
+  stub behind the ``cluster.rpc`` fault seam, measuring round-trip
+  latency into ``load_score``) and :class:`TcpHostLane`, the drop-in
+  remote twin of ``serve.cluster.HostLane``.
+* :mod:`~spfft_tpu.net.agent` — :class:`HostAgent`, the server side
+  (``python -m spfft_tpu.net.agent``) fronting a local
+  ``ServeExecutor``.
+* :mod:`~spfft_tpu.net.blobstore` — the object-store-shaped byte
+  transport below the disk tier of ``PlanArtifactStore``.
+* :mod:`~spfft_tpu.net.smoke` — the two-process localhost pod behind
+  ``make pod-smoke``.
+"""
+
+from .blobstore import (BlobStore, FileBlobStore, HttpBlobStore,
+                        open_blobstore)
+from .frame import (FRAME_VERSION, error_from_wire, error_to_wire,
+                    pack_values, recv_frame, send_frame,
+                    signature_from_wire, signature_to_wire,
+                    unpack_values)
+from .transport import TcpHostLane, TcpTransport
+
+__all__ = [
+    "BlobStore", "FileBlobStore", "HttpBlobStore", "open_blobstore",
+    "FRAME_VERSION", "error_from_wire", "error_to_wire",
+    "pack_values", "recv_frame", "send_frame", "signature_from_wire",
+    "signature_to_wire", "unpack_values",
+    "TcpHostLane", "TcpTransport",
+]

@@ -1,0 +1,391 @@
+"""The server side of the pod's wire: :class:`HostAgent`.
+
+``python -m spfft_tpu.net.agent --host h0`` turns one process into one
+pod host: a local ``ServeExecutor`` (own registry, own artifact store,
+optionally the fleet's remote blob tier) fronted by a framed-TCP
+accept loop speaking the :mod:`~spfft_tpu.net.frame` protocol. The
+dispatch table is the ``HostLane`` seam verbatim — submit / signals /
+signatures / plan / metrics / health — plus the membership and
+introspection verbs the elastic pod needs (prewarm, drain, shutdown,
+stats, spans).
+
+Three contracts the agent keeps:
+
+* **One trace id end-to-end** — a submit frame carries the frontend's
+  ``TraceContext``; the agent restores it, so the local
+  ``serve.request`` (or ``cluster.spmd_execute``) span is a child of
+  the remote ``cluster.request`` root across the process boundary.
+* **Typed errors only** — a handler that raises answers with an
+  ``error`` record; :func:`~spfft_tpu.net.frame.error_from_wire` maps
+  it back onto the taxonomy client-side (a remote ``QueueFullError``
+  stays backpressure, never lane death).
+* **Plans never cross the wire** — ``plan`` answers a descriptor
+  (held / distributed / fingerprint); execution happens here, next to
+  the devices that compiled the plan.
+
+``net.accept`` is the agent's fault site: a firing check drops the
+inbound connection on the floor — the client sees exactly a crashed
+host.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+from typing import Dict, Optional, Tuple
+
+from .. import faults as _faults
+from .. import obs as _obs
+from ..control.config import global_config
+from ..errors import InvalidParameterError, NetProtocolError
+from ..faults import InjectedFault
+from ..obs.exporters import prometheus_text
+from ..parallel.multihost import plan_fingerprint
+from ..plan import TransformPlan
+from ..serve.executor import ServeExecutor
+from ..serve.registry import PlanSignature
+from ..types import Scaling
+from .frame import (error_to_wire, pack_values, recv_frame, send_frame,
+                    signature_from_wire, signature_to_wire,
+                    unpack_values)
+
+
+def _jsonify(obj):
+    """Make a telemetry snapshot JSON-clean: stringify non-str dict
+    keys (the fused-batch histogram is int-keyed) and coerce numpy
+    scalars through their Python item()."""
+    if isinstance(obj, dict):
+        return {str(k): _jsonify(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonify(v) for v in obj]
+    if hasattr(obj, "item") and not isinstance(obj, (str, bytes)):
+        try:
+            return obj.item()
+        except Exception:
+            return str(obj)
+    return obj
+
+
+class HostAgent:
+    """One pod host: a TCP accept loop dispatching framed requests
+    onto a local :class:`ServeExecutor`. ``port=0`` binds an ephemeral
+    port (read it back from :attr:`port` — how the smoke wires a pod
+    of subprocesses together)."""
+
+    def __init__(self, host: str, executor: ServeExecutor,
+                 bind: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.executor = executor
+        self.closing = threading.Event()
+        self._lock = threading.Lock()
+        #: guarded by _lock
+        self._sig_locks: Dict[PlanSignature, threading.Lock] = {}
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((bind, port))
+        self._sock.listen(64)
+        # short accept timeout: the loop notices `closing` promptly
+        self._sock.settimeout(0.2)
+        self.port = self._sock.getsockname()[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "HostAgent":
+        self._thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"spfft-agent-{self.host}")
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self.closing.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    # -- the accept loop ---------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self.closing.is_set():
+            try:
+                conn, _addr = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                if self.closing.is_set():
+                    return
+                continue
+            try:
+                _faults.check_site("net.accept")
+            except InjectedFault:
+                # a dropped inbound connection: the client observes a
+                # crashed host (EOF), which is the point of the site
+                conn.close()
+                continue
+            threading.Thread(
+                target=self._handle_conn, args=(conn,), daemon=True,
+                name=f"spfft-agent-{self.host}-conn").start()
+
+    def _handle_conn(self, conn) -> None:
+        cfg = global_config()
+        conn.settimeout(cfg.net_rpc_timeout_ms / 1000.0)
+        try:
+            while not self.closing.is_set():
+                try:
+                    frame = recv_frame(conn, eof_ok=True)
+                except (NetProtocolError, InjectedFault) as exc:
+                    # best effort: tell the client what went wrong,
+                    # then give up on this (possibly desynced) stream
+                    try:
+                        send_frame(conn, error_to_wire(exc))
+                    except (OSError, NetProtocolError, InjectedFault):
+                        pass
+                    return
+                except OSError:
+                    return
+                if frame is None:
+                    return
+                header, payload = frame
+                op = str(header.get("type", "?"))
+                _obs.GLOBAL_COUNTERS.inc(
+                    "spfft_net_agent_requests_total", op=op)
+                try:
+                    reply, rpayload = self._dispatch(op, header, payload)
+                except Exception as exc:
+                    reply, rpayload = error_to_wire(exc), b""
+                try:
+                    send_frame(conn, reply, rpayload)
+                except (OSError, NetProtocolError, InjectedFault):
+                    return
+        finally:
+            conn.close()
+
+    # -- dispatch ----------------------------------------------------------
+    def _dispatch(self, op: str, header: dict,
+                  payload: bytes) -> Tuple[dict, bytes]:
+        if op == "submit":
+            ctx = _obs.TraceContext.from_wire(header.get("ctx"))
+            return self._handle_submit(header, payload, ctx)
+        if op == "signals":
+            return ({"type": "signals_ok",
+                     "signals": _jsonify(
+                         self.executor.metrics.signals())}, b"")
+        if op == "signatures":
+            return ({"type": "signatures_ok",
+                     "signatures": [
+                         signature_to_wire(s) for s in
+                         self.executor.registry.signatures()]}, b"")
+        if op == "plan":
+            sig = signature_from_wire(header.get("signature") or {})
+            plan = self.executor.registry.get(sig)
+            if plan is None:
+                return {"type": "plan_ok", "held": False}, b""
+            distributed = not isinstance(plan, TransformPlan)
+            return ({"type": "plan_ok", "held": True,
+                     "distributed": distributed,
+                     "fingerprint":
+                         plan_fingerprint(plan.dist_plan).hex()
+                         if distributed else None}, b"")
+        if op == "metrics":
+            return ({"type": "metrics_ok",
+                     "text": prometheus_text(
+                         metrics=self.executor.metrics,
+                         registry=self.executor.registry)}, b"")
+        if op == "health":
+            return ({"type": "health_ok",
+                     "health": _jsonify(self.executor.health())}, b"")
+        if op == "prewarm":
+            sigs = [signature_from_wire(d)
+                    for d in header.get("signatures", [])]
+            warmed = self.executor.registry.prewarm_signatures(
+                sigs, strict=bool(header.get("strict", True)))
+            return ({"type": "prewarm_ok", "warmed": warmed}, b"")
+        if op == "stats":
+            return ({"type": "stats_ok",
+                     "registry": _jsonify(
+                         self.executor.registry.stats())}, b"")
+        if op == "spans":
+            return self._handle_spans()
+        if op == "drain":
+            self.executor.close(drain=True)
+            return {"type": "drain_ok"}, b""
+        if op == "shutdown":
+            self.closing.set()
+            return {"type": "shutdown_ok"}, b""
+        if op == "ping":
+            return {"type": "pong", "host": self.host}, b""
+        raise InvalidParameterError(f"unknown wire op {op!r}")
+
+    # trace: boundary(ctx)
+    def _handle_submit(self, header: dict, payload: bytes,
+                       ctx) -> Tuple[dict, bytes]:
+        """Execute one submit frame to completion (the reply IS the
+        result — the asynchrony lives client-side in the lane's thread
+        pool), restoring the propagated trace context so this host's
+        spans join the frontend's trace."""
+        sig = signature_from_wire(header.get("signature") or {})
+        values = unpack_values(header, payload)
+        kind = str(header.get("kind", "backward"))
+        scaling = Scaling(header.get("scaling", Scaling.NONE.value))
+        timeout = header.get("timeout")
+        priority = str(header.get("priority", "normal"))
+        plan = self.executor.registry.get(sig)
+        if plan is None:
+            raise InvalidParameterError(
+                f"signature not held by host {self.host!r} "
+                f"(warm up first)")
+        if isinstance(plan, TransformPlan):
+            fut = self.executor.submit(
+                sig, values, kind, scaling=scaling, timeout=timeout,
+                priority=priority, trace_ctx=ctx)
+            result = fut.result()
+        else:
+            result = self._run_distributed(sig, plan, values, kind,
+                                           scaling, ctx)
+        meta, rpayload = pack_values(result)
+        return {"type": "result", **meta}, rpayload
+
+    def _run_distributed(self, sig, plan, values, kind, scaling, ctx):
+        """This host's half of the pod SPMD lane: serialized
+        per-signature (a shard_map executable spans the whole local
+        mesh — overlapping launches of one executable interleave on
+        every device and win nothing)."""
+        _obs.GLOBAL_COUNTERS.inc("spfft_cluster_spmd_requests_total")
+        if ctx is not None and _obs.active():
+            with _obs.GLOBAL_TRACER.span(
+                    "cluster.spmd_execute", trace_id=ctx.trace_id,
+                    parent=ctx, track="pod:spmd",
+                    args={"kind": kind, "host": self.host}):
+                return self._execute_distributed(sig, plan, values,
+                                                 kind, scaling)
+        return self._execute_distributed(sig, plan, values, kind,
+                                         scaling)
+
+    def _execute_distributed(self, sig, plan, values, kind, scaling):
+        with self._lock:
+            lock = self._sig_locks.get(sig)
+            if lock is None:
+                lock = self._sig_locks[sig] = threading.Lock()
+        with lock:
+            if kind == "backward":
+                return plan.backward(values)
+            return plan.forward(values, scaling)
+
+    def _handle_spans(self) -> Tuple[dict, bytes]:
+        tracer = _obs.GLOBAL_TRACER
+        spans = [{"name": s.name, "trace_id": s.trace_id,
+                  "span_id": s.span_id, "parent_id": s.parent_id}
+                 for s in tracer.events() if isinstance(s, _obs.Span)]
+        return ({"type": "spans_ok", "spans": spans,
+                 "open": tracer.open_count()}, b"")
+
+
+# ---------------------------------------------------------------------------
+# CLI: one process = one pod host
+# ---------------------------------------------------------------------------
+
+def _demo_warm(registry, spec: str) -> None:
+    """Warm the demo plan set the smokes serve: ``N,CUTOFF,SHARDS`` +
+    an optional mode — ``full`` (default) builds the single-device C2C
+    plan AND the matching distributed plan; ``dist`` builds ONLY the
+    distributed plan (the joining-host case: singles come warm from
+    the artifact tiers, and the distributed plan — which is never
+    serialized — is derived deterministically from the same triplet
+    set, so its fingerprint reconciles against the incumbents)."""
+    from ..benchmark import cutoff_stick_triplets
+    from ..parallel import make_distributed_plan, make_mesh
+    from ..types import TransformType
+    from ..utils.workloads import (even_plane_split,
+                                   round_robin_stick_partition)
+    from ..serve.registry import signature_for
+
+    parts = spec.split(",")
+    if len(parts) not in (3, 4):
+        raise InvalidParameterError(
+            f"--demo-warm wants N,CUTOFF,SHARDS[,MODE], got {spec!r}")
+    n, cutoff, shards = int(parts[0]), float(parts[1]), int(parts[2])
+    mode = parts[3] if len(parts) == 4 else "full"
+    if mode not in ("full", "dist"):
+        raise InvalidParameterError(
+            f"--demo-warm mode must be full|dist, got {mode!r}")
+    dims = (n, n, n)
+    trip = cutoff_stick_triplets(n, n, n, cutoff, hermitian=False)
+    if mode == "full":
+        registry.get_or_build(TransformType.C2C, *dims, trip,
+                              precision="double")
+    if shards > 1:
+        sparts = round_robin_stick_partition(trip, dims, shards)
+        planes = even_plane_split(dims[2], shards)
+        dplan = make_distributed_plan(TransformType.C2C, *dims, sparts,
+                                      planes, mesh=make_mesh(shards),
+                                      precision="double")
+        dsig = signature_for(TransformType.C2C, *dims, trip,
+                             precision="double", device_count=shards)
+        registry.put(dsig, dplan)
+    if registry.store is not None:
+        # flush async spills (incl. remote blob puts) before the port
+        # announcement: a joiner that boots next must find them
+        registry.store.drain()
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    from ..serve.registry import PlanRegistry
+
+    ap = argparse.ArgumentParser(
+        prog="python -m spfft_tpu.net.agent",
+        description="Run one pod host: a ServeExecutor behind a "
+                    "framed-TCP HostAgent.")
+    ap.add_argument("--host", required=True,
+                    help="this lane's host name in the pod")
+    ap.add_argument("--bind", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0,
+                    help="0 = ephemeral (announced on stdout)")
+    ap.add_argument("--store", default="",
+                    help="plan-artifact store root (disk tier)")
+    ap.add_argument("--blob", default="",
+                    help="remote blob tier: http:// URL or shared "
+                         "directory")
+    ap.add_argument("--manifest", default="",
+                    help="warmup manifest to boot from")
+    ap.add_argument("--demo-warm", default="",
+                    help="N,CUTOFF,SHARDS[,MODE] demo plan set "
+                         "(MODE=full|dist)")
+    ap.add_argument("--trace", action="store_true",
+                    help="enable tracing at sample rate 1.0")
+    args = ap.parse_args(argv)
+
+    if args.blob:
+        global_config().set_path("blob_store_url", args.blob)
+    if args.trace:
+        _obs.enable()
+        _obs.GLOBAL_TRACER.set_sample_rate(1.0)
+
+    registry = PlanRegistry(store=(args.store or False))
+    if args.manifest:
+        registry.warmup_manifest(args.manifest, compile=True)
+    if args.demo_warm:
+        _demo_warm(registry, args.demo_warm)
+    executor = ServeExecutor(registry)
+    agent = HostAgent(args.host, executor, bind=args.bind,
+                      port=args.port).start()
+    print(json.dumps({"agent": args.host, "port": agent.port}),
+          flush=True)
+    try:
+        agent.closing.wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        agent.close()
+        try:
+            executor.close(drain=False)
+        except Exception:
+            pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,223 @@
+"""The pod's framed wire protocol.
+
+One frame carries one typed record each way:
+
+.. code-block:: text
+
+    +-------+---------+------------+-------------+--------+---------+
+    | MAGIC | VERSION | HEADER_LEN | PAYLOAD_LEN | HEADER | PAYLOAD |
+    |  4 B  |   1 B   |  4 B (BE)  |  8 B (BE)   |  JSON  |  bytes  |
+    +-------+---------+------------+-------------+--------+---------+
+
+The header is a JSON object whose ``"type"`` field names the record
+(``submit``/``signals``/``plan``/... requests, ``result``/``*_ok``/
+``error`` responses); the payload is opaque bytes — for transform
+values an ``np.savez`` archive (:func:`pack_values` /
+:func:`unpack_values`), empty otherwise. Anything malformed — bad
+magic, version skew, truncated read, non-JSON header — raises the
+typed, transient :class:`~spfft_tpu.errors.NetProtocolError`; the
+transport translates it into the ``HostLaneError`` the frontend's
+route-around handling keys on.
+
+Cross-host identity rides the header: ``PlanSignature`` as its
+``dataclasses.asdict`` form (all plain str/int fields — JSON
+round-trips it exactly), ``obs.TraceContext`` as its ``to_wire`` dict
+(one trace id end-to-end), and failures as ``{"type": "error",
+"error_type": <class name>, "message": ...}`` records that
+:func:`error_from_wire` maps back onto the typed taxonomy — a remote
+``QueueFullError`` re-raises as ``QueueFullError``, never as a string.
+
+Fault sites: ``net.frame`` fires on each encode/decode, ``net.send``
+on the socket send, ``net.recv`` on every socket read (a firing check
+is a dropped or truncated frame mid-flight).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import json
+import struct
+from typing import List, Optional, Tuple, Union
+
+import numpy as np
+
+from .. import faults as _faults
+from .. import obs as _obs
+from ..errors import GenericError, NetProtocolError
+from ..serve.registry import PlanSignature
+
+MAGIC = b"SPFN"
+FRAME_VERSION = 1
+
+#: Preamble layout: magic, version, header length, payload length.
+_PREAMBLE = struct.Struct(">4sBIQ")
+
+#: Sanity caps a hostile/corrupt preamble cannot exceed (a truncated
+#: length field must reject, not allocate gigabytes).
+MAX_HEADER_BYTES = 1 << 22
+MAX_PAYLOAD_BYTES = 1 << 33
+
+_RECV_CHUNK = 1 << 16
+
+
+def send_frame(sock, header: dict, payload: bytes = b"") -> None:
+    """Encode and send one frame. Socket errors propagate as
+    ``OSError`` (the transport classifies them); a header that cannot
+    serialize is a :class:`NetProtocolError`."""
+    _faults.check_site("net.frame")
+    try:
+        hbytes = json.dumps(header).encode("utf-8")
+    except (TypeError, ValueError) as exc:
+        raise NetProtocolError(
+            f"frame header is not JSON-serializable: {exc}") from exc
+    data = b"".join([
+        _PREAMBLE.pack(MAGIC, FRAME_VERSION, len(hbytes), len(payload)),
+        hbytes, payload])
+    _faults.check_site("net.send")
+    sock.sendall(data)
+    _obs.GLOBAL_COUNTERS.inc("spfft_net_frames_total", dir="send")
+    _obs.GLOBAL_COUNTERS.inc("spfft_net_bytes_total", len(data),
+                             dir="send")
+
+
+def _recv_exact(sock, n: int, what: str) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        _faults.check_site("net.recv")
+        chunk = sock.recv(min(_RECV_CHUNK, n - len(buf)))
+        if not chunk:
+            raise NetProtocolError(
+                f"connection closed mid-frame reading {what} "
+                f"({len(buf)}/{n} bytes)")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def recv_frame(sock, eof_ok: bool = False
+               ) -> Optional[Tuple[dict, bytes]]:
+    """Receive one frame: ``(header, payload)``. A clean EOF before the
+    first byte returns None when ``eof_ok`` (the agent's
+    end-of-connection); everything else malformed raises
+    :class:`NetProtocolError`."""
+    _faults.check_site("net.recv")
+    first = sock.recv(1)
+    if not first:
+        if eof_ok:
+            return None
+        raise NetProtocolError("connection closed before a frame")
+    pre = first + _recv_exact(sock, _PREAMBLE.size - 1,
+                              "frame preamble")
+    magic, version, hlen, plen = _PREAMBLE.unpack(pre)
+    if magic != MAGIC:
+        raise NetProtocolError(f"bad frame magic {magic!r}")
+    if version != FRAME_VERSION:
+        raise NetProtocolError(
+            f"frame version {version} != {FRAME_VERSION} (protocol "
+            f"skew across the pod)")
+    if hlen > MAX_HEADER_BYTES or plen > MAX_PAYLOAD_BYTES:
+        raise NetProtocolError(
+            f"frame lengths implausible (header {hlen}, payload "
+            f"{plen})")
+    hbytes = _recv_exact(sock, hlen, "frame header")
+    payload = _recv_exact(sock, plen, "frame payload") if plen else b""
+    _faults.check_site("net.frame")
+    try:
+        header = json.loads(hbytes)
+    except ValueError as exc:
+        raise NetProtocolError(
+            f"frame header is not JSON: {exc}") from exc
+    if not isinstance(header, dict) or "type" not in header:
+        raise NetProtocolError("frame header lacks a 'type' field")
+    _obs.GLOBAL_COUNTERS.inc("spfft_net_frames_total", dir="recv")
+    _obs.GLOBAL_COUNTERS.inc("spfft_net_bytes_total",
+                             _PREAMBLE.size + hlen + plen, dir="recv")
+    return header, payload
+
+
+# -- array payloads ----------------------------------------------------------
+def pack_values(values: Union[None, np.ndarray, List]
+                ) -> Tuple[dict, bytes]:
+    """``(meta, payload)`` for a transform's values: a single array or
+    a list of per-shard arrays (distributed requests/results), packed
+    as an ``np.savez`` archive. Merge ``meta`` into the frame header;
+    :func:`unpack_values` reverses it."""
+    if values is None:
+        return {"values": "none"}, b""
+    buf = io.BytesIO()
+    if isinstance(values, (list, tuple)):
+        arrays = [np.asarray(v) for v in values]
+        np.savez(buf, **{f"a{i}": a for i, a in enumerate(arrays)})
+        return {"values": "list", "n": len(arrays)}, buf.getvalue()
+    np.savez(buf, a0=np.asarray(values))
+    return {"values": "single", "n": 1}, buf.getvalue()
+
+
+def unpack_values(meta: dict, payload: bytes):
+    """The values packed by :func:`pack_values`, or raise the typed
+    :class:`NetProtocolError` when the archive does not decode."""
+    kind = meta.get("values", "none")
+    if kind == "none":
+        return None
+    if kind not in ("single", "list"):
+        raise NetProtocolError(f"unknown values kind {kind!r}")
+    try:
+        with np.load(io.BytesIO(payload), allow_pickle=False) as z:
+            arrays = [np.asarray(z[f"a{i}"])
+                      for i in range(int(meta.get("n", 1)))]
+    except Exception as exc:
+        raise NetProtocolError(
+            f"array payload failed to decode: {exc!r}") from exc
+    return arrays if kind == "list" else arrays[0]
+
+
+# -- signatures --------------------------------------------------------------
+def signature_to_wire(sig: PlanSignature) -> dict:
+    """``PlanSignature`` -> plain dict (all fields str/int, so JSON
+    round-trips it losslessly)."""
+    return dataclasses.asdict(sig)
+
+
+def signature_from_wire(payload: dict) -> PlanSignature:
+    try:
+        return PlanSignature(**payload)
+    except TypeError as exc:
+        raise NetProtocolError(
+            f"malformed wire signature: {exc}") from exc
+
+
+# -- typed errors over the wire ----------------------------------------------
+#: Non-package types :func:`error_from_wire` restores exactly — the
+#: request-shaped builtins ``faults.REQUEST_ERROR_TYPES`` classifies.
+_WIRE_BUILTINS = {t.__name__: t for t in
+                  (TypeError, ValueError, IndexError, KeyError,
+                   TimeoutError)}
+
+
+def error_to_wire(exc: BaseException) -> dict:
+    """The error-record header for one failure (the agent's reply when
+    a handler raises)."""
+    return {"type": "error", "error_type": type(exc).__name__,
+            "message": str(exc)}
+
+
+def error_from_wire(header: dict) -> BaseException:
+    """An exception INSTANCE for an error record, mapped back onto the
+    typed taxonomy: an ``errors.py`` class by name, a request-shaped
+    builtin, or ``GenericError`` for anything unknown (still typed —
+    a remote failure never surfaces as a bare string or a raw
+    foreign type)."""
+    from .. import errors as _errors
+    name = str(header.get("error_type", ""))
+    message = str(header.get("message", ""))
+    cls = getattr(_errors, name, None)
+    if cls is None:
+        cls = getattr(_faults, name, None)
+    if isinstance(cls, type) and issubclass(cls, GenericError):
+        try:
+            return cls(message)
+        except Exception:  # an exotic constructor signature
+            return GenericError(f"{name}: {message}")
+    if name in _WIRE_BUILTINS:
+        return _WIRE_BUILTINS[name](message)
+    return GenericError(f"remote {name or 'failure'}: {message}")

@@ -1,0 +1,334 @@
+"""The client side of the pod's wire: :class:`TcpTransport` +
+:class:`TcpHostLane`.
+
+``serve.cluster.HostLane`` is the five-RPC host boundary against an
+in-process executor; :class:`TcpHostLane` is the same surface with the
+executor on the far side of a socket — the frontend cannot tell them
+apart (``PodFrontend`` routes, reconciles, federates and fails over
+identically), which is the whole point of the seam.
+
+Each RPC opens one connection, sends one framed request and reads one
+framed response (:mod:`~spfft_tpu.net.frame`). Connection/read
+failures, protocol violations and injected ``cluster.rpc``/``net.*``
+faults all translate into the typed, transient ``HostLaneError`` the
+frontend's route-around handling keys on; a typed ``error`` record in
+the response re-raises as its original taxonomy class (a remote
+``QueueFullError`` stays backpressure, not lane death).
+
+The transport measures each successful round trip into an EWMA
+(:attr:`TcpTransport.rtt`, exported as
+``spfft_net_rpc_rtt_seconds{host}``) and :meth:`TcpHostLane.rpc_signals`
+merges it into the host's signal snapshot as ``wire_rtt`` — the third
+term of ``serve.cluster.load_score``, so a far-away host really does
+score busier than a near one at equal queue depth.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import List, Optional, Tuple
+
+from .. import obs as _obs
+from ..control.config import global_config
+from ..errors import HostLaneError, NetProtocolError
+from ..faults import InjectedFault
+from ..serve.cluster import HostLane, LoopbackTransport
+from ..serve.registry import PlanSignature
+from ..types import Scaling
+from .frame import (error_from_wire, pack_values, recv_frame,
+                    send_frame, signature_from_wire, signature_to_wire,
+                    unpack_values)
+
+#: EWMA weight of the newest round-trip sample.
+_RTT_ALPHA = 0.2
+
+
+def _ctx_to_wire(ctx) -> Optional[dict]:
+    """Trace context → frame-header form (None stays None)."""
+    return None if ctx is None else ctx.to_wire()
+
+
+class TcpTransport(LoopbackTransport):
+    """The wire twin of ``LoopbackTransport``: same ``check`` seam
+    (liveness + the ``cluster.rpc`` fault site), plus :meth:`call` —
+    one framed request/response round trip with its latency folded
+    into :attr:`rtt`. Timeouts resolve through the control plane's
+    ``net_connect_timeout_ms`` / ``net_rpc_timeout_ms`` knobs unless
+    given explicitly (seconds)."""
+
+    def __init__(self, host: str, address: Tuple[str, int],
+                 connect_timeout: Optional[float] = None,
+                 rpc_timeout: Optional[float] = None):
+        super().__init__(host)
+        self.address = (str(address[0]), int(address[1]))
+        cfg = global_config()
+        self._connect_timeout = (
+            float(connect_timeout) if connect_timeout is not None
+            else cfg.net_connect_timeout_ms / 1000.0)
+        self._rpc_timeout = (
+            float(rpc_timeout) if rpc_timeout is not None
+            else cfg.net_rpc_timeout_ms / 1000.0)
+        self._rtt_lock = threading.Lock()
+        self._rtt = 0.0  #: guarded by _rtt_lock
+
+    @property
+    def rtt(self) -> float:
+        """EWMA of successful RPC round trips (seconds); 0.0 until the
+        first completes."""
+        with self._rtt_lock:
+            return self._rtt
+
+    def _fail(self, op: str, exc: BaseException) -> HostLaneError:
+        _obs.GLOBAL_COUNTERS.inc("spfft_cluster_rpc_failures_total",
+                                 host=self.host, op=op)
+        return HostLaneError(
+            f"host lane {self.host!r} wire RPC {op!r} to "
+            f"{self.address} failed: {exc}", host=self.host)
+
+    def start_call(self, header: dict, payload: bytes = b"",
+                   timeout: Optional[float] = None):
+        """The SYNCHRONOUS half of an RPC: connect and send the request
+        frame, returning ``(sock, op, t0)`` for :meth:`finish_call`.
+        Kept separate so a submit surfaces a dead host HERE — at
+        routing time, where the frontend can fail over — not later in
+        a background future. Connect/send failures raise the transient
+        :class:`HostLaneError`."""
+        op = str(header.get("type", "?"))
+        t0 = time.monotonic()
+        try:
+            sock = socket.create_connection(
+                self.address, timeout=self._connect_timeout)
+        except (OSError, InjectedFault) as exc:
+            raise self._fail(op, exc) from exc
+        try:
+            sock.settimeout(timeout if timeout is not None
+                            else self._rpc_timeout)
+            send_frame(sock, header, payload)
+        except (OSError, NetProtocolError, InjectedFault) as exc:
+            sock.close()
+            raise self._fail(op, exc) from exc
+        return sock, op, t0
+
+    def finish_call(self, sock, op: str,
+                    t0: float) -> Tuple[dict, bytes]:
+        """The (possibly deferred) second half: read the response
+        frame, fold the measured round trip into :attr:`rtt`, and
+        re-raise a typed ``error`` record as its original taxonomy
+        class."""
+        try:
+            try:
+                reply, rpayload = recv_frame(sock)
+            finally:
+                sock.close()
+        except (OSError, NetProtocolError, InjectedFault) as exc:
+            raise self._fail(op, exc) from exc
+        dt = time.monotonic() - t0
+        with self._rtt_lock:
+            self._rtt = dt if self._rtt <= 0.0 \
+                else (1.0 - _RTT_ALPHA) * self._rtt + _RTT_ALPHA * dt
+            rtt = self._rtt
+        _obs.GLOBAL_COUNTERS.set("spfft_net_rpc_rtt_seconds", rtt,
+                                 host=self.host)
+        if reply.get("type") == "error":
+            raise error_from_wire(reply)
+        return reply, rpayload
+
+    def call(self, header: dict, payload: bytes = b"",
+             timeout: Optional[float] = None) -> Tuple[dict, bytes]:
+        """One full request/response round trip (both halves,
+        blocking)."""
+        sock, op, t0 = self.start_call(header, payload, timeout)
+        return self.finish_call(sock, op, t0)
+
+
+class TcpHostLane(HostLane):
+    """A ``HostLane`` whose executor lives in another process behind a
+    :class:`HostAgent`. ``executor`` is None — every ``rpc_*`` crosses
+    the wire; a small thread pool makes :meth:`rpc_submit` return a
+    ``Future`` immediately (the frontend's submit path stays
+    non-blocking) while the round trip completes in the background."""
+
+    def __init__(self, host: str, address: Tuple[str, int],
+                 connect_timeout: Optional[float] = None,
+                 rpc_timeout: Optional[float] = None,
+                 max_inflight: int = 8):
+        self.host = host
+        self.executor = None
+        self.draining = False
+        self.transport = TcpTransport(host, address,
+                                      connect_timeout=connect_timeout,
+                                      rpc_timeout=rpc_timeout)
+        self._pool = ThreadPoolExecutor(
+            max_workers=max_inflight,
+            thread_name_prefix=f"spfft-net-{host}")
+
+    # trace: boundary(ctx)
+    def rpc_submit(self, signature: PlanSignature, values,
+                   kind: str = "backward",
+                   scaling: Scaling = Scaling.NONE,
+                   timeout: Optional[float] = None,
+                   priority: str = "normal", ctx=None) -> Future:
+        """Submit one request over the wire. The propagated trace
+        context rides the frame header, so the agent's ``serve.request``
+        root carries the frontend's trace id — one id end-to-end across
+        the process boundary. Connect + send run synchronously (a
+        ``kill -9``'d host raises ``HostLaneError`` HERE, where the
+        frontend fails over); only the response read is deferred to the
+        lane's pool."""
+        self.transport.check("submit")
+        meta, payload = pack_values(values)
+        header = {"type": "submit",
+                  "signature": signature_to_wire(signature),
+                  "kind": kind, "scaling": Scaling(scaling).value,
+                  "timeout": timeout, "priority": priority,
+                  "ctx": _ctx_to_wire(ctx),
+                  **meta}
+        wire_timeout = None if timeout is None \
+            else timeout + self.transport._rpc_timeout
+        sock, op, t0 = self.transport.start_call(header, payload,
+                                                 timeout=wire_timeout)
+        return self._pool.submit(self._wire_finish, sock, op, t0)
+
+    def _wire_finish(self, sock, op, t0):
+        reply, rpayload = self.transport.finish_call(sock, op, t0)
+        return unpack_values(reply, rpayload)
+
+    def rpc_signals(self) -> dict:
+        self.transport.check("signals")
+        reply, _ = self.transport.call({"type": "signals"})
+        signals = dict(reply.get("signals") or {})
+        # the wire's contribution to load_score: a far host at equal
+        # queue depth really is the slower choice
+        signals["wire_rtt"] = self.transport.rtt
+        return signals
+
+    def rpc_signatures(self) -> List[PlanSignature]:
+        self.transport.check("signatures")
+        reply, _ = self.transport.call({"type": "signatures"})
+        return [signature_from_wire(d)
+                for d in reply.get("signatures", [])]
+
+    def rpc_plan(self, signature: PlanSignature):
+        """A remote PLAN DESCRIPTOR (the plan object itself never
+        crosses the wire): ``{"remote": True, "distributed": bool,
+        "fingerprint": hex|None}``, or None when unheld. The frontend
+        routes and reconciles from the descriptor."""
+        self.transport.check("plan")
+        reply, _ = self.transport.call(
+            {"type": "plan", "signature": signature_to_wire(signature)})
+        if not reply.get("held"):
+            return None
+        return {"remote": True,
+                "distributed": bool(reply.get("distributed")),
+                "fingerprint": reply.get("fingerprint")}
+
+    def rpc_metrics_text(self) -> str:
+        self.transport.check("metrics")
+        reply, _ = self.transport.call({"type": "metrics"})
+        return str(reply.get("text", ""))
+
+    def rpc_health(self) -> dict:
+        self.transport.check("health")
+        reply, _ = self.transport.call({"type": "health"})
+        return dict(reply.get("health") or {})
+
+    def rpc_prewarm(self, signatures, strict: bool = True) -> int:
+        self.transport.check("prewarm")
+        reply, _ = self.transport.call(
+            {"type": "prewarm",
+             "signatures": [signature_to_wire(s) for s in signatures],
+             "strict": bool(strict)})
+        return int(reply.get("warmed", 0))
+
+    def rpc_drain(self) -> None:
+        self.transport.check("drain")
+        self.transport.call({"type": "drain"})
+
+    def rpc_shutdown(self) -> None:
+        self.transport.check("shutdown")
+        self.transport.call({"type": "shutdown"})
+
+    def rpc_stats(self) -> dict:
+        """The remote registry's ``stats()`` — the warm-boot observable
+        (``builds == 0`` after a remote-tier prewarm)."""
+        self.transport.check("stats")
+        reply, _ = self.transport.call({"type": "stats"})
+        return dict(reply.get("registry") or {})
+
+    def rpc_spans(self) -> dict:
+        """The agent's completed-span summaries + open count — how a
+        smoke asserts one trace id crossed the process boundary and
+        nothing leaked."""
+        self.transport.check("spans")
+        reply, _ = self.transport.call({"type": "spans"})
+        return {"spans": list(reply.get("spans", [])),
+                "open": int(reply.get("open", 0))}
+
+    def close(self) -> None:
+        """Release the lane's client thread pool (the remote agent is
+        NOT shut down — lanes don't own hosts)."""
+        self._pool.shutdown(wait=True)
+
+
+def wire_overhead_probe(repeats: int = 24, n: int = 8) -> dict:
+    """Measure what the wire costs: median ``rpc_submit`` round trip of
+    a tiny C2C backward through a loopback lane vs through an
+    in-process TCP agent fronting the SAME executor. Returns
+    microsecond medians plus the delta — the ``pod_wire`` bench
+    sub-row. Both paths are warmed (JIT + connection machinery) before
+    timing so the medians compare steady-state transports, not compile
+    time."""
+    import statistics
+
+    import numpy as np
+
+    from ..benchmark import cutoff_stick_triplets
+    from ..serve.executor import ServeExecutor
+    from ..serve.registry import PlanRegistry
+    from ..types import TransformType
+    from .agent import HostAgent
+
+    trip = cutoff_stick_triplets(n, n, n, 0.9, hermitian=False)
+    reg = PlanRegistry()
+    sig, _plan = reg.get_or_build(TransformType.C2C, n, n, n, trip,
+                                  precision="double")
+    executor = ServeExecutor(reg)
+    rng = np.random.default_rng(7)
+    v = rng.standard_normal(len(trip)) \
+        + 1j * rng.standard_normal(len(trip))
+
+    def timed(lane) -> float:
+        for _ in range(3):  # warm the JIT + transport path
+            lane.rpc_submit(sig, v, ctx=None).result(timeout=120)
+        samples = []
+        for _ in range(repeats):
+            t0 = time.monotonic()
+            lane.rpc_submit(sig, v, ctx=None).result(timeout=120)
+            samples.append(time.monotonic() - t0)
+        return statistics.median(samples)
+
+    agent = None
+    tcp_lane = None
+    try:
+        loop_lane = HostLane("probe-loop", executor)
+        loop_s = timed(loop_lane)
+        agent = HostAgent("probe-tcp", executor)
+        agent.start()
+        tcp_lane = TcpHostLane("probe-tcp",
+                               ("127.0.0.1", agent.port))
+        tcp_s = timed(tcp_lane)
+    finally:
+        if tcp_lane is not None:
+            tcp_lane.close()
+        if agent is not None:
+            agent.close()
+        executor.close(drain=False)
+    return {
+        "repeats": int(repeats),
+        "loopback_us": loop_s * 1e6,
+        "tcp_us": tcp_s * 1e6,
+        "overhead_us": max(0.0, (tcp_s - loop_s) * 1e6),
+    }

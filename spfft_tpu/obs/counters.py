@@ -291,6 +291,36 @@ METRIC_SPECS: Dict[str, Tuple[str, str]] = {
         ("counter",
          "Bucket materialisations that exceeded execute_timeout_ms "
          "and were failed as typed transient ExecuteTimeoutError."),
+    # wire transport + elastic membership + remote artifact tier (net/)
+    "spfft_cluster_membership_total":
+        ("counter",
+         "Pod membership transitions, labelled {event="
+         "join_started|prewarmed|reconciled|joined|join_failed|"
+         "leave_started|drained|left}."),
+    "spfft_cluster_spmd_rejected_total":
+        ("counter",
+         "SPMD-lane submissions refused by admission control, "
+         "labelled {reason=queue_full|expired}."),
+    "spfft_net_frames_total":
+        ("counter", "Wire frames moved, labelled {dir=send|recv}."),
+    "spfft_net_bytes_total":
+        ("counter",
+         "Wire bytes moved (preamble+header+payload), labelled "
+         "{dir=send|recv}."),
+    "spfft_net_rpc_rtt_seconds":
+        ("gauge",
+         "EWMA round-trip latency of each host lane's wire RPCs — "
+         "the third load_score term, labelled {host}."),
+    "spfft_net_agent_requests_total":
+        ("counter", "Requests a HostAgent served, labelled {op}."),
+    "spfft_blob_ops_total":
+        ("counter",
+         "Remote blob-tier operations, labelled {op=get|put, "
+         "outcome=hit|miss|ok|error}."),
+    "spfft_store_remote_total":
+        ("counter",
+         "Plan-artifact store remote-tier outcomes, labelled "
+         "{op=get|put, outcome=hit|miss|ok|error}."),
 }
 
 

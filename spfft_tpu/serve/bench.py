@@ -1065,20 +1065,167 @@ def _run_chaos(args) -> int:
             shutil.rmtree(tmp, ignore_errors=True)
         storm_log.append(outcome)
 
+    # -- phase E: wire + blob storms over a live TCP agent -------------
+    # The same seeded-storm discipline pointed at the pod's wire. One
+    # in-process HostAgent serves every storm over real localhost
+    # sockets; client and agent threads share the ambient plan, so the
+    # ``net.*`` sites fire on BOTH ends — dropped/truncated frames,
+    # refused accepts, mid-RPC socket death. Each storm also boots a
+    # cold artifact store off a faulted remote blob tier. Invariants:
+    # every wire failure is TYPED (``HostLaneError`` or a taxonomy
+    # error off the error frame), zero hangs, a clean post-disarm
+    # request is bit-exact, zero open spans — and blob faults stay
+    # CONTAINED (the remote tier is best-effort: they become
+    # ``spfft_store_remote_total{outcome="error"}`` counts, never a
+    # request failure).
+    from ..net.agent import HostAgent
+    from ..net.blobstore import FileBlobStore
+    from ..net.transport import TcpHostLane
+
+    net_menu = (
+        ("net.frame", "net", ("transient",)),
+        ("net.send", "net", ("transient",)),
+        ("net.recv", "net", ("transient", "hang")),
+        ("net.accept", "net", ("transient",)),
+        ("cluster.rpc", "cluster", ("transient",)),
+        ("blob.get", "blob", ("transient",)),
+        ("blob.put", "blob", ("transient",)),
+    )
+    subsystem_of.update({site: sub for site, sub, _ in net_menu})
+    agent_reg = PlanRegistry(store=False)
+    agent_reg.put(osig, oplan)
+    agent_ex = ServeExecutor(agent_reg)
+    agent = HostAgent("chaos-h0", agent_ex).start()
+    blob_tmp = tempfile.mkdtemp(prefix="spfft-chaos-blob-")
+    wire_storms = len(net_menu) + 1
+    try:
+        blob = FileBlobStore(blob_tmp)
+        # seed the blob tier once, clean, so storm-time gets find a
+        # real artifact behind the faulted fetch path
+        seed_tmp = tempfile.mkdtemp(prefix="spfft-chaos-seed-")
+        try:
+            seed_store = PlanArtifactStore(seed_tmp, remote=blob)
+            seed_store.save_plan(osig, oplan, trip)
+            seed_store.drain()
+        finally:
+            shutil.rmtree(seed_tmp, ignore_errors=True)
+        for storm in range(wire_storms):
+            site, _, kinds = net_menu[storm % len(net_menu)]
+            kind = kinds[int(rng.integers(len(kinds)))]
+            nth = 1 if site.startswith("blob") \
+                else int(rng.integers(1, 4))
+            script = [f"{site}@{nth}:{kind}"]
+            if rng.random() < 0.5:
+                extra = net_menu[int(rng.integers(len(net_menu)))]
+                if extra[0] != site:
+                    script.append(f"{extra[0]}@1:{extra[2][0]}")
+            plan_f = FaultPlan(script=script, hang_seconds=0.2)
+            good = [vals() for _ in range(4)]
+            oracles = [np.asarray(oplan.backward(w)) for w in good]
+            obs.GLOBAL_TRACER.reset()
+            outcome = {"script": script, "served": 0,
+                       "typed_failures": 0, "wire": True}
+            lane = TcpHostLane("chaos-h0", ("127.0.0.1", agent.port))
+            boot_tmp = tempfile.mkdtemp(prefix="spfft-chaos-boot-")
+            try:
+                faults.arm(plan_f)
+                futs = []
+                for w in good:
+                    try:
+                        futs.append(lane.rpc_submit(osig, w,
+                                                    ctx=None))
+                    except typed:
+                        outcome["typed_failures"] += 1
+                        futs.append(None)
+                    except Exception as exc:
+                        check(False,
+                              f"wire storm {storm} {script}: submit "
+                              f"failed UNTYPED "
+                              f"{type(exc).__name__}: {exc}")
+                        futs.append(None)
+                for i, (f, expect) in enumerate(zip(futs, oracles)):
+                    if f is None:
+                        continue
+                    try:
+                        got = f.result(timeout=60)
+                    except cf.TimeoutError:
+                        check(False, f"wire storm {storm} {script}: "
+                                     f"request {i} HUNG")
+                    except typed:
+                        outcome["typed_failures"] += 1
+                    except Exception as exc:
+                        check(False,
+                              f"wire storm {storm} {script}: request "
+                              f"{i} failed UNTYPED "
+                              f"{type(exc).__name__}: {exc}")
+                    else:
+                        outcome["served"] += 1
+                        check(np.array_equal(np.asarray(got), expect),
+                              f"wire storm {storm} {script}: request "
+                              f"{i} diverged from the serial oracle")
+                # cold boot off the faulted blob tier: contained, typed
+                try:
+                    boot_reg = PlanRegistry(
+                        store=PlanArtifactStore(boot_tmp, remote=blob))
+                    outcome["boot_warmed"] = \
+                        boot_reg.prewarm_signatures([osig],
+                                                    strict=False)
+                    boot_reg.store.save_plan(osig, oplan, trip)
+                    boot_reg.store.drain()
+                except Exception as exc:
+                    check(False,
+                          f"wire storm {storm} {script}: blob-tier "
+                          f"fault ESCAPED the best-effort seam as "
+                          f"{type(exc).__name__}: {exc}")
+                faults.disarm()
+                # the wire heals: a clean request through the same
+                # lane lands bit-exact
+                w = vals()
+                got = np.asarray(
+                    lane.rpc_submit(osig, w, ctx=None)
+                    .result(timeout=60))
+                check(np.array_equal(got,
+                                     np.asarray(oplan.backward(w))),
+                      f"wire storm {storm} {script}: post-disarm "
+                      f"request not bit-exact")
+                spans_closed(f"wire storm {storm} {script}")
+                tally(plan_f)
+            finally:
+                faults.disarm()
+                lane.close()
+                shutil.rmtree(boot_tmp, ignore_errors=True)
+            storm_log.append(outcome)
+    finally:
+        faults.disarm()
+        agent.close()
+        agent_ex.close(drain=False)
+        shutil.rmtree(blob_tmp, ignore_errors=True)
+    phases["E_wire_blob_storms"] = {
+        "storms": wire_storms,
+        "served": sum(o["served"] for o in storm_log
+                      if o.get("wire")),
+        "typed_failures": sum(o["typed_failures"] for o in storm_log
+                              if o.get("wire")),
+    }
+    spans_closed("phaseE")
+
     subsystems = sorted({subsystem_of[s] for s in fired_sites
                          if s in subsystem_of}
                         | ({"kernel"} if "kernel.launch" in fired_sites
                            else set()))
-    check(len(fired_sites) >= 8,
+    check(len(fired_sites) >= 12,
           f"chaos coverage: only {len(fired_sites)} fault sites fired "
           f"({sorted(fired_sites)})")
-    check(len(subsystems) >= 4,
+    check(len(subsystems) >= 6,
           f"chaos coverage: only {len(subsystems)} subsystems hit "
+          f"({subsystems})")
+    check({"net", "blob"} <= set(subsystems),
+          f"chaos coverage: wire subsystems not exercised "
           f"({subsystems})")
 
     ok = not failures
-    print(f"chaos: seed={seed} storms={storms} wave={wave} "
-          f"precision={args.precision}")
+    print(f"chaos: seed={seed} storms={storms}+{wire_storms} wire "
+          f"wave={wave} precision={args.precision}")
     for name, p in phases.items():
         print(f"  {name}: {p}")
     print(f"  sites fired ({len(fired_sites)}): "
@@ -1087,8 +1234,9 @@ def _run_chaos(args) -> int:
     for msg in failures:
         print(f"FAIL: {msg}", file=sys.stderr)
     result = {
-        "metric": f"serve.bench --chaos (4 ladders + {storms} seeded "
-                  f"storms over {len(fired_sites)} fault sites)",
+        "metric": f"serve.bench --chaos (5 ladders + {storms} seeded "
+                  f"storms + {wire_storms} wire storms over "
+                  f"{len(fired_sites)} fault sites)",
         "value": 1 if ok else 0,
         "unit": "ok",
         "chaos": True,

@@ -49,6 +49,7 @@ from __future__ import annotations
 
 import random
 import threading
+import time
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -57,8 +58,9 @@ import numpy as np
 from .. import faults as _faults
 from .. import obs as _obs
 from ..errors import (ClusterError, ClusterReconciliationError,
-                      HostLaneError, InvalidParameterError,
-                      ParameterMismatchError)
+                      DeadlineExpiredError, HostLaneError,
+                      InvalidParameterError, ParameterMismatchError,
+                      QueueFullError)
 from ..faults import InjectedFault
 from ..obs.counters import METRIC_SPECS
 from ..obs.exporters import _PromBuilder, parse_prometheus_text, \
@@ -81,12 +83,16 @@ _PRIORITIES = ("normal", "high")
 def load_score(signals: dict) -> Tuple[float, float, float]:
     """The routing load of one host from its live
     ``ServeMetrics.signals()``: expected queue drain time (queue depth x
-    device-execute p50), tie-broken by raw depth then raw p50. Small is
-    idle. A host with no execute history yet scores by depth alone —
-    two cold hosts compare equal and the sampler's order decides."""
+    device-execute p50) plus the measured wire round-trip to reach the
+    host (``wire_rtt``, merged in by ``net.TcpHostLane.rpc_signals``;
+    0 for in-process lanes), tie-broken by raw depth then raw p50.
+    Small is idle. A host with no execute history yet scores by wire
+    distance and depth alone — two cold in-process hosts compare equal
+    and the sampler's order decides."""
     depth = float(signals.get("queue_depth", 0) or 0)
     dx50 = float(signals.get("device_execute_p50", 0.0) or 0.0)
-    return (depth * max(dx50, 1e-6), depth, dx50)
+    rtt = float(signals.get("wire_rtt", 0.0) or 0.0)
+    return (depth * max(dx50, 1e-6) + rtt, depth, dx50)
 
 
 class LoopbackTransport:
@@ -133,6 +139,9 @@ class HostLane:
         self.host = host
         self.executor = executor
         self.transport = transport or LoopbackTransport(host)
+        # set by PodFrontend.leave(): a draining lane finishes its
+        # queue but receives no new routes
+        self.draining = False
 
     @property
     def alive(self) -> bool:
@@ -179,6 +188,25 @@ class HostLane:
         self.transport.check("health")
         return self.executor.health()
 
+    def rpc_prewarm(self, signatures, strict: bool = True) -> int:
+        """Pull a signature set warm through this host's artifact
+        tiers — the joining-lane half of elastic membership."""
+        self.transport.check("prewarm")
+        return self.executor.registry.prewarm_signatures(
+            list(signatures), strict=strict)
+
+    def rpc_drain(self) -> None:
+        """Drain this host's queue to completion — the leaving-lane
+        half of elastic membership."""
+        self.transport.check("drain")
+        self.executor.close(drain=True)
+
+    def rpc_stats(self) -> dict:
+        """This host's registry ``stats()`` (the warm-boot
+        observable)."""
+        self.transport.check("stats")
+        return self.executor.registry.stats()
+
 
 class _SPMDLane:
     """The pod-wide distributed lane: executes
@@ -194,6 +222,7 @@ class _SPMDLane:
             max_workers=max_workers, thread_name_prefix="spfft-pod-spmd")
         self._lock = threading.Lock()
         self._locks: Dict[PlanSignature, threading.Lock] = {}  #: guarded by _lock
+        self._depth = 0  #: guarded by _lock
 
     def _lock_for(self, signature: PlanSignature) -> threading.Lock:
         with self._lock:
@@ -203,20 +232,51 @@ class _SPMDLane:
             return lock
 
     def submit(self, signature: PlanSignature, plan, values, kind: str,
-               scaling: Scaling, root) -> Future:
+               scaling: Scaling, root,
+               timeout: Optional[float] = None) -> Future:
+        """Admission-controlled enqueue: the lane's queue is bounded by
+        the control plane's ``max_queue`` knob (overflow is the same
+        typed ``QueueFullError`` backpressure the single-host executor
+        answers), and a request carrying a deadline that expires while
+        queued is purged as ``DeadlineExpiredError`` instead of burning
+        the whole mesh on an answer nobody awaits."""
+        from ..control.config import global_config
+        cap = int(global_config().max_queue)
+        with self._lock:
+            if self._depth >= cap:
+                _obs.GLOBAL_COUNTERS.inc(
+                    "spfft_cluster_spmd_rejected_total",
+                    reason="queue_full")
+                raise QueueFullError(
+                    f"pod SPMD lane queue is full ({cap})")
+            self._depth += 1
+        deadline = None if timeout is None \
+            else time.monotonic() + float(timeout)
         return self._pool.submit(self._run, signature, plan, values,
-                                 kind, scaling, root)
+                                 kind, scaling, root, deadline)
 
-    def _run(self, signature, plan, values, kind, scaling, root):
-        _obs.GLOBAL_COUNTERS.inc("spfft_cluster_spmd_requests_total")
-        if root is not None and _obs.active():
-            with _obs.GLOBAL_TRACER.span(
-                    "cluster.spmd_execute", trace_id=root.trace_id,
-                    parent=root, track="pod:spmd",
-                    args={"kind": kind}):
-                return self._execute(signature, plan, values, kind,
-                                     scaling)
-        return self._execute(signature, plan, values, kind, scaling)
+    def _run(self, signature, plan, values, kind, scaling, root,
+             deadline):
+        try:
+            _obs.GLOBAL_COUNTERS.inc("spfft_cluster_spmd_requests_total")
+            if deadline is not None and time.monotonic() > deadline:
+                _obs.GLOBAL_COUNTERS.inc(
+                    "spfft_cluster_spmd_rejected_total",
+                    reason="expired")
+                raise DeadlineExpiredError(
+                    "distributed request deadline expired in the SPMD "
+                    "lane queue")
+            if root is not None and _obs.active():
+                with _obs.GLOBAL_TRACER.span(
+                        "cluster.spmd_execute", trace_id=root.trace_id,
+                        parent=root, track="pod:spmd",
+                        args={"kind": kind}):
+                    return self._execute(signature, plan, values, kind,
+                                         scaling)
+            return self._execute(signature, plan, values, kind, scaling)
+        finally:
+            with self._lock:
+                self._depth -= 1
 
     def _execute(self, signature, plan, values, kind, scaling):
         with self._lock_for(signature):
@@ -303,6 +363,11 @@ class PodFrontend:
                            if p is None]
                 raise ClusterReconciliationError(
                     f"host(s) {missing} no longer hold {sig}")
+            if any(isinstance(p, dict) for p in plans):
+                # at least one remote lane: plans never cross the wire,
+                # so agreement reduces to descriptor rows
+                self._reconcile_descriptors(sig, lanes, plans)
+                continue
             if isinstance(plans[0], TransformPlan):
                 continue  # local plans: signature equality IS the digest
             rows = [np.frombuffer(plan_fingerprint(p.dist_plan), np.uint8)
@@ -332,6 +397,36 @@ class PodFrontend:
         _obs.GLOBAL_COUNTERS.inc("spfft_cluster_reconciliations_total",
                                  outcome=outcome)
 
+    def _reconcile_descriptors(self, sig, lanes, plans) -> None:
+        """Digest agreement when any lane answers a remote plan
+        DESCRIPTOR (``net.TcpHostLane.rpc_plan``): every lane's answer
+        — descriptor, local single plan, or local distributed plan —
+        reduces to a ``(distributed, fingerprint-hex)`` row and all
+        rows must be identical; the wire analogue of the loopback
+        fingerprint collective."""
+        rows = []
+        for lane, p in zip(lanes, plans):
+            try:
+                _faults.check_site("cluster.reconcile")
+            except InjectedFault as exc:
+                self._count_reconcile("failed")
+                raise ClusterReconciliationError(
+                    f"reconciliation failed on host {lane.host!r}: "
+                    f"{exc}") from exc
+            if isinstance(p, dict):
+                rows.append((bool(p.get("distributed")),
+                             p.get("fingerprint")))
+            elif isinstance(p, TransformPlan):
+                rows.append((False, None))
+            else:
+                rows.append((True, plan_fingerprint(p.dist_plan).hex()))
+        if len(set(rows)) != 1:
+            self._count_reconcile("mismatch")
+            detail = {lane.host: row
+                      for lane, row in zip(lanes, rows)}
+            raise ClusterReconciliationError(
+                f"plan {sig} disagrees across the pod: {detail}")
+
     # -- submission ---------------------------------------------------------
     def submit(self, signature: PlanSignature, values,
                kind: str = "backward",
@@ -358,7 +453,15 @@ class PodFrontend:
                 f"priority must be 'normal' or 'high', got {priority!r}")
         scaling = Scaling(scaling)
         plan = self._resolve_plan(signature)
-        distributed = not isinstance(plan, TransformPlan)
+        # a dict is a remote plan DESCRIPTOR (net.TcpHostLane.rpc_plan
+        # — the plan object itself never crosses the wire): execution
+        # happens host-side, so even a distributed descriptor routes
+        # through the lane path
+        remote = isinstance(plan, dict)
+        if remote:
+            distributed = bool(plan.get("distributed"))
+        else:
+            distributed = not isinstance(plan, TransformPlan)
         root = None
         if _obs.active() and self._tracer.sample():
             # span: closed-by(PodFrontend._settle)
@@ -368,15 +471,17 @@ class PodFrontend:
                 args={"kind": kind,
                       "plan": "distributed" if distributed else "single"})
         try:
-            if distributed:
+            if distributed and not remote:
                 fut = self._spmd.submit(signature, plan, values, kind,
-                                        scaling, root)
+                                        scaling, root, timeout=timeout)
                 _obs.GLOBAL_COUNTERS.inc("spfft_cluster_routed_total",
                                          host="pod", kind="distributed")
             else:
-                fut = self._submit_single(signature, values, kind,
-                                          scaling, timeout, priority,
-                                          _obs.span_context(root))
+                fut = self._submit_single(
+                    signature, values, kind, scaling, timeout, priority,
+                    _obs.span_context(root),
+                    routed_kind="distributed" if distributed
+                    else "single")
         except BaseException as exc:
             self._settle(root, exc)
             raise
@@ -433,7 +538,8 @@ class PodFrontend:
             + (f" (last transport error: {last})" if last else ""))
 
     def _submit_single(self, signature, values, kind, scaling, timeout,
-                       priority, ctx) -> Future:
+                       priority, ctx,
+                       routed_kind: str = "single") -> Future:
         """Pick a host (p2c or rr), fail over across survivors on
         transport errors. Backpressure (``QueueFullError``) and every
         other executor-side error propagate untranslated — routing only
@@ -448,7 +554,7 @@ class PodFrontend:
                 self._mark_dead(lane)
                 continue
             _obs.GLOBAL_COUNTERS.inc("spfft_cluster_routed_total",
-                                     host=lane.host, kind="single")
+                                     host=lane.host, kind=routed_kind)
             return fut
         raise ClusterError(
             "no alive host lanes accepted the request (all transports "
@@ -456,8 +562,9 @@ class PodFrontend:
 
     def _candidates(self) -> List[HostLane]:
         """Lanes in dispatch-preference order: the policy's pick first,
-        then every other alive lane as failover."""
-        alive = [ln for ln in self._lanes if ln.alive]
+        then every other alive, non-draining lane as failover."""
+        alive = [ln for ln in self._lanes
+                 if ln.alive and not ln.draining]
         if len(alive) <= 1:
             return alive
         if self.policy == "rr":
@@ -498,9 +605,98 @@ class PodFrontend:
         for lane in self._lanes:
             if lane.host == host:
                 self._mark_dead(lane)
-                lane.executor.close()
+                if lane.executor is not None:
+                    lane.executor.close()
                 return
         raise InvalidParameterError(f"no lane named {host!r}")
+
+    # -- elastic membership -------------------------------------------------
+    @staticmethod
+    def _count_membership(event: str) -> None:
+        _obs.GLOBAL_COUNTERS.inc("spfft_cluster_membership_total",
+                                 event=event)
+
+    def join(self, lane) -> None:
+        """Admit one lane into the LIVE pod. The joiner prewarms from
+        an incumbent's signature set first (``rpc_prewarm`` resolves
+        every single-device signature through the joiner's artifact
+        tiers — memory, disk, remote blob — with zero builds; the
+        distributed plans it must already have derived, they are never
+        serialized), then an INCREMENTAL re-reconciliation checks the
+        newcomer against one incumbent (the rest of the pod already
+        agrees with it), and only then does the lane start receiving
+        routes. A failed join leaves the membership exactly as it was
+        and raises typed."""
+        if self._closed:
+            raise ClusterError("pod frontend is closed")
+        if not isinstance(lane, HostLane):
+            host, executor = lane
+            lane = HostLane(host, executor)
+        if any(ln.host == lane.host for ln in self._lanes):
+            raise InvalidParameterError(
+                f"host {lane.host!r} is already a pod member")
+        self._count_membership("join_started")
+        base = next(
+            (ln for ln in self._lanes if ln.alive and not ln.draining),
+            None)
+        try:
+            if base is None:
+                raise ClusterError(
+                    "no alive incumbent lane to join against")
+            sigs = base.rpc_signatures()
+            lane.rpc_prewarm(sigs, strict=True)
+            self._count_membership("prewarmed")
+            self._reconcile_join(lane, base, sigs)
+            self._count_membership("reconciled")
+        except Exception:
+            self._count_membership("join_failed")
+            raise
+        self._lanes.append(lane)
+        self._count_membership("joined")
+
+    def _reconcile_join(self, lane: HostLane, base: HostLane,
+                        sigs) -> None:
+        """The incremental half of :meth:`reconcile`: joiner vs one
+        incumbent, signature-set containment plus per-plan descriptor
+        agreement."""
+        held = set(lane.rpc_signatures())
+        missing = [s for s in sigs if s not in held]
+        if missing:
+            self._count_reconcile("mismatch")
+            raise ClusterReconciliationError(
+                f"joining host {lane.host!r} does not hold "
+                f"{missing[:4]} after prewarm")
+        for sig in sorted(sigs, key=repr):
+            pair = [base.rpc_plan(sig), lane.rpc_plan(sig)]
+            if any(p is None for p in pair):
+                self._count_reconcile("mismatch")
+                raise ClusterReconciliationError(
+                    f"{sig} vanished during join reconciliation")
+            self._reconcile_descriptors(sig, [base, lane], pair)
+        self._count_reconcile("ok")
+
+    def leave(self, host: str, drain: bool = True) -> dict:
+        """Remove one lane from the live pod: it stops receiving new
+        routes immediately (``draining``), optionally drains its queue
+        to completion (every accepted future resolves), then leaves the
+        membership."""
+        lane = next((ln for ln in self._lanes if ln.host == host), None)
+        if lane is None:
+            raise InvalidParameterError(f"no lane named {host!r}")
+        self._count_membership("leave_started")
+        lane.draining = True
+        drained = False
+        if drain and lane.alive:
+            try:
+                lane.rpc_drain()
+            except HostLaneError:
+                self._mark_dead(lane)
+            else:
+                drained = True
+                self._count_membership("drained")
+        self._lanes.remove(lane)
+        self._count_membership("left")
+        return {"host": host, "drained": drained}
 
     # -- federated telemetry ------------------------------------------------
     def health(self) -> dict:
@@ -573,11 +769,14 @@ class PodFrontend:
                 continue
             for (name, labels), value in \
                     parse_prometheus_text(text).items():
-                if name.startswith("spfft_cluster_"):
-                    # Pod-level families only ever render once, above:
-                    # in the loopback emulation every lane shares this
-                    # process's counter registry, so its exposition
-                    # already carries them.
+                if name.startswith("spfft_cluster_") \
+                        and lane.executor is not None:
+                    # Pod-level families only render once, above: an
+                    # IN-PROCESS lane shares this process's counter
+                    # registry, so its exposition already carries them.
+                    # A remote lane's (executor is None) are its own
+                    # process's facts and merge host-labelled like
+                    # everything else.
                     continue
                 mtype, help_ = METRIC_SPECS.get(name, ("gauge", name))
                 merged = dict(labels)
@@ -587,13 +786,19 @@ class PodFrontend:
 
     # -- lifecycle ----------------------------------------------------------
     def close(self) -> None:
-        """Close the SPMD lane and every alive lane's executor."""
+        """Close the SPMD lane and every alive lane's executor (remote
+        lanes release their client pool; the agent process they front
+        is not ours to stop)."""
         if self._closed:
             return
         self._closed = True
         self._spmd.close()
         for lane in self._lanes:
-            if lane.alive:
+            if lane.executor is None:
+                close = getattr(lane, "close", None)
+                if close is not None:
+                    close()
+            elif lane.alive:
                 lane.executor.close()
 
     def __enter__(self) -> "PodFrontend":

@@ -557,6 +557,31 @@ class PlanRegistry:
         return self.warmup(payload.get("entries", ()), compile=compile,
                            strict=strict)
 
+    def prewarm_signatures(self, signatures: Iterable[PlanSignature],
+                           strict: bool = True) -> int:
+        """Pull a signature set warm through the read-through tiers
+        (LRU -> disk -> remote blob) BEFORE taking traffic — the
+        joining-lane half of elastic pod membership: the incumbent
+        frontend hands the joiner its live signature set and the joiner
+        resolves every entry it can without building anything. Returns
+        the count now resident. A signature no tier can answer raises
+        :class:`~spfft_tpu.errors.PlanArtifactError` when ``strict``
+        (a lane must not join the pod half-warm); distributed
+        signatures the joiner derives locally (they are never
+        serialized) are the caller's business and simply skip."""
+        from ..errors import PlanArtifactError
+        warmed = 0
+        for sig in signatures:
+            if self.get(sig) is not None:
+                warmed += 1
+                continue
+            if strict and sig.device_count <= 1:
+                raise PlanArtifactError(
+                    f"prewarm cannot resolve {sig!r} from any artifact "
+                    f"tier (see spfft_store_rejects_total / "
+                    f"spfft_blob_ops_total for why)")
+        return warmed
+
     # -- counters ----------------------------------------------------------
     @property
     def bytes_in_use(self) -> int:

@@ -72,7 +72,7 @@ import numpy as np
 
 from .. import faults as _faults
 from .. import obs as _obs
-from ..errors import InvalidParameterError
+from ..errors import BlobStoreError, InvalidParameterError
 from ..indexing import IndexPlan
 from ..plan import PlanTables, TransformPlan, restore_plan
 from ..types import Scaling, TransformType
@@ -82,6 +82,16 @@ from .registry import PlanSignature, index_digest
 #: ``PlanRegistry``); the config's ``plan_store_path`` (settable via
 #: the boot artifact) takes precedence when set.
 PLAN_STORE_ENV = "SPFFT_TPU_PLAN_STORE"
+
+#: Default REMOTE artifact tier (``net/blobstore.py``): an ``http://``
+#: object-store URL or a shared directory. The config's
+#: ``blob_store_url`` path setting takes precedence when set. The
+#: remote tier sits BELOW the disk tier: a local miss consults it
+#: through the same digest/version gauntlet, a successful spill
+#: publishes to it best-effort — it is an optimisation (an autoscaled
+#: worker boots warm off the fleet's shared artifact set), never a
+#: correctness dependency.
+BLOB_STORE_ENV = "SPFFT_TPU_BLOB_STORE"
 
 #: Live boot-prewarm manifest: when set, every successful spill merges
 #: its entry into the manifest at this path (read -> dedupe by
@@ -493,10 +503,18 @@ class PlanArtifactStore:
     same key are idempotent (same content, last ``os.replace`` wins).
     """
 
-    def __init__(self, root: str, max_bytes: Optional[int] = None):
+    def __init__(self, root: str, max_bytes: Optional[int] = None,
+                 remote=None):
         self.root = str(root)
         self._max_bytes = max_bytes
         self._lock = threading.Lock()
+        # remote blob tier below disk: None resolves lazily through
+        # the config/env (first use, not construction — the agent CLI
+        # sets blob_store_url after stores may already exist); False
+        # disables; a str/BlobStore pins it.
+        self._remote_spec = remote
+        self._remote_obj = None    #: guarded by _lock
+        self._remote_ready = False  #: guarded by _lock
         self._hits = 0    #: guarded by _lock
         self._misses = 0  #: guarded by _lock
         self._spills = 0  #: guarded by _lock
@@ -716,14 +734,16 @@ class PlanArtifactStore:
         blobs = export_aot_blobs(plan) if aot else {}
         data = serialize_artifact(sig, plan, blobs)
         self._atomic_write(self.artifact_path(key), data)
+        self._remote_publish(f"art/{key}.plan", data)
         if triplets is not None:
             rkey = request_key(sig.transform_type, sig.dim_x, sig.dim_y,
                                sig.dim_z, triplets, sig.precision,
                                sig.scaling)
             alias = {REQUEST_KEY: 1, "artifact": key,
                      "signature": dataclasses.asdict(sig)}
-            self._atomic_write(self.request_path(rkey),
-                               json.dumps(alias).encode())
+            alias_bytes = json.dumps(alias).encode()
+            self._atomic_write(self.request_path(rkey), alias_bytes)
+            self._remote_publish(f"req/{rkey}.json", alias_bytes)
         self._count("spill")
         _obs.record_compile("store_spill", time.perf_counter() - t0, t0,
                             key=key[:12], bytes=len(data),
@@ -795,6 +815,75 @@ class PlanArtifactStore:
         for th in threads:
             th.join()
 
+    # -- the remote blob tier ----------------------------------------------
+    def _remote_tier(self):
+        """The resolved remote blob tier, or None. Resolution is lazy
+        and cached: an explicit ``remote=`` ctor value wins, otherwise
+        the control plane's ``blob_store_url`` path setting, otherwise
+        the ``SPFFT_TPU_BLOB_STORE`` env var; empty everywhere means
+        no remote tier."""
+        with self._lock:
+            if not self._remote_ready:
+                self._remote_ready = True
+                spec = self._remote_spec
+                if spec is None:
+                    from ..control.config import global_config
+                    spec = global_config().blob_store_url \
+                        or os.environ.get(BLOB_STORE_ENV, "")
+                if spec is False:
+                    spec = ""
+                if isinstance(spec, str):
+                    from ..net.blobstore import open_blobstore
+                    self._remote_obj = open_blobstore(spec)
+                else:
+                    self._remote_obj = spec
+            return self._remote_obj
+
+    @staticmethod
+    def _count_remote(op: str, outcome: str) -> None:
+        _obs.GLOBAL_COUNTERS.inc("spfft_store_remote_total", op=op,
+                                 outcome=outcome)
+
+    def _remote_fetch(self, rkey: str,
+                      write_through: Optional[str] = None
+                      ) -> Optional[bytes]:
+        """Read one blob from the remote tier: bytes on a hit, None on
+        a miss OR any remote failure (the tier is best-effort — a
+        wedged object store degrades to a local miss, counted, never
+        raised through a plan load). A hit writes through to the local
+        path so the next load is a disk read."""
+        remote = self._remote_tier()
+        if remote is None:
+            return None
+        try:
+            data = remote.get(rkey)
+        except BlobStoreError:
+            self._count_remote("get", "error")
+            return None
+        if data is None:
+            self._count_remote("get", "miss")
+            return None
+        self._count_remote("get", "hit")
+        if write_through is not None and not self.degraded:
+            try:
+                self._atomic_write(write_through, data)
+            except Exception:
+                pass  # the local tier is sick; the bytes still serve
+        return data
+
+    def _remote_publish(self, rkey: str, data: bytes) -> None:
+        """Best-effort put into the remote tier (the write-behind half
+        of a spill): a failure is a counter, never a failed spill."""
+        remote = self._remote_tier()
+        if remote is None:
+            return
+        try:
+            remote.put(rkey, data)
+        except BlobStoreError:
+            self._count_remote("put", "error")
+            return
+        self._count_remote("put", "ok")
+
     # -- reading -----------------------------------------------------------
     def _read_artifact(self, key: str):
         path = self.artifact_path(key)
@@ -807,7 +896,11 @@ class PlanArtifactStore:
             self._check("store.load")
             data = self._retry_io("read", read)
         except FileNotFoundError:
-            return None
+            # below the disk tier: the fleet's shared artifact set
+            data = self._remote_fetch(f"art/{key}.plan",
+                                      write_through=path)
+            if data is None:
+                return None
         except OSError as exc:
             raise StoreReject(REASON_IO, f"cannot read {path}: {exc!r}")
         return parse_artifact(data)
@@ -893,8 +986,16 @@ class PlanArtifactStore:
             with open(path) as f:
                 alias = json.load(f)
         except FileNotFoundError:
-            self._count("miss")
-            return None
+            raw = self._remote_fetch(f"req/{rkey}.json",
+                                     write_through=path)
+            if raw is None:
+                self._count("miss")
+                return None
+            try:
+                alias = json.loads(raw)
+            except ValueError:
+                self._count("reject", REASON_CORRUPT)
+                return None
         except (OSError, ValueError):
             self._count("reject", REASON_CORRUPT)
             return None

@@ -1,0 +1,547 @@
+"""spfft_tpu/net/: the wire protocol, the blob artifact tier and the
+real-TCP pod — the tier-1 twin of ``make pod-smoke``.
+
+The contracts under test (docs/cluster.md "Deployment"): frames
+round-trip bit-exact (arrays, signatures, trace contexts, typed
+errors) and reject corruption as ``NetProtocolError``; the blob tier
+round-trips bytes behind ``get/put/list`` on both backends and feeds
+``PlanArtifactStore`` as a best-effort remote tier (a cold store boots
+warm off it, faults never escape); a ``TcpHostLane`` against a live
+``HostAgent`` is indistinguishable from a loopback lane to the
+``PodFrontend`` (bit-exact serving, one trace id across the socket,
+typed failover when the agent dies); the SPMD lane's admission control
+rejects overflow as ``QueueFullError`` and purges expired deadlines;
+and a real two-agent SUBPROCESS pod serves bit-exact with kill -9
+failover.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spfft_tpu import obs
+from spfft_tpu.benchmark import cutoff_stick_triplets
+from spfft_tpu.control.config import global_config
+from spfft_tpu.errors import (BlobStoreError, DeadlineExpiredError,
+                              GenericError, HostLaneError,
+                              InvalidParameterError, NetProtocolError,
+                              QueueFullError)
+from spfft_tpu.net.agent import HostAgent
+from spfft_tpu.net.blobstore import (FileBlobStore, HttpBlobStore,
+                                     open_blobstore, serve_blobstore)
+from spfft_tpu.net.frame import (error_from_wire, error_to_wire,
+                                 pack_values, recv_frame, send_frame,
+                                 signature_from_wire,
+                                 signature_to_wire, unpack_values)
+from spfft_tpu.net.transport import TcpHostLane
+from spfft_tpu.serve.cluster import PodFrontend, _SPMDLane
+from spfft_tpu.serve.executor import ServeExecutor
+from spfft_tpu.serve.registry import PlanRegistry, signature_for
+from spfft_tpu.serve.store import PlanArtifactStore
+from spfft_tpu.types import Scaling, TransformType
+
+N = 8
+DIMS = (N, N, N)
+SHARDS = 2
+
+
+@pytest.fixture(scope="module")
+def plans():
+    """One local + one 2-shard distributed plan, shared module-wide."""
+    from spfft_tpu.parallel import make_distributed_plan, make_mesh
+    from spfft_tpu.utils.workloads import (even_plane_split,
+                                           round_robin_stick_partition)
+    trip = cutoff_stick_triplets(N, N, N, 0.9, hermitian=False)
+    reg = PlanRegistry()
+    sig, plan = reg.get_or_build(TransformType.C2C, *DIMS, trip,
+                                 precision="double")
+    parts = round_robin_stick_partition(trip, DIMS, SHARDS)
+    planes = even_plane_split(DIMS[2], SHARDS)
+    dplan = make_distributed_plan(TransformType.C2C, *DIMS, parts,
+                                  planes,
+                                  mesh=make_mesh(SHARDS),
+                                  precision="double")
+    dsig = signature_for(TransformType.C2C, *DIMS, trip,
+                         precision="double", device_count=SHARDS)
+    return {"trip": trip, "sig": sig, "plan": plan,
+            "dsig": dsig, "dplan": dplan}
+
+
+def _vals(plans, rng):
+    n = len(plans["trip"])
+    return rng.standard_normal(n) + 1j * rng.standard_normal(n)
+
+
+# ---------------------------------------------------------------------------
+# frame protocol
+# ---------------------------------------------------------------------------
+
+def test_frame_round_trip_with_payload():
+    a, b = socket.socketpair()
+    try:
+        payload = os.urandom(4096)
+        send_frame(a, {"type": "ping", "k": [1, 2]}, payload)
+        header, got = recv_frame(b)
+        assert header == {"type": "ping", "k": [1, 2]}
+        assert got == payload
+    finally:
+        a.close()
+        b.close()
+
+
+def test_frame_rejects_bad_magic_and_truncation():
+    a, b = socket.socketpair()
+    try:
+        a.sendall(b"NOPE" + b"\x00" * 13)
+        a.close()
+        with pytest.raises(NetProtocolError):
+            recv_frame(b)
+    finally:
+        b.close()
+    a, b = socket.socketpair()
+    try:
+        send_frame(a, {"type": "ping"}, b"full-payload")
+        buf = b.recv(1 << 20)
+        c, d = socket.socketpair()
+        try:
+            c.sendall(buf[:-4])  # truncated mid-payload
+            c.close()
+            with pytest.raises(NetProtocolError):
+                recv_frame(d)
+        finally:
+            d.close()
+    finally:
+        a.close()
+        b.close()
+
+
+def test_frame_eof_ok_returns_none():
+    a, b = socket.socketpair()
+    a.close()
+    try:
+        assert recv_frame(b, eof_ok=True) is None
+    finally:
+        b.close()
+
+
+def test_pack_unpack_values_shapes():
+    rng = np.random.default_rng(0)
+    single = rng.standard_normal(17) + 1j * rng.standard_normal(17)
+    meta, blob = pack_values(single)
+    out = unpack_values(meta, blob)
+    assert np.array_equal(out, single)
+    many = [rng.standard_normal((5, 2)).astype(np.float32),
+            rng.standard_normal(9) + 1j * rng.standard_normal(9)]
+    meta, blob = pack_values(many)
+    out = unpack_values(meta, blob)
+    assert isinstance(out, list) and len(out) == 2
+    for got, want in zip(out, many):
+        assert got.dtype == want.dtype
+        assert np.array_equal(got, want)
+    meta, blob = pack_values(None)
+    assert unpack_values(meta, blob) is None
+
+
+def test_signature_wire_round_trip(plans):
+    wire = signature_to_wire(plans["sig"])
+    json.dumps(wire)  # must be JSON-serializable as-is
+    assert signature_from_wire(wire) == plans["sig"]
+    with pytest.raises(NetProtocolError):
+        signature_from_wire({"bogus_field": 1})
+
+
+def test_error_wire_round_trip():
+    wire = error_to_wire(QueueFullError("queue is full"))
+    assert wire["type"] == "error"
+    back = error_from_wire(wire)
+    assert isinstance(back, QueueFullError)
+    assert "queue is full" in str(back)
+    # builtins that model request-shaped failures survive too
+    assert isinstance(error_from_wire(error_to_wire(ValueError("x"))),
+                      ValueError)
+    # an unknown class degrades to the taxonomy root, never crashes
+    unknown = error_from_wire({"type": "error",
+                               "error_type": "BogusError",
+                               "message": "?"})
+    assert isinstance(unknown, GenericError)
+
+
+# ---------------------------------------------------------------------------
+# blob tier
+# ---------------------------------------------------------------------------
+
+def test_file_blobstore_round_trip(tmp_path):
+    bs = FileBlobStore(str(tmp_path))
+    assert bs.get("art/missing.plan") is None
+    bs.put("art/a.plan", b"alpha")
+    bs.put("req/b.json", b"beta")
+    assert bs.get("art/a.plan") == b"alpha"
+    assert sorted(bs.list()) == ["art/a.plan", "req/b.json"]
+    for bad in ("", "/abs", "../up", "a\\b"):
+        with pytest.raises(InvalidParameterError):
+            bs.put(bad, b"x")
+
+
+def test_http_blobstore_round_trip(tmp_path):
+    server, thread = serve_blobstore(str(tmp_path))
+    try:
+        url = f"http://127.0.0.1:{server.server_address[1]}"
+        bs = open_blobstore(url)
+        assert isinstance(bs, HttpBlobStore)
+        assert bs.get("art/missing.plan") is None
+        bs.put("art/a.plan", b"alpha")
+        assert bs.get("art/a.plan") == b"alpha"
+        assert bs.list() == ["art/a.plan"]
+        # same bytes through the file backend: one shared tier
+        assert FileBlobStore(str(tmp_path)).get("art/a.plan") == b"alpha"
+    finally:
+        server.shutdown()
+        thread.join(timeout=10)
+
+
+def test_open_blobstore_dispatch(tmp_path):
+    assert open_blobstore(None) is None
+    assert open_blobstore("") is None
+    assert isinstance(open_blobstore(str(tmp_path)), FileBlobStore)
+    assert isinstance(open_blobstore("http://127.0.0.1:1/x"),
+                      HttpBlobStore)
+
+
+def test_store_remote_tier_cold_boot(tmp_path, plans):
+    """A fresh process-shaped store (empty disk) boots warm off the
+    remote tier alone: artifact fetched, parsed through the digest
+    gauntlet, zero builds."""
+    blob = FileBlobStore(str(tmp_path / "blob"))
+    warm = PlanArtifactStore(str(tmp_path / "warm"), remote=blob)
+    warm.save_plan(plans["sig"], plans["plan"], plans["trip"])
+    warm.drain()
+    assert any(k.startswith("art/") for k in blob.list())
+
+    cold = PlanArtifactStore(str(tmp_path / "cold"), remote=blob)
+    reg = PlanRegistry(store=cold)
+    assert reg.prewarm_signatures([plans["sig"]], strict=True) == 1
+    st = reg.stats()
+    assert st["builds"] == 0
+    loaded = reg.get(plans["sig"])
+    rng = np.random.default_rng(3)
+    v = _vals(plans, rng)
+    assert np.array_equal(np.asarray(loaded.backward(v)),
+                          np.asarray(plans["plan"].backward(v)))
+
+
+def test_store_remote_tier_faults_contained(tmp_path, plans):
+    """Blob faults are best-effort: a dead remote never fails a local
+    load or save (it just counts)."""
+    class _Dead:
+        def get(self, key):
+            raise BlobStoreError("remote down")
+
+        def put(self, key, data):
+            raise BlobStoreError("remote down")
+
+    store = PlanArtifactStore(str(tmp_path / "s"), remote=_Dead())
+    key = store.save_plan(plans["sig"], plans["plan"], plans["trip"])
+    store.drain()
+    assert os.path.exists(store.artifact_path(key))
+    # a read through an empty disk tier + dead remote is a clean miss
+    cold = PlanArtifactStore(str(tmp_path / "c"), remote=_Dead())
+    reg = PlanRegistry(store=cold)
+    assert reg.prewarm_signatures([plans["sig"]], strict=False) == 0
+
+
+# ---------------------------------------------------------------------------
+# SPMD-lane admission control
+# ---------------------------------------------------------------------------
+
+def test_spmd_lane_queue_full_and_deadline_purge(plans):
+    release = threading.Event()
+
+    class _Blocking:
+        def backward(self, values):
+            release.wait(30)
+            return values
+
+    lane = _SPMDLane(max_workers=1)
+    cfg = global_config()
+    old = cfg.max_queue
+    cfg.set("max_queue", 2, source="test", reason="admission test")
+    try:
+        f1 = lane.submit(plans["dsig"], _Blocking(), 1, "backward",
+                         Scaling.NONE, None)
+        time.sleep(0.05)  # let the worker pick f1 up
+        f2 = lane.submit(plans["dsig"], _Blocking(), 2, "backward",
+                         Scaling.NONE, None, timeout=0.02)
+        with pytest.raises(QueueFullError):
+            lane.submit(plans["dsig"], _Blocking(), 3, "backward",
+                        Scaling.NONE, None)
+        time.sleep(0.1)  # let f2's queued deadline lapse
+        release.set()
+        assert f1.result(timeout=30) == 1
+        # f2's deadline expired while queued behind f1: purged typed
+        with pytest.raises(DeadlineExpiredError):
+            f2.result(timeout=30)
+        rej = obs.GLOBAL_COUNTERS.snapshot()[
+            "spfft_cluster_spmd_rejected_total"]["samples"]
+        reasons = {dict(k).get("reason") for k in rej}
+        assert {"queue_full", "expired"} <= reasons
+    finally:
+        release.set()
+        cfg.set("max_queue", old, source="test",
+                reason="restore after admission test")
+        lane.close()
+
+
+# ---------------------------------------------------------------------------
+# TcpHostLane against a live in-process agent
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def agent_pod(plans):
+    """A PodFrontend over one loopback lane + one REAL TCP lane backed
+    by an in-process HostAgent — the mixed pod the seam promises."""
+    regs = []
+    for _ in range(2):
+        reg = PlanRegistry()
+        reg.put(plans["sig"], plans["plan"])
+        reg.put(plans["dsig"], plans["dplan"])
+        regs.append(reg)
+    loop_ex = ServeExecutor(regs[0])
+    tcp_ex = ServeExecutor(regs[1])
+    agent = HostAgent("t1", tcp_ex).start()
+    lane = TcpHostLane("t1", ("127.0.0.1", agent.port))
+    pod = PodFrontend([("t0", loop_ex), lane], policy="rr", seed=0)
+    yield {"pod": pod, "lane": lane, "agent": agent,
+           "tcp_ex": tcp_ex, "loop_ex": loop_ex}
+    pod.close()
+    lane.close()
+    agent.close()
+    tcp_ex.close(drain=False)
+    loop_ex.close(drain=False)
+
+
+def test_mixed_pod_serves_bit_exact(agent_pod, plans):
+    pod = agent_pod["pod"]
+    rng = np.random.default_rng(1)
+    for _ in range(4):
+        v = _vals(plans, rng)
+        got = np.asarray(pod.submit_backward(plans["sig"], v)
+                         .result(timeout=120))
+        assert np.array_equal(
+            got, np.asarray(plans["plan"].backward(v)))
+    dvalues = [
+        (rng.standard_normal(p.num_values)
+         + 1j * rng.standard_normal(p.num_values))
+        for p in plans["dplan"].dist_plan.shard_plans]
+    dgot = np.asarray(pod.submit(plans["dsig"], dvalues)
+                      .result(timeout=120))
+    assert np.array_equal(
+        dgot, np.asarray(plans["dplan"].backward(dvalues)))
+
+
+def test_trace_id_crosses_the_socket(agent_pod, plans):
+    pod, lane = agent_pod["pod"], agent_pod["lane"]
+    obs.enable()
+    tracer = obs.GLOBAL_TRACER
+    tracer.reset()
+    tracer.set_sample_rate(1.0)
+    try:
+        rng = np.random.default_rng(2)
+        for _ in range(4):
+            v = _vals(plans, rng)
+            pod.submit_backward(plans["sig"], v).result(timeout=120)
+        assert tracer.open_count() == 0
+        roots = {s.trace_id for s in tracer.events()
+                 if isinstance(s, obs.Span)
+                 and s.name == "cluster.request"}
+        remote = lane.rpc_spans()
+        assert remote["open"] == 0
+        served = [s for s in remote["spans"]
+                  if s["name"] == "serve.request"]
+        assert served, "agent recorded no serve.request spans"
+        assert all(s["trace_id"] in roots for s in served)
+    finally:
+        obs.disable()
+
+
+def test_wire_rtt_feeds_signals(agent_pod, plans):
+    pod, lane = agent_pod["pod"], agent_pod["lane"]
+    rng = np.random.default_rng(4)
+    pod.submit_backward(plans["sig"], _vals(plans, rng)) \
+       .result(timeout=120)
+    signals = lane.rpc_signals()
+    assert signals["wire_rtt"] > 0.0
+    assert lane.transport.rtt == pytest.approx(signals["wire_rtt"])
+
+
+def test_remote_error_stays_typed(agent_pod, plans):
+    """An executor-side rejection crosses the wire as its own class —
+    backpressure is not lane death."""
+    lane = agent_pod["lane"]
+    bogus = signature_for(
+        TransformType.C2C, 6, 6, 6,
+        cutoff_stick_triplets(6, 6, 6, 0.9, hermitian=False),
+        precision="double")
+    with pytest.raises(InvalidParameterError):
+        lane.rpc_submit(bogus, np.zeros(3, complex),
+                        ctx=None).result(timeout=60)
+    assert lane.alive  # a typed rejection must NOT kill the lane
+
+
+def test_agent_death_fails_over_typed(agent_pod, plans):
+    pod, agent = agent_pod["pod"], agent_pod["agent"]
+    agent.close()
+    agent_pod["tcp_ex"].close(drain=False)
+    rng = np.random.default_rng(5)
+    for _ in range(4):  # every request lands on the survivor
+        v = _vals(plans, rng)
+        got = np.asarray(pod.submit_backward(plans["sig"], v)
+                         .result(timeout=120))
+        assert np.array_equal(
+            got, np.asarray(plans["plan"].backward(v)))
+    assert not agent_pod["lane"].alive
+    assert pod.health()["state"] == "degraded"
+
+
+def test_membership_join_prewarm_and_leave(agent_pod, plans,
+                                           tmp_path):
+    """A TCP lane joins a live pod: prewarmed from the incumbent's
+    signature set over the wire (builds == 0 via the blob tier),
+    reconciled, serves, then drain-leaves."""
+    pod = agent_pod["pod"]
+    blob = FileBlobStore(str(tmp_path / "blob"))
+    seed_store = PlanArtifactStore(str(tmp_path / "seed"), remote=blob)
+    seed_store.save_plan(plans["sig"], plans["plan"], plans["trip"])
+    seed_store.drain()
+
+    reg = PlanRegistry(store=PlanArtifactStore(
+        str(tmp_path / "join"), remote=blob))
+    reg.put(plans["dsig"], plans["dplan"])  # derived, never serialized
+    join_ex = ServeExecutor(reg)
+    agent2 = HostAgent("t2", join_ex).start()
+    lane2 = TcpHostLane("t2", ("127.0.0.1", agent2.port))
+    try:
+        pod.join(lane2)
+        assert lane2.rpc_stats()["builds"] == 0
+        rng = np.random.default_rng(6)
+        for _ in range(6):
+            v = _vals(plans, rng)
+            got = np.asarray(pod.submit_backward(plans["sig"], v)
+                             .result(timeout=120))
+            assert np.array_equal(
+                got, np.asarray(plans["plan"].backward(v)))
+        routed = obs.GLOBAL_COUNTERS.snapshot()[
+            "spfft_cluster_routed_total"]["samples"]
+        assert any(dict(k).get("host") == "t2" and v >= 1
+                   for k, v in routed.items())
+        left = pod.leave("t2")
+        assert left["drained"]
+        events = {dict(k).get("event")
+                  for k in obs.GLOBAL_COUNTERS.snapshot()
+                  ["spfft_cluster_membership_total"]["samples"]}
+        assert {"join_started", "prewarmed", "reconciled", "joined",
+                "leave_started", "drained", "left"} <= events
+    finally:
+        lane2.close()
+        agent2.close()
+        join_ex.close(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# the real thing: subprocess agents over localhost TCP
+# ---------------------------------------------------------------------------
+
+def _spawn(host, store, blob, warm):
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               JAX_ENABLE_X64="True",  # match the suite's x64 oracle
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "spfft_tpu.net.agent", "--host", host,
+         "--port", "0", "--store", store, "--blob", blob,
+         "--demo-warm", warm, "--trace"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env=env)
+    while True:
+        line = proc.stdout.readline()
+        if not line:
+            proc.kill()
+            raise RuntimeError(f"agent {host} died during warmup")
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if "port" in rec:
+            return proc, int(rec["port"])
+
+
+def test_two_process_pod_over_tcp(tmp_path):
+    """Two real agent processes: mixed traffic bit-exact vs a serial
+    oracle built here, then kill -9 one agent and the survivor keeps
+    the trace bit-exact."""
+    from spfft_tpu.parallel import make_distributed_plan, make_mesh
+    from spfft_tpu.utils.workloads import (even_plane_split,
+                                           round_robin_stick_partition)
+    trip = cutoff_stick_triplets(N, N, N, 0.9, hermitian=False)
+    reg = PlanRegistry()
+    sig, plan = reg.get_or_build(TransformType.C2C, *DIMS, trip,
+                                 precision="double")
+    parts = round_robin_stick_partition(trip, DIMS, SHARDS)
+    planes = even_plane_split(DIMS[2], SHARDS)
+    dplan = make_distributed_plan(TransformType.C2C, *DIMS, parts,
+                                  planes, mesh=make_mesh(SHARDS),
+                                  precision="double")
+    dsig = signature_for(TransformType.C2C, *DIMS, trip,
+                         precision="double", device_count=SHARDS)
+
+    blob = str(tmp_path / "blob")
+    os.makedirs(blob)
+    procs, lanes = {}, {}
+    pod = None
+    try:
+        for host in ("p0", "p1"):
+            procs[host], port = _spawn(
+                host, str(tmp_path / f"store-{host}"), blob,
+                f"{N},0.9,{SHARDS},full")
+            lanes[host] = TcpHostLane(host, ("127.0.0.1", port))
+        pod = PodFrontend([lanes["p0"], lanes["p1"]], policy="rr",
+                          seed=0)
+        rng = np.random.default_rng(7)
+        for _ in range(6):
+            v = rng.standard_normal(len(trip)) \
+                + 1j * rng.standard_normal(len(trip))
+            got = np.asarray(pod.submit_backward(sig, v)
+                             .result(timeout=120))
+            assert np.array_equal(got, np.asarray(plan.backward(v)))
+        dvalues = [
+            (rng.standard_normal(p.num_values)
+             + 1j * rng.standard_normal(p.num_values))
+            for p in dplan.dist_plan.shard_plans]
+        dgot = np.asarray(pod.submit(dsig, dvalues).result(timeout=120))
+        assert np.array_equal(dgot,
+                              np.asarray(dplan.backward(dvalues)))
+
+        procs["p1"].kill()
+        procs["p1"].wait(timeout=30)
+        for _ in range(4):
+            v = rng.standard_normal(len(trip)) \
+                + 1j * rng.standard_normal(len(trip))
+            got = np.asarray(pod.submit_backward(sig, v)
+                             .result(timeout=120))
+            assert np.array_equal(got, np.asarray(plan.backward(v)))
+        assert not lanes["p1"].alive
+        assert pod.health()["state"] == "degraded"
+    finally:
+        if pod is not None:
+            pod.close()
+        for lane in lanes.values():
+            lane.close()
+        for proc in procs.values():
+            proc.kill()
+            proc.wait(timeout=10)
