@@ -64,6 +64,14 @@ LOWER_IS_BETTER_UNITS = ("s", "ms", "us", "ns", "seconds", "bytes")
 #: TCP-vs-loopback rpc_submit round-trip overhead through an
 #: in-process localhost HostAgent — growth past threshold means the
 #: frame protocol or lane client got slower on the wire.
+#: pod_wire_pooled (unit "us", lower is better, recorded from
+#: BENCH_r06.json round 20 on) is the same probe over the KEPT-ALIVE
+#: pooled lane (net.transport._SocketPool) — growth past threshold
+#: means keep-alive reuse regressed toward connect-per-RPC cost.
+#: spmd_coalesce (unit "req/round", higher is better, recorded from
+#: BENCH_r06.json round 20 on) is the pod SPMD coalescer's
+#: requests-per-collective-round on a deterministic 12-request burst —
+#: a drop means the coalescing window splinters rounds.
 #: fused_dist (unit "directions",
 #: higher is better) counts the distributed fused directions active
 #: under the K=2 overlap pipeline (chunk-sliceable backward + forward
@@ -76,7 +84,7 @@ LOWER_IS_BETTER_UNITS = ("s", "ms", "us", "ns", "seconds", "bytes")
 #: load. All emitted by bench.py every run.
 SUB_ROWS = ("fused", "cold_start_ms", "warm_start_ms",
             "wire_bytes_r2c", "fused_r2c", "fused_dist", "pod_routing",
-            "pod_wire")
+            "pod_wire", "pod_wire_pooled", "spmd_coalesce")
 
 
 def load_payload(path: str) -> dict:

@@ -164,6 +164,15 @@ KNOB_SPECS: Dict[str, KnobSpec] = {spec.name: spec for spec in (
              "spfft_net_rpc_rtt_seconds",
              "Per-RPC socket read timeout (ms) on the pod wire; a "
              "submit adds the request's own deadline on top."),
+    KnobSpec("spmd_batch_window", 0.002, 0.0, 0.1, float,
+             "SPMD queue depth vs collective-launch p50",
+             "Coalescing window (seconds) the pod SPMD lane holds a "
+             "distributed request open for same-signature company "
+             "before launching the collective round."),
+    KnobSpec("spmd_max_batch", 8, 1, 128, int,
+             "SPMD batch-size histogram",
+             "Most distributed requests one coalesced SPMD collective "
+             "round carries."),
 )}
 
 #: String-valued settings (paths) the numeric KnobSpec clamp cannot

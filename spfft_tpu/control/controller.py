@@ -46,6 +46,17 @@ Signals → rules → knobs (the docs/control_plane.md table, in code):
   nothing — backpressure on a genuine overload is the knob working as
   designed. Idle periods decay the bound back toward the default by
   halving (retracing the growth path).
+* **spmd_batch_window / spmd_max_batch** ← SPMD queue depth vs
+  collective-launch p50 (``SPMDCoalescer.signals()``, merged in when a
+  coalescer is attached). Distributed requests backing up (depth >= 2)
+  while the coalescing window is shorter than one collective launch on
+  consecutive distributed steps means arrivals during a launch miss
+  the next window → DOUBLE the window (more requests per collective
+  round); a window above default that coalesces nothing decays back by
+  halving. Rounds repeatedly full AT the batch cap with a backlog →
+  double ``spmd_max_batch``; rounds far below an elevated cap → halve
+  it back (the fused ``max_batch`` rule, re-aimed at the distributed
+  lane).
 
 Stability machinery, also deterministic:
 
@@ -91,7 +102,8 @@ class Decision:
 #: Knobs the feedback rules manage (everything else in ServeConfig is
 #: hot-swappable but only moved by operators/the tuner).
 MANAGED_KNOBS = ("batch_window", "pin_after", "max_batch",
-                 "pipeline_depth", "max_queue", "overlap_chunks")
+                 "pipeline_depth", "max_queue", "overlap_chunks",
+                 "spmd_batch_window", "spmd_max_batch")
 
 
 class Controller:
@@ -107,17 +119,19 @@ class Controller:
     """
 
     def __init__(self, config: ServeConfig, metrics=None, executor=None,
-                 watchdog=None, cooldown_steps: int = 3,
+                 watchdog=None, spmd=None, cooldown_steps: int = 3,
                  shrink_ratio: float = 2.0, grow_ratio: float = 0.5,
                  pad_hi: float = 0.25, pad_lo: float = 0.02,
                  exec_floor_s: float = 1e-4,
                  reject_streak_steps: int = 2,
                  overlap_hi: float = 1.0, overlap_lo: float = 0.25,
-                 overlap_streak_steps: int = 2):
+                 overlap_streak_steps: int = 2,
+                 spmd_streak_steps: int = 2):
         self.config = config
         self.metrics = metrics
         self.executor = executor
         self.watchdog = watchdog
+        self.spmd = spmd
         self.cooldown_steps = max(0, int(cooldown_steps))
         self.shrink_ratio = float(shrink_ratio)
         self.grow_ratio = float(grow_ratio)
@@ -128,8 +142,10 @@ class Controller:
         self.overlap_hi = float(overlap_hi)
         self.overlap_lo = float(overlap_lo)
         self.overlap_streak_steps = max(1, int(overlap_streak_steps))
+        self.spmd_streak_steps = max(1, int(spmd_streak_steps))
         self._overlap_streak = 0
         self._reject_streak = 0
+        self._spmd_streak = 0
         self._step = 0
         self._prev: Optional[Dict] = None
         self._last_change: Dict[str, int] = {}
@@ -179,16 +195,21 @@ class Controller:
                 raise ValueError("Controller needs metrics or explicit "
                                  "signals")
             signals = self.metrics.signals()
+            if self.spmd is not None:
+                signals.update(self.spmd.signals())
         self._step += 1
         out: List[Decision] = []
         first = self._prev is None
         completed_d = self._delta(signals, "completed")
-        idle = (completed_d == 0 and signals.get("queue_depth", 0) == 0)
+        idle = (completed_d == 0 and signals.get("queue_depth", 0) == 0
+                and self._delta(signals, "spmd_launches") == 0
+                and signals.get("spmd_queue_depth", 0) == 0)
         if first:
             pass  # calibration step: record the baseline, act next
         elif idle:
             self._reject_streak = 0
             self._overlap_streak = 0
+            self._spmd_streak = 0
             self._decay_toward_defaults(out)
         else:
             self._rule_batch_window(out, signals)
@@ -197,6 +218,7 @@ class Controller:
             self._rule_pipeline_depth(out, signals)
             self._rule_max_queue(out, signals)
             self._rule_overlap_chunks(out, signals)
+            self._rule_spmd_coalesce(out, signals)
         self._prev = dict(signals)
         from .. import obs
         obs.GLOBAL_COUNTERS.inc(
@@ -215,7 +237,7 @@ class Controller:
             default = ServeConfig.default(knob)
             if cur == default:
                 continue
-            if knob == "batch_window":
+            if knob in ("batch_window", "spmd_batch_window"):
                 # retrace the halving/doubling path, snapping onto the
                 # default once one move reaches or crosses it
                 if cur < default:
@@ -223,7 +245,8 @@ class Controller:
                         else cur * 2
                 else:
                     nxt = max(default, cur / 2)
-            elif knob in ("max_queue", "overlap_chunks"):
+            elif knob in ("max_queue", "overlap_chunks",
+                          "spmd_max_batch"):
                 # these grow rules double, so the decay halves — one
                 # idle step per growth step back toward the default
                 nxt = max(default, cur // 2) if cur > default \
@@ -343,6 +366,65 @@ class Controller:
                              max(default, k // 2),
                              f"exchange hidden ({ratio:.2f} x compute):"
                              f" decay toward default")
+
+    def _rule_spmd_coalesce(self, out, s) -> None:
+        """Retune the pod SPMD lane's coalescing window and batch cap
+        from the coalescer's live signals (``SPMDCoalescer.signals``):
+        distributed requests backing up (queue depth >= 2) while the
+        window is shorter than one collective launch on
+        ``spmd_streak_steps`` consecutive distributed steps means
+        arrivals during a launch keep missing the next window → DOUBLE
+        ``spmd_batch_window`` (more requests per collective round); a
+        window above default that coalesced nothing this step decays
+        back by halving. Rounds repeatedly full AT ``spmd_max_batch``
+        with a backlog double the cap; rounds far below an elevated cap
+        halve it back — the fused ``max_batch`` rule, re-aimed at the
+        distributed lane. Steps with no collective launches reset the
+        streak and move nothing."""
+        launches_d = self._delta(s, "spmd_launches")
+        if launches_d <= 0:
+            self._spmd_streak = 0
+            return
+        depth = s.get("spmd_queue_depth", 0)
+        p50 = max(s.get("spmd_launch_p50", 0.0), self.exec_floor_s)
+        w = self.config.get("spmd_batch_window")
+        default = ServeConfig.default("spmd_batch_window")
+        if depth >= 2 and w < p50:
+            self._spmd_streak += 1
+            if self._spmd_streak >= self.spmd_streak_steps:
+                nxt = default if w == 0.0 else w * 2.0
+                if self._retune(
+                        out, "spmd_batch_window", nxt,
+                        f"SPMD backlog: depth {depth:g} with window "
+                        f"{w * 1e3:.2f} ms < launch p50 "
+                        f"{p50 * 1e3:.2f} ms over {self._spmd_streak} "
+                        f"consecutive distributed steps"):
+                    self._spmd_streak = 0
+        else:
+            self._spmd_streak = 0
+            if w > default and self._delta(s, "spmd_coalesced") == 0:
+                self._retune(out, "spmd_batch_window",
+                             max(default, w / 2.0),
+                             "window coalesced nothing: decay toward "
+                             "default")
+        mb = self.config.get("spmd_max_batch")
+        mb_default = ServeConfig.default("spmd_max_batch")
+        hist = s.get("spmd_batch_hist") or {}
+        prev_hist = (self._prev or {}).get("spmd_batch_hist") or {}
+        full_d = hist.get(mb, 0) - prev_hist.get(mb, 0)
+        sizes_d = [b for b in hist
+                   if hist.get(b, 0) - prev_hist.get(b, 0) > 0]
+        if full_d >= 2 and depth > 0:
+            self._retune(out, "spmd_max_batch", mb * 2,
+                         f"full collective rounds: {full_d:g} rounds "
+                         f"at the cap {mb} with SPMD queue depth "
+                         f"{depth:g}")
+        elif mb > mb_default and sizes_d \
+                and max(sizes_d) <= max(1, mb // 4):
+            self._retune(out, "spmd_max_batch",
+                         max(mb_default, mb // 2),
+                         f"rounds far below cap: largest coalesced "
+                         f"batch {max(sizes_d)} <= {mb}//4")
 
     def _rule_pipeline_depth(self, out, s) -> None:
         if self.executor is None:

@@ -41,6 +41,8 @@ site                  where it fires
                       (submit / signals / metrics / health)
 ``cluster.reconcile`` the per-host digest-validation collective during
                       pod reconciliation
+``cluster.spmd_window`` each coalesced SPMD window round, before the
+                      collective launch (all member futures fail typed)
 ``net.frame``         encode/decode of one wire frame (either socket
                       end of the pod's TCP transport)
 ``net.send``          the socket send of a framed request/response
@@ -127,8 +129,9 @@ SITES = (
     # distributed exchange
     "exchange.pack", "exchange.collective", "exchange.unpack",
     "exchange.chunk",
-    # pod cluster (round 18)
+    # pod cluster (round 18; spmd_window joined with the coalescer)
     "cluster.route", "cluster.rpc", "cluster.reconcile",
+    "cluster.spmd_window",
     # wire transport + remote artifact tier (net/)
     "net.frame", "net.send", "net.recv", "net.accept",
     "blob.get", "blob.put",

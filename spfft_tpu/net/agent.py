@@ -33,18 +33,18 @@ from __future__ import annotations
 import json
 import socket
 import threading
-from typing import Dict, Optional, Tuple
+from typing import Optional, Tuple
 
 from .. import faults as _faults
 from .. import obs as _obs
 from ..control.config import global_config
-from ..errors import InvalidParameterError, NetProtocolError
+from ..errors import (DeadlineExpiredError, InvalidParameterError,
+                      NetProtocolError, QueueFullError)
 from ..faults import InjectedFault
 from ..obs.exporters import prometheus_text
 from ..parallel.multihost import plan_fingerprint
 from ..plan import TransformPlan
 from ..serve.executor import ServeExecutor
-from ..serve.registry import PlanSignature
 from ..types import Scaling
 from .frame import (error_to_wire, pack_values, recv_frame, send_frame,
                     signature_from_wire, signature_to_wire,
@@ -79,8 +79,14 @@ class HostAgent:
         self.executor = executor
         self.closing = threading.Event()
         self._lock = threading.Lock()
-        #: guarded by _lock
-        self._sig_locks: Dict[PlanSignature, threading.Lock] = {}
+        self._inflight = 0  #: guarded by _lock
+        self._conns: set = set()  #: guarded by _lock
+        # this host's half of the pod SPMD lane: the same coalescing
+        # scheduler the in-process frontend runs, so same-signature
+        # distributed requests arriving over the wire share collective
+        # rounds too (serve.cluster has no net imports — no cycle)
+        from ..serve.cluster import SPMDCoalescer
+        self._spmd = SPMDCoalescer(span_args={"host": host})
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((bind, port))
@@ -103,8 +109,23 @@ class HostAgent:
             self._sock.close()
         except OSError:
             pass
+        # sever live keep-alive connections too: a closed host must
+        # look DOWN to pooled clients (EOF on their idle sockets), not
+        # keep answering frames from still-parked handler threads
+        with self._lock:
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
         if self._thread is not None:
             self._thread.join(timeout=5.0)
+        self._spmd.close()
 
     # -- the accept loop ---------------------------------------------------
     def _accept_loop(self) -> None:
@@ -131,6 +152,8 @@ class HostAgent:
     def _handle_conn(self, conn) -> None:
         cfg = global_config()
         conn.settimeout(cfg.net_rpc_timeout_ms / 1000.0)
+        with self._lock:
+            self._conns.add(conn)
         try:
             while not self.closing.is_set():
                 try:
@@ -160,6 +183,8 @@ class HostAgent:
                 except (OSError, NetProtocolError, InjectedFault):
                     return
         finally:
+            with self._lock:
+                self._conns.discard(conn)
             conn.close()
 
     # -- dispatch ----------------------------------------------------------
@@ -218,6 +243,31 @@ class HostAgent:
             return {"type": "pong", "host": self.host}, b""
         raise InvalidParameterError(f"unknown wire op {op!r}")
 
+    def _admit(self, timeout) -> None:
+        """The agent's own admission seam (mirroring the SPMD lane's):
+        a submit whose deadline is already spent rejects typed without
+        touching a device, and the count of submits in flight across
+        ALL connections is bounded by the ``max_queue`` knob — a
+        storming client cannot queue this host to death behind its
+        accept loop. Raising here answers the frame with the same
+        typed error record any handler failure does."""
+        if timeout is not None and float(timeout) <= 0:
+            _obs.GLOBAL_COUNTERS.inc("spfft_net_agent_rejected_total",
+                                     reason="expired")
+            raise DeadlineExpiredError(
+                f"request deadline already expired at host "
+                f"{self.host!r} admission")
+        cap = int(global_config().max_queue)
+        with self._lock:
+            if self._inflight >= cap:
+                _obs.GLOBAL_COUNTERS.inc(
+                    "spfft_net_agent_rejected_total",
+                    reason="queue_full")
+                raise QueueFullError(
+                    f"host {self.host!r} agent is at capacity ({cap} "
+                    f"submits in flight)")
+            self._inflight += 1
+
     # trace: boundary(ctx)
     def _handle_submit(self, header: dict, payload: bytes,
                        ctx) -> Tuple[dict, bytes]:
@@ -236,47 +286,31 @@ class HostAgent:
             raise InvalidParameterError(
                 f"signature not held by host {self.host!r} "
                 f"(warm up first)")
-        if isinstance(plan, TransformPlan):
-            fut = self.executor.submit(
-                sig, values, kind, scaling=scaling, timeout=timeout,
-                priority=priority, trace_ctx=ctx)
+        self._admit(timeout)
+        try:
+            if isinstance(plan, TransformPlan):
+                fut = self.executor.submit(
+                    sig, values, kind, scaling=scaling, timeout=timeout,
+                    priority=priority, trace_ctx=ctx)
+            else:
+                # the coalescer batches same-signature arrivals from
+                # every connection into one collective round
+                fut = self._spmd.submit(sig, plan, values, kind,
+                                        scaling, ctx, timeout=timeout,
+                                        priority=priority)
             result = fut.result()
-        else:
-            result = self._run_distributed(sig, plan, values, kind,
-                                           scaling, ctx)
+        finally:
+            with self._lock:
+                self._inflight -= 1
         meta, rpayload = pack_values(result)
         return {"type": "result", **meta}, rpayload
-
-    def _run_distributed(self, sig, plan, values, kind, scaling, ctx):
-        """This host's half of the pod SPMD lane: serialized
-        per-signature (a shard_map executable spans the whole local
-        mesh — overlapping launches of one executable interleave on
-        every device and win nothing)."""
-        _obs.GLOBAL_COUNTERS.inc("spfft_cluster_spmd_requests_total")
-        if ctx is not None and _obs.active():
-            with _obs.GLOBAL_TRACER.span(
-                    "cluster.spmd_execute", trace_id=ctx.trace_id,
-                    parent=ctx, track="pod:spmd",
-                    args={"kind": kind, "host": self.host}):
-                return self._execute_distributed(sig, plan, values,
-                                                 kind, scaling)
-        return self._execute_distributed(sig, plan, values, kind,
-                                         scaling)
-
-    def _execute_distributed(self, sig, plan, values, kind, scaling):
-        with self._lock:
-            lock = self._sig_locks.get(sig)
-            if lock is None:
-                lock = self._sig_locks[sig] = threading.Lock()
-        with lock:
-            if kind == "backward":
-                return plan.backward(values)
-            return plan.forward(values, scaling)
 
     def _handle_spans(self) -> Tuple[dict, bytes]:
         tracer = _obs.GLOBAL_TRACER
         spans = [{"name": s.name, "trace_id": s.trace_id,
-                  "span_id": s.span_id, "parent_id": s.parent_id}
+                  "span_id": s.span_id, "parent_id": s.parent_id,
+                  "member_trace_ids":
+                      (s.args or {}).get("member_trace_ids")}
                  for s in tracer.events() if isinstance(s, _obs.Span)]
         return ({"type": "spans_ok", "spans": spans,
                  "open": tracer.open_count()}, b"")

@@ -8,6 +8,13 @@ processes (``python -m spfft_tpu.net.agent``), fronts them with
 * a mixed single-device + distributed trace is bit-exact against a
   serial oracle built in THIS process — same plans, different process,
   every payload crossing the frame protocol twice;
+* two CONCURRENT same-signature distributed requests provably
+  coalesce agent-side: signature affinity co-locates them, the
+  agents' ``spmd_batch_window`` (booted off a
+  ``SPFFT_TPU_SERVE_CONFIG`` knob artifact) drains both into one
+  collective round (``spfft_cluster_spmd_coalesced_total`` moves, one
+  ``cluster.spmd_execute`` span carries both member trace ids) and
+  both stay bit-exact;
 * one trace id end-to-end: the agents' ``serve.request`` /
   ``cluster.spmd_execute`` spans (fetched over the ``spans`` RPC)
   carry the frontend's ``cluster.request`` trace ids, and neither side
@@ -46,15 +53,18 @@ _AGENT_ENV = {
 
 
 def _spawn_agent(host: str, store: str, blob: str, warm: str,
-                 timeout: float = 240.0):
+                 timeout: float = 240.0, extra_env=None):
     """Start one agent process and wait for its port announcement.
     Returns ``(proc, port)``; raises if the agent dies before
-    announcing."""
+    announcing. ``extra_env`` merges over the sharding defaults (the
+    smoke uses it to boot agents off a ``SPFFT_TPU_SERVE_CONFIG``
+    knob artifact)."""
     cmd = [sys.executable, "-m", "spfft_tpu.net.agent",
            "--host", host, "--port", "0", "--trace",
            "--store", store, "--blob", blob, "--demo-warm", warm]
     env = dict(os.environ)
     env.update(_AGENT_ENV)
+    env.update(extra_env or {})
     proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
                             stderr=subprocess.DEVNULL, text=True,
                             env=env)
@@ -129,6 +139,15 @@ def _run_pod_smoke(seed: int = 0) -> int:
     tmp = tempfile.TemporaryDirectory(prefix="spfft-pod-smoke-")
     blob = os.path.join(tmp.name, "blob")
     os.makedirs(blob)
+    # knob artifact the agents boot from: a generous coalescing window
+    # so the coalesce phase's concurrent pair provably shares a round
+    from ..control.config import CONFIG_ENV, ServeConfig
+    knob_cfg = ServeConfig()
+    knob_cfg.set("spmd_batch_window", 0.25, source="smoke",
+                 reason="pod-smoke coalesce phase window")
+    knob_path = os.path.join(tmp.name, "serve_config.json")
+    knob_cfg.save(knob_path)
+    agent_env = {CONFIG_ENV: knob_path}
     procs: Dict[str, subprocess.Popen] = {}
     lanes: Dict[str, TcpHostLane] = {}
     pod = None
@@ -136,7 +155,8 @@ def _run_pod_smoke(seed: int = 0) -> int:
         for host in ("h0", "h1"):
             store = os.path.join(tmp.name, f"store-{host}")
             procs[host], port = _spawn_agent(host, store, blob,
-                                             "10,0.9,2,full")
+                                             "10,0.9,2,full",
+                                             extra_env=agent_env)
             lanes[host] = TcpHostLane(host, ("127.0.0.1", port))
         pod = PodFrontend([lanes["h0"], lanes["h1"]], policy="rr",
                           seed=seed)
@@ -160,16 +180,44 @@ def _run_pod_smoke(seed: int = 0) -> int:
         check(np.array_equal(dgot, np.asarray(dplan.backward(dvalues))),
               "distributed result not bit-exact vs serial oracle")
 
+        # -- cross-request SPMD coalescing over the real wire ----------
+        # two concurrent same-signature distributed submits: signature
+        # affinity co-locates them on one agent, whose 0.25 s window
+        # (the knob artifact above) drains both into ONE collective
+        # round — both bit-exact, provably coalesced below
+        dpair = []
+        for _ in range(2):
+            dpair.append([
+                (rng.standard_normal(p.num_values)
+                 + 1j * rng.standard_normal(p.num_values))
+                for p in dplan.dist_plan.shard_plans])
+        pair_futs = [pod.submit(dsig, dv) for dv in dpair]
+        for dv, fut in zip(dpair, pair_futs):
+            got = np.asarray(fut.result(timeout=120))
+            check(np.array_equal(got, np.asarray(dplan.backward(dv))),
+                  "coalesced distributed result not bit-exact vs "
+                  "serial oracle")
+        coalesced = 0.0
+        for host, lane in lanes.items():
+            text = lane.rpc_metrics_text()
+            for line in text.splitlines():
+                if line.startswith("spfft_cluster_spmd_coalesced_total"):
+                    coalesced += float(line.rsplit(None, 1)[-1])
+        check(coalesced >= 2,
+              f"agent-side spfft_cluster_spmd_coalesced_total is "
+              f"{coalesced}, the concurrent pair never shared a round")
+
         # -- one trace id across the process boundary ------------------
         check(tracer.open_count() == 0,
               f"{tracer.open_count()} unclosed client spans")
         roots = [s for s in tracer.events()
                  if isinstance(s, _obs.Span)
                  and s.name == "cluster.request"]
-        check(len(roots) == 25,
-              f"expected 25 cluster.request roots, got {len(roots)}")
+        check(len(roots) == 27,
+              f"expected 27 cluster.request roots, got {len(roots)}")
         root_ids = {s.trace_id for s in roots}
         crossed = 0
+        shared_rounds = []
         for host, lane in lanes.items():
             remote = lane.rpc_spans()
             check(remote["open"] == 0,
@@ -183,13 +231,23 @@ def _run_pod_smoke(seed: int = 0) -> int:
                   f"{host}: {len(foreign)} agent spans carry trace ids "
                   f"no client root issued")
             crossed += len(served)
-        check(crossed >= 25,
+            shared_rounds += [
+                s for s in remote["spans"]
+                if s["name"] == "cluster.spmd_execute"
+                and len(s.get("member_trace_ids") or []) >= 2]
+        # 24 singles + the solo distributed request + ONE coalesced
+        # round serving the concurrent pair
+        check(crossed >= 26,
               f"only {crossed} spans crossed the process boundary")
+        check(len(shared_rounds) == 1
+              and set(shared_rounds[0]["member_trace_ids"]) <= root_ids,
+              f"expected ONE cluster.spmd_execute span serving both "
+              f"paired requests, got {len(shared_rounds)}")
 
         # -- elastic join: boots warm off the blob tier ----------------
         procs["h2"], port2 = _spawn_agent(
             "h2", os.path.join(tmp.name, "store-h2"), blob,
-            "10,0.9,2,dist")
+            "10,0.9,2,dist", extra_env=agent_env)
         lanes["h2"] = TcpHostLane("h2", ("127.0.0.1", port2))
         pod.join(lanes["h2"])
         stats2 = lanes["h2"].rpc_stats()
@@ -267,10 +325,12 @@ def _run_pod_smoke(seed: int = 0) -> int:
         print(f"pod-smoke FAIL: {msg}")
     if failures:
         return 1
-    print(f"pod-smoke: 37 requests bit-exact across a real TCP pod "
+    print(f"pod-smoke: 39 requests bit-exact across a real TCP pod "
           f"(2 processes + 1 mid-stream join, builds=0 on the joiner, "
-          f"kill -9 failover typed, {crossed} spans crossed the "
-          f"process boundary on one trace id each)")
+          f"a concurrent distributed pair COALESCED into one "
+          f"collective round agent-side, kill -9 failover typed, "
+          f"{crossed} spans crossed the process boundary on one "
+          f"trace id each)")
     print("POD SMOKE GREEN")
     return 0
 

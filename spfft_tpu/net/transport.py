@@ -7,13 +7,21 @@ executor on the far side of a socket — the frontend cannot tell them
 apart (``PodFrontend`` routes, reconciles, federates and fails over
 identically), which is the whole point of the seam.
 
-Each RPC opens one connection, sends one framed request and reads one
-framed response (:mod:`~spfft_tpu.net.frame`). Connection/read
-failures, protocol violations and injected ``cluster.rpc``/``net.*``
-faults all translate into the typed, transient ``HostLaneError`` the
-frontend's route-around handling keys on; a typed ``error`` record in
-the response re-raises as its original taxonomy class (a remote
-``QueueFullError`` stays backpressure, not lane death).
+RPCs ride POOLED keep-alive connections: a completed round trip
+returns its socket to a :class:`_SocketPool` and the next RPC reuses
+it (the agent's connection loop already serves many frames per
+connection), with an idle-timeout reaper closing sockets the traffic
+no longer needs — ``pool=False`` restores the round-19
+one-connect-per-RPC wire the ``pod_wire`` bench row measures.
+Connection/read failures, protocol violations and injected
+``cluster.rpc``/``net.*`` faults all translate into the typed,
+transient ``HostLaneError`` the frontend's route-around handling keys
+on (a stale pooled socket is NOT a failure: checkout probes liveness
+and a send that trips over a just-closed keep-alive falls back to a
+fresh connect, so a dead host still surfaces synchronously at
+``start_call`` where the frontend fails over); a typed ``error``
+record in the response re-raises as its original taxonomy class (a
+remote ``QueueFullError`` stays backpressure, not lane death).
 
 The transport measures each successful round trip into an EWMA
 (:attr:`TcpTransport.rtt`, exported as
@@ -51,6 +59,122 @@ def _ctx_to_wire(ctx) -> Optional[dict]:
     return None if ctx is None else ctx.to_wire()
 
 
+class _SocketPool:
+    """Idle keep-alive sockets for one transport's (host, address).
+
+    ``checkout`` hands back a pooled socket after a liveness probe
+    (non-blocking ``MSG_PEEK``: a server-closed keep-alive reads EOF
+    and is discarded; unexpected buffered bytes mean a desynced stream
+    and are discarded too) or ``None`` on a miss; ``checkin`` returns
+    a socket whose RPC completed cleanly. A lazy daemon reaper closes
+    sockets idle past ``idle_timeout`` seconds, so a traffic lull does
+    not pin file descriptors on either side of the wire. The client
+    idle timeout sits well under the agent's per-connection read
+    timeout (``net_rpc_timeout_ms``, 30 s default), so the client
+    side, not the server, retires idle connections."""
+
+    def __init__(self, idle_timeout: float = 5.0, max_idle: int = 8):
+        self.idle_timeout = float(idle_timeout)
+        self.max_idle = int(max_idle)
+        self._lock = threading.Lock()
+        self._idle: List[Tuple[socket.socket, float]] = []  #: guarded by _lock
+        self._closed = False  #: guarded by _lock
+        self._reaper: Optional[threading.Thread] = None  #: guarded by _lock
+        self.hits = 0  #: guarded by _lock
+        self.misses = 0  #: guarded by _lock
+        self.reaped = 0  #: guarded by _lock
+
+    @staticmethod
+    def _alive(sock) -> bool:
+        try:
+            sock.setblocking(False)
+            try:
+                chunk = sock.recv(1, socket.MSG_PEEK)
+            finally:
+                sock.setblocking(True)
+        except (BlockingIOError, InterruptedError):
+            return True  # nothing buffered: healthy idle keep-alive
+        except OSError:
+            return False
+        # EOF (b"") = server closed; actual bytes = desynced stream —
+        # either way the socket is not reusable
+        del chunk
+        return False
+
+    def checkout(self):
+        with self._lock:
+            while self._idle:
+                sock, _ = self._idle.pop()
+                if self._alive(sock):
+                    self.hits += 1
+                    return sock
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            self.misses += 1
+            return None
+
+    def checkin(self, sock) -> None:
+        with self._lock:
+            if not self._closed and len(self._idle) < self.max_idle:
+                self._idle.append((sock, time.monotonic()))
+                self._ensure_reaper_locked()
+                return
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    # lock: holds(_lock)
+    def _ensure_reaper_locked(self) -> None:
+        if self._reaper is None or not self._reaper.is_alive():
+            self._reaper = threading.Thread(
+                target=self._reap_loop, daemon=True,
+                name="spfft-net-pool-reaper")
+            self._reaper.start()
+
+    def _reap_loop(self) -> None:
+        while True:
+            time.sleep(max(self.idle_timeout / 4.0, 0.05))
+            now = time.monotonic()
+            stale: List[socket.socket] = []
+            with self._lock:
+                keep = []
+                for sock, stamp in self._idle:
+                    if now - stamp > self.idle_timeout:
+                        stale.append(sock)
+                    else:
+                        keep.append((sock, stamp))
+                self._idle = keep
+                self.reaped += len(stale)
+                done = self._closed or not self._idle
+                if done:
+                    self._reaper = None
+            for sock in stale:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            if done:
+                return
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"idle": len(self._idle), "hits": self.hits,
+                    "misses": self.misses, "reaped": self.reaped}
+
+    def close(self) -> None:
+        with self._lock:
+            idle, self._idle = self._idle, []
+            self._closed = True
+        for sock, _ in idle:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
 class TcpTransport(LoopbackTransport):
     """The wire twin of ``LoopbackTransport``: same ``check`` seam
     (liveness + the ``cluster.rpc`` fault site), plus :meth:`call` —
@@ -61,7 +185,9 @@ class TcpTransport(LoopbackTransport):
 
     def __init__(self, host: str, address: Tuple[str, int],
                  connect_timeout: Optional[float] = None,
-                 rpc_timeout: Optional[float] = None):
+                 rpc_timeout: Optional[float] = None,
+                 pool: bool = True,
+                 pool_idle_timeout: float = 5.0):
         super().__init__(host)
         self.address = (str(address[0]), int(address[1]))
         cfg = global_config()
@@ -73,6 +199,7 @@ class TcpTransport(LoopbackTransport):
             else cfg.net_rpc_timeout_ms / 1000.0)
         self._rtt_lock = threading.Lock()
         self._rtt = 0.0  #: guarded by _rtt_lock
+        self._pool = _SocketPool(pool_idle_timeout) if pool else None
 
     @property
     def rtt(self) -> float:
@@ -98,14 +225,30 @@ class TcpTransport(LoopbackTransport):
         :class:`HostLaneError`."""
         op = str(header.get("type", "?"))
         t0 = time.monotonic()
+        read_timeout = timeout if timeout is not None \
+            else self._rpc_timeout
+        if self._pool is not None:
+            sock = self._pool.checkout()
+            if sock is not None:
+                try:
+                    sock.settimeout(read_timeout)
+                    send_frame(sock, header, payload)
+                    return sock, op, t0
+                except OSError:
+                    # the keep-alive went stale between checkout and
+                    # send (server FIN in flight): fall back to a
+                    # fresh connect — a genuinely dead host fails THAT
+                    sock.close()
+                except (NetProtocolError, InjectedFault) as exc:
+                    sock.close()
+                    raise self._fail(op, exc) from exc
         try:
             sock = socket.create_connection(
                 self.address, timeout=self._connect_timeout)
         except (OSError, InjectedFault) as exc:
             raise self._fail(op, exc) from exc
         try:
-            sock.settimeout(timeout if timeout is not None
-                            else self._rpc_timeout)
+            sock.settimeout(read_timeout)
             send_frame(sock, header, payload)
         except (OSError, NetProtocolError, InjectedFault) as exc:
             sock.close()
@@ -117,14 +260,19 @@ class TcpTransport(LoopbackTransport):
         """The (possibly deferred) second half: read the response
         frame, fold the measured round trip into :attr:`rtt`, and
         re-raise a typed ``error`` record as its original taxonomy
-        class."""
+        class. A cleanly completed round trip returns its socket to
+        the keep-alive pool (the stream stays framed even after a
+        typed error reply — the agent's connection loop keeps
+        serving); any read failure closes it."""
         try:
-            try:
-                reply, rpayload = recv_frame(sock)
-            finally:
-                sock.close()
+            reply, rpayload = recv_frame(sock)
         except (OSError, NetProtocolError, InjectedFault) as exc:
+            sock.close()
             raise self._fail(op, exc) from exc
+        if self._pool is not None:
+            self._pool.checkin(sock)
+        else:
+            sock.close()
         dt = time.monotonic() - t0
         with self._rtt_lock:
             self._rtt = dt if self._rtt <= 0.0 \
@@ -143,6 +291,17 @@ class TcpTransport(LoopbackTransport):
         sock, op, t0 = self.start_call(header, payload, timeout)
         return self.finish_call(sock, op, t0)
 
+    def pool_stats(self) -> Optional[dict]:
+        """Keep-alive pool counters (idle/hits/misses/reaped); None on
+        an unpooled transport."""
+        return None if self._pool is None else self._pool.stats()
+
+    def close(self) -> None:
+        """Close any idle keep-alive sockets (in-flight RPCs keep
+        theirs until finish_call)."""
+        if self._pool is not None:
+            self._pool.close()
+
 
 class TcpHostLane(HostLane):
     """A ``HostLane`` whose executor lives in another process behind a
@@ -154,13 +313,14 @@ class TcpHostLane(HostLane):
     def __init__(self, host: str, address: Tuple[str, int],
                  connect_timeout: Optional[float] = None,
                  rpc_timeout: Optional[float] = None,
-                 max_inflight: int = 8):
+                 max_inflight: int = 8, pool: bool = True):
         self.host = host
         self.executor = None
         self.draining = False
         self.transport = TcpTransport(host, address,
                                       connect_timeout=connect_timeout,
-                                      rpc_timeout=rpc_timeout)
+                                      rpc_timeout=rpc_timeout,
+                                      pool=pool)
         self._pool = ThreadPoolExecutor(
             max_workers=max_inflight,
             thread_name_prefix=f"spfft-net-{host}")
@@ -268,17 +428,21 @@ class TcpHostLane(HostLane):
                 "open": int(reply.get("open", 0))}
 
     def close(self) -> None:
-        """Release the lane's client thread pool (the remote agent is
-        NOT shut down — lanes don't own hosts)."""
+        """Release the lane's client thread pool and any idle
+        keep-alive sockets (the remote agent is NOT shut down — lanes
+        don't own hosts)."""
         self._pool.shutdown(wait=True)
+        self.transport.close()
 
 
 def wire_overhead_probe(repeats: int = 24, n: int = 8) -> dict:
     """Measure what the wire costs: median ``rpc_submit`` round trip of
     a tiny C2C backward through a loopback lane vs through an
-    in-process TCP agent fronting the SAME executor. Returns
-    microsecond medians plus the delta — the ``pod_wire`` bench
-    sub-row. Both paths are warmed (JIT + connection machinery) before
+    in-process TCP agent fronting the SAME executor — once over the
+    round-19 connect-per-RPC wire (the ``pod_wire`` bench sub-row,
+    semantics unchanged) and once over the pooled keep-alive wire (the
+    ``pod_wire_pooled`` sub-row). Returns microsecond medians plus the
+    deltas. All paths are warmed (JIT + connection machinery) before
     timing so the medians compare steady-state transports, not compile
     time."""
     import statistics
@@ -312,17 +476,24 @@ def wire_overhead_probe(repeats: int = 24, n: int = 8) -> dict:
 
     agent = None
     tcp_lane = None
+    pooled_lane = None
     try:
         loop_lane = HostLane("probe-loop", executor)
         loop_s = timed(loop_lane)
         agent = HostAgent("probe-tcp", executor)
         agent.start()
         tcp_lane = TcpHostLane("probe-tcp",
-                               ("127.0.0.1", agent.port))
+                               ("127.0.0.1", agent.port), pool=False)
         tcp_s = timed(tcp_lane)
+        pooled_lane = TcpHostLane("probe-tcp-pooled",
+                                  ("127.0.0.1", agent.port), pool=True)
+        pooled_s = timed(pooled_lane)
+        pool_stats = pooled_lane.transport.pool_stats() or {}
     finally:
         if tcp_lane is not None:
             tcp_lane.close()
+        if pooled_lane is not None:
+            pooled_lane.close()
         if agent is not None:
             agent.close()
         executor.close(drain=False)
@@ -330,5 +501,9 @@ def wire_overhead_probe(repeats: int = 24, n: int = 8) -> dict:
         "repeats": int(repeats),
         "loopback_us": loop_s * 1e6,
         "tcp_us": tcp_s * 1e6,
+        "tcp_pooled_us": pooled_s * 1e6,
         "overhead_us": max(0.0, (tcp_s - loop_s) * 1e6),
+        "overhead_pooled_us": max(0.0, (pooled_s - loop_s) * 1e6),
+        "pool_hits": int(pool_stats.get("hits", 0)),
+        "pool_misses": int(pool_stats.get("misses", 0)),
     }

@@ -137,6 +137,13 @@ METRIC_SPECS: Dict[str, Tuple[str, str]] = {
     "spfft_cluster_spmd_requests_total":
         ("counter", "Distributed-plan requests executed on the "
                     "pod-wide SPMD lane."),
+    "spfft_cluster_spmd_coalesced_total":
+        ("counter", "Distributed requests that shared a coalesced SPMD "
+                    "window round (batch >= 2) — one collective round "
+                    "moved all of them."),
+    "spfft_cluster_spmd_batch_size_total":
+        ("counter", "Coalesced SPMD rounds by batch size, labelled "
+                    "{size} (the coalescer's batch-size histogram)."),
     "spfft_cluster_lane_deaths_total":
         ("counter", "Host lanes marked dead by the pod frontend, "
                     "labelled by host."),
@@ -313,6 +320,10 @@ METRIC_SPECS: Dict[str, Tuple[str, str]] = {
          "the third load_score term, labelled {host}."),
     "spfft_net_agent_requests_total":
         ("counter", "Requests a HostAgent served, labelled {op}."),
+    "spfft_net_agent_rejected_total":
+        ("counter",
+         "Submits a HostAgent refused at its own admission seam, "
+         "labelled {reason=queue_full|expired}."),
     "spfft_blob_ops_total":
         ("counter",
          "Remote blob-tier operations, labelled {op=get|put, "

@@ -1911,6 +1911,32 @@ class DistributedTransformPlan:
                 space_batch, *self._device_tables)
         return box.value
 
+    # -- cross-request coalescing --------------------------------------------
+    def coalesce_backward(self, values_list: Sequence):
+        """Backward-execute N independent requests' value sets as ONE fused
+        SPMD program and demux: one exchange collective round moves all N
+        payloads (the Grid amortization, resurrected for the pod lane).
+        ``values_list``: N value sets (each a per-shard list or padded
+        (S, mv, 2) array). Returns a list of N per-request (S, planes, ...)
+        space arrays, each identical to ``self.backward(values_list[i])``."""
+        if self._local1 is not None or len(values_list) == 1:
+            # comm-size-1 delegates have no batched body; a batch of one
+            # gains nothing — run the serial path per request.
+            return [self.backward(v) for v in values_list]
+        stacked = self.backward_batched(values_list)
+        return [stacked[:, b] for b in range(len(values_list))]
+
+    def coalesce_forward(self, space_list: Sequence,
+                         scaling: Scaling = Scaling.NONE):
+        """Forward twin of :meth:`coalesce_backward`: N space slabs through
+        one batched SPMD program, demuxed to N per-request (S, mv, 2) value
+        arrays, each identical to ``self.forward(space_list[i], scaling)``."""
+        scaling = Scaling(scaling)
+        if self._local1 is not None or len(space_list) == 1:
+            return [self.forward(s, scaling) for s in space_list]
+        stacked = self.forward_batched(space_list, scaling)
+        return [stacked[:, b] for b in range(len(space_list))]
+
 
 def make_distributed_plan(transform_type: TransformType,
                           dim_x: int, dim_y: int, dim_z: int,

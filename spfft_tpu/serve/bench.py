@@ -740,7 +740,10 @@ def _run_chaos(args) -> int:
        recovered through the serial fallback;
     D. killing one host lane of a 2-host pod mid-trace degrades the
        pod, the killed lane's queue resolves typed (never hangs), and
-       every post-kill request lands bit-exact on the survivor —
+       every post-kill request lands bit-exact on the survivor;
+    D2. an armed ``cluster.spmd_window`` fault fails EVERY member of a
+       coalesced SPMD round typed, and the next round (the one-shot
+       script spent) is bit-exact —
 
     then 16 fault STORMS, every choice drawn from ONE seeded RNG: each
     storm arms a scripted multi-site :class:`~spfft_tpu.faults`
@@ -975,6 +978,63 @@ def _run_chaos(args) -> int:
             ex_l.close()
     spans_closed("phaseD")
 
+    # -- phase D2: SPMD window fault fails the whole round typed ------
+    # chaos-smoke runs on a 1-device mesh, so the storm aims a
+    # duck-typed plan at the coalescer's window seam: one armed
+    # ``cluster.spmd_window`` fault must fail EVERY coalesced member
+    # typed, and the next round (fault spent) must be bit-exact.
+    from ..control.config import global_config
+    from ..types import Scaling
+    from .cluster import SPMDCoalescer
+
+    class _CoalescePlan:
+        def coalesce_backward(self, values_list):
+            return [np.asarray(v) * 2.0 for v in values_list]
+
+    spmd_fp = FaultPlan(script="cluster.spmd_window@1")
+    faults.arm(spmd_fp)
+    spmd = SPMDCoalescer(max_workers=1)
+    cfg_d2 = global_config()
+    old_window = cfg_d2.spmd_batch_window
+    cfg_d2.set("spmd_batch_window", 0.3, source="chaos",
+               reason="phase D2 coalescing window")
+    try:
+        doomed = [spmd.submit(osig, _CoalescePlan(), vals(),
+                              "backward", Scaling.NONE, None)
+                  for _ in range(2)]
+        spmd_failed = 0
+        for i, f in enumerate(doomed):
+            try:
+                f.result(timeout=60)
+                check(False, f"phaseD2: coalesced member {i} served "
+                             f"through an armed window fault")
+            except typed:
+                spmd_failed += 1
+            except Exception as exc:
+                check(False, f"phaseD2: member {i} failed UNTYPED "
+                             f"{type(exc).__name__}: {exc}")
+        good_v = [vals() for _ in range(2)]
+        healed = [spmd.submit(osig, _CoalescePlan(), v, "backward",
+                              Scaling.NONE, None) for v in good_v]
+        for i, (f, v) in enumerate(zip(healed, good_v)):
+            check(np.array_equal(np.asarray(f.result(timeout=60)),
+                                 np.asarray(v) * 2.0),
+                  f"phaseD2: post-fault round member {i} diverged")
+        sig_d2 = spmd.signals()
+        check(sig_d2["spmd_coalesced"] >= 2,
+              f"phaseD2: the window never coalesced: {sig_d2}")
+    finally:
+        faults.disarm()
+        cfg_d2.set("spmd_batch_window", old_window, source="chaos",
+                   reason="restore after phase D2")
+        spmd.close()
+    tally(spmd_fp)
+    phases["D2_spmd_window_fault"] = {
+        "typed_failures": spmd_failed,
+        "coalesced": sig_d2["spmd_coalesced"],
+        "launches": sig_d2["spmd_launches"]}
+    spans_closed("phaseD2")
+
     # -- seeded storms -------------------------------------------------
     #: site menu: (site, subsystem, flow order, script kinds). Extras
     #: are only drawn from LATER flow stages than the primary, so the
@@ -992,6 +1052,7 @@ def _run_chaos(args) -> int:
         ("loop", "executor", 9, ("transient", "permanent")),
     )
     subsystem_of = {site: sub for site, sub, _, _ in menu}
+    subsystem_of["cluster.spmd_window"] = "cluster"  # phase D2
     storms = 16
     wave = 5
     storm_log = []

@@ -47,9 +47,12 @@ Round-18. See docs/cluster.md.
 
 from __future__ import annotations
 
+import heapq
+import math
 import random
 import threading
 import time
+import zlib
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -208,32 +211,72 @@ class HostLane:
         return self.executor.registry.stats()
 
 
-class _SPMDLane:
-    """The pod-wide distributed lane: executes
-    ``DistributedTransformPlan`` requests on a small worker pool,
-    serialized per-signature — concurrent requests for one signature
-    queue behind its lock (a shard_map executable spans the whole mesh;
-    overlapping launches of the same executable would interleave on
-    every device and win nothing), while different signatures may
-    overlap."""
+class _SPMDRequest:
+    """One queued distributed request inside the coalescer."""
 
-    def __init__(self, max_workers: int = 2):
+    __slots__ = ("plan", "values", "root", "deadline", "priority",
+                 "future")
+
+    def __init__(self, plan, values, root, deadline, priority):
+        self.plan = plan
+        self.values = values
+        self.root = root
+        self.deadline = deadline
+        self.priority = priority
+        self.future: Future = Future()
+
+
+class SPMDCoalescer:
+    """The pod-wide distributed lane, grown into a coalescing
+    scheduler: N queued same-signature distributed requests drain into
+    ONE batched SPMD execution whose exchange moves all N payloads in a
+    single collective round (the reference's shared-``Grid``
+    amortization, resurrected for the pod — the distributed twin of the
+    executor's fused batching win).
+
+    Requests queue per ``(signature, kind, scaling)`` key in EDF order
+    (high priority first, then earliest deadline, then arrival). A
+    per-key drainer waits out a ``spmd_batch_window``-long batching
+    window — closed EARLY when a queued deadline would lapse inside it
+    or a high-priority member is already aboard — then executes up to
+    ``spmd_max_batch`` requests through the plan's
+    ``coalesce_backward``/``coalesce_forward`` batched entry points and
+    demuxes per-request results. Plans without batched entry points
+    (and comm-size-1 delegates, and windows that close with a single
+    member) fall back to the per-request serial path, so coalescing is
+    strictly an optimization: every interleaving is bit-exact vs serial
+    execution.
+
+    Admission is unchanged from the round-19 lane: the queue is bounded
+    by the ``max_queue`` knob (typed ``QueueFullError``), and expired
+    deadlines purge as ``DeadlineExpiredError`` — now also at
+    window-drain time, so a request that dies while queued never rides
+    a collective round."""
+
+    #: bound on the launch-duration reservoir feeding signals()
+    _RESERVOIR = 256
+
+    def __init__(self, max_workers: int = 2,
+                 span_args: Optional[dict] = None):
         self._pool = ThreadPoolExecutor(
             max_workers=max_workers, thread_name_prefix="spfft-pod-spmd")
-        self._lock = threading.Lock()
-        self._locks: Dict[PlanSignature, threading.Lock] = {}  #: guarded by _lock
-        self._depth = 0  #: guarded by _lock
+        self._cv = threading.Condition()
+        self._queues: Dict[tuple, list] = {}  #: guarded by _cv
+        self._active: set = set()  #: guarded by _cv
+        self._depth = 0  #: guarded by _cv
+        self._seq = 0  #: guarded by _cv
+        self._closed = False  #: guarded by _cv
+        self._launches = 0  #: guarded by _cv
+        self._coalesced = 0  #: guarded by _cv
+        self._batch_hist: Dict[int, int] = {}  #: guarded by _cv
+        self._launch_s: List[float] = []  #: guarded by _cv
+        self._span_args = dict(span_args or {})
 
-    def _lock_for(self, signature: PlanSignature) -> threading.Lock:
-        with self._lock:
-            lock = self._locks.get(signature)
-            if lock is None:
-                lock = self._locks[signature] = threading.Lock()
-            return lock
-
+    # -- admission ----------------------------------------------------------
     def submit(self, signature: PlanSignature, plan, values, kind: str,
                scaling: Scaling, root,
-               timeout: Optional[float] = None) -> Future:
+               timeout: Optional[float] = None,
+               priority: str = "normal") -> Future:
         """Admission-controlled enqueue: the lane's queue is bounded by
         the control plane's ``max_queue`` knob (overflow is the same
         typed ``QueueFullError`` backpressure the single-host executor
@@ -242,7 +285,13 @@ class _SPMDLane:
         the whole mesh on an answer nobody awaits."""
         from ..control.config import global_config
         cap = int(global_config().max_queue)
-        with self._lock:
+        deadline = None if timeout is None \
+            else time.monotonic() + float(timeout)
+        req = _SPMDRequest(plan, values, root, deadline, priority)
+        key = (signature, kind, Scaling(scaling))
+        with self._cv:
+            if self._closed:
+                raise ClusterError("pod SPMD lane is closed")
             if self._depth >= cap:
                 _obs.GLOBAL_COUNTERS.inc(
                     "spfft_cluster_spmd_rejected_total",
@@ -250,42 +299,181 @@ class _SPMDLane:
                 raise QueueFullError(
                     f"pod SPMD lane queue is full ({cap})")
             self._depth += 1
-        deadline = None if timeout is None \
-            else time.monotonic() + float(timeout)
-        return self._pool.submit(self._run, signature, plan, values,
-                                 kind, scaling, root, deadline)
+            self._seq += 1
+            rank = (0 if priority == "high" else 1,
+                    math.inf if deadline is None else deadline,
+                    self._seq)
+            heapq.heappush(self._queues.setdefault(key, []),
+                           rank + (req,))
+            if key not in self._active:
+                self._active.add(key)
+                self._pool.submit(self._drain_key, key)
+            self._cv.notify_all()
+        return req.future
 
-    def _run(self, signature, plan, values, kind, scaling, root,
-             deadline):
+    # -- the drain loop -----------------------------------------------------
+    def _drain_key(self, key) -> None:
+        """Form and execute coalescing rounds for one key until its
+        queue is dry. Between rounds the drainer hands its pool slot
+        back (resubmitting itself) so other signatures' drainers get a
+        turn under a small pool."""
+        while True:
+            bucket = self._collect(key)
+            if bucket:
+                self._execute_round(key, bucket)
+            with self._cv:
+                if not self._queues.get(key):
+                    self._active.discard(key)
+                    self._queues.pop(key, None)
+                    return
+                if not self._closed:
+                    try:
+                        self._pool.submit(self._drain_key, key)
+                        return
+                    except RuntimeError:  # pragma: no cover
+                        pass  # pool shutting down: finish inline
+
+    def _collect(self, key) -> List[_SPMDRequest]:
+        """Wait out the batching window, absorbing same-key arrivals
+        until the bucket is full or the window closes (early on an
+        imminent member deadline or a high-priority member). Expired
+        queued requests purge here — the drain-time half of the
+        deadline contract."""
+        from ..control.config import global_config
+        cfg = global_config()
+        window = float(cfg.spmd_batch_window)
+        cap = max(1, int(cfg.spmd_max_batch))
+        bucket: List[_SPMDRequest] = []
+        purged: List[_SPMDRequest] = []
+        until = None
+        with self._cv:
+            while True:
+                now = time.monotonic()
+                lane = self._queues.get(key) or []
+                expired = [e for e in lane if e[1] <= now]
+                if expired:
+                    lane[:] = [e for e in lane if e[1] > now]
+                    heapq.heapify(lane)
+                    purged.extend(e[3] for e in expired)
+                    self._depth -= len(expired)
+                while lane and len(bucket) < cap:
+                    bucket.append(heapq.heappop(lane)[3])
+                if len(bucket) >= cap or self._closed or not bucket:
+                    break
+                if until is None:
+                    until = now + window
+                close_at = min(until,
+                               min((r.deadline for r in bucket
+                                    if r.deadline is not None),
+                                   default=math.inf))
+                if close_at - now <= 0 \
+                        or any(r.priority == "high" for r in bucket):
+                    break
+                self._cv.wait(close_at - now)
+        # purged futures resolve OUTSIDE the lock (done callbacks run
+        # arbitrary frontend code)
+        for req in purged:
+            _obs.GLOBAL_COUNTERS.inc("spfft_cluster_spmd_rejected_total",
+                                     reason="expired")
+            req.future.set_exception(DeadlineExpiredError(
+                "distributed request deadline expired in the SPMD "
+                "lane queue"))
+        return bucket
+
+    # -- one coalesced round ------------------------------------------------
+    def _execute_round(self, key, bucket: List[_SPMDRequest]) -> None:
+        signature, kind, scaling = key
+        batch = len(bucket)
+        _obs.GLOBAL_COUNTERS.inc("spfft_cluster_spmd_requests_total",
+                                 batch)
+        span = None
+        traced = [r for r in bucket if r.root is not None]
+        if traced and _obs.active():
+            first = traced[0].root
+            args = {"kind": kind, "batch": batch,
+                    "member_trace_ids": [r.root.trace_id
+                                         for r in traced]}
+            args.update(self._span_args)
+            # span: closed-by(SPMDCoalescer._execute_round)
+            span = _obs.GLOBAL_TRACER.begin(
+                "cluster.spmd_execute", cat="cluster",
+                trace_id=first.trace_id, parent=first,
+                track="pod:spmd", args=args)
+        t0 = time.perf_counter()
         try:
-            _obs.GLOBAL_COUNTERS.inc("spfft_cluster_spmd_requests_total")
-            if deadline is not None and time.monotonic() > deadline:
-                _obs.GLOBAL_COUNTERS.inc(
-                    "spfft_cluster_spmd_rejected_total",
-                    reason="expired")
-                raise DeadlineExpiredError(
-                    "distributed request deadline expired in the SPMD "
-                    "lane queue")
-            if root is not None and _obs.active():
-                with _obs.GLOBAL_TRACER.span(
-                        "cluster.spmd_execute", trace_id=root.trace_id,
-                        parent=root, track="pod:spmd",
-                        args={"kind": kind}):
-                    return self._execute(signature, plan, values, kind,
-                                         scaling)
-            return self._execute(signature, plan, values, kind, scaling)
-        finally:
-            with self._lock:
-                self._depth -= 1
+            _faults.check_site("cluster.spmd_window")
+            results = self._execute(bucket[0].plan,
+                                    [r.values for r in bucket],
+                                    kind, scaling)
+        except BaseException as exc:
+            if span is not None:
+                _obs.GLOBAL_TRACER.finish(span, status="error",
+                                          error=type(exc).__name__)
+            self._finish_round(batch, time.perf_counter() - t0)
+            for req in bucket:
+                req.future.set_exception(exc)
+            return
+        if span is not None:
+            _obs.GLOBAL_TRACER.finish(span)
+        self._finish_round(batch, time.perf_counter() - t0)
+        if batch > 1:
+            _obs.GLOBAL_COUNTERS.inc("spfft_cluster_spmd_coalesced_total",
+                                     batch)
+        _obs.GLOBAL_COUNTERS.inc("spfft_cluster_spmd_batch_size_total",
+                                 size=str(batch))
+        for req, result in zip(bucket, results):
+            req.future.set_result(result)
 
-    def _execute(self, signature, plan, values, kind, scaling):
-        with self._lock_for(signature):
-            if kind == "backward":
-                return plan.backward(values)
-            return plan.forward(values, scaling)
+    def _finish_round(self, batch: int, seconds: float) -> None:
+        with self._cv:
+            self._depth -= batch
+            self._launches += 1
+            self._batch_hist[batch] = self._batch_hist.get(batch, 0) + 1
+            if batch > 1:
+                self._coalesced += batch
+            self._launch_s.append(seconds)
+            del self._launch_s[:-self._RESERVOIR]
+
+    @staticmethod
+    def _execute(plan, values_list, kind, scaling):
+        """Batched execution when the plan offers it; the per-request
+        serial path otherwise (duck-typed test plans, remote
+        descriptors). ``coalesce_*`` itself serializes batch==1 and
+        comm-size-1 delegates, so this seam is bit-exactness-neutral."""
+        if kind == "backward":
+            coalesce = getattr(plan, "coalesce_backward", None)
+            if coalesce is not None:
+                return coalesce(values_list)
+            return [plan.backward(v) for v in values_list]
+        coalesce = getattr(plan, "coalesce_forward", None)
+        if coalesce is not None:
+            return coalesce(values_list, scaling)
+        return [plan.forward(v, scaling) for v in values_list]
+
+    # -- telemetry ----------------------------------------------------------
+    def signals(self) -> dict:
+        """Live coalescer signals for the controller's
+        ``spmd_batch_window``/``spmd_max_batch`` rule."""
+        with self._cv:
+            depth = self._depth
+            launches = self._launches
+            coalesced = self._coalesced
+            hist = dict(self._batch_hist)
+            samples = sorted(self._launch_s)
+        p50 = samples[len(samples) // 2] if samples else 0.0
+        return {"spmd_queue_depth": depth, "spmd_launches": launches,
+                "spmd_coalesced": coalesced, "spmd_launch_p50": p50,
+                "spmd_batch_hist": hist}
 
     def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
         self._pool.shutdown(wait=True)
+
+
+#: Back-compat name for the round-19 lane the coalescer grew out of.
+_SPMDLane = SPMDCoalescer
 
 
 class PodFrontend:
@@ -473,15 +661,21 @@ class PodFrontend:
         try:
             if distributed and not remote:
                 fut = self._spmd.submit(signature, plan, values, kind,
-                                        scaling, root, timeout=timeout)
+                                        scaling, root, timeout=timeout,
+                                        priority=priority)
                 _obs.GLOBAL_COUNTERS.inc("spfft_cluster_routed_total",
                                          host="pod", kind="distributed")
             else:
+                # remote distributed descriptors route with SIGNATURE
+                # AFFINITY: the agent-side coalescing window can only
+                # merge what routing co-locates, so concurrent
+                # same-signature requests must land on the same host
                 fut = self._submit_single(
                     signature, values, kind, scaling, timeout, priority,
                     _obs.span_context(root),
                     routed_kind="distributed" if distributed
-                    else "single")
+                    else "single",
+                    affinity=signature if distributed else None)
         except BaseException as exc:
             self._settle(root, exc)
             raise
@@ -539,13 +733,17 @@ class PodFrontend:
 
     def _submit_single(self, signature, values, kind, scaling, timeout,
                        priority, ctx,
-                       routed_kind: str = "single") -> Future:
-        """Pick a host (p2c or rr), fail over across survivors on
-        transport errors. Backpressure (``QueueFullError``) and every
-        other executor-side error propagate untranslated — routing only
-        absorbs the lane-is-unreachable failure mode."""
+                       routed_kind: str = "single",
+                       affinity=None) -> Future:
+        """Pick a host (p2c or rr; signature affinity when given), fail
+        over across survivors on transport errors. Backpressure
+        (``QueueFullError``) and every other executor-side error
+        propagate untranslated — routing only absorbs the
+        lane-is-unreachable failure mode."""
         _faults.check_site("cluster.route")
-        for lane in self._candidates():
+        candidates = (self._candidates() if affinity is None
+                      else self._affinity_candidates(affinity))
+        for lane in candidates:
             try:
                 fut = lane.rpc_submit(signature, values, kind,
                                       scaling=scaling, timeout=timeout,
@@ -590,6 +788,19 @@ class PodFrontend:
         rest = [ln for ln in alive
                 if ln.alive and ln not in picked]
         return picked + rest
+
+    def _affinity_candidates(self, signature) -> List[HostLane]:
+        """Lanes in dispatch order for a remote DISTRIBUTED request: a
+        stable per-signature primary (crc32 of the signature's repr mod
+        the alive-lane count) so concurrent same-signature requests
+        co-locate and the host agent's coalescing window can merge
+        them; the remaining alive lanes follow as failover."""
+        alive = [ln for ln in self._lanes
+                 if ln.alive and not ln.draining]
+        if len(alive) <= 1:
+            return alive
+        start = zlib.crc32(repr(signature).encode()) % len(alive)
+        return alive[start:] + alive[:start]
 
     def _mark_dead(self, lane: HostLane) -> None:
         if lane.transport.alive:

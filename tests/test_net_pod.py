@@ -40,7 +40,7 @@ from spfft_tpu.net.frame import (error_from_wire, error_to_wire,
                                  pack_values, recv_frame, send_frame,
                                  signature_from_wire,
                                  signature_to_wire, unpack_values)
-from spfft_tpu.net.transport import TcpHostLane
+from spfft_tpu.net.transport import TcpHostLane, _SocketPool
 from spfft_tpu.serve.cluster import PodFrontend, _SPMDLane
 from spfft_tpu.serve.executor import ServeExecutor
 from spfft_tpu.serve.registry import PlanRegistry, signature_for
@@ -296,6 +296,169 @@ def test_spmd_lane_queue_full_and_deadline_purge(plans):
         cfg.set("max_queue", old, source="test",
                 reason="restore after admission test")
         lane.close()
+
+
+# ---------------------------------------------------------------------------
+# connection pooling (net/transport.py _SocketPool)
+# ---------------------------------------------------------------------------
+
+def test_socket_pool_reuses_connections(plans):
+    """Sequential RPCs over one TcpHostLane ride ONE kept-alive
+    socket: the first call dials (a pool miss), the rest are pool
+    hits — and the answers stay bit-exact."""
+    reg = PlanRegistry()
+    reg.put(plans["sig"], plans["plan"])
+    ex = ServeExecutor(reg)
+    agent = HostAgent("pool0", ex).start()
+    lane = TcpHostLane("pool0", ("127.0.0.1", agent.port))
+    rng = np.random.default_rng(5)
+    try:
+        for _ in range(4):
+            v = _vals(plans, rng)
+            got = np.asarray(lane.rpc_submit(plans["sig"], v)
+                             .result(timeout=120))
+            assert np.array_equal(
+                got, np.asarray(plans["plan"].backward(v)))
+        stats = lane.transport.pool_stats()
+        assert stats["misses"] >= 1
+        assert stats["hits"] >= 2
+        assert stats["idle"] >= 1  # the socket went back on the shelf
+    finally:
+        lane.close()
+        agent.close()
+        ex.close(drain=False)
+
+
+def test_socket_pool_reaper_closes_idle():
+    """Idle pooled sockets older than the idle timeout are reaped by
+    the background thread (no descriptor leak behind a quiet lane)."""
+    a, b = socket.socketpair()
+    pool = _SocketPool(idle_timeout=0.12)
+    try:
+        pool.checkin(a)
+        assert pool.stats()["idle"] == 1
+        deadline = time.monotonic() + 5.0
+        while pool.stats()["reaped"] == 0 \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        stats = pool.stats()
+        assert stats["reaped"] == 1
+        assert stats["idle"] == 0
+    finally:
+        pool.close()
+        b.close()
+
+
+def test_socket_pool_discards_stale_sockets():
+    """A kept-alive socket whose peer hung up is detected at checkout
+    (MSG_PEEK probe) and discarded — the caller dials fresh instead of
+    writing into a dead stream."""
+    a, b = socket.socketpair()
+    pool = _SocketPool(idle_timeout=30.0)
+    try:
+        pool.checkin(a)
+        b.close()  # peer hangs up while the socket sits idle
+        assert pool.checkout() is None
+        assert pool.stats()["idle"] == 0
+        assert pool.stats()["misses"] == 1
+    finally:
+        pool.close()
+
+
+# ---------------------------------------------------------------------------
+# agent-side admission control (net/agent.py _admit)
+# ---------------------------------------------------------------------------
+
+def test_agent_rejects_expired_and_full_typed(plans):
+    """The HostAgent's own admission seam answers an already-expired
+    deadline as DeadlineExpiredError and a full host as
+    QueueFullError — typed over the wire, counted per reason — instead
+    of burning the executor on work nobody awaits."""
+    reg = PlanRegistry()
+    reg.put(plans["sig"], plans["plan"])
+    ex = ServeExecutor(reg)
+    agent = HostAgent("adm0", ex).start()
+    lane = TcpHostLane("adm0", ("127.0.0.1", agent.port))
+    rng = np.random.default_rng(6)
+    try:
+        with pytest.raises(DeadlineExpiredError):
+            lane.rpc_submit(plans["sig"], _vals(plans, rng),
+                            timeout=0.0).result(timeout=30)
+        cfg = global_config()
+        old = cfg.max_queue
+        cfg.set("max_queue", 1, source="test",
+                reason="agent admission test")
+        try:
+            with agent._lock:
+                agent._inflight += 1  # a request parked in the seam
+            with pytest.raises(QueueFullError):
+                lane.rpc_submit(plans["sig"], _vals(plans, rng)) \
+                    .result(timeout=30)
+        finally:
+            with agent._lock:
+                agent._inflight -= 1
+            cfg.set("max_queue", old, source="test",
+                    reason="restore after agent admission test")
+        # admission recovered: the lane serves again, bit-exact
+        v = _vals(plans, rng)
+        got = np.asarray(lane.rpc_submit(plans["sig"], v)
+                         .result(timeout=120))
+        assert np.array_equal(got,
+                              np.asarray(plans["plan"].backward(v)))
+        rej = obs.GLOBAL_COUNTERS.snapshot()[
+            "spfft_net_agent_rejected_total"]["samples"]
+        reasons = {dict(k).get("reason") for k in rej}
+        assert {"queue_full", "expired"} <= reasons
+    finally:
+        lane.close()
+        agent.close()
+        ex.close(drain=False)
+
+
+def test_agent_coalesces_concurrent_distributed_requests(plans):
+    """Two concurrent same-signature distributed submits over REAL TCP
+    share one collective round on the agent's coalescer (the in-process
+    twin of the pod-smoke coalesce phase): both bit-exact, and the
+    agent-side coalesced counter moves by exactly 2."""
+    from spfft_tpu.control.config import global_config as _gc
+    reg = PlanRegistry()
+    reg.put(plans["dsig"], plans["dplan"])
+    ex = ServeExecutor(reg)
+    agent = HostAgent("coal0", ex).start()
+    lane = TcpHostLane("coal0", ("127.0.0.1", agent.port))
+    rng = np.random.default_rng(8)
+    dvals = []
+    for _ in range(2):
+        dvals.append([
+            (rng.standard_normal(p.num_values)
+             + 1j * rng.standard_normal(p.num_values))
+            for p in plans["dplan"].dist_plan.shard_plans])
+    oracle = [np.asarray(plans["dplan"].backward(v)) for v in dvals]
+    plans["dplan"].coalesce_backward(dvals)  # warm the batched jit
+    counters = obs.GLOBAL_COUNTERS
+
+    def total():
+        return sum(counters.snapshot().get(
+            "spfft_cluster_spmd_coalesced_total",
+            {}).get("samples", {}).values())
+
+    before = total()
+    cfg = _gc()
+    old = cfg.spmd_batch_window
+    cfg.set("spmd_batch_window", 0.5, source="test",
+            reason="agent coalesce test")
+    try:
+        futs = [lane.rpc_submit(plans["dsig"], v) for v in dvals]
+        got = [np.asarray(f.result(timeout=120)) for f in futs]
+    finally:
+        cfg.set("spmd_batch_window", old, source="test",
+                reason="restore after agent coalesce test")
+        lane.close()
+        agent.close()
+        ex.close(drain=False)
+    for g, want in zip(got, oracle):
+        assert np.array_equal(g, want)
+    assert total() - before == 2
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,632 @@
+"""Cross-request SPMD coalescing (spfft_tpu/serve/cluster.py
+``SPMDCoalescer`` + parallel/dist.py ``coalesce_backward/forward``).
+
+The contracts under test (docs/cluster.md "SPMD coalescing"): the
+batched entry points are BIT-EXACT against per-request serial
+execution across kinds, overlap depth K, c2c/r2c trimming, the fused
+flag and every batch size including 1 and ``spmd_max_batch``; the
+coalesced program lowers a B-invariant collective count (one exchange
+round moves all N payloads — the whole point of the optimisation); the
+coalescer drains same-signature queues EDF-ordered (high priority
+first) inside a deadline-aware window that closes EARLY on an imminent
+member deadline or a high-priority member, purges expired requests at
+drain time (they never ride a collective round), emits exactly one
+``cluster.spmd_execute`` span per round carrying every member's trace
+id, and answers the ``cluster.spmd_window`` fault site typed; the
+controller widens/narrows ``spmd_batch_window``/``spmd_max_batch``
+from the coalescer's live signals; and PodFrontend routes remote
+distributed requests with signature affinity so coalescing windows see
+co-located company.
+"""
+
+import re
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spfft_tpu import Scaling, TransformType, faults, obs
+from spfft_tpu.benchmark import cutoff_stick_triplets
+from spfft_tpu.control import Controller, ServeConfig
+from spfft_tpu.control.config import global_config
+from spfft_tpu.errors import DeadlineExpiredError
+from spfft_tpu.faults import FaultPlan, InjectedFault
+from spfft_tpu.parallel import make_distributed_plan, make_mesh
+from spfft_tpu.serve.cluster import PodFrontend, SPMDCoalescer, _SPMDLane
+from spfft_tpu.serve.executor import ServeExecutor
+from spfft_tpu.serve.registry import PlanRegistry, signature_for
+from spfft_tpu.utils.workloads import (even_plane_split,
+                                       round_robin_stick_partition)
+
+from test_util import (hermitian_triplets, random_sparse_triplets,
+                       random_values)
+
+SHARDS = 2
+
+
+# ---------------------------------------------------------------------------
+# plan builders (the 2-shard twins of test_batched's 4-shard scenarios)
+# ---------------------------------------------------------------------------
+
+def _c2c_plan(rng, **kw):
+    from test_distributed import split_by_sticks, split_planes
+    dims = (10, 9, 11)
+    triplets = random_sparse_triplets(rng, dims)
+    parts = split_by_sticks(triplets, dims, [2, 1])
+    planes = split_planes(dims[2], [1, 2])
+    plan = make_distributed_plan(TransformType.C2C, *dims, parts,
+                                 planes, mesh=make_mesh(SHARDS),
+                                 precision="double", **kw)
+
+    def mkvals(batch):
+        return [[random_values(rng, len(p)) for p in parts]
+                for _ in range(batch)]
+
+    return plan, mkvals
+
+
+def _r2c_plan(rng, **kw):
+    from test_distributed import split_by_sticks, split_planes
+    dims = (8, 9, 10)
+    triplets = hermitian_triplets(rng, dims)
+    parts = split_by_sticks(triplets, dims, [1, 1])
+    planes = split_planes(dims[2], [1, 1])
+    plan = make_distributed_plan(TransformType.R2C, *dims, parts,
+                                 planes, mesh=make_mesh(SHARDS),
+                                 precision="double", **kw)
+
+    def mkvals(batch):
+        # hermitian-consistent values: sample a real field's spectrum
+        # per batch entry (the test_batched r2c idiom)
+        out = []
+        for _ in range(batch):
+            space = rng.standard_normal((dims[2], dims[1], dims[0]))
+            freq = np.fft.fftn(space)
+            row = []
+            for p in parts:
+                st = p.copy()
+                for ax, d in enumerate(dims):
+                    st[:, ax] = np.where(st[:, ax] < 0, st[:, ax] + d,
+                                         st[:, ax])
+                row.append(freq[st[:, 2], st[:, 1], st[:, 0]])
+            out.append(row)
+        return out
+
+    return plan, mkvals
+
+
+def _set_knobs(**kw):
+    cfg = global_config()
+    old = {k: cfg.get(k) for k in kw}
+    for k, v in kw.items():
+        cfg.set(k, v, source="test", reason="spmd coalesce test")
+    return cfg, old
+
+
+def _restore_knobs(cfg, old):
+    for k, v in old.items():
+        cfg.set(k, v, source="test", reason="restore after test")
+
+
+def _counter_total(name):
+    samples = obs.GLOBAL_COUNTERS.snapshot().get(
+        name, {}).get("samples", {})
+    return sum(samples.values())
+
+
+# ---------------------------------------------------------------------------
+# bit-exactness matrix: coalesced == serial, element for element
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("kind,K,fused", [
+    ("c2c", 1, None),
+    ("c2c", 1, False),
+    ("c2c", 2, None),
+    ("r2c", 1, None),
+    ("r2c", 2, None),
+])
+def test_coalesce_bit_exact_matrix(kind, K, fused):
+    """coalesce_backward / coalesce_forward demux to results that are
+    BYTE-identical to per-request serial execution (np.array_equal, no
+    tolerance) — the contract that lets the scheduler coalesce any
+    interleaving it likes."""
+    rng = np.random.default_rng(41 + 10 * K + (100 if kind == "r2c"
+                                               else 0))
+    build = _c2c_plan if kind == "c2c" else _r2c_plan
+    plan, mkvals = build(rng, overlap_chunks=K, use_pallas=fused)
+    for B in (1, 3):
+        vals = mkvals(B)
+        outs = plan.coalesce_backward(vals)
+        assert len(outs) == B
+        spaces = [plan.backward(v) for v in vals]
+        for got, want in zip(outs, spaces):
+            assert np.array_equal(np.asarray(got), np.asarray(want))
+        fouts = plan.coalesce_forward(spaces, Scaling.FULL)
+        for got, space in zip(fouts, spaces):
+            want = np.asarray(plan.forward(space, Scaling.FULL))
+            assert np.array_equal(np.asarray(got), want)
+
+
+def test_coalesce_bit_exact_at_max_batch():
+    """A full round at the default ``spmd_max_batch`` cap stays
+    bit-exact (the largest batch the coalescer will ever form without
+    a retune)."""
+    rng = np.random.default_rng(42)
+    plan, mkvals = _c2c_plan(rng)
+    cap = int(ServeConfig.default("spmd_max_batch"))
+    vals = mkvals(cap)
+    outs = plan.coalesce_backward(vals)
+    assert len(outs) == cap
+    for got, v in zip(outs, vals):
+        assert np.array_equal(np.asarray(got),
+                              np.asarray(plan.backward(v)))
+
+
+def test_coalesced_program_one_collective_round():
+    """The collective count of the coalesced program is B-INVARIANT
+    (the s8 fusion-proxy idiom): N coalesced requests ride a vmapped
+    batch axis inside the SAME exchange collectives — one round per
+    direction, not N — and the HLO grows sub-linearly in B."""
+    import jax  # noqa: F401 — lowering requires an initialised backend
+
+    rng = np.random.default_rng(43)
+    plan, mkvals = _c2c_plan(rng)
+    vals = mkvals(3)
+    jitted = plan._batched_jits()["backward"]
+
+    def lowered_text(B):
+        batch = plan.shard_values_batch(vals[:B])
+        return jitted.lower(batch, *plan._device_tables).as_text()
+
+    def collectives(t):
+        return len(re.findall(
+            r"all_to_all|collective_permute|all_gather|all_reduce", t))
+
+    t2, t3 = lowered_text(2), lowered_text(3)
+    assert collectives(t2) == collectives(t3) > 0
+    assert len(t3) < 1.5 * len(t2)
+
+
+# ---------------------------------------------------------------------------
+# the coalescing scheduler (duck-typed plans: scheduling, not math)
+# ---------------------------------------------------------------------------
+
+class _DuckPlan:
+    """Duck-typed distributed plan recording how the lane executed it."""
+
+    def __init__(self, block=None):
+        self.rounds = []  # value-lists per coalesced round
+        self.serial = []  # per-request fallback calls, in order
+        self._block = block
+
+    def coalesce_backward(self, values_list):
+        if self._block is not None:
+            self._block.wait(30)
+        self.rounds.append(list(values_list))
+        return [("out", v) for v in values_list]
+
+    def coalesce_forward(self, space_list, scaling):
+        self.rounds.append(list(space_list))
+        return [("fwd", s, Scaling(scaling)) for s in space_list]
+
+
+class _SerialPlan:
+    """No batched entry points: the lane must fall back per-request."""
+
+    def __init__(self, block_on=None, release=None):
+        self.calls = []
+        self._block_on = block_on
+        self._release = release
+
+    def backward(self, tag):
+        if self._block_on is not None and tag == self._block_on:
+            self._release.wait(30)
+        self.calls.append(tag)
+        return tag
+
+
+def test_coalescer_n_requests_one_launch():
+    """N same-signature requests inside one window drain into ONE
+    launch: launches == 1, every request marked coalesced, the batch
+    histogram records a single full round and the process counters
+    agree."""
+    before = _counter_total("spfft_cluster_spmd_coalesced_total")
+    cfg, old = _set_knobs(spmd_batch_window=0.4)
+    lane = SPMDCoalescer(max_workers=1)
+    plan = _DuckPlan()
+    try:
+        futs = [lane.submit("sig-one-launch", plan, i, "backward",
+                            Scaling.NONE, None) for i in range(3)]
+        assert [f.result(timeout=30) for f in futs] == [
+            ("out", 0), ("out", 1), ("out", 2)]
+    finally:
+        _restore_knobs(cfg, old)
+        lane.close()
+    assert plan.rounds == [[0, 1, 2]]
+    s = lane.signals()
+    assert s["spmd_launches"] == 1
+    assert s["spmd_coalesced"] == 3
+    assert s["spmd_batch_hist"] == {3: 1}
+    assert s["spmd_queue_depth"] == 0
+    assert s["spmd_launch_p50"] >= 0.0
+    after = _counter_total("spfft_cluster_spmd_coalesced_total")
+    assert after - before == 3
+
+
+def test_coalescer_forward_round_carries_scaling():
+    cfg, old = _set_knobs(spmd_batch_window=0.3)
+    lane = SPMDCoalescer(max_workers=1)
+    plan = _DuckPlan()
+    try:
+        futs = [lane.submit("sig-fwd", plan, i, "forward",
+                            Scaling.FULL, None) for i in range(2)]
+        got = [f.result(timeout=30) for f in futs]
+        assert got == [("fwd", 0, Scaling.FULL), ("fwd", 1, Scaling.FULL)]
+        assert plan.rounds == [[0, 1]]
+    finally:
+        _restore_knobs(cfg, old)
+        lane.close()
+
+
+def test_coalescer_edf_and_priority_ordering():
+    """Queued requests drain high-priority first, then earliest
+    deadline, then arrival — the executor's EDF discipline, re-aimed
+    at the pod lane."""
+    release = threading.Event()
+    plan = _SerialPlan(block_on="first", release=release)
+    cfg, old = _set_knobs(spmd_batch_window=0.0, spmd_max_batch=1)
+    lane = SPMDCoalescer(max_workers=1)
+    try:
+        f0 = lane.submit("sig-edf", plan, "first", "backward",
+                         Scaling.NONE, None)
+        time.sleep(0.05)  # let the drainer block inside round 1
+        f1 = lane.submit("sig-edf", plan, "late", "backward",
+                         Scaling.NONE, None, timeout=30.0)
+        f2 = lane.submit("sig-edf", plan, "soon", "backward",
+                         Scaling.NONE, None, timeout=5.0)
+        f3 = lane.submit("sig-edf", plan, "high", "backward",
+                         Scaling.NONE, None, priority="high")
+        release.set()
+        for f in (f0, f1, f2, f3):
+            f.result(timeout=30)
+        assert plan.calls == ["first", "high", "soon", "late"]
+    finally:
+        release.set()
+        _restore_knobs(cfg, old)
+        lane.close()
+
+
+def test_window_closes_early_on_member_deadline():
+    """A member whose deadline lands inside the window closes it at
+    the deadline instead of waiting the window out — the request is
+    served, not expired."""
+    cfg, old = _set_knobs(spmd_batch_window=5.0)
+    lane = SPMDCoalescer(max_workers=1)
+    plan = _DuckPlan()
+    t0 = time.monotonic()
+    try:
+        fut = lane.submit("sig-deadline", plan, 7, "backward",
+                          Scaling.NONE, None, timeout=0.25)
+        assert fut.result(timeout=10) == ("out", 7)
+        assert time.monotonic() - t0 < 3.0
+    finally:
+        _restore_knobs(cfg, old)
+        lane.close()
+
+
+def test_window_closes_early_on_high_priority():
+    cfg, old = _set_knobs(spmd_batch_window=5.0)
+    lane = SPMDCoalescer(max_workers=1)
+    plan = _DuckPlan()
+    t0 = time.monotonic()
+    try:
+        fut = lane.submit("sig-high", plan, 9, "backward",
+                          Scaling.NONE, None, priority="high")
+        assert fut.result(timeout=10) == ("out", 9)
+        assert time.monotonic() - t0 < 3.0
+    finally:
+        _restore_knobs(cfg, old)
+        lane.close()
+
+
+def test_drain_time_purge_never_executes_expired():
+    """A request whose deadline lapses while a round is in flight is
+    purged at the NEXT drain (DeadlineExpiredError) and its payload
+    never executes — the round-18 drain-time half of the deadline
+    contract (admission used to check only at submit)."""
+    release = threading.Event()
+    plan = _SerialPlan(block_on="alive", release=release)
+    cfg, old = _set_knobs(spmd_batch_window=0.0, spmd_max_batch=1)
+    lane = SPMDCoalescer(max_workers=1)
+    try:
+        f1 = lane.submit("sig-purge", plan, "alive", "backward",
+                         Scaling.NONE, None)
+        time.sleep(0.05)  # round 1 is blocked inside execute
+        f2 = lane.submit("sig-purge", plan, "doomed", "backward",
+                         Scaling.NONE, None, timeout=0.02)
+        time.sleep(0.1)  # f2's deadline lapses while f1 executes
+        release.set()
+        assert f1.result(timeout=30) == "alive"
+        with pytest.raises(DeadlineExpiredError):
+            f2.result(timeout=30)
+        assert plan.calls == ["alive"]  # the doomed payload never ran
+        assert lane.signals()["spmd_queue_depth"] == 0
+    finally:
+        release.set()
+        _restore_knobs(cfg, old)
+        lane.close()
+
+
+def test_spmd_window_fault_site_fails_round_typed():
+    """An armed ``cluster.spmd_window`` fault fails EVERY member of the
+    round typed, and the lane serves the next round normally once the
+    one-shot script is spent."""
+    cfg, old = _set_knobs(spmd_batch_window=0.3)
+    lane = SPMDCoalescer(max_workers=1)
+    plan = _DuckPlan()
+    faults.arm(FaultPlan(script="cluster.spmd_window@1"))
+    try:
+        futs = [lane.submit("sig-fault", plan, i, "backward",
+                            Scaling.NONE, None) for i in range(2)]
+        for f in futs:
+            with pytest.raises(InjectedFault):
+                f.result(timeout=30)
+        assert plan.rounds == []  # the round died before the launch
+        f3 = lane.submit("sig-fault", plan, 5, "backward",
+                         Scaling.NONE, None, priority="high")
+        assert f3.result(timeout=30) == ("out", 5)
+    finally:
+        faults.disarm()
+        _restore_knobs(cfg, old)
+        lane.close()
+    assert lane.signals()["spmd_queue_depth"] == 0
+
+
+def test_one_span_per_round_with_member_trace_ids():
+    """One coalesced round emits exactly ONE ``cluster.spmd_execute``
+    span, parented under the first traced member's root and carrying
+    EVERY member's trace id in its args — the federated-telemetry view
+    of 'two requests, one collective'."""
+    obs.enable()
+    tracer = obs.GLOBAL_TRACER
+    tracer.reset()
+    tracer.set_sample_rate(1.0)
+    cfg, old = _set_knobs(spmd_batch_window=0.4)
+    lane = SPMDCoalescer(max_workers=1)
+    plan = _DuckPlan()
+    roots = []
+    try:
+        for i in range(2):
+            roots.append(tracer.begin(
+                "cluster.request", cat="cluster",
+                trace_id=tracer.new_trace_id(), track="pod"))
+        futs = [lane.submit("sig-span", plan, i, "backward",
+                            Scaling.NONE, roots[i]) for i in range(2)]
+        for f in futs:
+            f.result(timeout=30)
+    finally:
+        for root in roots:
+            tracer.finish(root)
+        _restore_knobs(cfg, old)
+        lane.close()
+        obs.disable()
+    assert tracer.open_count() == 0, tracer.open_names()
+    spans = [e for e in tracer.events() if isinstance(e, obs.Span)]
+    execs = [s for s in spans if s.name == "cluster.spmd_execute"]
+    assert len(execs) == 1
+    ex = execs[0]
+    assert ex.args["batch"] == 2
+    assert ex.args["member_trace_ids"] == [r.trace_id for r in roots]
+    assert ex.trace_id == roots[0].trace_id
+    assert ex.parent_id == roots[0].span_id
+
+
+def test_lane_alias_is_the_coalescer():
+    """The round-19 `_SPMDLane` name still resolves (net/agent.py and
+    older callers import it)."""
+    assert _SPMDLane is SPMDCoalescer
+
+
+# ---------------------------------------------------------------------------
+# controller: spmd_batch_window / spmd_max_batch retune rules
+# ---------------------------------------------------------------------------
+
+def _signals(completed=0, launches=0, depth=0, p50=0.0, coalesced=0,
+             hist=None):
+    return {"completed": completed, "failed": 0, "queue_depth": 0,
+            "max_queue_depth": 0, "queue_wait_p95": 0.0,
+            "device_execute_p50": 0.0, "fused_rows": 0,
+            "padded_rows": 0, "fused_hist": {}, "stage_s": 0.0,
+            "dispatch_s": 0.0, "quarantines": 0,
+            "rejected_queue_full": 0, "exchange_s": 0.0,
+            "exchange_compute_s": 0.0, "latency_p99": 0.0,
+            "spmd_launches": launches, "spmd_queue_depth": depth,
+            "spmd_launch_p50": p50, "spmd_coalesced": coalesced,
+            "spmd_batch_hist": hist or {}}
+
+
+def test_controller_widens_spmd_window_on_backlog():
+    """Depth >= 2 with window < launch p50 over two consecutive
+    distributed steps doubles ``spmd_batch_window`` (arrivals during a
+    launch keep missing the next window)."""
+    cfg = ServeConfig()
+    ctl = Controller(cfg, cooldown_steps=0)
+    ctl.step(_signals(launches=1))  # calibration baseline
+    d1 = ctl.step(_signals(launches=2, depth=3, p50=0.05))
+    assert not [d for d in d1 if d.knob == "spmd_batch_window"]
+    d2 = ctl.step(_signals(launches=3, depth=3, p50=0.05))
+    moved = [d for d in d2 if d.knob == "spmd_batch_window"]
+    assert len(moved) == 1
+    assert moved[0].new == pytest.approx(0.004)
+    assert "SPMD backlog" in moved[0].reason
+    assert cfg.get("spmd_batch_window") == pytest.approx(0.004)
+
+
+def test_controller_decays_fruitless_spmd_window():
+    """A window above default that coalesced NOTHING this step halves
+    back toward the default."""
+    cfg = ServeConfig()
+    cfg.set("spmd_batch_window", 0.008, source="test",
+            reason="pre-widened window")
+    ctl = Controller(cfg, cooldown_steps=0)
+    ctl.step(_signals(launches=1, coalesced=5))
+    d = ctl.step(_signals(launches=2, depth=0, coalesced=5))
+    moved = [x for x in d if x.knob == "spmd_batch_window"]
+    assert len(moved) == 1
+    assert moved[0].new == pytest.approx(0.004)
+    assert "coalesced nothing" in moved[0].reason
+
+
+def test_controller_doubles_spmd_max_batch_when_rounds_full():
+    cfg = ServeConfig()
+    ctl = Controller(cfg, cooldown_steps=0)
+    mb = cfg.get("spmd_max_batch")
+    ctl.step(_signals(launches=1))
+    d = ctl.step(_signals(launches=3, depth=2, hist={mb: 2}))
+    moved = [x for x in d if x.knob == "spmd_max_batch"]
+    assert len(moved) == 1
+    assert moved[0].new == mb * 2
+    assert "full collective rounds" in moved[0].reason
+
+
+def test_controller_halves_oversized_spmd_max_batch():
+    cfg = ServeConfig()
+    cfg.set("spmd_max_batch", 32, source="test", reason="elevated cap")
+    ctl = Controller(cfg, cooldown_steps=0)
+    ctl.step(_signals(launches=1))
+    d = ctl.step(_signals(launches=2, hist={2: 3}))
+    moved = [x for x in d if x.knob == "spmd_max_batch"]
+    assert len(moved) == 1
+    assert moved[0].new == 16
+    assert "far below cap" in moved[0].reason
+
+
+def test_controller_idle_decays_spmd_knobs():
+    """Idle steps (no serving AND no collective launches) retrace
+    both spmd knobs toward their defaults."""
+    cfg = ServeConfig()
+    cfg.set("spmd_batch_window", 0.008, source="test", reason="widened")
+    cfg.set("spmd_max_batch", 16, source="test", reason="doubled")
+    ctl = Controller(cfg, cooldown_steps=0)
+    ctl.step(_signals(launches=1))
+    d = ctl.step(_signals(launches=1))  # launches delta 0 -> idle
+    knobs = {x.knob: x.new for x in d}
+    assert knobs.get("spmd_batch_window") == pytest.approx(0.004)
+    assert knobs.get("spmd_max_batch") == 8
+    assert all("idle" in x.reason for x in d)
+
+
+def test_controller_ignores_spmd_rule_without_launches():
+    """Steps where serving continued but no collective launched move
+    neither spmd knob (the rule gates on the launches delta)."""
+    cfg = ServeConfig()
+    ctl = Controller(cfg, cooldown_steps=0)
+    ctl.step(_signals(completed=1, launches=1))
+    d = ctl.step(_signals(completed=5, launches=1, depth=4, p50=0.05))
+    assert not [x for x in d
+                if x.knob in ("spmd_batch_window", "spmd_max_batch")]
+
+
+# ---------------------------------------------------------------------------
+# PodFrontend integration: real distributed plan, real coalescing
+# ---------------------------------------------------------------------------
+
+N = 12
+DIMS = (N, N, N)
+
+
+@pytest.fixture(scope="module")
+def pod_plans():
+    trip = cutoff_stick_triplets(N, N, N, 0.9, hermitian=False)
+    reg = PlanRegistry()
+    sig, plan = reg.get_or_build(TransformType.C2C, *DIMS, trip,
+                                 precision="double")
+    parts = round_robin_stick_partition(trip, DIMS, SHARDS)
+    planes = even_plane_split(DIMS[2], SHARDS)
+    dplan = make_distributed_plan(TransformType.C2C, *DIMS, parts,
+                                  planes, mesh=make_mesh(SHARDS),
+                                  precision="double")
+    dsig = signature_for(TransformType.C2C, *DIMS, trip,
+                         precision="double", device_count=SHARDS)
+    return {"sig": sig, "plan": plan, "dsig": dsig, "dplan": dplan,
+            "parts": parts}
+
+
+def _make_pod(p, hosts=("h0", "h1")):
+    lanes = []
+    for host in hosts:
+        reg = PlanRegistry()
+        reg.put(p["sig"], p["plan"])
+        reg.put(p["dsig"], p["dplan"])
+        lanes.append((host, ServeExecutor(reg)))
+    return PodFrontend(lanes)
+
+
+def _close_all(pod):
+    pod.close()
+    for lane in pod._lanes:
+        lane.executor.close()
+
+
+def _dvalues(p, rng):
+    return [random_values(rng, len(part)) for part in p["parts"]]
+
+
+def test_pod_concurrent_distributed_requests_coalesce(pod_plans):
+    """Two concurrent same-signature distributed submits through the
+    FRONTEND provably share one collective round: both bit-exact vs
+    the serial oracle, the coalesced counter moves, and ONE
+    ``cluster.spmd_execute`` span serves BOTH request roots."""
+    p = pod_plans
+    rng = np.random.default_rng(7)
+    vals = [_dvalues(p, rng), _dvalues(p, rng)]
+    oracle = [np.asarray(p["dplan"].backward(v)) for v in vals]
+    # warm the batched jit outside the timed window so the coalescing
+    # window is not raced by a first-call compile
+    p["dplan"].coalesce_backward(vals)
+    before = _counter_total("spfft_cluster_spmd_coalesced_total")
+    obs.enable()
+    tracer = obs.GLOBAL_TRACER
+    tracer.reset()
+    tracer.set_sample_rate(1.0)
+    cfg, old = _set_knobs(spmd_batch_window=0.5)
+    pod = _make_pod(p)
+    try:
+        futs = [pod.submit(p["dsig"], v) for v in vals]
+        got = [np.asarray(f.result(timeout=60)) for f in futs]
+    finally:
+        _restore_knobs(cfg, old)
+        _close_all(pod)
+        obs.disable()
+    for g, want in zip(got, oracle):
+        assert np.array_equal(g, want)
+    assert _counter_total("spfft_cluster_spmd_coalesced_total") \
+        - before == 2
+    assert tracer.open_count() == 0, tracer.open_names()
+    spans = [e for e in tracer.events() if isinstance(e, obs.Span)]
+    roots = [s for s in spans if s.name == "cluster.request"]
+    execs = [s for s in spans if s.name == "cluster.spmd_execute"]
+    assert len(roots) == 2
+    assert len(execs) == 1
+    assert execs[0].args["batch"] == 2
+    assert sorted(execs[0].args["member_trace_ids"]) == \
+        sorted(r.trace_id for r in roots)
+
+
+def test_pod_affinity_routing_is_sticky(pod_plans):
+    """Signature-affinity candidate ordering is deterministic per
+    signature (same host leads every time) while still listing every
+    alive lane as a failover candidate — remote coalescing windows
+    only merge what routing co-locates."""
+    p = pod_plans
+    pod = _make_pod(p, hosts=("h0", "h1", "h2"))
+    try:
+        first = pod._affinity_candidates(p["dsig"])
+        assert [l.host for l in first] \
+            == [l.host for l in pod._affinity_candidates(p["dsig"])]
+        assert sorted(l.host for l in first) == ["h0", "h1", "h2"]
+        other = pod._affinity_candidates(p["sig"])
+        assert sorted(l.host for l in other) == ["h0", "h1", "h2"]
+    finally:
+        _close_all(pod)
