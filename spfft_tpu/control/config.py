@@ -173,6 +173,26 @@ KNOB_SPECS: Dict[str, KnobSpec] = {spec.name: spec for spec in (
              "SPMD batch-size histogram",
              "Most distributed requests one coalesced SPMD collective "
              "round carries."),
+    KnobSpec("lease_ttl_ms", 1500, 50, 600_000, int,
+             "spfft_net_rpc_rtt_seconds inflation vs the TTL",
+             "Membership lease lifetime (ms): an agent whose heartbeat "
+             "has not renewed its lease within this window starts down "
+             "the suspected->probed->evicted ladder. The controller "
+             "widens it when observed wire RTT inflates toward it."),
+    KnobSpec("heartbeat_interval_ms", 500, 10, 600_000, int,
+             "spfft_membership_heartbeats_total",
+             "How often an agent renews its membership lease with the "
+             "view coordinator (ms); keep well under lease_ttl_ms."),
+    KnobSpec("lane_probe_backoff", 0.25, 0.001, 60.0, float,
+             "spfft_cluster_probes_total",
+             "Base backoff (seconds) before the pod frontend's first "
+             "health probe of a dead lane; doubles per failed probe "
+             "with jitter, capped at 64x."),
+    KnobSpec("blob_store_max_bytes", 0, 0, 1024 ** 4, int,
+             "spfft_blob_gc_total",
+             "Byte cap for the remote blob tier's req/ request-journal "
+             "namespace: the gc sweep evicts oldest-mtime keys past it "
+             "(0 = unbounded, no sweep)."),
 )}
 
 #: String-valued settings (paths) the numeric KnobSpec clamp cannot

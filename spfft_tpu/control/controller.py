@@ -103,7 +103,8 @@ class Decision:
 #: hot-swappable but only moved by operators/the tuner).
 MANAGED_KNOBS = ("batch_window", "pin_after", "max_batch",
                  "pipeline_depth", "max_queue", "overlap_chunks",
-                 "spmd_batch_window", "spmd_max_batch")
+                 "spmd_batch_window", "spmd_max_batch",
+                 "lease_ttl_ms")
 
 
 class Controller:
@@ -126,7 +127,8 @@ class Controller:
                  reject_streak_steps: int = 2,
                  overlap_hi: float = 1.0, overlap_lo: float = 0.25,
                  overlap_streak_steps: int = 2,
-                 spmd_streak_steps: int = 2):
+                 spmd_streak_steps: int = 2,
+                 rtt_hi: float = 0.2, rtt_streak_steps: int = 2):
         self.config = config
         self.metrics = metrics
         self.executor = executor
@@ -143,9 +145,12 @@ class Controller:
         self.overlap_lo = float(overlap_lo)
         self.overlap_streak_steps = max(1, int(overlap_streak_steps))
         self.spmd_streak_steps = max(1, int(spmd_streak_steps))
+        self.rtt_hi = float(rtt_hi)
+        self.rtt_streak_steps = max(1, int(rtt_streak_steps))
         self._overlap_streak = 0
         self._reject_streak = 0
         self._spmd_streak = 0
+        self._rtt_streak = 0
         self._step = 0
         self._prev: Optional[Dict] = None
         self._last_change: Dict[str, int] = {}
@@ -210,6 +215,7 @@ class Controller:
             self._reject_streak = 0
             self._overlap_streak = 0
             self._spmd_streak = 0
+            self._rtt_streak = 0
             self._decay_toward_defaults(out)
         else:
             self._rule_batch_window(out, signals)
@@ -219,6 +225,7 @@ class Controller:
             self._rule_max_queue(out, signals)
             self._rule_overlap_chunks(out, signals)
             self._rule_spmd_coalesce(out, signals)
+            self._rule_lease_ttl(out, signals)
         self._prev = dict(signals)
         from .. import obs
         obs.GLOBAL_COUNTERS.inc(
@@ -246,7 +253,7 @@ class Controller:
                 else:
                     nxt = max(default, cur / 2)
             elif knob in ("max_queue", "overlap_chunks",
-                          "spmd_max_batch"):
+                          "spmd_max_batch", "lease_ttl_ms"):
                 # these grow rules double, so the decay halves — one
                 # idle step per growth step back toward the default
                 nxt = max(default, cur // 2) if cur > default \
@@ -366,6 +373,35 @@ class Controller:
                              max(default, k // 2),
                              f"exchange hidden ({ratio:.2f} x compute):"
                              f" decay toward default")
+
+    def _rule_lease_ttl(self, out, s) -> None:
+        """Widen the membership lease under wire-RTT inflation (round
+        21): a measured ``wire_rtt`` above ``rtt_hi`` x the lease TTL on
+        ``rtt_streak_steps`` consecutive non-idle steps means heartbeat
+        renewals are racing the expiry ladder — a slow-but-alive pod
+        would start suspecting healthy hosts. Doubling ``lease_ttl_ms``
+        within its declared bounds restores the renewal margin; the
+        idle decay halves it back once the wire recovers. Steps with no
+        RTT signal (loopback pods) reset the streak and move
+        nothing."""
+        rtt = s.get("wire_rtt", 0.0)
+        if rtt <= 0.0:
+            self._rtt_streak = 0
+            return
+        ttl_s = self.config.get("lease_ttl_ms") / 1e3
+        if rtt <= self.rtt_hi * ttl_s:
+            self._rtt_streak = 0
+            return
+        self._rtt_streak += 1
+        if self._rtt_streak < self.rtt_streak_steps:
+            return
+        if self._retune(
+                out, "lease_ttl_ms",
+                self.config.get("lease_ttl_ms") * 2,
+                f"wire RTT inflation: {rtt * 1e3:.1f} ms RTT vs "
+                f"{ttl_s * 1e3:.0f} ms lease TTL over "
+                f"{self._rtt_streak} consecutive steps"):
+            self._rtt_streak = 0
 
     def _rule_spmd_coalesce(self, out, s) -> None:
         """Retune the pod SPMD lane's coalescing window and batch cap

@@ -236,6 +236,35 @@ class NetProtocolError(ClusterError):
     transient = True
 
 
+class StaleEpochError(ClusterError):
+    """Epoch-fenced rejection: routed work carried a membership-view
+    epoch older than the receiver's (``spfft_tpu.net.membership``) —
+    the sender is acting on a stale view of the pod. Transient by
+    design: the correct recovery is to refetch the view from the
+    coordinator and retry with the fresh epoch, which the pod frontend
+    does automatically. ``stale``/``current`` carry both epochs so the
+    skew is visible in the error text."""
+
+    transient = True
+
+    def __init__(self, message: str, stale: int = None,
+                 current: int = None):
+        super().__init__(message)
+        self.stale = stale
+        self.current = current
+
+
+class NetAuthError(ClusterError):
+    """Wire-authentication failure: a frame's HMAC did not verify, an
+    authenticated endpoint received an unauthenticated frame, or vice
+    versa (``SPFFT_TPU_NET_SECRET`` mismatch across the pod; the frame
+    version byte negotiates the authenticated protocol). PERMANENT —
+    retrying with the same secret can never succeed, so the door
+    rejects once, typed, instead of burning the failover ladder."""
+
+    transient = False
+
+
 class ExecutorCrashedError(ServeError):
     """The dispatch loop crashed unexpectedly and its supervisor
     exhausted the bounded restart budget; every queued and in-flight

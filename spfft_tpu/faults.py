@@ -52,6 +52,12 @@ site                  where it fires
                       (a firing check drops the connection)
 ``blob.get``          a remote blob-tier read (artifact or alias)
 ``blob.put``          a remote blob-tier write
+``net.heartbeat``     a membership lease-renewal heartbeat (sender's
+                      wire call AND the coordinator's renewal handling)
+``cluster.view``      serving/adopting a signed membership view (the
+                      coordinator's snapshot and the frontend's fetch)
+``cluster.readmit``   the re-reconcile step of a probed dead lane
+                      before it is readmitted to routing
 ===================== ====================================================
 
 A firing check raises :class:`InjectedFault` (or an
@@ -135,6 +141,8 @@ SITES = (
     # wire transport + remote artifact tier (net/)
     "net.frame", "net.send", "net.recv", "net.accept",
     "blob.get", "blob.put",
+    # lease-based membership + lane resurrection (round 21)
+    "net.heartbeat", "cluster.view", "cluster.readmit",
 )
 
 #: Substrings of runtime error text treated as transient — the

@@ -26,6 +26,15 @@ Three contracts the agent keeps:
 ``net.accept`` is the agent's fault site: a firing check drops the
 inbound connection on the floor — the client sees exactly a crashed
 host.
+
+The agent is also one membership node (:mod:`~spfft_tpu.net.membership`):
+it holds a lease it renews over the ``heartbeat`` verb, serves the
+signed pod view over ``view``, promotes itself to view coordinator
+when it is the lowest alive host id, and fences stale-epoch submits
+with the typed transient ``StaleEpochError`` (counted
+``spfft_net_agent_rejected_total{reason="stale_epoch"}``). Frames
+that fail wire authentication reject permanent ``NetAuthError`` at
+the door, counted ``{reason="auth"}``.
 """
 
 from __future__ import annotations
@@ -33,13 +42,14 @@ from __future__ import annotations
 import json
 import socket
 import threading
-from typing import Optional, Tuple
+from typing import Dict, Optional, Tuple
 
 from .. import faults as _faults
 from .. import obs as _obs
 from ..control.config import global_config
 from ..errors import (DeadlineExpiredError, InvalidParameterError,
-                      NetProtocolError, QueueFullError)
+                      NetAuthError, NetProtocolError, QueueFullError,
+                      StaleEpochError)
 from ..faults import InjectedFault
 from ..obs.exporters import prometheus_text
 from ..parallel.multihost import plan_fingerprint
@@ -49,6 +59,7 @@ from ..types import Scaling
 from .frame import (error_to_wire, pack_values, recv_frame, send_frame,
                     signature_from_wire, signature_to_wire,
                     unpack_values)
+from .membership import HeartbeatLoop, MembershipNode
 
 
 def _jsonify(obj):
@@ -74,7 +85,9 @@ class HostAgent:
     of subprocesses together)."""
 
     def __init__(self, host: str, executor: ServeExecutor,
-                 bind: str = "127.0.0.1", port: int = 0):
+                 bind: str = "127.0.0.1", port: int = 0,
+                 peers: Optional[Dict[str, str]] = None,
+                 advertise: Optional[str] = None):
         self.host = host
         self.executor = executor
         self.closing = threading.Event()
@@ -94,6 +107,12 @@ class HostAgent:
         # short accept timeout: the loop notices `closing` promptly
         self._sock.settimeout(0.2)
         self.port = self._sock.getsockname()[1]
+        # this host's membership half: lease + heartbeat + (when this
+        # is the lowest alive host id) the view-coordinator role
+        self.membership = MembershipNode(
+            host, address=advertise or f"{bind}:{self.port}",
+            peers=peers)
+        self._heartbeats = HeartbeatLoop(self.membership)
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> "HostAgent":
@@ -101,10 +120,12 @@ class HostAgent:
             target=self._accept_loop, daemon=True,
             name=f"spfft-agent-{self.host}")
         self._thread.start()
+        self._heartbeats.start()
         return self
 
     def close(self) -> None:
         self.closing.set()
+        self._heartbeats.stop()
         try:
             self._sock.close()
         except OSError:
@@ -158,6 +179,18 @@ class HostAgent:
             while not self.closing.is_set():
                 try:
                     frame = recv_frame(conn, eof_ok=True)
+                except NetAuthError as exc:
+                    # the authentication door: a frame that does not
+                    # verify rejects typed + permanent, counted, and
+                    # the stream is dropped (never dispatched)
+                    _obs.GLOBAL_COUNTERS.inc(
+                        "spfft_net_agent_rejected_total", reason="auth")
+                    try:
+                        send_frame(conn, error_to_wire(exc))
+                    except (OSError, NetProtocolError, NetAuthError,
+                            InjectedFault):
+                        pass
+                    return
                 except (NetProtocolError, InjectedFault) as exc:
                     # best effort: tell the client what went wrong,
                     # then give up on this (possibly desynced) stream
@@ -241,6 +274,13 @@ class HostAgent:
             return {"type": "shutdown_ok"}, b""
         if op == "ping":
             return {"type": "pong", "host": self.host}, b""
+        if op == "heartbeat":
+            ack = self.membership.on_heartbeat(
+                str(header.get("host", "?")), header.get("address"))
+            return ({"type": "heartbeat_ok", **ack}, b"")
+        if op == "view":
+            return ({"type": "view_ok",
+                     "view": self.membership.on_view()}, b"")
         raise InvalidParameterError(f"unknown wire op {op!r}")
 
     def _admit(self, timeout) -> None:
@@ -275,6 +315,12 @@ class HostAgent:
         result — the asynchrony lives client-side in the lane's thread
         pool), restoring the propagated trace context so this host's
         spans join the frontend's trace."""
+        try:
+            self.membership.check_epoch(header.get("epoch"))
+        except StaleEpochError:
+            _obs.GLOBAL_COUNTERS.inc("spfft_net_agent_rejected_total",
+                                     reason="stale_epoch")
+            raise
         sig = signature_from_wire(header.get("signature") or {})
         values = unpack_values(header, payload)
         kind = str(header.get("kind", "backward"))
@@ -390,6 +436,12 @@ def main(argv=None) -> int:
                          "(MODE=full|dist)")
     ap.add_argument("--trace", action="store_true",
                     help="enable tracing at sample rate 1.0")
+    ap.add_argument("--peers", default="",
+                    help="pod roster for lease-based membership: "
+                         "name=host:port,... (empty = standalone)")
+    ap.add_argument("--advertise", default="",
+                    help="address peers should heartbeat this agent "
+                         "at (default: bind:port)")
     args = ap.parse_args(argv)
 
     if args.blob:
@@ -403,9 +455,16 @@ def main(argv=None) -> int:
         registry.warmup_manifest(args.manifest, compile=True)
     if args.demo_warm:
         _demo_warm(registry, args.demo_warm)
+    peers = {}
+    for entry in filter(None, args.peers.split(",")):
+        name, _, addr = entry.partition("=")
+        if not name or ":" not in addr:
+            ap.error(f"--peers entry {entry!r} is not name=host:port")
+        peers[name.strip()] = addr.strip()
     executor = ServeExecutor(registry)
     agent = HostAgent(args.host, executor, bind=args.bind,
-                      port=args.port).start()
+                      port=args.port, peers=peers or None,
+                      advertise=(args.advertise or None)).start()
     print(json.dumps({"agent": args.host, "port": agent.port}),
           flush=True)
     try:

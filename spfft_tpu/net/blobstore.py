@@ -50,7 +50,9 @@ def _count(op: str, outcome: str) -> None:
 
 class BlobStore:
     """The abstract byte surface: ``get(key) -> bytes | None`` (None =
-    miss), ``put(key, data)``, ``list() -> [key]``. Keys are relative
+    miss), ``put(key, data)``, ``list() -> [key]``, plus the GC half —
+    ``stat(key) -> {"size", "mtime"} | None`` and
+    ``delete(key) -> bool`` (False = already gone). Keys are relative
     slash-separated paths (the store uses ``art/<key>`` and
     ``req/<rkey>`` namespaces)."""
 
@@ -61,6 +63,12 @@ class BlobStore:
         raise NotImplementedError
 
     def list(self) -> List[str]:
+        raise NotImplementedError
+
+    def stat(self, key: str) -> Optional[dict]:
+        raise NotImplementedError
+
+    def delete(self, key: str) -> bool:
         raise NotImplementedError
 
 
@@ -128,6 +136,26 @@ class FileBlobStore(BlobStore):
                 out.append(key.replace(os.sep, "/"))
         return sorted(out)
 
+    def stat(self, key: str) -> Optional[dict]:
+        try:
+            st = os.stat(self._path(key))
+        except FileNotFoundError:
+            return None
+        except OSError as exc:
+            raise BlobStoreError(
+                f"blob stat {key!r} failed: {exc}") from exc
+        return {"size": st.st_size, "mtime": st.st_mtime}
+
+    def delete(self, key: str) -> bool:
+        try:
+            os.unlink(self._path(key))
+        except FileNotFoundError:
+            return False
+        except OSError as exc:
+            raise BlobStoreError(
+                f"blob delete {key!r} failed: {exc}") from exc
+        return True
+
 
 class HttpBlobStore(BlobStore):
     """A minimal HTTP object store client: ``GET /<key>`` (404 = miss),
@@ -147,9 +175,11 @@ class HttpBlobStore(BlobStore):
         self._timeout = float(timeout)
 
     def _request(self, method: str, key: str,
-                 body: Optional[bytes] = None):
+                 body: Optional[bytes] = None, query: str = ""):
         path = f"{self._base}/{urllib.parse.quote(key)}" if key \
             else f"{self._base}/?list=1"
+        if key and query:
+            path = f"{path}?{query}"
         conn = http.client.HTTPConnection(self._host, self._port,
                                           timeout=self._timeout)
         try:
@@ -207,6 +237,40 @@ class HttpBlobStore(BlobStore):
                 f"blob list is not JSON: {exc}") from exc
         return [str(k) for k in keys]
 
+    def stat(self, key: str) -> Optional[dict]:
+        _validate_key(key)
+        try:
+            status, data = self._request("GET", key, query="stat=1")
+        except OSError as exc:
+            raise BlobStoreError(
+                f"blob stat {key!r} failed: {exc}") from exc
+        if status == 404:
+            return None
+        if status != 200:
+            raise BlobStoreError(
+                f"blob stat {key!r} answered HTTP {status}")
+        try:
+            row = json.loads(data)
+            return {"size": int(row["size"]),
+                    "mtime": float(row["mtime"])}
+        except (ValueError, KeyError, TypeError) as exc:
+            raise BlobStoreError(
+                f"blob stat {key!r} is malformed: {exc}") from exc
+
+    def delete(self, key: str) -> bool:
+        _validate_key(key)
+        try:
+            status, _ = self._request("DELETE", key)
+        except OSError as exc:
+            raise BlobStoreError(
+                f"blob delete {key!r} failed: {exc}") from exc
+        if status == 404:
+            return False
+        if status not in (200, 204):
+            raise BlobStoreError(
+                f"blob delete {key!r} answered HTTP {status}")
+        return True
+
 
 def open_blobstore(spec: Optional[str]) -> Optional[BlobStore]:
     """Resolve a blob-store spec: empty/None -> no remote tier,
@@ -217,6 +281,56 @@ def open_blobstore(spec: Optional[str]) -> Optional[BlobStore]:
     if spec.startswith("http://"):
         return HttpBlobStore(spec)
     return FileBlobStore(spec)
+
+
+def gc_blobstore(store: BlobStore, max_bytes: int,
+                 prefix: str = "req/") -> dict:
+    """Bound the remote tier's ``prefix`` namespace (default: the
+    ``req/`` request journal, which grows per served signature and has
+    no local-tier GC) to ``max_bytes`` by an oldest-mtime-first sweep
+    — the same eviction order as the disk tier's ``store gc``.
+    ``max_bytes <= 0`` means unbounded: nothing is swept (counted
+    ``skipped``). Per-key failures are typed and NON-FATAL: a
+    concurrently-deleted or unreachable key counts
+    ``spfft_blob_gc_total{outcome="error"}`` and the sweep continues —
+    GC is an optimisation, never an availability risk. Returns
+    ``{"removed": [keys], "bytes_in_use": n, "errors": n}``."""
+    if max_bytes is None or int(max_bytes) <= 0:
+        _obs.GLOBAL_COUNTERS.inc("spfft_blob_gc_total",
+                                 outcome="skipped")
+        return {"removed": [], "bytes_in_use": None, "errors": 0}
+    rows = []
+    errors = 0
+    for key in store.list():
+        if not key.startswith(prefix):
+            continue
+        try:
+            st = store.stat(key)
+        except BlobStoreError:
+            errors += 1
+            _obs.GLOBAL_COUNTERS.inc("spfft_blob_gc_total",
+                                     outcome="error")
+            continue
+        if st is not None:
+            rows.append((float(st["mtime"]), key, int(st["size"])))
+    rows.sort()  # oldest first
+    in_use = sum(size for _, _, size in rows)
+    removed: List[str] = []
+    for mtime, key, size in rows:
+        if in_use <= int(max_bytes):
+            break
+        try:
+            if store.delete(key):
+                removed.append(key)
+                _obs.GLOBAL_COUNTERS.inc("spfft_blob_gc_total",
+                                         outcome="removed")
+            in_use -= size
+        except BlobStoreError:
+            errors += 1
+            _obs.GLOBAL_COUNTERS.inc("spfft_blob_gc_total",
+                                     outcome="error")
+    return {"removed": removed, "bytes_in_use": in_use,
+            "errors": errors}
 
 
 # -- the matching local HTTP server ------------------------------------------
@@ -245,6 +359,23 @@ def serve_blobstore(root: str, bind: str = "127.0.0.1",
                 self.end_headers()
                 self.wfile.write(body)
                 return
+            if ("stat", "1") in urllib.parse.parse_qsl(parsed.query):
+                try:
+                    row = store.stat(self._key())
+                except (BlobStoreError, InvalidParameterError):
+                    self.send_response(500)
+                    self.end_headers()
+                    return
+                if row is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = json.dumps(row).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             try:
                 data = store.get(self._key())
             except (BlobStoreError, InvalidParameterError):
@@ -259,6 +390,16 @@ def serve_blobstore(root: str, bind: str = "127.0.0.1",
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
             self.wfile.write(data)
+
+        def do_DELETE(self):
+            try:
+                removed = store.delete(self._key())
+            except (BlobStoreError, InvalidParameterError):
+                self.send_response(500)
+                self.end_headers()
+                return
+            self.send_response(204 if removed else 404)
+            self.end_headers()
 
         def do_PUT(self):
             length = int(self.headers.get("Content-Length", 0))

@@ -30,13 +30,27 @@ round-trips it exactly), ``obs.TraceContext`` as its ``to_wire`` dict
 Fault sites: ``net.frame`` fires on each encode/decode, ``net.send``
 on the socket send, ``net.recv`` on every socket read (a firing check
 is a dropped or truncated frame mid-flight).
+
+**Authentication.** The version byte is the negotiation seam: when
+``SPFFT_TPU_NET_SECRET`` is set, frames go out as version 2 with a
+32-byte HMAC-SHA256 over header+payload keyed by the shared secret,
+inserted between the preamble and the header. A receiver rejects any
+mismatch — an authenticated frame it cannot verify, an authenticated
+frame when it holds no secret, or a plaintext frame when it requires
+auth — with the typed PERMANENT
+:class:`~spfft_tpu.errors.NetAuthError` at the door (retrying with
+the same secret can never succeed). Unknown versions stay
+:class:`NetProtocolError` (protocol skew, transient).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import hmac as _hmac
 import io
 import json
+import os
 import struct
 from typing import List, Optional, Tuple, Union
 
@@ -44,11 +58,21 @@ import numpy as np
 
 from .. import faults as _faults
 from .. import obs as _obs
-from ..errors import GenericError, NetProtocolError
+from ..errors import GenericError, NetAuthError, NetProtocolError
 from ..serve.registry import PlanSignature
 
 MAGIC = b"SPFN"
 FRAME_VERSION = 1
+#: The authenticated protocol: preamble carries version 2 and a
+#: 32-byte HMAC-SHA256(secret, header+payload) precedes the header.
+FRAME_VERSION_AUTH = 2
+
+#: Env var holding the pod's shared wire secret; empty/unset = the
+#: plaintext version-1 protocol.
+NET_SECRET_ENV = "SPFFT_TPU_NET_SECRET"
+
+_MAC_BYTES = 32
+_UNSET = object()
 
 #: Preamble layout: magic, version, header length, payload length.
 _PREAMBLE = struct.Struct(">4sBIQ")
@@ -61,19 +85,44 @@ MAX_PAYLOAD_BYTES = 1 << 33
 _RECV_CHUNK = 1 << 16
 
 
-def send_frame(sock, header: dict, payload: bytes = b"") -> None:
+def net_secret() -> Optional[bytes]:
+    """The process's shared wire secret (``SPFFT_TPU_NET_SECRET``),
+    or None for the plaintext protocol."""
+    raw = os.environ.get(NET_SECRET_ENV, "")
+    return raw.encode("utf-8") if raw else None
+
+
+def _frame_mac(secret: bytes, hbytes: bytes, payload: bytes) -> bytes:
+    mac = _hmac.new(secret, hbytes, hashlib.sha256)
+    mac.update(payload)
+    return mac.digest()
+
+
+def send_frame(sock, header: dict, payload: bytes = b"",
+               secret=_UNSET) -> None:
     """Encode and send one frame. Socket errors propagate as
     ``OSError`` (the transport classifies them); a header that cannot
-    serialize is a :class:`NetProtocolError`."""
+    serialize is a :class:`NetProtocolError`. With a shared secret
+    (``secret=`` override, else ``SPFFT_TPU_NET_SECRET``) the frame
+    goes out authenticated as version 2."""
     _faults.check_site("net.frame")
+    if secret is _UNSET:
+        secret = net_secret()
     try:
         hbytes = json.dumps(header).encode("utf-8")
     except (TypeError, ValueError) as exc:
         raise NetProtocolError(
             f"frame header is not JSON-serializable: {exc}") from exc
-    data = b"".join([
-        _PREAMBLE.pack(MAGIC, FRAME_VERSION, len(hbytes), len(payload)),
-        hbytes, payload])
+    if secret:
+        data = b"".join([
+            _PREAMBLE.pack(MAGIC, FRAME_VERSION_AUTH, len(hbytes),
+                           len(payload)),
+            _frame_mac(secret, hbytes, payload), hbytes, payload])
+    else:
+        data = b"".join([
+            _PREAMBLE.pack(MAGIC, FRAME_VERSION, len(hbytes),
+                           len(payload)),
+            hbytes, payload])
     _faults.check_site("net.send")
     sock.sendall(data)
     _obs.GLOBAL_COUNTERS.inc("spfft_net_frames_total", dir="send")
@@ -94,12 +143,13 @@ def _recv_exact(sock, n: int, what: str) -> bytes:
     return bytes(buf)
 
 
-def recv_frame(sock, eof_ok: bool = False
+def recv_frame(sock, eof_ok: bool = False, secret=_UNSET
                ) -> Optional[Tuple[dict, bytes]]:
     """Receive one frame: ``(header, payload)``. A clean EOF before the
     first byte returns None when ``eof_ok`` (the agent's
     end-of-connection); everything else malformed raises
-    :class:`NetProtocolError`."""
+    :class:`NetProtocolError`. Authentication mismatches — see the
+    module docstring — raise the permanent :class:`NetAuthError`."""
     _faults.check_site("net.recv")
     first = sock.recv(1)
     if not first:
@@ -111,7 +161,7 @@ def recv_frame(sock, eof_ok: bool = False
     magic, version, hlen, plen = _PREAMBLE.unpack(pre)
     if magic != MAGIC:
         raise NetProtocolError(f"bad frame magic {magic!r}")
-    if version != FRAME_VERSION:
+    if version not in (FRAME_VERSION, FRAME_VERSION_AUTH):
         raise NetProtocolError(
             f"frame version {version} != {FRAME_VERSION} (protocol "
             f"skew across the pod)")
@@ -119,8 +169,27 @@ def recv_frame(sock, eof_ok: bool = False
         raise NetProtocolError(
             f"frame lengths implausible (header {hlen}, payload "
             f"{plen})")
+    if secret is _UNSET:
+        secret = net_secret()
+    mac = None
+    if version == FRAME_VERSION_AUTH:
+        mac = _recv_exact(sock, _MAC_BYTES, "frame mac")
     hbytes = _recv_exact(sock, hlen, "frame header")
     payload = _recv_exact(sock, plen, "frame payload") if plen else b""
+    if version == FRAME_VERSION_AUTH:
+        if not secret:
+            raise NetAuthError(
+                "peer sent an authenticated frame but this endpoint "
+                "holds no SPFFT_TPU_NET_SECRET")
+        if not _hmac.compare_digest(
+                mac, _frame_mac(secret, hbytes, payload)):
+            raise NetAuthError(
+                "frame HMAC does not verify — shared-secret mismatch "
+                "across the pod")
+    elif secret:
+        raise NetAuthError(
+            "peer sent a plaintext frame but this endpoint requires "
+            "authentication (SPFFT_TPU_NET_SECRET is set)")
     _faults.check_site("net.frame")
     try:
         header = json.loads(hbytes)

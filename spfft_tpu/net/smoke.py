@@ -24,6 +24,14 @@ processes (``python -m spfft_tpu.net.agent``), fronts them with
   re-reconciliation) and then serves traffic;
 * ``kill -9`` of an agent fails over TYPED — survivors stay bit-exact,
   the pod degrades, nothing hangs and nothing leaks;
+* the pod SELF-HEALS with zero operator intervention: the killed
+  agent's lease expires on the coordinator (agents heartbeat each
+  other over the wire), the eviction bumps the view epoch and TWO
+  concurrent frontends converge on the same epoch/view, the agent
+  restarts on the same port with a fresh store dir (warm boot off the
+  shared blob tier, ``builds == 0``), heartbeats itself back into the
+  view, and the routing-piggybacked probe ladder re-reconciles and
+  readmits it — after which it serves bit-exact again;
 * a drain-leave walks the membership ladder
   (``leave_started → drained → left``).
 
@@ -53,15 +61,20 @@ _AGENT_ENV = {
 
 
 def _spawn_agent(host: str, store: str, blob: str, warm: str,
-                 timeout: float = 240.0, extra_env=None):
+                 timeout: float = 240.0, extra_env=None,
+                 port: int = 0, peers: str = ""):
     """Start one agent process and wait for its port announcement.
     Returns ``(proc, port)``; raises if the agent dies before
     announcing. ``extra_env`` merges over the sharding defaults (the
     smoke uses it to boot agents off a ``SPFFT_TPU_SERVE_CONFIG``
-    knob artifact)."""
+    knob artifact); ``port`` pins the listen port (the restart half of
+    the self-healing phase rebinds the dead agent's address) and
+    ``peers`` seeds the agent's membership roster."""
     cmd = [sys.executable, "-m", "spfft_tpu.net.agent",
-           "--host", host, "--port", "0", "--trace",
+           "--host", host, "--port", str(port), "--trace",
            "--store", store, "--blob", blob, "--demo-warm", warm]
+    if peers:
+        cmd += ["--peers", peers]
     env = dict(os.environ)
     env.update(_AGENT_ENV)
     env.update(extra_env or {})
@@ -145,19 +158,34 @@ def _run_pod_smoke(seed: int = 0) -> int:
     knob_cfg = ServeConfig()
     knob_cfg.set("spmd_batch_window", 0.25, source="smoke",
                  reason="pod-smoke coalesce phase window")
+    # tight leases so the self-healing phase's kill -> lease-expiry ->
+    # evict ladder resolves in well under a second of wall clock
+    knob_cfg.set("lease_ttl_ms", 300, source="smoke",
+                 reason="pod-smoke fast lease expiry")
+    knob_cfg.set("heartbeat_interval_ms", 100, source="smoke",
+                 reason="pod-smoke fast lease renewal")
     knob_path = os.path.join(tmp.name, "serve_config.json")
     knob_cfg.save(knob_path)
     agent_env = {CONFIG_ENV: knob_path}
+    # frontend-side: keep the resurrection ladder's exponential
+    # backoff short so routing-piggybacked probes readmit quickly
+    from ..control.config import global_config
+    global_config().set("lane_probe_backoff", 0.05, source="smoke",
+                        reason="pod-smoke fast readmission probes")
     procs: Dict[str, subprocess.Popen] = {}
     lanes: Dict[str, TcpHostLane] = {}
-    pod = None
+    ports: Dict[str, int] = {}
+    pod = pod2 = None
     try:
+        procs["h0"], ports["h0"] = _spawn_agent(
+            "h0", os.path.join(tmp.name, "store-h0"), blob,
+            "10,0.9,2,full", extra_env=agent_env)
+        peers = f"h0=127.0.0.1:{ports['h0']}"
+        procs["h1"], ports["h1"] = _spawn_agent(
+            "h1", os.path.join(tmp.name, "store-h1"), blob,
+            "10,0.9,2,full", extra_env=agent_env, peers=peers)
         for host in ("h0", "h1"):
-            store = os.path.join(tmp.name, f"store-{host}")
-            procs[host], port = _spawn_agent(host, store, blob,
-                                             "10,0.9,2,full",
-                                             extra_env=agent_env)
-            lanes[host] = TcpHostLane(host, ("127.0.0.1", port))
+            lanes[host] = TcpHostLane(host, ("127.0.0.1", ports[host]))
         pod = PodFrontend([lanes["h0"], lanes["h1"]], policy="rr",
                           seed=seed)
 
@@ -245,10 +273,10 @@ def _run_pod_smoke(seed: int = 0) -> int:
               f"paired requests, got {len(shared_rounds)}")
 
         # -- elastic join: boots warm off the blob tier ----------------
-        procs["h2"], port2 = _spawn_agent(
+        procs["h2"], ports["h2"] = _spawn_agent(
             "h2", os.path.join(tmp.name, "store-h2"), blob,
-            "10,0.9,2,dist", extra_env=agent_env)
-        lanes["h2"] = TcpHostLane("h2", ("127.0.0.1", port2))
+            "10,0.9,2,dist", extra_env=agent_env, peers=peers)
+        lanes["h2"] = TcpHostLane("h2", ("127.0.0.1", ports["h2"]))
         pod.join(lanes["h2"])
         stats2 = lanes["h2"].rpc_stats()
         check(stats2.get("builds", -1) == 0,
@@ -269,6 +297,7 @@ def _run_pod_smoke(seed: int = 0) -> int:
               "membership ladder missing the 'joined' event")
 
         # -- kill -9 one agent: typed failover, bit-exact survivors ----
+        epoch_pre = pod.view()["epoch"]
         procs["h1"].kill()
         procs["h1"].wait(timeout=30)
         for _ in range(6):
@@ -289,6 +318,96 @@ def _run_pod_smoke(seed: int = 0) -> int:
         check(tracer.open_count() == 0,
               "unclosed client spans after failover phase")
 
+        # -- self-healing: lease expiry -> evict -> restart -> readmit -
+        # The round-21 loop over the real wire, zero operator
+        # intervention: the killed agent's lease expires on h0's
+        # coordinator (agents heartbeat each other — 300 ms leases off
+        # the knob artifact), the eviction bumps the view epoch, a
+        # SECOND concurrent frontend observes the SAME epoch/view, the
+        # agent restarts on the SAME port, heartbeats itself back into
+        # the view, and each frontend's routing-piggybacked probe
+        # ladder re-reconciles and readmits it warm (builds == 0 off
+        # the blob tier).
+        pod2 = PodFrontend(
+            [TcpHostLane(h, ("127.0.0.1", ports[h]))
+             for h in ("h0", "h2")], seed=seed + 1)
+        evicted_view = None
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            va = pod.view()
+            if (va["members"].get("h1", {}).get("state") == "evicted"
+                    and va["epoch"] > epoch_pre):
+                evicted_view = va
+                break
+            time.sleep(0.1)
+        check(evicted_view is not None,
+              "h1's lease never expired into an eviction on the "
+              "coordinator (no epoch bump seen by frontend A)")
+        vb = pod2.view()
+        check(evicted_view is not None
+              and vb["epoch"] == evicted_view["epoch"]
+              and vb["members"].get("h1", {}).get("state") == "evicted",
+              f"frontend B did not converge on the eviction view: "
+              f"{vb} vs {evicted_view}")
+        # restart the killed agent on the SAME port (fresh store dir:
+        # its warm boot must come from the shared blob tier)
+        procs["h1"], _ = _spawn_agent(
+            "h1", os.path.join(tmp.name, "store-h1-r"), blob,
+            "10,0.9,2,full", extra_env=agent_env,
+            port=ports["h1"], peers=peers)
+        probe_lane = TcpHostLane("h1", ("127.0.0.1", ports["h1"]))
+        try:
+            check(probe_lane.rpc_stats().get("builds", -1) == 0,
+                  "restarted h1 compiled plans instead of booting "
+                  "warm off the blob tier")
+        finally:
+            probe_lane.close()
+        # zero operator intervention: routed traffic drives frontend
+        # A's probe ladder (it observed the death) until the lane is
+        # re-reconciled and readmitted; frontend B keeps serving
+        # through it directly
+        readmit_deadline = time.monotonic() + 60.0
+        while time.monotonic() < readmit_deadline:
+            for front in (pod, pod2):
+                v = (rng.standard_normal(len(trip))
+                     + 1j * rng.standard_normal(len(trip)))
+                got = np.asarray(front.submit_backward(sig, v)
+                                 .result(timeout=120))
+                check(np.array_equal(got,
+                                     np.asarray(plan.backward(v))),
+                      "request diverged during the readmission window")
+            if (_counter_sum("spfft_cluster_readmits_total",
+                             host="h1", outcome="readmitted") >= 1):
+                break
+            time.sleep(0.2)
+        check(_counter_sum("spfft_cluster_readmits_total",
+                           host="h1", outcome="readmitted") >= 1,
+              "the probe ladder never readmitted restarted h1")
+        check(lanes["h1"].alive,
+              "restarted h1's lane still marked dead after readmission")
+        alive_view = pod.view()
+        check(alive_view["members"].get("h1", {}).get("state")
+              == "alive" and alive_view["epoch"] > evicted_view["epoch"],
+              f"readmission did not re-alive h1 with an epoch bump: "
+              f"{alive_view}")
+        check(pod2.view()["epoch"] == alive_view["epoch"],
+              "frontends did not converge after readmission")
+        # the resurrected lane must actually serve again, bit-exact
+        served_by_h1 = _counter_sum("spfft_cluster_routed_total",
+                                    host="h1")
+        for _ in range(8):
+            v = (rng.standard_normal(len(trip))
+                 + 1j * rng.standard_normal(len(trip)))
+            got = np.asarray(pod.submit_backward(sig, v)
+                             .result(timeout=120))
+            check(np.array_equal(got, np.asarray(plan.backward(v))),
+                  "post-readmission result not bit-exact")
+        check(_counter_sum("spfft_cluster_routed_total",
+                           host="h1") > served_by_h1,
+              "readmitted h1 received no routes")
+        check(tracer.open_count() == 0,
+              "unclosed client spans after the self-healing phase")
+
         # -- drain-leave: the other half of elasticity -----------------
         left = pod.leave("h2")
         check(left["drained"],
@@ -299,12 +418,14 @@ def _run_pod_smoke(seed: int = 0) -> int:
                   f"membership ladder missing the {event!r} event")
 
         # polite shutdown for the survivors that still listen
-        for host in ("h0", "h2"):
+        for host in ("h0", "h1", "h2"):
             try:
                 lanes[host].rpc_shutdown()
             except Exception:
                 pass
     finally:
+        if pod2 is not None:
+            pod2.close()
         if pod is not None:
             pod.close()
         for lane in lanes.values():
@@ -325,12 +446,14 @@ def _run_pod_smoke(seed: int = 0) -> int:
         print(f"pod-smoke FAIL: {msg}")
     if failures:
         return 1
-    print(f"pod-smoke: 39 requests bit-exact across a real TCP pod "
+    print(f"pod-smoke: bit-exact across a real TCP pod "
           f"(2 processes + 1 mid-stream join, builds=0 on the joiner, "
           f"a concurrent distributed pair COALESCED into one "
           f"collective round agent-side, kill -9 failover typed, "
-          f"{crossed} spans crossed the process boundary on one "
-          f"trace id each)")
+          f"then SELF-HEALED: lease expired -> evicted with an epoch "
+          f"bump seen by two frontends -> restarted warm off the blob "
+          f"tier -> probe ladder readmitted, {crossed} spans crossed "
+          f"the process boundary on one trace id each)")
     print("POD SMOKE GREEN")
     return 0
 

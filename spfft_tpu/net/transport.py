@@ -33,6 +33,7 @@ score busier than a near one at equal queue depth.
 
 from __future__ import annotations
 
+import random
 import socket
 import threading
 import time
@@ -243,8 +244,7 @@ class TcpTransport(LoopbackTransport):
                     sock.close()
                     raise self._fail(op, exc) from exc
         try:
-            sock = socket.create_connection(
-                self.address, timeout=self._connect_timeout)
+            sock = self._connect_with_retry(op)
         except (OSError, InjectedFault) as exc:
             raise self._fail(op, exc) from exc
         try:
@@ -254,6 +254,29 @@ class TcpTransport(LoopbackTransport):
             sock.close()
             raise self._fail(op, exc) from exc
         return sock, op, t0
+
+    #: Fresh-connect attempts before the lane is declared dead, and
+    #: the base backoff between them (exponential + jitter). One
+    #: refused connect from an agent mid-restart must not kill the
+    #: lane; a truly dead host still exhausts the budget in well under
+    #: a second on ECONNREFUSED.
+    CONNECT_ATTEMPTS = 3
+    CONNECT_BACKOFF_S = 0.05
+
+    def _connect_with_retry(self, op: str):
+        last = None
+        for attempt in range(self.CONNECT_ATTEMPTS):
+            if attempt:
+                delay = self.CONNECT_BACKOFF_S * (2 ** (attempt - 1))
+                time.sleep(delay * (1.0 + random.random() * 0.25))
+                _obs.GLOBAL_COUNTERS.inc(
+                    "spfft_net_rpc_retries_total", verb=op)
+            try:
+                return socket.create_connection(
+                    self.address, timeout=self._connect_timeout)
+            except OSError as exc:
+                last = exc
+        raise last
 
     def finish_call(self, sock, op: str,
                     t0: float) -> Tuple[dict, bytes]:
@@ -330,12 +353,15 @@ class TcpHostLane(HostLane):
                    kind: str = "backward",
                    scaling: Scaling = Scaling.NONE,
                    timeout: Optional[float] = None,
-                   priority: str = "normal", ctx=None) -> Future:
+                   priority: str = "normal", ctx=None,
+                   epoch: Optional[int] = None) -> Future:
         """Submit one request over the wire. The propagated trace
         context rides the frame header, so the agent's ``serve.request``
         root carries the frontend's trace id — one id end-to-end across
-        the process boundary. Connect + send run synchronously (a
-        ``kill -9``'d host raises ``HostLaneError`` HERE, where the
+        the process boundary. ``epoch`` stamps the frontend's view
+        epoch for membership fencing (the agent rejects stale stamps
+        typed as ``StaleEpochError``). Connect + send run synchronously
+        (a ``kill -9``'d host raises ``HostLaneError`` HERE, where the
         frontend fails over); only the response read is deferred to the
         lane's pool."""
         self.transport.check("submit")
@@ -344,7 +370,7 @@ class TcpHostLane(HostLane):
                   "signature": signature_to_wire(signature),
                   "kind": kind, "scaling": Scaling(scaling).value,
                   "timeout": timeout, "priority": priority,
-                  "ctx": _ctx_to_wire(ctx),
+                  "ctx": _ctx_to_wire(ctx), "epoch": epoch,
                   **meta}
         wire_timeout = None if timeout is None \
             else timeout + self.transport._rpc_timeout
@@ -426,6 +452,25 @@ class TcpHostLane(HostLane):
         reply, _ = self.transport.call({"type": "spans"})
         return {"spans": list(reply.get("spans", [])),
                 "open": int(reply.get("open", 0))}
+
+    def rpc_heartbeat(self, host: str,
+                      address: Optional[str] = None) -> dict:
+        """Renew ``host``'s membership lease with this lane's agent
+        (redirect acks name the real coordinator)."""
+        self.transport.check("heartbeat")
+        reply, _ = self.transport.call(
+            {"type": "heartbeat", "host": host, "address": address})
+        return {k: v for k, v in reply.items() if k != "type"}
+
+    # trace: boundary(ctx)
+    def rpc_view(self, ctx=None) -> dict:
+        """Fetch the agent's signed membership view (wire form). The
+        propagated trace context rides the header so a view refetch
+        inside a stale-epoch retry stays on the request's trace."""
+        self.transport.check("view")
+        reply, _ = self.transport.call(
+            {"type": "view", "ctx": _ctx_to_wire(ctx)})
+        return dict(reply.get("view") or {})
 
     def close(self) -> None:
         """Release the lane's client thread pool and any idle

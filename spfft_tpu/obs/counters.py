@@ -303,7 +303,7 @@ METRIC_SPECS: Dict[str, Tuple[str, str]] = {
         ("counter",
          "Pod membership transitions, labelled {event="
          "join_started|prewarmed|reconciled|joined|join_failed|"
-         "leave_started|drained|left}."),
+         "leave_started|drained|left|evicted|readmitted}."),
     "spfft_cluster_spmd_rejected_total":
         ("counter",
          "SPMD-lane submissions refused by admission control, "
@@ -323,7 +323,7 @@ METRIC_SPECS: Dict[str, Tuple[str, str]] = {
     "spfft_net_agent_rejected_total":
         ("counter",
          "Submits a HostAgent refused at its own admission seam, "
-         "labelled {reason=queue_full|expired}."),
+         "labelled {reason=queue_full|expired|auth|stale_epoch}."),
     "spfft_blob_ops_total":
         ("counter",
          "Remote blob-tier operations, labelled {op=get|put, "
@@ -332,6 +332,46 @@ METRIC_SPECS: Dict[str, Tuple[str, str]] = {
         ("counter",
          "Plan-artifact store remote-tier outcomes, labelled "
          "{op=get|put, outcome=hit|miss|ok|error}."),
+    # lease-based membership + lane resurrection (round 21)
+    "spfft_net_rpc_retries_total":
+        ("counter",
+         "Wire-RPC connect retries before a lane was declared dead "
+         "(bounded backoff in the sync connect path), labelled "
+         "{verb}."),
+    "spfft_membership_epoch":
+        ("gauge",
+         "Current membership-view epoch as each node last saw it, "
+         "labelled {node} (coordinator host or frontend id) — nodes "
+         "converging is the split-brain invariant."),
+    "spfft_membership_transitions_total":
+        ("counter",
+         "Lease-ladder state transitions at the view coordinator, "
+         "labelled {host, to=alive|suspected|probed|evicted}."),
+    "spfft_membership_heartbeats_total":
+        ("counter",
+         "Membership lease-renewal heartbeats, labelled "
+         "{outcome=ok|redirect|failed}."),
+    "spfft_membership_views_total":
+        ("counter",
+         "Signed membership-view traffic, labelled "
+         "{outcome=served|adopted|stale|bad_sig|error}."),
+    "spfft_cluster_stale_epoch_total":
+        ("counter",
+         "Operations rejected for carrying a stale view epoch "
+         "(typed transient StaleEpochError; the sender refetches the "
+         "view and retries), labelled {node}."),
+    "spfft_cluster_probes_total":
+        ("counter",
+         "Health probes of dead lanes by the resurrection ladder, "
+         "labelled {host, outcome=ok|failed}."),
+    "spfft_cluster_readmits_total":
+        ("counter",
+         "Dead-lane readmission attempts after a successful probe, "
+         "labelled {host, outcome=readmitted|blocked}."),
+    "spfft_blob_gc_total":
+        ("counter",
+         "Remote blob-tier gc sweep outcomes over the req/ journal "
+         "namespace, labelled {outcome=removed|error|skipped}."),
 }
 
 

@@ -1270,17 +1270,217 @@ def _run_chaos(args) -> int:
     }
     spans_closed("phaseE")
 
+    # -- phase F: partition storm — self-healing membership ------------
+    # The round-21 liveness ladder under deterministic partitions.
+    # F1: TWO frontends over the SAME loopback pod share one
+    # ViewCoordinator — a lane death observed by frontend A evicts the
+    # lane with an epoch bump, frontend B's stale stamp is fenced typed
+    # (StaleEpochError, counted) and recovers by refetching, both
+    # converge on the SAME epoch/view, survivors stay bit-exact, and
+    # the resurrection ladder (probe -> blocked-under-fault ->
+    # re-reconcile -> readmit) brings the lane back warm. F2: a
+    # three-node lease-based membership on a fake clock — the
+    # coordinator dies, its heartbeat targets re-elect the SAME
+    # successor deterministically, an expired lease walks
+    # suspected->probed->evicted, and a restarted node's next heartbeat
+    # readmits it alive. The three round-21 sites (net.heartbeat,
+    # cluster.view, cluster.readmit) each fire typed and contained.
+    from ..errors import StaleEpochError
+    from ..net.membership import (ALIVE, EVICTED, MembershipNode,
+                                  ViewCoordinator)
+    from .cluster import HostLane, PodFrontend
+
+    subsystem_of.update({"net.heartbeat": "membership",
+                         "cluster.view": "membership",
+                         "cluster.readmit": "cluster"})
+
+    # F1 — two-frontend convergence over a shared coordinator
+    reg_f0 = PlanRegistry(store=False)
+    reg_f0.put(osig, oplan)
+    reg_f1 = PlanRegistry(store=False)
+    reg_f1.put(osig, oplan)
+    ex_f0 = ServeExecutor(reg_f0)
+    ex_f1 = ServeExecutor(reg_f1)
+    mm = ViewCoordinator("h0")
+    fa = PodFrontend([HostLane("h0", ex_f0), HostLane("h1", ex_f1)],
+                     membership=mm, seed=seed)
+    fb = PodFrontend([HostLane("h0", ex_f0), HostLane("h1", ex_f1)],
+                     membership=mm, seed=seed + 1)
+    try:
+        for front, tag in ((fa, "fa"), (fb, "fb")):
+            w = vals()
+            got = np.asarray(front.submit(osig, w).result(timeout=60))
+            check(np.array_equal(got, np.asarray(oplan.backward(w))),
+                  f"phaseF1: pre-storm request via {tag} diverged")
+        epoch0 = fa.epoch
+        check(fb.epoch == epoch0,
+              f"phaseF1: frontends disagree pre-storm "
+              f"({fa.epoch} vs {fb.epoch})")
+        # frontend A observes h1's death: failover + eviction + bump.
+        # _mark_dead is the detection event a failed RPC delivers
+        # (kill_host would also close the executor we resurrect below).
+        dead_lane = fa._lanes[1]
+        fa._mark_dead(dead_lane)
+        for _ in range(3):
+            w = vals()
+            got = np.asarray(fa.submit(osig, w).result(timeout=60))
+            check(np.array_equal(got, np.asarray(oplan.backward(w))),
+                  "phaseF1: survivor request diverged after kill")
+        check(fa.epoch > epoch0,
+              f"phaseF1: eviction did not bump the epoch "
+              f"({epoch0} -> {fa.epoch})")
+        # frontend B is now STALE: its next submit is fenced typed
+        # (counted) and recovers by refetching the shared view
+        stale0 = obs.GLOBAL_COUNTERS.get(
+            "spfft_cluster_stale_epoch_total", node="frontend")
+        w = vals()
+        got = np.asarray(fb.submit(osig, w).result(timeout=60))
+        check(np.array_equal(got, np.asarray(oplan.backward(w))),
+              "phaseF1: stale frontend's request diverged")
+        check(obs.GLOBAL_COUNTERS.get(
+                  "spfft_cluster_stale_epoch_total",
+                  node="frontend") > stale0,
+              "phaseF1: stale frontend was not fenced typed")
+        check(fb.epoch == fa.epoch,
+              f"phaseF1: frontends did not converge after eviction "
+              f"({fa.epoch} vs {fb.epoch})")
+        va, vb = fa.view(), fb.view()
+        check(va["epoch"] == vb["epoch"]
+              and va["members"] == vb["members"],
+              f"phaseF1: views diverge: {va} vs {vb}")
+        check(va["members"]["h1"]["state"] == EVICTED,
+              f"phaseF1: h1 not tombstoned evicted: {va}")
+        # resurrection: readmission BLOCKED under an armed
+        # cluster.readmit fault, then clean probe readmits warm
+        dead_lane.transport.alive = True
+        fplan = FaultPlan(script=["cluster.readmit@1"])
+        faults.arm(fplan)
+        out1 = fa.probe_dead(force=True)
+        faults.disarm()
+        tally(fplan)
+        check(out1.get("h1") == "blocked",
+              f"phaseF1: faulted readmit not blocked: {out1}")
+        out2 = fa.probe_dead(force=True)
+        check(out2.get("h1") == "readmitted",
+              f"phaseF1: clean probe did not readmit: {out2}")
+        check(fa.view()["members"]["h1"]["state"] == ALIVE,
+              "phaseF1: readmitted lane not alive in the view")
+        check(fb.view()["epoch"] == fa.epoch,
+              "phaseF1: frontends did not converge after readmission")
+        for front, tag in ((fa, "fa"), (fb, "fb")):
+            w = vals()
+            got = np.asarray(front.submit(osig, w).result(timeout=60))
+            check(np.array_equal(got, np.asarray(oplan.backward(w))),
+                  f"phaseF1: post-readmit request via {tag} diverged")
+        phases["F1_two_frontend_convergence"] = {
+            "epoch": fa.epoch, "members": fa.view()["members"]}
+    finally:
+        faults.disarm()
+        fa.close()
+        fb.close()
+    spans_closed("phaseF1")
+
+    # F2 — lease expiry, deterministic re-election, heartbeat readmit
+    now_s = [0.0]
+    nodes: dict = {}
+    down: set = set()
+
+    def mem_wire(addr, hdr):
+        if addr in down:
+            raise OSError(f"{addr} unreachable (partitioned)")
+        return nodes[addr].on_heartbeat(str(hdr["host"]),
+                                        hdr.get("address"))
+
+    for h in ("m0", "m1", "m2"):
+        peers = {p: p for p in ("m0", "m1", "m2") if p != h}
+        nodes[h] = MembershipNode(h, address=h, peers=peers,
+                                  clock=lambda: now_s[0], secret=None)
+    check(nodes["m0"].is_coordinator
+          and not nodes["m1"].is_coordinator,
+          "phaseF2: lowest host id is not the initial coordinator")
+    for h in ("m1", "m2"):
+        check(nodes[h].tick(mem_wire) == "ok",
+              f"phaseF2: initial heartbeat from {h} failed")
+    # net.heartbeat fires typed and is CONTAINED in the tick
+    fplan = FaultPlan(script=["net.heartbeat@1"])
+    faults.arm(fplan)
+    check(nodes["m1"].tick(mem_wire) == "failed",
+          "phaseF2: faulted heartbeat not contained as 'failed'")
+    faults.disarm()
+    tally(fplan)
+    check(nodes["m1"].tick(mem_wire) == "ok",
+          "phaseF2: heartbeat did not recover post-disarm")
+    # cluster.view fires typed on view serving
+    fplan = FaultPlan(script=["cluster.view@1"])
+    faults.arm(fplan)
+    try:
+        nodes["m0"].on_view()
+        check(False, "phaseF2: armed cluster.view did not fire")
+    except typed:
+        pass
+    faults.disarm()
+    tally(fplan)
+    for h in ("m1", "m2"):
+        check(nodes[h].adopt(nodes["m0"].on_view()),
+              f"phaseF2: {h} did not adopt the coordinator view")
+    # kill the coordinator: its heartbeat targets re-elect the SAME
+    # successor (lowest alive id) after COORD_FAIL_STREAK failures
+    down.add("m0")
+    outcomes = [nodes["m1"].tick(mem_wire) for _ in range(3)]
+    check(outcomes[-1] == "promoted",
+          f"phaseF2: m1 did not promote itself: {outcomes}")
+    check(nodes["m1"].is_coordinator,
+          "phaseF2: promoted node is not coordinator")
+    m2_out = [nodes["m2"].tick(mem_wire) for _ in range(4)]
+    check("re-elected" in m2_out and m2_out[-1] == "ok",
+          f"phaseF2: m2 did not re-elect and re-target m1: {m2_out}")
+    check(nodes["m2"].adopt(nodes["m1"].on_view()),
+          "phaseF2: m2 did not adopt the new coordinator's view")
+    check(nodes["m2"].epoch == nodes["m1"].epoch,
+          f"phaseF2: epochs diverge after election "
+          f"({nodes['m1'].epoch} vs {nodes['m2'].epoch})")
+    # lease expiry ladder: m2 stops renewing, the clock runs past
+    # EVICT_AFTER x TTL, the coordinator evicts it with a bump
+    pre_evict = nodes["m1"].epoch
+    now_s[0] += 10.0
+    nodes["m1"].tick(mem_wire)  # coordinator tick runs expiry
+    states = {h: r["state"]
+              for h, r in nodes["m1"].on_view()["members"].items()}
+    check(states.get("m2") == EVICTED,
+          f"phaseF2: silent m2 not evicted by lease expiry: {states}")
+    check(nodes["m1"].epoch > pre_evict,
+          "phaseF2: lease eviction did not bump the epoch")
+    # epoch fencing at the agent door: the pre-eviction stamp is
+    # rejected typed, the current stamp passes
+    try:
+        nodes["m1"].check_epoch(pre_evict - 1)
+        check(False, "phaseF2: stale epoch stamp not fenced")
+    except StaleEpochError:
+        pass
+    nodes["m1"].check_epoch(nodes["m1"].epoch)
+    # restart: the evicted node's next heartbeat readmits it alive
+    check(nodes["m2"].tick(mem_wire) == "ok",
+          "phaseF2: restarted node's heartbeat failed")
+    states = {h: r["state"]
+              for h, r in nodes["m1"].on_view()["members"].items()}
+    check(states.get("m2") == ALIVE,
+          f"phaseF2: restarted m2 not readmitted alive: {states}")
+    phases["F2_lease_election"] = {
+        "coordinator": nodes["m1"].coordinator()[0],
+        "epoch": nodes["m1"].epoch, "states": states}
+    spans_closed("phaseF2")
+
     subsystems = sorted({subsystem_of[s] for s in fired_sites
                          if s in subsystem_of}
                         | ({"kernel"} if "kernel.launch" in fired_sites
                            else set()))
-    check(len(fired_sites) >= 12,
+    check(len(fired_sites) >= 21,
           f"chaos coverage: only {len(fired_sites)} fault sites fired "
           f"({sorted(fired_sites)})")
-    check(len(subsystems) >= 6,
+    check(len(subsystems) >= 8,
           f"chaos coverage: only {len(subsystems)} subsystems hit "
           f"({subsystems})")
-    check({"net", "blob"} <= set(subsystems),
+    check({"net", "blob", "membership"} <= set(subsystems),
           f"chaos coverage: wire subsystems not exercised "
           f"({subsystems})")
 

@@ -33,11 +33,25 @@ into pod throughput:
   ``/metrics`` (each host's series re-labelled ``host="..."``), with
   :meth:`PodFrontend.health` as the worst-health-wins ``/healthz``.
 * **Fault sites** — ``cluster.route`` (the host pick),
-  ``cluster.rpc`` (every lane RPC) and ``cluster.reconcile`` (the
-  per-host digest collective) extend the package seam in
+  ``cluster.rpc`` (every lane RPC), ``cluster.reconcile`` (the
+  per-host digest collective) and ``cluster.readmit`` (the
+  resurrection re-reconcile) extend the package seam in
   ``spfft_tpu.faults``; a lane whose transport fails is marked dead,
   the pod degrades, survivors keep serving and every issued future
   still resolves.
+* **Self-healing membership** (round 21) — the frontend stamps every
+  routed request with the membership view epoch from
+  :mod:`spfft_tpu.net.membership` (a private ``ViewCoordinator`` for
+  loopback pods, the agents' lease-based coordinator for remote ones).
+  Work stamped with an older epoch is rejected typed
+  (``StaleEpochError``, transient): the frontend refetches the view
+  and retries, so two frontends over the same pod converge on one
+  membership instead of disagreeing silently. A dead lane is no longer
+  dead forever: it enters a backoff-probed resurrection ladder
+  (``rpc_health`` probes under exponential backoff + jitter), is
+  RE-RECONCILED against an incumbent (the round-18 fingerprint digest
+  — a resurrected host serving stale plans is blocked, not readmitted)
+  and only then readmitted warm with an epoch bump.
 
 ``python -m spfft_tpu.serve.cluster --smoke`` is the deterministic
 2-host CPU smoke behind ``make cluster-smoke``; ``--simulate`` runs the
@@ -62,8 +76,9 @@ from .. import faults as _faults
 from .. import obs as _obs
 from ..errors import (ClusterError, ClusterReconciliationError,
                       DeadlineExpiredError, HostLaneError,
-                      InvalidParameterError, ParameterMismatchError,
-                      QueueFullError)
+                      InvalidParameterError, NetAuthError,
+                      ParameterMismatchError, PlanArtifactError,
+                      QueueFullError, StaleEpochError)
 from ..faults import InjectedFault
 from ..obs.counters import METRIC_SPECS
 from ..obs.exporters import _PromBuilder, parse_prometheus_text, \
@@ -81,6 +96,18 @@ _STATE_ORDER = ("healthy", "degraded", "draining", "failed")
 _STATE_RANK = {s: i for i, s in enumerate(_STATE_ORDER)}
 
 _PRIORITIES = ("normal", "high")
+
+#: Resurrection-ladder backoff growth cap: a probed-forever lane
+#: settles at ``lane_probe_backoff * 64`` between probes, never more.
+_PROBE_BACKOFF_CAP = 64
+
+
+def _membership_module():
+    """Deferred import of :mod:`spfft_tpu.net.membership` —
+    ``net.transport`` imports THIS module at its top level, so the
+    membership plane must resolve lazily to keep the package acyclic."""
+    from ..net import membership
+    return membership
 
 
 def load_score(signals: dict) -> Tuple[float, float, float]:
@@ -155,10 +182,15 @@ class HostLane:
                    kind: str = "backward",
                    scaling: Scaling = Scaling.NONE,
                    timeout: Optional[float] = None,
-                   priority: str = "normal", ctx=None) -> Future:
+                   priority: str = "normal", ctx=None,
+                   epoch: Optional[int] = None) -> Future:
         """Submit one single-device request to this host's executor,
         restoring the propagated trace context so the host's
-        ``serve.request`` root is a child of the frontend span."""
+        ``serve.request`` root is a child of the frontend span. The
+        ``epoch`` stamp is accepted for surface parity with the remote
+        lane but not fenced here: an in-process pod fences at the
+        frontend's door (``PodFrontend.submit``), where the one shared
+        ``ViewCoordinator`` lives."""
         self.transport.check("submit")
         return self.executor.submit(signature, values, kind,
                                     scaling=scaling, timeout=timeout,
@@ -487,10 +519,19 @@ class PodFrontend:
     or ``"rr"`` (round-robin; kept for the routing benchmark and as the
     degenerate fallback). ``seed`` fixes the choice sampler, so a
     replayed trace routes identically.
+
+    ``membership`` is the :class:`net.membership.ViewCoordinator` this
+    frontend fences against: None builds a private one (a loopback pod
+    is trivially its own coordinator); two frontends over the same
+    lanes share one coordinator to converge on a single epoch-fenced
+    view. When any lane is remote (it carries ``rpc_view``), the
+    AGENTS' lease-based coordinator is the authority instead and the
+    local coordinator is only this frontend's fencing mirror.
     """
 
     def __init__(self, lanes: Sequence, policy: str = "p2c",
-                 seed: int = 0, reconcile: bool = True):
+                 seed: int = 0, reconcile: bool = True,
+                 membership=None):
         if policy not in ("p2c", "rr"):
             raise InvalidParameterError(
                 f"routing policy must be 'p2c' or 'rr', got {policy!r}")
@@ -514,6 +555,24 @@ class PodFrontend:
         self._spmd = _SPMDLane()
         self._tracer = _obs.GLOBAL_TRACER
         self._closed = False
+        # -- membership plane: the epoch this frontend fences against
+        self._remote = any(hasattr(ln, "rpc_view") for ln in self._lanes)
+        if membership is None:
+            membership = _membership_module().ViewCoordinator(
+                min(names))
+        self._membership = membership
+        for ln in self._lanes:
+            self._membership.ensure(ln.host)
+        #: resurrection ladder: host -> [failed probes, next-probe
+        #: deadline (monotonic)]  #: guarded by _dead_lock
+        self._dead: Dict[str, list] = {}
+        self._dead_lock = threading.Lock()
+        self._stamp = self._membership.epoch  # refreshed via view()
+        if self._remote:
+            try:
+                self.view()
+            except (ClusterError, HostLaneError):
+                pass  # no agent reachable yet; first submit refetches
         if reconcile:
             self.reconcile()
 
@@ -615,6 +674,50 @@ class PodFrontend:
             raise ClusterReconciliationError(
                 f"plan {sig} disagrees across the pod: {detail}")
 
+    # -- membership view ----------------------------------------------------
+    @property
+    def epoch(self) -> int:
+        """The view epoch this frontend currently stamps on routed
+        work (the last one :meth:`view` fetched)."""
+        return self._stamp
+
+    def view(self) -> dict:
+        """Fetch, verify and adopt the pod's current signed membership
+        view; returns its wire form and refreshes the fencing stamp.
+        Loopback pods serve it from the frontend's own coordinator;
+        remote pods fetch it from the first reachable agent (every
+        agent converges on the coordinator's view). A view whose
+        signature does not verify is the permanent
+        :class:`NetAuthError` — never silently adopted."""
+        mm = _membership_module()
+        if not self._remote:
+            v = self._membership.view()
+            self._stamp = v.epoch
+            return v.to_wire()
+        last: Optional[Exception] = None
+        for lane in self._lanes:
+            if not hasattr(lane, "rpc_view") or not lane.alive:
+                continue
+            try:
+                wire = lane.rpc_view(ctx=None)
+            except HostLaneError as exc:
+                last = exc
+                continue
+            v = mm.MembershipView.from_wire(wire)
+            if not v.verify(mm._secret()):
+                _obs.GLOBAL_COUNTERS.inc(
+                    "spfft_membership_views_total", outcome="bad_sig")
+                raise NetAuthError(
+                    f"membership view from host {lane.host!r} does "
+                    f"not verify")
+            _obs.GLOBAL_COUNTERS.inc("spfft_membership_views_total",
+                                     outcome="adopted")
+            self._stamp = v.epoch
+            return v.to_wire()
+        raise ClusterError(
+            "no alive host lane served the membership view"
+            + (f" (last transport error: {last})" if last else ""))
+
     # -- submission ---------------------------------------------------------
     def submit(self, signature: PlanSignature, values,
                kind: str = "backward",
@@ -640,6 +743,17 @@ class PodFrontend:
             raise InvalidParameterError(
                 f"priority must be 'normal' or 'high', got {priority!r}")
         scaling = Scaling(scaling)
+        if not self._remote:
+            # loopback fencing happens at the frontend's own door: a
+            # stamp gone stale (another frontend over the shared
+            # coordinator changed the membership) is rejected typed —
+            # and recovered exactly as the contract says, by refetching
+            # the view and retrying with the fresh epoch.
+            try:
+                self._membership.check_epoch(self._stamp,
+                                             node="frontend")
+            except StaleEpochError:
+                self._stamp = self._membership.epoch
         plan = self._resolve_plan(signature)
         # a dict is a remote plan DESCRIPTOR (net.TcpHostLane.rpc_plan
         # — the plan object itself never crosses the wire): execution
@@ -747,22 +861,68 @@ class PodFrontend:
             try:
                 fut = lane.rpc_submit(signature, values, kind,
                                       scaling=scaling, timeout=timeout,
-                                      priority=priority, ctx=ctx)
+                                      priority=priority, ctx=ctx,
+                                      epoch=self._stamp)
             except HostLaneError:
                 self._mark_dead(lane)
                 continue
             _obs.GLOBAL_COUNTERS.inc("spfft_cluster_routed_total",
                                      host=lane.host, kind=routed_kind)
+            if self._remote:
+                fut = self._fence_retry(
+                    fut, lane, (signature, values, kind, scaling,
+                                timeout, priority, ctx))
             return fut
         raise ClusterError(
             "no alive host lanes accepted the request (all transports "
             "down)")
 
+    def _fence_retry(self, fut: Future, lane, request) -> Future:
+        """Wrap a remote submit future with the epoch-fencing recovery
+        contract: an agent-side :class:`StaleEpochError` (typed,
+        transient) refetches the view and resubmits ONCE with the
+        fresh stamp — transparent to the caller's future. Any other
+        resolution passes through untouched."""
+        outer: Future = Future()
+        outer.set_running_or_notify_cancel()
+        signature, values, kind, scaling, timeout, priority, ctx = \
+            request
+
+        def _copy(f: Future) -> None:
+            exc = f.exception()
+            if exc is None:
+                outer.set_result(f.result())
+            else:
+                outer.set_exception(exc)
+
+        def _first(f: Future) -> None:
+            exc = f.exception()
+            if not isinstance(exc, StaleEpochError):
+                _copy(f)
+                return
+            try:
+                self.view()
+                retry = lane.rpc_submit(
+                    signature, values, kind, scaling=scaling,
+                    timeout=timeout, priority=priority, ctx=ctx,
+                    epoch=self._stamp)
+            except BaseException as rexc:
+                outer.set_exception(rexc)
+                return
+            retry.add_done_callback(_copy)
+
+        fut.add_done_callback(_first)
+        return outer
+
     def _candidates(self) -> List[HostLane]:
         """Lanes in dispatch-preference order: the policy's pick first,
-        then every other alive, non-draining lane as failover."""
+        then every other alive, non-draining lane as failover. Lanes on
+        the resurrection ladder are NOT candidates — readmission, not
+        the raw transport flag, controls candidacy."""
+        self._maybe_probe()
         alive = [ln for ln in self._lanes
-                 if ln.alive and not ln.draining]
+                 if ln.alive and not ln.draining
+                 and not self._on_ladder(ln.host)]
         if len(alive) <= 1:
             return alive
         if self.policy == "rr":
@@ -795,18 +955,164 @@ class PodFrontend:
         the alive-lane count) so concurrent same-signature requests
         co-locate and the host agent's coalescing window can merge
         them; the remaining alive lanes follow as failover."""
+        self._maybe_probe()
         alive = [ln for ln in self._lanes
-                 if ln.alive and not ln.draining]
+                 if ln.alive and not ln.draining
+                 and not self._on_ladder(ln.host)]
         if len(alive) <= 1:
             return alive
         start = zlib.crc32(repr(signature).encode()) % len(alive)
         return alive[start:] + alive[:start]
 
     def _mark_dead(self, lane: HostLane) -> None:
+        """A transport failure takes the lane out of routing — but no
+        longer forever. The lane enters the resurrection ladder: its
+        eviction bumps the view epoch (both frontends over a shared
+        coordinator observe it), and backoff-spaced health probes keep
+        testing it until re-reconciliation readmits it warm."""
         if lane.transport.alive:
             lane.transport.alive = False
         _obs.GLOBAL_COUNTERS.inc("spfft_cluster_lane_deaths_total",
                                  host=lane.host)
+        with self._dead_lock:
+            fresh = lane.host not in self._dead
+            if fresh:
+                base = self._probe_backoff()
+                with self._rng_lock:
+                    jitter = 1.0 + self._rng.random() * 0.25
+                self._dead[lane.host] = [0,
+                                         time.monotonic() + base * jitter]
+        if fresh:
+            self._membership.evict(lane.host)
+            self._count_membership("evicted")
+            if not self._remote:
+                self._stamp = self._membership.epoch
+
+    def _probe_backoff(self) -> float:
+        from ..control.config import global_config
+        return float(global_config().lane_probe_backoff)
+
+    def _on_ladder(self, host: str) -> bool:
+        with self._dead_lock:
+            return host in self._dead
+
+    def _maybe_probe(self, now: Optional[float] = None) -> None:
+        """Opportunistic resurrection, piggybacked on routing (no
+        extra thread): probe any dead lane whose backoff deadline has
+        passed."""
+        if now is None:
+            now = time.monotonic()
+        with self._dead_lock:
+            due = [h for h, (_, deadline) in self._dead.items()
+                   if now >= deadline]
+        for host in due:
+            lane = next((ln for ln in self._lanes if ln.host == host),
+                        None)
+            if lane is None:  # left the pod while on the ladder
+                with self._dead_lock:
+                    self._dead.pop(host, None)
+                continue
+            self._probe(lane, now)
+
+    def probe_dead(self, force: bool = False) -> Dict[str, str]:
+        """Ops/chaos entry point: walk the resurrection ladder NOW.
+        Returns per-host outcomes (``backoff`` when the next probe is
+        not yet due and ``force`` is False, else ``failed`` /
+        ``blocked`` / ``readmitted``)."""
+        now = time.monotonic()
+        with self._dead_lock:
+            entries = [(h, deadline)
+                       for h, (_, deadline) in self._dead.items()]
+        out: Dict[str, str] = {}
+        for host, deadline in entries:
+            if not force and now < deadline:
+                out[host] = "backoff"
+                continue
+            lane = next((ln for ln in self._lanes if ln.host == host),
+                        None)
+            if lane is None:
+                with self._dead_lock:
+                    self._dead.pop(host, None)
+                continue
+            out[host] = self._probe(lane, now)
+        return out
+
+    def _probe(self, lane: HostLane, now: float) -> str:
+        """One ladder step: health-probe the dead lane; on success run
+        the readmission re-reconcile. A remote lane's death is only a
+        cached belief about another process, so the probe re-tests the
+        wire (the transport flag flips back on failure); a loopback
+        lane's flag IS the simulated host state and is respected."""
+        remote = hasattr(lane, "rpc_view")
+        revived = False
+        if remote and not lane.transport.alive:
+            lane.transport.alive = True
+            revived = True
+        try:
+            lane.rpc_health()
+        except (HostLaneError, InjectedFault):
+            if revived:
+                lane.transport.alive = False
+            _obs.GLOBAL_COUNTERS.inc("spfft_cluster_probes_total",
+                                     host=lane.host, outcome="failed")
+            self._defer_probe(lane.host, now)
+            return "failed"
+        _obs.GLOBAL_COUNTERS.inc("spfft_cluster_probes_total",
+                                 host=lane.host, outcome="ok")
+        return self._readmit_lane(lane, now, revived)
+
+    def _readmit_lane(self, lane: HostLane, now: float,
+                      revived: bool) -> str:
+        """The gate between 'answers health probes' and 'receives
+        routes': re-reconcile the resurrected lane against an incumbent
+        over the round-18 fingerprint-digest path. A host that came
+        back serving a DIFFERENT plan set is blocked (typed, counted),
+        not silently readmitted."""
+        base = next(
+            (ln for ln in self._lanes
+             if ln.alive and not ln.draining and ln is not lane
+             and not self._on_ladder(ln.host)), None)
+        try:
+            _faults.check_site("cluster.readmit")
+            if base is not None:
+                sigs = base.rpc_signatures()
+                lane.rpc_prewarm(sigs, strict=True)
+                self._reconcile_join(lane, base, sigs)
+        except (ClusterReconciliationError, HostLaneError,
+                PlanArtifactError, InjectedFault):
+            if revived:
+                lane.transport.alive = False
+            _obs.GLOBAL_COUNTERS.inc("spfft_cluster_readmits_total",
+                                     host=lane.host, outcome="blocked")
+            self._defer_probe(lane.host, now)
+            return "blocked"
+        with self._dead_lock:
+            self._dead.pop(lane.host, None)
+        lane.transport.alive = True
+        lane.draining = False
+        self._membership.readmit(lane.host)
+        self._count_membership("readmitted")
+        if not self._remote:
+            self._stamp = self._membership.epoch
+        _obs.GLOBAL_COUNTERS.inc("spfft_cluster_readmits_total",
+                                 host=lane.host, outcome="readmitted")
+        return "readmitted"
+
+    def _defer_probe(self, host: str, now: float) -> None:
+        """Push the host's next probe out: exponential backoff from
+        the ``lane_probe_backoff`` knob, capped at 64x, jittered from
+        the frontend's seeded sampler (deterministic under chaos
+        replay)."""
+        with self._dead_lock:
+            entry = self._dead.get(host)
+            if entry is None:
+                return
+            entry[0] += 1
+            delay = self._probe_backoff() * min(2 ** entry[0],
+                                                _PROBE_BACKOFF_CAP)
+            with self._rng_lock:
+                delay *= 1.0 + self._rng.random() * 0.25
+            entry[1] = now + delay
 
     def kill_host(self, host: str) -> None:
         """Chaos/ops entry point: take one lane out of the pod. Its
@@ -863,6 +1169,9 @@ class PodFrontend:
             self._count_membership("join_failed")
             raise
         self._lanes.append(lane)
+        self._membership.ensure(lane.host)
+        if not self._remote:
+            self._stamp = self._membership.epoch
         self._count_membership("joined")
 
     def _reconcile_join(self, lane: HostLane, base: HostLane,
@@ -906,6 +1215,11 @@ class PodFrontend:
                 drained = True
                 self._count_membership("drained")
         self._lanes.remove(lane)
+        with self._dead_lock:
+            self._dead.pop(host, None)
+        self._membership.leave(host)
+        if not self._remote:
+            self._stamp = self._membership.epoch
         self._count_membership("left")
         return {"host": host, "drained": drained}
 
@@ -952,7 +1266,7 @@ class PodFrontend:
                                      state=s)
         return {"state": worst, "hosts": hosts,
                 "alive": len(self._lanes) - dead,
-                "lanes": len(self._lanes)}
+                "lanes": len(self._lanes), "epoch": self._stamp}
 
     def metrics_text(self) -> str:
         """The pod ``/metrics``: pod-level cluster series (from the

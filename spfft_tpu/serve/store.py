@@ -1250,6 +1250,10 @@ def main(argv=None) -> int:
     g = sub.add_parser("gc", help="evict oldest artifacts past the cap")
     g.add_argument("root")
     g.add_argument("--max-bytes", type=int, default=None)
+    g.add_argument("--remote", action="store_true",
+                   help="also sweep the remote blob tier's req/ "
+                        "journal to the blob_store_max_bytes knob "
+                        "(or --max-bytes when given)")
 
     v = sub.add_parser("verify", help="integrity-check every artifact")
     v.add_argument("root")
@@ -1368,8 +1372,20 @@ def main(argv=None) -> int:
 
     if args.cmd == "gc":
         removed = store.gc(max_bytes=args.max_bytes)
-        print(json.dumps({"cmd": "gc", "removed": removed,
-                          "bytes_in_use": store.bytes_in_use()}))
+        report = {"cmd": "gc", "removed": removed,
+                  "bytes_in_use": store.bytes_in_use()}
+        if args.remote:
+            from ..control.config import global_config
+            from ..net.blobstore import gc_blobstore
+            tier = store._remote_tier()
+            if tier is None:
+                report["remote"] = {"error": "no remote blob tier "
+                                             "configured"}
+            else:
+                cap = args.max_bytes if args.max_bytes is not None \
+                    else global_config().blob_store_max_bytes
+                report["remote"] = gc_blobstore(tier, cap)
+        print(json.dumps(report))
         return 0
 
     if args.cmd == "verify":

@@ -272,6 +272,32 @@ def test_controller_max_queue_grows_on_sustained_reject_burn():
     assert lo <= cfg.max_queue <= hi
 
 
+def test_controller_lease_ttl_widens_under_rtt_inflation():
+    """Round-21 membership rule: sustained wire RTT above 20% of the
+    lease TTL doubles ``lease_ttl_ms`` (a slow fabric must not look
+    like mass death); a single RTT spike moves nothing, and idle
+    steps decay the widened TTL back by halving."""
+    cfg = ServeConfig()
+    default = ServeConfig.default("lease_ttl_ms")
+    ctl = Controller(cfg, cooldown_steps=0)
+    ctl.step(_signals(completed=1))                       # baseline
+    # one inflated-RTT step: a blip, no move
+    s = _signals(completed=5)
+    s["wire_rtt"] = 0.5                                   # > 0.2 * 1.5s
+    assert not [d for d in ctl.step(dict(s))
+                if d.knob == "lease_ttl_ms"]
+    assert cfg.lease_ttl_ms == default
+    # second consecutive step: sustained inflation -> double
+    s["completed"] = 9
+    moved = [d for d in ctl.step(dict(s))
+             if d.knob == "lease_ttl_ms"]
+    assert len(moved) == 1 and moved[0].new == 2 * default
+    assert "RTT" in moved[0].reason
+    # the fabric recovers: idle decay halves back to the default
+    ctl.step(_signals(completed=9))
+    assert cfg.lease_ttl_ms == default
+
+
 def test_controller_max_queue_blip_then_quiet_never_moves():
     cfg = ServeConfig()
     ctl = Controller(cfg, cooldown_steps=0)
