@@ -6,10 +6,14 @@ same pod) and a lane marked dead stayed dead forever. This module is
 the convergence point both gaps close through:
 
 * **Leases** — every agent holds a time-bounded lease it renews with
-  a lightweight ``heartbeat`` RPC. A lease that stops renewing walks
-  the expiry ladder ``alive -> suspected -> probed -> evicted`` at
-  multiples of ``lease_ttl_ms`` past its last renewal; no state is
-  removed on a single missed beat.
+  a lightweight ``heartbeat`` RPC; the ack carries the coordinator's
+  full signed view, which the follower adopts each beat (elections
+  run over real per-host states, never a states-less roster). A lease
+  that stops renewing walks the expiry ladder ``alive -> suspected ->
+  probed -> evicted`` at multiples of ``lease_ttl_ms`` past its last
+  renewal; no state is removed on a single missed beat. Members
+  registered statically via :meth:`ViewCoordinator.ensure` (loopback
+  lanes nothing heartbeats) hold no lease and never expire.
 * **Epochs** — a single :class:`ViewCoordinator` (the lowest alive
   host id; deterministic, no Raft — leases + fencing suffice at pod
   scale) bumps a monotonic view epoch on EVERY membership change and
@@ -179,10 +183,16 @@ class MembershipView:
 
 
 class _Member:
+    """``renewed is None`` means the member holds NO lease (it was
+    statically registered via :meth:`ViewCoordinator.ensure` — a
+    loopback/frontend-embedded lane nothing heartbeats) and is exempt
+    from lease expiry; the first heartbeat converts it to a leased
+    member."""
+
     __slots__ = ("state", "address", "renewed")
 
     def __init__(self, state: str, address: Optional[str],
-                 renewed: float):
+                 renewed: Optional[float]):
         self.state = state
         self.address = address
         self.renewed = renewed
@@ -224,12 +234,15 @@ class ViewCoordinator:
     def ensure(self, host: str, address: Optional[str] = None) -> None:
         """Register ``host`` alive if it is not already a member (the
         frontend's initial roster; idempotent, so two frontends over
-        the same lanes converge instead of double-bumping)."""
-        now = self._clock()
+        the same lanes converge instead of double-bumping). A member
+        registered this way holds NO lease — nothing heartbeats a
+        loopback lane, so lease expiry must not walk it down the
+        ladder; explicit :meth:`evict`/:meth:`readmit` remain its only
+        transitions until a first heartbeat leases it."""
         with self._lock:
             m = self._members.get(host)
             if m is None:
-                self._members[host] = _Member(ALIVE, address, now)
+                self._members[host] = _Member(ALIVE, address, None)
                 self._bump(host, ALIVE)
             elif address is not None and m.address is None:
                 m.address = address
@@ -239,8 +252,10 @@ class ViewCoordinator:
         """Renew ``host``'s lease (creating or resurrecting it — a
         heartbeat from an evicted or unknown host readmits it alive
         with an epoch bump). Returns the renewal ack every agent
-        converges on: epoch, coordinator, TTL and the address
-        roster."""
+        converges on: epoch, coordinator, TTL, the address roster AND
+        the full signed view — followers adopt it each beat, so a
+        coordinator death is re-elected over real per-host states, not
+        a states-less roster (exactly one successor promotes)."""
         _faults.check_site("net.heartbeat")
         if now is None:
             now = self._clock()
@@ -259,9 +274,13 @@ class ViewCoordinator:
             _count_hb("ok")
             roster = {h: mm.address for h, mm in self._members.items()
                       if mm.address and mm.state != EVICTED}
-            return {"epoch": self._epoch, "coordinator": self.host,
-                    "lease_ttl_ms": int(self.ttl() * 1e3),
-                    "roster": roster}
+            snapshot = self._view_locked()
+            ack = {"epoch": self._epoch, "coordinator": self.host,
+                   "lease_ttl_ms": int(self.ttl() * 1e3),
+                   "roster": roster}
+        # sign outside the lock (hashing is the expensive part)
+        ack["view"] = snapshot.signed(self._secret).to_wire()
+        return ack
 
     def expire(self, now: Optional[float] = None
                ) -> List[Tuple[str, str, str]]:
@@ -274,8 +293,9 @@ class ViewCoordinator:
         out = []
         with self._lock:
             for host, m in self._members.items():
-                if host == self.host or m.state == EVICTED:
-                    continue
+                if host == self.host or m.state == EVICTED \
+                        or m.renewed is None:
+                    continue  # self, tombstones and leaseless members
                 age = now - m.renewed
                 if age > EVICT_AFTER * ttl:
                     target = EVICTED
@@ -303,16 +323,19 @@ class ViewCoordinator:
     def readmit(self, host: str, address: Optional[str] = None
                 ) -> None:
         """Explicit readmission after the resurrection ladder
-        re-reconciled the host."""
+        re-reconciled the host. A leaseless (statically ensured)
+        member stays leaseless — readmission must not start a lease
+        nothing will renew."""
         now = self._clock()
         with self._lock:
             m = self._members.get(host)
             if m is None:
-                self._members[host] = _Member(ALIVE, address, now)
+                self._members[host] = _Member(ALIVE, address, None)
                 self._bump(host, ALIVE)
             elif m.state != ALIVE:
                 m.state = ALIVE
-                m.renewed = now
+                if m.renewed is not None:
+                    m.renewed = now
                 if address is not None:
                     m.address = address
                 self._bump(host, ALIVE)
@@ -344,18 +367,22 @@ class ViewCoordinator:
             self._epoch += 1
             _gauge_epoch(self.host, self._epoch)
 
+    # lock: holds(_lock)
+    def _view_locked(self) -> MembershipView:
+        """The unsigned snapshot of the current members + epoch."""
+        members = {h: {"state": m.state, "address": m.address}
+                   for h, m in self._members.items()}
+        return MembershipView(self._epoch, self.host, members)
+
     def view(self, now: Optional[float] = None) -> MembershipView:
         """The signed current view. Serving implies current ladder
         state, so expiry runs first."""
         _faults.check_site("cluster.view")
         self.expire(now)
         with self._lock:
-            members = {h: {"state": m.state, "address": m.address}
-                       for h, m in self._members.items()}
-            epoch = self._epoch
+            snapshot = self._view_locked()
         _count_view("served")
-        return MembershipView(
-            epoch, self.host, members).signed(self._secret)
+        return snapshot.signed(self._secret)
 
     def check_epoch(self, epoch: Optional[int],
                     node: Optional[str] = None) -> None:
@@ -419,6 +446,11 @@ class MembershipNode:
         self._lock = threading.Lock()
         self._roster: Dict[str, str] = dict(peers or {})  #: guarded by _lock
         self._view: Optional[MembershipView] = None  #: guarded by _lock
+        #: hosts THIS node locally believes dead (heartbeat failure
+        #: streaks) — kept OUTSIDE the adopted view, which is signed
+        #: and must never be mutated; cleared on the next successful
+        #: renewal. guarded by _lock
+        self._suspected: set = set()
         self._fail_streak = 0  #: guarded by _lock
         self._coord = ViewCoordinator(host, clock=self._clock,
                                       secret=self._secret)
@@ -434,12 +466,18 @@ class MembershipNode:
     def coordinator(self) -> Tuple[str, Optional[str]]:
         """``(host, address)`` of the coordinator this node believes
         in: itself when active, else the election over its freshest
-        view, else the lowest peer id."""
+        view (with locally suspected hosts overlaid — the adopted view
+        itself stays untouched so its signature keeps verifying), else
+        the lowest peer id."""
         with self._lock:
             if self._active:
                 return self.host, self.address
             if self._view is not None:
-                host = elect_coordinator(self._view.states())
+                states = self._view.states()
+                for suspect in self._suspected:
+                    if suspect in states:
+                        states[suspect] = SUSPECTED
+                host = elect_coordinator(states)
                 if host is not None and host != self.host:
                     row = self._view.members.get(host) or {}
                     addr = row.get("address") \
@@ -552,10 +590,20 @@ class MembershipNode:
             return self._on_heartbeat_failure(coord)
         with self._lock:
             self._fail_streak = 0
+            self._suspected.clear()  # the coordinator answered
             roster = ack.get("roster") or {}
             for h, a in roster.items():
                 if a:
                     self._roster[h] = a
+        # adopt the coordinator's signed view riding the ack: THIS is
+        # what a later election runs over — without it a coordinator
+        # death would leave every follower stateless and self-electing
+        view_wire = ack.get("view")
+        if view_wire:
+            try:
+                self.adopt(view_wire)
+            except _faults.InjectedFault:
+                pass  # the renewal itself succeeded; next beat retries
         _gauge_epoch(self.host, int(ack.get("epoch", 0)))
         return "ok"
 
@@ -565,21 +613,26 @@ class MembershipNode:
             if self._fail_streak < COORD_FAIL_STREAK:
                 return "failed"
             # the coordinator is gone as far as this node can tell:
-            # suspect it in the local view and re-run the election
+            # suspect it LOCALLY (never by mutating the adopted view —
+            # it is signed and must keep verifying when re-served) and
+            # re-run the election over the freshest real states; with
+            # no view yet (bootstrap), peers are presumed alive so a
+            # high-id node defers instead of self-promoting
             self._fail_streak = 0
+            self._suspected.add(coord)
             seed = self._view
-            states = dict(seed.states()) if seed is not None else {}
+            if seed is not None:
+                states = dict(seed.states())
+            else:
+                states = {h: ALIVE for h in self._roster}
             states.setdefault(self.host, ALIVE)
-            states[coord] = SUSPECTED
+            for suspect in self._suspected:
+                states[suspect] = SUSPECTED
             winner = elect_coordinator(states) or self.host
             if winner != self.host:
                 # someone else should win; drop the dead coordinator
                 # from the roster so the next tick targets the winner
                 self._roster.pop(coord, None)
-                if seed is not None:
-                    row = seed.members.get(coord)
-                    if row is not None:
-                        row["state"] = SUSPECTED
                 return "re-elected"
             self._active = True
         self._coord.promote(seed, dead=coord)

@@ -258,10 +258,16 @@ class TcpTransport(LoopbackTransport):
     #: Fresh-connect attempts before the lane is declared dead, and
     #: the base backoff between them (exponential + jitter). One
     #: refused connect from an agent mid-restart must not kill the
-    #: lane; a truly dead host still exhausts the budget in well under
-    #: a second on ECONNREFUSED.
+    #: lane; a truly dead-but-reachable host still exhausts the budget
+    #: in well under a second on ECONNREFUSED. Only refused/reset-class
+    #: errors retry — a connect TIMEOUT (unreachable host, blackholed
+    #: route) fails fast so failover starts after ONE connect timeout,
+    #: not three.
     CONNECT_ATTEMPTS = 3
     CONNECT_BACKOFF_S = 0.05
+    _RETRYABLE_CONNECT_ERRORS = (ConnectionRefusedError,
+                                 ConnectionResetError,
+                                 ConnectionAbortedError)
 
     def _connect_with_retry(self, op: str):
         last = None
@@ -274,7 +280,7 @@ class TcpTransport(LoopbackTransport):
             try:
                 return socket.create_connection(
                     self.address, timeout=self._connect_timeout)
-            except OSError as exc:
+            except self._RETRYABLE_CONNECT_ERRORS as exc:
                 last = exc
         raise last
 

@@ -101,6 +101,14 @@ _PRIORITIES = ("normal", "high")
 #: settles at ``lane_probe_backoff * 64`` between probes, never more.
 _PROBE_BACKOFF_CAP = 64
 
+#: Metric families that belong to the POD plane (this process's
+#: global counters, many carrying their own ``host`` label): rendered
+#: once at pod level by :meth:`PodFrontend.metrics_text` and skipped
+#: from in-process lanes' expositions — re-labelling them with the
+#: lane's host would collapse distinct series into duplicates.
+_POD_LEVEL_FAMILIES = ("spfft_cluster_", "spfft_membership_",
+                       "spfft_net_")
+
 
 def _membership_module():
     """Deferred import of :mod:`spfft_tpu.net.membership` —
@@ -567,6 +575,16 @@ class PodFrontend:
         #: deadline (monotonic)]  #: guarded by _dead_lock
         self._dead: Dict[str, list] = {}
         self._dead_lock = threading.Lock()
+        #: hosts with a probe in flight (background worker or an
+        #: explicit probe_dead walk) — one prober per host at a time
+        #: guarded by _dead_lock
+        self._probing: set = set()
+        #: background prober: routing only SCHEDULES due probes here —
+        #: the health RPC and the strict prewarm + re-reconcile
+        #: readmission gate (which may compile plans) must never run
+        #: inline on a live submit
+        self._probe_pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="spfft-pod-probe")
         self._stamp = self._membership.epoch  # refreshed via view()
         if self._remote:
             try:
@@ -997,28 +1015,49 @@ class PodFrontend:
             return host in self._dead
 
     def _maybe_probe(self, now: Optional[float] = None) -> None:
-        """Opportunistic resurrection, piggybacked on routing (no
-        extra thread): probe any dead lane whose backoff deadline has
-        passed."""
+        """Opportunistic resurrection: routing notices a dead lane
+        whose backoff deadline has passed and SCHEDULES its probe on
+        the background worker. The submit path never blocks on the
+        health RPC or the readmission gate (strict prewarm +
+        re-reconcile, which may compile plans) — a due probe costs a
+        live request one set-membership check and a thread-pool
+        enqueue."""
         if now is None:
             now = time.monotonic()
         with self._dead_lock:
             due = [h for h, (_, deadline) in self._dead.items()
-                   if now >= deadline]
+                   if now >= deadline and h not in self._probing]
+            self._probing.update(due)
         for host in due:
+            try:
+                self._probe_pool.submit(self._probe_bg, host)
+            except RuntimeError:  # pool shut down mid-close
+                with self._dead_lock:
+                    self._probing.discard(host)
+
+    def _probe_bg(self, host: str) -> None:
+        """One scheduled background probe (the worker half of
+        :meth:`_maybe_probe`)."""
+        try:
             lane = next((ln for ln in self._lanes if ln.host == host),
                         None)
             if lane is None:  # left the pod while on the ladder
                 with self._dead_lock:
                     self._dead.pop(host, None)
-                continue
-            self._probe(lane, now)
+                return
+            if not self._closed:
+                self._probe(lane, time.monotonic())
+        finally:
+            with self._dead_lock:
+                self._probing.discard(host)
 
     def probe_dead(self, force: bool = False) -> Dict[str, str]:
-        """Ops/chaos entry point: walk the resurrection ladder NOW.
+        """Ops/chaos entry point: walk the resurrection ladder NOW
+        (synchronously — unlike routing's background scheduling).
         Returns per-host outcomes (``backoff`` when the next probe is
-        not yet due and ``force`` is False, else ``failed`` /
-        ``blocked`` / ``readmitted``)."""
+        not yet due and ``force`` is False, ``probing`` when a
+        background probe already has the host in flight, else
+        ``failed`` / ``blocked`` / ``readmitted``)."""
         now = time.monotonic()
         with self._dead_lock:
             entries = [(h, deadline)
@@ -1028,13 +1067,23 @@ class PodFrontend:
             if not force and now < deadline:
                 out[host] = "backoff"
                 continue
-            lane = next((ln for ln in self._lanes if ln.host == host),
-                        None)
-            if lane is None:
+            with self._dead_lock:
+                if host in self._probing:
+                    out[host] = "probing"
+                    continue
+                self._probing.add(host)
+            try:
+                lane = next(
+                    (ln for ln in self._lanes if ln.host == host),
+                    None)
+                if lane is None:
+                    with self._dead_lock:
+                        self._dead.pop(host, None)
+                    continue
+                out[host] = self._probe(lane, now)
+            finally:
                 with self._dead_lock:
-                    self._dead.pop(host, None)
-                continue
-            out[host] = self._probe(lane, now)
+                    self._probing.discard(host)
         return out
 
     def _probe(self, lane: HostLane, now: float) -> str:
@@ -1279,7 +1328,7 @@ class PodFrontend:
         b = _PromBuilder()
         snap = _obs.GLOBAL_COUNTERS.snapshot()
         for name in sorted(snap):
-            if not name.startswith("spfft_cluster_"):
+            if not name.startswith(_POD_LEVEL_FAMILIES):
                 continue
             fam = snap[name]
             for key, value in sorted(fam["samples"].items()):
@@ -1294,11 +1343,14 @@ class PodFrontend:
                 continue
             for (name, labels), value in \
                     parse_prometheus_text(text).items():
-                if name.startswith("spfft_cluster_") \
+                if name.startswith(_POD_LEVEL_FAMILIES) \
                         and lane.executor is not None:
                     # Pod-level families only render once, above: an
                     # IN-PROCESS lane shares this process's counter
-                    # registry, so its exposition already carries them.
+                    # registry, so its exposition already carries them
+                    # (and the membership/net families carry their OWN
+                    # host label — re-labelling them with the lane's
+                    # would collapse distinct series into duplicates).
                     # A remote lane's (executor is None) are its own
                     # process's facts and merge host-labelled like
                     # everything else.
@@ -1317,6 +1369,7 @@ class PodFrontend:
         if self._closed:
             return
         self._closed = True
+        self._probe_pool.shutdown(wait=True, cancel_futures=True)
         self._spmd.close()
         for lane in self._lanes:
             if lane.executor is None:

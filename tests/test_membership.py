@@ -28,9 +28,11 @@ import pytest
 
 from spfft_tpu import faults, obs
 from spfft_tpu.benchmark import cutoff_stick_triplets
+from spfft_tpu.control.config import global_config
 from spfft_tpu.errors import (BlobStoreError, HostLaneError,
                               NetAuthError, StaleEpochError)
 from spfft_tpu.faults import FaultPlan, InjectedFault
+from spfft_tpu.net.agent import HostAgent
 from spfft_tpu.net.blobstore import (FileBlobStore, gc_blobstore,
                                      serve_blobstore)
 from spfft_tpu.net.frame import recv_frame, send_frame
@@ -110,6 +112,33 @@ def test_expiry_skips_rungs_for_a_long_dead_lease():
     assert vc.expire() == [("a1", ALIVE, EVICTED)]
 
 
+def test_static_ensured_members_hold_no_lease_and_never_expire():
+    """A loopback/frontend-embedded lane registered via ``ensure``
+    has nothing heartbeating it: it must be exempt from lease expiry
+    (views served long after init still say ALIVE), while a first
+    heartbeat converts it to a normal leased member."""
+    now = [0.0]
+    vc = ViewCoordinator("c0", clock=lambda: now[0], lease_ttl_s=TTL,
+                         secret=None)
+    vc.ensure("h1", "127.0.0.1:1")
+    e0 = vc.epoch
+    now[0] = 100 * TTL  # far past every ladder rung
+    assert vc.expire() == []
+    assert vc.view().states()["h1"] == ALIVE
+    assert vc.epoch == e0  # no phantom transitions, no epoch churn
+    # explicit evict/readmit still work, and readmission does NOT
+    # start a lease nothing will renew
+    vc.evict("h1")
+    vc.readmit("h1")
+    now[0] = 200 * TTL
+    assert vc.expire() == []
+    assert vc.view().states()["h1"] == ALIVE
+    # the first real heartbeat leases it: now expiry applies
+    vc.heartbeat("h1")
+    now[0] += 10 * TTL
+    assert vc.expire() == [("h1", ALIVE, EVICTED)]
+
+
 def test_heartbeat_fault_injection_is_typed_and_contained():
     vc = ViewCoordinator("c0", lease_ttl_s=TTL, secret=None)
     faults.arm(FaultPlan(script=["net.heartbeat@1"]))
@@ -185,6 +214,129 @@ def test_coordinator_death_reelects_deterministically():
     assert nodes["m2"].coordinator()[0] == "m1"
     nodes["m2"].adopt(nodes["m1"].on_view())
     assert nodes["m2"].epoch == nodes["m1"].epoch
+
+
+def test_heartbeat_ack_carries_view_and_followers_adopt_it():
+    """The renewal ack rides the coordinator's full signed view and
+    ``tick`` adopts it — the production flow (nothing else calls
+    ``adopt``) must leave followers holding real per-host states, or a
+    coordinator death degenerates into every follower self-electing."""
+    coord = MembershipNode("a0", address="a0", secret=None)
+    nodes = {"a0": coord}
+
+    def wire(addr, hdr):
+        return nodes[addr].on_heartbeat(str(hdr["host"]),
+                                        hdr.get("address"))
+
+    f1 = MembershipNode("a1", address="a1", peers={"a0": "a0"},
+                        secret=None)
+    f2 = MembershipNode("a2", address="a2", peers={"a0": "a0"},
+                        secret=None)
+    assert f1.tick(wire) == "ok" and f2.tick(wire) == "ok"
+    assert f1.tick(wire) == "ok"  # a1 re-renews: sees a2 in the view
+    for node in (f1, f2):
+        assert node._view is not None
+        assert node._view.verify(None)  # adopted verbatim, signature ok
+    assert f1._view.states() == {"a0": ALIVE, "a1": ALIVE, "a2": ALIVE}
+    assert f1.epoch == coord.epoch
+
+
+def test_follower_served_view_stays_verifiable_through_failover():
+    """Locally suspecting a dead coordinator must NOT mutate the
+    adopted signed view in place: ``on_view`` keeps serving a view
+    whose signature verifies (the pre-fix bug re-served mutated
+    members under the original signature — a permanent NetAuthError
+    for every verifier mid-failover)."""
+    nodes, down = {}, set()
+
+    def wire(addr, hdr):
+        if addr in down:
+            raise OSError(f"{addr} unreachable")
+        return nodes[addr].on_heartbeat(str(hdr["host"]),
+                                        hdr.get("address"))
+
+    roster = {h: h for h in ("m0", "m1", "m2")}
+    for h in roster:
+        peers = {p: a for p, a in roster.items() if p != h}
+        nodes[h] = MembershipNode(h, address=h, peers=peers,
+                                  secret=None)
+    for h in ("m1", "m2"):
+        assert nodes[h].tick(wire) == "ok"
+        assert nodes[h].tick(wire) == "ok"  # both see the full pod
+    down.add("m0")
+    outcomes = [nodes["m2"].tick(wire) for _ in range(3)]
+    assert outcomes == ["failed", "failed", "re-elected"]
+    # the cached view still verifies — suspicion is an overlay, never
+    # a mutation — so any frontend/agent fetching it mid-failover
+    # adopts it cleanly instead of dying on NetAuthError
+    served = nodes["m2"].on_view()
+    assert MembershipView.from_wire(served).verify(None)
+    fresh = MembershipNode("m9", peers={"m2": "m2"}, secret=None)
+    assert fresh.adopt(served)
+    # and the election overlay targets the real successor
+    assert nodes["m2"].coordinator()[0] == "m1"
+
+
+def test_wire_coordinator_kill_exactly_one_node_promotes():
+    """Over REAL TCP with three agents: kill the coordinator and the
+    survivors — whose views arrived solely via heartbeat acks, the
+    production flow — converge with EXACTLY ONE promotion (the
+    next-lowest alive id). The pre-fix failure mode was every follower
+    promoting simultaneously into permanent multi-coordinator
+    split-brain."""
+    cfg = global_config()
+    old_hb = cfg.heartbeat_interval_ms
+    cfg.set("heartbeat_interval_ms", 100, source="test",
+            reason="fast convergence for coordinator-kill test")
+    agents: dict = {}
+    exs = []
+    try:
+        for name in ("n0", "n1", "n2"):
+            reg = PlanRegistry(store=False)
+            ex = ServeExecutor(reg)
+            exs.append(ex)
+            peers = {h: f"127.0.0.1:{a.port}"
+                     for h, a in agents.items()}
+            agents[name] = HostAgent(name, ex,
+                                     peers=peers or None).start()
+        assert agents["n0"].membership.is_coordinator
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            if all(agents[h].membership._view is not None
+                   and len(agents[h].membership._view.members) == 3
+                   for h in ("n1", "n2")):
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("followers never adopted the full pod view "
+                        "from heartbeat acks")
+        pre = agents["n0"].membership.epoch
+        agents["n0"].close()  # kill -9 equivalent: refused connects
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if agents["n1"].membership.is_coordinator \
+                    and agents["n2"].membership.coordinator()[0] == "n1":
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("survivors never converged on a successor")
+        promoted = [h for h in ("n1", "n2")
+                    if agents[h].membership.is_coordinator]
+        assert promoted == ["n1"]  # exactly one, the next-lowest id
+        assert agents["n1"].membership.epoch > pre
+        view = MembershipView.from_wire(agents["n1"].membership.on_view())
+        assert view.coordinator == "n1"
+        assert view.states()["n0"] != ALIVE  # the dead node is suspect
+    finally:
+        cfg.set("heartbeat_interval_ms", old_hb, source="test",
+                reason="restore after coordinator-kill test")
+        for agent in agents.values():
+            try:
+                agent.close()
+            except Exception:  # noqa: BLE001 - teardown best effort
+                pass
+        for ex in exs:
+            ex.close(drain=False)
 
 
 # -- signed views -------------------------------------------------------------
@@ -317,6 +469,55 @@ def test_probe_respects_backoff_and_dead_host(mem_plans):
             ex.close()
 
 
+def test_routing_schedules_probes_in_background(mem_plans):
+    """The routing path only SCHEDULES a due probe — it must never
+    block a live submit on the health RPC + readmission gate. A probe
+    stalled inside the health call keeps the host on the ladder while
+    submits keep serving from survivors; releasing it readmits with no
+    further routing involvement."""
+    p = mem_plans
+    rng = np.random.default_rng(9)
+    mm = ViewCoordinator("h0", lease_ttl_s=TTL, secret=None)
+    fa, fb, exs = _shared_pod_pair(p, mm)
+    entered = threading.Event()
+    release = threading.Event()
+    try:
+        lane = fa._lanes[1]
+        orig_health = lane.rpc_health
+
+        def stalled_health():
+            entered.set()
+            release.wait(30)
+            return orig_health()
+
+        lane.rpc_health = stalled_health
+        fa._mark_dead(lane)
+        lane.transport.alive = True  # the host is back up
+        with fa._dead_lock:
+            fa._dead["h1"][1] = 0.0  # the probe is due NOW
+        # this submit notices the due probe; it must return a served
+        # result while the probe is still stalled in the background
+        v = _values(p, rng)
+        got = np.asarray(fa.submit(p["sig"], v).result(timeout=60))
+        assert np.array_equal(got, np.asarray(p["plan"].backward(v)))
+        assert entered.wait(10), "probe was never scheduled"
+        assert fa._on_ladder("h1")  # served while the probe ran
+        # a synchronous walk reports the in-flight probe, not a second
+        assert fa.probe_dead(force=True).get("h1") == "probing"
+        release.set()
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and fa._on_ladder("h1"):
+            time.sleep(0.02)
+        assert not fa._on_ladder("h1")
+        assert fa.view()["members"]["h1"]["state"] == ALIVE
+    finally:
+        release.set()
+        fa.close()
+        fb.close()
+        for ex in exs:
+            ex.close()
+
+
 # -- frame auth ---------------------------------------------------------------
 def test_frame_auth_round_trip_and_mismatches():
     secret = b"wire-secret"
@@ -358,6 +559,34 @@ def test_tcp_connect_retries_are_counted():
         lane.close()
     assert obs.GLOBAL_COUNTERS.get("spfft_net_rpc_retries_total",
                                    verb="health") >= before + 2
+
+
+def test_tcp_connect_timeout_fails_fast(monkeypatch):
+    """A blackholed/unreachable host costs ONE connect timeout before
+    the lane is declared dead — only refused/reset-class errors spend
+    the retry budget, so failover starts within a single connect
+    timeout, not three of them plus backoff."""
+    import spfft_tpu.net.transport as transport_mod
+
+    calls = []
+
+    def timed_out(address, timeout=None):
+        calls.append(address)
+        raise socket.timeout("connect timed out")
+
+    monkeypatch.setattr(transport_mod.socket, "create_connection",
+                        timed_out)
+    before = obs.GLOBAL_COUNTERS.get("spfft_net_rpc_retries_total",
+                                     verb="health")
+    lane = TcpHostLane("hx", ("10.255.255.1", 9))
+    try:
+        with pytest.raises(HostLaneError):
+            lane.rpc_health()
+    finally:
+        lane.close()
+    assert len(calls) == 1  # no retry loop on a timing-out connect
+    assert obs.GLOBAL_COUNTERS.get("spfft_net_rpc_retries_total",
+                                   verb="health") == before
 
 
 # -- blob journal GC ----------------------------------------------------------
@@ -468,7 +697,10 @@ def test_two_frontend_convergence_fuzz(mem_plans):
             fa._lanes[1].transport.alive = True
             deadline = time.monotonic() + 10.0
             while time.monotonic() < deadline:
-                if fa.probe_dead(force=True).get("h1") == "readmitted":
+                # either this walk readmits it, or a background probe
+                # scheduled off a hammer submit already did
+                if fa.probe_dead(force=True).get("h1") == "readmitted" \
+                        or not fa._on_ladder("h1"):
                     break
                 time.sleep(0.05)
             else:
