@@ -141,10 +141,46 @@ def symmetry_inner() -> None:
         TransformType.R2C, n, n, n,
         round_robin_stick_partition(half, dims, shards),
         planes)).wire_elements() * elem
-    c2c_wire = build_ragged_schedule(build_distributed_plan(
+    c2c_dp = build_distributed_plan(
         TransformType.C2C, n, n, n,
-        round_robin_stick_partition(full, dims, shards),
-        planes)).wire_elements() * elem
+        round_robin_stick_partition(full, dims, shards), planes)
+    c2c_wire = build_ragged_schedule(c2c_dp).wire_elements() * elem
+
+    # --- wire_bytes_int8: the compressed-wire ladder's bottom rung ---
+    # Padded block layout (the only mechanism that carries the int8
+    # rung: the scale sidecar rides each slot's row through the SAME
+    # collective), same 256^3 spherical set and shard count. Backward
+    # convention, scales included — one f32 absmax scale per
+    # (slot, stick row). Compared against the f32 wire on the SAME
+    # layout, so the ratio isolates the rung, not the layout.
+    ms, mp = c2c_dp.max_sticks, c2c_dp.max_planes
+    links = shards * (shards - 1)
+    int8_wire = links * (ms * mp * 2 + ms * 4)
+    f32_wire = links * ms * mp * 8
+
+    # --- wire_error_int8: measured end-to-end rel-l2 of the rung ---
+    # A real 2-shard int8-wire plan on the virtual-CPU mesh vs its
+    # rung-0 twin: seeded spectrum with adversarial 10^+-4 per-value
+    # dynamic range through the actual quantized collective.
+    wn = 32
+    wfull = spherical_cutoff_triplets(wn)
+    wparts = round_robin_stick_partition(wfull, (wn, wn, wn), 2)
+    wplanes = even_plane_split(wn, 2)
+    w_ref = make_distributed_plan(
+        TransformType.C2C, wn, wn, wn, wparts, wplanes,
+        mesh=make_mesh(2), precision="single", wire_precision=0)
+    w_int8 = make_distributed_plan(
+        TransformType.C2C, wn, wn, wn, wparts, wplanes,
+        mesh=make_mesh(2), precision="single", wire_precision=3)
+    wrng = np.random.default_rng(0xA11)
+    wv = [wrng.standard_normal(p.num_values)
+          * 10.0 ** wrng.uniform(-4, 4, p.num_values)
+          + 1j * wrng.standard_normal(p.num_values)
+          for p in w_ref.dist_plan.shard_plans]
+    ref_out = np.asarray(w_ref.backward(wv), np.float64)
+    int8_out = np.asarray(w_int8.backward(wv), np.float64)
+    wire_err = float(np.linalg.norm(int8_out - ref_out)
+                     / np.linalg.norm(ref_out))
 
     # --- fused_r2c: the two r2c fused seams on the interpret lane ---
     fd = (8, 6, 128)  # dim_z % 128 == 0: fused eligibility floor
@@ -289,6 +325,28 @@ def symmetry_inner() -> None:
                       "net.transport.wire_overhead_probe)",
             "value": round(wire["overhead_pooled_us"], 1),
             "unit": "us",
+        },
+        "wire_bytes_int8": {
+            "metric": f"{n}^3 spherical-cutoff C2C distributed exchange "
+                      f"aggregate wire bytes on the int8 rung (padded "
+                      f"block layout, {shards} shards, per-stick f32 "
+                      f"scales INCLUDED: {links * ms * 4} B of scales "
+                      f"on {links * ms * mp * 2} B payload; f32 wire "
+                      f"on the same layout {f32_wire} B, ratio "
+                      f"{int8_wire / f32_wire:.4f})",
+            "value": int(int8_wire),
+            "unit": "bytes",
+        },
+        "wire_error_int8": {
+            "metric": "measured end-to-end rel-l2 of the int8 wire "
+                      f"rung: {wn}^3 spherical C2C backward on 2 "
+                      "virtual shards vs the rung-0 twin, seeded "
+                      "spectrum with 10^+-4 per-value dynamic range "
+                      f"(plan probe err {w_int8.wire_probe_error:.2e}, "
+                      f"declared budget {w_int8.wire_error_budget:g}, "
+                      f"resolved rung {w_int8.wire_rung_name})",
+            "value": round(wire_err, 6),
+            "unit": "rel-l2",
         },
         "spmd_coalesce": {
             "metric": "cross-request SPMD coalescing: distributed "

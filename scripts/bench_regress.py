@@ -44,7 +44,8 @@ import re
 import sys
 
 #: Units where SMALLER is better; anything else is treated as a rate.
-LOWER_IS_BETTER_UNITS = ("s", "ms", "us", "ns", "seconds", "bytes")
+LOWER_IS_BETTER_UNITS = ("s", "ms", "us", "ns", "seconds", "bytes",
+                         "rel-l2")
 
 #: Named sub-measurements compared alongside the primary row whenever
 #: both files carry them (e.g. {"fused": {"value": ..., "unit": "s"}}).
@@ -81,10 +82,19 @@ LOWER_IS_BETTER_UNITS = ("s", "ms", "us", "ns", "seconds", "bytes")
 #: imbalance reduction (rr completed-work skew / p2c skew over the
 #: seeded discrete-event replay of the live load_score) — a drop past
 #: threshold means the routing policy stopped spreading the skewed
-#: load. All emitted by bench.py every run.
+#: load. wire_bytes_int8 (unit "bytes", lower is better, recorded from
+#: BENCH_r06.json round 22 on) is the compressed-wire ladder's int8
+#: rung on the 256^3 spherical C2C padded block layout, per-stick f32
+#: scales INCLUDED — deterministic accounting, so growth past
+#: threshold means the quantized packing (or its sidecar) bloated.
+#: wire_error_int8 (unit "rel-l2", lower is better) is the measured
+#: end-to-end error of a real 2-shard int8-wire backward vs its rung-0
+#: twin on a seeded adversarial spectrum — growth past threshold means
+#: the quantizer lost accuracy. All emitted by bench.py every run.
 SUB_ROWS = ("fused", "cold_start_ms", "warm_start_ms",
             "wire_bytes_r2c", "fused_r2c", "fused_dist", "pod_routing",
-            "pod_wire", "pod_wire_pooled", "spmd_coalesce")
+            "pod_wire", "pod_wire_pooled", "spmd_coalesce",
+            "wire_bytes_int8", "wire_error_int8")
 
 
 def load_payload(path: str) -> dict:

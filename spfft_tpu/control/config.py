@@ -193,6 +193,17 @@ KNOB_SPECS: Dict[str, KnobSpec] = {spec.name: spec for spec in (
              "Byte cap for the remote blob tier's req/ request-journal "
              "namespace: the gc sweep evicts oldest-mtime keys past it "
              "(0 = unbounded, no sweep)."),
+    KnobSpec("wire_precision", 0, 0, 3, int,
+             "exposed-exchange ratio + spfft_wire_rung_declined_total",
+             "Requested wire-compression rung for distributed exchanges "
+             "(0=full, 1=f32, 2=bf16, 3=int8+per-stick scales); the "
+             "plan's measured-error probe may decline down the ladder "
+             "within wire_error_budget."),
+    KnobSpec("wire_error_budget", 0.01, 1e-6, 1.0, float,
+             "spfft_wire_rung_declined_total{reason=over_budget}",
+             "Declared rel-l2 error budget for the compressed wire: a "
+             "rung whose probe error exceeds it is REFUSED at plan "
+             "build and the plan falls one rung down."),
 )}
 
 #: String-valued settings (paths) the numeric KnobSpec clamp cannot

@@ -38,6 +38,16 @@ Signals → rules → knobs (the docs/control_plane.md table, in code):
   below ``overlap_lo``) → halve back toward the K=1 default, which is
   the bit-identical monolithic path. The streak is the hysteresis —
   one chunky step moves nothing.
+* **wire_precision** ← the same exchange-vs-compute deltas, behind
+  HARDER thresholds (``wire_hi`` > ``overlap_hi``, longer streak).
+  Chunking hides wire time for free; compression spends accuracy
+  budget — so the rung escalates one step only when the exchange still
+  dominates after the chunking rule has had its chance, and decays one
+  step back when the wire is well hidden. Plans built under the new
+  value re-probe against their own declared ``wire_error_budget`` and
+  may still refuse the rung (the budget gate belongs to the plan, not
+  the controller); rung moves are counted
+  (``spfft_wire_rung_changes_total{direction}``).
 * **max_queue** ← ``rejected_queue_full`` burn. Rejects on
   ``reject_streak_steps`` CONSECUTIVE steps mean the queue bound is
   turning a transient burst into dropped traffic → DOUBLE the bound
@@ -104,7 +114,7 @@ class Decision:
 MANAGED_KNOBS = ("batch_window", "pin_after", "max_batch",
                  "pipeline_depth", "max_queue", "overlap_chunks",
                  "spmd_batch_window", "spmd_max_batch",
-                 "lease_ttl_ms")
+                 "lease_ttl_ms", "wire_precision")
 
 
 class Controller:
@@ -128,7 +138,9 @@ class Controller:
                  overlap_hi: float = 1.0, overlap_lo: float = 0.25,
                  overlap_streak_steps: int = 2,
                  spmd_streak_steps: int = 2,
-                 rtt_hi: float = 0.2, rtt_streak_steps: int = 2):
+                 rtt_hi: float = 0.2, rtt_streak_steps: int = 2,
+                 wire_hi: float = 1.5, wire_lo: float = 0.25,
+                 wire_streak_steps: int = 3):
         self.config = config
         self.metrics = metrics
         self.executor = executor
@@ -147,6 +159,10 @@ class Controller:
         self.spmd_streak_steps = max(1, int(spmd_streak_steps))
         self.rtt_hi = float(rtt_hi)
         self.rtt_streak_steps = max(1, int(rtt_streak_steps))
+        self.wire_hi = float(wire_hi)
+        self.wire_lo = float(wire_lo)
+        self.wire_streak_steps = max(1, int(wire_streak_steps))
+        self._wire_streak = 0
         self._overlap_streak = 0
         self._reject_streak = 0
         self._spmd_streak = 0
@@ -216,6 +232,7 @@ class Controller:
             self._overlap_streak = 0
             self._spmd_streak = 0
             self._rtt_streak = 0
+            self._wire_streak = 0
             self._decay_toward_defaults(out)
         else:
             self._rule_batch_window(out, signals)
@@ -224,6 +241,7 @@ class Controller:
             self._rule_pipeline_depth(out, signals)
             self._rule_max_queue(out, signals)
             self._rule_overlap_chunks(out, signals)
+            self._rule_wire_precision(out, signals)
             self._rule_spmd_coalesce(out, signals)
             self._rule_lease_ttl(out, signals)
         self._prev = dict(signals)
@@ -260,7 +278,13 @@ class Controller:
                     else min(default, cur * 2)
             else:
                 nxt = cur + 1 if cur < default else cur - 1
-            self._retune(out, knob, nxt, "idle: decay toward default")
+            moved = self._retune(out, knob, nxt,
+                                 "idle: decay toward default")
+            if moved and knob == "wire_precision":
+                from .. import obs
+                obs.GLOBAL_COUNTERS.inc(
+                    "spfft_wire_rung_changes_total", 1,
+                    direction="down")
 
     def _rule_batch_window(self, out, s) -> None:
         qw = s.get("queue_wait_p95", 0.0)
@@ -373,6 +397,53 @@ class Controller:
                              max(default, k // 2),
                              f"exchange hidden ({ratio:.2f} x compute):"
                              f" decay toward default")
+
+    def _rule_wire_precision(self, out, s) -> None:
+        """Escalate the wire-compression rung under SUSTAINED exposed
+        exchange (the compressed-wire tentpole's controller half): the
+        same exchange-vs-compute span deltas that drive
+        ``overlap_chunks``, behind harder thresholds (``wire_hi`` >
+        ``overlap_hi`` and a longer streak) — chunking hides wire time
+        for free, compression spends accuracy budget, so the rung moves
+        only when the exchange still dominates after the chunking rule
+        has had its chance. One rung per move, within the declared
+        [0, 3] clamp; plans built under the new value re-probe against
+        their own ``wire_error_budget`` and may still decline (the
+        budget gate is the plan's, not the controller's). Exchange well
+        hidden (below ``wire_lo``) decays one rung back; streak +
+        cooldown are the anti-oscillation guard the scenario test
+        pins. Rung moves are counted by direction."""
+        ex_d = self._delta(s, "exchange_s")
+        cp_d = self._delta(s, "exchange_compute_s")
+        if ex_d <= 0 and cp_d <= 0:
+            self._wire_streak = 0
+            return
+        rung = self.config.get("wire_precision")
+        default = ServeConfig.default("wire_precision")
+        ratio = ex_d / max(cp_d, self.exec_floor_s)
+        if ratio > self.wire_hi:
+            self._wire_streak += 1
+            if self._wire_streak >= self.wire_streak_steps \
+                    and self._retune(
+                        out, "wire_precision", rung + 1,
+                        f"exposed exchange: {ex_d * 1e3:.1f} ms "
+                        f"exchange vs {cp_d * 1e3:.1f} ms compute over "
+                        f"{self._wire_streak} consecutive steps"):
+                self._wire_streak = 0
+                from .. import obs
+                obs.GLOBAL_COUNTERS.inc(
+                    "spfft_wire_rung_changes_total", 1, direction="up")
+        else:
+            self._wire_streak = 0
+            if ratio < self.wire_lo and rung > default:
+                if self._retune(
+                        out, "wire_precision", rung - 1,
+                        f"exchange hidden ({ratio:.2f} x compute): "
+                        f"decay toward default"):
+                    from .. import obs
+                    obs.GLOBAL_COUNTERS.inc(
+                        "spfft_wire_rung_changes_total", 1,
+                        direction="down")
 
     def _rule_lease_ttl(self, out, s) -> None:
         """Widen the membership lease under wire-RTT inflation (round

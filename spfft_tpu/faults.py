@@ -35,6 +35,9 @@ site                  where it fires
 ``exchange.collective`` the all-to-all / collective itself (trace time)
 ``exchange.unpack``   distributed post-exchange unpack (trace time)
 ``exchange.chunk``    each chunk of an overlapped exchange (trace time)
+``exchange.quantize`` the int8 wire rung's scale computation (the
+                      plan-build probe; a firing check declines the
+                      rung, falling back one rung, counted)
 ``cluster.route``     the pod frontend's host-pick for a single-device
                       request (before the lane RPC)
 ``cluster.rpc``       each host-lane RPC through the pod transport
@@ -134,7 +137,7 @@ SITES = (
     "kernel.launch",
     # distributed exchange
     "exchange.pack", "exchange.collective", "exchange.unpack",
-    "exchange.chunk",
+    "exchange.chunk", "exchange.quantize",
     # pod cluster (round 18; spmd_window joined with the coalescer)
     "cluster.route", "cluster.rpc", "cluster.reconcile",
     "cluster.spmd_window",

@@ -180,17 +180,30 @@ def record_exchange_plan(plan, seconds: float,
     GLOBAL_COUNTERS.set("spfft_exchange_busiest_link_bytes", busiest,
                         help="Bottleneck-link bytes per exchange of the "
                              "most recent plan.", **labels)
+    GLOBAL_COUNTERS.set("spfft_wire_rung",
+                        float(getattr(plan, "wire_rung", 0)),
+                        help="Resolved wire-compression rung of the most "
+                             "recent distributed plan (0=full, 1=f32, "
+                             "2=bf16, 3=int8).", **labels)
     if not active():
         return
     ov = getattr(plan, "_overlap", None)
     per_chunk = []
     if ov is not None:
         elem = plan._wire_elem_bytes()
+        # int8 rung: each chunk also carries its scale sidecar — one f32
+        # per (slot, quant row) over the chunk's stick/plane slice
+        int8 = getattr(plan, "wire_rung", 0) == 3
+        dp = plan.dist_plan
+        links = dp.num_shards * (dp.num_shards - 1)
         for c in range(ov.num_chunks):
+            sc_b = (links * ov.chunk_scale_rows(c) * 4) if int8 else 0
+            sc_f = (links * ov.chunk_scale_rows(c, forward=True) * 4
+                    ) if int8 else 0
             per_chunk.append({
-                "bwd_bytes": ov.chunk_wire_elements(c) * elem,
+                "bwd_bytes": ov.chunk_wire_elements(c) * elem + sc_b,
                 "fwd_bytes": ov.chunk_wire_elements(c, forward=True)
-                * elem,
+                * elem + sc_f,
                 "busiest_link_bytes":
                     ov.chunk_busiest_link_elements(c) * elem,
             })

@@ -57,6 +57,18 @@ METRIC_SPECS: Dict[str, Tuple[str, str]] = {
     "spfft_exchange_busiest_link_bytes":
         ("gauge",
          "Bottleneck-link bytes per exchange of the most recent plan."),
+    "spfft_wire_rung":
+        ("gauge",
+         "Resolved wire-compression rung of the most recent distributed "
+         "plan (0=full, 1=f32, 2=bf16, 3=int8)."),
+    "spfft_wire_rung_changes_total":
+        ("counter",
+         "Controller wire-rung moves by direction (up=escalate under "
+         "exposed exchange, down=decay)."),
+    "spfft_wire_rung_declined_total":
+        ("counter",
+         "Wire rungs refused at plan build by reason (over_budget, "
+         "exact_count_layout, fault_injected)."),
     "spfft_hlo_collectives":
         ("gauge", "Collective launches in the most recently inspected "
                   "lowered module."),

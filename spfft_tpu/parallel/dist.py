@@ -70,6 +70,15 @@ from .overlap import build_overlap_schedule
 #: K=1 (the default) is today's monolithic single-collective path.
 OVERLAP_CHUNKS_ENV = "SPFFT_TPU_OVERLAP_CHUNKS"
 
+#: The wire-compression ladder (docs/distributed.md "Compressed wire"):
+#: rung index == ``wire_precision`` knob value. Rung 0 ships the payload
+#: at transform precision; 1/2 are the typed float downcasts the legacy
+#: ``*_FLOAT`` exchange variants hard-coded; 3 quantizes to int8 with
+#: per-stick absmax scales packed alongside the payload.
+WIRE_RUNGS = ("full", "f32", "bf16", "int8")
+WIRE_PRECISION_ENV = "SPFFT_TPU_WIRE_PRECISION"
+WIRE_ERROR_BUDGET_ENV = "SPFFT_TPU_WIRE_ERROR_BUDGET"
+
 logger = logging.getLogger("spfft_tpu")
 
 
@@ -175,7 +184,9 @@ class DistributedTransformPlan:
                  mesh: Optional[Mesh] = None, precision: str = "single",
                  exchange: ExchangeType = ExchangeType.DEFAULT,
                  use_pallas: Optional[bool] = None,
-                 overlap_chunks: Optional[int] = None):
+                 overlap_chunks: Optional[int] = None,
+                 wire_precision: Optional[int] = None,
+                 wire_error_budget: Optional[float] = None):
         from ..utils.platform import enable_persistent_compilation_cache
         enable_persistent_compilation_cache()
         _t0_build = time.perf_counter()
@@ -201,11 +212,11 @@ class DistributedTransformPlan:
                 "on-device double-single mode covers local plans only) "
                 "— use the CPU backend with JAX_ENABLE_X64=1 for true "
                 "f64 (docs/precision.md)")
-        # one real dtype down from the transform precision.
+        # Wire rung resolution (``self._wire_dtype``) is deferred to
+        # _resolve_wire_rung below: int8 eligibility depends on the
+        # exchange mechanism selected next, and the legacy *_FLOAT
+        # variants map onto the ladder there (one rung down).
         self._wire_dtype = None
-        if self.exchange.float_wire:
-            self._wire_dtype = (np.float32 if precision == "double"
-                                else jnp.bfloat16)
         self._init_split_x()
         # UNBUFFERED selects the ppermute-ring mechanism; COMPACT_BUFFERED
         # the exact-count exchange — ONE ragged_all_to_all per direction
@@ -307,6 +318,11 @@ class DistributedTransformPlan:
             self._exchange_fn = ring_exchange_blocks
         else:
             self._exchange_fn = all_to_all_blocks
+        # Error-budgeted wire ladder: pick the rung (and _wire_dtype) now
+        # that the mechanism is known — the int8 rung needs the padded
+        # block layout, and the measured probe must run BEFORE the
+        # comm-size-1 local delegation so every plan records its rung.
+        self._resolve_wire_rung(wire_precision, wire_error_budget)
         self._build_tables()
         self._init_pallas(use_pallas)
         self._sharded = NamedSharding(self.mesh, P(self.axis_name))
@@ -439,6 +455,115 @@ class DistributedTransformPlan:
         _dt = time.perf_counter() - _t0_build
         _obs.record_plan_build(self, _dt, _t0_build)
         _obs.record_exchange_plan(self, _dt, _t0_build)
+
+    # -- wire precision ladder ----------------------------------------------
+    def _resolve_wire_rung(self, wire_precision, wire_error_budget) -> None:
+        """Resolve the wire-compression rung (docs/distributed.md
+        "Compressed wire"): walk DOWN from the requested rung, declining
+        any rung the plan cannot carry (int8 needs the padded block
+        layout for its scale sidecar) or whose MEASURED probe error
+        exceeds the declared l2 budget, until one fits — rung 0 ("full")
+        always does. Each decline is recorded with a reason
+        (``spfft_wire_rung_declined_total{reason}`` + ``wire_declines``)
+        so a refusal is observable, never silent. Legacy ``*_FLOAT``
+        exchange variants map onto the ladder here (requested rung 1 for
+        double, 2 for single) so their one-rung downcast keeps working
+        unchanged under the same budget gate."""
+        import os as _os
+        from ..control.config import global_config
+        if wire_precision is None:
+            env = _os.environ.get(WIRE_PRECISION_ENV)
+            wire_precision = (int(env) if env
+                              else int(global_config().wire_precision))
+        if wire_error_budget is None:
+            env = _os.environ.get(WIRE_ERROR_BUDGET_ENV)
+            wire_error_budget = (
+                float(env) if env
+                else float(global_config().wire_error_budget))
+        requested = int(wire_precision)
+        if not 0 <= requested < len(WIRE_RUNGS):
+            raise InvalidParameterError(
+                f"wire_precision must be in [0, {len(WIRE_RUNGS) - 1}], "
+                f"got {requested}")
+        if float(wire_error_budget) <= 0:
+            raise InvalidParameterError(
+                f"wire_error_budget must be > 0, got {wire_error_budget}")
+        if requested == 0 and self.exchange.float_wire:
+            requested = 1 if self.precision == "double" else 2
+        # int8 packs per-stick scales alongside the padded block payload;
+        # the exact-count layouts (ragged/compact and their overlap
+        # kinds) address individual elements, leaving no room on the
+        # wire for the scale sidecar in one collective round.
+        int8_ok = (self._compact is None and self._ragged is None
+                   and (self._overlap is None
+                        or self._overlap.kind == "block"))
+        self.wire_rung_requested = requested
+        self.wire_error_budget = float(wire_error_budget)
+        declines = []
+        rung = requested
+        probe_err = 0.0
+        from .. import obs as _obs
+        while rung > 0:
+            if rung == 3 and not int8_ok:
+                reason = "exact_count_layout"
+            else:
+                try:
+                    probe_err = self._probe_wire_error(rung)
+                except _faults.InjectedFault:
+                    reason = "fault_injected"
+                else:
+                    if probe_err <= self.wire_error_budget:
+                        break
+                    reason = "over_budget"
+            declines.append((WIRE_RUNGS[rung], reason))
+            _obs.GLOBAL_COUNTERS.inc("spfft_wire_rung_declined_total",
+                                     reason=reason)
+            rung -= 1
+        if rung == 0:
+            probe_err = 0.0
+        self.wire_rung = rung
+        self.wire_rung_name = WIRE_RUNGS[rung]
+        self.wire_probe_error = float(probe_err)
+        self.wire_declines = tuple(declines)
+        self._wire_dtype = {0: None, 1: np.float32, 2: jnp.bfloat16,
+                            3: jnp.int8}[rung]
+        if declines:
+            logger.info(
+                "spfft_tpu: wire rung %s declined to %s (%s; budget %g, "
+                "probe err %g)", WIRE_RUNGS[requested],
+                self.wire_rung_name,
+                ", ".join(f"{n}:{r}" for n, r in declines),
+                self.wire_error_budget, self.wire_probe_error)
+
+    def _probe_wire_error(self, rung: int) -> float:
+        """Measured rel-l2 round-trip error of ``rung`` on an adversarial
+        host-side probe spectrum: seeded gaussian stick rows with a huge
+        per-row dynamic range (10^±6 magnitudes) — the shape the int8
+        per-stick scales exist to survive. The reference signal is the
+        device payload (probe cast to the transform's real dtype), so
+        rung 1 under single precision measures exactly 0. Runs once at
+        plan build, never on the hot path; the int8 twin mirrors
+        ``exchange.quantize_blocks_int8`` in numpy, with the
+        ``exchange.quantize`` fault seam guarding the scale
+        computation."""
+        rng = np.random.default_rng(0x51F8)
+        dp = self.dist_plan
+        rows = int(min(max(dp.max_sticks, 1), 64))
+        cols = int(min(max(dp.dim_z, 1), 64))
+        mags = 10.0 ** rng.uniform(-6.0, 6.0, size=(rows, 1, 1))
+        il = (rng.standard_normal((rows, cols, 2)) * mags)
+        ref = il.astype(self._rdt).astype(np.float64)
+        if rung == 3:
+            _faults.check_site("exchange.quantize")
+            absmax = np.max(np.abs(ref), axis=(1, 2), keepdims=True)
+            scale = np.where(absmax > 0, absmax / 127.0, 1.0)
+            q = np.clip(np.rint(ref / scale), -127, 127).astype(np.int8)
+            back = q.astype(np.float64) * scale
+        else:
+            wdt = np.float32 if rung == 1 else jnp.bfloat16
+            back = ref.astype(wdt).astype(np.float64)
+        denom = float(np.linalg.norm(ref))
+        return float(np.linalg.norm(back - ref) / denom) if denom else 0.0
 
     # -- static tables -------------------------------------------------------
     def _init_split_x(self) -> None:
@@ -1056,7 +1181,7 @@ class DistributedTransformPlan:
             # leading axis are layout no-ops (256^3 dist1 pair:
             # 20.2 -> 17.5 ms).
             blocks = self._exchange_fn(blocks, self.axis_name,
-                                       self._wire_dtype)
+                                       self._wire_dtype, quant_axis=1)
         return unpack_blocks_to_grid(blocks, col_inv, dp.dim_y,
                                      self._xf_eff)
 
@@ -1092,7 +1217,7 @@ class DistributedTransformPlan:
         if dp.num_shards > 1:
             # comm-size-1 local collapse (see _exchange_freq_to_grid)
             blocks = self._exchange_fn(blocks, self.axis_name,
-                                       self._wire_dtype)
+                                       self._wire_dtype, quant_axis=2)
         return unpack_blocks_to_sticks(blocks, z_src)
 
     # -- chunk-pipelined exchange (compute/communication overlap) -----------
@@ -1135,8 +1260,12 @@ class DistributedTransformPlan:
             if ov.kind == "block":
                 blocks = pack_freq_to_blocks(s_c, zmap)
                 if dp.num_shards > 1:
+                    # int8 quant rows = sticks (axis 1): the chunk slice
+                    # axis, so per-chunk scale sidecars partition the
+                    # monolithic one exactly at every K
                     blocks = self._exchange_fn(blocks, self.axis_name,
-                                               self._wire_dtype)
+                                               self._wire_dtype,
+                                               quant_axis=1)
                 recvs.append(blocks)
                 continue
             flat = s_c.reshape(batch + (-1,))
@@ -1191,8 +1320,12 @@ class DistributedTransformPlan:
                                               dp.num_shards,
                                               dp.max_sticks)
                 if dp.num_shards > 1:
+                    # forward chunks slice planes (axis 2) — the int8
+                    # quant axis follows, keeping scale-sidecar bytes
+                    # conserved at every K (mirror of the backward)
                     blocks = self._exchange_fn(blocks, self.axis_name,
-                                               self._wire_dtype)
+                                               self._wire_dtype,
+                                               quant_axis=2)
                 recvs.append(blocks)
                 continue
             flat = g_c.reshape(batch + (-1,))
@@ -1688,10 +1821,28 @@ class DistributedTransformPlan:
     def _wire_elem_bytes(self) -> int:
         elem = np.dtype(self._cdt).itemsize
         if self._wire_dtype is not None:
+            # int8 rung: 2 bytes per complex element (re+im quantized);
+            # the per-stick scale sidecar is counted separately
+            # (_wire_scale_bytes), not folded into the element size.
             elem = 2 * np.dtype(self._wire_dtype).itemsize
         return elem
 
-    def exchange_wire_bytes(self) -> int:
+    def _wire_scale_bytes(self, forward: bool, busiest: bool = False) -> int:
+        """int8 scale-sidecar bytes for ONE exchange: one f32 absmax
+        scale per (destination slot, quant row), quant rows being sticks
+        backward / planes forward — the overlap chunk-slice axes, so the
+        total is conserved at every K (OverlapSchedule.scale_rows is the
+        per-chunk decomposition). Zero on every other rung."""
+        if (self._wire_dtype is None
+                or np.dtype(self._wire_dtype) != np.dtype(np.int8)):
+            return 0
+        dp = self.dist_plan
+        rows = dp.max_planes if forward else dp.max_sticks
+        links = ((dp.num_shards - 1) if busiest
+                 else dp.num_shards * (dp.num_shards - 1))
+        return links * rows * 4
+
+    def exchange_wire_bytes(self, forward: bool = False) -> int:
         """TOTAL off-shard bytes (summed over all shards) for ONE exchange
         under the selected mechanism — the aggregate-ICI-traffic model (the
         quantity the reference's Alltoallv layout exists to minimise,
@@ -1711,9 +1862,10 @@ class DistributedTransformPlan:
         if self._compact is not None:
             return self._compact.wire_elements() * elem
         return (dp.num_shards * (dp.num_shards - 1)
-                * dp.max_sticks * dp.max_planes * elem)
+                * dp.max_sticks * dp.max_planes * elem
+                + self._wire_scale_bytes(forward))
 
-    def exchange_busiest_link_bytes(self) -> int:
+    def exchange_busiest_link_bytes(self, forward: bool = False) -> int:
         """Max over shards of max(sent, received) off-shard bytes for ONE
         exchange — the bottleneck-link model. A shard that genuinely owns
         most of the slab receives that payload under ANY exact layout, so
@@ -1727,7 +1879,8 @@ class DistributedTransformPlan:
             return self._ragged.busiest_link_elements() * elem
         if self._compact is not None:
             return self._compact.busiest_link_elements() * elem
-        return (dp.num_shards - 1) * dp.max_sticks * dp.max_planes * elem
+        return ((dp.num_shards - 1) * dp.max_sticks * dp.max_planes * elem
+                + self._wire_scale_bytes(forward, busiest=True))
 
     def estimated_device_bytes(self) -> int:
         """Approximate resident bytes this plan pins for its lifetime:
@@ -1947,6 +2100,8 @@ def make_distributed_plan(transform_type: TransformType,
                           exchange: ExchangeType = ExchangeType.DEFAULT,
                           use_pallas: Optional[bool] = None,
                           overlap_chunks: Optional[int] = None,
+                          wire_precision: Optional[int] = None,
+                          wire_error_budget: Optional[float] = None,
                           ) -> DistributedTransformPlan:
     """Plan a distributed transform in one call (the distributed analogue of
     ``Grid::create_transform``, reference grid.hpp:138-141). Under
@@ -1960,4 +2115,6 @@ def make_distributed_plan(transform_type: TransformType,
         validate_consistent(dist)
     return DistributedTransformPlan(dist, mesh=mesh, precision=precision,
                                     exchange=exchange, use_pallas=use_pallas,
-                                    overlap_chunks=overlap_chunks)
+                                    overlap_chunks=overlap_chunks,
+                                    wire_precision=wire_precision,
+                                    wire_error_budget=wire_error_budget)

@@ -21,7 +21,11 @@ padding:
 The reference's reduced-precision wire option (``*_FLOAT`` exchange types,
 docs/source/details.rst "MPI Exchange") maps to casting the interleaved block
 to the next lower real dtype around the collective: f64 -> f32 on the wire for
-double transforms, f32 -> bf16 for single.
+double transforms, f32 -> bf16 for single. The bottom rung of the wire ladder
+(docs/distributed.md "Compressed wire") quantizes the interleaved block to
+int8 with one float32 absmax scale per (target-slot, stick-row) — the scales
+are bitcast to int8 and concatenated after the payload on each slot's row, so
+payload and scales ride the SAME collective and one round still suffices.
 """
 
 from __future__ import annotations
@@ -110,8 +114,75 @@ def unpack_blocks_to_sticks(blocks, z_src):
     return flat[:, z_src]
 
 
+def is_int8_wire(wire_real_dtype) -> bool:
+    """True when ``wire_real_dtype`` selects the int8-quantized wire rung
+    (the other rungs are plain real dtypes the interleaved block casts to)."""
+    return wire_real_dtype is not None \
+        and np.dtype(wire_real_dtype) == np.dtype(np.int8)
+
+
+def quantize_blocks_int8(blocks, quant_axis: int):
+    """Quantize a padded complex block to the int8 wire layout.
+
+    The block is viewed as interleaved reals and quantized with one
+    float32 absmax scale per row of ``quant_axis`` (axis 1 = stick rows
+    for the backward exchange, axis 2 = plane rows for the forward —
+    matching the axis the overlap pipeline chunks, so per-chunk scale
+    bytes sum exactly to the monolithic total at every K). Scales are
+    bitcast to int8 and concatenated after the payload on each slot's
+    row: ``packed[s] = [payload(rows * planes * 2 int8), scales(rows *
+    4 int8)]``, so one collective moves both.
+
+    Args:
+      blocks: (num_shards, max_sticks, max_planes) complex.
+      quant_axis: 1 (per-stick scales) or 2 (per-plane scales).
+    Returns:
+      (num_shards, payload + scale bytes) int8.
+    """
+    il = complex_to_interleaved(blocks).astype(jnp.float32)
+    reduce_axes = tuple(a for a in (1, 2, 3) if a != quant_axis)
+    absmax = jnp.max(jnp.abs(il), axis=reduce_axes, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / 127.0,
+                      jnp.ones_like(absmax))
+    q = jnp.clip(jnp.round(il / scale), -127, 127).astype(jnp.int8)
+    num_shards = il.shape[0]
+    payload = q.reshape(num_shards, -1)
+    scales8 = jax.lax.bitcast_convert_type(
+        scale.reshape(num_shards, -1), jnp.int8
+    ).reshape(num_shards, -1)
+    return jnp.concatenate([payload, scales8], axis=1)
+
+
+def dequantize_blocks_int8(packed, shape, quant_axis: int, real_dtype):
+    """Invert :func:`quantize_blocks_int8` after the collective.
+
+    Args:
+      packed: (num_shards, payload + scale bytes) int8 — each slot row
+        carries the SENDER's payload and its scales (rows travel intact
+        through both block collectives, so slot r's scales are always
+        the ones slot r's payload was quantized with).
+      shape: the (num_shards, max_sticks, max_planes) block shape.
+      quant_axis: must match the quantize call.
+      real_dtype: the transform's real dtype to cast back to.
+    Returns:
+      (num_shards, max_sticks, max_planes) complex.
+    """
+    num_shards, max_sticks, max_planes = shape
+    n_payload = max_sticks * max_planes * 2
+    q = packed[:, :n_payload].reshape(
+        num_shards, max_sticks, max_planes, 2).astype(jnp.float32)
+    rows = max_sticks if quant_axis == 1 else max_planes
+    scale = jax.lax.bitcast_convert_type(
+        packed[:, n_payload:].reshape(num_shards, rows, 4), jnp.float32)
+    bshape = [num_shards, 1, 1, 1]
+    bshape[quant_axis] = rows
+    il = q * scale.reshape(bshape)
+    return interleaved_to_complex(il.astype(real_dtype))
+
+
 def ring_exchange_blocks(blocks, axis_name: str,
-                         wire_real_dtype: Optional[jnp.dtype] = None):
+                         wire_real_dtype: Optional[jnp.dtype] = None,
+                         quant_axis: int = 1):
     """All-to-all block exchange as S-1 ``ppermute`` ring steps.
 
     Mechanically distinct alternative to the single fused ``all_to_all``
@@ -127,6 +198,11 @@ def ring_exchange_blocks(blocks, axis_name: str,
     num_shards = blocks.shape[0]
     if num_shards == 1:
         return blocks
+    if is_int8_wire(wire_real_dtype):
+        rdt = blocks.real.dtype
+        packed = quantize_blocks_int8(blocks, quant_axis)
+        out = ring_exchange_blocks(packed, axis_name, None)
+        return dequantize_blocks_int8(out, blocks.shape, quant_axis, rdt)
     if wire_real_dtype is not None:
         rdt = blocks.real.dtype
         il = complex_to_interleaved(blocks).astype(wire_real_dtype)
@@ -628,7 +704,8 @@ def compact_exchange(bufs, ops, num_shards: int, axis_name: str,
 
 
 def all_to_all_blocks(blocks, axis_name: str,
-                      wire_real_dtype: Optional[jnp.dtype] = None):
+                      wire_real_dtype: Optional[jnp.dtype] = None,
+                      quant_axis: int = 1):
     """Exchange blocks between shards; block (r -> s) lands at (s, slot r).
 
     One XLA all-to-all over the mesh axis — the whole distributed backbone
@@ -636,13 +713,22 @@ def all_to_all_blocks(blocks, axis_name: str,
     enables the reduced-precision wire mode: the complex block is viewed as
     interleaved reals, cast down for the collective, and cast back after
     (reference float-exchange conversion in pack/unpack,
-    transpose_mpi_compact_buffered_host.cpp:60-63).
+    transpose_mpi_compact_buffered_host.cpp:60-63). The int8 rung instead
+    quantizes each slot row with per-``quant_axis``-row absmax scales
+    packed alongside the payload (:func:`quantize_blocks_int8`) — still a
+    single collective round.
     """
     if wire_real_dtype is None:
         return jax.lax.all_to_all(blocks, axis_name, split_axis=0,
                                   concat_axis=0, tiled=True)
+    if is_int8_wire(wire_real_dtype):
+        rdt = blocks.real.dtype
+        packed = quantize_blocks_int8(blocks, quant_axis)
+        out = jax.lax.all_to_all(packed, axis_name, split_axis=0,
+                                 concat_axis=0, tiled=True)
+        return dequantize_blocks_int8(out, blocks.shape, quant_axis, rdt)
     rdt = blocks.real.dtype
     il = complex_to_interleaved(blocks).astype(wire_real_dtype)
-    il = jax.lax.all_to_all(il, axis_name, split_axis=0, concat_axis=0,
-                            tiled=True)
+    il = jnp.asarray(jax.lax.all_to_all(
+        il, axis_name, split_axis=0, concat_axis=0, tiled=True))
     return interleaved_to_complex(il.astype(rdt))

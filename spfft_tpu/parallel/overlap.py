@@ -303,6 +303,27 @@ class OverlapSchedule:
         both = np.maximum(send, recv)
         return int(both.max()) if self.num_shards else 0
 
+    def chunk_scale_rows(self, c: int, forward: bool = False) -> int:
+        """int8-wire scale rows chunk ``c`` carries: one f32 absmax
+        scale per (destination slot, quant row), quant rows being the
+        chunk's stick slice backward / plane slice forward — exactly
+        the chunk-bound axes, so the per-chunk sidecars partition the
+        monolithic one. Only the padded block kind carries the int8
+        rung (exact-count kinds decline it), so other kinds report 0."""
+        if self.kind != "block":
+            return 0
+        ch = self.chunks[c]
+        return (ch.plane_hi - ch.plane_lo if forward
+                else ch.stick_hi - ch.stick_lo)
+
+    def scale_rows(self, forward: bool = False) -> int:
+        """TOTAL int8 scale rows per exchange (all chunks). The chunk
+        bounds partition ``[0, max_sticks)`` / ``[0, max_planes)``, so
+        this is conserved at every K — the sidecar analogue of the
+        :meth:`wire_elements` conservation the tests assert."""
+        return sum(self.chunk_scale_rows(c, forward)
+                   for c in range(self.num_chunks))
+
     # -- device-table plumbing ----------------------------------------------
     def device_tables(self) -> list:
         """The (S, ...) arrays the SPMD bodies consume, flattened in a

@@ -661,12 +661,16 @@ class TransformPlan:
             fused_reasons=dict(self._fused_reasons))
 
     def install_aot(self, executables: dict) -> None:
-        """Install ``jax.export``-deserialised executables (keys
-        ``"backward"`` / ``"forward_none"`` / ``"forward_full"``) for
-        the default-placement public entries — the store's AOT prewarm.
-        The first call then skips straight to execution instead of
-        trace + lower (+ compile, when the backend's compilation cache
-        misses)."""
+        """Install ``jax.export``-deserialised executables for the
+        default-placement public entries — the store's AOT prewarm.
+        Single-request keys are ``"backward"`` / ``"forward_none"`` /
+        ``"forward_full"``; batched keys (symbolic leading batch dim)
+        are ``"batched_backward"`` / ``"batched_forward_none"`` /
+        ``"batched_forward_full"``; identity fused-pair keys
+        (``apply_pointwise`` with ``fn=None``) are ``"pair_none"`` /
+        ``"pair_full"``. The first call then skips straight to
+        execution instead of trace + lower (+ compile, when the
+        backend's compilation cache misses)."""
         self._aot = dict(executables) if executables else None
 
     def _join_build(self) -> None:
@@ -784,6 +788,7 @@ class TransformPlan:
             self._backward_jit = jax.jit(self._backward_impl)
             if self._aot is not None:
                 self._aot.pop("backward", None)
+                self._aot.pop("batched_backward", None)
         else:
             self._forward_jit = {
                 Scaling.NONE: jax.jit(functools.partial(
@@ -794,6 +799,12 @@ class TransformPlan:
             if self._aot is not None:
                 self._aot.pop("forward_none", None)
                 self._aot.pop("forward_full", None)
+                self._aot.pop("batched_forward_none", None)
+                self._aot.pop("batched_forward_full", None)
+        if self._aot is not None:
+            # the fused pair bakes BOTH directions into one program
+            self._aot.pop("pair_none", None)
+            self._aot.pop("pair_full", None)
         self._batched = None
         self._pair_jits = {}
 
@@ -1712,8 +1723,9 @@ class TransformPlan:
             if device is not None:
                 batch = jax.device_put(batch, device)
             box.value = self._guarded(
-                "dec", lambda: self._batched_jits()["backward"](
-                    batch, self._tables_on(device)))
+                "dec", lambda: self._call_aot_or_jit(
+                    "batched_backward", self._batched_jits()["backward"],
+                    batch, device))
             if self._ds:
                 box.value = self._ds_space_to_host(box.value)
         return box.value
@@ -1750,8 +1762,10 @@ class TransformPlan:
             if device is not None:
                 batch = jax.device_put(batch, device)
             box.value = self._guarded(
-                "cmp", lambda: self._batched_jits()[scaling](
-                    batch, self._tables_on(device)))
+                "cmp", lambda: self._call_aot_or_jit(
+                    "batched_forward_full" if scaling is Scaling.FULL
+                    else "batched_forward_none",
+                    self._batched_jits()[scaling], batch, device))
             if self._ds:
                 box.value = self._ds_values_to_host(box.value)
         return box.value
@@ -1831,7 +1845,15 @@ class TransformPlan:
             self._pair_jits[key] = jitted
         self._finalize()
         with timed_transform("apply_pointwise") as box:
-            box.value = jitted(values_il, self._tables_hot, *fn_args)
+            if fn is None and not fn_args:
+                # the identity pair is a store-exported AOT entry; fn
+                # captures are compile-time constants, so only the
+                # fn-free round trip can reuse a serialized executable
+                box.value = self._call_aot_or_jit(
+                    "pair_full" if scaling is Scaling.FULL
+                    else "pair_none", jitted, values_il, None)
+            else:
+                box.value = jitted(values_il, self._tables_hot, *fn_args)
             if self._ds:
                 box.value = self._ds_values_to_host(box.value)
         return box.value

@@ -1039,33 +1039,102 @@ def _run_chaos(args) -> int:
     #: site menu: (site, subsystem, flow order, script kinds). Extras
     #: are only drawn from LATER flow stages than the primary, so the
     #: primary always fires even when it aborts the storm's flow.
+    #: ``exchange.quantize`` leads the flow (the wire-ladder probe runs
+    #: before everything else in a distributed plan build) and takes
+    #: the dedicated dist-plan storm flow below instead of the
+    #: registry/executor one.
     menu = (
-        ("store.load", "store", 0, ("transient", "enospc")),
-        ("registry.build", "registry", 1, ("transient", "permanent")),
-        ("plan.build", "plan", 2, ("transient", "permanent")),
-        ("store.spill", "store", 3, ("transient", "enospc")),
-        ("store.fsync", "store", 4, ("transient", "enospc")),
-        ("store.replace", "store", 5, ("transient", "enospc")),
-        ("stage", "executor", 6, ("transient", "permanent", "poison")),
-        ("dispatch", "executor", 7, ("transient", "permanent")),
-        ("materialise", "executor", 8, ("transient", "hang")),
-        ("loop", "executor", 9, ("transient", "permanent")),
+        ("exchange.quantize", "exchange", 0, ("transient",)),
+        ("store.load", "store", 1, ("transient", "enospc")),
+        ("registry.build", "registry", 2, ("transient", "permanent")),
+        ("plan.build", "plan", 3, ("transient", "permanent")),
+        ("store.spill", "store", 4, ("transient", "enospc")),
+        ("store.fsync", "store", 5, ("transient", "enospc")),
+        ("store.replace", "store", 6, ("transient", "enospc")),
+        ("stage", "executor", 7, ("transient", "permanent", "poison")),
+        ("dispatch", "executor", 8, ("transient", "permanent")),
+        ("materialise", "executor", 9, ("transient", "hang")),
+        ("loop", "executor", 10, ("transient", "permanent")),
     )
     subsystem_of = {site: sub for site, sub, _, _ in menu}
     subsystem_of["cluster.spmd_window"] = "cluster"  # phase D2
+    # shared fixture for the exchange.quantize storms: a 1-shard
+    # distributed plan (chaos-smoke runs on one CPU device) whose wire
+    # probe still exercises the int8 scale computation, plus a clean
+    # full-rung oracle — at S=1 no collective runs, so the degraded
+    # plan must stay BIT-exact, not merely within budget.
+    from ..parallel.dist import DistributedTransformPlan, \
+        build_distributed_plan
+    wire_trip = cutoff_stick_triplets(8, 8, 8, 0.9, hermitian=False)
+    wire_dp = build_distributed_plan(TransformType.C2C, 8, 8, 8,
+                                     [wire_trip], [8])
+    wire_oplan = DistributedTransformPlan(wire_dp, precision="single")
+    nv_w = wire_dp.shard_plans[0].num_values
+    wire_vals = [(rng.standard_normal(nv_w)
+                  + 1j * rng.standard_normal(nv_w)).astype(np.complex64)]
+    wire_oracle = np.asarray(wire_oplan.backward(wire_vals))
     storms = 16
     wave = 5
     storm_log = []
     for storm in range(storms):
         site, _, order, kinds = menu[storm % len(menu)]
         kind = kinds[int(rng.integers(len(kinds)))]
-        nth = int(rng.integers(1, 3)) if order >= 6 else 1
+        # stage/dispatch are checked once per fused bucket and the wave
+        # fits one bucket, so nth=2 would never fire there — only the
+        # per-request/per-iteration sites (materialise, loop) can take
+        # a deeper traversal
+        nth = int(rng.integers(1, 3)) if order >= 9 else 1
         script = [f"{site}@{nth}:{kind}"]
         later = [m for m in menu if m[2] > order]
         if later and rng.random() < 0.5:
             extra = later[int(rng.integers(len(later)))]
             script.append(f"{extra[0]}@1:{extra[3][0]}")
         plan_f = FaultPlan(script=script, hang_seconds=0.2)
+        if site == "exchange.quantize":
+            # wire-ladder storm: the armed fault fires during the int8
+            # probe's scale computation -> typed transient, the plan
+            # falls back EXACTLY one rung (int8 -> bf16), records the
+            # decline, and still serves bit-exact (S=1: no collective).
+            obs.GLOBAL_TRACER.reset()
+            outcome = {"script": script, "served": 0,
+                       "typed_failures": 0, "wire_rung": None}
+            try:
+                faults.arm(plan_f)
+                try:
+                    wplan = DistributedTransformPlan(
+                        wire_dp, precision="single",
+                        wire_precision=3, wire_error_budget=1.0)
+                except typed:
+                    outcome["typed_failures"] += 1
+                    check(False, f"storm {storm} {script}: quantize "
+                                 f"fault ESCAPED the probe's decline "
+                                 f"ladder")
+                except Exception as exc:
+                    check(False, f"storm {storm} {script}: UNTYPED "
+                                 f"build failure "
+                                 f"{type(exc).__name__}: {exc}")
+                else:
+                    outcome["wire_rung"] = wplan.wire_rung_name
+                    check(wplan.wire_rung == 2,
+                          f"storm {storm} {script}: faulted probe did "
+                          f"not fall back one rung "
+                          f"({wplan.wire_rung_name})")
+                    check(("int8", "fault_injected")
+                          in wplan.wire_declines,
+                          f"storm {storm} {script}: decline reason not "
+                          f"recorded: {wplan.wire_declines}")
+                    got = np.asarray(wplan.backward(wire_vals))
+                    check(np.array_equal(got, wire_oracle),
+                          f"storm {storm} {script}: degraded-rung plan "
+                          f"diverged from the oracle")
+                    outcome["served"] += 1
+                faults.disarm()
+                spans_closed(f"storm {storm} {script}")
+                tally(plan_f)
+            finally:
+                faults.disarm()
+            storm_log.append(outcome)
+            continue
         good = [vals() for _ in range(wave)]
         oracles = [np.asarray(oplan.backward(w)) for w in good]
         obs.GLOBAL_TRACER.reset()
@@ -1474,10 +1543,10 @@ def _run_chaos(args) -> int:
                          if s in subsystem_of}
                         | ({"kernel"} if "kernel.launch" in fired_sites
                            else set()))
-    check(len(fired_sites) >= 21,
+    check(len(fired_sites) >= 22,
           f"chaos coverage: only {len(fired_sites)} fault sites fired "
           f"({sorted(fired_sites)})")
-    check(len(subsystems) >= 8,
+    check(len(subsystems) >= 9,
           f"chaos coverage: only {len(subsystems)} subsystems hit "
           f"({subsystems})")
     check({"net", "blob", "membership"} <= set(subsystems),

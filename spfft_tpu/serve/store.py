@@ -59,6 +59,7 @@ store-smoke``). See docs/artifact_cache.md.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import hashlib
 import io
 import json
@@ -411,11 +412,17 @@ def _plan_tables_of(header: dict, arrays: dict) -> PlanTables:
 
 # -- AOT executables ---------------------------------------------------------
 def export_aot_blobs(plan: TransformPlan) -> Dict[str, bytes]:
-    """``jax.export``-serialize the plan's three single-request
-    executables (backward, forward NONE, forward FULL). Best-effort:
-    any direction that fails to export is simply absent (the restored
-    plan jits it fresh). Double-single plans export nothing (their
-    host-side split/combine boundary is not a single traced function)."""
+    """``jax.export``-serialize the plan's executables: the three
+    single-request entries (backward, forward NONE, forward FULL), the
+    three batched entries over a SYMBOLIC batch dimension (one exported
+    module serves every batch size — the serving executor's fused
+    batches hit it without per-B re-export), and the two identity
+    fused-pair entries (``apply_pointwise`` with ``fn=None``, NONE and
+    FULL scaling — the reference benchmark's backward+forward round
+    trip). Best-effort: any entry that fails to export is simply absent
+    (the restored plan jits it fresh). Double-single plans export
+    nothing (their host-side split/combine boundary is not a single
+    traced function)."""
     if getattr(plan, "_ds", False):
         return {}
     try:
@@ -432,6 +439,14 @@ def export_aot_blobs(plan: TransformPlan) -> Dict[str, bytes]:
         sshape, sdtype = plan.batch_row_template("space")
     except Exception:
         return {}
+    batched = plan._batched_jits()
+    # un-donated pair jits: the store's copy must not inherit the
+    # caller's donate_inputs buffer reuse
+    pair_none = jax.jit(functools.partial(plan._pair_impl, scaled=False,
+                                          fn=None))
+    pair_full = jax.jit(functools.partial(plan._pair_impl, scaled=True,
+                                          fn=None))
+    b, = jax_export.symbolic_shape("b")
     entries = (
         ("backward", plan._backward_jit,
          jax.ShapeDtypeStruct(vshape, vdtype)),
@@ -439,6 +454,14 @@ def export_aot_blobs(plan: TransformPlan) -> Dict[str, bytes]:
          jax.ShapeDtypeStruct(sshape, sdtype)),
         ("forward_full", plan._forward_jit[Scaling.FULL],
          jax.ShapeDtypeStruct(sshape, sdtype)),
+        ("batched_backward", batched["backward"],
+         jax.ShapeDtypeStruct((b, *vshape), vdtype)),
+        ("batched_forward_none", batched[Scaling.NONE],
+         jax.ShapeDtypeStruct((b, *sshape), sdtype)),
+        ("batched_forward_full", batched[Scaling.FULL],
+         jax.ShapeDtypeStruct((b, *sshape), sdtype)),
+        ("pair_none", pair_none, jax.ShapeDtypeStruct(vshape, vdtype)),
+        ("pair_full", pair_full, jax.ShapeDtypeStruct(vshape, vdtype)),
     )
     out = {}
     for key, jitted, aval in entries:

@@ -181,7 +181,10 @@ def test_aot_executables_install_and_disable(tmp_path, monkeypatch):
     # this container's jax has jax.export for the CPU platform
     assert plan2._aot is not None
     assert set(plan2._aot) == {"backward", "forward_none",
-                               "forward_full"}
+                               "forward_full", "batched_backward",
+                               "batched_forward_none",
+                               "batched_forward_full", "pair_none",
+                               "pair_full"}
     # disabled: the spilled artifact carries no AOT blobs at all
     monkeypatch.setenv("SPFFT_TPU_PLAN_STORE_AOT", "0")
     store2 = PlanArtifactStore(str(tmp_path / "store2"))
@@ -214,6 +217,46 @@ def test_aot_call_failure_falls_back_to_jit(tmp_path):
                                    reason="call_failed") == before + 1
     # later calls go straight through the jit path
     assert np.array_equal(np.asarray(plan2.backward(vals)), want)
+
+
+def test_aot_batched_and_pair_roundtrip_bit_exact(tmp_path):
+    """The batched (symbolic leading batch dim) and identity fused-pair
+    executables round-trip through the store and serve requests
+    bit-exactly against a fresh-jit plan — at MULTIPLE batch sizes, so
+    one exported module demonstrably covers every B."""
+    store, reg, tr, sig, plan = _build_store(tmp_path)
+    got = PlanArtifactStore(store.root).load_signature(sig)
+    assert got is not None
+    _, plan2 = got
+    for key in ("batched_backward", "batched_forward_none",
+                "batched_forward_full", "pair_none", "pair_full"):
+        assert key in plan2._aot, key
+
+    rng = np.random.default_rng(11)
+    for b in (1, 3):
+        vals_b = rng.standard_normal(
+            (b, plan.index_plan.num_values, 2)).astype(np.float32)
+        want_b = np.asarray(plan.backward_batched(vals_b))
+        assert np.array_equal(
+            np.asarray(plan2.backward_batched(vals_b)), want_b)
+        for scaling in (Scaling.NONE, Scaling.FULL):
+            assert np.array_equal(
+                np.asarray(plan2.forward_batched(want_b,
+                                                 scaling=scaling)),
+                np.asarray(plan.forward_batched(want_b,
+                                                scaling=scaling)))
+    # the AOT entries survived every dispatch (no silent call_failed
+    # fallback ate them)
+    for key in ("batched_backward", "batched_forward_none",
+                "batched_forward_full"):
+        assert key in plan2._aot, key
+
+    vals = _values(plan, seed=12)
+    for scaling in (Scaling.NONE, Scaling.FULL):
+        assert np.array_equal(
+            np.asarray(plan2.apply_pointwise(vals, scaling=scaling)),
+            np.asarray(plan.apply_pointwise(vals, scaling=scaling)))
+    assert "pair_none" in plan2._aot and "pair_full" in plan2._aot
 
 
 # -- poisoned artifacts ------------------------------------------------------

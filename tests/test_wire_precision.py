@@ -1,0 +1,430 @@
+"""Error-budgeted compressed exchange wire (dist.py "wire precision
+ladder" + exchange.quantize_blocks_int8): a typed rung ladder
+full -> f32 -> bf16 -> int8 for the distributed exchange payload, gated
+at plan build by a MEASURED probe error against the declared l2 budget.
+
+Properties checked here, on the virtual CPU mesh:
+
+* the pure int8 quantize/dequantize pair round-trips adversarial
+  per-row dynamic range within the per-stick-scale error bound, on both
+  quantization axes, with the exact packed layout (payload + bitcast
+  f32 scale sidecar) the byte accounting declares;
+* the budget gate REFUSES over-budget rungs and ineligible layouts by
+  walking down the ladder, recording every decline with its reason
+  (``wire_declines`` + ``spfft_wire_rung_declined_total``) — never
+  silently shipping an out-of-budget wire;
+* rung resolution composes with env/config/legacy ``*_FLOAT`` requests
+  and rejects out-of-range knobs;
+* end-to-end fuzz: compressed-wire plans reproduce their rung-0 twin
+  within budget across exchange kinds, overlap chunk counts and
+  transform types, and the block-layout int8 wire is BIT-identical
+  across K (per-chunk scales partition the monolithic sidecar);
+* byte accounting: int8 wire = 2 B/value + the f32 scale sidecar,
+  conserved exactly across ``overlap_chunks``, and at most 0.30x the
+  f32 rung's wire on the spherical workload shape;
+* the controller escalates the rung only under SUSTAINED exposed
+  exchange, decays it when the wire hides, and never oscillates on
+  alternating traffic — with direction-labelled rung-change counters.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from spfft_tpu import ExchangeType, TransformType, faults, obs
+from spfft_tpu.control import Controller, ServeConfig
+from spfft_tpu.errors import InvalidParameterError
+from spfft_tpu.parallel import exchange, make_distributed_plan, make_mesh
+from spfft_tpu.parallel.dist import (WIRE_ERROR_BUDGET_ENV,
+                                     WIRE_PRECISION_ENV, WIRE_RUNGS)
+from spfft_tpu.utils.workloads import (even_plane_split,
+                                       round_robin_stick_partition,
+                                       spherical_cutoff_triplets)
+
+from test_util import hermitian_triplets
+
+N = 12
+SHARDS = 3
+
+
+def _rel(got, ref):
+    got = np.asarray(got)
+    ref = np.asarray(ref)
+    denom = np.linalg.norm(ref)
+    return float(np.linalg.norm(got - ref) / denom) if denom else 0.0
+
+
+def _sphere_setup(n=N, shards=SHARDS, seed=0xA11, span=4.0):
+    """Spherical C2C workload with per-value dynamic range 10^±span —
+    the shape the per-stick scales exist to survive."""
+    tr = spherical_cutoff_triplets(n)
+    parts = round_robin_stick_partition(tr, (n, n, n), shards)
+    planes = even_plane_split(n, shards)
+    rng = np.random.default_rng(seed)
+    vals = []
+    for p in parts:
+        m = 10.0 ** rng.uniform(-span, span, size=len(p))
+        vals.append(((rng.uniform(-1, 1, len(p))
+                      + 1j * rng.uniform(-1, 1, len(p))) * m)
+                    .astype(np.complex64))
+    return parts, planes, vals
+
+
+def _build(parts, planes, **kw):
+    kw.setdefault("precision", "single")
+    return make_distributed_plan(TransformType.C2C, N, N, N, parts,
+                                 planes, mesh=make_mesh(SHARDS), **kw)
+
+
+# -- pure quantizer ---------------------------------------------------------
+
+@pytest.mark.parametrize("quant_axis", [1, 2])
+def test_int8_quantize_roundtrip_survives_per_row_dynamic_range(
+        quant_axis):
+    """Per-row absmax scales bound the round-trip error by the row's
+    own magnitude — a 12-decade spread ACROSS rows costs nothing."""
+    rng = np.random.default_rng(3)
+    s, ms, mp = 3, 7, 9
+    rows = ms if quant_axis == 1 else mp
+    shape = [1, 1]
+    shape[quant_axis - 1] = rows
+    mags = 10.0 ** rng.uniform(-6, 6, size=(s, *shape))
+    blocks = ((rng.standard_normal((s, ms, mp))
+               + 1j * rng.standard_normal((s, ms, mp)))
+              * mags).astype(np.complex64)
+    packed = np.asarray(exchange.quantize_blocks_int8(
+        jnp.asarray(blocks), quant_axis))
+    # exact packed layout: int8 payload then the bitcast f32 sidecar,
+    # one scale per quantization row — the accounting's 2 B/value +
+    # rows*4 B contract
+    assert packed.dtype == np.int8
+    assert packed.shape == (s, ms * mp * 2 + rows * 4)
+    got = np.asarray(exchange.dequantize_blocks_int8(
+        jnp.asarray(packed), blocks.shape, quant_axis, jnp.float32))
+    assert got.shape == blocks.shape
+    assert _rel(got, blocks) < 0.01
+    # per-row relative error bounded by the row's quantization step
+    for sh in range(s):
+        for r in range(rows):
+            sl = (sh, r) if quant_axis == 1 else (sh, slice(None), r)
+            row_ref = blocks[sl]
+            row_err = np.max(np.abs(got[sl] - row_ref))
+            assert row_err <= np.max(np.abs(
+                np.stack([row_ref.real, row_ref.imag]))) / 127.0 + 1e-30
+
+
+def test_int8_quantize_zero_rows_roundtrip_exactly():
+    """All-zero rows take the scale=1 branch and come back as exact
+    zeros — no NaN from a 0/0 scale."""
+    blocks = np.zeros((2, 4, 5), np.complex64)
+    blocks[1, 2, :] = 3.5 + 0.5j  # one live row next to dead ones
+    packed = exchange.quantize_blocks_int8(jnp.asarray(blocks), 1)
+    got = np.asarray(exchange.dequantize_blocks_int8(
+        packed, blocks.shape, 1, jnp.float32))
+    assert np.all(np.isfinite(got))
+    assert np.all(got[0] == 0) and np.all(got[1, :2] == 0)
+    assert _rel(got[1, 2], blocks[1, 2]) < 0.01
+
+
+def test_is_int8_wire_predicate():
+    assert exchange.is_int8_wire(jnp.int8)
+    assert not exchange.is_int8_wire(np.float32)
+    assert not exchange.is_int8_wire(jnp.bfloat16)
+    assert not exchange.is_int8_wire(None)
+
+
+# -- budget gate ------------------------------------------------------------
+
+def test_budget_gate_accepts_int8_within_budget():
+    parts, planes, _ = _sphere_setup()
+    plan = _build(parts, planes, wire_precision=3, wire_error_budget=0.01)
+    assert plan.wire_rung == 3
+    assert plan.wire_rung_name == "int8"
+    assert plan.wire_rung_requested == 3
+    assert plan.wire_declines == ()
+    assert 0.0 < plan.wire_probe_error <= 0.01
+
+
+def test_budget_gate_walks_down_ladder_recording_declines():
+    """A 1e-3 budget is under both the int8 (~5e-3) and bf16 (~1.6e-3)
+    probe errors: the plan declines both FOR A REASON and lands on f32,
+    which measures exactly 0 against the single-precision payload."""
+    parts, planes, _ = _sphere_setup()
+    before = obs.GLOBAL_COUNTERS.get("spfft_wire_rung_declined_total",
+                                     reason="over_budget")
+    plan = _build(parts, planes, wire_precision=3,
+                  wire_error_budget=1e-3)
+    assert plan.wire_rung_name == "f32"
+    assert plan.wire_declines == (("int8", "over_budget"),
+                                  ("bf16", "over_budget"))
+    assert plan.wire_probe_error == 0.0
+    assert obs.GLOBAL_COUNTERS.get("spfft_wire_rung_declined_total",
+                                   reason="over_budget") == before + 2
+
+
+def test_budget_gate_declines_int8_on_exact_count_layout():
+    """The compact schedule addresses individual elements — no room on
+    the wire for the scale sidecar, so int8 declines to bf16 with the
+    layout reason (NOT over_budget: the budget never got a say)."""
+    parts, planes, _ = _sphere_setup()
+    plan = _build(parts, planes, exchange=ExchangeType.COMPACT_BUFFERED,
+                  wire_precision=3, wire_error_budget=1.0)
+    assert plan.wire_rung_name == "bf16"
+    assert plan.wire_declines == (("int8", "exact_count_layout"),)
+
+
+def test_budget_gate_fault_seam_declines_one_rung():
+    """An armed ``exchange.quantize`` fault fails the int8 probe: the
+    plan falls back exactly one rung and records the injected reason —
+    chaos-storm behaviour, pinned here deterministically."""
+    parts, planes, _ = _sphere_setup()
+    faults.arm(faults.FaultPlan(script="exchange.quantize@1"))
+    try:
+        plan = _build(parts, planes, wire_precision=3,
+                      wire_error_budget=1.0)
+    finally:
+        faults.disarm()
+    assert plan.wire_rung_name == "bf16"
+    assert ("int8", "fault_injected") in plan.wire_declines
+
+
+def test_wire_knobs_validated_and_env_resolved(monkeypatch):
+    parts, planes, _ = _sphere_setup()
+    with pytest.raises(InvalidParameterError):
+        _build(parts, planes, wire_precision=len(WIRE_RUNGS))
+    with pytest.raises(InvalidParameterError):
+        _build(parts, planes, wire_precision=-1)
+    with pytest.raises(InvalidParameterError):
+        _build(parts, planes, wire_precision=3, wire_error_budget=0.0)
+    # env resolution: the knob pair reads its SPFFT_TPU_* envs when the
+    # caller passes nothing
+    monkeypatch.setenv(WIRE_PRECISION_ENV, "3")
+    monkeypatch.setenv(WIRE_ERROR_BUDGET_ENV, "1.0")
+    plan = _build(parts, planes)
+    assert plan.wire_rung_name == "int8"
+    assert plan.wire_error_budget == 1.0
+
+
+def test_legacy_float_wire_maps_onto_ladder():
+    """BUFFERED_FLOAT's one-rung downcast rides the same gate: single
+    precision requests bf16, double requests f32 — both within the
+    default budget, so the legacy behaviour is unchanged."""
+    parts, planes, _ = _sphere_setup()
+    single = _build(parts, planes, exchange=ExchangeType.BUFFERED_FLOAT)
+    assert single.wire_rung_requested == 2
+    assert single.wire_rung_name == "bf16"
+    double = _build(parts, planes, exchange=ExchangeType.BUFFERED_FLOAT,
+                    precision="double")
+    assert double.wire_rung_requested == 1
+    assert double.wire_rung_name == "f32"
+
+
+# -- end-to-end fuzz --------------------------------------------------------
+
+@pytest.mark.parametrize("kind,rung,k,expect", [
+    (ExchangeType.DEFAULT, 3, 1, "int8"),
+    (ExchangeType.DEFAULT, 3, 2, "int8"),
+    (ExchangeType.DEFAULT, 2, 1, "bf16"),
+    (ExchangeType.UNBUFFERED, 3, 1, "int8"),
+    (ExchangeType.COMPACT_BUFFERED, 3, 1, "bf16"),
+])
+def test_compressed_backward_within_budget_of_rung0_twin(
+        kind, rung, k, expect):
+    parts, planes, vals = _sphere_setup()
+    plan = _build(parts, planes, exchange=kind, overlap_chunks=k,
+                  wire_precision=rung, wire_error_budget=1.0)
+    ref = _build(parts, planes, exchange=kind, overlap_chunks=k,
+                 wire_precision=0)
+    assert plan.wire_rung_name == expect
+    err = _rel(plan.backward(vals), ref.backward(vals))
+    assert err <= 0.02, f"{expect} wire err {err:.2e}"
+    # the end-to-end error tracks the build-time probe's promise
+    assert err <= max(4 * plan.wire_probe_error, 1e-6)
+
+
+def test_compressed_backward_r2c_within_budget():
+    rng = np.random.default_rng(11)
+    dims = (N, N, N)
+    tr = hermitian_triplets(rng, dims)
+    parts = round_robin_stick_partition(tr, dims, SHARDS)
+    planes = even_plane_split(N, SHARDS)
+    vals = [((rng.uniform(-1, 1, len(p))
+              + 1j * rng.uniform(-1, 1, len(p)))
+             * 10.0 ** rng.uniform(-3, 3, size=len(p)))
+            .astype(np.complex64) for p in parts]
+
+    def build(rung):
+        return make_distributed_plan(
+            TransformType.R2C, *dims, parts, planes,
+            mesh=make_mesh(SHARDS), precision="single",
+            wire_precision=rung, wire_error_budget=1.0)
+
+    plan, ref = build(3), build(0)
+    assert plan.wire_rung_name == "int8"
+    err = _rel(plan.backward(vals), ref.backward(vals))
+    assert err <= 0.02, f"r2c int8 wire err {err:.2e}"
+
+
+def test_int8_wire_bit_identical_across_overlap_chunks():
+    """Per-chunk scale sidecars partition the monolithic one exactly
+    (the chunk slice axis IS the quantization axis), so the K=1/2/4
+    outputs agree to the BIT — overlap never re-quantizes differently."""
+    parts, planes, vals = _sphere_setup()
+    outs = []
+    for k in (1, 2, 4):
+        plan = _build(parts, planes, overlap_chunks=k, wire_precision=3,
+                      wire_error_budget=1.0)
+        assert plan.wire_rung_name == "int8"
+        outs.append(np.asarray(plan.backward(vals)))
+    assert np.array_equal(outs[0], outs[1])
+    assert np.array_equal(outs[0], outs[2])
+
+
+# -- byte accounting --------------------------------------------------------
+
+def test_int8_wire_byte_formula_and_conservation():
+    parts, planes, _ = _sphere_setup()
+    plans = {k: _build(parts, planes, overlap_chunks=k, wire_precision=3,
+                       wire_error_budget=1.0) for k in (1, 2, 4)}
+    p1 = plans[1]
+    dp = p1.dist_plan
+    ms, mp = dp.max_sticks, dp.max_planes
+    links = SHARDS * (SHARDS - 1)
+    # 2 B per complex value (two int8 components) + one f32 scale per
+    # stick (backward) / per plane (forward) per link
+    assert p1.exchange_wire_bytes() == links * (ms * mp * 2 + ms * 4)
+    assert p1.exchange_wire_bytes(forward=True) == \
+        links * (ms * mp * 2 + mp * 4)
+    assert p1.exchange_busiest_link_bytes() == \
+        (SHARDS - 1) * (ms * mp * 2 + ms * 4)
+    # conserved exactly across chunking — chunk sidecars partition the
+    # monolithic one, they never inflate it
+    for k in (2, 4):
+        assert plans[k].exchange_wire_bytes() == p1.exchange_wire_bytes()
+        assert plans[k].exchange_wire_bytes(forward=True) == \
+            p1.exchange_wire_bytes(forward=True)
+
+
+def test_int8_wire_at_most_030x_of_f32_wire():
+    """The ISSUE acceptance ratio on the spherical workload shape:
+    (2 B + sidecar) vs 8 B per complex value — <= 0.30 whenever the
+    plane extent amortises the per-stick scale (mp >= 10; the flagship
+    256^3/8-shard shape has mp = 32 and measures 0.266)."""
+    n, shards = 32, 2
+    tr = spherical_cutoff_triplets(n)
+    parts = round_robin_stick_partition(tr, (n, n, n), shards)
+    planes = even_plane_split(n, shards)
+
+    def build(rung):
+        return make_distributed_plan(
+            TransformType.C2C, n, n, n, parts, planes,
+            mesh=make_mesh(shards), precision="single",
+            wire_precision=rung, wire_error_budget=1.0)
+
+    int8, f32 = build(3), build(1)
+    assert int8.wire_rung_name == "int8"
+    dp = int8.dist_plan
+    assert f32.exchange_wire_bytes() == \
+        shards * (shards - 1) * dp.max_sticks * dp.max_planes * 8
+    ratio = int8.exchange_wire_bytes() / f32.exchange_wire_bytes()
+    assert ratio <= 0.30, f"int8 wire ratio {ratio:.3f} > 0.30"
+
+
+def test_wire_rung_gauge_recorded_at_plan_build():
+    parts, planes, _ = _sphere_setup()
+    plan = _build(parts, planes, wire_precision=3, wire_error_budget=1.0)
+    assert obs.GLOBAL_COUNTERS.get(
+        "spfft_wire_rung", exchange=plan.exchange.value,
+        shards=str(SHARDS), chunks=str(plan.overlap_chunks)) == 3.0
+
+
+# -- controller rule --------------------------------------------------------
+
+def _signals(completed=0, exchange_s=0.0, compute_s=0.0):
+    return {"completed": completed, "failed": 0, "queue_depth": 0,
+            "max_queue_depth": 0, "queue_wait_p95": 0.0,
+            "device_execute_p50": 0.0, "fused_rows": 0,
+            "padded_rows": 0, "fused_hist": {}, "stage_s": 0.0,
+            "dispatch_s": 0.0, "quarantines": 0,
+            "rejected_queue_full": 0, "exchange_s": exchange_s,
+            "exchange_compute_s": compute_s, "latency_p99": 0.0}
+
+
+def test_controller_wire_rung_escalates_on_sustained_exposed_exchange():
+    """Three CONSECUTIVE steps with exchange dominating compute past
+    wire_hi move the rung by ONE; the streak then restarts, so the next
+    rung needs three more steps — deterministic, no oscillation."""
+    cfg = ServeConfig()
+    ctl = Controller(cfg, cooldown_steps=0)
+    up0 = obs.GLOBAL_COUNTERS.get("spfft_wire_rung_changes_total",
+                                  direction="up")
+    ctl.step(_signals(completed=1))                       # baseline
+    ctl.step(_signals(completed=5, exchange_s=0.9, compute_s=0.2))
+    ctl.step(_signals(completed=9, exchange_s=1.8, compute_s=0.4))
+    assert cfg.wire_precision == 0                        # streak < 3
+    d = ctl.step(_signals(completed=12, exchange_s=2.7, compute_s=0.6))
+    moved = [x for x in d if x.knob == "wire_precision"]
+    assert len(moved) == 1 and moved[0].new == 1
+    assert "exposed exchange" in moved[0].reason
+    assert obs.GLOBAL_COUNTERS.get("spfft_wire_rung_changes_total",
+                                   direction="up") == up0 + 1
+    # two more exposed steps: streak restarted, not enough yet
+    ctl.step(_signals(completed=15, exchange_s=3.6, compute_s=0.8))
+    ctl.step(_signals(completed=18, exchange_s=4.5, compute_s=1.0))
+    assert cfg.wire_precision == 1
+    ctl.step(_signals(completed=21, exchange_s=5.4, compute_s=1.2))
+    assert cfg.wire_precision == 2
+    lo, hi = ServeConfig.bounds("wire_precision")
+    assert lo <= cfg.wire_precision <= hi
+
+
+def test_controller_wire_rung_decays_when_exchange_hidden():
+    cfg = ServeConfig()
+    cfg.set("wire_precision", 3, source="test")
+    ctl = Controller(cfg, cooldown_steps=0)
+    down0 = obs.GLOBAL_COUNTERS.get("spfft_wire_rung_changes_total",
+                                    direction="down")
+    ctl.step(_signals(completed=1))
+    # hidden wire: 0.02 / 0.5 = 0.04 < wire_lo -> one rung back per step
+    ctl.step(_signals(completed=5, exchange_s=0.02, compute_s=0.5))
+    assert cfg.wire_precision == 2
+    ctl.step(_signals(completed=9, exchange_s=0.04, compute_s=1.0))
+    assert cfg.wire_precision == 1
+    ctl.step(_signals(completed=12, exchange_s=0.06, compute_s=1.5))
+    assert cfg.wire_precision == 0
+    ctl.step(_signals(completed=15, exchange_s=0.08, compute_s=2.0))
+    assert cfg.wire_precision == 0                        # never below
+    assert obs.GLOBAL_COUNTERS.get("spfft_wire_rung_changes_total",
+                                   direction="down") == down0 + 3
+
+
+def test_controller_wire_rung_no_oscillation_on_alternating_traffic():
+    """Exposed/hidden alternation never ratchets the rung: the up-side
+    needs a 3-streak, the down-side needs rung > default — from the
+    default the knob cannot move at all on mixed traffic."""
+    cfg = ServeConfig()
+    ctl = Controller(cfg, cooldown_steps=0)
+    ctl.step(_signals(completed=1))
+    for i in range(8):
+        if i % 2 == 0:
+            ctl.step(_signals(completed=5 + 3 * i, exchange_s=0.5 * (i + 1),
+                              compute_s=0.1 * (i + 1)))
+        else:
+            ctl.step(_signals(completed=5 + 3 * i))       # local only
+    assert cfg.wire_precision == 0
+    assert not [d for d in ctl.decisions()
+                if d.knob == "wire_precision"]
+
+
+def test_controller_wire_rung_idle_decays_by_one_step():
+    cfg = ServeConfig()
+    cfg.set("wire_precision", 2, source="test")
+    ctl = Controller(cfg, cooldown_steps=0)
+    ctl.step(_signals(completed=5))          # baseline with traffic
+    ctl.step(_signals(completed=5))          # idle
+    assert cfg.wire_precision == 1
+    ctl.step(_signals(completed=5))
+    assert cfg.wire_precision == 0
+    ctl.step(_signals(completed=5))
+    assert cfg.wire_precision == 0           # never undershoots
