@@ -93,7 +93,12 @@ def symmetry_rows() -> dict:
       connect-per-RPC wire and the kept-alive pooled wire;
     * ``spmd_coalesce`` — distributed requests per collective round
       for a concurrent same-signature burst through the pod SPMD
-      coalescer (deterministic scheduler accounting).
+      coalescer (deterministic scheduler accounting);
+    * ``recorder_overhead`` — per-request hot-path cost of the ARMED
+      flight recorder (journal + tail retention) minus the disarmed
+      path, from the deterministic ``obs.recorder.overhead_probe``
+      micro A/B (the disarmed path itself is budgeted at <= 1% of a
+      request in tests/test_recorder.py).
 
     Returns {} (with a stderr note) if the probe subprocess fails —
     the primary measurement must not die on an accounting row.
@@ -258,6 +263,10 @@ def symmetry_inner() -> None:
         lane.close()
     per_round = burst / max(spmd_sig["spmd_launches"], 1)
 
+    # --- recorder_overhead: armed-vs-disarmed hot path micro A/B ---
+    from spfft_tpu.obs.recorder import overhead_probe
+    rec = overhead_probe()
+
     print(json.dumps({
         "wire_bytes_r2c": {
             "metric": f"{n}^3 spherical-cutoff R2C distributed exchange "
@@ -358,6 +367,19 @@ def symmetry_inner() -> None:
                       "the window splinters rounds)",
             "value": round(per_round, 2),
             "unit": "req/round",
+        },
+        "recorder_overhead": {
+            "metric": "flight-recorder armed hot-path cost per "
+                      "request: journal events + trace tail retention "
+                      "with the recorder ON minus the same path "
+                      "disarmed, deterministic synthetic-request "
+                      "micro A/B (obs.recorder.overhead_probe, "
+                      f"{rec['requests']} requests x {rec['repeats']} "
+                      f"repeats, best-of; disarmed path "
+                      f"{rec['off_us']:.2f} us/req, armed "
+                      f"{rec['on_us']:.2f} us/req)",
+            "value": round(rec["delta_us"], 2),
+            "unit": "us",
         },
     }))
 

@@ -90,11 +90,18 @@ LOWER_IS_BETTER_UNITS = ("s", "ms", "us", "ns", "seconds", "bytes",
 #: wire_error_int8 (unit "rel-l2", lower is better) is the measured
 #: end-to-end error of a real 2-shard int8-wire backward vs its rung-0
 #: twin on a seeded adversarial spectrum — growth past threshold means
-#: the quantizer lost accuracy. All emitted by bench.py every run.
+#: the quantizer lost accuracy. recorder_overhead (unit "us", lower is
+#: better, recorded from BENCH_r06.json round 23 on) is the flight
+#: recorder's ARMED per-request hot-path cost — journal + tail
+#: retention minus the disarmed path, from the deterministic
+#: obs.recorder.overhead_probe micro A/B — growth past threshold
+#: means instrumenting the serve pipeline got more expensive (the
+#: disarmed path's <= 1% budget is tier-1's job). All emitted by
+#: bench.py every run.
 SUB_ROWS = ("fused", "cold_start_ms", "warm_start_ms",
             "wire_bytes_r2c", "fused_r2c", "fused_dist", "pod_routing",
             "pod_wire", "pod_wire_pooled", "spmd_coalesce",
-            "wire_bytes_int8", "wire_error_int8")
+            "wire_bytes_int8", "wire_error_int8", "recorder_overhead")
 
 
 def load_payload(path: str) -> dict:

@@ -19,6 +19,9 @@ and annotation syntax):
 * ``fault-sites`` — every fault-injection check names a site declared
   exactly once in ``faults.SITES``, and every declared site is checked
   somewhere (:mod:`.faults_check`);
+* ``event-registry`` — every ``record_event`` kind declared exactly
+  once in ``obs/recorder.py``'s ``EVENT_SPECS``, every declared kind
+  emitted, attrs inside the declared key set (:mod:`.events_check`);
 * ``trace-context`` — every ``# trace: boundary(param)``-annotated
   cluster RPC boundary forwards its propagated trace context, opens
   no context-less span, and is never called without the context bound
@@ -36,8 +39,8 @@ from __future__ import annotations
 import os
 from typing import Dict, List, Optional
 
-from . import (baseline, counters_check, errors_check, faults_check,
-               knobs, locks, spans, trace_check)
+from . import (baseline, counters_check, errors_check, events_check,
+               faults_check, knobs, locks, spans, trace_check)
 from .core import (Finding, PackageIndex, Report, index_package,
                    index_sources)
 
@@ -49,7 +52,7 @@ __all__ = ["Finding", "PackageIndex", "Report", "index_package",
 #: them.
 CHECKERS = ("lock-discipline", "span-closure", "counter-registry",
             "error-taxonomy", "knob-registry", "fault-sites",
-            "trace-context", "baseline-lint")
+            "event-registry", "trace-context", "baseline-lint")
 
 
 def package_root() -> str:
@@ -103,6 +106,10 @@ def run_analysis(root: Optional[str] = None,
     if "fault-sites" in selected:
         findings, extras = faults_check.check(index)
         report.extend("fault-sites", findings)
+        report.extras.update(extras)
+    if "event-registry" in selected:
+        findings, extras = events_check.check(index)
+        report.extend("event-registry", findings)
         report.extras.update(extras)
     if "trace-context" in selected:
         findings, extras = trace_check.check(index)

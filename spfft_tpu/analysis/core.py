@@ -54,8 +54,8 @@ from typing import Dict, Iterable, List, Optional, Set, Tuple
 #: Annotation comment patterns.
 GUARD_RE = re.compile(r"#:\s*guarded by\s+([A-Za-z_][A-Za-z0-9_]*)")
 WAIVE_RE = re.compile(
-    r"#\s*(lock|span|counters|errors|knobs|lint|faults|trace)\s*:\s*"
-    r"waived\(([^)]*)\)")
+    r"#\s*(lock|span|counters|errors|knobs|lint|faults|trace|events)"
+    r"\s*:\s*waived\(([^)]*)\)")
 HOLDS_RE = re.compile(
     r"#\s*lock\s*:\s*holds\(([A-Za-z_][A-Za-z0-9_]*)\)")
 CLOSED_BY_RE = re.compile(r"#\s*span\s*:\s*closed-by\(([^)]+)\)")

@@ -369,6 +369,8 @@ class ServeConfig:
                 "spfft_control_clamped_total", 1,
                 help="Knob writes clamped into their declared bounds.",
                 knob=name)
+        obs.record_event("control.knob", knob=name, old=old,
+                         new=clamped, reason=reason, source=source)
         if obs.active():
             obs.GLOBAL_TRACER.instant(
                 "control.retune", cat="control", track="control",

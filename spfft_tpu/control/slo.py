@@ -248,6 +248,11 @@ class SLOWatchdog:
                     "spfft_slo_window_alerts_total", 1,
                     help="Multi-window page conditions entered.",
                     slo=name)
+                obs.record_event("slo.alert", slo=name)
+                # the rising edge is a flight-recorder auto trigger:
+                # snapshot the black box the moment the page condition
+                # is entered, not when an operator notices
+                obs.maybe_auto_capture("slo_alert", name)
         self._alerting = set(window_alerts)
         if violations:
             obs.GLOBAL_COUNTERS.inc(

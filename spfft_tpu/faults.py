@@ -146,6 +146,9 @@ SITES = (
     "blob.get", "blob.put",
     # lease-based membership + lane resurrection (round 21)
     "net.heartbeat", "cluster.view", "cluster.readmit",
+    # flight recorder: a failing incident-bundle write is typed and
+    # non-fatal (recording must never take down serving)
+    "obs.capture",
 )
 
 #: Substrings of runtime error text treated as transient — the
@@ -296,6 +299,16 @@ def _record(metric: str, **labels) -> None:
     GLOBAL_COUNTERS.inc(metric, **labels)
 
 
+def _journal(site: str, fire: str) -> None:
+    """Best-effort flight-recorder journal entry for a fired fault
+    (same lazy-import discipline as :func:`_record`)."""
+    try:
+        from .obs import record_event
+    except Exception:  # pragma: no cover - circular/partial import
+        return
+    record_event("fault.fired", site=site, kind=fire)
+
+
 class FaultPlan:
     """Deterministic fault-injection oracle, shared package-wide.
 
@@ -372,6 +385,7 @@ class FaultPlan:
                 self._fired_by_site.get(site, 0) + 1
             hang = self._hang_seconds if fire == "hang" else 0.0
         _record("spfft_faults_injected_total", site=site, kind=fire)
+        _journal(site, fire)
         where = site if device is None else f"{site} (device {device})"
         if fire == "enospc":
             raise InjectedDiskFull(f"injected disk-full at {where}")

@@ -266,6 +266,12 @@ class HostAgent:
                          self.executor.registry.stats())}, b"")
         if op == "spans":
             return self._handle_spans()
+        if op == "incident":
+            from ..obs.recorder import build_incident_bundle
+            return ({"type": "incident_ok",
+                     "bundle": _jsonify(build_incident_bundle(
+                         str(header.get("reason", "remote")),
+                         host=self.host))}, b"")
         if op == "drain":
             self.executor.close(drain=True)
             return {"type": "drain_ok"}, b""

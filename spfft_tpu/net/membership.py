@@ -221,6 +221,8 @@ class ViewCoordinator:
         self._epoch += 1
         _obs.GLOBAL_COUNTERS.inc(
             "spfft_membership_transitions_total", host=host, to=to)
+        _obs.record_event("membership.transition", host=host, to=to,
+                          epoch=self._epoch)
         _gauge_epoch(self.host, self._epoch)
 
     @property
@@ -365,6 +367,8 @@ class ViewCoordinator:
                         host, _Member(state, row.get("address"), now))
                 self._epoch = max(self._epoch, seed.epoch)
             self._epoch += 1
+            _obs.record_event("membership.elect", host=self.host,
+                              epoch=self._epoch)
             _gauge_epoch(self.host, self._epoch)
 
     # lock: holds(_lock)

@@ -459,6 +459,14 @@ class TcpHostLane(HostLane):
         return {"spans": list(reply.get("spans", [])),
                 "open": int(reply.get("open", 0))}
 
+    def rpc_incident(self, reason: str) -> dict:
+        """The agent process's in-memory incident bundle — the remote
+        half of a pod-wide flight-recorder capture."""
+        self.transport.check("incident")
+        reply, _ = self.transport.call(
+            {"type": "incident", "reason": str(reason)})
+        return dict(reply.get("bundle") or {})
+
     def rpc_heartbeat(self, host: str,
                       address: Optional[str] = None) -> dict:
         """Renew ``host``'s membership lease with this lane's agent

@@ -46,6 +46,16 @@ from .counters import GLOBAL_COUNTERS, Counters
 from .exporters import (export_trace, parse_prometheus_text,
                         prometheus_text, trace_events)
 from .http import METRICS_PORT_ENV, MetricsServer, port_from_env
+from .recorder import (BUNDLE_VERSION, EVENT_SPECS, GLOBAL_JOURNAL,
+                       build_incident_bundle, capture_incident,
+                       disable_recorder, enable_recorder, flag_trace,
+                       maybe_auto_capture, merge_pod_bundle,
+                       overhead_probe, record_event, recorder_active,
+                       recorder_from_env, recorder_stats,
+                       reset_recorder, retained_traces,
+                       set_health_provider, set_incident_capturer,
+                       set_latency_source, validate_bundle,
+                       write_bundle)
 from .trace import (GLOBAL_TRACER, RequestTrace, Span, TraceContext,
                     Tracer, active, disable, enable, span_context)
 
@@ -60,6 +70,15 @@ __all__ = [
     "record_compile", "record_plan_build", "record_exchange_plan",
     "record_hlo_counts", "record_plan_fallback", "record_store",
     "record_store_aot_skip",
+    # flight recorder (obs.recorder)
+    "EVENT_SPECS", "GLOBAL_JOURNAL", "BUNDLE_VERSION",
+    "record_event", "enable_recorder", "disable_recorder",
+    "recorder_active", "recorder_from_env", "recorder_stats",
+    "reset_recorder", "flag_trace", "retained_traces",
+    "build_incident_bundle", "capture_incident", "write_bundle",
+    "maybe_auto_capture", "merge_pod_bundle", "validate_bundle",
+    "set_health_provider", "set_incident_capturer",
+    "set_latency_source", "overhead_probe",
 ]
 
 

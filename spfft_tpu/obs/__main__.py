@@ -16,6 +16,12 @@ Three subcommands:
 * ``prom [FILE]`` — with a FILE, round-trip it through the validating
   exposition-format parser; without, print the current process's
   :func:`~spfft_tpu.obs.exporters.prometheus_text`.
+* ``incident`` — flight-recorder ops verb: ``--validate FILE``
+  schema-checks a captured bundle; otherwise capture one NOW from
+  this process (``--dir`` overrides the incident directory) and, with
+  repeatable ``--peer [name=]ip:port`` agent addresses, gather every
+  peer's bundle over the wire into one pod bundle — the out-of-band
+  collection path when no pod frontend is running.
 """
 
 from __future__ import annotations
@@ -192,6 +198,70 @@ def _cmd_prom(args) -> int:
     return 0
 
 
+def _cmd_incident(args) -> int:
+    from . import recorder
+    if args.validate:
+        try:
+            with open(args.validate) as f:
+                bundle = json.load(f)
+        except (OSError, json.JSONDecodeError) as exc:
+            print(f"FAIL: {args.validate}: {exc}", file=sys.stderr)
+            return 1
+        failures = recorder.validate_bundle(bundle)
+        for msg in failures:
+            print(f"FAIL: {msg}", file=sys.stderr)
+        if failures:
+            return 1
+        hosts = sorted(bundle.get("hosts") or ())
+        detail = f", hosts: {', '.join(hosts)}" if hosts else ""
+        print(f"ok: {args.validate} ({bundle.get('kind')} bundle, "
+              f"{len(bundle.get('timeline') or bundle.get('events') or ())}"
+              f" events{detail})")
+        return 0
+    if not recorder.recorder_active():
+        recorder.enable_recorder(incident_dir=args.dir, auto=False)
+    reason = args.reason
+    if args.peer:
+        from ..net.transport import TcpHostLane
+        bundles = {args.host: recorder.build_incident_bundle(
+            reason, host=args.host)}
+        for spec in args.peer:
+            name, _, addr = spec.rpartition("=")
+            ip, _, port = addr.rpartition(":")
+            name = name or addr
+            try:
+                lane = TcpHostLane(name, (ip or "127.0.0.1", int(port)))
+            except (OSError, ValueError) as exc:
+                bundles[name] = {"error": f"{type(exc).__name__}: {exc}"}
+                continue
+            try:
+                bundles[name] = lane.rpc_incident(reason)
+            except Exception as exc:
+                bundles[name] = {"error": f"{type(exc).__name__}: {exc}"}
+            finally:
+                close = getattr(lane, "close", None)
+                if close is not None:
+                    close()
+        pod = recorder.merge_pod_bundle(reason, bundles)
+        try:
+            path = recorder.write_bundle(pod, directory=args.dir)
+        except Exception as exc:
+            print(f"FAIL: bundle write failed: {exc}", file=sys.stderr)
+            return 1
+    else:
+        path = recorder.capture_incident(reason, directory=args.dir)
+        if path is None:
+            print("FAIL: incident capture failed (no incident dir? "
+                  "pass --dir)", file=sys.stderr)
+            return 1
+    with open(path) as f:
+        failures = recorder.validate_bundle(json.load(f))
+    for msg in failures:
+        print(f"FAIL: {msg}", file=sys.stderr)
+    print(f"wrote {path}")
+    return 1 if failures else 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m spfft_tpu.obs",
@@ -221,11 +291,30 @@ def main(argv=None) -> int:
                                        "exposition text")
     prom.add_argument("file", nargs="?", default=None)
 
+    inc = sub.add_parser("incident",
+                         help="capture or validate a flight-recorder "
+                              "incident bundle")
+    inc.add_argument("--validate", default=None, metavar="FILE.json",
+                     help="schema-check a captured bundle instead of "
+                          "capturing")
+    inc.add_argument("--dir", default=None,
+                     help="incident directory (default: "
+                          "SPFFT_TPU_INCIDENT_DIR)")
+    inc.add_argument("--reason", default="cli")
+    inc.add_argument("--host", default="local",
+                     help="host label for this process's bundle")
+    inc.add_argument("--peer", action="append", default=[],
+                     metavar="[NAME=]IP:PORT",
+                     help="agent address to gather into a pod bundle "
+                          "(repeatable)")
+
     args = p.parse_args(argv if argv is not None else sys.argv[1:])
     if args.cmd == "demo":
         return _cmd_demo(args)
     if args.cmd == "validate":
         return _cmd_validate(args)
+    if args.cmd == "incident":
+        return _cmd_incident(args)
     return _cmd_prom(args)
 
 

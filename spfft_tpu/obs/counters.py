@@ -280,6 +280,28 @@ METRIC_SPECS: Dict[str, Tuple[str, str]] = {
         ("gauge", "Spans currently open (must be 0 at quiescence)."),
     "spfft_trace_events_dropped_total":
         ("counter", "Events dropped by the bounded ring buffer."),
+    # flight recorder (obs.recorder): journal, tail retention, bundles
+    "spfft_recorder_events_total":
+        ("counter",
+         "Typed events appended to the flight-recorder journal, "
+         "labelled {kind} (every kind declared in EVENT_SPECS)."),
+    "spfft_recorder_events_dropped_total":
+        ("counter",
+         "Journal events dropped (undeclared kind — the analyzer's "
+         "event-registry checker catches these statically too)."),
+    "spfft_recorder_traces_retained_total":
+        ("counter",
+         "Completed traces promoted to the retained ring, labelled "
+         "{reason=error|slow|flagged}."),
+    "spfft_recorder_incidents_total":
+        ("counter",
+         "Incident bundles captured successfully, labelled {trigger} "
+         "(the reason prefix: slo_alert, health_degraded, "
+         "health_failed, lane_death, manual, ...)."),
+    "spfft_recorder_incident_failures_total":
+        ("counter",
+         "Incident bundle captures that failed non-fatally (the "
+         "obs.capture fault site fires here in chaos storms)."),
     # package-wide fault seam (spfft_tpu.faults) + degradation ladders
     "spfft_faults_injected_total":
         ("counter",

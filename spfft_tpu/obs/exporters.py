@@ -33,12 +33,18 @@ from .trace import GLOBAL_TRACER, Span, Tracer
 
 # -- Chrome trace-event JSON ------------------------------------------------
 
-def trace_events(tracer: Optional[Tracer] = None) -> List[dict]:
+def trace_events(tracer: Optional[Tracer] = None,
+                 events: Optional[List] = None,
+                 bare: bool = False) -> List[dict]:
     """The tracer's buffer as a Chrome trace-event list. Tracks map to
     (pid=1, tid) rows with thread_name metadata; timestamps are
-    microseconds since the tracer's epoch."""
+    microseconds since the tracer's epoch. ``events`` substitutes a
+    pre-filtered raw slice of the buffer (the flight recorder converts
+    one retained trace's events this way); ``bare`` omits the process/
+    thread metadata events (sub-lists embedded in a bundle don't
+    re-declare them)."""
     tracer = tracer or GLOBAL_TRACER
-    raw = tracer.events()
+    raw = events if events is not None else tracer.events()
     tracks: Dict[str, int] = {}
 
     def tid(track: Optional[str]) -> int:
@@ -79,6 +85,8 @@ def trace_events(tracer: Optional[Tracer] = None) -> List[dict]:
                            "cat": ev["cat"], "ts": us(ev["ts"]),
                            "pid": 1, "tid": tid(ev.get("track")),
                            "args": ev.get("args") or {}})
+    if bare:
+        return events
     meta = [{"ph": "M", "pid": 1, "name": "process_name",
              "args": {"name": "spfft_tpu"}}]
     for name, t in sorted(tracks.items(), key=lambda kv: kv[1]):

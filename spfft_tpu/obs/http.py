@@ -14,7 +14,13 @@ a stdlib ``ThreadingHTTPServer`` (no new dependencies) that serves
   check works out of the box;
 * ``GET /configz``  — the live control-plane knob values (executor
   required), so an operator can see what the controller has retuned
-  without log archaeology.
+  without log archaeology;
+* ``GET /incidentz`` — trigger a flight-recorder incident capture NOW
+  (``incident_fn`` hook — a pod frontend binds its pod-wide
+  :meth:`~spfft_tpu.serve.cluster.PodFrontend.capture_incident`;
+  otherwise the recorder's local capture) and return the written
+  bundle path as JSON; 503 when the recorder is disarmed or the
+  capture failed.
 
 Opt-in: nothing listens unless a server is started —
 ``serve.bench --metrics-port N`` or the ``SPFFT_TPU_METRICS_PORT``
@@ -71,7 +77,7 @@ class MetricsServer:
 
     def __init__(self, metrics=None, registry=None, executor=None,
                  port: int = 0, host: str = "127.0.0.1",
-                 text_fn=None, health_fn=None):
+                 text_fn=None, health_fn=None, incident_fn=None):
         if executor is not None:
             metrics = metrics if metrics is not None else executor.metrics
             registry = registry if registry is not None \
@@ -80,11 +86,13 @@ class MetricsServer:
         self.registry = registry
         self.executor = executor
         # Aggregation hooks: a pod frontend overrides what /metrics
-        # renders (its merged multi-host exposition) and what /healthz
-        # reports (worst-lane-health-wins) without subclassing the
+        # renders (its merged multi-host exposition), what /healthz
+        # reports (worst-lane-health-wins) and what /incidentz
+        # captures (the pod-wide bundle) without subclassing the
         # handler; None keeps the single-process defaults.
         self.text_fn = text_fn
         self.health_fn = health_fn
+        self.incident_fn = incident_fn
         self.host = host
         self.port = int(port)
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -139,9 +147,28 @@ class MetricsServer:
                             self._send(200, json.dumps(
                                 server.executor.config.snapshot()),
                                 "application/json")
+                    elif path == "/incidentz":
+                        from . import recorder as _recorder
+                        if server.incident_fn is not None:
+                            path_ = server.incident_fn("http")
+                        elif _recorder.recorder_active():
+                            path_ = _recorder.capture_incident("http")
+                        else:
+                            self._send(503, json.dumps(
+                                {"error": "recorder disarmed"}),
+                                "application/json")
+                            return
+                        if path_ is None:
+                            self._send(503, json.dumps(
+                                {"error": "capture failed"}),
+                                "application/json")
+                        else:
+                            self._send(200, json.dumps(
+                                {"path": path_}), "application/json")
                     else:
                         self._send(404, "try /metrics, /healthz, "
-                                        "/configz\n", "text/plain")
+                                        "/configz, /incidentz\n",
+                                   "text/plain")
                 except Exception as exc:  # a broken scrape must not
                     try:                  # kill the handler thread
                         self._send(500, f"{type(exc).__name__}: "

@@ -51,12 +51,36 @@ DEFAULT_BUFFER_EVENTS = 65536
 
 _enabled = os.environ.get(TRACE_ENV) == "1"
 
+#: Flight-recorder overrides (set by obs.recorder, never directly):
+#: ``_force_sample`` bypasses the head sampler so tail retention sees
+#: every request; ``_trace_complete_hook`` is called with
+#: ``(tracer, root_span, status, error)`` as each RequestTrace closes.
+_force_sample = False
+_trace_complete_hook = None
+
 
 def active() -> bool:
     """The one-boolean disabled-path check every instrumentation point
     starts with. Module-global so the executor's hot path pays a read,
     not an attribute chain."""
     return _enabled
+
+
+def force_sampling(on: bool) -> None:
+    """Recorder seam: make :meth:`Tracer.sample` return True for every
+    request while tail retention is armed (head sampling can stay
+    off/low — the recorder needs a tail to retain)."""
+    global _force_sample
+    _force_sample = bool(on)
+
+
+def set_trace_complete_hook(hook) -> None:
+    """Recorder seam: register (or clear, with None) the callable every
+    :meth:`RequestTrace.close` notifies after settling its root span.
+    Exceptions from the hook are swallowed — trace completion is on
+    request-resolution paths and must never fail them."""
+    global _trace_complete_hook
+    _trace_complete_hook = hook
 
 
 def enable() -> None:
@@ -190,6 +214,8 @@ class Tracer:
         """Deterministic rate sampler: returns True for exactly
         ``sample_rate`` of calls (accumulator, no RNG — a replayed
         trace samples the same requests)."""
+        if _force_sample:
+            return True
         with self._lock:
             self._sample_acc += self._sample_rate
             if self._sample_acc >= 1.0 - 1e-12:
@@ -373,9 +399,16 @@ class RequestTrace:
               error: Optional[str] = None) -> None:
         for name in list(self.open):
             self.finish(name, status=status, error=error)
-        if self.root is not None:
-            self.tracer.finish(self.root, status=status, error=error)
+        root = self.root
+        if root is not None:
+            self.tracer.finish(root, status=status, error=error)
             self.root = None
+            hook = _trace_complete_hook
+            if hook is not None:
+                try:
+                    hook(self.tracer, root, status, error)
+                except Exception:  # never fail a resolution path
+                    pass
 
 
 #: Process-global tracer (the exporters' and executor's default).

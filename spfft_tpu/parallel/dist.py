@@ -518,9 +518,15 @@ class DistributedTransformPlan:
             declines.append((WIRE_RUNGS[rung], reason))
             _obs.GLOBAL_COUNTERS.inc("spfft_wire_rung_declined_total",
                                      reason=reason)
+            _obs.record_event("wire.decline", rung=WIRE_RUNGS[rung],
+                              reason=reason)
             rung -= 1
         if rung == 0:
             probe_err = 0.0
+        _obs.record_event("wire.resolve",
+                          requested=WIRE_RUNGS[requested],
+                          resolved=WIRE_RUNGS[rung],
+                          probe_error=float(probe_err))
         self.wire_rung = rung
         self.wire_rung_name = WIRE_RUNGS[rung]
         self.wire_probe_error = float(probe_err)

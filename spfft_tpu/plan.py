@@ -834,6 +834,9 @@ class TransformPlan:
         if probing:
             _obs.GLOBAL_COUNTERS.inc("spfft_fused_reprobes_total",
                                      which=which, outcome="failed")
+        _obs.record_event("fused.demote", which=which,
+                          reason=rec["reason"],
+                          permanent=rec["permanent"])
         logger.warning(
             "spfft_tpu: fused %s kernel failed at runtime (%r) — "
             "demoted to the unfused composition%s", which, exc,
@@ -849,6 +852,8 @@ class TransformPlan:
         from . import obs as _obs
         _obs.GLOBAL_COUNTERS.inc("spfft_fused_reprobes_total",
                                  which=which, outcome="readmitted")
+        _obs.record_event("fused.readmit", which=which,
+                          probes=rec["probes"] if rec else 0)
         logger.info(
             "spfft_tpu: fused %s kernel re-probe succeeded after %d "
             "failed probe(s) — readmitted", which,

@@ -754,8 +754,13 @@ def _run_chaos(args) -> int:
     future resolves (zero hangs), every failure is a TYPED taxonomy
     error, healthy requests are bit-exact vs a clean serial oracle,
     zero unclosed obs spans after quiescence, and the store holds no
-    torn ``.tmp-`` files and verifies clean. Exit code 1 on any
-    violation."""
+    torn ``.tmp-`` files and verifies clean. Phase G then arms the
+    flight recorder over a live 2-host pod and proves the black box
+    under fire: a lane death auto-captures a validating POD bundle
+    holding the fault-site journal events and the typed failure's
+    tail-retained trace, and an armed ``obs.capture`` fault fails the
+    capture path contained (zero torn bundles) before healing. Exit
+    code 1 on any violation."""
     import concurrent.futures as cf
     import os
     import shutil
@@ -1539,18 +1544,146 @@ def _run_chaos(args) -> int:
         "epoch": nodes["m1"].epoch, "states": states}
     spans_closed("phaseF2")
 
+    # -- phase G: flight recorder — auto-captured incident bundles -----
+    # The black box under fire. G1: the recorder armed over a live
+    # 2-host loopback pod — a transient executor fault journals its
+    # firing, a poisoned request's errored trace is tail-retained, and
+    # a lane death auto-captures a POD bundle that must hold all of it
+    # (validating schema, fault-site events, the typed failure's
+    # trace). G2: an armed ``obs.capture`` fault fails the capture
+    # path CONTAINED (None return, counted, zero torn ``.tmp``) and
+    # the next capture heals with both outcomes journalled.
+    subsystem_of["obs.capture"] = "obs"
+    inc_tmp = tempfile.mkdtemp(prefix="spfft-chaos-incident-")
+    obs.reset_recorder()
+    obs.enable_recorder(incident_dir=inc_tmp, min_interval_s=0.0)
+    g_plans = [FaultPlan(script="dispatch@1") for _ in range(2)]
+    lanes_g = []
+    for host, plan_g in zip(("g0", "g1"), g_plans):
+        reg = PlanRegistry(store=False)
+        reg.put(osig, oplan)
+        lanes_g.append((host, ServeExecutor(reg, fault_plan=plan_g)))
+    podg = PodFrontend(lanes_g, seed=seed)
+    try:
+        # transient dispatch faults fire (journalled), requests recover
+        good = [vals() for _ in range(3)]
+        for i, w in enumerate(good):
+            got = np.asarray(
+                podg.submit_backward(osig, w).result(timeout=60))
+            check(np.array_equal(got, np.asarray(oplan.backward(w))),
+                  f"phaseG: request {i} not recovered bit-exact "
+                  f"through the armed dispatch fault")
+        # a poisoned request fails TYPED and its trace is retained
+        try:
+            podg.submit_backward(osig, np.zeros(3)).result(timeout=60)
+            check(False, "phaseG: poisoned request did not fail")
+        except typed:
+            pass
+        except Exception as exc:
+            check(False, f"phaseG: poisoned request failed UNTYPED "
+                         f"{type(exc).__name__}: {exc}")
+        err_traces = [t for t in obs.retained_traces()
+                      if t["reason"] == "error"]
+        check(err_traces,
+              "phaseG: typed failure's trace was not tail-retained")
+        kinds_now = {e["kind"] for e in obs.GLOBAL_JOURNAL.snapshot()}
+        check("fault.fired" in kinds_now,
+              f"phaseG: armed fault firing not journalled "
+              f"({sorted(kinds_now)})")
+        # lane death -> debounce-free auto capture of a POD bundle
+        podg.kill_host("g1")
+        names = [n for n in os.listdir(inc_tmp)
+                 if n.startswith("incident-") and n.endswith(".json")]
+        check(names, "phaseG: lane death auto-captured no bundle")
+        lane_death_bundle = None
+        for nme in sorted(names):
+            with open(os.path.join(inc_tmp, nme)) as f:
+                b = json.load(f)
+            bad = obs.validate_bundle(b)
+            check(not bad, f"phaseG: bundle {nme} invalid: {bad}")
+            if str(b.get("reason", "")).startswith("lane_death"):
+                lane_death_bundle = b
+        check(lane_death_bundle is not None,
+              f"phaseG: no lane_death bundle among {sorted(names)}")
+        if lane_death_bundle is not None:
+            check(lane_death_bundle["kind"] == "pod",
+                  "phaseG: lane-death capture is not a pod bundle")
+            tl_kinds = {e["kind"]
+                        for e in lane_death_bundle["timeline"]}
+            check({"fault.fired", "lane.death"} <= tl_kinds,
+                  f"phaseG: pod timeline missing fault/lane-death "
+                  f"events ({sorted(tl_kinds)})")
+            bundle_errs = [
+                t for sub in lane_death_bundle["hosts"].values()
+                for t in (sub or {}).get("traces", ())
+                if t.get("reason") == "error"]
+            check(any(t["trace_id"] == err_traces[0]["trace_id"]
+                      for t in bundle_errs) if err_traces else False,
+                  "phaseG: typed failure's retained trace missing "
+                  "from the auto-captured bundle")
+        # the pod keeps serving after the capture
+        w = vals()
+        got = np.asarray(
+            podg.submit_backward(osig, w).result(timeout=60))
+        check(np.array_equal(got, np.asarray(oplan.backward(w))),
+              "phaseG: post-capture request diverged on the survivor")
+        # G2: the capture path itself fails CONTAINED under its fault
+        cap_plan = FaultPlan(script="obs.capture@1")
+        faults.arm(cap_plan)
+        check(obs.capture_incident("chaos-g2") is None,
+              "phaseG: faulted capture did not fail contained")
+        faults.disarm()
+        tally(cap_plan)
+        torn = [n for n in os.listdir(inc_tmp) if n.endswith(".tmp")]
+        check(not torn,
+              f"phaseG: faulted capture left torn files: {torn}")
+        # the capture path heals, with BOTH outcomes journalled
+        path_g = obs.capture_incident("chaos-g2")
+        check(path_g is not None, "phaseG: clean capture failed")
+        if path_g is not None:
+            with open(path_g) as f:
+                healed = json.load(f)
+            bad = obs.validate_bundle(healed)
+            check(not bad, f"phaseG: healed bundle invalid: {bad}")
+            cap_events = [e for e in healed["events"]
+                          if e["kind"] == "incident.capture"]
+            outcomes = {e["attrs"]["outcome"].split(":")[0]
+                        for e in cap_events}
+            check({"failed", "written"} <= outcomes,
+                  f"phaseG: capture outcomes not journalled "
+                  f"({sorted(outcomes)})")
+            fired_ev = {e["attrs"]["site"] for e in healed["events"]
+                        if e["kind"] == "fault.fired"}
+            check("obs.capture" in fired_ev,
+                  f"phaseG: obs.capture firing not journalled "
+                  f"({sorted(fired_ev)})")
+        for plan_g in g_plans:
+            tally(plan_g)
+        phases["G_flight_recorder"] = {
+            "bundles": len(names),
+            "retained_error_traces": len(err_traces),
+            "stats": obs.recorder_stats()}
+    finally:
+        faults.disarm()
+        podg.close()
+        for _, ex_g in lanes_g:
+            ex_g.close()
+        obs.disable_recorder()
+        shutil.rmtree(inc_tmp, ignore_errors=True)
+    spans_closed("phaseG")
+
     subsystems = sorted({subsystem_of[s] for s in fired_sites
                          if s in subsystem_of}
                         | ({"kernel"} if "kernel.launch" in fired_sites
                            else set()))
-    check(len(fired_sites) >= 22,
+    check(len(fired_sites) >= 23,
           f"chaos coverage: only {len(fired_sites)} fault sites fired "
           f"({sorted(fired_sites)})")
-    check(len(subsystems) >= 9,
+    check(len(subsystems) >= 10,
           f"chaos coverage: only {len(subsystems)} subsystems hit "
           f"({subsystems})")
-    check({"net", "blob", "membership"} <= set(subsystems),
-          f"chaos coverage: wire subsystems not exercised "
+    check({"net", "blob", "membership", "obs"} <= set(subsystems),
+          f"chaos coverage: wire/recorder subsystems not exercised "
           f"({subsystems})")
 
     ok = not failures
@@ -1565,8 +1698,9 @@ def _run_chaos(args) -> int:
         print(f"FAIL: {msg}", file=sys.stderr)
     result = {
         "metric": f"serve.bench --chaos (5 ladders + {storms} seeded "
-                  f"storms + {wire_storms} wire storms over "
-                  f"{len(fired_sites)} fault sites)",
+                  f"storms + {wire_storms} wire storms + flight-"
+                  f"recorder phase over {len(fired_sites)} fault "
+                  f"sites)",
         "value": 1 if ok else 0,
         "unit": "ok",
         "chaos": True,

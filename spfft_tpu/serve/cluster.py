@@ -101,13 +101,16 @@ _PRIORITIES = ("normal", "high")
 #: settles at ``lane_probe_backoff * 64`` between probes, never more.
 _PROBE_BACKOFF_CAP = 64
 
-#: Metric families that belong to the POD plane (this process's
-#: global counters, many carrying their own ``host`` label): rendered
-#: once at pod level by :meth:`PodFrontend.metrics_text` and skipped
-#: from in-process lanes' expositions — re-labelling them with the
-#: lane's host would collapse distinct series into duplicates.
-_POD_LEVEL_FAMILIES = ("spfft_cluster_", "spfft_membership_",
-                       "spfft_net_")
+#: Metric families that belong to one LANE's executor (per-lane
+#: ``ServeMetrics`` / ``PlanRegistry`` facts): the only families an
+#: IN-PROCESS lane contributes to the federated pod exposition.
+#: Everything else an in-process lane renders — compile, faults, SLO,
+#: store, cluster, membership, recorder, timing, trace — reads this
+#: process's shared globals, which :meth:`PodFrontend.metrics_text`
+#: renders exactly once; re-exporting them per lane duplicated every
+#: process-wide series under per-lane ``host`` labels, with the
+#: surviving copy dependent on lane iteration order.
+_LANE_LEVEL_FAMILIES = ("spfft_serve_", "spfft_registry_")
 
 
 def _membership_module():
@@ -249,6 +252,16 @@ class HostLane:
         observable)."""
         self.transport.check("stats")
         return self.executor.registry.stats()
+
+    def rpc_incident(self, reason: str) -> dict:
+        """This host's flight-recorder incident bundle, built in
+        memory — the caller owns persistence (a pod capture writes
+        ONE file). In-process lanes share the process's journal, so
+        :meth:`PodFrontend.capture_incident` asks only remote lanes;
+        the verb exists here for surface parity with the agent."""
+        self.transport.check("incident")
+        from ..obs.recorder import build_incident_bundle
+        return build_incident_bundle(reason, host=self.host)
 
 
 class _SPMDRequest:
@@ -593,6 +606,13 @@ class PodFrontend:
                 pass  # no agent reachable yet; first submit refetches
         if reconcile:
             self.reconcile()
+        # flight recorder: route auto triggers (SLO page, health
+        # degrade, lane death) through the POD capture, so one rising
+        # edge snapshots every alive host, not just this process
+        from ..obs import recorder as _recorder
+        self._incident_capturer = self.capture_incident
+        _recorder.set_incident_capturer(self._incident_capturer)
+        _recorder.set_health_provider(self.health)
 
     # -- reconciliation -----------------------------------------------------
     def reconcile(self) -> None:
@@ -1001,10 +1021,15 @@ class PodFrontend:
                 self._dead[lane.host] = [0,
                                          time.monotonic() + base * jitter]
         if fresh:
+            _obs.record_event("lane.death", host=lane.host)
             self._membership.evict(lane.host)
             self._count_membership("evicted")
             if not self._remote:
                 self._stamp = self._membership.epoch
+            # a lane death is a flight-recorder auto trigger: the pod
+            # just lost capacity, snapshot the black box while the
+            # failure's trace tail is still in the retained ring
+            _obs.maybe_auto_capture("lane_death", lane.host)
 
     def _probe_backoff(self) -> float:
         from ..control.config import global_config
@@ -1104,10 +1129,13 @@ class PodFrontend:
                 lane.transport.alive = False
             _obs.GLOBAL_COUNTERS.inc("spfft_cluster_probes_total",
                                      host=lane.host, outcome="failed")
+            _obs.record_event("lane.probe", host=lane.host,
+                              outcome="failed")
             self._defer_probe(lane.host, now)
             return "failed"
         _obs.GLOBAL_COUNTERS.inc("spfft_cluster_probes_total",
                                  host=lane.host, outcome="ok")
+        _obs.record_event("lane.probe", host=lane.host, outcome="ok")
         return self._readmit_lane(lane, now, revived)
 
     def _readmit_lane(self, lane: HostLane, now: float,
@@ -1145,6 +1173,7 @@ class PodFrontend:
             self._stamp = self._membership.epoch
         _obs.GLOBAL_COUNTERS.inc("spfft_cluster_readmits_total",
                                  host=lane.host, outcome="readmitted")
+        _obs.record_event("lane.readmit", host=lane.host)
         return "readmitted"
 
     def _defer_probe(self, host: str, now: float) -> None:
@@ -1318,21 +1347,39 @@ class PodFrontend:
                 "lanes": len(self._lanes), "epoch": self._stamp}
 
     def metrics_text(self) -> str:
-        """The pod ``/metrics``: pod-level cluster series (from the
-        frontend's process-global counters) followed by every alive
-        host's full exposition with a ``host`` label merged in —
-        parsed, not concatenated, so the result is one valid exposition
-        document (one HELP/TYPE header per family) a scraper consumes
-        directly."""
+        """The pod ``/metrics``: this process's FULL exposition
+        rendered exactly once (pod-level cluster series plus every
+        process-global family — compile, faults, SLO, recorder,
+        timing, trace — that an in-process lane's own exposition also
+        carries), then every alive host's lane-level families with a
+        ``host`` label merged in — parsed, not concatenated, so the
+        result is one valid exposition document (one HELP/TYPE header
+        per family) a scraper consumes directly.
+
+        The merge is IDEMPOTENT: an in-process lane shares this
+        process's counter registry, so only its per-executor
+        ``spfft_serve_*`` / ``spfft_registry_*`` families federate
+        (anything else it renders is a process-global already emitted
+        above — re-exporting those once per lane double-counted every
+        process-wide series under per-lane ``host`` labels). A remote
+        lane's exposition is its own process's facts and merges whole;
+        families that already carry a ``host`` label (membership, net)
+        keep their own rather than being clobbered with the lane's."""
         self.health()  # refresh the aggregate gauges first
         b = _PromBuilder()
-        snap = _obs.GLOBAL_COUNTERS.snapshot()
-        for name in sorted(snap):
-            if not name.startswith(_POD_LEVEL_FAMILIES):
-                continue
-            fam = snap[name]
-            for key, value in sorted(fam["samples"].items()):
-                b.add(name, fam["type"], fam["help"], value, dict(key))
+        seen = set()
+
+        def _merge(name, value, labels):
+            key = (name, tuple(sorted(labels.items())))
+            if key in seen:
+                return
+            seen.add(key)
+            mtype, help_ = METRIC_SPECS.get(name, ("gauge", name))
+            b.add(name, mtype, help_, value, labels)
+
+        for (name, labels), value in parse_prometheus_text(
+                prometheus_text()).items():
+            _merge(name, value, dict(labels))
         for lane in self._lanes:
             if not lane.alive:
                 continue
@@ -1341,25 +1388,57 @@ class PodFrontend:
             except HostLaneError:
                 self._mark_dead(lane)
                 continue
+            local = lane.executor is not None
             for (name, labels), value in \
                     parse_prometheus_text(text).items():
-                if name.startswith(_POD_LEVEL_FAMILIES) \
-                        and lane.executor is not None:
-                    # Pod-level families only render once, above: an
-                    # IN-PROCESS lane shares this process's counter
-                    # registry, so its exposition already carries them
-                    # (and the membership/net families carry their OWN
-                    # host label — re-labelling them with the lane's
-                    # would collapse distinct series into duplicates).
-                    # A remote lane's (executor is None) are its own
-                    # process's facts and merge host-labelled like
-                    # everything else.
-                    continue
-                mtype, help_ = METRIC_SPECS.get(name, ("gauge", name))
+                if local and not name.startswith(_LANE_LEVEL_FAMILIES):
+                    continue  # an in-process lane's process-globals
                 merged = dict(labels)
-                merged["host"] = lane.host
-                b.add(name, mtype, help_, value, merged)
+                merged.setdefault("host", lane.host)
+                _merge(name, value, merged)
         return b.text()
+
+    def capture_incident(self, reason: str = "manual",
+                         directory: Optional[str] = None
+                         ) -> Optional[str]:
+        """Pod-wide flight-recorder capture: gather every alive
+        REMOTE lane's incident bundle over the wire (in-process lanes
+        share this process's journal, contributed once under the
+        coordinator's host name) and atomically write ONE
+        host-labelled pod bundle with a single merged timeline.
+        Returns the written path, or None on failure (counted,
+        non-fatal). Registered as the recorder's incident capturer on
+        construction, so auto triggers capture the whole pod."""
+        from ..obs import recorder as _recorder
+        local = self._membership.host
+        bundles: Dict[str, dict] = {
+            local: _recorder.build_incident_bundle(reason, host=local)}
+        for lane in self._lanes:
+            if lane.executor is not None or not lane.alive:
+                continue  # in-process lanes share the local bundle
+            try:
+                bundles[lane.host] = lane.rpc_incident(reason)
+            except (HostLaneError, ClusterError) as exc:
+                bundles[lane.host] = {
+                    "error": f"{type(exc).__name__}: {exc}"}
+        pod = _recorder.merge_pod_bundle(reason, bundles)
+        try:
+            pod["health"] = self.health()
+        except (ClusterError, HostLaneError):
+            pass  # a mid-capture lane death must not lose the bundle
+        try:
+            path = _recorder.write_bundle(pod, directory=directory)
+        except Exception as exc:
+            _obs.GLOBAL_COUNTERS.inc(
+                "spfft_recorder_incident_failures_total")
+            _obs.record_event("incident.capture", reason=reason,
+                              outcome=f"failed: {type(exc).__name__}")
+            return None
+        _obs.GLOBAL_COUNTERS.inc("spfft_recorder_incidents_total",
+                                 trigger=reason.split(":", 1)[0])
+        _obs.record_event("incident.capture", reason=reason,
+                          outcome="written")
+        return path
 
     # -- lifecycle ----------------------------------------------------------
     def close(self) -> None:
@@ -1369,6 +1448,11 @@ class PodFrontend:
         if self._closed:
             return
         self._closed = True
+        from ..obs import recorder as _recorder
+        if getattr(_recorder, "_capturer", None) \
+                is self._incident_capturer:
+            _recorder.set_incident_capturer(None)
+            _recorder.set_health_provider(None)
         self._probe_pool.shutdown(wait=True, cancel_futures=True)
         self._spmd.close()
         for lane in self._lanes:

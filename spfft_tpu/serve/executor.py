@@ -1022,6 +1022,8 @@ class ServeExecutor:
                 # already outstanding: skip
         if probed is not None:
             self.metrics.record_probation()
+            _obs.record_event("device.probation", device=probed.index,
+                              backoff_s=probed.backoff)
             if _obs.active():
                 _obs.GLOBAL_TRACER.instant(
                     "serve.probation", track=_dev_track(probed),
@@ -1046,6 +1048,7 @@ class ServeExecutor:
                 readmitted = True
         if readmitted:
             self.metrics.record_readmission()
+            _obs.record_event("device.readmit", device=slot.index)
             if _obs.active():
                 _obs.GLOBAL_TRACER.instant("serve.readmission",
                                            track=_dev_track(slot))
@@ -1089,6 +1092,8 @@ class ServeExecutor:
                 slot.failures = 0
         if quarantined:
             self.metrics.record_quarantine()
+            _obs.record_event("device.quarantine", device=slot.index,
+                              backoff_s=slot.backoff)
             if _obs.active():
                 _obs.GLOBAL_TRACER.instant(
                     "serve.quarantine", track=_dev_track(slot),

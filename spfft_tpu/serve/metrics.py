@@ -256,7 +256,16 @@ class ServeMetrics:
         """The executor pushes its lifecycle state here on transitions:
         ``healthy`` / ``degraded`` / ``draining`` / ``failed``."""
         with self._lock:
+            prev = self._health_state
             self._health_state = state
+        if state != prev:
+            from .. import obs
+            obs.record_event("health.transition", state=state, prev=prev)
+            if state in ("degraded", "failed"):
+                # a downward lifecycle transition is a flight-recorder
+                # auto trigger: capture the black box at the moment the
+                # executor's own health report worsens
+                obs.maybe_auto_capture("health_" + state, state)
 
     def record_batch(self, size: int, fused: bool,
                      padded_rows: int = 0, pinned: bool = False,

@@ -475,6 +475,8 @@ class PlanRegistry:
             flight.exc = exc
             with self._lock:
                 self._build_failures += 1
+            _obs.record_event("registry.build_failure",
+                              error=type(exc).__name__)
             raise
         finally:
             with self._lock:

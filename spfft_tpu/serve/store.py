@@ -608,6 +608,9 @@ class PlanArtifactStore:
             self._reprobe_at = now + self._reprobe_interval
             interval = self._reprobe_interval
         _obs.GLOBAL_COUNTERS.set("spfft_store_degraded", 1.0)
+        _obs.record_event("store.degrade",
+                          reason=f"{type(exc).__name__}: {exc}",
+                          interval_s=interval)
         import logging
         logging.getLogger("spfft_tpu").warning(
             "spfft_tpu: plan-artifact store degraded to memory-only "
@@ -631,6 +634,7 @@ class PlanArtifactStore:
             self._degrade_extend()
             _obs.GLOBAL_COUNTERS.inc("spfft_store_reprobes_total",
                                      outcome="failed")
+            _obs.record_event("store.reprobe", outcome="failed")
             return
         with self._lock:
             self._degraded_reason = None
@@ -639,6 +643,7 @@ class PlanArtifactStore:
         _obs.GLOBAL_COUNTERS.set("spfft_store_degraded", 0.0)
         _obs.GLOBAL_COUNTERS.inc("spfft_store_reprobes_total",
                                  outcome="recovered")
+        _obs.record_event("store.reprobe", outcome="recovered")
         import logging
         logging.getLogger("spfft_tpu").warning(
             "spfft_tpu: plan-artifact store disk re-probe succeeded — "

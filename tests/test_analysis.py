@@ -17,8 +17,8 @@ import sys
 import pytest
 
 from spfft_tpu.analysis import (baseline, counters_check, errors_check,
-                                faults_check, knobs, locks, run_analysis,
-                                spans, trace_check)
+                                events_check, faults_check, knobs, locks,
+                                run_analysis, spans, trace_check)
 from spfft_tpu.analysis.core import index_sources
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -666,7 +666,7 @@ def test_analysis_cli_smoke(tmp_path):
     assert set(payload["checkers"]) == {
         "lock-discipline", "span-closure", "counter-registry",
         "error-taxonomy", "knob-registry", "fault-sites",
-        "trace-context", "baseline-lint"}
+        "event-registry", "trace-context", "baseline-lint"}
     assert payload["waivers"], "the report must list the waivers"
 
 
@@ -842,6 +842,138 @@ SITES = (
     errs = _errors(findings)
     assert any("site grammar" in f.message for f in errs)
     assert any("non-literal entry" in f.message for f in errs)
+
+
+# ---------------------------------------------------------------------------
+# event-registry
+# ---------------------------------------------------------------------------
+
+EVENTS_DECL = '''
+EVENT_SPECS = {
+    "demo.start": ("demo", "Run started.", ("run",)),
+    "demo.stop": ("demo", "Run stopped.", ("run", "outcome")),
+}
+'''
+
+EVENTS_OK = '''
+def emit(obs):
+    obs.record_event("demo.start", run=1)
+    obs.record_event("demo.stop", run=1, outcome="ok")
+'''
+
+
+def test_event_registry_clean():
+    findings, extras = events_check.check(index_sources({
+        "obs/recorder.py": EVENTS_DECL, "serve/x.py": EVENTS_OK}))
+    assert _errors(findings) == []
+    assert extras == {"declared_event_kinds": 2,
+                      "event_emission_sites": 2}
+
+
+def test_event_registry_catches_undeclared_kind():
+    src = EVENTS_OK.replace('"demo.start"', '"demo.stat"')
+    findings, _ = events_check.check(index_sources({
+        "obs/recorder.py": EVENTS_DECL, "serve/x.py": src}))
+    errs = _errors(findings)
+    assert any("demo.stat" in f.message and "not declared" in f.message
+               for f in errs)
+    # the typo also orphans the declared kind
+    assert any("demo.start" in f.message
+               and "never emitted" in f.message for f in errs)
+
+
+def test_event_registry_catches_never_emitted_kind():
+    src = EVENTS_OK.replace(
+        '    obs.record_event("demo.stop", run=1, outcome="ok")\n', "")
+    findings, _ = events_check.check(index_sources({
+        "obs/recorder.py": EVENTS_DECL, "serve/x.py": src}))
+    errs = _errors(findings)
+    assert any("demo.stop" in f.message and "never emitted" in f.message
+               for f in errs)
+
+
+def test_event_registry_catches_undeclared_attr():
+    src = EVENTS_OK.replace("outcome=\"ok\"", "result=\"ok\"")
+    findings, _ = events_check.check(index_sources({
+        "obs/recorder.py": EVENTS_DECL, "serve/x.py": src}))
+    errs = _errors(findings)
+    assert any("'result'" in f.message
+               and "undeclared attr" in f.message for f in errs)
+
+
+def test_event_registry_catches_duplicate_declaration():
+    dup = EVENTS_DECL.replace(
+        '    "demo.stop": ("demo", "Run stopped.", ("run", "outcome")),',
+        '    "demo.stop": ("demo", "Run stopped.", ("run", "outcome")),\n'
+        '    "demo.start": ("demo", "Again.", ("run",)),')
+    findings, _ = events_check.check(index_sources({
+        "obs/recorder.py": dup, "serve/x.py": EVENTS_OK}))
+    errs = _errors(findings)
+    assert any("more than once" in f.message for f in errs)
+
+
+def test_event_registry_catches_malformed_spec_and_kind_grammar():
+    bad = '''
+EVENT_SPECS = {
+    "Demo.Start": ("demo", "Bad case.", ("run",)),
+    "demo.loose": ("demo", "No attrs tuple."),
+}
+
+def emit(obs):
+    obs.record_event("Demo.Start", run=1)
+    obs.record_event("demo.loose")
+'''
+    findings, _ = events_check.check(index_sources({
+        "obs/recorder.py": bad}))
+    errs = _errors(findings)
+    assert any("dotted lowercase" in f.message for f in errs)
+    assert any("demo.loose" in f.message
+               and "literal (category, help, (attr, ...))" in f.message
+               for f in errs)
+
+
+def test_event_registry_positional_attrs_are_an_error():
+    src = EVENTS_OK.replace('obs.record_event("demo.start", run=1)',
+                            'obs.record_event("demo.start", 1)')
+    findings, _ = events_check.check(index_sources({
+        "obs/recorder.py": EVENTS_DECL, "serve/x.py": src}))
+    errs = _errors(findings)
+    assert any("one positional arg" in f.message for f in errs)
+
+
+def test_event_registry_waiver_is_listed_not_failed():
+    src = EVENTS_OK.replace(
+        'obs.record_event("demo.start", run=1)',
+        'obs.record_event("demo.probe")'
+        '  # events: waived(staging: declared next round)')
+    findings, _ = events_check.check(index_sources({
+        "obs/recorder.py": EVENTS_DECL, "serve/x.py": src}))
+    waived = [f for f in findings if f.waived]
+    assert any("demo.probe" in f.message for f in waived)
+    assert not [f for f in _errors(findings)
+                if "demo.probe" in f.message]
+
+
+def test_event_registry_variable_kind_is_a_warning():
+    src = '''
+def emit(obs, kind):
+    obs.record_event(kind, run=1)
+    obs.record_event("demo.start", run=1)
+    obs.record_event("demo.stop", run=1, outcome="ok")
+'''
+    findings, _ = events_check.check(index_sources({
+        "obs/recorder.py": EVENTS_DECL, "serve/x.py": src}))
+    assert _errors(findings) == []
+    warns = [f for f in findings if f.severity == "warning"]
+    assert any("non-literal kind" in f.message for f in warns)
+
+
+def test_event_registry_missing_registry_is_an_error():
+    findings, extras = events_check.check(index_sources({
+        "serve/x.py": EVENTS_OK}))
+    errs = _errors(findings)
+    assert any("no EVENT_SPECS declaration" in f.message for f in errs)
+    assert extras == {}
 
 
 # ---------------------------------------------------------------------------

@@ -221,6 +221,38 @@ def test_merged_metrics_parse_and_health(pod_plans):
         _close_all(pod)
 
 
+def test_merged_metrics_no_duplicate_series(pod_plans):
+    """Regression: with >= 2 IN-PROCESS lanes, each process-global
+    series (trace/timing/cluster families) must appear EXACTLY once in
+    the federated document — the pre-fix merge emitted every lane's
+    copy of the process globals, so the text carried duplicate samples
+    whose effective value depended on lane iteration order. Lane-level
+    serve/registry families still appear once PER HOST."""
+    p = pod_plans
+    rng = np.random.default_rng(7)
+    pod = _make_pod(p)
+    try:
+        for _ in range(4):
+            pod.submit_backward(p["sig"],
+                                _values(p, rng)).result(timeout=60)
+        text = pod.metrics_text()
+        samples = [ln.split("{")[0].split(" ")[0]
+                   + (("{" + ln.split("{", 1)[1].rsplit("}", 1)[0]
+                       + "}") if "{" in ln else "")
+                   for ln in text.splitlines()
+                   if ln and not ln.startswith("#")]
+        dupes = {s for s in samples if samples.count(s) > 1}
+        assert not dupes, f"duplicate series in pod exposition: " \
+                          f"{sorted(dupes)[:5]}"
+        parsed = obs.parse_prometheus_text(text)
+        # per-host lane families survive the merge, host-labelled
+        hosts = {dict(labels).get("host") for (name, labels) in parsed
+                 if name == "spfft_serve_completed_total"}
+        assert {"h0", "h1"} <= hosts
+    finally:
+        _close_all(pod)
+
+
 # -- reconciliation -----------------------------------------------------------
 def test_reconciliation_rejects_differing_plan_sets(pod_plans):
     p = pod_plans

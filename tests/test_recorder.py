@@ -1,0 +1,546 @@
+"""Black-box flight recorder (spfft_tpu/obs/recorder.py): the tier-1
+twin of chaos phase G.
+
+The contracts under test (docs/observability.md "Flight recorder &
+incidents"):
+
+* the structured event journal records DECLARED kinds with their
+  declared attrs, drops undeclared kinds/attrs counted-not-raised,
+  and stays bounded (ring capacity, dropped counter);
+* tail-based retention promotes errored / explicitly-flagged /
+  p99-slow traces into the retained ring with head sampling OFF
+  (enabling the recorder forces span recording so there is a tail);
+* incident bundles are versioned, self-contained, atomically written
+  (a faulted write leaves NO torn file), GC'd to ``keep``, and
+  round-trip the schema validator; pod bundles merge host bundles
+  into one host-labelled timestamp-ordered timeline and tolerate
+  unreachable-host error stubs;
+* the deterministic full loop: with head-sampling off, a seeded fault
+  storm on a live 2-host pod (loopback + real TCP agent) auto-captures
+  a pod bundle holding the errored request's tail-retained trace (one
+  trace id across the socket), the fault-site firing, the lane-death
+  and controller events in timestamp order — zero torn files, zero
+  unclosed spans;
+* ``/incidentz`` on the MetricsServer and ``python -m spfft_tpu.obs
+  incident`` surface capture + validation;
+* the recorder-DISARMED hot path stays within its <= 1% budget
+  (``overhead_probe``'s off leg is a module-global read per
+  checkpoint).
+"""
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from spfft_tpu import faults, obs
+from spfft_tpu.benchmark import cutoff_stick_triplets
+from spfft_tpu.control.config import global_config
+from spfft_tpu.errors import GenericError
+from spfft_tpu.faults import FaultPlan
+from spfft_tpu.net.agent import HostAgent
+from spfft_tpu.net.transport import TcpHostLane
+from spfft_tpu.obs import recorder
+from spfft_tpu.obs.http import MetricsServer
+from spfft_tpu.obs.recorder import EventJournal
+from spfft_tpu.obs.trace import RequestTrace
+from spfft_tpu.serve.cluster import PodFrontend
+from spfft_tpu.serve.executor import ServeExecutor
+from spfft_tpu.serve.metrics import ServeMetrics
+from spfft_tpu.serve.registry import PlanRegistry
+from spfft_tpu.types import TransformType
+
+N = 8
+DIMS = (N, N, N)
+
+
+@pytest.fixture(autouse=True)
+def recorder_isolation():
+    """Every test starts and ends with the recorder disarmed and the
+    journal + rings empty (the journal is process-global and always
+    on — other test files' events must not leak in)."""
+    obs.disable_recorder()
+    recorder.reset_recorder()
+    yield
+    faults.disarm()
+    obs.disable_recorder()
+    recorder.reset_recorder()
+    obs.GLOBAL_TRACER.set_sample_rate(1.0)
+    obs.disable()
+
+
+@pytest.fixture(scope="module")
+def plans():
+    trip = cutoff_stick_triplets(N, N, N, 0.9, hermitian=False)
+    reg = PlanRegistry()
+    sig, plan = reg.get_or_build(TransformType.C2C, *DIMS, trip,
+                                 precision="double")
+    return {"trip": trip, "sig": sig, "plan": plan}
+
+
+def _values(p, rng):
+    n = len(p["trip"])
+    return rng.standard_normal(n) + 1j * rng.standard_normal(n)
+
+
+# -- event journal ----------------------------------------------------------
+
+def test_journal_records_declared_event():
+    before = obs.GLOBAL_JOURNAL.stats()["seq"]
+    obs.record_event("lane.death", host="h9")
+    events = obs.GLOBAL_JOURNAL.snapshot()
+    assert events[-1]["kind"] == "lane.death"
+    assert events[-1]["cat"] == "cluster"
+    assert events[-1]["attrs"] == {"host": "h9"}
+    assert events[-1]["seq"] == before + 1
+    assert isinstance(events[-1]["ts"], float)
+
+
+def test_journal_drops_undeclared_kind_counted():
+    dropped0 = obs.GLOBAL_COUNTERS.get(
+        "spfft_recorder_events_dropped_total", reason="undeclared_kind")
+    obs.record_event("nope.bogus", foo=1)
+    assert all(e["kind"] != "nope.bogus"
+               for e in obs.GLOBAL_JOURNAL.snapshot())
+    assert obs.GLOBAL_COUNTERS.get(
+        "spfft_recorder_events_dropped_total",
+        reason="undeclared_kind") == dropped0 + 1
+
+
+def test_journal_filters_undeclared_attrs_and_sanitises():
+    obs.record_event("device.quarantine", device=np.int64(3),
+                     backoff_s=1.5, bogus_attr="dropped")
+    ev = obs.GLOBAL_JOURNAL.snapshot()[-1]
+    assert ev["attrs"] == {"device": 3, "backoff_s": 1.5}
+    assert isinstance(ev["attrs"]["device"], int)  # JSON-safe
+    json.dumps(ev)  # the whole entry is JSON-clean
+
+
+def test_journal_ring_bounded():
+    j = EventJournal(capacity=16)
+    for i in range(40):
+        j.record("lane.probe", {"host": f"h{i}", "outcome": "ok"})
+    st = j.stats()
+    assert st["buffered"] == 16 and st["capacity"] == 16
+    assert st["seq"] == 40 and st["dropped"] == 24
+    hosts = [e["attrs"]["host"] for e in j.snapshot()]
+    assert hosts == [f"h{i}" for i in range(24, 40)]  # oldest evicted
+    assert len(j.snapshot(limit=4)) == 4
+
+
+def test_event_specs_all_well_formed():
+    """Runtime mirror of the event-registry analyzer: dotted lowercase
+    kinds, (category, help, attrs) literals."""
+    import re
+    for kind, spec in obs.EVENT_SPECS.items():
+        assert re.match(r"^[a-z][a-z0-9_]*\.[a-z][a-z0-9_]*$", kind)
+        cat, help_, attrs = spec
+        assert re.match(r"^[a-z][a-z0-9_]*$", cat)
+        assert help_ and isinstance(help_, str)
+        assert all(isinstance(a, str) for a in attrs)
+
+
+# -- tail retention ---------------------------------------------------------
+
+def _traced_request(status="ok", error=None, stages=("serve.stage",)):
+    tr = RequestTrace(obs.GLOBAL_TRACER, "t0")
+    for s in stages:
+        tr.begin(s)
+        tr.finish(s)
+    tid = tr.trace_id
+    tr.close(status=status, error=error)
+    return tid
+
+
+def test_errored_trace_promoted_ok_trace_held(plans):
+    obs.enable()
+    obs.GLOBAL_TRACER.reset()
+    obs.GLOBAL_TRACER.set_sample_rate(0.0)  # head sampling OFF
+    obs.enable_recorder(auto=False)
+    ok_tid = _traced_request()
+    err_tid = _traced_request(status="error", error="InjectedFault")
+    retained = obs.retained_traces()
+    assert [t["trace_id"] for t in retained] == [err_tid]
+    assert retained[0]["reason"] == "error"
+    assert retained[0]["status"] == "error"
+    # the promoted entry carries the trace's Chrome-format events,
+    # recorded despite the 0.0 head sample rate (forced sampling)
+    names = {e["name"] for e in retained[0]["events"]}
+    assert {"serve.request", "serve.stage"} <= names
+    stats = recorder.recorder_stats()
+    assert stats["holding"] == 2 and stats["retained"] == 1
+    assert ok_tid != err_tid
+
+
+def test_flag_trace_promotes_held_trace():
+    obs.enable()
+    obs.GLOBAL_TRACER.reset()
+    obs.enable_recorder(auto=False)
+    tid = _traced_request()
+    assert obs.retained_traces() == []
+    assert obs.flag_trace(tid, reason="operator")
+    retained = obs.retained_traces()
+    assert retained[0]["trace_id"] == tid
+    assert retained[0]["reason"] == "flagged" or \
+        retained[0]["reason"] == "operator"
+
+
+def test_slow_trace_promoted_against_latency_source():
+    obs.enable()
+    obs.GLOBAL_TRACER.reset()
+    obs.enable_recorder(auto=False)
+    recorder.set_latency_source(lambda: 0.001)  # p99 = 1 ms
+    try:
+        tr = RequestTrace(obs.GLOBAL_TRACER, "t0")
+        time.sleep(0.02)  # >> 3 x p99
+        tr.close()
+        retained = obs.retained_traces()
+        assert retained and retained[-1]["reason"] == "slow"
+    finally:
+        recorder.set_latency_source(None)
+
+
+def test_disarmed_recorder_retains_nothing():
+    obs.enable()
+    obs.GLOBAL_TRACER.reset()
+    _traced_request(status="error", error="boom")
+    assert obs.retained_traces() == []
+    assert recorder.recorder_stats()["active"] is False
+
+
+# -- incident bundles -------------------------------------------------------
+
+def test_bundle_builds_and_validates(tmp_path):
+    obs.enable_recorder(incident_dir=str(tmp_path), auto=False)
+    obs.record_event("health.transition", state="degraded",
+                     prev="healthy")
+    bundle = obs.build_incident_bundle("unit", host="me")
+    assert obs.validate_bundle(bundle) == []
+    assert bundle["kind"] == "host" and bundle["host"] == "me"
+    assert any(e["kind"] == "health.transition"
+               for e in bundle["events"])
+    assert "spfft_recorder_events_total" in bundle["prometheus"]
+    assert "knobs" in bundle["config"]
+    json.dumps(bundle)  # self-contained and JSON-clean
+
+
+def test_capture_writes_atomically_and_gcs(tmp_path):
+    obs.enable_recorder(incident_dir=str(tmp_path), keep=2,
+                        auto=False)
+    paths = [obs.capture_incident(f"unit-{i}") for i in range(4)]
+    assert all(p is not None for p in paths)
+    left = sorted(os.listdir(tmp_path))
+    assert len(left) == 2  # GC'd down to keep
+    assert all(n.startswith("incident-") and n.endswith(".json")
+               for n in left)
+    for n in left:
+        with open(tmp_path / n) as f:
+            assert obs.validate_bundle(json.load(f)) == []
+
+
+def test_faulted_capture_contained_no_torn_file(tmp_path):
+    obs.enable_recorder(incident_dir=str(tmp_path), auto=False)
+    fails0 = obs.GLOBAL_COUNTERS.get(
+        "spfft_recorder_incident_failures_total")
+    faults.arm(FaultPlan(script="obs.capture@1"))
+    try:
+        assert obs.capture_incident("doomed") is None
+    finally:
+        faults.disarm()
+    assert obs.GLOBAL_COUNTERS.get(
+        "spfft_recorder_incident_failures_total") == fails0 + 1
+    assert not any(n.endswith(".tmp") for n in os.listdir(tmp_path))
+    # both the failure and the post-disarm success are journalled
+    assert obs.capture_incident("healed") is not None
+    outcomes = [e["attrs"]["outcome"]
+                for e in obs.GLOBAL_JOURNAL.snapshot()
+                if e["kind"] == "incident.capture"]
+    assert any(o.startswith("failed") for o in outcomes)
+    assert "written" in outcomes
+
+
+def test_capture_without_dir_is_contained(tmp_path, monkeypatch):
+    monkeypatch.delenv(recorder.INCIDENT_DIR_ENV, raising=False)
+    obs.enable_recorder(auto=False)
+    assert obs.capture_incident("nowhere") is None
+
+
+def test_auto_capture_debounce_and_disarm(tmp_path):
+    obs.enable_recorder(incident_dir=str(tmp_path),
+                        min_interval_s=3600.0)
+    assert obs.maybe_auto_capture("health_degraded") is not None
+    # inside the debounce window: dropped
+    assert obs.maybe_auto_capture("health_degraded") is None
+    assert len(os.listdir(tmp_path)) == 1
+    obs.disable_recorder()
+    assert obs.maybe_auto_capture("health_degraded") is None
+
+
+def test_health_transition_auto_triggers_capture(tmp_path):
+    obs.enable_recorder(incident_dir=str(tmp_path),
+                        min_interval_s=0.0)
+    m = ServeMetrics()
+    m.record_health("degraded")
+    names = os.listdir(tmp_path)
+    assert len(names) == 1
+    with open(tmp_path / names[0]) as f:
+        bundle = json.load(f)
+    assert bundle["reason"].startswith("health_degraded")
+    assert any(e["kind"] == "health.transition"
+               and e["attrs"]["state"] == "degraded"
+               for e in bundle["events"])
+    # same-state is NOT a rising edge: no second capture
+    m.record_health("degraded")
+    assert len(os.listdir(tmp_path)) == 1
+
+
+def test_merge_pod_bundle_timeline_and_stub_tolerance(tmp_path):
+    obs.enable_recorder(incident_dir=str(tmp_path), auto=False)
+    obs.record_event("lane.death", host="h1")
+    a = obs.build_incident_bundle("unit", host="a")
+    recorder.reset_recorder()
+    obs.record_event("membership.elect", host="b", epoch=2)
+    b = obs.build_incident_bundle("unit", host="b")
+    pod = obs.merge_pod_bundle("unit", {
+        "a": a, "b": b,
+        "c": {"error": "HostLaneError: unreachable"}})
+    assert obs.validate_bundle(pod) == []
+    assert pod["kind"] == "pod"
+    assert set(pod["hosts"]) == {"a", "b", "c"}
+    tl = pod["timeline"]
+    assert all(e["host"] in ("a", "b") for e in tl)
+    assert [e["ts"] for e in tl] == sorted(e["ts"] for e in tl)
+    kinds = {e["kind"] for e in tl}
+    assert {"lane.death", "membership.elect"} <= kinds
+
+
+def test_validator_rejects_malformed_bundles():
+    assert obs.validate_bundle([]) == ["bundle is not a JSON object"]
+    bad = obs.validate_bundle({"version": 99, "kind": "blob"})
+    assert any("version" in m for m in bad)
+    assert any("kind" in m for m in bad)
+    good = obs.build_incident_bundle("unit")
+    broken = dict(good)
+    broken["events"] = "not-a-list"
+    assert obs.validate_bundle(broken)
+
+
+# -- overhead ---------------------------------------------------------------
+
+def test_overhead_probe_disabled_path_budget():
+    """The recorder-OFF leg is one module-global read per checkpoint:
+    sub-microsecond per request on any machine — far inside the
+    round-10 <= 1% budget against the >= 100 us serve hot path the
+    recorder_overhead bench row gates the armed leg against."""
+    probe = obs.overhead_probe(requests=500, repeats=3)
+    assert set(probe) >= {"off_us", "on_us", "delta_us"}
+    assert probe["off_us"] < 1.0  # 1% of a 100 us request
+    assert probe["delta_us"] >= 0.0
+    assert probe["on_us"] >= probe["off_us"]
+    # the probe restores the disarmed state it measured
+    assert not recorder.recorder_active()
+    assert obs.retained_traces() == []
+
+
+# -- the full loop: pod incident on a live 2-host pod -----------------------
+
+def test_pod_incident_full_loop(plans, tmp_path):
+    """ISSUE 20's acceptance loop: head-sampling OFF, a seeded fault
+    storm on a live 2-host pod (loopback + REAL TCP agent), a typed
+    failure whose trace is tail-retained end-to-end (one trace id
+    across the socket), a lane death auto-capturing a pod bundle whose
+    timeline holds the fault-site firing, the lane-death and the
+    controller events in timestamp order — validating, with zero torn
+    files and zero unclosed spans."""
+    p = plans
+    rng = np.random.default_rng(20)
+    obs.enable()
+    obs.GLOBAL_TRACER.reset()
+    obs.GLOBAL_TRACER.set_sample_rate(0.0)  # head sampling OFF
+    obs.enable_recorder(incident_dir=str(tmp_path), min_interval_s=0.0)
+
+    regs = []
+    for _ in range(2):
+        reg = PlanRegistry()
+        reg.put(p["sig"], p["plan"])
+        regs.append(reg)
+    # the seeded storm: a transient dispatch fault on each lane fires
+    # (journalled via fault.fired) and recovers
+    g_plans = [FaultPlan(script="dispatch@1") for _ in range(2)]
+    loop_ex = ServeExecutor(regs[0], fault_plan=g_plans[0])
+    tcp_ex = ServeExecutor(regs[1], fault_plan=g_plans[1])
+    agent = HostAgent("r1", tcp_ex).start()
+    lane = TcpHostLane("r1", ("127.0.0.1", agent.port))
+    pod = PodFrontend([("r0", loop_ex), lane], policy="rr", seed=0)
+    cfg = global_config()
+    old_batch = cfg.max_batch
+    try:
+        # a controller event lands in the journal
+        cfg.set("max_batch", max(2, old_batch - 1), source="test",
+                reason="incident-test controller event")
+        for _ in range(4):  # rr: both lanes serve, both faults fire
+            v = _values(p, rng)
+            got = np.asarray(pod.submit_backward(p["sig"], v)
+                             .result(timeout=120))
+            assert np.array_equal(got,
+                                  np.asarray(p["plan"].backward(v)))
+        # the poisoned request fails TYPED; its trace is the tail
+        with pytest.raises(GenericError):
+            pod.submit_backward(p["sig"],
+                                np.zeros(3)).result(timeout=120)
+        err = [t for t in obs.retained_traces()
+               if t["reason"] == "error"]
+        assert err, "typed failure's trace was not tail-retained"
+        # end-to-end under ONE trace id: the retained entry holds the
+        # frontend's cluster.request root AND the lane-side
+        # serve.request span, all recorded despite the 0.0 head sample
+        # rate (the armed recorder forces span recording)
+        names = {e["name"] for e in err[0]["events"]}
+        assert {"cluster.request", "serve.request"} <= names
+        # and the id crosses the REAL socket: the TCP agent's
+        # serve.request spans carry frontend root ids
+        roots = {s.trace_id for s in obs.GLOBAL_TRACER.events()
+                 if isinstance(s, obs.Span)
+                 and s.name == "cluster.request"}
+        served = [s for s in lane.rpc_spans()["spans"]
+                  if s["name"] == "serve.request"]
+        assert served and all(s["trace_id"] in roots for s in served)
+        # lane death: the auto trigger captures a POD bundle
+        pod.kill_host("r1")
+        bundles = [n for n in os.listdir(tmp_path)
+                   if n.startswith("incident-")
+                   and n.endswith(".json")]
+        assert bundles, "lane death auto-captured nothing"
+        lane_death = None
+        for n in sorted(bundles):
+            with open(tmp_path / n) as f:
+                b = json.load(f)
+            assert obs.validate_bundle(b) == [], n
+            if str(b.get("reason", "")).startswith("lane_death"):
+                lane_death = b
+        assert lane_death is not None
+        assert lane_death["kind"] == "pod"
+        tl = lane_death["timeline"]
+        kinds = {e["kind"] for e in tl}
+        assert {"control.knob", "fault.fired", "lane.death"} <= kinds
+        assert [e["ts"] for e in tl] == sorted(e["ts"] for e in tl)
+        dead = [e for e in tl if e["kind"] == "lane.death"]
+        assert dead[-1]["attrs"]["host"] == "r1"
+        fired = [e for e in tl if e["kind"] == "fault.fired"]
+        assert any(e["attrs"]["site"] == "dispatch" for e in fired)
+        # the errored request's retained trace rode into the bundle
+        bundle_traces = [t for sub in lane_death["hosts"].values()
+                         if isinstance(sub, dict)
+                         for t in sub.get("traces", ())]
+        assert any(t["trace_id"] == err[0]["trace_id"]
+                   and t["reason"] == "error" for t in bundle_traces)
+        # zero torn files, zero unclosed spans, survivor serves on
+        assert not any(n.endswith(".tmp")
+                       for n in os.listdir(tmp_path))
+        assert obs.GLOBAL_TRACER.open_count() == 0
+        v = _values(p, rng)
+        got = np.asarray(pod.submit_backward(p["sig"], v)
+                         .result(timeout=120))
+        assert np.array_equal(got, np.asarray(p["plan"].backward(v)))
+    finally:
+        cfg.set("max_batch", old_batch, source="test",
+                reason="restore after incident test")
+        pod.close()
+        lane.close()
+        agent.close()
+        tcp_ex.close(drain=False)
+        loop_ex.close(drain=False)
+
+
+def test_pod_capture_gathers_remote_host_over_the_wire(plans,
+                                                       tmp_path):
+    """PodFrontend.capture_incident pulls the ALIVE remote lane's
+    bundle through the new ``incident`` ops verb and labels it by
+    host in the merged pod bundle."""
+    p = plans
+    obs.enable_recorder(incident_dir=str(tmp_path), auto=False)
+    reg0, reg1 = PlanRegistry(), PlanRegistry()
+    reg0.put(p["sig"], p["plan"])
+    reg1.put(p["sig"], p["plan"])
+    loop_ex = ServeExecutor(reg0)
+    tcp_ex = ServeExecutor(reg1)
+    agent = HostAgent("w1", tcp_ex).start()
+    lane = TcpHostLane("w1", ("127.0.0.1", agent.port))
+    pod = PodFrontend([("w0", loop_ex), lane], policy="rr", seed=0)
+    try:
+        path = pod.capture_incident("manual")
+        assert path is not None
+        with open(path) as f:
+            bundle = json.load(f)
+        assert obs.validate_bundle(bundle) == []
+        assert bundle["kind"] == "pod"
+        assert "w1" in bundle["hosts"]  # gathered over real TCP
+        assert bundle["hosts"]["w1"]["kind"] == "host"
+        # the lane's own rpc surface answers too
+        direct = lane.rpc_incident("direct")
+        assert direct["kind"] == "host"
+        assert direct["reason"] == "direct"
+    finally:
+        pod.close()
+        lane.close()
+        agent.close()
+        tcp_ex.close(drain=False)
+        loop_ex.close(drain=False)
+
+
+# -- surfaces: /incidentz + CLI ---------------------------------------------
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.status, resp.read().decode()
+
+
+def test_incidentz_route(tmp_path):
+    obs.enable_recorder(incident_dir=str(tmp_path), auto=False)
+    with MetricsServer(metrics=ServeMetrics(), port=0) as srv:
+        status, body = _get(f"{srv.url}/incidentz")
+        assert status == 200
+        path = json.loads(body)["path"]
+        with open(path) as f:
+            assert obs.validate_bundle(json.load(f)) == []
+    obs.disable_recorder()
+    with MetricsServer(metrics=ServeMetrics(), port=0) as srv:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(f"{srv.url}/incidentz")
+        assert err.value.code == 503
+
+
+def test_incidentz_prefers_registered_capturer(tmp_path):
+    obs.enable_recorder(incident_dir=str(tmp_path), auto=False)
+    calls = []
+
+    def capture(reason):
+        calls.append(reason)
+        return obs.capture_incident(reason)
+
+    with MetricsServer(metrics=ServeMetrics(), port=0,
+                       incident_fn=capture) as srv:
+        status, body = _get(f"{srv.url}/incidentz")
+        assert status == 200
+    assert calls == ["http"]
+
+
+def test_cli_incident_capture_and_validate(tmp_path, capsys):
+    from spfft_tpu.obs.__main__ import main
+    rc = main(["incident", "--dir", str(tmp_path),
+               "--reason", "cli-test"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    path = out.strip().split()[-1]
+    assert os.path.dirname(path) == str(tmp_path)
+    rc = main(["incident", "--validate", path])
+    assert rc == 0
+    assert "ok:" in capsys.readouterr().out
+    # a malformed file fails validation with exit 1
+    bad = tmp_path / "broken.json"
+    bad.write_text("{\"version\": 99}")
+    assert main(["incident", "--validate", str(bad)]) == 1

@@ -320,6 +320,8 @@ def test_serve_bench_chaos_harness(capsys):
     assert "A_fused_demotion" in payload["phases"]
     assert "B_enospc_memory_only" in payload["phases"]
     assert "C_execute_watchdog" in payload["phases"]
+    assert "G_flight_recorder" in payload["phases"]
+    assert payload["phases"]["G_flight_recorder"]["bundles"] >= 1
     # the coverage floor the harness itself enforces, restated here so
     # a silent scope regression fails the tier-1 suite too
     assert len(payload["fired_sites"]) >= 8

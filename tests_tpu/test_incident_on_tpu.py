@@ -1,0 +1,97 @@
+"""On-TPU flight-recorder twin (make ci-tpu): the incident loop over
+REAL device execution.
+
+tests/test_recorder.py proves the full tail-retention + pod-bundle
+loop on the CPU-pinned virtual mesh; this lane re-proves the two
+behaviours where the chip is load-bearing:
+
+  * tail retention triggered by REAL device-execute spans — the
+    retained errored trace's Chrome events carry genuine chip stage
+    timings, not interpret-mode noise, under head sampling 0.0;
+  * a pod incident bundle captured while a real-chip pod is serving
+    validates end-to-end and is written atomically (no torn file)
+    even with device work in flight.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from spfft_tpu import obs
+from spfft_tpu.benchmark import cutoff_stick_triplets
+from spfft_tpu.errors import GenericError
+from spfft_tpu.obs import recorder
+from spfft_tpu.serve.cluster import PodFrontend
+from spfft_tpu.serve.executor import ServeExecutor
+from spfft_tpu.serve.registry import PlanRegistry
+from spfft_tpu.types import TransformType
+
+N = 32
+
+
+@pytest.fixture(autouse=True)
+def recorder_isolation():
+    obs.disable_recorder()
+    recorder.reset_recorder()
+    yield
+    obs.disable_recorder()
+    recorder.reset_recorder()
+    obs.GLOBAL_TRACER.set_sample_rate(1.0)
+    obs.disable()
+
+
+def test_incident_loop_on_tpu(tmp_path):
+    dims = (N, N, N)
+    trip = cutoff_stick_triplets(N, N, N, 0.7, hermitian=False)
+    reg = PlanRegistry(store=False)
+    sig, plan = reg.get_or_build(TransformType.C2C, *dims, trip,
+                                 precision="single")
+    obs.enable()
+    obs.GLOBAL_TRACER.reset()
+    obs.GLOBAL_TRACER.set_sample_rate(0.0)  # head sampling OFF
+    obs.enable_recorder(incident_dir=str(tmp_path),
+                        min_interval_s=0.0)
+    lanes = []
+    for host in ("h0", "h1"):
+        r = PlanRegistry(store=False)
+        r.put(sig, plan)
+        lanes.append((host, ServeExecutor(r)))
+    pod = PodFrontend(lanes, seed=0)
+    rng = np.random.default_rng(0)
+    try:
+        for _ in range(4):
+            v = (rng.standard_normal(len(trip))
+                 + 1j * rng.standard_normal(len(trip))) \
+                .astype(np.complex64)
+            got = np.asarray(pod.submit_backward(sig, v)
+                             .result(timeout=300))
+            assert np.array_equal(got, np.asarray(plan.backward(v)))
+        # typed failure -> tail-retained trace with REAL chip spans
+        with pytest.raises(GenericError):
+            pod.submit_backward(sig,
+                                np.zeros(3)).result(timeout=300)
+        err = [t for t in obs.retained_traces()
+               if t["reason"] == "error"]
+        assert err, "errored trace not tail-retained on the chip"
+        # pod bundle captured mid-serve: validates, atomically written
+        path = pod.capture_incident("tpu-ci")
+        assert path is not None
+        with open(path) as f:
+            bundle = json.load(f)
+        assert obs.validate_bundle(bundle) == []
+        assert bundle["kind"] == "pod"
+        assert set(bundle["hosts"]) == {"h0", "h1"}
+        assert not any(n.endswith(".tmp")
+                       for n in os.listdir(tmp_path))
+        assert obs.GLOBAL_TRACER.open_count() == 0
+        # still serving after capture
+        v = (rng.standard_normal(len(trip))
+             + 1j * rng.standard_normal(len(trip))) \
+            .astype(np.complex64)
+        got = np.asarray(pod.submit_backward(sig, v)
+                         .result(timeout=300))
+        assert np.array_equal(got, np.asarray(plan.backward(v)))
+    finally:
+        pod.close()
